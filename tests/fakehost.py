@@ -1,0 +1,101 @@
+"""Fake TPU host filesystem fixtures.
+
+Builds tmpdir sysfs/devfs trees with real files and symlinks, emulating the
+kernel the way the reference's tests do (reference:
+pkg/device_plugin/device_plugin_test.go:137-166, :279-323 — tmpdir trees with
+driver/iommu_group symlinks and attribute files).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass
+class FakeChip:
+    bdf: str
+    device_id: str = "0062"            # default: v4 placeholder id
+    iommu_group: str = "1"
+    numa_node: int = 0
+    vendor: str = "0x1ae0"
+    driver: Optional[str] = "vfio-pci"
+    accel_index: Optional[int] = None  # also expose /sys/class/accel + /dev/accelN
+    vfio_dev: Optional[str] = None     # e.g. "vfio3": create <bdf>/vfio-dev/vfio3
+
+
+class FakeHost:
+    """Materialize chips/mdevs/devfs under a root dir; returns a Config-able root."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.pci = os.path.join(self.root, "sys/bus/pci/devices")
+        self.drivers = os.path.join(self.root, "sys/bus/pci/drivers")
+        self.iommu_groups = os.path.join(self.root, "sys/kernel/iommu_groups")
+        self.mdev = os.path.join(self.root, "sys/bus/mdev/devices")
+        self.accel = os.path.join(self.root, "sys/class/accel")
+        self.devfs = os.path.join(self.root, "dev")
+        for d in (self.pci, self.drivers, self.iommu_groups, self.mdev,
+                  self.accel, os.path.join(self.devfs, "vfio")):
+            os.makedirs(d, exist_ok=True)
+        self._write(os.path.join(self.devfs, "vfio", "vfio"), "")
+
+    def _write(self, path: str, content: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="ascii") as f:
+            f.write(content)
+
+    def add_chip(self, chip: FakeChip) -> None:
+        base = os.path.join(self.pci, chip.bdf)
+        os.makedirs(base, exist_ok=True)
+        self._write(os.path.join(base, "vendor"), chip.vendor + "\n")
+        self._write(os.path.join(base, "device"), "0x" + chip.device_id + "\n")
+        self._write(os.path.join(base, "numa_node"), f"{chip.numa_node}\n")
+        if chip.driver:
+            drv_dir = os.path.join(self.drivers, chip.driver)
+            os.makedirs(drv_dir, exist_ok=True)
+            link = os.path.join(base, "driver")
+            if not os.path.islink(link):
+                os.symlink(drv_dir, link)
+        grp_dir = os.path.join(self.iommu_groups, chip.iommu_group)
+        os.makedirs(grp_dir, exist_ok=True)
+        link = os.path.join(base, "iommu_group")
+        if not os.path.islink(link):
+            os.symlink(grp_dir, link)
+        self._write(os.path.join(self.devfs, "vfio", chip.iommu_group), "")
+        if chip.accel_index is not None:
+            accel_dir = os.path.join(self.accel, f"accel{chip.accel_index}")
+            os.makedirs(accel_dir, exist_ok=True)
+            dev_link = os.path.join(accel_dir, "device")
+            if not os.path.islink(dev_link):
+                os.symlink(base, dev_link)
+            self._write(os.path.join(self.devfs, f"accel{chip.accel_index}"), "")
+        if chip.vfio_dev:
+            os.makedirs(os.path.join(base, "vfio-dev", chip.vfio_dev), exist_ok=True)
+            self._write(os.path.join(self.devfs, "vfio", "devices", chip.vfio_dev), "")
+
+    def enable_iommufd(self) -> None:
+        self._write(os.path.join(self.devfs, "iommu"), "")
+
+    def add_mdev(self, uuid: str, type_name: str, parent_bdf: str) -> None:
+        """mdev device: a symlink whose resolved path has the parent BDF
+        second-to-last (reference derives parent that way, :347-357)."""
+        parent_dir = os.path.join(self.pci, parent_bdf)
+        real = os.path.join(parent_dir, uuid)
+        os.makedirs(os.path.join(real, "mdev_type"), exist_ok=True)
+        self._write(os.path.join(real, "mdev_type", "name"), type_name + "\n")
+        link = os.path.join(self.mdev, uuid)
+        if not os.path.islink(link):
+            os.symlink(real, link)
+
+    def add_shared_device(self, name: str, member_bdfs: Sequence[str],
+                          class_name: str = "egm") -> None:
+        """EGM-analogue shared device: class entry + membership file + /dev node."""
+        base = os.path.join(self.root, "sys/class", class_name, name)
+        os.makedirs(base, exist_ok=True)
+        self._write(os.path.join(base, "chip_devices"), "\n".join(member_bdfs) + "\n")
+        self._write(os.path.join(self.devfs, name), "")
+
+    def remove_vfio_group(self, group: str) -> None:
+        os.unlink(os.path.join(self.devfs, "vfio", group))

@@ -1,0 +1,159 @@
+"""Discovery over fake sysfs trees (reference: device_plugin_test.go:279-323)."""
+
+import json
+import os
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin.config import Config
+from tpu_device_plugin import discovery
+
+
+def make_cfg(host, **overrides):
+    cfg = Config().with_root(host.root)
+    if overrides:
+        from dataclasses import replace
+        cfg = replace(cfg, **overrides)
+    return cfg
+
+
+def test_helpers(tmp_path):
+    p = tmp_path / "vendor"
+    p.write_text("0x1ae0\n")
+    assert discovery.read_id_from_file(str(p)) == "1ae0"
+    p.write_text("1ae0\n")  # fixture without 0x prefix still parses
+    assert discovery.read_id_from_file(str(p)) == "1ae0"
+    assert discovery.read_id_from_file(str(tmp_path / "missing")) is None
+
+    n = tmp_path / "numa_node"
+    n.write_text("-1\n")
+    assert discovery.read_numa_node(str(n)) == 0  # negative clamps to 0
+    n.write_text("1\n")
+    assert discovery.read_numa_node(str(n)) == 1
+    assert discovery.read_numa_node(str(tmp_path / "missing")) == 0
+
+    target = tmp_path / "tgt"
+    target.mkdir()
+    link = tmp_path / "lnk"
+    os.symlink(str(target), str(link))
+    assert discovery.read_link_basename(str(link)) == "tgt"
+    assert discovery.read_link_basename(str(tmp_path / "none")) is None
+
+
+def test_passthrough_discovery_filters(tmp_path):
+    host = FakeHost(tmp_path)
+    # 4 valid v4 chips in 2 iommu groups across 2 numa nodes
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:06.0", iommu_group="12", numa_node=1))
+    host.add_chip(FakeChip("0000:00:07.0", iommu_group="12", numa_node=1))
+    # filtered out: wrong vendor, wrong driver, no driver
+    host.add_chip(FakeChip("0000:00:08.0", vendor="0x10de", iommu_group="13"))
+    host.add_chip(FakeChip("0000:00:09.0", driver="gvnic", iommu_group="14"))
+    host.add_chip(FakeChip("0000:00:0a.0", driver=None, iommu_group="15"))
+
+    registry, generations = discovery.discover_passthrough(make_cfg(host))
+
+    devs = registry.devices_by_model["0062"]
+    assert len(devs) == 4
+    assert set(registry.bdf_to_group) == {
+        "0000:00:04.0", "0000:00:05.0", "0000:00:06.0", "0000:00:07.0"}
+    assert registry.bdf_to_group["0000:00:04.0"] == "11"
+    assert len(registry.iommu_map["12"]) == 2
+    # v4 chips picked up 2x2x1 torus coords in BDF order
+    by_bdf = {d.bdf: d for d in devs}
+    assert by_bdf["0000:00:04.0"].ici_coords == (0, 0, 0)
+    assert by_bdf["0000:00:07.0"].ici_coords == (1, 1, 0)
+    assert by_bdf["0000:00:06.0"].numa_node == 1
+    assert generations["0062"].name == "v4"
+
+
+def test_accel_correlation_and_hints(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", accel_index=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", accel_index=1))
+    hints = tmp_path / "topo.json"
+    hints.write_text(json.dumps({"0000:00:05.0": [1, 1, 0]}))
+    cfg = make_cfg(host, topology_hints_path=str(hints))
+    registry, _ = discovery.discover_passthrough(cfg)
+    by_bdf = {d.bdf: d for d in registry.devices_by_model["0062"]}
+    assert by_bdf["0000:00:04.0"].accel_index == 0
+    assert by_bdf["0000:00:05.0"].accel_index == 1
+    assert by_bdf["0000:00:05.0"].ici_coords == (1, 1, 0)
+    assert by_bdf["0000:00:04.0"].ici_coords == (0, 0, 0)
+
+
+def test_mdev_partition_discovery(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=1))
+    host.add_mdev("uuid-1", "TPU v4 half chip", "0000:00:04.0")
+    host.add_mdev("uuid-2", "TPU v4 half chip", "0000:00:04.0")
+    registry, _ = discovery.discover(make_cfg(host))
+    parts = registry.partitions_by_type["TPU_v4_half_chip"]
+    assert {p.uuid for p in parts} == {"uuid-1", "uuid-2"}
+    assert parts[0].parent_bdf == "0000:00:04.0"
+    assert parts[0].numa_node == 1
+    assert parts[0].provider == "mdev"
+    assert registry.parent_to_partitions["0000:00:04.0"] == ("uuid-1", "uuid-2")
+
+
+def test_logical_partition_per_core(tmp_path):
+    host = FakeHost(tmp_path)
+    # accel-owned chip (not vfio): driver is the accel driver
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    cfg = make_cfg(host, partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    parts = registry.partitions_by_type["v4-core"]
+    assert {p.uuid for p in parts} == {"0000:00:04.0-core0", "0000:00:04.0-core1"}
+    assert all(p.provider == "logical" and p.accel_index == 0 for p in parts)
+    # the vfio passthrough map must NOT include the accel-owned chip
+    assert registry.bdf_to_group == {}
+
+
+def test_explicit_logical_partitions(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=2))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "v4 shared", "parent_bdf": "0000:00:04.0"},
+        {"uuid": "bad"},  # missing keys -> skipped
+    ]}))
+    registry, _ = discovery.discover(make_cfg(host, partition_config_path=str(pc)))
+    parts = registry.partitions_by_type["v4_shared"]
+    assert parts[0].uuid == "p0"
+    assert parts[0].accel_index == 2
+    assert len(registry.partitions_by_type) == 1
+
+
+def test_empty_host(tmp_path):
+    host = FakeHost(tmp_path)
+    registry, _ = discovery.discover(make_cfg(host))
+    assert registry.all_devices() == []
+    assert registry.partitions_by_type == {}
+
+
+def test_per_core_skips_foreign_accel_vendor(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", vendor="0x8086",
+                           driver="intel_vpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    registry, _ = discovery.discover(make_cfg(host, partition_config_path=str(pc)))
+    assert registry.partitions_by_type == {}
+
+
+def test_non_dict_config_files_tolerated(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    gm = tmp_path / "gens.json"
+    gm.write_text("[1, 2]")
+    pc = tmp_path / "parts.json"
+    pc.write_text("[]")
+    cfg = make_cfg(host, generation_map_path=str(gm),
+                   partition_config_path=str(pc))
+    registry, generations = discovery.discover(cfg)
+    assert len(registry.all_devices()) == 1   # discovery survives bad configs
+    assert generations["0062"].name == "v4"   # built-ins retained

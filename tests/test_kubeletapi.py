@@ -1,0 +1,110 @@
+"""Protocol layer: protobuf wire roundtrips + gRPC over a unix socket."""
+
+import os
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.kubeletapi import pb
+
+
+def test_device_roundtrip():
+    d = pb.Device(
+        ID="0000:00:05.0",
+        health=api.HEALTHY,
+        topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=1)]),
+    )
+    e = pb.Device.FromString(d.SerializeToString())
+    assert e.ID == "0000:00:05.0"
+    assert e.health == "Healthy"
+    assert e.topology.nodes[0].ID == 1
+
+
+def test_allocate_response_roundtrip():
+    resp = pb.AllocateResponse(
+        container_responses=[
+            pb.ContainerAllocateResponse(
+                envs={"PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4": "0000:00:05.0"},
+                devices=[
+                    pb.DeviceSpec(host_path="/dev/vfio/vfio",
+                                  container_path="/dev/vfio/vfio",
+                                  permissions="mrw"),
+                ],
+            )
+        ]
+    )
+    e = pb.AllocateResponse.FromString(resp.SerializeToString())
+    assert e.container_responses[0].envs[
+        "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4"] == "0000:00:05.0"
+    assert e.container_responses[0].devices[0].permissions == "mrw"
+
+
+class _EchoPlugin(api.DevicePluginServicer):
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        yield pb.ListAndWatchResponse(
+            devices=[pb.Device(ID="d0", health=api.HEALTHY)])
+
+    def Allocate(self, request, context):
+        ids = list(request.container_requests[0].devices_ids)
+        return pb.AllocateResponse(container_responses=[
+            pb.ContainerAllocateResponse(envs={"IDS": ",".join(ids)})])
+
+
+@pytest.fixture
+def unix_server(tmp_path):
+    sock = os.path.join(str(tmp_path), "plugin.sock")
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    api.add_device_plugin_servicer(server, _EchoPlugin())
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield sock
+    server.stop(0)
+
+
+def test_grpc_over_unix_socket(unix_server):
+    with grpc.insecure_channel(f"unix://{unix_server}") as ch:
+        stub = api.DevicePluginStub(ch)
+        opts = stub.GetDevicePluginOptions(pb.Empty(), timeout=5)
+        assert opts.get_preferred_allocation_available is True
+        stream = stub.ListAndWatch(pb.Empty(), timeout=5)
+        first = next(iter(stream))
+        assert first.devices[0].ID == "d0"
+        resp = stub.Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["a", "b"])]),
+            timeout=5)
+        assert resp.container_responses[0].envs["IDS"] == "a,b"
+
+
+class _Kubelet(api.RegistrationServicer):
+    def __init__(self):
+        self.requests = []
+
+    def Register(self, request, context):
+        self.requests.append(request)
+        return pb.Empty()
+
+
+def test_registration_service(tmp_path):
+    sock = os.path.join(str(tmp_path), "kubelet.sock")
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    kubelet = _Kubelet()
+    api.add_registration_servicer(server, kubelet)
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    try:
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            api.RegistrationStub(ch).Register(
+                pb.RegisterRequest(version=api.API_VERSION,
+                                   endpoint="tpukubevirt-v4.sock",
+                                   resource_name="cloud-tpus.google.com/v4"),
+                timeout=5)
+        assert kubelet.requests[0].resource_name == "cloud-tpus.google.com/v4"
+        assert kubelet.requests[0].version == "v1beta1"
+    finally:
+        server.stop(0)

@@ -1,0 +1,67 @@
+"""Naming: sanitizer, generation table, pci.ids streaming parser."""
+
+import json
+
+from tpu_device_plugin import naming
+
+
+def test_sanitize_name():
+    assert naming.sanitize_name("TPU v5e / lite.pod") == "TPU_V5E___LITE_POD"
+    assert naming.sanitize_name("weird*chars()") == "WEIRDCHARS"
+
+
+def test_builtin_generations():
+    table = naming.load_generation_map(None)
+    assert table["0062"].name == "v4"
+    assert table["0063"].host_topology == (2, 4)
+
+
+def test_generation_map_override(tmp_path):
+    p = tmp_path / "gens.json"
+    p.write_text(json.dumps({
+        "00aa": {"name": "v7", "chips_per_host": 4, "host_topology": [2, 2]},
+        "bad": {"name": "x"},  # missing fields -> skipped
+    }))
+    table = naming.load_generation_map(str(p))
+    assert table["00aa"].name == "v7"
+    assert table["00aa"].host_topology == (2, 2)
+    assert "bad" not in table
+    assert table["0062"].name == "v4"  # built-ins retained
+
+
+PCI_IDS_FIXTURE = """\
+# test pci.ids with a cross-vendor duplicate device id
+10de  NVIDIA Corporation
+\t1eb8  TU104GL [Tesla T4]
+\tabcd  Fake NVIDIA Thing
+1ae0  Google, Inc.
+\t001f  NVMe device
+\tabcd  Airbrush Edge TPU
+\t\t1ae0 0001  subsystem line must be ignored
+1af4  Red Hat, Inc.
+\tabcd  Virtio Fake
+"""
+
+
+def test_pci_ids_lookup(tmp_path):
+    p = tmp_path / "pci.ids"
+    p.write_text(PCI_IDS_FIXTURE)
+    # picks the right vendor's entry for a duplicated device id
+    assert naming.pci_ids_device_name(str(p), "1ae0", "abcd") == "Airbrush Edge TPU"
+    assert naming.pci_ids_device_name(str(p), "10de", "abcd") == "Fake NVIDIA Thing"
+    assert naming.pci_ids_device_name(str(p), "1ae0", "dead") is None
+    assert naming.pci_ids_device_name(str(p), "ffff", "abcd") is None
+    assert naming.pci_ids_device_name("/nonexistent", "1ae0", "abcd") is None
+
+
+def test_resource_name_priority(tmp_path):
+    p = tmp_path / "pci.ids"
+    p.write_text(PCI_IDS_FIXTURE)
+    table = naming.load_generation_map(None)
+    # generation table wins
+    assert naming.resource_name_for("0062", table, str(p)) == "v4"
+    # pci.ids fallback, sanitized
+    assert naming.resource_name_for("abcd", table, str(p)) == "AIRBRUSH_EDGE_TPU"
+    # raw-id fallback
+    assert naming.resource_name_for("dead", table, str(p)) == "TPU_DEAD"
+    assert naming.resource_name_for("dead", table, None) == "TPU_DEAD"

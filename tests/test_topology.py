@@ -1,0 +1,146 @@
+"""ICI topology: coordinate assignment and 3-tier preferred allocation."""
+
+import pytest
+
+from tpu_device_plugin.naming import GenerationInfo
+from tpu_device_plugin.topology import (
+    AllocatableDevice,
+    MustIncludeTooLarge,
+    assign_coords,
+    preferred_allocation,
+)
+
+V5E = GenerationInfo("v5e", 8, (2, 4))
+V4 = GenerationInfo("v4", 4, (2, 2, 1))
+
+
+def bdfs(n, start=4):
+    return [f"0000:00:{i:02x}.0" for i in range(start, start + n)]
+
+
+def test_assign_coords_lexicographic():
+    ids = bdfs(4)
+    coords = assign_coords(ids, V4)
+    assert coords[ids[0]] == (0, 0, 0)
+    assert coords[ids[1]] == (0, 1, 0)
+    assert coords[ids[2]] == (1, 0, 0)
+    assert coords[ids[3]] == (1, 1, 0)
+
+
+def test_assign_coords_hints_win():
+    ids = bdfs(2)
+    coords = assign_coords(ids, V4, hints={ids[1]: (1, 1, 0)})
+    assert coords[ids[1]] == (1, 1, 0)
+    assert coords[ids[0]] == (0, 0, 0)  # first free slot
+
+
+def test_assign_coords_overflow_gets_none():
+    ids = bdfs(5)
+    coords = assign_coords(ids, V4)
+    assert sum(1 for c in coords.values() if c is None) == 1
+
+
+def _v5e_devices():
+    ids = bdfs(8)
+    coords = assign_coords(ids, V5E)
+    return ids, [AllocatableDevice(i, numa_node=0 if coords[i][0] == 0 else 1,
+                                   coords=coords[i]) for i in ids]
+
+
+def test_ici_contiguous_pair_preferred():
+    ids, devs = _v5e_devices()
+    # ask for 2 with a scattered availability order: a contiguous pair must win
+    order = [ids[0], ids[7], ids[1], ids[6]]
+    picked = preferred_allocation(devs, order, [], 2, torus_dims=(2, 4))
+    by_id = {d.device_id: d for d in devs}
+    c0, c1 = by_id[picked[0]].coords, by_id[picked[1]].coords
+    # manhattan-adjacent on the torus
+    dist = sum(min(abs(a - b), dim - abs(a - b))
+               for a, b, dim in zip(c0, c1, (2, 4)))
+    assert dist == 1
+
+
+def test_ici_full_host_slice():
+    ids, devs = _v5e_devices()
+    picked = preferred_allocation(devs, ids, [], 8, torus_dims=(2, 4))
+    assert sorted(picked) == sorted(ids)
+
+
+def test_must_include_kept_and_box_built_around_it():
+    ids, devs = _v5e_devices()
+    picked = preferred_allocation(devs, ids, [ids[5]], 4, torus_dims=(2, 4))
+    assert ids[5] in picked
+    assert len(picked) == 4
+
+
+def test_must_include_too_large():
+    ids, devs = _v5e_devices()
+    with pytest.raises(MustIncludeTooLarge):
+        preferred_allocation(devs, ids, ids[:3], 2, torus_dims=(2, 4))
+
+
+def test_numa_tier_without_coords():
+    # no torus dims -> reference-style NUMA preference
+    devs = [AllocatableDevice(f"d{i}", numa_node=i % 2) for i in range(6)]
+    order = [f"d{i}" for i in range(6)]  # alternating numa 0/1
+    picked = preferred_allocation(devs, order, [], 3)
+    assert {d for d in picked} == {"d0", "d2", "d4"}  # single NUMA node 0
+
+
+def test_kubelet_order_fallback():
+    # sizes too big for any single numa node -> kubelet order preserved
+    devs = [AllocatableDevice(f"d{i}", numa_node=i % 2) for i in range(4)]
+    order = ["d3", "d1", "d0", "d2"]
+    picked = preferred_allocation(devs, order, [], 4)
+    assert picked == order
+
+
+def test_numa_respects_must_include_node():
+    devs = [AllocatableDevice(f"d{i}", numa_node=0 if i < 3 else 1) for i in range(6)]
+    order = [f"d{i}" for i in range(6)]
+    picked = preferred_allocation(devs, order, ["d4"], 3)
+    assert "d4" in picked
+    assert all(d in {"d3", "d4", "d5"} for d in picked)
+
+
+def test_no_false_wraparound_adjacency():
+    # free chips at (0,0) and (0,3) are NOT adjacent on a partial axis of a
+    # larger pod torus; a truly adjacent pair must win
+    devs = [
+        AllocatableDevice("a", 0, (0, 0)),
+        AllocatableDevice("b", 0, (0, 3)),
+        AllocatableDevice("c", 0, (1, 1)),
+        AllocatableDevice("d", 0, (1, 2)),
+    ]
+    picked = preferred_allocation(devs, ["a", "b", "c", "d"], [], 2,
+                                  torus_dims=(2, 4))
+    assert sorted(picked) == ["c", "d"]
+
+
+def test_malformed_hints_ignored():
+    ids = bdfs(2)
+    coords = assign_coords(ids, V5E, hints={ids[0]: (1,), ids[1]: (9, 9)})
+    # both hints invalid (arity / range) -> chips fall back to free slots
+    assert coords[ids[0]] == (0, 0)
+    assert coords[ids[1]] == (0, 1)
+
+
+def test_short_arity_coords_never_match_boxes():
+    devs = [
+        AllocatableDevice("short", 0, (1,)),
+        AllocatableDevice("c", 0, (1, 1)),
+        AllocatableDevice("d", 0, (1, 2)),
+    ]
+    picked = preferred_allocation(devs, ["short", "c", "d"], [], 2,
+                                  torus_dims=(2, 4))
+    assert sorted(picked) == ["c", "d"]
+
+
+def test_load_topology_hints_bad_json(tmp_path):
+    from tpu_device_plugin.topology import load_topology_hints
+    p = tmp_path / "h.json"
+    p.write_text("[1,2,3]")
+    assert load_topology_hints(str(p)) == {}
+    p.write_text("{\"bdf\": [0, 1]}")
+    assert load_topology_hints(str(p)) == {"bdf": (0, 1)}
+    assert load_topology_hints(None) == {}

@@ -1,0 +1,18 @@
+"""tpu_device_plugin — a TPU-native KubeVirt device plugin.
+
+A Kubernetes device plugin (DaemonSet) that discovers Google Cloud TPU PCIe
+endpoints bound to vfio-pci (for PCI passthrough into KubeVirt VMIs) plus
+`/dev/accel*` character devices, advertises them to the kubelet as
+`cloud-tpus.google.com/<generation>` extended resources, serves the kubelet
+Device Plugin gRPC API v1beta1 over unix sockets, prefers ICI-adjacent chip
+groups in `GetPreferredAllocation`, and health-monitors devices with an
+inotify watcher plus a native libtpu liveness shim.
+
+Capability parity target: NVIDIA/kubevirt-gpu-device-plugin (see SURVEY.md).
+Architecture is TPU-first, not a port: discovery models ICI torus topology,
+allocation keeps slices contiguous, and the guest-side validator
+(`tpu_device_plugin.validator`) proves a passed-through slice is usable by
+running an SPMD JAX workload over `jax.devices()`.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,92 @@
+"""Injectable configuration, replacing the reference's package-global knobs.
+
+The reference keeps every tunable as a compile-time package var that doubles
+as a test seam (reference: pkg/device_plugin/device_plugin.go:70-87). Here a
+single `Config` dataclass is threaded through discovery, servers, and health;
+tests construct one pointed at tmpdir fixtures instead of monkeypatching
+globals.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from .kubeletapi.api import (
+    DEVICE_PLUGIN_PATH as _DEVICE_PLUGIN_PATH,
+    KUBELET_SOCKET as _KUBELET_SOCKET,
+)
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- sysfs / devfs roots (tests point these at tmpdir fixtures) ---------
+    pci_base_path: str = "/sys/bus/pci/devices"
+    mdev_base_path: str = "/sys/bus/mdev/devices"
+    accel_class_path: str = "/sys/class/accel"
+    # Root prefixed onto absolute /dev and /sys paths that are probed at
+    # Allocate/health time (reference: device_plugin.go:76 `rootPath`).
+    root_path: str = "/"
+    pci_ids_path: str = "/usr/pci.ids"
+
+    # --- kubelet contract (defaults from kubeletapi contract constants) -----
+    device_plugin_path: str = _DEVICE_PLUGIN_PATH
+    kubelet_socket: str = _KUBELET_SOCKET
+    socket_prefix: str = "tpukubevirt"
+
+    # --- resource naming ----------------------------------------------------
+    # Extended-resource namespace: devices surface as
+    # `cloud-tpus.google.com/<generation>` (reference advertises
+    # `nvidia.com/<pci.ids name>`, generic_device_plugin.go:57).
+    resource_namespace: str = "cloud-tpus.google.com"
+    # KubeVirt externalResourceProvider env prefix: KubeVirt's virt-launcher
+    # selects passed-through PCI devices from
+    # `PCI_RESOURCE_<RESOURCE_NAME>` (reference: generic_device_plugin.go:58).
+    env_prefix: str = "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM"
+    vtpu_env_prefix: str = "MDEV_PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM"
+
+    # --- discovery filters --------------------------------------------------
+    vendor_ids: tuple[str, ...] = ("1ae0",)  # Google, Inc.
+    vfio_drivers: tuple[str, ...] = ("vfio-pci",)
+    # Optional JSON file overriding the built-in device-id → generation table
+    # (utils/tpu_ids.json ships the defaults; real fleets may override).
+    generation_map_path: Optional[str] = None
+    # Optional JSON file mapping BDF → ICI torus coordinates for hosts whose
+    # physical chip order differs from BDF order.
+    topology_hints_path: Optional[str] = None
+
+    # --- vTPU partitions ----------------------------------------------------
+    # Optional JSON file declaring logical partitions of physical chips for
+    # hosts without mdev support (see vtpu.py).
+    partition_config_path: Optional[str] = None
+
+    # --- shared host devices (EGM analogue, reference #9) -------------------
+    # sysfs class dirs scanned for shared devices spanning multiple chips;
+    # each entry must contain a membership file listing chip BDFs.
+    shared_device_classes: tuple[str, ...] = ("/sys/class/egm",)
+
+    # --- timing -------------------------------------------------------------
+    grpc_timeout_s: float = 5.0      # registration dial bound (reference :53)
+    health_poll_s: float = 5.0       # native liveness probe cadence (NVML parity)
+    rediscovery_interval_s: float = 0.0  # 0 disables periodic re-discovery
+
+    # --- native shim --------------------------------------------------------
+    native_lib_path: Optional[str] = None  # override libtpuhealth.so location
+
+    def dev_path(self, *parts: str) -> str:
+        """Join an absolute devfs/sysfs path under root_path."""
+        return os.path.join(self.root_path, *[p.lstrip("/") for p in parts])
+
+    def with_root(self, root: str) -> "Config":
+        """Convenience for tests: re-root every filesystem path under `root`."""
+        return replace(
+            self,
+            pci_base_path=os.path.join(root, "sys/bus/pci/devices"),
+            mdev_base_path=os.path.join(root, "sys/bus/mdev/devices"),
+            accel_class_path=os.path.join(root, "sys/class/accel"),
+            root_path=root,
+            device_plugin_path=os.path.join(root, "device-plugins/"),
+            kubelet_socket=os.path.join(root, "device-plugins/kubelet.sock"),
+            shared_device_classes=(os.path.join(root, "sys/class/egm"),),
+        )

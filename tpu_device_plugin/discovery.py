@@ -1,0 +1,281 @@
+"""Host discovery: VFIO-bound TPU chips, /dev/accel correlation, partitions.
+
+TPU analogue of the reference's sysfs walks
+(`createIommuDeviceMap` device_plugin.go:187-247, `createVgpuIDMap` :255-291):
+walk /sys/bus/pci/devices filtering vendor 1ae0 + vfio drivers, read the
+iommu_group symlink / NUMA node / device id, then additionally correlate
+/sys/class/accel char devices and stamp each chip with ICI torus coordinates.
+Discovery is one-shot and side-effect free: it returns an immutable Registry.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .config import Config
+from .naming import GenerationInfo, load_generation_map
+from .registry import Registry, TpuDevice, TpuPartition
+from .topology import assign_coords, load_topology_hints
+
+log = logging.getLogger(__name__)
+
+_ACCEL_RE = re.compile(r"^accel(\d+)$")
+
+
+# --- low-level sysfs readers (unit-testable against tmpdir fixtures) ---------
+
+def read_id_from_file(path: str) -> Optional[str]:
+    """Read a sysfs hex id file, stripping the 0x prefix.
+
+    The reference slices bytes 2: unconditionally (device_plugin.go:294-302);
+    we only strip an actual `0x` so hand-written fixtures also parse.
+    """
+    try:
+        with open(path, "r", encoding="ascii", errors="replace") as f:
+            data = f.read().strip()
+    except OSError as exc:
+        log.debug("could not read %s: %s", path, exc)
+        return None
+    return data[2:] if data.lower().startswith("0x") else data
+
+
+def read_link_basename(path: str) -> Optional[str]:
+    """Basename of a sysfs symlink target (driver name, iommu group number)."""
+    try:
+        return os.path.basename(os.readlink(path))
+    except OSError as exc:
+        log.debug("could not readlink %s: %s", path, exc)
+        return None
+
+
+def read_numa_node(path: str) -> int:
+    """NUMA node, clamping negatives (unset) to 0 (reference :304-320)."""
+    try:
+        with open(path, "r", encoding="ascii") as f:
+            node = int(f.read().strip())
+    except (OSError, ValueError) as exc:
+        log.debug("could not read numa node %s: %s", path, exc)
+        return 0
+    return max(node, 0)
+
+
+def scan_accel_class(accel_class_path: str) -> Dict[str, int]:
+    """Map PCI BDF → /dev/accelN index via /sys/class/accel/accelN/device.
+
+    Only populated on hosts where the accel driver still owns chips (i.e. the
+    vTPU/logical-partition path); vfio-bound chips vanish from this class.
+    """
+    out: Dict[str, int] = {}
+    try:
+        entries = sorted(os.listdir(accel_class_path))
+    except OSError:
+        return out
+    for entry in entries:
+        m = _ACCEL_RE.match(entry)
+        if not m:
+            continue
+        bdf = read_link_basename(os.path.join(accel_class_path, entry, "device"))
+        if bdf:
+            out[bdf] = int(m.group(1))
+    return out
+
+
+# --- passthrough discovery ---------------------------------------------------
+
+def discover_passthrough(
+    cfg: Config,
+    accel_by_bdf: Optional[Dict[str, int]] = None,
+) -> Tuple[Registry, Dict[str, GenerationInfo]]:
+    """Walk the PCI bus for VFIO-bound TPU endpoints; build the registry maps."""
+    generations = load_generation_map(cfg.generation_map_path)
+    hints = load_topology_hints(cfg.topology_hints_path)
+    if accel_by_bdf is None:
+        accel_by_bdf = scan_accel_class(cfg.accel_class_path)
+
+    raw: List[TpuDevice] = []
+    try:
+        entries = sorted(os.listdir(cfg.pci_base_path))
+    except OSError as exc:
+        log.warning("PCI sysfs %s unreadable: %s", cfg.pci_base_path, exc)
+        entries = []
+    for bdf in entries:
+        base = os.path.join(cfg.pci_base_path, bdf)
+        if not os.path.isdir(base):
+            continue
+        vendor = read_id_from_file(os.path.join(base, "vendor"))
+        if vendor is None or vendor.lower() not in cfg.vendor_ids:
+            continue
+        driver = read_link_basename(os.path.join(base, "driver"))
+        if driver not in cfg.vfio_drivers:
+            log.info("TPU %s bound to %r, not a vfio driver; skipping", bdf, driver)
+            continue
+        group = read_link_basename(os.path.join(base, "iommu_group"))
+        if group is None:
+            log.warning("TPU %s has no iommu_group; skipping", bdf)
+            continue
+        device_id = read_id_from_file(os.path.join(base, "device"))
+        if device_id is None:
+            log.warning("TPU %s has no device id; skipping", bdf)
+            continue
+        raw.append(
+            TpuDevice(
+                bdf=bdf,
+                device_id=device_id.lower(),
+                iommu_group=group,
+                numa_node=read_numa_node(os.path.join(base, "numa_node")),
+                accel_index=accel_by_bdf.get(bdf),
+            )
+        )
+
+    # Stamp ICI coordinates per model (coords are host-local per generation).
+    by_model: Dict[str, List[TpuDevice]] = {}
+    for dev in raw:
+        by_model.setdefault(dev.device_id, []).append(dev)
+    devices_by_model: Dict[str, Tuple[TpuDevice, ...]] = {}
+    iommu_map: Dict[str, List[TpuDevice]] = {}
+    bdf_to_group: Dict[str, str] = {}
+    for model, devs in by_model.items():
+        coords = assign_coords([d.bdf for d in devs], generations.get(model), hints)
+        stamped = tuple(
+            TpuDevice(
+                bdf=d.bdf, device_id=d.device_id, iommu_group=d.iommu_group,
+                numa_node=d.numa_node, accel_index=d.accel_index,
+                ici_coords=coords.get(d.bdf),
+            )
+            for d in devs
+        )
+        devices_by_model[model] = stamped
+        for d in stamped:
+            iommu_map.setdefault(d.iommu_group, []).append(d)
+            bdf_to_group[d.bdf] = d.iommu_group
+
+    registry = Registry(
+        devices_by_model=devices_by_model,
+        iommu_map={g: tuple(ds) for g, ds in iommu_map.items()},
+        bdf_to_group=bdf_to_group,
+    )
+    log.info("discovered %d VFIO TPU chips in %d iommu groups",
+             len(raw), len(registry.iommu_map))
+    return registry, generations
+
+
+# --- vTPU (partition) discovery ----------------------------------------------
+
+def _sanitize_type(raw: str) -> str:
+    return raw.strip().replace(" ", "_")
+
+
+def discover_mdev_partitions(cfg: Config) -> List[TpuPartition]:
+    """Enumerate kernel mdev devices (reference vGPU path, :255-291)."""
+    out: List[TpuPartition] = []
+    try:
+        uuids = sorted(os.listdir(cfg.mdev_base_path))
+    except OSError:
+        return out
+    for uuid in uuids:
+        base = os.path.join(cfg.mdev_base_path, uuid)
+        type_name = None
+        name_path = os.path.join(base, "mdev_type", "name")
+        try:
+            with open(name_path, "r", encoding="ascii", errors="replace") as f:
+                type_name = _sanitize_type(f.read())
+        except OSError as exc:
+            log.warning("mdev %s has no type name (%s); skipping", uuid, exc)
+            continue
+        # Parent BDF = second-to-last element of the resolved mdev path
+        # (reference derives it the same way, :347-357).
+        try:
+            real = os.path.realpath(base)
+            parent_bdf = real.rstrip("/").split("/")[-2]
+        except (OSError, IndexError):
+            log.warning("mdev %s parent unresolvable; skipping", uuid)
+            continue
+        numa = read_numa_node(os.path.join(cfg.pci_base_path, parent_bdf, "numa_node"))
+        out.append(TpuPartition(uuid=uuid, type_name=type_name,
+                                parent_bdf=parent_bdf, numa_node=numa,
+                                provider="mdev"))
+    return out
+
+
+def discover_logical_partitions(
+    cfg: Config,
+    generations: Dict[str, GenerationInfo],
+    accel_by_bdf: Optional[Dict[str, int]] = None,
+) -> List[TpuPartition]:
+    """Synthesize partitions where hardware lacks mdev (SURVEY.md §7 hard part d).
+
+    TPU chips expose no mediated-device layer; multi-tenant chip sharing is a
+    host-software construct. Two declaration styles in the partition config
+    JSON (Config.partition_config_path):
+
+    - {"per_core": true} — split every accel-owned chip into
+      `cores_per_chip` partitions named `<gen>-core`, uuid `<bdf>-coreN`.
+    - {"partitions": [{"uuid": ..., "type": ..., "parent_bdf": ...}]} —
+      explicit list.
+    """
+    if not cfg.partition_config_path:
+        return []
+    try:
+        with open(cfg.partition_config_path, "r", encoding="utf-8") as f:
+            spec = json.load(f)
+        if not isinstance(spec, dict):
+            raise ValueError("top level must be an object")
+    except (OSError, ValueError) as exc:
+        log.warning("partition config %s unreadable: %s", cfg.partition_config_path, exc)
+        return []
+    out: List[TpuPartition] = []
+    if accel_by_bdf is None:
+        accel_by_bdf = scan_accel_class(cfg.accel_class_path)
+    if spec.get("per_core"):
+        for bdf, accel_idx in sorted(accel_by_bdf.items()):
+            vendor = read_id_from_file(os.path.join(cfg.pci_base_path, bdf, "vendor"))
+            if vendor is None or vendor.lower() not in cfg.vendor_ids:
+                continue  # foreign accel-class hardware (VPU/Habana/...) is not a TPU
+            device_id = read_id_from_file(os.path.join(cfg.pci_base_path, bdf, "device"))
+            info = generations.get((device_id or "").lower())
+            cores = info.cores_per_chip if info else 1
+            gen = info.name if info else "tpu"
+            numa = read_numa_node(os.path.join(cfg.pci_base_path, bdf, "numa_node"))
+            for core in range(cores):
+                out.append(TpuPartition(
+                    uuid=f"{bdf}-core{core}", type_name=f"{gen}-core",
+                    parent_bdf=bdf, numa_node=numa,
+                    provider="logical", accel_index=accel_idx,
+                ))
+    for entry in spec.get("partitions", []):
+        try:
+            bdf = entry["parent_bdf"]
+            out.append(TpuPartition(
+                uuid=entry["uuid"], type_name=_sanitize_type(entry["type"]),
+                parent_bdf=bdf,
+                numa_node=read_numa_node(os.path.join(cfg.pci_base_path, bdf, "numa_node")),
+                provider="logical", accel_index=accel_by_bdf.get(bdf),
+            ))
+        except KeyError as exc:
+            log.warning("partition entry %r missing %s; skipped", entry, exc)
+    return out
+
+
+def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
+    """Full discovery: passthrough chips + mdev/logical partitions."""
+    accel_by_bdf = scan_accel_class(cfg.accel_class_path)
+    registry, generations = discover_passthrough(cfg, accel_by_bdf)
+    partitions = discover_mdev_partitions(cfg)
+    partitions += discover_logical_partitions(cfg, generations, accel_by_bdf)
+    by_type: Dict[str, List[TpuPartition]] = {}
+    parent_map: Dict[str, List[str]] = {}
+    for p in partitions:
+        by_type.setdefault(p.type_name, []).append(p)
+        parent_map.setdefault(p.parent_bdf, []).append(p.uuid)
+    registry = Registry(
+        devices_by_model=registry.devices_by_model,
+        iommu_map=registry.iommu_map,
+        bdf_to_group=registry.bdf_to_group,
+        partitions_by_type={t: tuple(ps) for t, ps in by_type.items()},
+        parent_to_partitions={b: tuple(us) for b, us in parent_map.items()},
+    )
+    return registry, generations

@@ -1,0 +1,23 @@
+"""Kubelet Device Plugin API v1beta1 — messages, constants, gRPC wiring.
+
+The protobuf messages are generated from `proto/deviceplugin_v1beta1.proto`
+(`make proto`); the gRPC service/stub wiring is hand-written in `api.py`
+because this image ships no grpc codegen plugin. Wire-compatible with the
+kubelet's published v1beta1 contract (reference:
+vendor/k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/api.proto).
+"""
+
+from . import deviceplugin_v1beta1_pb2 as pb  # noqa: F401
+from .api import (  # noqa: F401
+    API_VERSION,
+    DEVICE_PLUGIN_PATH,
+    HEALTHY,
+    KUBELET_SOCKET,
+    UNHEALTHY,
+    DevicePluginServicer,
+    DevicePluginStub,
+    RegistrationServicer,
+    RegistrationStub,
+    add_device_plugin_servicer,
+    add_registration_servicer,
+)

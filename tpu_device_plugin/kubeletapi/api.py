@@ -1,0 +1,137 @@
+"""Hand-rolled gRPC service/stub wiring for the kubelet v1beta1 API.
+
+grpcio's generic handler API lets us register method handlers without
+generated service stubs. Method paths (`/v1beta1.DevicePlugin/...`) and the
+constants below are part of the kubelet contract (reference:
+vendor/k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/constants.go:19-46).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import deviceplugin_v1beta1_pb2 as pb
+
+# -- kubelet contract constants ------------------------------------------------
+API_VERSION = "v1beta1"
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+_DEVICE_PLUGIN_SERVICE = "v1beta1.DevicePlugin"
+_REGISTRATION_SERVICE = "v1beta1.Registration"
+
+
+class DevicePluginServicer:
+    """Server-side interface for the DevicePlugin service (5 RPCs)."""
+
+    def GetDevicePluginOptions(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetDevicePluginOptions")
+
+    def ListAndWatch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ListAndWatch")
+
+    def GetPreferredAllocation(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetPreferredAllocation")
+
+    def Allocate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Allocate")
+
+    def PreStartContainer(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "PreStartContainer")
+
+
+def add_device_plugin_servicer(server: grpc.Server, servicer: DevicePluginServicer) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_DEVICE_PLUGIN_SERVICE, handlers),)
+    )
+
+
+class DevicePluginStub:
+    """Client stub for the DevicePlugin service (what the kubelet dials)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN_SERVICE}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_DEVICE_PLUGIN_SERVICE}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN_SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN_SERVICE}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN_SERVICE}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+
+class RegistrationServicer:
+    """Server-side interface for the Registration service (fake kubelet in tests)."""
+
+    def Register(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Register")
+
+
+def add_registration_servicer(server: grpc.Server, servicer: RegistrationServicer) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_REGISTRATION_SERVICE, handlers),)
+    )
+
+
+class RegistrationStub:
+    """Client stub for the kubelet Registration service (the plugin dials this)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{_REGISTRATION_SERVICE}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )

@@ -1,0 +1,136 @@
+"""Resource naming: PCI device id → TPU generation, with pci.ids fallback.
+
+The reference names resources by streaming /usr/pci.ids for the device's
+marketing name (reference: pkg/device_plugin/device_plugin.go:371-438) and
+falls back to the raw device id (:125-127). pci.ids carries **no Cloud TPU
+device ids** (vendor 1ae0 lists only NVMe/gVNIC/Pixel entries), so the TPU
+build leads with a built-in, overridable device-id → generation table and
+keeps the pci.ids scan only as a display-name fallback for unknown ids.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class GenerationInfo:
+    """Static per-generation facts used for naming and ICI topology."""
+
+    name: str                     # resource name suffix, e.g. "v5e"
+    chips_per_host: int           # chips a single host exposes
+    host_topology: Tuple[int, ...]  # host-local ICI torus dims, prod(dims) == chips_per_host
+    cores_per_chip: int = 1       # logical vTPU partitions a chip supports
+
+
+# Built-in defaults. pci.ids has no Cloud TPU ids, and Google does not publish
+# a PCI-id table for TPUs, so these ids are *placeholders chosen for tests and
+# examples*; production fleets override via utils/tpu_ids.json or
+# --generation-map (Config.generation_map_path). The table shape — id →
+# generation + host torus — is the contract; the key values are data.
+DEFAULT_GENERATIONS: Dict[str, GenerationInfo] = {
+    # 3D-torus generations: 4 chips/host arranged 2x2x1.
+    "0062": GenerationInfo("v4", 4, (2, 2, 1), cores_per_chip=2),
+    "0064": GenerationInfo("v5p", 4, (2, 2, 1), cores_per_chip=2),
+    # 2D-torus generations: v5e-8 hosts expose 8 chips as 2x4.
+    "0063": GenerationInfo("v5e", 8, (2, 4), cores_per_chip=1),
+    "0065": GenerationInfo("v6e", 8, (2, 4), cores_per_chip=1),
+}
+
+_SANITIZE_KEEP = re.compile(r"[^A-Z0-9_]")
+
+
+def sanitize_name(raw: str) -> str:
+    """Uppercase and strip to [A-Z0-9_], mapping separators to underscores.
+
+    Mirrors the reference's name sanitizer so resource names stay valid k8s
+    extended-resource names (reference: device_plugin.go:388-415).
+    """
+    out = raw.strip().upper()
+    for ch in ("/", ".", " ", "-", ":"):
+        out = out.replace(ch, "_")
+    return _SANITIZE_KEEP.sub("", out)
+
+
+def load_generation_map(path: Optional[str]) -> Dict[str, GenerationInfo]:
+    """Built-in table, optionally overlaid with a JSON override file.
+
+    Override format: {"<device_id>": {"name": "v5e", "chips_per_host": 8,
+    "host_topology": [2, 4], "cores_per_chip": 1}, ...}
+    """
+    table = dict(DEFAULT_GENERATIONS)
+    if not path:
+        return table
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        if not isinstance(raw, dict):
+            raise ValueError("top level must be an object of device_id -> info")
+    except (OSError, ValueError) as exc:
+        log.warning("generation map %s unreadable (%s); using built-ins", path, exc)
+        return table
+    for dev_id, info in raw.items():
+        try:
+            table[dev_id.lower()] = GenerationInfo(
+                name=str(info["name"]),
+                chips_per_host=int(info["chips_per_host"]),
+                host_topology=tuple(int(d) for d in info["host_topology"]),
+                cores_per_chip=int(info.get("cores_per_chip", 1)),
+            )
+        except (KeyError, TypeError, ValueError) as exc:
+            log.warning("generation map entry %r invalid (%s); skipped", dev_id, exc)
+    return table
+
+
+def pci_ids_device_name(pci_ids_path: str, vendor_id: str, device_id: str) -> Optional[str]:
+    """Stream pci.ids for `vendor_id`'s `device_id` name; None if absent.
+
+    Same scan discipline as the reference — seek the vendor line, then match
+    tab-indented device lines under it, stopping at the next vendor
+    (reference: device_plugin.go:424-438, :371-422) — but written as a
+    single-pass generator over the file.
+    """
+    vendor_id = vendor_id.lower()
+    device_id = device_id.lower()
+    try:
+        with open(pci_ids_path, "r", encoding="utf-8", errors="replace") as f:
+            in_vendor = False
+            for line in f:
+                if not line.strip() or line.startswith("#"):
+                    continue
+                if not line.startswith("\t"):
+                    in_vendor = line[:4].lower() == vendor_id
+                    continue
+                if in_vendor and not line.startswith("\t\t"):
+                    entry = line.strip()
+                    if entry[:4].lower() == device_id:
+                        return entry[4:].strip()
+    except OSError as exc:
+        log.warning("pci.ids %s unreadable: %s", pci_ids_path, exc)
+    return None
+
+
+def resource_name_for(
+    device_id: str,
+    generations: Dict[str, GenerationInfo],
+    pci_ids_path: Optional[str] = None,
+    vendor_id: str = "1ae0",
+) -> str:
+    """Resource-name suffix for a device id: generation, pci.ids name, or raw id.
+
+    Advertised as `<namespace>/<this>`, e.g. `cloud-tpus.google.com/v5e`.
+    """
+    info = generations.get(device_id.lower())
+    if info is not None:
+        return info.name
+    if pci_ids_path:
+        name = pci_ids_device_name(pci_ids_path, vendor_id, device_id)
+        if name:
+            return sanitize_name(name)
+    return sanitize_name(f"TPU_{device_id}")

@@ -1,0 +1,92 @@
+"""Device registry: typed records + lookup maps, no package globals.
+
+The reference keeps five package-global maps mutated during discovery
+(reference: pkg/device_plugin/device_plugin.go:50-68, getters :359-369).
+Here discovery returns one immutable `Registry` value that is injected into
+every consumer, so tests never share state and servers can atomically swap
+registries on re-discovery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TpuDevice:
+    """One TPU PCIe endpoint bound to a VFIO driver.
+
+    Extends the reference's `NvidiaGpuDevice{addr, numaNode}`
+    (device_plugin.go:50-53) with TPU-native attributes: the PCI device id
+    (drives generation naming), the correlated `/dev/accel*` index when the
+    accel driver owns the chip, and the chip's ICI torus coordinates.
+    """
+
+    bdf: str                                  # PCI address, e.g. "0000:00:05.0"
+    device_id: str                            # PCI device id hex, no 0x prefix
+    iommu_group: str                          # e.g. "42"
+    numa_node: int                            # negative values clamped to 0
+    accel_index: Optional[int] = None         # /dev/accelN, if correlated
+    ici_coords: Optional[Tuple[int, ...]] = None  # host-local torus coords
+
+
+@dataclass(frozen=True)
+class TpuPartition:
+    """One shareable sub-chip partition (vTPU; the reference's vGPU/mdev slot).
+
+    Covers both providers: kernel mdev devices (uuid = mdev UUID,
+    reference: device_plugin.go:255-291) and logical partitions declared in
+    a partition config for hardware without mdev (uuid is synthesized).
+    """
+
+    uuid: str
+    type_name: str                            # sanitized partition type
+    parent_bdf: str
+    numa_node: int
+    provider: str = "mdev"                    # "mdev" | "logical"
+    accel_index: Optional[int] = None         # logical partitions ride /dev/accelN
+
+
+@dataclass(frozen=True)
+class SharedDevice:
+    """A host device shared across several chips (EGM analogue, reference #9).
+
+    Injected into an allocation only when *every* member chip is allocated
+    (all-or-nothing, reference: generic_device_plugin.go:159-184).
+    """
+
+    name: str                                 # e.g. "egm0"
+    dev_path: str                             # e.g. "/dev/egm0"
+    member_bdfs: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class Registry:
+    """Immutable snapshot of everything discovery found on this host."""
+
+    # device id → devices of that model (reference `deviceMap`, :59)
+    devices_by_model: Dict[str, Tuple[TpuDevice, ...]] = field(default_factory=dict)
+    # iommu group → all devices in the group (reference `iommuMap`, :56)
+    iommu_map: Dict[str, Tuple[TpuDevice, ...]] = field(default_factory=dict)
+    # BDF → iommu group (reference `bdfToIommuMap`, :62)
+    bdf_to_group: Dict[str, str] = field(default_factory=dict)
+    # partition type → partitions (reference `vGpuMap`, :65)
+    partitions_by_type: Dict[str, Tuple[TpuPartition, ...]] = field(default_factory=dict)
+    # parent BDF → partition uuids (reference `gpuVgpuMap`, :68)
+    parent_to_partitions: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    def device(self, bdf: str) -> Optional[TpuDevice]:
+        group = self.bdf_to_group.get(bdf)
+        if group is None:
+            return None
+        for dev in self.iommu_map.get(group, ()):
+            if dev.bdf == bdf:
+                return dev
+        return None
+
+    def all_devices(self) -> List[TpuDevice]:
+        out: List[TpuDevice] = []
+        for devs in self.devices_by_model.values():
+            out.extend(devs)
+        return out

@@ -1,0 +1,185 @@
+"""ICI topology model and topology-aware preferred allocation.
+
+This is the TPU-native replacement for the reference's NUMA-only
+`GetPreferredAllocation` (reference: pkg/device_plugin/generic_device_plugin.go:470-608)
+and the domain analogue of "parallelism strategy" (SURVEY.md §2 #18): the
+scale dimension of a device plugin is *slice shape*. Chips on one host sit at
+coordinates of a small ICI torus (3D for v4/v5p, 2D for v5e/v6e); a VMI that
+receives an axis-aligned contiguous sub-slice can run XLA collectives over
+ICI, while a ragged set falls back to PCIe/DCN. Preference order:
+
+1. smallest axis-aligned ICI sub-box that covers the request,
+2. single NUMA node (reference behavior),
+3. kubelet-provided order (reference fallback).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .naming import GenerationInfo
+
+log = logging.getLogger(__name__)
+
+Coords = Tuple[int, ...]
+
+
+def load_topology_hints(path: Optional[str]) -> Dict[str, Coords]:
+    """Optional JSON map BDF → [x, y, ...] torus coordinates."""
+    if not path:
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        if not isinstance(raw, dict):
+            raise ValueError("top level must be an object of bdf -> [coords]")
+        return {bdf: tuple(int(c) for c in coords) for bdf, coords in raw.items()}
+    except (OSError, ValueError, TypeError, AttributeError) as exc:
+        log.warning("topology hints %s unreadable (%s); ignoring", path, exc)
+        return {}
+
+
+def assign_coords(
+    bdfs: Sequence[str],
+    info: Optional[GenerationInfo],
+    hints: Optional[Dict[str, Coords]] = None,
+) -> Dict[str, Optional[Coords]]:
+    """Place each BDF on the host-local torus.
+
+    Explicit hints win. Otherwise chips are laid out in sorted-BDF order along
+    lexicographic torus coordinates — on real hosts PCIe enumeration order
+    tracks physical chip order, and fleets with exotic routing supply hints
+    (Config.topology_hints_path). BDFs beyond the torus capacity get no
+    coordinates (and therefore only NUMA-level preference).
+    """
+    hints = hints or {}
+    out: Dict[str, Optional[Coords]] = {}
+    if info is None:
+        return {bdf: hints.get(bdf) for bdf in bdfs}
+    dims = info.host_topology
+    # Drop malformed hints (wrong arity / out of range) rather than letting a
+    # typo'd hints file poison sub-box scoring downstream.
+    bad = {b: c for b, c in hints.items()
+           if len(c) != len(dims) or any(not 0 <= x < d for x, d in zip(c, dims))}
+    for b, c in bad.items():
+        log.warning("topology hint %s=%s invalid for torus %s; ignoring", b, c, dims)
+    hints = {b: c for b, c in hints.items() if b not in bad}
+    grid = list(itertools.product(*[range(d) for d in dims]))
+    unhinted = [b for b in sorted(bdfs) if b not in hints]
+    taken = set(hints.values())
+    free_slots = [c for c in grid if c not in taken]
+    for bdf in bdfs:
+        if bdf in hints:
+            out[bdf] = hints[bdf]
+    for bdf, coords in zip(unhinted, free_slots):
+        out[bdf] = coords
+    for bdf in bdfs:
+        if bdf not in out:
+            log.warning("chip %s exceeds %s host torus %s; no ICI coords",
+                        bdf, info.name, info.host_topology)
+            out[bdf] = None
+    return out
+
+
+@dataclass(frozen=True)
+class AllocatableDevice:
+    """What the allocator needs to know about one advertised device."""
+
+    device_id: str            # kubelet device ID (BDF or partition uuid)
+    numa_node: int
+    coords: Optional[Coords] = None
+
+
+class MustIncludeTooLarge(ValueError):
+    """MustIncludeDeviceIDs exceeds AllocationSize (reference errors too, :535-538)."""
+
+
+def _boxes(dims: Coords) -> Iterable[Tuple[Tuple[int, int], ...]]:
+    """All axis-aligned sub-boxes, as per-axis (start, length).
+
+    Non-wrapping: a host's chips are a *slice* of the pod torus, so partial
+    axes have no wraparound ICI link — a "wrapped" pair would really be
+    several hops apart. Full-axis boxes (length == dim) cover the wrap case.
+    """
+    per_axis = [
+        [(s, l) for l in range(1, d + 1) for s in range(d) if s + l <= d]
+        for d in dims
+    ]
+    return itertools.product(*per_axis)
+
+
+def _in_box(coords: Coords, box: Tuple[Tuple[int, int], ...]) -> bool:
+    return all(start <= c < start + length for c, (start, length) in zip(coords, box))
+
+
+def preferred_allocation(
+    devices: Sequence[AllocatableDevice],
+    available_ids: Sequence[str],
+    must_include_ids: Sequence[str],
+    size: int,
+    torus_dims: Optional[Coords] = None,
+) -> List[str]:
+    """Pick `size` device IDs, preferring contiguous ICI, then one NUMA node.
+
+    `available_ids` order is the kubelet's and is preserved within each
+    preference tier (reference preserves it the same way, :493-504).
+    """
+    if len(must_include_ids) > size:
+        raise MustIncludeTooLarge(
+            f"{len(must_include_ids)} must-include devices > allocation size {size}"
+        )
+    by_id = {d.device_id: d for d in devices}
+    avail = [i for i in available_ids if i in by_id]
+    must = list(must_include_ids)
+    need = size - len(must)
+    fill_pool = [i for i in avail if i not in set(must)]
+
+    # Tier 1: smallest ICI sub-box covering must-include with enough chips.
+    if torus_dims:
+        def placed(i: str) -> bool:
+            d = by_id.get(i)
+            return (d is not None and d.coords is not None
+                    and len(d.coords) == len(torus_dims))
+
+        if all(placed(i) for i in must):
+            best: Optional[Tuple[Tuple[int, int], List[str]]] = None
+            for box in _boxes(torus_dims):
+                in_box = [i for i in fill_pool
+                          if placed(i) and _in_box(by_id[i].coords, box)]
+                if not all(_in_box(by_id[i].coords, box) for i in must):
+                    continue
+                if len(in_box) < need:
+                    continue
+                chosen = must + in_box[:need]
+                volume = 1
+                for _, length in box:
+                    volume *= length
+                numa_span = len({by_id[i].numa_node for i in chosen})
+                score = (volume, numa_span)
+                if best is None or score < best[0]:
+                    best = (score, chosen)
+            if best is not None:
+                log.info("preferred allocation: ICI sub-box %s", best[1])
+                return best[1]
+
+    # Tier 2: a single NUMA node that can satisfy the request.
+    nodes: Dict[int, List[str]] = {}
+    for i in fill_pool:
+        nodes.setdefault(by_id[i].numa_node, []).append(i)
+    must_nodes = {by_id[i].numa_node for i in must if i in by_id}
+    for node, ids in sorted(nodes.items()):
+        if must_nodes and must_nodes != {node}:
+            continue
+        if len(ids) >= need:
+            chosen = must + ids[:need]
+            log.info("preferred allocation: NUMA node %d %s", node, chosen)
+            return chosen
+
+    # Tier 3: kubelet order.
+    chosen = must + fill_pool[:need]
+    log.info("preferred allocation: kubelet-order fallback %s", chosen)
+    return chosen
