@@ -1,0 +1,49 @@
+# Build system for the TPU KubeVirt device plugin
+# (role of the reference's Makefile:37-90: build/test/coverage/update-pcidb).
+
+PYTHON ?= python3
+CXX ?= g++
+CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
+IMAGE ?= tpu-device-plugin
+VERSION ?= 0.1.0
+
+.PHONY: all native proto test coverage bench clean update-pcidb image dryrun
+
+all: native proto
+
+# The one native component: the libtpu liveness shim (NVML-binding analogue).
+native: native/libtpuhealth.so
+
+native/libtpuhealth.so: native/tpuhealth.cpp
+	$(CXX) $(CXXFLAGS) -shared -o $@ $< -ldl
+
+# Regenerate kubelet v1beta1 protobuf messages (generated file is committed).
+proto: proto/deviceplugin_v1beta1.proto
+	protoc --python_out=tpu_device_plugin/kubeletapi -Iproto proto/deviceplugin_v1beta1.proto
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+coverage:
+	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin --cov-report=term-missing 2>/dev/null \
+		|| $(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+# Validate the multi-chip sharding path on a virtual CPU mesh.
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+# Refresh the bundled PCI id database (network required; the bundled copy is
+# a curated subset — see utils/README.md).
+update-pcidb:
+	curl -fsSL -o utils/pci.ids https://pci-ids.ucw.cz/v2.2/pci.ids
+
+image:
+	docker build -f deployments/container/Dockerfile -t $(IMAGE):$(VERSION) .
+
+clean:
+	rm -f native/libtpuhealth.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -1,0 +1,95 @@
+// libtpuhealth — native TPU liveness shim.
+//
+// The one native component of the plugin, mirroring the role of the
+// reference's NVML cgo binding (the only C code it has:
+// vendor/.../nvml/nvml_dl.go:30 dlopen("libnvidia-ml.so.1")). A vfio-bound
+// TPU has no host driver to query, so liveness comes from three probes that
+// work regardless of driver binding:
+//
+//  1. PCI config-space read: sysfs exposes <bdf>/config even for vfio-bound
+//     devices; a chip that fell off the bus reads back all-0xFF.
+//  2. Device-node probe: the vfio group / accel char device must exist.
+//  3. libtpu presence: dlopen("libtpu.so") + symbol lookup, *without*
+//     initializing the driver — initialization would seize the chips the
+//     plugin is trying to hand out (the same reason the reference's
+//     passthrough path has no NVML probe).
+//
+// Exposed as a flat C ABI consumed from Python via ctypes
+// (tpu_device_plugin/native/__init__.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Return codes shared by all probes.
+enum tpuhealth_status {
+  TPUHEALTH_OK = 0,          // device looks alive
+  TPUHEALTH_DEAD = 1,        // device present in sysfs but not responding
+  TPUHEALTH_MISSING = 2,     // path does not exist
+  TPUHEALTH_ERR = -1,        // probe itself failed (permissions, I/O error)
+};
+
+// Probe a PCI device via its sysfs config file (e.g.
+// /sys/bus/pci/devices/0000:00:05.0/config). Reads the 16-bit vendor id:
+// unreadable or 0xFFFF means the device no longer answers config cycles.
+int tpuhealth_probe_config(const char* config_path) {
+  int fd = open(config_path, O_RDONLY);
+  if (fd < 0) {
+    return errno == ENOENT ? TPUHEALTH_MISSING : TPUHEALTH_ERR;
+  }
+  uint8_t buf[2] = {0, 0};
+  ssize_t n = read(fd, buf, sizeof(buf));
+  close(fd);
+  if (n != static_cast<ssize_t>(sizeof(buf))) {
+    return TPUHEALTH_DEAD;
+  }
+  uint16_t vendor = static_cast<uint16_t>(buf[0]) |
+                    (static_cast<uint16_t>(buf[1]) << 8);
+  if (vendor == 0xFFFF || vendor == 0x0000) {
+    return TPUHEALTH_DEAD;
+  }
+  return TPUHEALTH_OK;
+}
+
+// Probe that a device node (vfio group, /dev/accelN) still exists and is
+// openable. O_NONBLOCK so a wedged driver cannot hang the health thread.
+int tpuhealth_probe_node(const char* dev_path) {
+  int fd = open(dev_path, O_RDONLY | O_NONBLOCK);
+  if (fd < 0) {
+    if (errno == ENOENT) return TPUHEALTH_MISSING;
+    // EACCES/EBUSY still prove the node exists and is owned by a driver.
+    if (errno == EACCES || errno == EBUSY || errno == EPERM) return TPUHEALTH_OK;
+    return TPUHEALTH_ERR;
+  }
+  close(fd);
+  return TPUHEALTH_OK;
+}
+
+// libtpu presence: dlopen + lazy symbol lookup, never initialization.
+// Returns 1 when libtpu.so is loadable and exports a known entry point,
+// 0 when absent. Handle is cached for the process lifetime.
+static void* tpuhealth_libtpu_handle() {
+  static void* handle = dlopen("libtpu.so", RTLD_LAZY | RTLD_LOCAL);
+  return handle;
+}
+
+int tpuhealth_libtpu_available(void) {
+  void* h = tpuhealth_libtpu_handle();
+  if (h == nullptr) return 0;
+  // Current libtpu exposes the PJRT entry point; older builds the TpuDriver.
+  if (dlsym(h, "GetPjrtApi") != nullptr) return 1;
+  if (dlsym(h, "TpuDriver_Open") != nullptr) return 1;
+  return 0;
+}
+
+// ABI version tag so the Python side can detect stale .so builds.
+int tpuhealth_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,7 +1,11 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh before any jax import."""
 
 import os
+import shutil
 import sys
+import tempfile
+
+import pytest
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -9,3 +13,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def short_root():
+    """A short tmpdir for fixtures that bind unix sockets: pytest's tmp_path
+    can push socket paths past the kernel's 107-char sun_path limit."""
+    root = tempfile.mkdtemp(prefix="tdp-")
+    yield root
+    shutil.rmtree(root, ignore_errors=True)

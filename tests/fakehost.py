@@ -78,13 +78,21 @@ class FakeHost:
     def enable_iommufd(self) -> None:
         self._write(os.path.join(self.devfs, "iommu"), "")
 
-    def add_mdev(self, uuid: str, type_name: str, parent_bdf: str) -> None:
+    def add_mdev(self, uuid: str, type_name: str, parent_bdf: str,
+                 iommu_group: Optional[str] = None) -> None:
         """mdev device: a symlink whose resolved path has the parent BDF
         second-to-last (reference derives parent that way, :347-357)."""
         parent_dir = os.path.join(self.pci, parent_bdf)
         real = os.path.join(parent_dir, uuid)
         os.makedirs(os.path.join(real, "mdev_type"), exist_ok=True)
         self._write(os.path.join(real, "mdev_type", "name"), type_name + "\n")
+        if iommu_group is not None:
+            grp_dir = os.path.join(self.iommu_groups, iommu_group)
+            os.makedirs(grp_dir, exist_ok=True)
+            grp_link = os.path.join(real, "iommu_group")
+            if not os.path.islink(grp_link):
+                os.symlink(grp_dir, grp_link)
+            self._write(os.path.join(self.devfs, "vfio", iommu_group), "")
         link = os.path.join(self.mdev, uuid)
         if not os.path.islink(link):
             os.symlink(real, link)

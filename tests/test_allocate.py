@@ -1,0 +1,157 @@
+"""Allocate semantics (reference: generic_device_plugin_test.go:180-331)."""
+
+import os
+from dataclasses import replace
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import allocate, discovery
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import pb
+
+
+@pytest.fixture
+def host4(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:06.0", iommu_group="12", numa_node=1))
+    host.add_chip(FakeChip("0000:00:07.0", iommu_group="12", numa_node=1))
+    return host
+
+
+def setup(host, **overrides):
+    cfg = Config().with_root(host.root)
+    if overrides:
+        cfg = replace(cfg, **overrides)
+    registry, _ = discovery.discover_passthrough(cfg)
+    return cfg, registry
+
+
+def test_happy_path_expands_group(host4):
+    cfg, registry = setup(host4)
+    plan = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    # requesting one BDF pulls in its whole iommu group
+    assert plan.expanded_bdfs == ["0000:00:04.0", "0000:00:05.0"]
+    host_paths = [s.host_path for s in plan.device_specs]
+    assert host_paths == [
+        cfg.dev_path("dev/vfio/vfio"),
+        cfg.dev_path("dev/vfio", "11"),
+    ]
+    assert all(s.permissions == "mrw" for s in plan.device_specs)
+    assert plan.envs == {
+        "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4":
+            "0000:00:04.0,0000:00:05.0"}
+
+
+def test_two_groups_deduped(host4):
+    cfg, registry = setup(host4)
+    plan = allocate.plan_allocation(
+        cfg, registry, "v4",
+        ["0000:00:04.0", "0000:00:05.0", "0000:00:06.0"])
+    host_paths = [s.host_path for s in plan.device_specs]
+    assert host_paths == [
+        cfg.dev_path("dev/vfio/vfio"),
+        cfg.dev_path("dev/vfio", "11"),
+        cfg.dev_path("dev/vfio", "12"),
+    ]
+    assert len(plan.expanded_bdfs) == 4
+
+
+def test_unknown_bdf_errors(host4):
+    cfg, registry = setup(host4)
+    with pytest.raises(allocate.AllocationError, match="not a known TPU"):
+        allocate.plan_allocation(cfg, registry, "v4", ["0000:00:99.0"])
+
+
+def test_toctou_group_change_rejected(host4):
+    cfg, registry = setup(host4)
+    # after discovery, the kernel moved the device to another iommu group
+    link = os.path.join(cfg.pci_base_path, "0000:00:05.0", "iommu_group")
+    os.unlink(link)
+    os.symlink(os.path.join(host4.iommu_groups, "99"), link)
+    with pytest.raises(allocate.AllocationError, match="iommu group changed"):
+        allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+
+
+def test_toctou_vendor_change_rejected(host4):
+    cfg, registry = setup(host4)
+    with open(os.path.join(cfg.pci_base_path, "0000:00:04.0", "vendor"), "w") as f:
+        f.write("0x10de\n")
+    with pytest.raises(allocate.AllocationError, match="not a TPU"):
+        allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+
+
+def test_iommufd_path_ordering(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", vfio_dev="vfio3"))
+    host.enable_iommufd()
+    cfg, registry = setup(host)
+    plan = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    host_paths = [s.host_path for s in plan.device_specs]
+    assert host_paths == [
+        cfg.dev_path("dev/vfio/vfio"),
+        cfg.dev_path("dev/vfio", "11"),
+        cfg.dev_path("dev/vfio/devices", "vfio3"),
+        cfg.dev_path("dev/iommu"),
+    ]
+    container_paths = [s.container_path for s in plan.device_specs]
+    assert container_paths == [
+        "/dev/vfio/vfio", "/dev/vfio/11", "/dev/vfio/devices/vfio3", "/dev/iommu"]
+
+
+def test_shared_device_all_or_nothing(host4):
+    # shared device spans both chips of group 11
+    host4.add_shared_device("egm0", ["0000:00:04.0", "0000:00:05.0"])
+    cfg, registry = setup(host4)
+    full = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    assert any(s.host_path.endswith("/egm0") for s in full.device_specs)
+    # an allocation that covers only group 12 must NOT get egm0
+    partial = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:06.0"])
+    assert not any(s.host_path.endswith("/egm0") for s in partial.device_specs)
+
+
+def test_shared_device_spanning_sockets(host4):
+    # shared device spans chips in different groups: only a both-group
+    # allocation may receive it (reference multi-socket EGM test analogue)
+    host4.add_shared_device("egm1", ["0000:00:04.0", "0000:00:06.0"])
+    cfg, registry = setup(host4)
+    both = allocate.plan_allocation(
+        cfg, registry, "v4", ["0000:00:04.0", "0000:00:06.0"])
+    assert any(s.host_path.endswith("/egm1") for s in both.device_specs)
+    one = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    assert not any(s.host_path.endswith("/egm1") for s in one.device_specs)
+
+
+def test_shared_device_missing_dev_node_tolerated(host4):
+    host4.add_shared_device("egm2", ["0000:00:04.0", "0000:00:05.0"])
+    os.unlink(os.path.join(host4.devfs, "egm2"))
+    cfg, registry = setup(host4)
+    plan = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    assert not any("egm2" in s.host_path for s in plan.device_specs)
+
+
+def test_gpu_devices_member_file_accepted(host4, tmp_path):
+    # Grace-Hopper-style EGM trees name the membership file gpu_devices
+    base = os.path.join(host4.root, "sys/class/egm/egm3")
+    os.makedirs(base)
+    with open(os.path.join(base, "gpu_devices"), "w") as f:
+        f.write("0000:00:04.0\n0000:00:05.0\n")
+    with open(os.path.join(host4.devfs, "egm3"), "w") as f:
+        f.write("")
+    cfg, registry = setup(host4)
+    plan = allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+    assert any(s.host_path.endswith("/egm3") for s in plan.device_specs)
+
+
+def test_allocate_response_multi_container(host4):
+    cfg, registry = setup(host4)
+    req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"]),
+        pb.ContainerAllocateRequest(devices_ids=["0000:00:06.0"]),
+    ])
+    resp = allocate.allocate_response(cfg, registry, "v4", req)
+    assert len(resp.container_responses) == 2
+    assert resp.container_responses[1].envs[
+        "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4"] == "0000:00:06.0,0000:00:07.0"

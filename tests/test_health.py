@@ -1,0 +1,151 @@
+"""Health machinery: inotify watcher, monitor callbacks, native shim parity."""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from tpu_device_plugin.health import HealthMonitor, InotifyWatcher
+from tpu_device_plugin.native import DEAD, MISSING, OK, TpuHealth
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_inotify_create_delete(tmp_path):
+    w = InotifyWatcher()
+    w.watch_dir(str(tmp_path))
+    try:
+        f = tmp_path / "node"
+        f.write_text("")
+        events = w.poll(1.0)
+        assert any(name == "node" and mask & 0x100 for _, name, mask in events)
+        f.unlink()
+        events = w.poll(1.0)
+        assert any(name == "node" and mask & 0x200 for _, name, mask in events)
+    finally:
+        w.close()
+
+
+def test_monitor_group_node_lifecycle(tmp_path):
+    vfio = tmp_path / "dev" / "vfio"
+    vfio.mkdir(parents=True)
+    (vfio / "7").write_text("")
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    sock = sock_dir / "p.sock"
+    sock.write_text("")
+    hits = []
+    mon = HealthMonitor(
+        socket_path=str(sock),
+        group_paths={"7": str(vfio / "7")},
+        group_bdfs={"7": ["0000:00:04.0"]},
+        on_device_health=lambda g, ok, src: hits.append((g, ok, src)),
+        on_socket_removed=lambda: hits.append(("SOCKET", None, None)),
+    )
+    mon.start()
+    try:
+        (vfio / "7").unlink()
+        assert _wait(lambda: ("7", False, "fs") in hits)
+        (vfio / "7").write_text("")
+        assert _wait(lambda: ("7", True, "fs") in hits)
+        sock.unlink()
+        assert _wait(lambda: ("SOCKET", None, None) in hits)
+        assert _wait(lambda: not mon.is_alive())
+    finally:
+        mon.stop_event.set()
+
+
+def test_monitor_probe_drives_health(tmp_path):
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    sock = sock_dir / "p.sock"
+    sock.write_text("")
+    verdict = {"ok": True}
+    hits = []
+    mon = HealthMonitor(
+        socket_path=str(sock),
+        group_paths={},
+        group_bdfs={"g": ["bdf0"]},
+        on_device_health=lambda g, ok, src: hits.append((g, ok, src)),
+        on_socket_removed=lambda: None,
+        probe=lambda bdf: verdict["ok"],
+        poll_interval_s=0.1,
+    )
+    mon.start()
+    try:
+        assert _wait(lambda: ("g", True, "probe") in hits)
+        verdict["ok"] = False
+        assert _wait(lambda: ("g", False, "probe") in hits)
+    finally:
+        mon.stop_event.set()
+
+
+# --- native shim -------------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def native_lib(tmp_path_factory):
+    """Build libtpuhealth.so with g++; skip native tests if no compiler."""
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "tpuhealth.cpp")
+    out = str(tmp_path_factory.mktemp("native") / "libtpuhealth.so")
+    try:
+        subprocess.run(["g++", "-O2", "-fPIC", "-shared", "-o", out, src, "-ldl"],
+                       check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as exc:
+        pytest.skip(f"cannot build native shim: {exc}")
+    return out
+
+
+@pytest.fixture(params=["native", "fallback"])
+def shim(request, native_lib):
+    if request.param == "native":
+        t = TpuHealth(native_lib)
+        assert t.is_native
+        return t
+    return TpuHealth("/nonexistent/libtpuhealth.so")
+
+
+def test_probe_config_verdicts(shim, tmp_path):
+    alive = tmp_path / "config_alive"
+    alive.write_bytes(bytes([0xE0, 0x1A, 0x00, 0x00]))  # vendor 0x1ae0 LE
+    assert shim.probe_config(str(alive)) == OK
+    dead = tmp_path / "config_dead"
+    dead.write_bytes(bytes([0xFF, 0xFF, 0xFF, 0xFF]))
+    assert shim.probe_config(str(dead)) == DEAD
+    zero = tmp_path / "config_zero"
+    zero.write_bytes(bytes([0x00, 0x00]))
+    assert shim.probe_config(str(zero)) == DEAD
+    truncated = tmp_path / "config_trunc"
+    truncated.write_bytes(b"\x01")
+    assert shim.probe_config(str(truncated)) == DEAD
+    assert shim.probe_config(str(tmp_path / "missing")) == MISSING
+
+
+def test_probe_node_verdicts(shim, tmp_path):
+    node = tmp_path / "accel0"
+    node.write_text("")
+    assert shim.probe_node(str(node)) == OK
+    assert shim.probe_node(str(tmp_path / "gone")) == MISSING
+
+
+def test_chip_alive_composite(shim, tmp_path):
+    pci = tmp_path / "devices"
+    bdf_dir = pci / "0000:00:04.0"
+    bdf_dir.mkdir(parents=True)
+    # no config file but device dir exists (fixture tree) -> alive
+    assert shim.chip_alive(str(pci), "0000:00:04.0") is True
+    (bdf_dir / "config").write_bytes(bytes([0xE0, 0x1A]))
+    assert shim.chip_alive(str(pci), "0000:00:04.0") is True
+    (bdf_dir / "config").write_bytes(bytes([0xFF, 0xFF]))
+    assert shim.chip_alive(str(pci), "0000:00:04.0") is False
+    # whole device vanished -> dead
+    assert shim.chip_alive(str(pci), "0000:00:99.0") is False

@@ -1,0 +1,169 @@
+"""PluginManager: per-resource plugin fan-out, error tolerance, run loop."""
+
+import json
+import os
+import threading
+import time
+from concurrent import futures
+from dataclasses import replace
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.lifecycle import PluginManager
+
+
+class FakeKubelet(api.RegistrationServicer):
+    def __init__(self):
+        self.registrations = []
+        self.cond = threading.Condition()
+
+    def Register(self, request, context):
+        with self.cond:
+            self.registrations.append(request)
+            self.cond.notify_all()
+        return pb.Empty()
+
+    def wait_for(self, n, timeout=10):
+        with self.cond:
+            return self.cond.wait_for(lambda: len(self.registrations) >= n,
+                                      timeout=timeout)
+
+
+@pytest.fixture
+def kubelet(short_root):
+    host = FakeHost(short_root)
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    api.add_registration_servicer(server, kubelet)
+    server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
+    server.start()
+    yield host, cfg, kubelet
+    server.stop(0)
+
+
+def test_manager_starts_plugin_per_resource(kubelet):
+    host, cfg, kub = kubelet
+    # two generations: 4x v4 (0062) + 2x v5e (0063), plus mdev partitions
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0062",
+                               iommu_group=str(11 + i)))
+    for i in range(2):
+        host.add_chip(FakeChip(f"0000:01:{i:02x}.0", device_id="0063",
+                               iommu_group=str(21 + i)))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="31")
+
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kub.wait_for(3)
+        names = sorted(r.resource_name for r in kub.registrations)
+        assert names == [
+            "cloud-tpus.google.com/TPU_vhalf",
+            "cloud-tpus.google.com/v4",
+            "cloud-tpus.google.com/v5e",
+        ]
+        socks = sorted(os.listdir(cfg.device_plugin_path))
+        assert "tpukubevirt-v4.sock" in socks
+        assert "tpukubevirt-v5e.sock" in socks
+        assert "tpukubevirt-vtpu-TPU_vhalf.sock" in socks
+    finally:
+        manager.stop()
+    assert all(not os.path.exists(os.path.join(cfg.device_plugin_path, s))
+               for s in ("tpukubevirt-v4.sock", "tpukubevirt-v5e.sock"))
+
+
+def test_manager_tolerates_partial_start_failure(kubelet, monkeypatch):
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+
+    from tpu_device_plugin import server as server_mod
+
+    orig_start = server_mod.TpuDevicePlugin.start
+
+    def flaky_start(self):
+        if self.resource_suffix == "v4":
+            raise RuntimeError("boom")
+        orig_start(self)
+
+    monkeypatch.setattr(server_mod.TpuDevicePlugin, "start", flaky_start)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kub.wait_for(1)
+        # the failed plugin stays pending for retry; the healthy one serves
+        assert [p.resource_suffix for p in manager.pending] == ["v4"]
+        assert kub.registrations[0].resource_name == "cloud-tpus.google.com/v5e"
+    finally:
+        manager.stop()
+
+
+def test_plugin_started_late_when_kubelet_appears(short_root):
+    """Plugin pod up before the kubelet: registration must retry, not die."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = replace(Config().with_root(host.root), grpc_timeout_s=1.0)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        time.sleep(1.5)  # first start attempt fails: no kubelet socket yet
+        assert len(manager.pending) == 1
+        kubelet = FakeKubelet()
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        api.add_registration_servicer(server, kubelet)
+        server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
+        server.start()
+        try:
+            assert kubelet.wait_for(1, timeout=15), \
+                "plugin never registered after kubelet came up"
+            deadline = time.monotonic() + 5
+            while manager.pending and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert manager.pending == []
+        finally:
+            server.stop(0)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_run_loop_stops_on_event(kubelet):
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    assert kub.wait_for(1)
+    stop.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert manager.plugins == []
+
+
+def test_rediscovery_restarts_on_inventory_change(kubelet):
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = replace(cfg, rediscovery_interval_s=0.3)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        # hotplug a second chip -> manager must notice and re-register
+        host.add_chip(FakeChip("0000:00:05.0", iommu_group="12"))
+        assert kub.wait_for(2, timeout=15)
+    finally:
+        stop.set()
+        t.join(timeout=10)

@@ -1,0 +1,187 @@
+"""End-to-end plugin server tests against a fake kubelet.
+
+Goes beyond the reference's fake-stream harness
+(generic_device_plugin_test.go:55-62): a real gRPC Registration server plays
+kubelet, the plugin serves on a real unix socket, and health transitions are
+induced by deleting/creating actual device nodes.
+"""
+
+import os
+import threading
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.server import TpuDevicePlugin
+
+
+class FakeKubelet(api.RegistrationServicer):
+    def __init__(self):
+        self.registrations = []
+        self.event = threading.Event()
+
+    def Register(self, request, context):
+        self.registrations.append(request)
+        self.event.set()
+        return pb.Empty()
+
+
+@pytest.fixture
+def rig(short_root):
+    """FakeHost + fake kubelet Registration server + started plugin."""
+    host = FakeHost(short_root)
+    for i, (g, n) in enumerate([("11", 0), ("11", 0), ("12", 1), ("12", 1)]):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", iommu_group=g, numa_node=n))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+
+    kubelet = FakeKubelet()
+    kubelet_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    api.add_registration_servicer(kubelet_server, kubelet)
+    kubelet_server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
+    kubelet_server.start()
+
+    registry, generations = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"],
+                             torus_dims=generations["0062"].host_topology)
+    plugin.start()
+    yield host, cfg, kubelet, plugin
+    plugin.stop()
+    kubelet_server.stop(0)
+
+
+def _wait(pred, timeout=5.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_start_registers_with_kubelet(rig):
+    host, cfg, kubelet, plugin = rig
+    assert kubelet.event.wait(timeout=5)
+    req = kubelet.registrations[0]
+    assert req.resource_name == "cloud-tpus.google.com/v4"
+    assert req.version == "v1beta1"
+    assert req.endpoint == os.path.basename(plugin.socket_path)
+    assert req.options.get_preferred_allocation_available is True
+    assert os.path.exists(plugin.socket_path)
+
+
+def test_list_and_watch_health_transitions(rig):
+    host, cfg, kubelet, plugin = rig
+    updates = []
+    done = threading.Event()
+
+    def consume():
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            try:
+                for resp in stub.ListAndWatch(pb.Empty()):
+                    updates.append({d.ID: d.health for d in resp.devices})
+                    done.set()
+            except grpc.RpcError:
+                pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert _wait(lambda: len(updates) >= 1)
+    assert set(updates[0].values()) == {"Healthy"}
+    assert len(updates[0]) == 4
+
+    # kill group 12's vfio node -> chips 06/07 go Unhealthy
+    host.remove_vfio_group("12")
+    assert _wait(lambda: len(updates) >= 2 and
+                 updates[-1]["0000:00:06.0"] == "Unhealthy")
+    assert updates[-1]["0000:00:07.0"] == "Unhealthy"
+    assert updates[-1]["0000:00:04.0"] == "Healthy"
+
+    # node comes back -> Healthy again
+    with open(os.path.join(host.devfs, "vfio", "12"), "w") as f:
+        f.write("")
+    assert _wait(lambda: updates[-1]["0000:00:06.0"] == "Healthy")
+
+
+def test_allocate_and_preferred_over_socket(rig):
+    host, cfg, kubelet, plugin = rig
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        stub = api.DevicePluginStub(ch)
+        pref = stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=["0000:00:04.0", "0000:00:07.0",
+                                         "0000:00:05.0", "0000:00:06.0"],
+                    allocation_size=2)]),
+            timeout=5)
+        picked = list(pref.container_responses[0].deviceIDs)
+        assert picked == ["0000:00:04.0", "0000:00:05.0"]
+
+        resp = stub.Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=picked)]),
+            timeout=5)
+        creps = resp.container_responses[0]
+        assert creps.envs["PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4"] == \
+            "0000:00:04.0,0000:00:05.0"
+        assert [d.container_path for d in creps.devices] == \
+            ["/dev/vfio/vfio", "/dev/vfio/11"]
+
+
+def test_allocate_unknown_device_is_invalid_argument(rig):
+    host, cfg, kubelet, plugin = rig
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        stub = api.DevicePluginStub(ch)
+        with pytest.raises(grpc.RpcError) as exc_info:
+            stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["0000:00:99.0"])]),
+                timeout=5)
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_must_include_too_large_is_invalid_argument(rig):
+    host, cfg, kubelet, plugin = rig
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        stub = api.DevicePluginStub(ch)
+        with pytest.raises(grpc.RpcError) as exc_info:
+            stub.GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=["0000:00:04.0", "0000:00:05.0"],
+                        must_include_deviceIDs=["0000:00:04.0", "0000:00:05.0"],
+                        allocation_size=1)]),
+                timeout=5)
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_kubelet_restart_triggers_reregistration(rig):
+    host, cfg, kubelet, plugin = rig
+    assert kubelet.event.wait(timeout=5)
+    kubelet.event.clear()
+    # kubelet restart wipes the device-plugin dir: remove the plugin's socket
+    os.unlink(plugin.socket_path)
+    assert kubelet.event.wait(timeout=10), "plugin did not re-register"
+    assert len(kubelet.registrations) == 2
+    assert _wait(lambda: os.path.exists(plugin.socket_path))
+    # plugin is serving again on the fresh socket
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        stub = api.DevicePluginStub(ch)
+        opts = stub.GetDevicePluginOptions(pb.Empty(), timeout=5)
+        assert opts.get_preferred_allocation_available is True
+
+
+def test_stop_removes_socket(rig):
+    host, cfg, kubelet, plugin = rig
+    assert os.path.exists(plugin.socket_path)
+    plugin.stop()
+    assert not os.path.exists(plugin.socket_path)

@@ -1,0 +1,182 @@
+"""vTPU partition plugin: scoped mounts, live validation, packing preference."""
+
+import os
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.vtpu import VtpuDevicePlugin
+
+
+@pytest.fixture
+def mdev_rig(short_root):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", numa_node=1))
+    host.add_mdev("uuid-a1", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    host.add_mdev("uuid-a2", "TPU vhalf", "0000:00:04.0", iommu_group="22")
+    host.add_mdev("uuid-b1", "TPU vhalf", "0000:00:05.0", iommu_group="23")
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    parts = registry.partitions_by_type["TPU_vhalf"]
+    plugin = VtpuDevicePlugin(cfg, "TPU_vhalf", registry, parts)
+    return host, cfg, plugin
+
+
+def _serve(plugin):
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    api.add_device_plugin_servicer(server, plugin)
+    server.add_insecure_port(f"unix://{plugin.socket_path}")
+    server.start()
+    return server
+
+
+def test_mdev_allocate_scoped_vfio_mount(mdev_rig):
+    host, cfg, plugin = mdev_rig
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            resp = stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["uuid-a1"])]),
+                timeout=5)
+            cresp = resp.container_responses[0]
+            # only the partition's own group — never the whole /dev/vfio dir
+            assert [d.container_path for d in cresp.devices] == \
+                ["/dev/vfio/vfio", "/dev/vfio/21"]
+            assert cresp.envs[
+                "MDEV_PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_TPU_VHALF"] == "uuid-a1"
+    finally:
+        server.stop(0)
+
+
+def test_mdev_allocate_without_group_falls_back_wide(short_root):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_mdev("uuid-x", "TPU vhalf", "0000:00:04.0")  # no iommu_group
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    plugin = VtpuDevicePlugin(cfg, "TPU_vhalf", registry,
+                              registry.partitions_by_type["TPU_vhalf"])
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["uuid-x"])]),
+                timeout=5)
+            assert [d.container_path for d in resp.container_responses[0].devices] == \
+                ["/dev/vfio/vfio", "/dev/vfio"]
+    finally:
+        server.stop(0)
+
+
+def test_mdev_type_mismatch_rejected(mdev_rig):
+    host, cfg, plugin = mdev_rig
+    # live sysfs now claims a different type for uuid-a1
+    with open(os.path.join(host.pci, "0000:00:04.0", "uuid-a1",
+                           "mdev_type", "name"), "w") as f:
+        f.write("TPU vother\n")
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            with pytest.raises(grpc.RpcError) as exc_info:
+                api.DevicePluginStub(ch).Allocate(
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(devices_ids=["uuid-a1"])]),
+                    timeout=5)
+            assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(0)
+
+
+def test_unknown_partition_rejected(mdev_rig):
+    host, cfg, plugin = mdev_rig
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            with pytest.raises(grpc.RpcError) as exc_info:
+                api.DevicePluginStub(ch).Allocate(
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(devices_ids=["nope"])]),
+                    timeout=5)
+            assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        server.stop(0)
+
+
+def test_preferred_allocation_packs_parents(mdev_rig):
+    host, cfg, plugin = mdev_rig
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=["uuid-b1", "uuid-a1", "uuid-a2"],
+                        allocation_size=2)]),
+                timeout=5)
+            picked = list(resp.container_responses[0].deviceIDs)
+            # both partitions of chip 04 (the fullest parent), not one of each
+            assert sorted(picked) == ["uuid-a1", "uuid-a2"]
+    finally:
+        server.stop(0)
+
+
+def test_preferred_allocation_honors_must_include_parent(mdev_rig):
+    host, cfg, plugin = mdev_rig
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=["uuid-a1", "uuid-a2", "uuid-b1"],
+                        must_include_deviceIDs=["uuid-b1"],
+                        allocation_size=2)]),
+                timeout=5)
+            picked = list(resp.container_responses[0].deviceIDs)
+            assert picked[0] == "uuid-b1"
+            assert len(picked) == 2
+    finally:
+        server.stop(0)
+
+
+def test_logical_partition_allocate_mounts_accel(short_root, tmp_path):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=3))
+    pc = tmp_path / "partitions.json"
+    import json
+    pc.write_text(json.dumps({"per_core": True}))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root),
+                  partition_config_path=str(pc))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    parts = registry.partitions_by_type["v4-core"]
+    plugin = VtpuDevicePlugin(cfg, "v4-core", registry, parts)
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devices_ids=["0000:00:04.0-core0",
+                                     "0000:00:04.0-core1"])]),
+                timeout=5)
+            cresp = resp.container_responses[0]
+            # both cores share one accel node -> deduped single spec
+            assert [d.container_path for d in cresp.devices] == ["/dev/accel3"]
+            assert cresp.devices[0].permissions == "rw"
+    finally:
+        server.stop(0)

@@ -1,0 +1,198 @@
+"""Allocate(): turn requested BDFs into VFIO DeviceSpecs + KubeVirt env vars.
+
+TPU analogue of the reference's passthrough Allocate
+(generic_device_plugin.go:352-444): expand each requested BDF to its whole
+IOMMU group, re-validate live sysfs against the discovery-time snapshot
+(TOCTOU guard, :388-397), emit `/dev/vfio/vfio` + `/dev/vfio/<group>` (plus
+the iommufd trio when `/dev/iommu` exists, :692-716), and set the
+`PCI_RESOURCE_...` env var KubeVirt's virt-launcher reads to pick the PCI
+devices for the VMI (externalResourceProvider contract).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .config import Config
+from .discovery import read_id_from_file, read_link_basename
+from .kubeletapi import pb
+from .naming import sanitize_name
+from .registry import Registry, SharedDevice
+
+log = logging.getLogger(__name__)
+
+
+class AllocationError(Exception):
+    """Request references devices this plugin cannot serve (unknown/invalid)."""
+
+
+def supports_iommufd(cfg: Config) -> bool:
+    """iommufd-capable host: /dev/iommu exists (reference :692-701)."""
+    return os.path.exists(cfg.dev_path("dev/iommu"))
+
+
+def vfio_device_node(cfg: Config, bdf: str) -> Optional[str]:
+    """`vfioN` cdev name from sysfs `<bdf>/vfio-dev/` (reference :702-716)."""
+    vfio_dev_dir = os.path.join(cfg.pci_base_path, bdf, "vfio-dev")
+    try:
+        entries = sorted(os.listdir(vfio_dev_dir))
+    except OSError:
+        return None
+    for entry in entries:
+        if entry.startswith("vfio"):
+            return entry
+    return None
+
+
+def discover_shared_devices(cfg: Config) -> List[SharedDevice]:
+    """Scan shared-device classes (EGM analogue, reference :120-157).
+
+    Each class entry lists its member chips in a `chip_devices` file
+    (`gpu_devices` also accepted so Grace-Hopper-style EGM trees work) and has
+    a matching /dev node. Shared devices are injected all-or-nothing.
+    """
+    out: List[SharedDevice] = []
+    for class_dir in cfg.shared_device_classes:
+        try:
+            entries = sorted(os.listdir(class_dir))
+        except OSError:
+            continue
+        for name in entries:
+            members: Optional[Tuple[str, ...]] = None
+            for member_file in ("chip_devices", "gpu_devices"):
+                path = os.path.join(class_dir, name, member_file)
+                try:
+                    with open(path, "r", encoding="ascii", errors="replace") as f:
+                        members = tuple(l.strip() for l in f if l.strip())
+                    break
+                except OSError:
+                    continue
+            if not members:
+                continue
+            dev_path = cfg.dev_path("dev", name)
+            if not os.path.exists(dev_path):
+                log.warning("shared device %s has no %s; skipping", name, dev_path)
+                continue
+            out.append(SharedDevice(name=name, dev_path=dev_path, member_bdfs=members))
+    return out
+
+
+def _revalidate(cfg: Config, bdf: str, expected_group: str) -> None:
+    """Live sysfs must still agree with the discovery snapshot (TOCTOU guard).
+
+    Mirrors the reference's re-reads inside Allocate (:388-397): the iommu
+    group link must be unchanged and the vendor must still be a TPU.
+    """
+    base = os.path.join(cfg.pci_base_path, bdf)
+    live_group = read_link_basename(os.path.join(base, "iommu_group"))
+    if live_group != expected_group:
+        raise AllocationError(
+            f"device {bdf}: iommu group changed ({expected_group!r} -> {live_group!r})")
+    vendor = read_id_from_file(os.path.join(base, "vendor"))
+    if vendor is None or vendor.lower() not in cfg.vendor_ids:
+        raise AllocationError(f"device {bdf}: vendor {vendor!r} is not a TPU")
+
+
+@dataclass
+class AllocationPlan:
+    device_specs: List[pb.DeviceSpec]
+    envs: Dict[str, str]
+    expanded_bdfs: List[str]
+
+
+def plan_allocation(
+    cfg: Config,
+    registry: Registry,
+    resource_suffix: str,
+    requested_bdfs: Sequence[str],
+    shared_devices: Optional[Sequence[SharedDevice]] = None,
+) -> AllocationPlan:
+    """Build the DeviceSpec list + env map for one container request.
+
+    DeviceSpec order matches the reference's: the shared /dev/vfio/vfio
+    container node first, then one /dev/vfio/<group> per IOMMU group, then
+    iommufd cdevs + /dev/iommu, then qualifying shared devices.
+    """
+    iommufd = supports_iommufd(cfg)
+    if shared_devices is None:
+        shared_devices = discover_shared_devices(cfg)
+
+    specs: List[pb.DeviceSpec] = [
+        pb.DeviceSpec(
+            host_path=cfg.dev_path("dev/vfio/vfio"),
+            container_path="/dev/vfio/vfio",
+            permissions="mrw",
+        )
+    ]
+    expanded: List[str] = []
+    seen_groups: List[str] = []
+    iommufd_specs: List[pb.DeviceSpec] = []
+    for bdf in requested_bdfs:
+        group = registry.bdf_to_group.get(bdf)
+        if group is None:
+            raise AllocationError(f"requested device {bdf} is not a known TPU")
+        if group in seen_groups:
+            continue
+        seen_groups.append(group)
+        for dev in registry.iommu_map[group]:
+            _revalidate(cfg, dev.bdf, group)
+            expanded.append(dev.bdf)
+            if iommufd:
+                node = vfio_device_node(cfg, dev.bdf)
+                if node is not None:
+                    iommufd_specs.append(pb.DeviceSpec(
+                        host_path=cfg.dev_path("dev/vfio/devices", node),
+                        container_path=f"/dev/vfio/devices/{node}",
+                        permissions="mrw",
+                    ))
+        specs.append(pb.DeviceSpec(
+            host_path=cfg.dev_path("dev/vfio", group),
+            container_path=f"/dev/vfio/{group}",
+            permissions="mrw",
+        ))
+    specs.extend(iommufd_specs)
+    if iommufd and seen_groups:
+        specs.append(pb.DeviceSpec(
+            host_path=cfg.dev_path("dev/iommu"),
+            container_path="/dev/iommu",
+            permissions="mrw",
+        ))
+
+    # Shared devices ride along iff every member chip is in this allocation
+    # (all-or-nothing, reference :159-184).
+    allocated = set(expanded)
+    for shared in shared_devices:
+        if shared.member_bdfs and set(shared.member_bdfs) <= allocated:
+            specs.append(pb.DeviceSpec(
+                host_path=shared.dev_path,
+                container_path=f"/dev/{shared.name}",
+                permissions="mrw",
+            ))
+            log.info("allocation includes shared device %s (members %s)",
+                     shared.name, ",".join(shared.member_bdfs))
+
+    env_key = f"{cfg.env_prefix}_{sanitize_name(resource_suffix)}"
+    envs = {env_key: ",".join(expanded)}
+    log.info("allocate %s: groups=%s devices=%s iommufd=%s",
+             resource_suffix, seen_groups, expanded, iommufd)
+    return AllocationPlan(device_specs=specs, envs=envs, expanded_bdfs=expanded)
+
+
+def allocate_response(
+    cfg: Config,
+    registry: Registry,
+    resource_suffix: str,
+    request: pb.AllocateRequest,
+) -> pb.AllocateResponse:
+    """Full Allocate handler body: one ContainerAllocateResponse per request."""
+    shared = discover_shared_devices(cfg)
+    resp = pb.AllocateResponse()
+    for creq in request.container_requests:
+        plan = plan_allocation(cfg, registry, resource_suffix,
+                               list(creq.devices_ids), shared)
+        resp.container_responses.append(pb.ContainerAllocateResponse(
+            envs=plan.envs, devices=plan.device_specs))
+    return resp

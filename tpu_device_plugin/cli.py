@@ -1,0 +1,93 @@
+"""CLI entrypoint: flag parsing → Config → PluginManager until SIGTERM.
+
+Analogue of cmd/main.go:33-35, with the small flag surface SURVEY.md §5
+recommends (the reference has zero flags; every knob is a compile-time var).
+Defaults match production paths; every flag exists so the same binary runs
+against fixture trees.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from dataclasses import replace
+
+from .config import Config
+from .lifecycle import PluginManager
+
+
+def build_config(argv=None) -> Config:
+    parser = argparse.ArgumentParser(
+        prog="tpu-device-plugin",
+        description="KubeVirt device plugin advertising Google Cloud TPUs "
+                    "for VFIO passthrough into VMs.",
+    )
+    cfg = Config()
+    parser.add_argument("--root", default=None,
+                        help="re-root every sysfs/devfs/kubelet path under "
+                             "this directory (fixture/testing mode)")
+    parser.add_argument("--pci-base-path", default=cfg.pci_base_path)
+    parser.add_argument("--mdev-base-path", default=cfg.mdev_base_path)
+    parser.add_argument("--accel-class-path", default=cfg.accel_class_path)
+    parser.add_argument("--pci-ids-path", default=cfg.pci_ids_path)
+    parser.add_argument("--device-plugin-path", default=cfg.device_plugin_path)
+    parser.add_argument("--resource-namespace", default=cfg.resource_namespace)
+    parser.add_argument("--generation-map", default=None,
+                        help="JSON overriding the device-id → generation table")
+    parser.add_argument("--topology-file", default=None,
+                        help="JSON mapping BDF → ICI torus coordinates")
+    parser.add_argument("--partition-config", default=None,
+                        help="JSON declaring logical vTPU partitions")
+    parser.add_argument("--native-lib", default=None,
+                        help="path to libtpuhealth.so")
+    parser.add_argument("--health-poll-seconds", type=float,
+                        default=cfg.health_poll_s)
+    parser.add_argument("--rediscovery-seconds", type=float,
+                        default=cfg.rediscovery_interval_s,
+                        help="0 disables periodic re-discovery")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    cfg = replace(
+        cfg,
+        pci_base_path=args.pci_base_path,
+        mdev_base_path=args.mdev_base_path,
+        accel_class_path=args.accel_class_path,
+        pci_ids_path=args.pci_ids_path,
+        device_plugin_path=args.device_plugin_path,
+        kubelet_socket=args.device_plugin_path.rstrip("/") + "/kubelet.sock",
+        resource_namespace=args.resource_namespace,
+        generation_map_path=args.generation_map,
+        topology_hints_path=args.topology_file,
+        partition_config_path=args.partition_config,
+        native_lib_path=args.native_lib,
+        health_poll_s=args.health_poll_seconds,
+        rediscovery_interval_s=args.rediscovery_seconds,
+    )
+    if args.root:
+        cfg = cfg.with_root(args.root)
+    return cfg
+
+
+def main(argv=None) -> int:
+    cfg = build_config(argv)
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        logging.getLogger(__name__).info("signal %d; shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    PluginManager(cfg).run(stop)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

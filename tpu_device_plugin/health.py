@@ -1,0 +1,227 @@
+"""Device + socket health monitoring.
+
+Two mechanisms, mirroring the reference's split (SURVEY.md §5):
+
+1. Filesystem watch (the reference's fsnotify, generic_device_plugin.go:611-690):
+   an inotify watcher (ctypes over libc — fsnotify is itself just an inotify
+   wrapper) on the socket dir and on `/dev/vfio/`. Group node Remove/Rename →
+   every device in the group goes Unhealthy; Create → Healthy; removal of the
+   plugin's own socket means the kubelet restarted and wiped its socket dir →
+   the plugin must re-register.
+
+2. Native liveness probe (the reference's NVML XID watch,
+   generic_vgpu_device_plugin.go:387-433): every `health_poll_s` (5 s, the
+   NVML WaitForEvent cadence) the libtpuhealth shim reads each chip's PCI
+   config space — a vfio-bound chip has no host driver to ask, but config
+   reads still work and a dead/fallen-off chip returns all-FF. See
+   `tpu_device_plugin.native`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import logging
+import os
+import select
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+IN_CREATE = 0x00000100
+IN_DELETE = 0x00000200
+IN_MOVED_FROM = 0x00000040
+IN_MOVED_TO = 0x00000080
+IN_DELETE_SELF = 0x00000400
+IN_ATTRIB = 0x00000004
+
+_GONE = IN_DELETE | IN_MOVED_FROM
+_BACK = IN_CREATE | IN_MOVED_TO
+
+_EVENT_HDR = struct.Struct("iIII")  # wd, mask, cookie, len
+
+
+class InotifyWatcher:
+    """Minimal inotify directory watcher: poll() yields (dir, name, mask)."""
+
+    def __init__(self) -> None:
+        libc_name = ctypes.util.find_library("c") or "libc.so.6"
+        self._libc = ctypes.CDLL(libc_name, use_errno=True)
+        self._fd = self._libc.inotify_init1(os.O_NONBLOCK)
+        if self._fd < 0:
+            raise OSError(ctypes.get_errno(), "inotify_init1 failed")
+        self._wd_to_dir: Dict[int, str] = {}
+
+    def watch_dir(self, path: str) -> None:
+        mask = IN_CREATE | IN_DELETE | IN_MOVED_FROM | IN_MOVED_TO
+        wd = self._libc.inotify_add_watch(self._fd, path.encode(), mask)
+        if wd < 0:
+            raise OSError(ctypes.get_errno(), f"inotify_add_watch({path}) failed")
+        self._wd_to_dir[wd] = path
+
+    def poll(self, timeout_s: float) -> List[Tuple[str, str, int]]:
+        ready, _, _ = select.select([self._fd], [], [], timeout_s)
+        if not ready:
+            return []
+        try:
+            buf = os.read(self._fd, 65536)
+        except BlockingIOError:
+            return []
+        events: List[Tuple[str, str, int]] = []
+        off = 0
+        while off + _EVENT_HDR.size <= len(buf):
+            wd, mask, _cookie, name_len = _EVENT_HDR.unpack_from(buf, off)
+            off += _EVENT_HDR.size
+            name = buf[off:off + name_len].split(b"\0", 1)[0].decode(errors="replace")
+            off += name_len
+            directory = self._wd_to_dir.get(wd, "")
+            events.append((directory, name, mask))
+        return events
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class HealthMonitor(threading.Thread):
+    """Watches group nodes + the plugin socket; drives health callbacks.
+
+    Callbacks (all thread-safe on the caller's side):
+      on_device_health(group, healthy, source)
+                                        — source "fs" (node came/went) or
+                                          "probe" (native liveness verdict)
+      on_socket_removed()               — kubelet restarted; plugin must restart
+      probe(bdf) -> bool                — native liveness; False marks the
+                                          chip's group Unhealthy
+    """
+
+    def __init__(
+        self,
+        socket_path: str,
+        group_paths: Dict[str, str],        # iommu group -> /dev/vfio/<group>
+        group_bdfs: Dict[str, List[str]],   # iommu group -> member BDFs
+        on_device_health: Callable[[str, bool, str], None],
+        on_socket_removed: Callable[[], None],
+        probe: Optional[Callable[[str], bool]] = None,
+        poll_interval_s: float = 5.0,
+        stop_event: Optional[threading.Event] = None,
+    ) -> None:
+        super().__init__(daemon=True, name=f"health-{os.path.basename(socket_path)}")
+        self._socket_path = socket_path
+        self._group_paths = dict(group_paths)
+        self._group_bdfs = {g: list(b) for g, b in group_bdfs.items()}
+        self._on_device_health = on_device_health
+        self._on_socket_removed = on_socket_removed
+        self._probe = probe
+        self._poll_interval_s = poll_interval_s
+        self.stop_event = stop_event or threading.Event()
+        self._probe_state: Dict[str, bool] = {}
+        self._watcher: Optional[InotifyWatcher] = None
+
+    def start(self) -> None:
+        """Register inotify watches *before* the thread runs, so an event
+        arriving immediately after start() (e.g. the kubelet wiping its socket
+        dir during registration) cannot be lost to setup latency. If inotify
+        is unavailable (fd/watch limits exhausted), the monitor degrades to
+        existence polling rather than running blind."""
+        watcher = None
+        try:
+            watcher = InotifyWatcher()
+            watcher.watch_dir(os.path.dirname(self._socket_path) or ".")
+            vfio_dirs = {os.path.dirname(p) for p in self._group_paths.values()}
+            for d in vfio_dirs:
+                if os.path.isdir(d):
+                    watcher.watch_dir(d)
+            self._watcher = watcher
+        except OSError as exc:
+            if watcher is not None:
+                watcher.close()
+            log.error("health monitor: inotify unavailable (%s); "
+                      "falling back to existence polling", exc)
+            self._watcher = None
+        super().start()
+
+    def _socket_gone(self) -> bool:
+        """Handle disappearance of the plugin's own socket; True = terminate."""
+        if self.stop_event.is_set():
+            # intentional teardown: grpc unlinks the unix socket during
+            # server.stop(); not a kubelet restart
+            return True
+        log.info("plugin socket %s removed — kubelet restart", self._socket_path)
+        self._on_socket_removed()
+        return True  # restart tears this monitor down
+
+    def _scan_existing(self, fs_state: Dict[str, bool]) -> None:
+        """Reconcile against current node existence. inotify only reports
+        *future* events, so a group node already missing at monitor start
+        (e.g. removed during a restart window) must be flagged here; also the
+        whole event source in polling-fallback mode."""
+        for group, path in self._group_paths.items():
+            exists = os.path.exists(path)
+            if fs_state.get(group) != exists:
+                fs_state[group] = exists
+                if not exists:
+                    log.warning("device node %s missing", path)
+                self._on_device_health(group, exists, "fs")
+
+    def run(self) -> None:
+        watcher = self._watcher
+        group_by_node = {os.path.basename(p): g for g, p in self._group_paths.items()}
+        socket_name = os.path.basename(self._socket_path)
+        fs_state: Dict[str, bool] = {g: True for g in self._group_paths}
+        self._scan_existing(fs_state)
+        # The socket is bound (by grpc) before this monitor starts watching;
+        # an unlink in that window leaves no future inotify event, so check
+        # current existence once.
+        if not os.path.exists(self._socket_path):
+            if self._socket_gone():
+                return
+        last_probe = 0.0
+        import time
+        try:
+            while not self.stop_event.is_set():
+                if watcher is not None:
+                    for directory, name, mask in watcher.poll(0.2):
+                        if name == socket_name and \
+                                directory == os.path.dirname(self._socket_path):
+                            if mask & _GONE and self._socket_gone():
+                                return
+                            continue
+                        group = group_by_node.get(name)
+                        if group is None:
+                            continue
+                        if mask & _GONE:
+                            log.warning("vfio group node %s removed", name)
+                            fs_state[group] = False
+                            self._on_device_health(group, False, "fs")
+                        elif mask & _BACK:
+                            log.info("vfio group node %s (re)created", name)
+                            fs_state[group] = True
+                            self._on_device_health(group, True, "fs")
+                else:
+                    # polling fallback: existence is the event source
+                    self.stop_event.wait(0.2)
+                    if not os.path.exists(self._socket_path):
+                        if self._socket_gone():
+                            return
+                    self._scan_existing(fs_state)
+                now = time.monotonic()
+                if self._probe is not None and now - last_probe >= self._poll_interval_s:
+                    last_probe = now
+                    self._run_probes()
+        finally:
+            if watcher is not None:
+                watcher.close()
+
+    def _run_probes(self) -> None:
+        for group, bdfs in self._group_bdfs.items():
+            healthy = all(self._probe(bdf) for bdf in bdfs)
+            if self._probe_state.get(group) != healthy:
+                self._probe_state[group] = healthy
+                if not healthy:
+                    log.warning("liveness probe failed for group %s (%s)",
+                                group, ",".join(bdfs))
+                self._on_device_health(group, healthy, "probe")

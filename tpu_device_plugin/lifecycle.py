@@ -1,0 +1,116 @@
+"""PluginManager — discovery → one plugin server per resource → run loop.
+
+Analogue of `InitiateDevicePlugin`/`createDevicePlugins`
+(device_plugin.go:89-176): run discovery once, spin up one TpuDevicePlugin
+per TPU model/generation and one VtpuDevicePlugin per partition type, then
+block until stopped. A plugin that fails to start is logged and skipped, not
+fatal (the reference tolerates per-plugin start errors the same way,
+device_plugin_test.go:102-107). Optional periodic re-discovery (off by
+default, matching the reference's no-hotplug stance) restarts the plugin set
+when the host inventory changes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from .config import Config
+from .discovery import discover
+from .naming import resource_name_for
+from .native import TpuHealth
+from .registry import Registry
+from .server import TpuDevicePlugin
+from .vtpu import VtpuDevicePlugin
+
+log = logging.getLogger(__name__)
+
+
+class PluginManager:
+    def __init__(self, cfg: Config) -> None:
+        self.cfg = cfg
+        self.plugins: List[TpuDevicePlugin] = []
+        self.pending: List[TpuDevicePlugin] = []
+        self.registry: Optional[Registry] = None
+        self._shim = TpuHealth(cfg.native_lib_path)
+
+    def build_plugins(self) -> List[TpuDevicePlugin]:
+        registry, generations = discover(self.cfg)
+        self.registry = registry
+        plugins: List[TpuDevicePlugin] = []
+        for model, devs in sorted(registry.devices_by_model.items()):
+            suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
+            info = generations.get(model)
+            plugins.append(TpuDevicePlugin(
+                self.cfg, suffix, registry, devs,
+                torus_dims=info.host_topology if info else None,
+                health_shim=self._shim,
+            ))
+            log.info("plugin for %s: %d chips (model %s, torus %s)",
+                     suffix, len(devs), model,
+                     info.host_topology if info else None)
+        for type_name, parts in sorted(registry.partitions_by_type.items()):
+            plugins.append(VtpuDevicePlugin(
+                self.cfg, type_name, registry, parts, health_shim=self._shim))
+            log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
+        return plugins
+
+    def start(self) -> None:
+        self.plugins = self.build_plugins()
+        self.pending = list(self.plugins)
+        self._try_start_pending()
+
+    def _try_start_pending(self) -> None:
+        """Start plugins that are not serving yet; keep failures for retry.
+
+        At node boot the plugin pod regularly comes up before the kubelet's
+        socket exists — registration then fails and must be retried, not
+        abandoned (one bad plugin must also not sink the rest)."""
+        still_pending: List[TpuDevicePlugin] = []
+        for plugin in self.pending:
+            try:
+                plugin.start()
+            except Exception as exc:
+                log.error("plugin %s failed to start (%s); will retry",
+                          plugin.resource_name, exc)
+                still_pending.append(plugin)
+        self.pending = still_pending
+
+    def stop(self) -> None:
+        for plugin in self.plugins:
+            try:
+                plugin.stop()
+            except Exception as exc:
+                log.error("plugin %s failed to stop cleanly: %s",
+                          plugin.resource_name, exc)
+        self.plugins = []
+        self.pending = []
+
+    def _inventory_changed(self) -> bool:
+        registry, _ = discover(self.cfg)
+        old = self.registry
+        if old is None:
+            return True
+        return (
+            registry.bdf_to_group != old.bdf_to_group
+            or {t: tuple(p.uuid for p in ps)
+                for t, ps in registry.partitions_by_type.items()}
+            != {t: tuple(p.uuid for p in ps)
+                for t, ps in old.partitions_by_type.items()}
+        )
+
+    def run(self, stop_event: threading.Event) -> None:
+        """Start everything and block until `stop_event` (reference :166-175)."""
+        self.start()
+        interval = self.cfg.rediscovery_interval_s
+        try:
+            while not stop_event.wait(timeout=interval if interval > 0 else 1.0):
+                if self.pending:
+                    self._try_start_pending()
+                if interval > 0 and self._inventory_changed():
+                    log.info("host inventory changed; restarting plugin set")
+                    self.stop()
+                    self.start()
+        finally:
+            self.stop()

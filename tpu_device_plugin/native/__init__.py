@@ -1,0 +1,98 @@
+"""ctypes binding for libtpuhealth.so, with a pure-Python fallback.
+
+Role-equivalent of the reference's vendored NVML cgo binding (SURVEY.md §2
+#14): the native shim is loaded dynamically at runtime; when the .so is not
+present (unit tests, cross-builds) a Python implementation of the same
+probes keeps the plugin functional — health checks are I/O-bound, the native
+path exists for deployments that must not run probe I/O under the GIL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+OK = 0
+DEAD = 1
+MISSING = 2
+ERR = -1
+
+_SEARCH_PATHS = (
+    os.path.join(os.path.dirname(__file__), "libtpuhealth.so"),
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "libtpuhealth.so"),
+    "libtpuhealth.so",
+)
+
+
+class TpuHealth:
+    """Probe API; backed by libtpuhealth.so when loadable, else Python."""
+
+    def __init__(self, lib_path: Optional[str] = None):
+        self._lib = None
+        candidates = (lib_path,) if lib_path else _SEARCH_PATHS
+        for cand in candidates:
+            if cand is None:
+                continue
+            try:
+                lib = ctypes.CDLL(cand)
+                if lib.tpuhealth_abi_version() != 1:
+                    log.warning("libtpuhealth %s has unknown ABI; ignoring", cand)
+                    continue
+                for fn in ("tpuhealth_probe_config", "tpuhealth_probe_node",
+                           "tpuhealth_libtpu_available"):
+                    getattr(lib, fn).restype = ctypes.c_int
+                    if fn != "tpuhealth_libtpu_available":
+                        getattr(lib, fn).argtypes = [ctypes.c_char_p]
+                self._lib = lib
+                log.info("loaded native libtpuhealth from %s", cand)
+                break
+            except OSError:
+                continue
+        if self._lib is None:
+            log.info("libtpuhealth.so not found; using Python probe fallback")
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def probe_config(self, config_path: str) -> int:
+        """PCI config-space liveness: 0xFFFF/unreadable vendor id == dead."""
+        if self._lib is not None:
+            return self._lib.tpuhealth_probe_config(config_path.encode())
+        try:
+            with open(config_path, "rb") as f:
+                data = f.read(2)
+        except FileNotFoundError:
+            return MISSING
+        except OSError:
+            return ERR
+        if len(data) != 2:
+            return DEAD
+        vendor = data[0] | (data[1] << 8)
+        return DEAD if vendor in (0xFFFF, 0x0000) else OK
+
+    def probe_node(self, dev_path: str) -> int:
+        if self._lib is not None:
+            return self._lib.tpuhealth_probe_node(dev_path.encode())
+        if not os.path.exists(dev_path):
+            return MISSING
+        return OK
+
+    def libtpu_available(self) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.tpuhealth_libtpu_available())
+        return False
+
+    def chip_alive(self, pci_base_path: str, bdf: str) -> bool:
+        """Composite liveness for one chip (what HealthMonitor polls)."""
+        status = self.probe_config(os.path.join(pci_base_path, bdf, "config"))
+        if status == MISSING:
+            # Fixture trees have no config file; absence of the whole device
+            # dir is the real death signal there.
+            return os.path.isdir(os.path.join(pci_base_path, bdf))
+        return status == OK

@@ -1,0 +1,317 @@
+"""TpuDevicePlugin — one gRPC plugin server per advertised resource.
+
+TPU analogue of the reference's `GenericDevicePlugin`
+(generic_device_plugin.go:72-690): serves the five DevicePlugin RPCs on a
+unix socket under the kubelet's device-plugin dir, registers with the
+kubelet, streams device health over ListAndWatch, and restarts itself when
+the kubelet wipes its socket dir. Differences by design:
+
+- health events flow through a versioned device table + condition variable
+  instead of unbuffered channels (the reference's can deadlock healthCheck
+  when ListAndWatch is gone, SURVEY.md §7e);
+- `restart()` builds a fresh stop event per Start, so a restart never
+  orphans a shared stop channel (ibid.);
+- GetPreferredAllocation is ICI-topology aware (topology.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+
+from . import allocate as allocate_mod
+from . import kubeletapi as api
+from .config import Config
+from .health import HealthMonitor
+from .kubeletapi import pb
+from .native import TpuHealth
+from .registry import Registry, TpuDevice
+from .topology import AllocatableDevice, MustIncludeTooLarge, preferred_allocation
+
+log = logging.getLogger(__name__)
+
+
+class TpuDevicePlugin(api.DevicePluginServicer):
+    """Passthrough plugin server for one TPU generation/model."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        resource_suffix: str,
+        registry: Registry,
+        devices: Sequence[TpuDevice],
+        torus_dims: Optional[Tuple[int, ...]] = None,
+        health_shim: Optional[TpuHealth] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.resource_suffix = resource_suffix
+        self.resource_name = f"{cfg.resource_namespace}/{resource_suffix}"
+        self.registry = registry
+        self.devices = list(devices)
+        self.torus_dims = torus_dims
+        self.health_shim = health_shim or TpuHealth(cfg.native_lib_path)
+        self.socket_path = os.path.join(
+            cfg.device_plugin_path, f"{cfg.socket_prefix}-{resource_suffix}.sock")
+
+        self._cond = threading.Condition()
+        self._devs: Dict[str, pb.Device] = {}
+        self._health_sources: Dict[str, Dict[str, bool]] = {}
+        self._version = 0
+        self._server: Optional[grpc.Server] = None
+        self._monitor: Optional[HealthMonitor] = None
+        self._stop = threading.Event()
+        self._closed = threading.Event()   # terminal stop(); restarts must abort
+        self._lifecycle_lock = threading.RLock()  # serializes start/teardown
+        self._serving = False
+        self._restart_count = 0
+        self._build_device_table()
+
+    # ------------------------------------------------------------------ state
+
+    def _build_device_table(self) -> None:
+        with self._cond:
+            self._devs = {
+                d.bdf: pb.Device(
+                    ID=d.bdf,
+                    health=api.HEALTHY,
+                    topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=d.numa_node)]),
+                )
+                for d in self.devices
+            }
+            self._version += 1
+            self._cond.notify_all()
+
+    def set_group_health(self, group: str, healthy: bool, source: str = "fs") -> None:
+        """Fan a group-level event out to every member device (reference :664-676)."""
+        members = [d.bdf for d in self.registry.iommu_map.get(group, ())]
+        self.set_devices_health(members, healthy, source)
+
+    def set_devices_health(self, device_ids: Sequence[str], healthy: bool,
+                           source: str = "fs") -> None:
+        """Record one source's verdict; a device is Healthy iff ALL sources agree.
+
+        Health has two independent observers — the filesystem watcher and the
+        native liveness probe — that see different failure modes (a removed
+        vfio node is invisible to a config-space read and vice versa), so
+        their verdicts are ANDed rather than last-writer-wins.
+        """
+        with self._cond:
+            changed = False
+            for dev_id in device_ids:
+                dev = self._devs.get(dev_id)
+                if dev is None:
+                    continue
+                sources = self._health_sources.setdefault(dev_id, {})
+                sources[source] = healthy
+                state = api.HEALTHY if all(sources.values()) else api.UNHEALTHY
+                if dev.health != state:
+                    dev.health = state
+                    changed = True
+            if changed:
+                self._version += 1
+                self._cond.notify_all()
+
+    def _snapshot(self) -> Tuple[int, List[pb.Device]]:
+        with self._cond:
+            return self._version, [pb.Device.FromString(d.SerializeToString())
+                                   for d in self._devs.values()]
+
+    # -------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Serve + self-dial readiness + register + health watch (reference :216-256).
+
+        Exception-safe: a failure after the gRPC server came up (e.g. the
+        kubelet socket is not there yet) tears the server and socket back
+        down before re-raising, so callers never leak a half-started plugin.
+        """
+        with self._lifecycle_lock:
+            self._stop = threading.Event()
+            self._cleanup_socket()
+            os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
+            server = grpc.server(
+                futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix=f"dp-{self.resource_suffix}"))
+            api.add_device_plugin_servicer(server, self)
+            server.add_insecure_port(f"unix://{self.socket_path}")
+            server.start()
+            self._server = server
+            try:
+                self._wait_ready()
+                self.register()
+                self._start_monitor()
+            except Exception:
+                self._teardown()
+                raise
+            self._serving = True
+            log.info("%s: serving on %s", self.resource_name, self.socket_path)
+
+    def _wait_ready(self) -> None:
+        """Self-dial until our own socket answers (reference :186-213)."""
+        with grpc.insecure_channel(f"unix://{self.socket_path}") as ch:
+            grpc.channel_ready_future(ch).result(timeout=self.cfg.grpc_timeout_s)
+
+    def register(self) -> None:
+        """Announce this plugin to the kubelet (reference :288-309)."""
+        with grpc.insecure_channel(f"unix://{self.cfg.kubelet_socket}") as ch:
+            grpc.channel_ready_future(ch).result(timeout=self.cfg.grpc_timeout_s)
+            api.RegistrationStub(ch).Register(
+                pb.RegisterRequest(
+                    version=api.API_VERSION,
+                    endpoint=os.path.basename(self.socket_path),
+                    resource_name=self.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True),
+                ),
+                timeout=self.cfg.grpc_timeout_s,
+            )
+        log.info("registered %s with kubelet", self.resource_name)
+
+    def _start_monitor(self) -> None:
+        group_paths = {g: self.cfg.dev_path("dev/vfio", g)
+                       for g in self._watched_groups()}
+        group_bdfs = {g: [d.bdf for d in self.registry.iommu_map.get(g, ())]
+                      for g in self._watched_groups()}
+        self._monitor = HealthMonitor(
+            socket_path=self.socket_path,
+            group_paths=group_paths,
+            group_bdfs=group_bdfs,
+            on_device_health=self.set_group_health,
+            on_socket_removed=self._restart_async,
+            probe=lambda bdf: self.health_shim.chip_alive(self.cfg.pci_base_path, bdf),
+            poll_interval_s=self.cfg.health_poll_s,
+            stop_event=self._stop,
+        )
+        self._monitor.start()
+
+    def _watched_groups(self) -> List[str]:
+        return sorted({d.iommu_group for d in self.devices})
+
+    def _restart_async(self) -> None:
+        """Socket removed ⇒ kubelet restarted ⇒ re-serve + re-register
+        (reference :677-687,274-285). Runs off the monitor thread, which is
+        about to exit. A stop already in progress wins over a restart."""
+        if self._closed.is_set() or self._stop.is_set():
+            return
+        threading.Thread(target=self.restart, daemon=True,
+                         name=f"restart-{self.resource_suffix}").start()
+
+    def restart(self) -> None:
+        """Re-serve + re-register, retrying with backoff until the kubelet is
+        back. A terminal stop() (self._closed) aborts the loop at any point;
+        the lifecycle lock makes a concurrent stop() either wait for an
+        attempt to finish (and then tear it down) or win outright."""
+        self._restart_count += 1
+        log.info("%s: restarting (count=%d)", self.resource_name, self._restart_count)
+        with self._lifecycle_lock:
+            self._teardown()
+        backoff = 1.0
+        while not self._closed.is_set():
+            deadline = time.monotonic() + self.cfg.grpc_timeout_s
+            while not os.path.exists(self.cfg.kubelet_socket) \
+                    and time.monotonic() < deadline \
+                    and not self._closed.is_set():
+                time.sleep(0.1)
+            with self._lifecycle_lock:
+                if self._closed.is_set():
+                    return
+                try:
+                    self.start()
+                    return
+                except Exception as exc:
+                    log.error("%s: restart attempt failed (%s); retrying in %.0fs",
+                              self.resource_name, exc, backoff)
+            if self._closed.wait(timeout=backoff):
+                return
+            backoff = min(backoff * 2, 30.0)
+
+    def stop(self) -> None:
+        """Terminal stop: no restart may resurrect the plugin afterwards."""
+        self._closed.set()
+        with self._lifecycle_lock:
+            self._teardown()
+
+    def _teardown(self) -> None:
+        self._serving = False
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._server is not None:
+            self._server.stop(grace=0.5).wait()
+            self._server = None
+        if self._monitor is not None and self._monitor.is_alive() \
+                and threading.current_thread() is not self._monitor:
+            self._monitor.join(timeout=2)
+        self._monitor = None
+        self._cleanup_socket()
+        log.info("%s: stopped", self.resource_name)
+
+    def _cleanup_socket(self) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------- RPCs
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):
+        """Initial full list, then a re-send on every health transition
+        (reference :312-349)."""
+        version, devices = self._snapshot()
+        log.info("%s: ListAndWatch stream opened (%d devices)",
+                 self.resource_name, len(devices))
+        yield pb.ListAndWatchResponse(devices=devices)
+        while not self._stop.is_set() and context.is_active():
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._version != version or self._stop.is_set(),
+                    timeout=0.5)
+                if self._stop.is_set() or self._version == version:
+                    continue
+            version, devices = self._snapshot()
+            log.info("%s: device state changed; re-sending %d devices",
+                     self.resource_name, len(devices))
+            yield pb.ListAndWatchResponse(devices=devices)
+
+    def GetPreferredAllocation(self, request, context):
+        resp = pb.PreferredAllocationResponse()
+        allocatable = [
+            AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
+            for d in self.devices
+        ]
+        for creq in request.container_requests:
+            try:
+                ids = preferred_allocation(
+                    allocatable,
+                    list(creq.available_deviceIDs),
+                    list(creq.must_include_deviceIDs),
+                    creq.allocation_size,
+                    torus_dims=self.torus_dims,
+                )
+            except MustIncludeTooLarge as exc:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
+        return resp
+
+    def Allocate(self, request, context):
+        log.info("%s: Allocate(%s)", self.resource_name,
+                 [list(c.devices_ids) for c in request.container_requests])
+        try:
+            return allocate_mod.allocate_response(
+                self.cfg, self.registry, self.resource_suffix, request)
+        except allocate_mod.AllocationError as exc:
+            log.error("%s: allocate failed: %s", self.resource_name, exc)
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
