@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Benchmark: the plugin's VMI-attach control-plane critical path.
+
+BASELINE.md config 1 defines the measurable baseline ("1 vfio-pci stub
+device → 1 VMI: Allocate() RPC latency; devices advertised; plugin on CPU").
+This bench builds a fake 8-chip v5e host, serves a real plugin over a real
+unix-socket gRPC server, and measures the kubelet-visible critical path for
+a 4-chip ICI-adjacent allocation: GetPreferredAllocation + Allocate RPC
+round-trips. The reference publishes no numbers (SURVEY.md §6), so
+vs_baseline is 1.0 by definition against our own recorded protocol.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+"""
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+from concurrent import futures
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import grpc
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.server import TpuDevicePlugin
+
+ITERATIONS = 300
+WARMUP = 20
+
+
+def main() -> int:
+    import logging
+    logging.disable(logging.CRITICAL)  # keep the one-line contract
+
+    root = tempfile.mkdtemp(prefix="tdpbench-")
+    try:
+        host = FakeHost(root)
+        # 8-chip v5e host (2x4 ICI torus), one chip per IOMMU group
+        for i in range(8):
+            host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                                   iommu_group=str(11 + i), numa_node=i // 4))
+        cfg = Config().with_root(root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+
+        t0 = time.perf_counter()
+        registry, generations = discover_passthrough(cfg)
+        discovery_ms = (time.perf_counter() - t0) * 1e3
+        devices = registry.devices_by_model["0063"]
+
+        plugin = TpuDevicePlugin(cfg, "v5e", registry, devices,
+                                 torus_dims=generations["0063"].host_topology)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        api.add_device_plugin_servicer(server, plugin)
+        server.add_insecure_port(f"unix://{plugin.socket_path}")
+        server.start()
+
+        all_ids = [d.bdf for d in devices]
+        attach_us = []
+        pref_us = []
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            for i in range(ITERATIONS + WARMUP):
+                t1 = time.perf_counter()
+                pref = stub.GetPreferredAllocation(
+                    pb.PreferredAllocationRequest(container_requests=[
+                        pb.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=all_ids, allocation_size=4)]),
+                    timeout=5)
+                t2 = time.perf_counter()
+                picked = list(pref.container_responses[0].deviceIDs)
+                resp = stub.Allocate(
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(devices_ids=picked)]),
+                    timeout=5)
+                t3 = time.perf_counter()
+                assert len(resp.container_responses[0].devices) >= 5  # vfio + 4 groups
+                if i >= WARMUP:
+                    pref_us.append((t2 - t1) * 1e6)
+                    attach_us.append((t3 - t1) * 1e6)
+        server.stop(0)
+
+        p50 = statistics.median(attach_us)
+        result = {
+            "metric": "vmi_attach_control_plane_p50",
+            "value": round(p50, 1),
+            "unit": "us",
+            "vs_baseline": 1.0,
+            "preferred_allocation_p50_us": round(statistics.median(pref_us), 1),
+            "allocate_p50_us": round(p50 - statistics.median(pref_us), 1),
+            "p99_us": round(statistics.quantiles(attach_us, n=100)[98], 1),
+            "discovery_ms": round(discovery_ms, 2),
+            "devices_advertised": len(devices),
+            "allocation_size": 4,
+            "iterations": ITERATIONS,
+        }
+        print(json.dumps(result))
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Some environments force-register an out-of-process TPU PJRT plugin from
+# sitecustomize, overriding JAX_PLATFORMS; initializing it would contend for
+# the (single) real chip from every test process. Pin the config to CPU
+# before any backend initialization.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 

@@ -169,7 +169,11 @@ class HealthMonitor(threading.Thread):
 
     def run(self) -> None:
         watcher = self._watcher
-        group_by_node = {os.path.basename(p): g for g, p in self._group_paths.items()}
+        # several keys may share one node path (logical partitions of a chip
+        # all ride /dev/accelN) — basename maps to ALL of them
+        groups_by_node: Dict[str, List[str]] = {}
+        for g, p in self._group_paths.items():
+            groups_by_node.setdefault(os.path.basename(p), []).append(g)
         socket_name = os.path.basename(self._socket_path)
         fs_state: Dict[str, bool] = {g: True for g in self._group_paths}
         self._scan_existing(fs_state)
@@ -180,6 +184,7 @@ class HealthMonitor(threading.Thread):
             if self._socket_gone():
                 return
         last_probe = 0.0
+        last_scan = 0.0
         import time
         try:
             while not self.stop_event.is_set():
@@ -190,17 +195,15 @@ class HealthMonitor(threading.Thread):
                             if mask & _GONE and self._socket_gone():
                                 return
                             continue
-                        group = group_by_node.get(name)
-                        if group is None:
-                            continue
-                        if mask & _GONE:
-                            log.warning("vfio group node %s removed", name)
-                            fs_state[group] = False
-                            self._on_device_health(group, False, "fs")
-                        elif mask & _BACK:
-                            log.info("vfio group node %s (re)created", name)
-                            fs_state[group] = True
-                            self._on_device_health(group, True, "fs")
+                        for group in groups_by_node.get(name, ()):
+                            if mask & _GONE:
+                                log.warning("device node %s removed", name)
+                                fs_state[group] = False
+                                self._on_device_health(group, False, "fs")
+                            elif mask & _BACK:
+                                log.info("device node %s (re)created", name)
+                                fs_state[group] = True
+                                self._on_device_health(group, True, "fs")
                 else:
                     # polling fallback: existence is the event source
                     self.stop_event.wait(0.2)
@@ -209,6 +212,13 @@ class HealthMonitor(threading.Thread):
                             return
                     self._scan_existing(fs_state)
                 now = time.monotonic()
+                if watcher is not None and now - last_scan >= self._poll_interval_s:
+                    # periodic reconciliation even with inotify: sysfs (kernfs)
+                    # emits no inotify events at all (mdev paths), and dirs
+                    # missing at start (udev still populating /dev/vfio) get
+                    # no watch — existence scanning is the ground truth
+                    last_scan = now
+                    self._scan_existing(fs_state)
                 if self._probe is not None and now - last_probe >= self._poll_interval_s:
                     last_probe = now
                     self._run_probes()

@@ -35,8 +35,8 @@ class PluginManager:
         self.registry: Optional[Registry] = None
         self._shim = TpuHealth(cfg.native_lib_path)
 
-    def build_plugins(self) -> List[TpuDevicePlugin]:
-        registry, generations = discover(self.cfg)
+    def build_plugins(self, inventory=None) -> List[TpuDevicePlugin]:
+        registry, generations = inventory if inventory else discover(self.cfg)
         self.registry = registry
         plugins: List[TpuDevicePlugin] = []
         for model, devs in sorted(registry.devices_by_model.items()):
@@ -56,8 +56,8 @@ class PluginManager:
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         return plugins
 
-    def start(self) -> None:
-        self.plugins = self.build_plugins()
+    def start(self, inventory=None) -> None:
+        self.plugins = self.build_plugins(inventory)
         self.pending = list(self.plugins)
         self._try_start_pending()
 
@@ -87,8 +87,7 @@ class PluginManager:
         self.plugins = []
         self.pending = []
 
-    def _inventory_changed(self) -> bool:
-        registry, _ = discover(self.cfg)
+    def _inventory_changed(self, registry: Registry) -> bool:
         old = self.registry
         if old is None:
             return True
@@ -108,9 +107,11 @@ class PluginManager:
             while not stop_event.wait(timeout=interval if interval > 0 else 1.0):
                 if self.pending:
                     self._try_start_pending()
-                if interval > 0 and self._inventory_changed():
-                    log.info("host inventory changed; restarting plugin set")
-                    self.stop()
-                    self.start()
+                if interval > 0:
+                    inventory = discover(self.cfg)  # one walk per tick
+                    if self._inventory_changed(inventory[0]):
+                        log.info("host inventory changed; restarting plugin set")
+                        self.stop()
+                        self.start(inventory)
         finally:
             self.stop()

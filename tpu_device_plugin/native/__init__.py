@@ -51,7 +51,9 @@ class TpuHealth:
                 self._lib = lib
                 log.info("loaded native libtpuhealth from %s", cand)
                 break
-            except OSError:
+            except (OSError, AttributeError):
+                # unloadable path, or a foreign .so without our symbols —
+                # degrade to the Python fallback rather than crash startup
                 continue
         if self._lib is None:
             log.info("libtpuhealth.so not found; using Python probe fallback")

@@ -1,0 +1,20 @@
+"""Guest-side slice validator — the JAX/TPU compute component.
+
+The host plugin's job ends when a VMI boots with its VFIO groups attached;
+proof that the slice actually *works* comes from inside the guest. This
+package is that proof: it enumerates `jax.devices()`, builds a `Mesh` shaped
+like the allocated slice, and runs an SPMD transformer burn-in whose matmuls
+exercise the MXU and whose gradient reduction exercises ICI collectives. Run
+it in the guest right after boot:
+
+    python -m tpu_device_plugin.validator
+
+It measures the north-star metric (process start → `jax.devices()` visible →
+first compiled step) and reports per-chip matmul throughput, mirroring the
+acceptance-test role NVML/DCGM diagnostics play on GPU nodes (the reference
+plugin itself has no guest-side validation — README.md:208 lists health
+improvement as a TODO; this closes that gap TPU-first).
+"""
+
+from .mesh import infer_mesh_shape, slice_mesh  # noqa: F401
+from .workload import ModelConfig, build_workload  # noqa: F401

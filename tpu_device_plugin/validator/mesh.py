@@ -1,0 +1,47 @@
+"""Slice → jax.sharding.Mesh mapping.
+
+Axes follow the scaling-book decomposition: `dp` (pure data parallel,
+gradient all-reduce), `tp` (tensor parallel, activation collectives on the
+fastest links), `sp` (sequence parallel for long context). On a passed-
+through slice all three ride ICI; the mesh construction puts `tp` innermost
+so its collectives land on nearest-neighbor links.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def infer_mesh_shape(n_devices: int,
+                     tp: Optional[int] = None,
+                     sp: Optional[int] = None) -> Tuple[int, int, int]:
+    """Factor `n_devices` into (dp, sp, tp).
+
+    Defaults: tp takes the largest power-of-two ≤ min(n, 4) (one host's worth
+    of nearest-neighbor links), sp stays 1 unless asked, dp absorbs the rest.
+    """
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(n_devices, 4) and n_devices % (tp * 2) == 0:
+            tp *= 2
+    if sp is None:
+        sp = 1
+    if n_devices % (tp * sp) != 0:
+        raise ValueError(f"{n_devices} devices not divisible by tp={tp} * sp={sp}")
+    dp = n_devices // (tp * sp)
+    return dp, sp, tp
+
+
+def slice_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               tp: Optional[int] = None,
+               sp: Optional[int] = None) -> Mesh:
+    """Build a ("dp", "sp", "tp") mesh over the visible slice."""
+    if devices is None:
+        devices = jax.devices()
+    dp, sp_, tp_ = infer_mesh_shape(len(devices), tp=tp, sp=sp)
+    grid = np.array(devices).reshape(dp, sp_, tp_)
+    return Mesh(grid, axis_names=("dp", "sp", "tp"))

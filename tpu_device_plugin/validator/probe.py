@@ -1,0 +1,115 @@
+"""Slice validation probe: the north-star measurement.
+
+BASELINE.md's metric is "VMI TPU-attach → jax.devices() latency; chips
+allocatable/node". Inside the guest this module measures the guest-side
+portion: process start → backend init → `jax.devices()` enumerated → first
+compiled training step done, then burns the slice in and reports per-chip
+throughput. Exit code is non-zero when the slice is unusable, so a VMI
+startup probe can gate workload admission on it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_PROCESS_START = time.monotonic()
+
+
+@dataclass
+class SliceReport:
+    ok: bool
+    platform: str = ""
+    n_devices: int = 0
+    device_kinds: List[str] = field(default_factory=list)
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    devices_visible_s: float = 0.0   # process start -> jax.devices() returned
+    first_step_s: float = 0.0        # process start -> first compiled step done
+    step_time_s: float = 0.0         # steady-state step latency
+    tflops_per_chip: float = 0.0     # burn-in matmul throughput
+    loss_start: float = 0.0
+    loss_end: float = 0.0
+    error: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__, sort_keys=True)
+
+
+def _workload_flops(cfg) -> float:
+    """Approximate training FLOPs per step (fwd+bwd ≈ 3x fwd matmul FLOPs)."""
+    per_token = (
+        4 * cfg.d_model * cfg.d_model        # qkv+o projections
+        + 2 * cfg.d_model * cfg.seq_len      # attention scores + values
+        + 2 * cfg.d_model * cfg.d_ff         # mlp
+    ) * 2 * cfg.n_layers + 2 * cfg.d_model * cfg.vocab * 2
+    return 3.0 * per_token * cfg.batch * cfg.seq_len
+
+
+def validate_slice(
+    cfg=None,
+    steps: int = 20,
+    tp: Optional[int] = None,
+    sp: Optional[int] = None,
+    devices=None,
+) -> SliceReport:
+    report = SliceReport(ok=False)
+    try:
+        import jax
+        if devices is None:
+            devices = jax.devices()
+        report.devices_visible_s = time.monotonic() - _PROCESS_START
+        report.platform = devices[0].platform
+        report.n_devices = len(devices)
+        report.device_kinds = sorted({d.device_kind for d in devices})
+
+        from .mesh import slice_mesh
+        from .workload import ModelConfig, build_workload
+        cfg = cfg or ModelConfig()
+        mesh = slice_mesh(devices, tp=tp, sp=sp) if len(devices) > 1 else None
+        if mesh is not None:
+            report.mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        step, params, momentum, tokens = build_workload(cfg, mesh)
+
+        params, momentum, loss = step(params, momentum, tokens)
+        report.loss_start = float(loss)
+        report.first_step_s = time.monotonic() - _PROCESS_START
+
+        t0 = time.monotonic()
+        for _ in range(steps):
+            params, momentum, loss = step(params, momentum, tokens)
+        jax.block_until_ready(loss)
+        elapsed = time.monotonic() - t0
+        report.loss_end = float(loss)
+        report.step_time_s = elapsed / steps
+        report.tflops_per_chip = (
+            _workload_flops(cfg) / report.step_time_s / 1e12 / max(report.n_devices, 1))
+
+        # a slice that cannot learn is broken even if it computes
+        report.ok = report.loss_end < report.loss_start
+        if not report.ok:
+            report.error = (f"loss did not decrease "
+                            f"({report.loss_start:.4f} -> {report.loss_end:.4f})")
+    except Exception as exc:  # report, don't crash the probe harness
+        report.error = f"{type(exc).__name__}: {exc}"
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="tpu-slice-validator",
+        description="Validate a passed-through TPU slice from inside the guest.")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--tp", type=int, default=None)
+    parser.add_argument("--sp", type=int, default=None)
+    parser.add_argument("--seq-len", type=int, default=None)
+    args = parser.parse_args(argv)
+    cfg = None
+    if args.seq_len is not None:
+        from .workload import ModelConfig
+        cfg = ModelConfig(seq_len=args.seq_len)
+    report = validate_slice(cfg=cfg, steps=args.steps, tp=args.tp, sp=args.sp)
+    print(report.to_json())
+    return 0 if report.ok else 1
