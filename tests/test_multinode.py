@@ -1,0 +1,148 @@
+"""Multi-node slice (BASELINE config 5): two nodes, one v5p-16 slice.
+
+No new mechanism is needed (SURVEY.md §5 "long-context" note): each node's
+DaemonSet pod independently advertises its local chips; the Topology Manager
+and KubeVirt compose the multi-VMI slice. This test runs two full plugin
+stacks against two fake kubelets — one per "node" — and checks that each
+advertises its own chips with per-node ICI coordinates, and that allocations
+on both nodes succeed independently.
+"""
+
+import os
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.lifecycle import PluginManager
+
+
+class Node:
+    """One simulated TPU host: fake sysfs + fake kubelet + plugin manager."""
+
+    def __init__(self, root: str, n_chips: int = 4, device_id: str = "0064"):
+        self.host = FakeHost(root)
+        for i in range(n_chips):
+            self.host.add_chip(FakeChip(
+                f"0000:00:{4 + i:02x}.0", device_id=device_id,
+                iommu_group=str(11 + i), numa_node=i // 2))
+        self.cfg = Config().with_root(root)
+        os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
+        self.registrations = []
+        self._event = threading.Event()
+        self.kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+
+        outer = self
+
+        class Reg(api.RegistrationServicer):
+            def Register(self, request, context):
+                outer.registrations.append(request)
+                outer._event.set()
+                return pb.Empty()
+
+        api.add_registration_servicer(self.kubelet, Reg())
+        self.kubelet.add_insecure_port(f"unix://{self.cfg.kubelet_socket}")
+        self.kubelet.start()
+        self.manager = PluginManager(self.cfg)
+
+    def start(self):
+        self.manager.start()
+
+    def wait_registered(self, timeout=10):
+        return self._event.wait(timeout)
+
+    def plugin_stub(self, suffix="v5p"):
+        sock = os.path.join(self.cfg.device_plugin_path,
+                            f"tpukubevirt-{suffix}.sock")
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        return channel, api.DevicePluginStub(channel)
+
+    def stop(self):
+        self.manager.stop()
+        self.kubelet.stop(0)
+
+
+@pytest.fixture
+def two_nodes(short_root):
+    nodes = [Node(os.path.join(short_root, f"n{i}")) for i in range(2)]
+    for n in nodes:
+        n.start()
+    yield nodes
+    for n in nodes:
+        n.stop()
+
+
+def test_each_node_advertises_local_chips(two_nodes):
+    for node in two_nodes:
+        assert node.wait_registered()
+        assert node.registrations[0].resource_name == "cloud-tpus.google.com/v5p"
+        ch, stub = node.plugin_stub()
+        with ch:
+            resp = next(iter(stub.ListAndWatch(pb.Empty())))
+            assert len(resp.devices) == 4
+            assert all(d.health == "Healthy" for d in resp.devices)
+
+
+def test_parallel_allocation_across_nodes(two_nodes):
+    """A 2-VMI slice: each VMI lands on one node; both Allocates succeed and
+    each returns only its own node's devfs paths."""
+    envs = []
+    for node in two_nodes:
+        assert node.wait_registered()
+        ch, stub = node.plugin_stub()
+        with ch:
+            pref = stub.GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=[f"0000:00:{4 + i:02x}.0"
+                                             for i in range(4)],
+                        allocation_size=4)]),
+                timeout=5)
+            picked = list(pref.container_responses[0].deviceIDs)
+            assert len(picked) == 4
+            resp = stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=picked)]),
+                timeout=5)
+            cresp = resp.container_responses[0]
+            for spec in cresp.devices:
+                assert spec.host_path.startswith(node.cfg.root_path)
+            envs.append(dict(cresp.envs))
+    assert envs[0] == envs[1]  # same shape per node; paths differ per root
+
+
+def test_node_failure_isolated(two_nodes):
+    """Killing chips on node 0 must not disturb node 1's advertisement."""
+    n0, n1 = two_nodes
+    assert n0.wait_registered() and n1.wait_registered()
+    updates0, updates1 = [], []
+
+    def consume(node, sink):
+        ch, stub = node.plugin_stub()
+        with ch:
+            try:
+                for resp in stub.ListAndWatch(pb.Empty()):
+                    sink.append({d.ID: d.health for d in resp.devices})
+            except grpc.RpcError:
+                pass
+
+    threading.Thread(target=consume, args=(n0, updates0), daemon=True).start()
+    threading.Thread(target=consume, args=(n1, updates1), daemon=True).start()
+    import time
+    deadline = time.monotonic() + 5
+    while (not updates0 or not updates1) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    n0.host.remove_vfio_group("11")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if updates0 and updates0[-1].get("0000:00:04.0") == "Unhealthy":
+            break
+        time.sleep(0.05)
+    assert updates0[-1]["0000:00:04.0"] == "Unhealthy"
+    # node 1 saw no unhealthy transition at all
+    assert all(set(u.values()) == {"Healthy"} for u in updates1)

@@ -1,0 +1,81 @@
+"""Status endpoint: /healthz gating and /status content."""
+
+import json
+import os
+import threading
+import urllib.request
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.lifecycle import PluginManager
+from tpu_device_plugin.status import StatusServer
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture
+def rig(short_root):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+
+    class Reg(api.RegistrationServicer):
+        def Register(self, request, context):
+            return pb.Empty()
+
+    api.add_registration_servicer(kubelet, Reg())
+    kubelet.add_insecure_port(f"unix://{cfg.kubelet_socket}")
+    kubelet.start()
+    manager = PluginManager(cfg)
+    status = StatusServer(manager, port=0)
+    status.start()
+    yield host, manager, status
+    status.stop()
+    manager.stop()
+    kubelet.stop(0)
+
+
+def test_healthz_tracks_manager_state(rig):
+    host, manager, status = rig
+    code, _ = _get(status.port, "/healthz")
+    assert code == 503  # nothing serving yet
+    manager.start()
+    code, body = _get(status.port, "/healthz")
+    assert (code, body) == (200, b"ok")
+    manager.stop()
+    code, _ = _get(status.port, "/healthz")
+    assert code == 503
+
+
+def test_status_payload(rig):
+    host, manager, status = rig
+    manager.start()
+    code, body = _get(status.port, "/status")
+    assert code == 200
+    payload = json.loads(body)
+    assert payload["pending"] == []
+    (plugin,) = payload["plugins"]
+    assert plugin["resource"] == "cloud-tpus.google.com/v4"
+    assert plugin["serving"] is True
+    assert plugin["devices"] == {"0000:00:04.0": "Healthy"}
+    assert plugin["restarts"] == 0
+
+
+def test_unknown_path_404(rig):
+    host, manager, status = rig
+    code, _ = _get(status.port, "/nope")
+    assert code == 404

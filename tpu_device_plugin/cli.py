@@ -18,7 +18,7 @@ from .config import Config
 from .lifecycle import PluginManager
 
 
-def build_config(argv=None) -> Config:
+def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser = argparse.ArgumentParser(
         prog="tpu-device-plugin",
         description="KubeVirt device plugin advertising Google Cloud TPUs "
@@ -47,6 +47,13 @@ def build_config(argv=None) -> Config:
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
+    parser.add_argument("--status-port", type=int, default=0,
+                        help="serve /healthz and /status on this port "
+                             "(0 disables)")
+    parser.add_argument("--status-host", default="0.0.0.0",
+                        help="bind address for the status endpoint (the "
+                             "default serves kubelet httpGet probes on the "
+                             "pod IP)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -72,11 +79,11 @@ def build_config(argv=None) -> Config:
     )
     if args.root:
         cfg = cfg.with_root(args.root)
-    return cfg
+    return cfg, args
 
 
 def main(argv=None) -> int:
-    cfg = build_config(argv)
+    cfg, args = build_config(argv)
     stop = threading.Event()
 
     def handle(signum, frame):
@@ -85,7 +92,17 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
-    PluginManager(cfg).run(stop)
+    manager = PluginManager(cfg)
+    status = None
+    if args.status_port:
+        from .status import StatusServer
+        status = StatusServer(manager, args.status_port, host=args.status_host)
+        status.start()
+    try:
+        manager.run(stop)
+    finally:
+        if status is not None:
+            status.stop()
     return 0
 
 

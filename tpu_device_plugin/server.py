@@ -70,6 +70,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._lifecycle_lock = threading.RLock()  # serializes start/teardown
         self._serving = False
         self._restart_count = 0
+        self._allocatable = [
+            AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
+            for d in self.devices
+        ]
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -258,6 +262,22 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         except OSError:
             pass
 
+    def status_snapshot(self) -> dict:
+        """Public state snapshot for the status endpoint (/status)."""
+        with self._cond:
+            devices = {dev_id: d.health for dev_id, d in self._devs.items()}
+        return {
+            "resource": self.resource_name,
+            "socket": self.socket_path,
+            "serving": self._serving,
+            "restarts": self._restart_count,
+            "devices": devices,
+        }
+
+    @property
+    def serving(self) -> bool:
+        return self._serving
+
     # ------------------------------------------------------------------- RPCs
 
     def GetDevicePluginOptions(self, request, context):
@@ -284,10 +304,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def GetPreferredAllocation(self, request, context):
         resp = pb.PreferredAllocationResponse()
-        allocatable = [
-            AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
-            for d in self.devices
-        ]
+        allocatable = self._allocatable
         for creq in request.container_requests:
             try:
                 ids = preferred_allocation(

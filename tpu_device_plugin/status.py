@@ -1,0 +1,76 @@
+"""Optional HTTP status endpoint for the DaemonSet.
+
+The reference exposes no health surface (SURVEY §5: "no Prometheus, no
+/healthz"); a kubelet can only observe the process. This adds a minimal,
+dependency-free endpoint for liveness probes and debugging:
+
+  GET /healthz  -> 200 "ok" while the manager has plugins serving
+                   (503 otherwise)
+  GET /status   -> JSON: per-plugin resource name, socket, restart count,
+                   device health table, pending (not-yet-registered) plugins
+
+Disabled by default (--status-port 0).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class StatusServer:
+    def __init__(self, manager, port: int = 0, host: str = "127.0.0.1"):
+        self.manager = manager
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                log.debug("status: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer.healthy():
+                        self._send(200, b"ok", "text/plain")
+                    else:
+                        self._send(503, b"no plugins serving", "text/plain")
+                elif self.path == "/status":
+                    self._send(200, json.dumps(outer.status(),
+                                               sort_keys=True).encode())
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="status-http")
+
+    def start(self) -> None:
+        self._thread.start()
+        log.info("status endpoint on http://127.0.0.1:%d", self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def healthy(self) -> bool:
+        plugins = self.manager.plugins
+        return bool(plugins) and any(p.serving for p in plugins)
+
+    def status(self) -> dict:
+        return {
+            "plugins": [p.status_snapshot() for p in self.manager.plugins],
+            "pending": [p.resource_name for p in self.manager.pending],
+        }

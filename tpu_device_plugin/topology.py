@@ -15,6 +15,7 @@ ICI, while a ragged set falls back to PCIe/DCN. Preference order:
 
 from __future__ import annotations
 
+import functools
 import itertools
 import json
 import logging
@@ -98,8 +99,10 @@ class MustIncludeTooLarge(ValueError):
     """MustIncludeDeviceIDs exceeds AllocationSize (reference errors too, :535-538)."""
 
 
-def _boxes(dims: Coords) -> Iterable[Tuple[Tuple[int, int], ...]]:
-    """All axis-aligned sub-boxes, as per-axis (start, length).
+@functools.lru_cache(maxsize=64)
+def _boxes(dims: Coords) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
+    """All axis-aligned sub-boxes, as per-axis (start, length), smallest
+    volume first (so the scan can stop at the first tier of feasible boxes).
 
     Non-wrapping: a host's chips are a *slice* of the pod torus, so partial
     axes have no wraparound ICI link — a "wrapped" pair would really be
@@ -109,7 +112,12 @@ def _boxes(dims: Coords) -> Iterable[Tuple[Tuple[int, int], ...]]:
         [(s, l) for l in range(1, d + 1) for s in range(d) if s + l <= d]
         for d in dims
     ]
-    return itertools.product(*per_axis)
+    def volume(box):
+        v = 1
+        for _, length in box:
+            v *= length
+        return v
+    return tuple(sorted(itertools.product(*per_axis), key=volume))
 
 
 def _in_box(coords: Coords, box: Tuple[Tuple[int, int], ...]) -> bool:
@@ -148,6 +156,13 @@ def preferred_allocation(
         if all(placed(i) for i in must):
             best: Optional[Tuple[Tuple[int, int], List[str]]] = None
             for box in _boxes(torus_dims):
+                volume = 1
+                for _, length in box:
+                    volume *= length
+                if best is not None and volume > best[0][0]:
+                    break  # boxes are volume-sorted; no better score ahead
+                if volume < size:
+                    continue
                 in_box = [i for i in fill_pool
                           if placed(i) and _in_box(by_id[i].coords, box)]
                 if not all(_in_box(by_id[i].coords, box) for i in must):
@@ -155,9 +170,6 @@ def preferred_allocation(
                 if len(in_box) < need:
                     continue
                 chosen = must + in_box[:need]
-                volume = 1
-                for _, length in box:
-                    volume *= length
                 numa_span = len({by_id[i].numa_node for i in chosen})
                 score = (volume, numa_span)
                 if best is None or score < best[0]:

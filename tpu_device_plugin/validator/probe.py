@@ -105,7 +105,35 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
+    # multi-VMI slices (e.g. v5p-16 across 2 nodes): each guest runs the
+    # validator with the same coordinator; jax.distributed composes the
+    # global slice over ICI/DCN and jax.devices() returns ALL chips.
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of process 0 for a multi-VMI slice")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--init-timeout", type=int, default=60,
+                        help="seconds to wait for the multi-VMI rendezvous "
+                             "before reporting failure (default 60)")
     args = parser.parse_args(argv)
+    if args.coordinator is not None:
+        try:
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+                initialization_timeout=args.init_timeout)
+        except Exception as exc:
+            # keep the report-don't-crash contract for catchable failures
+            # (bad/missing arguments). NOTE: an unreachable coordinator makes
+            # jaxlib's C++ coordination client LOG(FATAL) after the timeout —
+            # that path exits the process with a clear stderr message and
+            # cannot be converted to a JSON report from inside the process.
+            report = SliceReport(
+                ok=False, error=f"distributed init: {type(exc).__name__}: {exc}")
+            print(report.to_json())
+            return 1
     cfg = None
     if args.seq_len is not None:
         from .workload import ModelConfig
