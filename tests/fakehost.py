@@ -9,8 +9,54 @@ driver/iommu_group symlinks and attribute files).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
+
+
+class FakeKubelet:
+    """A real gRPC Registration server playing the kubelet.
+
+    Records every RegisterRequest; `wait_for(n)` blocks until n registrations
+    arrived. Shared by every suite that needs a kubelet endpoint.
+    """
+
+    def __init__(self, kubelet_socket: str, max_workers: int = 4):
+        from concurrent import futures
+
+        import grpc
+
+        from tpu_device_plugin import kubeletapi as api
+        from tpu_device_plugin.kubeletapi import pb
+
+        self.registrations = []
+        self.cond = threading.Condition()
+        outer = self
+
+        class Reg(api.RegistrationServicer):
+            def Register(self, request, context):
+                with outer.cond:
+                    outer.registrations.append(request)
+                    outer.cond.notify_all()
+                return pb.Empty()
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        api.add_registration_servicer(self._server, Reg())
+        self._server.add_insecure_port(f"unix://{kubelet_socket}")
+        self._server.start()
+
+    def wait_for(self, n: int, timeout: float = 10) -> bool:
+        with self.cond:
+            return self.cond.wait_for(lambda: len(self.registrations) >= n,
+                                      timeout=timeout)
+
+    @property
+    def resource_names(self):
+        with self.cond:
+            return [r.resource_name for r in self.registrations]
+
+    def stop(self) -> None:
+        self._server.stop(0)
 
 
 @dataclass

@@ -10,28 +10,11 @@ from dataclasses import replace
 import grpc
 import pytest
 
-from tests.fakehost import FakeChip, FakeHost
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
 from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.kubeletapi import pb
 from tpu_device_plugin.lifecycle import PluginManager
-
-
-class FakeKubelet(api.RegistrationServicer):
-    def __init__(self):
-        self.registrations = []
-        self.cond = threading.Condition()
-
-    def Register(self, request, context):
-        with self.cond:
-            self.registrations.append(request)
-            self.cond.notify_all()
-        return pb.Empty()
-
-    def wait_for(self, n, timeout=10):
-        with self.cond:
-            return self.cond.wait_for(lambda: len(self.registrations) >= n,
-                                      timeout=timeout)
 
 
 @pytest.fixture
@@ -39,13 +22,9 @@ def kubelet(short_root):
     host = FakeHost(short_root)
     cfg = Config().with_root(host.root)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
-    kubelet = FakeKubelet()
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-    api.add_registration_servicer(server, kubelet)
-    server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
-    server.start()
-    yield host, cfg, kubelet
-    server.stop(0)
+    kub = FakeKubelet(cfg.kubelet_socket)
+    yield host, cfg, kub
+    kub.stop()
 
 
 def test_manager_starts_plugin_per_resource(kubelet):
@@ -118,20 +97,16 @@ def test_plugin_started_late_when_kubelet_appears(short_root):
     try:
         time.sleep(1.5)  # first start attempt fails: no kubelet socket yet
         assert len(manager.pending) == 1
-        kubelet = FakeKubelet()
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-        api.add_registration_servicer(server, kubelet)
-        server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
-        server.start()
+        kub2 = FakeKubelet(cfg.kubelet_socket)
         try:
-            assert kubelet.wait_for(1, timeout=15), \
+            assert kub2.wait_for(1, timeout=15), \
                 "plugin never registered after kubelet came up"
             deadline = time.monotonic() + 5
             while manager.pending and time.monotonic() < deadline:
                 time.sleep(0.05)
             assert manager.pending == []
         finally:
-            server.stop(0)
+            kub2.stop()
     finally:
         stop.set()
         t.join(timeout=10)

@@ -14,23 +14,12 @@ from concurrent import futures
 import grpc
 import pytest
 
-from tests.fakehost import FakeChip, FakeHost
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
 from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.discovery import discover_passthrough
 from tpu_device_plugin.kubeletapi import pb
 from tpu_device_plugin.server import TpuDevicePlugin
-
-
-class FakeKubelet(api.RegistrationServicer):
-    def __init__(self):
-        self.registrations = []
-        self.event = threading.Event()
-
-    def Register(self, request, context):
-        self.registrations.append(request)
-        self.event.set()
-        return pb.Empty()
 
 
 @pytest.fixture
@@ -41,13 +30,7 @@ def rig(short_root):
         host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", iommu_group=g, numa_node=n))
     cfg = Config().with_root(host.root)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
-
-    kubelet = FakeKubelet()
-    kubelet_server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-    api.add_registration_servicer(kubelet_server, kubelet)
-    kubelet_server.add_insecure_port(f"unix://{cfg.kubelet_socket}")
-    kubelet_server.start()
-
+    kubelet = FakeKubelet(cfg.kubelet_socket)
     registry, generations = discover_passthrough(cfg)
     plugin = TpuDevicePlugin(cfg, "v4", registry,
                              registry.devices_by_model["0062"],
@@ -55,7 +38,7 @@ def rig(short_root):
     plugin.start()
     yield host, cfg, kubelet, plugin
     plugin.stop()
-    kubelet_server.stop(0)
+    kubelet.stop()
 
 
 def _wait(pred, timeout=5.0, interval=0.05):
@@ -69,7 +52,7 @@ def _wait(pred, timeout=5.0, interval=0.05):
 
 def test_start_registers_with_kubelet(rig):
     host, cfg, kubelet, plugin = rig
-    assert kubelet.event.wait(timeout=5)
+    assert kubelet.wait_for(1, timeout=5)
     req = kubelet.registrations[0]
     assert req.resource_name == "cloud-tpus.google.com/v4"
     assert req.version == "v1beta1"
@@ -166,11 +149,10 @@ def test_must_include_too_large_is_invalid_argument(rig):
 
 def test_kubelet_restart_triggers_reregistration(rig):
     host, cfg, kubelet, plugin = rig
-    assert kubelet.event.wait(timeout=5)
-    kubelet.event.clear()
+    assert kubelet.wait_for(1, timeout=5)
     # kubelet restart wipes the device-plugin dir: remove the plugin's socket
     os.unlink(plugin.socket_path)
-    assert kubelet.event.wait(timeout=10), "plugin did not re-register"
+    assert kubelet.wait_for(2, timeout=10), "plugin did not re-register"
     assert len(kubelet.registrations) == 2
     assert _wait(lambda: os.path.exists(plugin.socket_path))
     # plugin is serving again on the fresh socket

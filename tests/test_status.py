@@ -3,13 +3,14 @@
 import json
 import os
 import threading
+import time
 import urllib.request
 from concurrent import futures
 
 import grpc
 import pytest
 
-from tests.fakehost import FakeChip, FakeHost
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
 from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.kubeletapi import pb
@@ -31,34 +32,65 @@ def rig(short_root):
     host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
     cfg = Config().with_root(host.root)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
-    kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
-
-    class Reg(api.RegistrationServicer):
-        def Register(self, request, context):
-            return pb.Empty()
-
-    api.add_registration_servicer(kubelet, Reg())
-    kubelet.add_insecure_port(f"unix://{cfg.kubelet_socket}")
-    kubelet.start()
+    kubelet = FakeKubelet(cfg.kubelet_socket)
     manager = PluginManager(cfg)
     status = StatusServer(manager, port=0)
     status.start()
     yield host, manager, status
     status.stop()
     manager.stop()
-    kubelet.stop(0)
+    kubelet.stop()
 
 
-def test_healthz_tracks_manager_state(rig):
+def test_healthz_is_liveness_not_readiness(rig):
+    """healthz must stay 200 while the run loop is alive even when no plugin
+    is serving yet (boot-wait-for-kubelet must NOT be killed by a liveness
+    probe); readyz flips with actual serving state."""
     host, manager, status = rig
     code, _ = _get(status.port, "/healthz")
-    assert code == 503  # nothing serving yet
-    manager.start()
-    code, body = _get(status.port, "/healthz")
-    assert (code, body) == (200, b"ok")
-    manager.stop()
-    code, _ = _get(status.port, "/healthz")
+    assert code == 503  # run loop not started
+    code, _ = _get(status.port, "/readyz")
     assert code == 503
+
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if _get(status.port, "/readyz")[0] == 200:
+            break
+        time.sleep(0.05)
+    assert _get(status.port, "/healthz")[0] == 200
+    assert _get(status.port, "/readyz")[0] == 200
+    stop.set()
+    t.join(timeout=10)
+    code, _ = _get(status.port, "/healthz")
+    assert code == 503  # loop exited
+
+
+def test_healthz_alive_while_pending(short_root):
+    """No kubelet at all: plugins stay pending, healthz 200, readyz 503."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root), grpc_timeout_s=0.5)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    manager = PluginManager(cfg)
+    status = StatusServer(manager, port=0)
+    status.start()
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not manager.pending and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _get(status.port, "/healthz")[0] == 200
+        assert _get(status.port, "/readyz")[0] == 503
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        status.stop()
 
 
 def test_status_payload(rig):

@@ -14,7 +14,7 @@ from concurrent import futures
 import grpc
 import pytest
 
-from tests.fakehost import FakeChip, FakeHost
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
 from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.discovery import discover_passthrough
@@ -30,17 +30,8 @@ def rig(short_root):
                                iommu_group=str(11 + i), numa_node=i // 4))
     cfg = Config().with_root(host.root)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
-    kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
-    regs = []
-
-    class Reg(api.RegistrationServicer):
-        def Register(self, request, context):
-            regs.append(request.resource_name)
-            return pb.Empty()
-
-    api.add_registration_servicer(kubelet, Reg())
-    kubelet.add_insecure_port(f"unix://{cfg.kubelet_socket}")
-    kubelet.start()
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    regs = kubelet.registrations
     registry, generations = discover_passthrough(cfg)
     plugin = TpuDevicePlugin(cfg, "v5e", registry,
                              registry.devices_by_model["0063"],
@@ -48,7 +39,7 @@ def rig(short_root):
     plugin.start()
     yield host, cfg, plugin, regs
     plugin.stop()
-    kubelet.stop(0)
+    kubelet.stop()
 
 
 def test_parallel_rpcs_under_health_churn(rig):

@@ -33,6 +33,7 @@ class PluginManager:
         self.plugins: List[TpuDevicePlugin] = []
         self.pending: List[TpuDevicePlugin] = []
         self.registry: Optional[Registry] = None
+        self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
 
     def build_plugins(self, inventory=None) -> List[TpuDevicePlugin]:
@@ -101,6 +102,7 @@ class PluginManager:
 
     def run(self, stop_event: threading.Event) -> None:
         """Start everything and block until `stop_event` (reference :166-175)."""
+        self.running.set()
         self.start()
         interval = self.cfg.rediscovery_interval_s
         try:
@@ -114,4 +116,5 @@ class PluginManager:
                         self.stop()
                         self.start(inventory)
         finally:
+            self.running.clear()
             self.stop()

@@ -4,8 +4,11 @@ The reference exposes no health surface (SURVEY §5: "no Prometheus, no
 /healthz"); a kubelet can only observe the process. This adds a minimal,
 dependency-free endpoint for liveness probes and debugging:
 
-  GET /healthz  -> 200 "ok" while the manager has plugins serving
-                   (503 otherwise)
+  GET /healthz  -> liveness: 200 while the manager's run loop is alive —
+                   including the boot state where plugins are still waiting
+                   for the kubelet socket (killing the pod there would defeat
+                   the manager's own retry loop); 503 only when the loop died
+  GET /readyz   -> readiness: 200 once at least one plugin is serving
   GET /status   -> JSON: per-plugin resource name, socket, restart count,
                    device health table, pending (not-yet-registered) plugins
 
@@ -18,7 +21,6 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
 log = logging.getLogger(__name__)
 
@@ -42,7 +44,12 @@ class StatusServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    if outer.healthy():
+                    if outer.alive():
+                        self._send(200, b"ok", "text/plain")
+                    else:
+                        self._send(503, b"manager loop not running", "text/plain")
+                elif self.path == "/readyz":
+                    if outer.ready():
                         self._send(200, b"ok", "text/plain")
                     else:
                         self._send(503, b"no plugins serving", "text/plain")
@@ -59,13 +66,17 @@ class StatusServer:
 
     def start(self) -> None:
         self._thread.start()
-        log.info("status endpoint on http://127.0.0.1:%d", self.port)
+        host, port = self._httpd.server_address[:2]
+        log.info("status endpoint on http://%s:%d", host, port)
 
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
 
-    def healthy(self) -> bool:
+    def alive(self) -> bool:
+        return self.manager.running.is_set()
+
+    def ready(self) -> bool:
         plugins = self.manager.plugins
         return bool(plugins) and any(p.serving for p in plugins)
 

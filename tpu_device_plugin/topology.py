@@ -100,9 +100,9 @@ class MustIncludeTooLarge(ValueError):
 
 
 @functools.lru_cache(maxsize=64)
-def _boxes(dims: Coords) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
-    """All axis-aligned sub-boxes, as per-axis (start, length), smallest
-    volume first (so the scan can stop at the first tier of feasible boxes).
+def _boxes(dims: Coords) -> Tuple[Tuple[int, Tuple[Tuple[int, int], ...]], ...]:
+    """All axis-aligned sub-boxes as (volume, per-axis (start, length)),
+    smallest volume first (so the scan can stop at the first feasible tier).
 
     Non-wrapping: a host's chips are a *slice* of the pod torus, so partial
     axes have no wraparound ICI link — a "wrapped" pair would really be
@@ -117,7 +117,8 @@ def _boxes(dims: Coords) -> Tuple[Tuple[Tuple[int, int], ...], ...]:
         for _, length in box:
             v *= length
         return v
-    return tuple(sorted(itertools.product(*per_axis), key=volume))
+    return tuple(sorted(((volume(b), b) for b in itertools.product(*per_axis)),
+                        key=lambda vb: vb[0]))
 
 
 def _in_box(coords: Coords, box: Tuple[Tuple[int, int], ...]) -> bool:
@@ -155,10 +156,7 @@ def preferred_allocation(
 
         if all(placed(i) for i in must):
             best: Optional[Tuple[Tuple[int, int], List[str]]] = None
-            for box in _boxes(torus_dims):
-                volume = 1
-                for _, length in box:
-                    volume *= length
+            for volume, box in _boxes(torus_dims):
                 if best is not None and volume > best[0][0]:
                     break  # boxes are volume-sorted; no better score ahead
                 if volume < size:

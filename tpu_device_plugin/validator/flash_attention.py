@@ -1,0 +1,159 @@
+"""Pallas flash-attention kernel — the burn-in's hot op, TPU-first.
+
+Causal multi-head attention computed blockwise with the online-softmax
+recurrence so the (S, S) score matrix never materializes in HBM: each grid
+step streams one (block_q, block_k) tile through VMEM, keeping running max
+`m`, normalizer `l`, and output accumulator in VMEM scratch. The MXU sees two
+matmuls per tile (Q·Kᵀ and P·V) with float32 accumulation; blocks entirely
+above the causal diagonal are skipped via `pl.when`.
+
+Training integration uses `jax.custom_vjp` with a rematerialized reference
+backward: the forward runs the Pallas kernel; the backward recomputes
+attention with plain einsum math and differentiates that. This keeps the
+kernel forward-only (the expensive, latency-critical direction for burn-in)
+while gradients stay exactly correct.
+
+`interpret=True` runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _reference_attention(q, k, v, sm_scale: float, causal: bool):
+    """Plain einsum attention; used for the custom-vjp backward and tests.
+
+    Shapes: q, k, v are (heads_batch, seq, head_dim).
+    """
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        seq = q.shape[1]
+        mask = jnp.tril(jnp.ones((seq, seq), jnp.bool_))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  sm_scale: float, causal: bool,
+                  block_q: int, block_k: int, num_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip tiles strictly above the causal diagonal
+    run = (kj * block_k <= qi * block_q + block_q - 1) if causal else (kj >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                       # (block_q, d)
+        k = k_ref[0]                       # (block_k, d)
+        v = v_ref[0]
+        # Padding discipline: when seq_len is not a block multiple, Pallas
+        # pads the trailing block with undefined data (NaN in interpret
+        # mode). Padding key columns must be masked out of the softmax and
+        # padding value rows zeroed, or NaN poisons every query row.
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        k_valid = cols < seq_len
+        v_rows_valid = (kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, v.shape, 0)) < seq_len
+        v = jnp.where(v_rows_valid, v, jnp.zeros_like(v))
+        k = jnp.where(v_rows_valid, k, jnp.zeros_like(k))
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # (bq, bk)
+        mask = k_valid if not causal else (k_valid & (cols <= rows))
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, :1]                                # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                               # (bq, bk) f32
+        alpha = jnp.exp(m_prev - m_new)                      # (bq, 1)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:, :1] = m_new
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    last_k = (jnp.minimum((qi * block_q + block_q - 1) // block_k, num_k - 1)
+              if causal else num_k - 1)
+
+    @pl.when(kj == last_k)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_3d(q, k, v, sm_scale: float, causal: bool,
+              block_q: int, block_k: int, interpret: bool):
+    """(heads_batch, seq, head_dim) flash attention via pallas_call."""
+    hb, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    num_q = pl.cdiv(seq, block_q)
+    num_k = pl.cdiv(seq, block_k)
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k, seq_len=seq)
+    return pl.pallas_call(
+        kernel,
+        grid=(hb, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((hb, seq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
+            pltpu.VMEM((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, sm_scale: Optional[float] = None,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Blockwise causal attention. q, k, v: (heads_batch, seq, head_dim)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _flash_3d(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, d_out):
+    q, k, v = residuals
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    # rematerialized reference backward: exact gradients, no kernel state
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, sm_scale, causal),
+        q, k, v)
+    return vjp(d_out)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -79,23 +79,47 @@ def param_specs(cfg: ModelConfig) -> Params:
     }
 
 
-def _attention(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
+               flash: bool = False, interpret: bool = True) -> jax.Array:
     b, s, d = x.shape
     h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
     q = (x @ layer["wq"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
     k = (x @ layer["wk"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
     v = (x @ layer["wv"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
-    # Sequence parallelism: queries stay sequence-sharded; keys/values are
-    # gathered across the sp axis (XLA emits the all-gather) so every query
-    # block attends over the full context.
-    q = jax.lax.with_sharding_constraint(q, P("dp", "sp", "tp", None))
-    k = jax.lax.with_sharding_constraint(k, P("dp", None, "tp", None))
-    v = jax.lax.with_sharding_constraint(v, P("dp", None, "tp", None))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (dh ** -0.5)
-    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
-    scores = jnp.where(mask[None, None, :, :], scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
+    if flash:
+        # batch and heads are embarrassingly parallel over dp x tp: run the
+        # Pallas flash kernel per shard via shard_map (requires sp == 1 so
+        # every shard holds the full sequence)
+        from .flash_attention import flash_attention
+
+        def local_attn(q_, k_, v_):
+            bl, sl, hl, dl = q_.shape
+            def fold(t):
+                return t.transpose(0, 2, 1, 3).reshape(bl * hl, sl, dl)
+            o = flash_attention(fold(q_), fold(k_), fold(v_),
+                                None, True, 128, 128, interpret)
+            return o.reshape(bl, hl, sl, dl).transpose(0, 2, 1, 3)
+
+        out4 = jax.shard_map(
+            local_attn,
+            in_specs=(P("dp", None, "tp", None),) * 3,
+            out_specs=P("dp", None, "tp", None),
+            # pallas_call's out_shape carries no varying-mesh-axes metadata
+            check_vma=False,
+        )(q, k, v)
+        out = out4.reshape(b, s, d)
+    else:
+        # Sequence parallelism: queries stay sequence-sharded; keys/values
+        # are gathered across the sp axis (XLA emits the all-gather) so
+        # every query block attends over the full context.
+        q = jax.lax.with_sharding_constraint(q, P("dp", "sp", "tp", None))
+        k = jax.lax.with_sharding_constraint(k, P("dp", None, "tp", None))
+        v = jax.lax.with_sharding_constraint(v, P("dp", None, "tp", None))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (dh ** -0.5)
+        mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        scores = jnp.where(mask[None, None, :, :], scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, d)
     return out @ layer["wo"].astype(jnp.bfloat16)
 
 
@@ -109,19 +133,21 @@ def _rms_norm(x: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
 
 
-def forward(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            flash: bool = False, interpret: bool = True) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
     for layer in params["layers"]:
-        x = x + _attention(_rms_norm(x), layer, cfg)
+        x = x + _attention(_rms_norm(x), layer, cfg, flash, interpret)
         x = x + _mlp(_rms_norm(x), layer)
         x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
     logits = _rms_norm(x) @ params["unembed"].astype(jnp.bfloat16)
     return logits.astype(jnp.float32)
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    logits = forward(params, tokens, cfg)
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            flash: bool = False, interpret: bool = True) -> jax.Array:
+    logits = forward(params, tokens, cfg, flash, interpret)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1])
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
@@ -129,9 +155,10 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def sgd_step(params: Params, momentum: Params, tokens: jax.Array,
-             cfg: ModelConfig) -> Tuple[Params, Params, jax.Array]:
+             cfg: ModelConfig, flash: bool = False,
+             interpret: bool = True) -> Tuple[Params, Params, jax.Array]:
     """One full training step: loss, grads (psum over dp/sp implicit), SGD-M."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, flash, interpret)
     new_momentum = jax.tree.map(
         lambda m, g: cfg.momentum * m + g, momentum, grads)
     new_params = jax.tree.map(
@@ -143,17 +170,28 @@ def build_workload(
     cfg: Optional[ModelConfig] = None,
     mesh: Optional[Mesh] = None,
     seed: int = 0,
+    flash: Optional[bool] = None,
 ):
     """Returns (jitted step, params, momentum, tokens), device-placed.
 
     Params/optimizer state follow `param_specs`, the batch is sharded
     (dp, sp). Without a mesh a trivial 1x1x1 mesh over the first visible
     device is used, so the same annotated program compiles single-chip.
+
+    flash=None auto-selects the Pallas flash-attention kernel on TPU when
+    the mesh has no sequence sharding (sp == 1); flash=True forces it (in
+    interpret mode off-TPU), flash=False forces the einsum path.
     """
     cfg = cfg or ModelConfig()
     if mesh is None:
         from .mesh import slice_mesh
         mesh = slice_mesh(jax.devices()[:1])
+    platform = mesh.devices.flat[0].platform
+    sp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
+    if flash is None:
+        flash = platform == "tpu" and sp_size == 1
+    elif flash and sp_size != 1:
+        raise ValueError("flash attention requires sp == 1 (full local sequence)")
     key = jax.random.key(seed)
     params = init_params(key, cfg)
     momentum = jax.tree.map(jnp.zeros_like, params)
@@ -161,7 +199,8 @@ def build_workload(
         jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
         dtype=jnp.int32)
 
-    step = partial(sgd_step, cfg=cfg)
+    step = partial(sgd_step, cfg=cfg, flash=flash,
+                   interpret=platform != "tpu")
     pspecs = param_specs(cfg)
     param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
                             is_leaf=lambda x: isinstance(x, P))
