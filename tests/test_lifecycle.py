@@ -1,19 +1,14 @@
 """PluginManager: per-resource plugin fan-out, error tolerance, run loop."""
 
-import json
 import os
 import threading
 import time
-from concurrent import futures
 from dataclasses import replace
 
-import grpc
 import pytest
 
 from tests.fakehost import FakeChip, FakeHost, FakeKubelet
-from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
-from tpu_device_plugin.kubeletapi import pb
 from tpu_device_plugin.lifecycle import PluginManager
 
 

@@ -10,7 +10,6 @@ on both nodes succeed independently.
 
 import os
 import threading
-from concurrent import futures
 
 import grpc
 import pytest

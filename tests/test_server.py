@@ -9,7 +9,6 @@ induced by deleting/creating actual device nodes.
 import os
 import threading
 import time
-from concurrent import futures
 
 import grpc
 import pytest

@@ -5,15 +5,11 @@ import os
 import threading
 import time
 import urllib.request
-from concurrent import futures
 
-import grpc
 import pytest
 
 from tests.fakehost import FakeChip, FakeHost, FakeKubelet
-from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
-from tpu_device_plugin.kubeletapi import pb
 from tpu_device_plugin.lifecycle import PluginManager
 from tpu_device_plugin.status import StatusServer
 

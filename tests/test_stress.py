@@ -9,7 +9,6 @@ import os
 import random
 import threading
 import time
-from concurrent import futures
 
 import grpc
 import pytest

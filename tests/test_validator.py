@@ -86,3 +86,17 @@ def test_validate_slice_single_device():
 def test_graft_dryrun_multichip():
     import __graft_entry__ as graft
     graft.dryrun_multichip(8)
+
+
+def test_microbench_failure_never_vetoes(monkeypatch):
+    """A diagnostic microbench failure must not flip a passing validation."""
+    from tpu_device_plugin.validator import probe as probe_mod
+
+    def boom(device):
+        raise MemoryError("256MiB scratch OOM")
+
+    monkeypatch.setattr(probe_mod, "_microbench", boom)
+    report = probe_mod.validate_slice(cfg=SMALL, steps=2, devices=cpus()[:1])
+    assert report.ok is True
+    assert report.matmul_tflops == 0.0
+    assert "microbench skipped" in report.error

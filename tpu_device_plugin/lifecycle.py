@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from .config import Config
 from .discovery import discover

@@ -20,7 +20,7 @@ import itertools
 import json
 import logging
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .naming import GenerationInfo
 

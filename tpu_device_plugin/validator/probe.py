@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 _PROCESS_START = time.monotonic()
 
@@ -29,6 +29,8 @@ class SliceReport:
     first_step_s: float = 0.0        # process start -> first compiled step done
     step_time_s: float = 0.0         # steady-state step latency
     tflops_per_chip: float = 0.0     # burn-in matmul throughput
+    matmul_tflops: float = 0.0       # peak-ish single-chip bf16 matmul
+    hbm_gbps: float = 0.0            # single-chip memory bandwidth estimate
     loss_start: float = 0.0
     loss_end: float = 0.0
     error: str = ""
@@ -45,6 +47,42 @@ def _workload_flops(cfg) -> float:
         + 2 * cfg.d_model * cfg.d_ff         # mlp
     ) * 2 * cfg.n_layers + 2 * cfg.d_model * cfg.vocab * 2
     return 3.0 * per_token * cfg.batch * cfg.seq_len
+
+
+def _microbench(device) -> tuple:
+    """Single-chip sanity numbers: bf16 matmul TFLOP/s and memory GB/s.
+
+    Small enough to finish in seconds; meant to catch a chip running at a
+    fraction of expected speed (thermal clamp, degraded HBM), not to be a
+    rigorous peak benchmark.
+    """
+    import jax
+    import jax.numpy as jnp
+    on_tpu = device.platform == "tpu"
+    n = 4096 if on_tpu else 512
+    x = jax.device_put(jnp.ones((n, n), jnp.bfloat16), device)
+    mm = jax.jit(lambda a: a @ a)
+    mm(x).block_until_ready()
+    iters = 8
+    t0 = time.monotonic()
+    y = x
+    for _ in range(iters):
+        y = mm(y)
+    y.block_until_ready()
+    tflops = 2.0 * n ** 3 * iters / (time.monotonic() - t0) / 1e12
+
+    m = (256 if on_tpu else 16) * 1024 * 1024 // 4
+    big = jax.device_put(jnp.ones((m,), jnp.float32), device)
+    add = jax.jit(lambda a: a + 1.0)
+    add(big).block_until_ready()
+    t0 = time.monotonic()
+    z = big
+    for _ in range(iters):
+        z = add(z)
+    z.block_until_ready()
+    # one read + one write of m float32 per iteration
+    gbps = 2.0 * m * 4 * iters / (time.monotonic() - t0) / 1e9
+    return tflops, gbps
 
 
 def validate_slice(
@@ -92,6 +130,19 @@ def validate_slice(
         if not report.ok:
             report.error = (f"loss did not decrease "
                             f"({report.loss_start:.4f} -> {report.loss_end:.4f})")
+
+        # Diagnostic-only numbers, never a veto: runs after the verdict, on a
+        # device THIS process can address (in multi-VMI mode jax.devices()
+        # spans all guests but only local ones are usable here).
+        try:
+            local = next((d for d in devices
+                          if d.process_index == jax.process_index()),
+                         jax.local_devices()[0])
+            report.matmul_tflops, report.hbm_gbps = _microbench(local)
+        except Exception as exc:
+            log_err = f"microbench skipped: {type(exc).__name__}: {exc}"
+            if not report.error:
+                report.error = log_err
     except Exception as exc:  # report, don't crash the probe harness
         report.error = f"{type(exc).__name__}: {exc}"
     return report

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import grpc
 
@@ -32,7 +32,6 @@ from .kubeletapi import pb
 from .naming import sanitize_name
 from .registry import Registry, TpuPartition
 from .server import TpuDevicePlugin
-from .topology import MustIncludeTooLarge
 
 log = logging.getLogger(__name__)
 
