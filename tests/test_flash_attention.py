@@ -64,9 +64,9 @@ def test_flash_training_matches_einsum_sharded():
     cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, d_ff=128, n_layers=1,
                       seq_len=64, batch=4)
     mesh = slice_mesh(cpus, tp=2, sp=1)
-    step_f, p, m, t = build_workload(cfg, mesh, seed=3, flash=True)
+    step_f, p, m, t = build_workload(cfg, mesh, seed=3, attention="flash")
     _, _, loss_flash = step_f(p, m, t)
-    step_e, p, m, t = build_workload(cfg, mesh, seed=3, flash=False)
+    step_e, p, m, t = build_workload(cfg, mesh, seed=3, attention="einsum")
     _, _, loss_einsum = step_e(p, m, t)
     assert abs(float(loss_flash) - float(loss_einsum)) < 2e-2
 
@@ -79,4 +79,45 @@ def test_flash_requires_full_sequence():
     from tpu_device_plugin.validator.workload import ModelConfig, build_workload
     mesh = slice_mesh(cpus, tp=2, sp=2)
     with pytest.raises(ValueError, match="sp == 1"):
-        build_workload(ModelConfig(), mesh, flash=True)
+        build_workload(ModelConfig(), mesh, attention="flash")
+
+
+def test_ring_training_matches_einsum_sharded():
+    """Ring attention (sp=2) must train identically to the KV-all-gather."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("need 8 virtual CPU devices")
+    from tpu_device_plugin.validator.mesh import slice_mesh
+    from tpu_device_plugin.validator.workload import ModelConfig, build_workload
+    cfg = ModelConfig(vocab=64, d_model=64, n_heads=4, d_ff=128, n_layers=1,
+                      seq_len=64, batch=4)
+    mesh = slice_mesh(cpus, tp=2, sp=2)
+    step_r, p, m, t = build_workload(cfg, mesh, seed=3, attention="ring")
+    _, _, loss_ring = step_r(p, m, t)
+    step_e, p, m, t = build_workload(cfg, mesh, seed=3, attention="einsum")
+    _, _, loss_einsum = step_e(p, m, t)
+    assert abs(float(loss_ring) - float(loss_einsum)) < 2e-2
+
+
+def test_ring_is_default_for_sp_meshes():
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("need 8 virtual CPU devices")
+    from tpu_device_plugin.validator.mesh import slice_mesh
+    from tpu_device_plugin.validator.workload import ModelConfig, build_workload
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=1,
+                      seq_len=32, batch=4)
+    mesh = slice_mesh(cpus, tp=1, sp=4)  # dp=2, sp=4
+    step, p, m, t = build_workload(cfg, mesh, seed=1)  # attention=None -> ring
+    p, m, loss0 = step(p, m, t)
+    for _ in range(3):
+        p, m, loss = step(p, m, t)
+    assert float(loss) < float(loss0)
+
+
+def test_unknown_attention_mode_rejected():
+    from tpu_device_plugin.validator.mesh import slice_mesh
+    from tpu_device_plugin.validator.workload import ModelConfig, build_workload
+    with pytest.raises(ValueError, match="unknown attention"):
+        build_workload(ModelConfig(), slice_mesh(jax.devices("cpu")[:1]),
+                       attention="quantum")

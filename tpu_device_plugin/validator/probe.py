@@ -91,7 +91,7 @@ def validate_slice(
     tp: Optional[int] = None,
     sp: Optional[int] = None,
     devices=None,
-    flash: Optional[bool] = None,
+    attention: Optional[str] = None,
 ) -> SliceReport:
     report = SliceReport(ok=False)
     try:
@@ -109,7 +109,8 @@ def validate_slice(
         mesh = slice_mesh(devices, tp=tp, sp=sp) if len(devices) > 1 else None
         if mesh is not None:
             report.mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-        step, params, momentum, tokens = build_workload(cfg, mesh, flash=flash)
+        step, params, momentum, tokens = build_workload(cfg, mesh,
+                                                        attention=attention)
 
         params, momentum, loss = step(params, momentum, tokens)
         report.loss_start = float(loss)
@@ -157,9 +158,11 @@ def main(argv=None) -> int:
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
-    parser.add_argument("--attention", choices=["auto", "flash", "einsum"],
+    parser.add_argument("--attention",
+                        choices=["auto", "flash", "ring", "einsum"],
                         default="auto",
-                        help="auto = Pallas flash kernel on TPU when sp == 1")
+                        help="auto = ring when sp > 1, Pallas flash kernel "
+                             "on TPU when sp == 1, einsum otherwise")
     # multi-VMI slices (e.g. v5p-16 across 2 nodes): each guest runs the
     # validator with the same coordinator; jax.distributed composes the
     # global slice over ICI/DCN and jax.devices() returns ALL chips.
@@ -193,8 +196,8 @@ def main(argv=None) -> int:
     if args.seq_len is not None:
         from .workload import ModelConfig
         cfg = ModelConfig(seq_len=args.seq_len)
-    flash = {"auto": None, "flash": True, "einsum": False}[args.attention]
+    attention = None if args.attention == "auto" else args.attention
     report = validate_slice(cfg=cfg, steps=args.steps, tp=args.tp, sp=args.sp,
-                            flash=flash)
+                            attention=attention)
     print(report.to_json())
     return 0 if report.ok else 1

@@ -1,0 +1,68 @@
+"""Ring attention — sequence-parallel causal attention over the ICI ring.
+
+The KV-all-gather form of sequence parallelism (workload.py's einsum path)
+materializes the full K/V on every chip: O(S) memory per chip. Ring attention
+keeps K/V sharded — each of the `sp` shards holds S/sp keys/values — and
+rotates the KV block around the mesh axis with `jax.lax.ppermute` while
+accumulating attention with the same online-softmax recurrence the Pallas
+flash kernel uses. Forward-pass K/V residency is O(S/sp) per chip and every
+hop is a nearest-neighbor ICI transfer, which is exactly what the torus is
+for. (Under plain autodiff the backward pass still saves the rotated blocks
+and per-step score tiles — a rematerializing custom_vjp like the flash
+kernel's would extend the bound to training; the burn-in's sequences are
+short enough that exact autodiff is the simpler, safer choice here.)
+
+Causality at block granularity: shard i's queries attend fully to KV blocks
+j < i, causally to block j == i, and not at all to j > i. The rotation
+schedule visits the local block first, so the running max is finite from
+step 0.
+
+Runs inside `jax.shard_map`; the loop over ring steps is a static Python
+unroll (mesh size is static), XLA-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   sm_scale: float, axis_name: str = "sp") -> jax.Array:
+    """Causal attention with KV rotating around `axis_name`.
+
+    Local shapes: q, k, v are (heads_batch, seq_local, head_dim); the global
+    sequence is the concatenation of shards along `axis_name` in axis order.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    bh, s_local, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    m = jnp.full((bh, s_local, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, s_local, 1), jnp.float32)
+    acc = jnp.zeros((bh, s_local, d), jnp.float32)
+    tril = jnp.tril(jnp.ones((s_local, s_local), jnp.bool_))[None]
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        # the KV block now held locally originated at shard (my_idx - step)
+        src = (my_idx - step) % n
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_cur.astype(jnp.float32)) * sm_scale
+        allow = (src < my_idx) | ((src == my_idx) & tril)
+        s = jnp.where(allow, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bqk,bkd->bqd", p, v_cur.astype(jnp.float32))
+        m = m_new
+        if step != n - 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    return (acc / l).astype(q.dtype)

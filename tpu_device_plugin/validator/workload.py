@@ -4,10 +4,14 @@ A deliberately small but *real* training step — embedding, multi-head causal
 attention, MLP, cross-entropy, SGD-with-momentum — written TPU-first:
 
 - all matmuls run in bfloat16 (MXU-shaped), accumulating in float32;
-- parallelism is expressed purely through sharding annotations on a
-  ("dp", "sp", "tp") mesh and `with_sharding_constraint`; XLA inserts the
-  collectives (gradient psum over dp/sp, activation all-gathers for tp, and
-  the KV all-gather that implements sequence parallelism for long context);
+- parallelism is expressed through sharding annotations on a
+  ("dp", "sp", "tp") mesh plus `shard_map` for the attention inner loop; XLA
+  inserts the collectives (gradient psum over dp/sp, activation all-gathers
+  for tp);
+- long context gets three attention strategies: `ring` (sequence-parallel
+  ring attention, K/V rotate over ICI via ppermute — O(S/sp) forward
+  residency), `flash` (Pallas blockwise kernel when the full sequence is
+  local), and `einsum` (KV all-gather reference path);
 - control flow is static: one traced step, no data-dependent Python.
 
 Used by the guest validator to burn in a passed-through slice, and by
@@ -79,26 +83,53 @@ def param_specs(cfg: ModelConfig) -> Params:
     }
 
 
+def _fold_heads(t: jax.Array):
+    bl, sl, hl, dl = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(bl * hl, sl, dl)
+
+
+def _unfold_heads(t: jax.Array, bl: int, hl: int):
+    _, sl, dl = t.shape
+    return t.reshape(bl, hl, sl, dl).transpose(0, 2, 1, 3)
+
+
 def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
-               flash: bool = False, interpret: bool = True) -> jax.Array:
+               attention: str = "einsum", interpret: bool = True) -> jax.Array:
     b, s, d = x.shape
     h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
     q = (x @ layer["wq"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
     k = (x @ layer["wk"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
     v = (x @ layer["wv"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
-    if flash:
+    if attention == "ring":
+        # sequence-parallel ring attention: K/V stay sharded along sp and
+        # rotate around the ICI ring (O(S/sp) memory vs the all-gather's O(S))
+        from .ring_attention import ring_attention
+
+        def local_ring(q_, k_, v_):
+            bl, _, hl, _ = q_.shape
+            o = ring_attention(_fold_heads(q_), _fold_heads(k_),
+                               _fold_heads(v_), dh ** -0.5, axis_name="sp")
+            return _unfold_heads(o, bl, hl)
+
+        out4 = jax.shard_map(
+            local_ring,
+            in_specs=(P("dp", "sp", "tp", None),) * 3,
+            out_specs=P("dp", "sp", "tp", None),
+            check_vma=False,
+        )(q, k, v)
+        out = out4.reshape(b, s, d)
+    elif attention == "flash":
         # batch and heads are embarrassingly parallel over dp x tp: run the
         # Pallas flash kernel per shard via shard_map (requires sp == 1 so
         # every shard holds the full sequence)
         from .flash_attention import flash_attention
 
         def local_attn(q_, k_, v_):
-            bl, sl, hl, dl = q_.shape
-            def fold(t):
-                return t.transpose(0, 2, 1, 3).reshape(bl * hl, sl, dl)
-            o = flash_attention(fold(q_), fold(k_), fold(v_),
-                                None, True, 128, 128, interpret)
-            return o.reshape(bl, hl, sl, dl).transpose(0, 2, 1, 3)
+            bl, _, hl, _ = q_.shape
+            o = flash_attention(_fold_heads(q_), _fold_heads(k_),
+                                _fold_heads(v_), None, True, 128, 128,
+                                interpret)
+            return _unfold_heads(o, bl, hl)
 
         out4 = jax.shard_map(
             local_attn,
@@ -134,11 +165,11 @@ def _rms_norm(x: jax.Array) -> jax.Array:
 
 
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            flash: bool = False, interpret: bool = True) -> jax.Array:
+            attention: str = "einsum", interpret: bool = True) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
     for layer in params["layers"]:
-        x = x + _attention(_rms_norm(x), layer, cfg, flash, interpret)
+        x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret)
         x = x + _mlp(_rms_norm(x), layer)
         x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
     logits = _rms_norm(x) @ params["unembed"].astype(jnp.bfloat16)
@@ -146,8 +177,8 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            flash: bool = False, interpret: bool = True) -> jax.Array:
-    logits = forward(params, tokens, cfg, flash, interpret)
+            attention: str = "einsum", interpret: bool = True) -> jax.Array:
+    logits = forward(params, tokens, cfg, attention, interpret)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1])
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
@@ -155,10 +186,11 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
 
 
 def sgd_step(params: Params, momentum: Params, tokens: jax.Array,
-             cfg: ModelConfig, flash: bool = False,
+             cfg: ModelConfig, attention: str = "einsum",
              interpret: bool = True) -> Tuple[Params, Params, jax.Array]:
     """One full training step: loss, grads (psum over dp/sp implicit), SGD-M."""
-    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, flash, interpret)
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attention,
+                                              interpret)
     new_momentum = jax.tree.map(
         lambda m, g: cfg.momentum * m + g, momentum, grads)
     new_params = jax.tree.map(
@@ -170,7 +202,7 @@ def build_workload(
     cfg: Optional[ModelConfig] = None,
     mesh: Optional[Mesh] = None,
     seed: int = 0,
-    flash: Optional[bool] = None,
+    attention: Optional[str] = None,
 ):
     """Returns (jitted step, params, momentum, tokens), device-placed.
 
@@ -178,9 +210,10 @@ def build_workload(
     (dp, sp). Without a mesh a trivial 1x1x1 mesh over the first visible
     device is used, so the same annotated program compiles single-chip.
 
-    flash=None auto-selects the Pallas flash-attention kernel on TPU when
-    the mesh has no sequence sharding (sp == 1); flash=True forces it (in
-    interpret mode off-TPU), flash=False forces the einsum path.
+    attention: "flash" (Pallas kernel, needs sp == 1), "ring"
+    (sequence-parallel ring attention, K/V rotate over the sp axis),
+    "einsum" (KV all-gather). None auto-selects: ring when sp > 1, flash on
+    TPU when sp == 1, einsum otherwise.
     """
     cfg = cfg or ModelConfig()
     if mesh is None:
@@ -188,10 +221,17 @@ def build_workload(
         mesh = slice_mesh(jax.devices()[:1])
     platform = mesh.devices.flat[0].platform
     sp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
-    if flash is None:
-        flash = platform == "tpu" and sp_size == 1
-    elif flash and sp_size != 1:
+    if attention is None:
+        if sp_size > 1:
+            attention = "ring"
+        elif platform == "tpu":
+            attention = "flash"
+        else:
+            attention = "einsum"
+    if attention == "flash" and sp_size != 1:
         raise ValueError("flash attention requires sp == 1 (full local sequence)")
+    if attention not in ("flash", "ring", "einsum"):
+        raise ValueError(f"unknown attention mode {attention!r}")
     key = jax.random.key(seed)
     params = init_params(key, cfg)
     momentum = jax.tree.map(jnp.zeros_like, params)
@@ -199,7 +239,7 @@ def build_workload(
         jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
         dtype=jnp.int32)
 
-    step = partial(sgd_step, cfg=cfg, flash=flash,
+    step = partial(sgd_step, cfg=cfg, attention=attention,
                    interpret=platform != "tpu")
     pspecs = param_specs(cfg)
     param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
