@@ -83,6 +83,17 @@ def param_specs(cfg: ModelConfig) -> Params:
     }
 
 
+def _constrain(x: jax.Array, spec: P, mesh: Optional[Mesh]) -> jax.Array:
+    """Sharding constraint against an explicit mesh; no-op without one.
+
+    Explicit NamedShardings keep the whole program jittable without an
+    ambient `jax.set_mesh` context (which is illegal inside a jit trace).
+    """
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def _fold_heads(t: jax.Array):
     bl, sl, hl, dl = t.shape
     return t.transpose(0, 2, 1, 3).reshape(bl * hl, sl, dl)
@@ -94,7 +105,8 @@ def _unfold_heads(t: jax.Array, bl: int, hl: int):
 
 
 def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
-               attention: str = "einsum", interpret: bool = True) -> jax.Array:
+               attention: str = "einsum", interpret: bool = True,
+               mesh: Optional[Mesh] = None) -> jax.Array:
     b, s, d = x.shape
     h, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
     q = (x @ layer["wq"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
@@ -113,6 +125,7 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
 
         out4 = jax.shard_map(
             local_ring,
+            mesh=mesh,
             in_specs=(P("dp", "sp", "tp", None),) * 3,
             out_specs=P("dp", "sp", "tp", None),
             check_vma=False,
@@ -133,6 +146,7 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
 
         out4 = jax.shard_map(
             local_attn,
+            mesh=mesh,
             in_specs=(P("dp", None, "tp", None),) * 3,
             out_specs=P("dp", None, "tp", None),
             # pallas_call's out_shape carries no varying-mesh-axes metadata
@@ -143,9 +157,9 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
         # Sequence parallelism: queries stay sequence-sharded; keys/values
         # are gathered across the sp axis (XLA emits the all-gather) so
         # every query block attends over the full context.
-        q = jax.lax.with_sharding_constraint(q, P("dp", "sp", "tp", None))
-        k = jax.lax.with_sharding_constraint(k, P("dp", None, "tp", None))
-        v = jax.lax.with_sharding_constraint(v, P("dp", None, "tp", None))
+        q = _constrain(q, P("dp", "sp", "tp", None), mesh)
+        k = _constrain(k, P("dp", None, "tp", None), mesh)
+        v = _constrain(v, P("dp", None, "tp", None), mesh)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (dh ** -0.5)
         mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
         scores = jnp.where(mask[None, None, :, :], scores, -1e9)
@@ -165,20 +179,22 @@ def _rms_norm(x: jax.Array) -> jax.Array:
 
 
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            attention: str = "einsum", interpret: bool = True) -> jax.Array:
+            attention: str = "einsum", interpret: bool = True,
+            mesh: Optional[Mesh] = None) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
-    x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
+    x = _constrain(x, P("dp", "sp", None), mesh)
     for layer in params["layers"]:
-        x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret)
+        x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret, mesh)
         x = x + _mlp(_rms_norm(x), layer)
-        x = jax.lax.with_sharding_constraint(x, P("dp", "sp", None))
+        x = _constrain(x, P("dp", "sp", None), mesh)
     logits = _rms_norm(x) @ params["unembed"].astype(jnp.bfloat16)
     return logits.astype(jnp.float32)
 
 
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
-            attention: str = "einsum", interpret: bool = True) -> jax.Array:
-    logits = forward(params, tokens, cfg, attention, interpret)
+            attention: str = "einsum", interpret: bool = True,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    logits = forward(params, tokens, cfg, attention, interpret, mesh)
     targets = tokens[:, 1:]
     logprobs = jax.nn.log_softmax(logits[:, :-1])
     nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
@@ -187,10 +203,11 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
 
 def sgd_step(params: Params, momentum: Params, tokens: jax.Array,
              cfg: ModelConfig, attention: str = "einsum",
-             interpret: bool = True) -> Tuple[Params, Params, jax.Array]:
+             interpret: bool = True,
+             mesh: Optional[Mesh] = None) -> Tuple[Params, Params, jax.Array]:
     """One full training step: loss, grads (psum over dp/sp implicit), SGD-M."""
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attention,
-                                              interpret)
+                                              interpret, mesh)
     new_momentum = jax.tree.map(
         lambda m, g: cfg.momentum * m + g, momentum, grads)
     new_params = jax.tree.map(
@@ -240,7 +257,7 @@ def build_workload(
         dtype=jnp.int32)
 
     step = partial(sgd_step, cfg=cfg, attention=attention,
-                   interpret=platform != "tpu")
+                   interpret=platform != "tpu", mesh=mesh)
     pspecs = param_specs(cfg)
     param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
                             is_leaf=lambda x: isinstance(x, P))
@@ -254,11 +271,4 @@ def build_workload(
         out_shardings=(param_sh, param_sh, NamedSharding(mesh, P())),
         donate_argnums=(0, 1),
     )
-
-    def run(p, m, t):
-        # bare PartitionSpecs in with_sharding_constraint resolve against the
-        # ambient mesh; keep it set for tracing and execution alike
-        with jax.set_mesh(mesh):
-            return jitted(p, m, t)
-
-    return run, params, momentum, tokens
+    return jitted, params, momentum, tokens
