@@ -5,12 +5,12 @@ materializes the full K/V on every chip: O(S) memory per chip. Ring attention
 keeps K/V sharded — each of the `sp` shards holds S/sp keys/values — and
 rotates the KV block around the mesh axis with `jax.lax.ppermute` while
 accumulating attention with the same online-softmax recurrence the Pallas
-flash kernel uses. Forward-pass K/V residency is O(S/sp) per chip and every
-hop is a nearest-neighbor ICI transfer, which is exactly what the torus is
-for. (Under plain autodiff the backward pass still saves the rotated blocks
-and per-step score tiles — a rematerializing custom_vjp like the flash
-kernel's would extend the bound to training; the burn-in's sequences are
-short enough that exact autodiff is the simpler, safer choice here.)
+flash kernel uses. Per-chip residency is O(S/sp) in BOTH directions: the
+custom VJP saves only (q, k, v, o, logsumexp) and the backward pass
+re-rotates K/V around the ring, recomputing each score tile and rotating the
+dK/dV accumulators along with their blocks so every gradient arrives back at
+its origin shard after a full cycle. Every hop is a nearest-neighbor ICI
+transfer, which is exactly what the torus is for.
 
 Causality at block granularity: shard i's queries attend fully to KV blocks
 j < i, causally to block j == i, and not at all to j > i. The rotation
@@ -23,19 +23,25 @@ unroll (mesh size is static), XLA-friendly.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
 
 
-def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   sm_scale: float, axis_name: str = "sp") -> jax.Array:
-    """Causal attention with KV rotating around `axis_name`.
+def _rotate(t: jax.Array, axis_name: str, n: int) -> jax.Array:
+    return jax.lax.ppermute(t, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
-    Local shapes: q, k, v are (heads_batch, seq_local, head_dim); the global
-    sequence is the concatenation of shards along `axis_name` in axis order.
-    """
+
+def _block_mask(src, my_idx, tril):
+    """Allowed positions for the KV block that originated at shard `src`."""
+    return (src < my_idx) | ((src == my_idx) & tril)
+
+
+def _ring_forward(q, k, v, sm_scale: float, axis_name: str):
+    """Online-softmax ring pass; returns (output, logsumexp)."""
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     bh, s_local, d = q.shape
@@ -51,8 +57,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # the KV block now held locally originated at shard (my_idx - step)
         src = (my_idx - step) % n
         s = jnp.einsum("bqd,bkd->bqk", qf, k_cur.astype(jnp.float32)) * sm_scale
-        allow = (src < my_idx) | ((src == my_idx) & tril)
-        s = jnp.where(allow, s, NEG_INF)
+        s = jnp.where(_block_mask(src, my_idx, tril), s, NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new)
@@ -62,7 +67,66 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             "bqk,bkd->bqd", p, v_cur.astype(jnp.float32))
         m = m_new
         if step != n - 1:
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-    return (acc / l).astype(q.dtype)
+            k_cur = _rotate(k_cur, axis_name, n)
+            v_cur = _rotate(v_cur, axis_name, n)
+    lse = m + jnp.log(l)
+    return (acc / l).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   sm_scale: float, axis_name: str = "sp") -> jax.Array:
+    """Causal attention with KV rotating around `axis_name`.
+
+    Local shapes: q, k, v are (heads_batch, seq_local, head_dim); the global
+    sequence is the concatenation of shards along `axis_name` in axis order.
+    """
+    out, _ = _ring_forward(q, k, v, sm_scale, axis_name)
+    return out
+
+
+def _ring_fwd(q, k, v, sm_scale, axis_name):
+    out, lse = _ring_forward(q, k, v, sm_scale, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(sm_scale, axis_name, residuals, d_out):
+    """Rematerialized backward: re-rotate KV, recompute each tile's
+    probabilities from the saved logsumexp, and carry dK/dV accumulators
+    around the ring with their blocks (n rotations = home again)."""
+    q, k, v, out, lse = residuals
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    bh, s_local, d = q.shape
+    qf = q.astype(jnp.float32)
+    dof = d_out.astype(jnp.float32)
+    tril = jnp.tril(jnp.ones((s_local, s_local), jnp.bool_))[None]
+    # D_i = sum_j dO_ij * O_ij (the softmax-jacobian diagonal term)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    dq = jnp.zeros((bh, s_local, d), jnp.float32)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros((bh, s_local, d), jnp.float32)
+    dv_cur = jnp.zeros((bh, s_local, d), jnp.float32)
+    for step in range(n):
+        src = (my_idx - step) % n
+        kf = k_cur.astype(jnp.float32)
+        vf = v_cur.astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
+        s = jnp.where(_block_mask(src, my_idx, tril), s, NEG_INF)
+        p = jnp.exp(s - lse)                       # masked entries -> 0
+        dv_cur = dv_cur + jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+        ds = p * (dp - delta) * sm_scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk_cur = dk_cur + jnp.einsum("bqk,bqd->bkd", ds, qf)
+        # rotate the block AND its gradient accumulators; after the n-th
+        # rotation each accumulator is back at its block's origin shard
+        k_cur = _rotate(k_cur, axis_name, n)
+        v_cur = _rotate(v_cur, axis_name, n)
+        dk_cur = _rotate(dk_cur, axis_name, n)
+        dv_cur = _rotate(dv_cur, axis_name, n)
+    return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)

@@ -9,9 +9,10 @@ attention, MLP, cross-entropy, SGD-with-momentum — written TPU-first:
   inserts the collectives (gradient psum over dp/sp, activation all-gathers
   for tp);
 - long context gets three attention strategies: `ring` (sequence-parallel
-  ring attention, K/V rotate over ICI via ppermute — O(S/sp) forward
-  residency), `flash` (Pallas blockwise kernel when the full sequence is
-  local), and `einsum` (KV all-gather reference path);
+  ring attention, K/V rotate over ICI via ppermute — O(S/sp) residency in
+  forward AND backward via a rematerializing custom VJP), `flash` (Pallas
+  blockwise kernel when the full sequence is local), and `einsum` (KV
+  all-gather reference path);
 - control flow is static: one traced step, no data-dependent Python.
 
 Used by the guest validator to burn in a passed-through slice, and by
