@@ -121,9 +121,12 @@ def _ring_bwd(sm_scale, axis_name, residuals, d_out):
         dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kf)
         dk_cur = dk_cur + jnp.einsum("bqk,bqd->bkd", ds, qf)
         # rotate the block AND its gradient accumulators; after the n-th
-        # rotation each accumulator is back at its block's origin shard
-        k_cur = _rotate(k_cur, axis_name, n)
-        v_cur = _rotate(v_cur, axis_name, n)
+        # rotation each accumulator is back at its block's origin shard.
+        # K/V themselves are dead after the last tile — only the
+        # accumulators need the final homing hop.
+        if step != n - 1:
+            k_cur = _rotate(k_cur, axis_name, n)
+            v_cur = _rotate(v_cur, axis_name, n)
         dk_cur = _rotate(dk_cur, axis_name, n)
         dv_cur = _rotate(dv_cur, axis_name, n)
     return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
