@@ -1,0 +1,169 @@
+"""CDI support: spec generation and CDIDevice names in Allocate."""
+
+import json
+import os
+from dataclasses import replace
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
+from tpu_device_plugin import cdi
+from tpu_device_plugin.allocate import allocate_response
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.lifecycle import PluginManager
+
+
+@pytest.fixture
+def host2(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", accel_index=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12"))
+    return host
+
+
+def test_spec_contents(host2, tmp_path):
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    registry, _ = discover_passthrough(cfg)
+    devs = registry.devices_by_model["0062"]
+    path = cdi.write_spec(cfg, cdi.device_entries(cfg, devs), "v4")
+    assert path and os.path.exists(path)
+    spec = json.loads(open(path).read())
+    assert spec["cdiVersion"] == "0.6.0"
+    assert spec["kind"] == "cloud-tpus.google.com/tpu"
+    assert spec["containerEdits"]["deviceNodes"][0]["path"] == "/dev/vfio/vfio"
+    by_name = {d["name"]: d for d in spec["devices"]}
+    nodes4 = by_name["0000:00:04.0"]["containerEdits"]["deviceNodes"]
+    assert {n["path"] for n in nodes4} == {"/dev/vfio/11", "/dev/accel0"}
+    nodes5 = by_name["0000:00:05.0"]["containerEdits"]["deviceNodes"]
+    assert {n["path"] for n in nodes5} == {"/dev/vfio/12"}
+
+
+def test_write_spec_disabled_returns_none(host2):
+    cfg = Config().with_root(host2.root)
+    registry, _ = discover_passthrough(cfg)
+    assert cdi.write_spec(
+        cfg, cdi.device_entries(cfg, registry.devices_by_model["0062"]),
+        "v4") is None
+
+
+def test_allocate_includes_cdi_names_when_enabled(host2, tmp_path):
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    registry, _ = discover_passthrough(cfg)
+    req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])])
+    resp = allocate_response(cfg, registry, "v4", req)
+    cresp = resp.container_responses[0]
+    assert [c.name for c in cresp.cdi_devices] == \
+        ["cloud-tpus.google.com/tpu=0000:00:04.0"]
+    # classic specs + env stay for non-CDI kubelets
+    assert cresp.devices and cresp.envs
+
+
+def test_allocate_no_cdi_by_default(host2):
+    cfg = Config().with_root(host2.root)
+    registry, _ = discover_passthrough(cfg)
+    req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])])
+    resp = allocate_response(cfg, registry, "v4", req)
+    assert len(resp.container_responses[0].cdi_devices) == 0
+
+
+def test_manager_writes_specs_at_startup(short_root, tmp_path):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = replace(Config().with_root(host.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kubelet.wait_for(1)
+        files = os.listdir(cfg.cdi_spec_dir)
+        assert files == ["cloud-tpus.google.com-v4.json"]
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_cdi_names_suppressed_when_spec_write_fails(short_root, tmp_path):
+    """Unwritable spec dir: plugin serves classic DeviceSpecs, no CDI names."""
+    import grpc
+    from tpu_device_plugin import kubeletapi as api
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    blocked = tmp_path / "blocked"
+    blocked.write_text("")  # a FILE, so makedirs/mkstemp under it fails
+    cfg = replace(Config().with_root(host.root),
+                  cdi_spec_dir=str(blocked / "cdi"))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kubelet.wait_for(1)
+        sock = os.path.join(cfg.device_plugin_path, "tpukubevirt-v4.sock")
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])]),
+                timeout=5)
+            cresp = resp.container_responses[0]
+            assert len(cresp.cdi_devices) == 0   # no unresolvable names
+            assert cresp.devices                 # classic path intact
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_vtpu_partitions_get_cdi_names(short_root, tmp_path):
+    import grpc
+    import json as json_mod
+    from tpu_device_plugin import kubeletapi as api
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    cfg = replace(Config().with_root(host.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kubelet.wait_for(2)
+        files = sorted(os.listdir(cfg.cdi_spec_dir))
+        assert files == ["cloud-tpus.google.com-TPU_vhalf.json",
+                         "cloud-tpus.google.com-v4.json"]
+        sock = os.path.join(cfg.device_plugin_path,
+                            "tpukubevirt-vtpu-TPU_vhalf.sock")
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["uuid-1"])]),
+                timeout=5)
+            names = [c.name for c in resp.container_responses[0].cdi_devices]
+            assert names == ["cloud-tpus.google.com/tpu=uuid-1"]
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_prune_stale_specs(host2, tmp_path):
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    registry, _ = discover_passthrough(cfg)
+    devs = registry.devices_by_model["0062"]
+    kept = cdi.write_spec(cfg, cdi.device_entries(cfg, devs), "v4")
+    stale = cdi.write_spec(cfg, [], "v99")
+    foreign = os.path.join(cfg.cdi_spec_dir, "other-vendor.json")
+    with open(foreign, "w") as f:
+        f.write("{}")
+    cdi.prune_specs(cfg, [kept])
+    left = sorted(os.listdir(cfg.cdi_spec_dir))
+    assert os.path.basename(kept) in left
+    assert os.path.basename(stale) not in left
+    assert "other-vendor.json" in left  # never touches foreign specs

@@ -176,8 +176,9 @@ def plan_allocation(
 
     env_key = f"{cfg.env_prefix}_{sanitize_name(resource_suffix)}"
     envs = {env_key: ",".join(expanded)}
-    log.info("allocate %s: groups=%s devices=%s iommufd=%s",
-             resource_suffix, seen_groups, expanded, iommufd)
+    log.info("allocate %s: groups=%s devices=%s iommufd=%s cdi=%s",
+             resource_suffix, seen_groups, expanded, iommufd,
+             bool(cfg.cdi_spec_dir))
     return AllocationPlan(device_specs=specs, envs=envs, expanded_bdfs=expanded)
 
 
@@ -186,13 +187,27 @@ def allocate_response(
     registry: Registry,
     resource_suffix: str,
     request: pb.AllocateRequest,
+    cdi_enabled: Optional[bool] = None,
 ) -> pb.AllocateResponse:
-    """Full Allocate handler body: one ContainerAllocateResponse per request."""
+    """Full Allocate handler body: one ContainerAllocateResponse per request.
+
+    `cdi_enabled=None` falls back to `bool(cfg.cdi_spec_dir)`; the plugin
+    server passes an explicit value reflecting whether this resource's CDI
+    spec file was actually written (unresolvable names are worse than none).
+    """
+    if cdi_enabled is None:
+        cdi_enabled = bool(cfg.cdi_spec_dir)
     shared = discover_shared_devices(cfg)
     resp = pb.AllocateResponse()
     for creq in request.container_requests:
         plan = plan_allocation(cfg, registry, resource_suffix,
                                list(creq.devices_ids), shared)
-        resp.container_responses.append(pb.ContainerAllocateResponse(
-            envs=plan.envs, devices=plan.device_specs))
+        cresp = pb.ContainerAllocateResponse(
+            envs=plan.envs, devices=plan.device_specs)
+        if cdi_enabled:
+            from .cdi import cdi_device_name
+            cresp.cdi_devices.extend(
+                pb.CDIDevice(name=cdi_device_name(cfg, bdf))
+                for bdf in plan.expanded_bdfs)
+        resp.container_responses.append(cresp)
     return resp

@@ -1,0 +1,134 @@
+"""CDI (Container Device Interface) support.
+
+The v1beta1 AllocateResponse can name CDI devices instead of raw DeviceSpecs
+(api.proto `cdi_devices`); kubelets with the CDI feature resolve those names
+against spec files in /var/run/cdi or /etc/cdi. When `Config.cdi_spec_dir`
+is set, the plugin:
+
+1. writes one spec file per resource at startup
+   (`<dir>/cloud-tpus.google.com-<suffix>.json`, CDI v0.6.0 schema) mapping
+   each chip/partition to its device nodes, pruning files from resources
+   that no longer exist, and
+2. returns `CDIDevice` names (`cloud-tpus.google.com/tpu=<id>`) from
+   Allocate alongside the classic DeviceSpecs + env var — older kubelets
+   ignore the CDI names, CDI-aware ones get first-class device injection.
+   Names are only emitted for resources whose spec file was actually
+   written; a failed write degrades that resource to the classic path
+   rather than handing out unresolvable names.
+
+The reference plugin predates CDI; this is a forward-compatibility addition,
+kept strictly additive.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from .config import Config
+from .registry import TpuDevice, TpuPartition
+
+log = logging.getLogger(__name__)
+
+CDI_VERSION = "0.6.0"
+CDI_KIND_DEVICE = "tpu"
+
+
+def cdi_kind(cfg: Config) -> str:
+    return f"{cfg.resource_namespace}/{CDI_KIND_DEVICE}"
+
+
+def cdi_device_name(cfg: Config, device_id: str) -> str:
+    """Fully-qualified CDI name the kubelet resolves: <kind>=<id>."""
+    return f"{cdi_kind(cfg)}={device_id}"
+
+
+def device_entries(cfg: Config, devices: Sequence[TpuDevice]) -> List[dict]:
+    """Spec entries for passthrough chips: VFIO group (+ accel) nodes."""
+    entries = []
+    for dev in devices:
+        nodes = [{"path": f"/dev/vfio/{dev.iommu_group}",
+                  "hostPath": cfg.dev_path("dev/vfio", dev.iommu_group)}]
+        if dev.accel_index is not None:
+            nodes.append({"path": f"/dev/accel{dev.accel_index}",
+                          "hostPath": cfg.dev_path("dev", f"accel{dev.accel_index}")})
+        entries.append({"name": dev.bdf, "containerEdits": {"deviceNodes": nodes}})
+    return entries
+
+
+def partition_entries(cfg: Config, partitions: Sequence[TpuPartition]) -> List[dict]:
+    """Spec entries for vTPU partitions: the partition's accel node (logical)
+    — mdev partitions resolve their VFIO group at allocate time, so their
+    entry carries only what is statically known."""
+    entries = []
+    for p in partitions:
+        nodes = []
+        if p.accel_index is not None:
+            nodes.append({"path": f"/dev/accel{p.accel_index}",
+                          "hostPath": cfg.dev_path("dev", f"accel{p.accel_index}")})
+        entries.append({"name": p.uuid, "containerEdits": {"deviceNodes": nodes}})
+    return entries
+
+
+def _spec_path(cfg: Config, suffix: str) -> str:
+    return os.path.join(
+        cfg.cdi_spec_dir,
+        f"{cfg.resource_namespace.replace('/', '_')}-{suffix}.json")
+
+
+def write_spec(cfg: Config, entries: Sequence[dict], suffix: str) -> Optional[str]:
+    """Atomically write one resource's spec file; None on failure/disabled."""
+    if not cfg.cdi_spec_dir:
+        return None
+    spec = {
+        "cdiVersion": CDI_VERSION,
+        "kind": cdi_kind(cfg),
+        "containerEdits": {
+            "deviceNodes": [{"path": "/dev/vfio/vfio",
+                             "hostPath": cfg.dev_path("dev/vfio/vfio")}],
+        },
+        "devices": list(entries),
+    }
+    path = _spec_path(cfg, suffix)
+    try:
+        os.makedirs(cfg.cdi_spec_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=cfg.cdi_spec_dir, suffix=".tmp")
+    except OSError as exc:
+        log.error("could not write CDI spec %s: %s", path, exc)
+        return None
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(spec, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as exc:
+        log.error("could not write CDI spec %s: %s", path, exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    log.info("wrote CDI spec %s (%d devices)", path, len(spec["devices"]))
+    return path
+
+
+def prune_specs(cfg: Config, keep_paths: Sequence[str]) -> None:
+    """Remove this plugin's spec files not in `keep_paths` (resources that
+    disappeared across a rediscovery must not keep advertising dead nodes)."""
+    if not cfg.cdi_spec_dir:
+        return
+    prefix = f"{cfg.resource_namespace.replace('/', '_')}-"
+    keep = {os.path.basename(p) for p in keep_paths}
+    try:
+        entries = os.listdir(cfg.cdi_spec_dir)
+    except OSError:
+        return
+    for name in entries:
+        if name.startswith(prefix) and name.endswith(".json") and name not in keep:
+            try:
+                os.unlink(os.path.join(cfg.cdi_spec_dir, name))
+                log.info("pruned stale CDI spec %s", name)
+            except OSError as exc:
+                log.warning("could not prune CDI spec %s: %s", name, exc)

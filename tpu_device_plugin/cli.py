@@ -42,6 +42,9 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="JSON declaring logical vTPU partitions")
     parser.add_argument("--native-lib", default=None,
                         help="path to libtpuhealth.so")
+    parser.add_argument("--cdi-spec-dir", default=None,
+                        help="write CDI specs here (e.g. /var/run/cdi) and "
+                             "return CDIDevice names from Allocate")
     parser.add_argument("--health-poll-seconds", type=float,
                         default=cfg.health_poll_s)
     parser.add_argument("--rediscovery-seconds", type=float,
@@ -74,6 +77,7 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         topology_hints_path=args.topology_file,
         partition_config_path=args.partition_config,
         native_lib_path=args.native_lib,
+        cdi_spec_dir=args.cdi_spec_dir,
         health_poll_s=args.health_poll_seconds,
         rediscovery_interval_s=args.rediscovery_seconds,
     )

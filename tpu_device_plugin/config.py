@@ -74,6 +74,11 @@ class Config:
     # --- native shim --------------------------------------------------------
     native_lib_path: Optional[str] = None  # override libtpuhealth.so location
 
+    # --- CDI ----------------------------------------------------------------
+    # When set, write CDI specs here (e.g. /var/run/cdi) and return CDIDevice
+    # names from Allocate alongside the classic DeviceSpecs.
+    cdi_spec_dir: Optional[str] = None
+
     def dev_path(self, *parts: str) -> str:
         """Join an absolute devfs/sysfs path under root_path."""
         return os.path.join(self.root_path, *[p.lstrip("/") for p in parts])

@@ -40,21 +40,42 @@ class PluginManager:
         registry, generations = inventory if inventory else discover(self.cfg)
         self.registry = registry
         plugins: List[TpuDevicePlugin] = []
+        cdi_paths: List[str] = []
         for model, devs in sorted(registry.devices_by_model.items()):
             suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
             info = generations.get(model)
+            cdi_enabled = False
+            if self.cfg.cdi_spec_dir:
+                from . import cdi
+                path = cdi.write_spec(
+                    self.cfg, cdi.device_entries(self.cfg, devs), suffix)
+                cdi_enabled = path is not None
+                if path:
+                    cdi_paths.append(path)
             plugins.append(TpuDevicePlugin(
                 self.cfg, suffix, registry, devs,
                 torus_dims=info.host_topology if info else None,
-                health_shim=self._shim,
+                health_shim=self._shim, cdi_enabled=cdi_enabled,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
                      info.host_topology if info else None)
         for type_name, parts in sorted(registry.partitions_by_type.items()):
+            cdi_enabled = False
+            if self.cfg.cdi_spec_dir:
+                from . import cdi
+                path = cdi.write_spec(
+                    self.cfg, cdi.partition_entries(self.cfg, parts), type_name)
+                cdi_enabled = path is not None
+                if path:
+                    cdi_paths.append(path)
             plugins.append(VtpuDevicePlugin(
-                self.cfg, type_name, registry, parts, health_shim=self._shim))
+                self.cfg, type_name, registry, parts, health_shim=self._shim,
+                cdi_enabled=cdi_enabled))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
+        if self.cfg.cdi_spec_dir:
+            from . import cdi
+            cdi.prune_specs(self.cfg, cdi_paths)
         return plugins
 
     def start(self, inventory=None) -> None:

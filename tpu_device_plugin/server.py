@@ -48,8 +48,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         devices: Sequence[TpuDevice],
         torus_dims: Optional[Tuple[int, ...]] = None,
         health_shim: Optional[TpuHealth] = None,
+        cdi_enabled: bool = False,
     ) -> None:
         self.cfg = cfg
+        # CDI names are only valid when this resource's spec file was written
+        self.cdi_enabled = cdi_enabled
         self.resource_suffix = resource_suffix
         self.resource_name = f"{cfg.resource_namespace}/{resource_suffix}"
         self.registry = registry
@@ -325,7 +328,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                  [list(c.devices_ids) for c in request.container_requests])
         try:
             return allocate_mod.allocate_response(
-                self.cfg, self.registry, self.resource_suffix, request)
+                self.cfg, self.registry, self.resource_suffix, request,
+                cdi_enabled=self.cdi_enabled)
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))

@@ -44,9 +44,11 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         registry: Registry,
         partitions: Sequence[TpuPartition],
         health_shim=None,
+        cdi_enabled: bool = False,
     ) -> None:
         self.partitions = list(partitions)
-        super().__init__(cfg, type_name, registry, devices=[], health_shim=health_shim)
+        super().__init__(cfg, type_name, registry, devices=[],
+                         health_shim=health_shim, cdi_enabled=cdi_enabled)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
@@ -140,8 +142,14 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                         add(self.cfg.dev_path("dev", f"accel{p.accel_index}"),
                             f"/dev/accel{p.accel_index}", "rw")
                 env_key = f"{self.cfg.vtpu_env_prefix}_{sanitize_name(self.resource_suffix)}"
-                resp.container_responses.append(pb.ContainerAllocateResponse(
-                    envs={env_key: ",".join(uuids)}, devices=specs))
+                cresp = pb.ContainerAllocateResponse(
+                    envs={env_key: ",".join(uuids)}, devices=specs)
+                if self.cdi_enabled:
+                    from .cdi import cdi_device_name
+                    cresp.cdi_devices.extend(
+                        pb.CDIDevice(name=cdi_device_name(self.cfg, uuid))
+                        for uuid in uuids)
+                resp.container_responses.append(cresp)
         except AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
