@@ -155,3 +155,33 @@ def test_allocate_response_multi_container(host4):
     assert len(resp.container_responses) == 2
     assert resp.container_responses[1].envs[
         "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4"] == "0000:00:06.0,0000:00:07.0"
+
+
+def test_allocate_scoped_to_plugin_devices(host4):
+    """A plugin must reject BDFs of another model (beats the reference's
+    global-map lookup, generic_device_plugin.go:376-380)."""
+    cfg, registry = setup(host4)
+    # pretend this plugin only manages group 12's chips (the "v5e" set)
+    with pytest.raises(allocate.AllocationError, match="not managed by resource"):
+        allocate.plan_allocation(
+            cfg, registry, "v5e", ["0000:00:04.0"],
+            allowed_bdfs=frozenset({"0000:00:06.0", "0000:00:07.0"}))
+
+
+def test_allocate_scope_allows_own_devices(host4):
+    cfg, registry = setup(host4)
+    plan = allocate.plan_allocation(
+        cfg, registry, "v4", ["0000:00:04.0"],
+        allowed_bdfs=frozenset({"0000:00:04.0", "0000:00:05.0"}))
+    assert plan.expanded_bdfs == ["0000:00:04.0", "0000:00:05.0"]
+
+
+def test_iommufd_missing_cdev_fails_fast(tmp_path):
+    """iommufd host + unreadable vfio-dev entry: fail the whole Allocate
+    rather than boot the VM with an incomplete device set."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))  # no vfio_dev
+    host.enable_iommufd()
+    cfg, registry = setup(host)
+    with pytest.raises(allocate.AllocationError, match="no vfio-dev cdev"):
+        allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])

@@ -120,9 +120,11 @@ def test_cdi_names_suppressed_when_spec_write_fails(short_root, tmp_path):
         kubelet.stop()
 
 
-def test_vtpu_partitions_get_cdi_names(short_root, tmp_path):
+def test_mdev_partitions_get_no_cdi_names(short_root, tmp_path):
+    """An mdev's VFIO group is allocate-time knowledge (destroy/recreate under
+    the same UUID moves it); freezing it into a CDI spec at startup would hand
+    the kubelet a stale node. mdevs ride the classic DeviceSpec path only."""
     import grpc
-    import json as json_mod
     from tpu_device_plugin import kubeletapi as api
     host = FakeHost(short_root)
     host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
@@ -136,8 +138,13 @@ def test_vtpu_partitions_get_cdi_names(short_root, tmp_path):
     try:
         assert kubelet.wait_for(2)
         files = sorted(os.listdir(cfg.cdi_spec_dir))
-        assert files == ["cloud-tpus.google.com-TPU_vhalf.json",
-                         "cloud-tpus.google.com-v4.json"]
+        # vtpu spec files are namespaced like the vtpu socket, so a partition
+        # type named after a generation can never clobber the passthrough spec
+        assert files == ["cloud-tpus.google.com-v4.json",
+                         "cloud-tpus.google.com-vtpu-TPU_vhalf.json"]
+        spec = json.loads(open(os.path.join(
+            cfg.cdi_spec_dir, "cloud-tpus.google.com-vtpu-TPU_vhalf.json")).read())
+        assert spec["devices"] == []  # no frozen mdev group nodes
         sock = os.path.join(cfg.device_plugin_path,
                             "tpukubevirt-vtpu-TPU_vhalf.sock")
         with grpc.insecure_channel(f"unix://{sock}") as ch:
@@ -145,8 +152,44 @@ def test_vtpu_partitions_get_cdi_names(short_root, tmp_path):
                 pb.AllocateRequest(container_requests=[
                     pb.ContainerAllocateRequest(devices_ids=["uuid-1"])]),
                 timeout=5)
+            cresp = resp.container_responses[0]
+            assert [c.name for c in cresp.cdi_devices] == []
+            # classic path carries the injection, resolved live
+            assert [d.container_path for d in cresp.devices] == \
+                ["/dev/vfio/vfio", "/dev/vfio/21"]
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_accel_partitions_get_cdi_names(short_root, tmp_path):
+    """Logical partitions with a static accel node DO get CDI entries+names."""
+    import grpc
+    from tpu_device_plugin import kubeletapi as api
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    cfg = replace(Config().with_root(host.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"),
+                  partition_config_path=str(pc))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kubelet.wait_for(1)
+        sock = os.path.join(cfg.device_plugin_path,
+                            "tpukubevirt-vtpu-v4-core.sock")
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devices_ids=["0000:00:04.0-core0"])]),
+                timeout=5)
             names = [c.name for c in resp.container_responses[0].cdi_devices]
-            assert names == ["cloud-tpus.google.com/tpu=uuid-1"]
+            assert names == ["cloud-tpus.google.com/tpu=0000:00:04.0-core0"]
     finally:
         manager.stop()
         kubelet.stop()

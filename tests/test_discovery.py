@@ -157,3 +157,24 @@ def test_non_dict_config_files_tolerated(tmp_path):
     registry, generations = discovery.discover(cfg)
     assert len(registry.all_devices()) == 1   # discovery survives bad configs
     assert generations["0062"].name == "v4"   # built-ins retained
+
+
+def test_logical_partition_parent_excluded_from_passthrough(tmp_path):
+    """A vfio-bound chip backing logical partitions must not also be
+    advertised as a passthrough resource — the kubelet would otherwise grant
+    the same VFIO group to two VMIs."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12"))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "vslice", "parent_bdf": "0000:00:04.0"}]}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    # chip 04 is consumed by the vTPU resource; only chip 05 stays passthrough
+    assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:05.0"]
+    # lookup maps stay intact: the vTPU plugin resolves the parent through them
+    assert registry.bdf_to_group["0000:00:04.0"] == "11"
+    assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]

@@ -77,7 +77,7 @@ def test_monitor_probe_drives_health(tmp_path):
         group_bdfs={"g": ["bdf0"]},
         on_device_health=lambda g, ok, src: hits.append((g, ok, src)),
         on_socket_removed=lambda: None,
-        probe=lambda bdf: verdict["ok"],
+        probe=lambda bdf, node: verdict["ok"],
         poll_interval_s=0.1,
     )
     mon.start()
@@ -251,3 +251,40 @@ def test_foreign_so_falls_back(tmp_path):
     cfgf = tmp_path / "config"
     cfgf.write_bytes(bytes([0xE0, 0x1A]))
     assert t.probe_config(str(cfgf)) == OK
+
+
+def test_chip_alive_ands_node_probe(shim, tmp_path):
+    """Native verdict must also cover the chip's device node, so a vanished
+    node flips health even when the inotify watcher is degraded."""
+    pci = tmp_path / "devices"
+    bdf_dir = pci / "0000:00:04.0"
+    bdf_dir.mkdir(parents=True)
+    (bdf_dir / "config").write_bytes(bytes([0xE0, 0x1A]))
+    node = tmp_path / "vfio11"
+    assert shim.chip_alive(str(pci), "0000:00:04.0", str(node)) is False
+    node.write_text("")
+    assert shim.chip_alive(str(pci), "0000:00:04.0", str(node)) is True
+
+
+def test_monitor_probe_receives_group_node_path(tmp_path):
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    sock = sock_dir / "p.sock"
+    sock.write_text("")
+    node = tmp_path / "vfio11"
+    node.write_text("")
+    seen = []
+    mon = HealthMonitor(
+        socket_path=str(sock),
+        group_paths={"g": str(node)},
+        group_bdfs={"g": ["bdf0"]},
+        on_device_health=lambda g, ok, src: None,
+        on_socket_removed=lambda: None,
+        probe=lambda bdf, n: seen.append((bdf, n)) or True,
+        poll_interval_s=0.1,
+    )
+    mon.start()
+    try:
+        assert _wait(lambda: ("bdf0", str(node)) in seen)
+    finally:
+        mon.stop_event.set()

@@ -27,7 +27,10 @@ def rig(short_root):
     host = FakeHost(short_root)
     for i, (g, n) in enumerate([("11", 0), ("11", 0), ("12", 1), ("12", 1)]):
         host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", iommu_group=g, numa_node=n))
-    cfg = Config().with_root(host.root)
+    # short probe cadence: the native probe now also observes group nodes, so
+    # recovery after a node reappears is bounded by health_poll_s
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root), health_poll_s=0.2)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
     kubelet = FakeKubelet(cfg.kubelet_socket)
     registry, generations = discover_passthrough(cfg)
@@ -166,3 +169,38 @@ def test_stop_removes_socket(rig):
     assert os.path.exists(plugin.socket_path)
     plugin.stop()
     assert not os.path.exists(plugin.socket_path)
+
+
+def test_allocate_rejects_other_models_bdf(short_root):
+    """The v5e plugin must refuse a v4 BDF even though both live in the same
+    registry (the reference's global map would hand it out)."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root), health_poll_s=60)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    registry, generations = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v5e", registry,
+                             registry.devices_by_model["0063"])
+    plugin.start()
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            with pytest.raises(grpc.RpcError) as exc_info:
+                stub.Allocate(
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(
+                            devices_ids=["0000:00:04.0"])]),
+                    timeout=5)
+            assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            # its own chip still allocates fine
+            resp = stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["0000:01:00.0"])]),
+                timeout=5)
+            assert resp.container_responses[0].devices
+    finally:
+        plugin.stop()
+        kubelet.stop()

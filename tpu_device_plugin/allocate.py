@@ -109,12 +109,18 @@ def plan_allocation(
     resource_suffix: str,
     requested_bdfs: Sequence[str],
     shared_devices: Optional[Sequence[SharedDevice]] = None,
+    allowed_bdfs: Optional[frozenset] = None,
 ) -> AllocationPlan:
     """Build the DeviceSpec list + env map for one container request.
 
     DeviceSpec order matches the reference's: the shared /dev/vfio/vfio
     container node first, then one /dev/vfio/<group> per IOMMU group, then
     iommufd cdevs + /dev/iommu, then qualifying shared devices.
+
+    `allowed_bdfs` scopes the request to the calling plugin's own devices:
+    the reference resolves any BDF in its global map, so its v-something
+    plugin would allocate another model's GPUs (generic_device_plugin.go:376-380)
+    — here a cross-model BDF is an AllocationError.
     """
     iommufd = supports_iommufd(cfg)
     if shared_devices is None:
@@ -134,6 +140,10 @@ def plan_allocation(
         group = registry.bdf_to_group.get(bdf)
         if group is None:
             raise AllocationError(f"requested device {bdf} is not a known TPU")
+        if allowed_bdfs is not None and bdf not in allowed_bdfs:
+            raise AllocationError(
+                f"requested device {bdf} is not managed by resource "
+                f"{resource_suffix!r}")
         if group in seen_groups:
             continue
         seen_groups.append(group)
@@ -142,12 +152,18 @@ def plan_allocation(
             expanded.append(dev.bdf)
             if iommufd:
                 node = vfio_device_node(cfg, dev.bdf)
-                if node is not None:
-                    iommufd_specs.append(pb.DeviceSpec(
-                        host_path=cfg.dev_path("dev/vfio/devices", node),
-                        container_path=f"/dev/vfio/devices/{node}",
-                        permissions="mrw",
-                    ))
+                if node is None:
+                    # On an iommufd host every vfio-bound device has a cdev;
+                    # an unreadable vfio-dev entry would boot the VM with an
+                    # incomplete device set — fail fast like the reference
+                    # (generic_device_plugin.go:702-716 errors the Allocate).
+                    raise AllocationError(
+                        f"device {dev.bdf}: iommufd host but no vfio-dev cdev")
+                iommufd_specs.append(pb.DeviceSpec(
+                    host_path=cfg.dev_path("dev/vfio/devices", node),
+                    container_path=f"/dev/vfio/devices/{node}",
+                    permissions="mrw",
+                ))
         specs.append(pb.DeviceSpec(
             host_path=cfg.dev_path("dev/vfio", group),
             container_path=f"/dev/vfio/{group}",
@@ -188,6 +204,7 @@ def allocate_response(
     resource_suffix: str,
     request: pb.AllocateRequest,
     cdi_enabled: Optional[bool] = None,
+    allowed_bdfs: Optional[frozenset] = None,
 ) -> pb.AllocateResponse:
     """Full Allocate handler body: one ContainerAllocateResponse per request.
 
@@ -201,7 +218,8 @@ def allocate_response(
     resp = pb.AllocateResponse()
     for creq in request.container_requests:
         plan = plan_allocation(cfg, registry, resource_suffix,
-                               list(creq.devices_ids), shared)
+                               list(creq.devices_ids), shared,
+                               allowed_bdfs=allowed_bdfs)
         cresp = pb.ContainerAllocateResponse(
             envs=plan.envs, devices=plan.device_specs)
         if cdi_enabled:

@@ -59,16 +59,37 @@ def device_entries(cfg: Config, devices: Sequence[TpuDevice]) -> List[dict]:
     return entries
 
 
-def partition_entries(cfg: Config, partitions: Sequence[TpuPartition]) -> List[dict]:
-    """Spec entries for vTPU partitions: the partition's accel node (logical)
-    — mdev partitions resolve their VFIO group at allocate time, so their
-    entry carries only what is statically known."""
+def partition_entries(cfg: Config, partitions: Sequence[TpuPartition],
+                      bdf_to_group: Optional[Dict[str, str]] = None) -> List[dict]:
+    """Spec entries for vTPU partitions.
+
+    Every returned entry resolves to ≥1 STABLE device node: the partition's
+    accel node, or its vfio-bound parent's group (stable for the registry's
+    lifetime, like the passthrough entries). A partition whose nodes are only
+    known at allocate time gets NO entry — notably mdevs, whose iommu group
+    changes if the mdev is destroyed and recreated under the same UUID (the
+    live-resolution the plugin's Allocate already does, vtpu.py) — Allocate
+    then omits its CDI name and the classic DeviceSpec path carries the
+    injection (a stale or unresolvable CDI name is worse than none)."""
     entries = []
     for p in partitions:
         nodes = []
         if p.accel_index is not None:
             nodes.append({"path": f"/dev/accel{p.accel_index}",
                           "hostPath": cfg.dev_path("dev", f"accel{p.accel_index}")})
+        elif p.provider != "mdev" and bdf_to_group is not None:
+            group = bdf_to_group.get(p.parent_bdf)
+            # legacy VFIO group node only (iommufd-only hosts have no
+            # /dev/vfio/<group>; their cdev set is allocate-time knowledge)
+            if group is not None and os.path.exists(
+                    cfg.dev_path("dev/vfio", group)):
+                nodes.append({"path": f"/dev/vfio/{group}",
+                              "hostPath": cfg.dev_path("dev/vfio", group)})
+        if not nodes:
+            log.info("partition %s has no statically stable device node; "
+                     "omitting from CDI spec (classic DeviceSpec path covers "
+                     "it)", p.uuid)
+            continue
         entries.append({"name": p.uuid, "containerEdits": {"deviceNodes": nodes}})
     return entries
 

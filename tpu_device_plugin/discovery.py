@@ -266,6 +266,41 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
     registry, generations = discover_passthrough(cfg, accel_by_bdf)
     partitions = discover_mdev_partitions(cfg)
     partitions += discover_logical_partitions(cfg, generations, accel_by_bdf)
+    # A logical partition is only allocatable through its parent's accel node
+    # or VFIO group; one with neither would hand a VMI zero DeviceSpecs —
+    # refuse it here with a reason instead of failing at Allocate time.
+    allocatable: List[TpuPartition] = []
+    for p in partitions:
+        if (p.provider == "logical" and p.accel_index is None
+                and p.parent_bdf not in registry.bdf_to_group):
+            log.warning(
+                "partition %s (type %s): parent %s has no accel node and is "
+                "not vfio-bound; refusing to advertise an unallocatable "
+                "partition", p.uuid, p.type_name, p.parent_bdf)
+            continue
+        allocatable.append(p)
+    partitions = allocatable
+    # A vfio-bound chip that backs logical partitions is consumed by the vTPU
+    # resource: advertising it as passthrough too would let the kubelet grant
+    # the same VFIO group to two VMIs. Remove such chips from the passthrough
+    # device lists (lookup maps stay intact — the vTPU plugin resolves the
+    # parent's group through them). The reference never faces this: mdev
+    # parents are bound to the vendor driver, so the sets are disjoint there.
+    consumed = {p.parent_bdf for p in partitions
+                if p.provider == "logical" and p.accel_index is None}
+    if consumed:
+        devices_by_model = {}
+        for model, devs in registry.devices_by_model.items():
+            kept = tuple(d for d in devs if d.bdf not in consumed)
+            if kept:
+                devices_by_model[model] = kept
+        log.info("chips %s back logical partitions; excluded from passthrough",
+                 ",".join(sorted(consumed)))
+        registry = Registry(
+            devices_by_model=devices_by_model,
+            iommu_map=registry.iommu_map,
+            bdf_to_group=registry.bdf_to_group,
+        )
     by_type: Dict[str, List[TpuPartition]] = {}
     parent_map: Dict[str, List[str]] = {}
     for p in partitions:

@@ -94,18 +94,22 @@ class HealthMonitor(threading.Thread):
                                         — source "fs" (node came/went) or
                                           "probe" (native liveness verdict)
       on_socket_removed()               — kubelet restarted; plugin must restart
-      probe(bdf) -> bool                — native liveness; False marks the
-                                          chip's group Unhealthy
+      probe(bdf, node_path) -> bool     — native liveness (node_path is the
+                                          group's watched node, or None);
+                                          False marks the chip's group
+                                          Unhealthy
     """
 
     def __init__(
         self,
         socket_path: str,
-        group_paths: Dict[str, str],        # iommu group -> /dev/vfio/<group>
-        group_bdfs: Dict[str, List[str]],   # iommu group -> member BDFs
+        group_paths: Dict[str, str],        # watch key -> device node path
+                                            # (iommu group -> /dev/vfio/<grp>,
+                                            #  partition uuid -> accel/mdev)
+        group_bdfs: Dict[str, List[str]],   # watch key -> member BDFs
         on_device_health: Callable[[str, bool, str], None],
         on_socket_removed: Callable[[], None],
-        probe: Optional[Callable[[str], bool]] = None,
+        probe: Optional[Callable[[str, Optional[str]], bool]] = None,
         poll_interval_s: float = 5.0,
         stop_event: Optional[threading.Event] = None,
     ) -> None:
@@ -228,7 +232,8 @@ class HealthMonitor(threading.Thread):
 
     def _run_probes(self) -> None:
         for group, bdfs in self._group_bdfs.items():
-            healthy = all(self._probe(bdf) for bdf in bdfs)
+            node = self._group_paths.get(group)
+            healthy = all(self._probe(bdf, node) for bdf in bdfs)
             if self._probe_state.get(group) != healthy:
                 self._probe_state[group] = healthy
                 if not healthy:

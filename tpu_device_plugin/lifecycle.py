@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import List, Optional
 
 from .config import Config
@@ -35,14 +36,26 @@ class PluginManager:
         self.registry: Optional[Registry] = None
         self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
+        # Queried once at startup: whether the host can dlopen libtpu.so.
+        # Purely informational on a passthrough host (chips are vfio-bound,
+        # the guest owns libtpu), but a useful deployment sanity signal.
+        self.native_info = {
+            "native_shim": self._shim.is_native,
+            "libtpu_available": self._shim.libtpu_available(),
+        }
+        log.info("native health shim: loaded=%s libtpu_available=%s",
+                 self.native_info["native_shim"],
+                 self.native_info["libtpu_available"])
 
     def build_plugins(self, inventory=None) -> List[TpuDevicePlugin]:
         registry, generations = inventory if inventory else discover(self.cfg)
         self.registry = registry
         plugins: List[TpuDevicePlugin] = []
         cdi_paths: List[str] = []
+        passthrough_suffixes = set()
         for model, devs in sorted(registry.devices_by_model.items()):
             suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
+            passthrough_suffixes.add(suffix)
             info = generations.get(model)
             cdi_enabled = False
             if self.cfg.cdi_spec_dir:
@@ -61,17 +74,30 @@ class PluginManager:
                      suffix, len(devs), model,
                      info.host_topology if info else None)
         for type_name, parts in sorted(registry.partitions_by_type.items()):
+            if type_name in passthrough_suffixes:
+                # both plugins would register the same extended-resource name
+                # with the kubelet (sockets are namespaced but resource names
+                # are not) — a partition-config author error, not recoverable
+                log.error("vTPU type %r collides with a passthrough resource "
+                          "suffix; skipping its plugin", type_name)
+                continue
             cdi_enabled = False
+            cdi_uuids: frozenset = frozenset()
             if self.cfg.cdi_spec_dir:
                 from . import cdi
-                path = cdi.write_spec(
-                    self.cfg, cdi.partition_entries(self.cfg, parts), type_name)
+                entries = cdi.partition_entries(
+                    self.cfg, parts, registry.bdf_to_group)
+                # spec files are namespaced like the vtpu socket so a type
+                # named after a generation can never clobber the passthrough
+                # resource's spec file
+                path = cdi.write_spec(self.cfg, entries, f"vtpu-{type_name}")
                 cdi_enabled = path is not None
                 if path:
                     cdi_paths.append(path)
+                    cdi_uuids = frozenset(e["name"] for e in entries)
             plugins.append(VtpuDevicePlugin(
                 self.cfg, type_name, registry, parts, health_shim=self._shim,
-                cdi_enabled=cdi_enabled))
+                cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi
@@ -122,16 +148,29 @@ class PluginManager:
         )
 
     def run(self, stop_event: threading.Event) -> None:
-        """Start everything and block until `stop_event` (reference :166-175)."""
+        """Start everything and block until `stop_event` (reference :166-175).
+
+        Pending-plugin start retries run on their own short cadence: a plugin
+        that raced the kubelet socket at boot must not wait out a long
+        rediscovery interval before registering.
+        """
         self.running.set()
         self.start()
         interval = self.cfg.rediscovery_interval_s
+        next_rediscovery = time.monotonic() + interval if interval > 0 else None
         try:
-            while not stop_event.wait(timeout=interval if interval > 0 else 1.0):
+            while True:
+                tick = interval if interval > 0 else 1.0
+                if self.pending:
+                    tick = min(tick, 2.0)
+                if stop_event.wait(timeout=tick):
+                    break
                 if self.pending:
                     self._try_start_pending()
-                if interval > 0:
-                    inventory = discover(self.cfg)  # one walk per tick
+                if next_rediscovery is not None \
+                        and time.monotonic() >= next_rediscovery:
+                    next_rediscovery = time.monotonic() + interval
+                    inventory = discover(self.cfg)  # one walk per interval
                     if self._inventory_changed(inventory[0]):
                         log.info("host inventory changed; restarting plugin set")
                         self.stop()

@@ -90,11 +90,24 @@ class TpuHealth:
             return bool(self._lib.tpuhealth_libtpu_available())
         return False
 
-    def chip_alive(self, pci_base_path: str, bdf: str) -> bool:
-        """Composite liveness for one chip (what HealthMonitor polls)."""
+    def chip_alive(self, pci_base_path: str, bdf: str,
+                   node_path: Optional[str] = None) -> bool:
+        """Composite liveness for one chip (what HealthMonitor polls).
+
+        ANDs two independent native probes: PCI config space (a fallen-off
+        chip reads all-FF) and, when the chip has an associated device node
+        (`/dev/vfio/<group>`, `/dev/accelN`, mdev sysfs dir), its presence via
+        `probe_node` — so a vanished node flips health through the native
+        source even when the inotify watcher is degraded (the reference's
+        NVML XID watch plays this role, generic_vgpu_device_plugin.go:387-433).
+        """
         status = self.probe_config(os.path.join(pci_base_path, bdf, "config"))
         if status == MISSING:
             # Fixture trees have no config file; absence of the whole device
             # dir is the real death signal there.
-            return os.path.isdir(os.path.join(pci_base_path, bdf))
-        return status == OK
+            alive = os.path.isdir(os.path.join(pci_base_path, bdf))
+        else:
+            alive = status == OK
+        if alive and node_path is not None:
+            alive = self.probe_node(node_path) == OK
+        return alive

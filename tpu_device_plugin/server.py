@@ -191,7 +191,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             group_bdfs=group_bdfs,
             on_device_health=self.set_group_health,
             on_socket_removed=self._restart_async,
-            probe=lambda bdf: self.health_shim.chip_alive(self.cfg.pci_base_path, bdf),
+            probe=lambda bdf, node: self.health_shim.chip_alive(
+                self.cfg.pci_base_path, bdf, node),
             poll_interval_s=self.cfg.health_poll_s,
             stop_event=self._stop,
         )
@@ -329,7 +330,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         try:
             return allocate_mod.allocate_response(
                 self.cfg, self.registry, self.resource_suffix, request,
-                cdi_enabled=self.cdi_enabled)
+                cdi_enabled=self.cdi_enabled,
+                allowed_bdfs=frozenset(d.bdf for d in self.devices))
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))

@@ -84,4 +84,5 @@ class StatusServer:
         return {
             "plugins": [p.status_snapshot() for p in self.manager.plugins],
             "pending": [p.resource_name for p in self.manager.pending],
+            "native": getattr(self.manager, "native_info", {}),
         }

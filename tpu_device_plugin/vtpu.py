@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence
 import grpc
 
 from . import kubeletapi as api
-from .allocate import AllocationError
+from .allocate import AllocationError, plan_allocation
 from .config import Config
 from .discovery import read_link_basename
 from .health import HealthMonitor
@@ -45,8 +45,11 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         partitions: Sequence[TpuPartition],
         health_shim=None,
         cdi_enabled: bool = False,
+        cdi_uuids: frozenset = frozenset(),
     ) -> None:
         self.partitions = list(partitions)
+        # only partitions with a resolvable CDI spec entry get CDI names
+        self.cdi_uuids = cdi_uuids
         super().__init__(cfg, type_name, registry, devices=[],
                          health_shim=health_shim, cdi_enabled=cdi_enabled)
         # own socket namespace so a generation and a partition type never collide
@@ -76,6 +79,12 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                 paths[p.uuid] = os.path.join(self.cfg.mdev_base_path, p.uuid)
             elif p.accel_index is not None:
                 paths[p.uuid] = self.cfg.dev_path("dev", f"accel{p.accel_index}")
+            else:
+                group = self.registry.bdf_to_group.get(p.parent_bdf)
+                if group is not None:
+                    # vfio-backed logical partition: watch the group node the
+                    # allocation will mount
+                    paths[p.uuid] = self.cfg.dev_path("dev/vfio", group)
             parents[p.uuid] = [p.parent_bdf]
         self._monitor = HealthMonitor(
             socket_path=self.socket_path,
@@ -84,7 +93,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             on_device_health=lambda uuid, ok, src: self.set_devices_health(
                 [uuid], ok, src),
             on_socket_removed=self._restart_async,
-            probe=lambda bdf: self.health_shim.chip_alive(self.cfg.pci_base_path, bdf),
+            probe=lambda bdf, node: self.health_shim.chip_alive(
+                self.cfg.pci_base_path, bdf, node),
             poll_interval_s=self.cfg.health_poll_s,
             stop_event=self._stop,
         )
@@ -141,6 +151,25 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     elif p.accel_index is not None:
                         add(self.cfg.dev_path("dev", f"accel{p.accel_index}"),
                             f"/dev/accel{p.accel_index}", "rw")
+                    else:
+                        # Logical partition of a vfio-bound parent: the guest
+                        # can only reach the chip through its VFIO group, so
+                        # mount it whole (chip sharing is then a scheduling
+                        # construct, not hardware isolation). Discovery drops
+                        # partitions with neither an accel node nor a
+                        # vfio-bound parent, so an allocation NEVER returns
+                        # zero DeviceSpecs. plan_allocation supplies the same
+                        # sysfs revalidation + iommufd handling passthrough
+                        # gets.
+                        if p.parent_bdf not in self.registry.bdf_to_group:
+                            raise AllocationError(
+                                f"partition {uuid}: parent {p.parent_bdf} has "
+                                "no accel node and is not vfio-bound")
+                        plan = plan_allocation(
+                            self.cfg, self.registry, self.resource_suffix,
+                            [p.parent_bdf], shared_devices=[])
+                        for s in plan.device_specs:
+                            add(s.host_path, s.container_path, s.permissions)
                 env_key = f"{self.cfg.vtpu_env_prefix}_{sanitize_name(self.resource_suffix)}"
                 cresp = pb.ContainerAllocateResponse(
                     envs={env_key: ",".join(uuids)}, devices=specs)
@@ -148,7 +177,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     from .cdi import cdi_device_name
                     cresp.cdi_devices.extend(
                         pb.CDIDevice(name=cdi_device_name(self.cfg, uuid))
-                        for uuid in uuids)
+                        for uuid in uuids if uuid in self.cdi_uuids)
                 resp.container_responses.append(cresp)
         except AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
