@@ -178,3 +178,20 @@ def test_logical_partition_parent_excluded_from_passthrough(tmp_path):
     # lookup maps stay intact: the vTPU plugin resolves the parent through them
     assert registry.bdf_to_group["0000:00:04.0"] == "11"
     assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
+
+
+def test_colliding_partition_type_dropped_keeps_passthrough(tmp_path):
+    """A partition type named after a passthrough suffix is refused at
+    discovery so the parent chip stays schedulable as passthrough (rather
+    than being consumed by a vTPU plugin that can never register)."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "v4", "parent_bdf": "0000:00:04.0"}]}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    assert "v4" not in registry.partitions_by_type
+    assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:04.0"]

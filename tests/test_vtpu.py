@@ -228,3 +228,33 @@ def test_unallocatable_logical_partition_refused_at_discovery(short_root, tmp_pa
     registry, _ = discover(cfg)
     uuids = [p.uuid for p in registry.partitions_by_type.get("vslice", ())]
     assert uuids == ["ok0"]
+
+
+def test_vfio_backed_partition_sets_pci_resource_env(short_root, tmp_path):
+    """virt-launcher attaches vfio-backed partitions as PCI passthrough of
+    the parent; the PCI_RESOURCE env must carry the parent's BDF group."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    import json
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "vslice", "parent_bdf": "0000:00:04.0"}]}))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    plugin = VtpuDevicePlugin(cfg, "vslice", registry,
+                              registry.partitions_by_type["vslice"])
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["p0"])]),
+                timeout=5)
+            envs = dict(resp.container_responses[0].envs)
+            assert envs["MDEV_PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_VSLICE"] == "p0"
+            assert envs["PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_VSLICE"] == \
+                "0000:00:04.0"
+    finally:
+        server.stop(0)

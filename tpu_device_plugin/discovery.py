@@ -266,6 +266,24 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
     registry, generations = discover_passthrough(cfg, accel_by_bdf)
     partitions = discover_mdev_partitions(cfg)
     partitions += discover_logical_partitions(cfg, generations, accel_by_bdf)
+    # A partition type named like a passthrough resource suffix would make
+    # two plugins register the same extended-resource name with the kubelet.
+    # Refuse the partitions here (not later in the lifecycle), so their
+    # parent chips stay advertised as passthrough instead of being consumed
+    # by a plugin that can never be built.
+    from .naming import resource_name_for
+    passthrough_suffixes = {
+        resource_name_for(m, generations, cfg.pci_ids_path)
+        for m in registry.devices_by_model
+    }
+    kept: List[TpuPartition] = []
+    for p in partitions:
+        if p.type_name in passthrough_suffixes:
+            log.error("partition %s: type %r collides with a passthrough "
+                      "resource suffix; dropping partition", p.uuid, p.type_name)
+            continue
+        kept.append(p)
+    partitions = kept
     # A logical partition is only allocatable through its parent's accel node
     # or VFIO group; one with neither would hand a VMI zero DeviceSpecs —
     # refuse it here with a reason instead of failing at Allocate time.

@@ -7,13 +7,16 @@ step streams one (block_q, block_k) tile through VMEM, keeping running max
 matmuls per tile (Q·Kᵀ and P·V) with float32 accumulation; blocks entirely
 above the causal diagonal are skipped via `pl.when`.
 
-Training integration uses `jax.custom_vjp` with a rematerialized reference
-backward: the forward runs the Pallas kernel; the backward recomputes
-attention with plain einsum math and differentiates that. This keeps the
-kernel forward-only (the expensive, latency-critical direction for burn-in)
-while gradients stay exactly correct.
+Training integration uses `jax.custom_vjp` with Pallas backward kernels in
+the FlashAttention-2 shape: the forward additionally stores the per-row
+logsumexp (replicated across 128 lanes, the same layout the public JAX TPU
+kernel uses for its `l`/`m` residuals), and the backward recomputes P per
+tile from (q, k, lse) — two grid passes, one accumulating (dk, dv) per key
+block and one accumulating dq per query block. Memory stays O(S) in the
+backward exactly like the forward; the (S, S) matrix never exists in HBM in
+either direction.
 
-`interpret=True` runs the same kernel on CPU for tests.
+`interpret=True` runs the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
@@ -43,9 +46,25 @@ def _reference_attention(q, k, v, sm_scale: float, causal: bool):
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  sm_scale: float, causal: bool,
+LANES = 128  # TPU lane count; row-vector residuals are replicated across it
+
+
+def _bcast_rows(x, ncols: int):
+    """(rows, 128) lane-replicated vector -> (rows, ncols) broadcast."""
+    if ncols <= LANES:
+        return x[:, :ncols]
+    if ncols % LANES:
+        raise ValueError(f"block size {ncols} not a multiple of {LANES}")
+    return jnp.tile(x, (1, ncols // LANES))
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  sm_scale: float, causal: bool, save_lse: bool,
                   block_q: int, block_k: int, num_k: int, seq_len: int):
+    if save_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref, (m_ref, l_ref, acc_ref) = None, rest
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -98,10 +117,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(kj == last_k)
     def _finalize():
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        if save_lse:
+            lse_ref[0] = jnp.broadcast_to(
+                m_ref[:, :1] + jnp.log(l_ref[:, :1]), (block_q, LANES))
 
 
 def _flash_3d(q, k, v, sm_scale: float, causal: bool,
-              block_q: int, block_k: int, interpret: bool):
+              block_q: int, block_k: int, interpret: bool,
+              return_lse: bool = False):
     """(heads_batch, seq, head_dim) flash attention via pallas_call."""
     hb, seq, d = q.shape
     block_q = min(block_q, seq)
@@ -109,9 +132,17 @@ def _flash_3d(q, k, v, sm_scale: float, causal: bool,
     num_q = pl.cdiv(seq, block_q)
     num_k = pl.cdiv(seq, block_k)
     kernel = functools.partial(
-        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        _flash_kernel, sm_scale=sm_scale, causal=causal, save_lse=return_lse,
         block_q=block_q, block_k=block_k, num_k=num_k, seq_len=seq)
-    return pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((hb, seq, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
+    if return_lse:
+        # logsumexp residual, lane-replicated (same layout as the public JAX
+        # TPU flash kernel keeps its l/m residuals)
+        out_shape.append(jax.ShapeDtypeStruct((hb, seq, LANES), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)))
+    result = pl.pallas_call(
         kernel,
         grid=(hb, num_q, num_k),
         in_specs=[
@@ -119,8 +150,8 @@ def _flash_3d(q, k, v, sm_scale: float, causal: bool,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((hb, seq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max
             pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer
@@ -128,6 +159,163 @@ def _flash_3d(q, k, v, sm_scale: float, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v)
+    return result if return_lse else result[0]
+
+
+# --- backward kernels (FlashAttention-2 two-pass shape) ----------------------
+
+def _bwd_tile(q, do, k, v, lse, di, valid, sm_scale):
+    """Shared per-tile math: recompute P from lse, return (p, ds) masked."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale       # (bq, bk)
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (bq, bk)
+    # explicit mask: padded-row lse/di are garbage and 0*NaN poisons sums
+    ds = jnp.where(valid, p * (dp - di) * sm_scale, 0.0)
+    return p, ds
+
+
+def _masks(qi, kj, block_q, block_k, seq_len, causal, q_shape, k_shape):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = (rows < seq_len) & (cols < seq_len)
+    if causal:
+        valid &= cols <= rows
+    q_rows_ok = (qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, q_shape, 0)) < seq_len
+    k_rows_ok = (kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, k_shape, 0)) < seq_len
+    return rows, cols, valid, q_rows_ok, k_rows_ok
+
+
+def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          sm_scale: float, causal: bool,
+                          block_q: int, block_k: int, num_q: int, seq_len: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    # causal: a key block only sees query blocks at or below its diagonal
+    run = (qi * block_q + block_q - 1 >= kj * block_k) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q, do, k, v = q_ref[0], do_ref[0], k_ref[0], v_ref[0]
+        _, _, valid, q_ok, k_ok = _masks(
+            qi, kj, block_q, block_k, seq_len, causal, q.shape, k.shape)
+        q = jnp.where(q_ok, q, jnp.zeros_like(q))
+        do = jnp.where(q_ok, do, jnp.zeros_like(do))
+        k = jnp.where(k_ok, k, jnp.zeros_like(k))
+        v = jnp.where(k_ok, v, jnp.zeros_like(v))
+        lse = _bcast_rows(lse_ref[0], block_k)
+        di = _bcast_rows(di_ref[0], block_k)
+        p, ds = _bwd_tile(q, do, k, v, lse, di, valid, sm_scale)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bk, d)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
+                         dq_ref, dq_acc, *,
+                         sm_scale: float, causal: bool,
+                         block_q: int, block_k: int, num_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (kj * block_k <= qi * block_q + block_q - 1) if causal else (kj >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q, do, k, v = q_ref[0], do_ref[0], k_ref[0], v_ref[0]
+        _, _, valid, q_ok, k_ok = _masks(
+            qi, kj, block_q, block_k, seq_len, causal, q.shape, k.shape)
+        q = jnp.where(q_ok, q, jnp.zeros_like(q))
+        do = jnp.where(q_ok, do, jnp.zeros_like(do))
+        k = jnp.where(k_ok, k, jnp.zeros_like(k))
+        v = jnp.where(k_ok, v, jnp.zeros_like(v))
+        lse = _bcast_rows(lse_ref[0], block_k)
+        di = _bcast_rows(di_ref[0], block_k)
+        _, ds = _bwd_tile(q, do, k, v, lse, di, valid, sm_scale)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bq, d)
+
+    last_k = (jnp.minimum((qi * block_q + block_q - 1) // block_k, num_k - 1)
+              if causal else num_k - 1)
+
+    @pl.when(kj == last_k)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
+                  block_q, block_k, interpret):
+    """Pallas backward: dq, dk, dv with O(S) memory (no (S, S) in HBM)."""
+    hb, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    num_q = pl.cdiv(seq, block_q)
+    num_k = pl.cdiv(seq, block_k)
+    # D_i = rowsum(dO ∘ O), lane-replicated like lse
+    di = jnp.broadcast_to(
+        jnp.sum(d_out.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (hb, seq, LANES))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q, seq_len=seq),
+        grid=(hb, num_k, num_q),
+        in_specs=[q_spec, q_spec, row_spec, row_spec, kv_spec, kv_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((hb, seq, d), k.dtype),
+                   jax.ShapeDtypeStruct((hb, seq, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, d_out, lse, di, k, v)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k=num_k, seq_len=seq),
+        grid=(hb, num_q, num_k),
+        in_specs=[q_spec2, q_spec2, row_spec2, row_spec2, kv_spec2, kv_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((hb, seq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, d_out, lse, di, k, v)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -141,19 +329,19 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    out, lse = _flash_3d(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, d_out):
-    q, k, v = residuals
+    q, k, v, o, lse = residuals
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    # rematerialized reference backward: exact gradients, no kernel state
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, sm_scale, causal),
-        q, k, v)
-    return vjp(d_out)
+    return _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
+                         block_q, block_k, interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -124,6 +124,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                 uuids = list(creq.devices_ids)
                 specs: List[pb.DeviceSpec] = []
                 seen_paths = set()
+                pci_addrs: List[str] = []  # vfio-backed parents, group-expanded
 
                 def add(host: str, container: str, perms: str = "mrw") -> None:
                     if host not in seen_paths:
@@ -170,9 +171,20 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                             [p.parent_bdf], shared_devices=[])
                         for s in plan.device_specs:
                             add(s.host_path, s.container_path, s.permissions)
+                        for addr in plan.expanded_bdfs:
+                            if addr not in pci_addrs:
+                                pci_addrs.append(addr)
                 env_key = f"{self.cfg.vtpu_env_prefix}_{sanitize_name(self.resource_suffix)}"
-                cresp = pb.ContainerAllocateResponse(
-                    envs={env_key: ",".join(uuids)}, devices=specs)
+                envs = {env_key: ",".join(uuids)}
+                if pci_addrs:
+                    # vfio-backed partitions attach as PCI passthrough of the
+                    # parent: virt-launcher locates the device through the
+                    # PCI_RESOURCE env (config.py env_prefix contract), not
+                    # the MDEV uuid env
+                    pci_key = (f"{self.cfg.env_prefix}_"
+                               f"{sanitize_name(self.resource_suffix)}")
+                    envs[pci_key] = ",".join(pci_addrs)
+                cresp = pb.ContainerAllocateResponse(envs=envs, devices=specs)
                 if self.cdi_enabled:
                     from .cdi import cdi_device_name
                     cresp.cdi_devices.extend(
