@@ -24,9 +24,13 @@ proto: proto/deviceplugin_v1beta1.proto
 test:
 	$(PYTHON) -m pytest tests/ -q
 
+# Enforced coverage (reference: Makefile:59-61 + golang.yml Coveralls job).
+# No silent fallback: a missing pytest-cov or a coverage drop below the
+# threshold fails the target, and CI runs this as a required job.
+COV_MIN ?= 80
 coverage:
-	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin --cov-report=term-missing 2>/dev/null \
-		|| $(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin \
+		--cov-report=term-missing --cov-fail-under=$(COV_MIN)
 
 bench:
 	$(PYTHON) bench.py

@@ -1,0 +1,223 @@
+"""Node topology labeler: facts, feature file, API PATCH, manager wiring."""
+
+import json
+import os
+import threading
+from dataclasses import replace
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover
+from tpu_device_plugin.labeler import NodeLabeler, node_facts, write_feature_file
+from tpu_device_plugin.lifecycle import PluginManager
+
+
+@pytest.fixture
+def inventory(tmp_path):
+    host = FakeHost(tmp_path)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0064",
+                               iommu_group=str(11 + i)))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="31")
+    cfg = Config().with_root(host.root)
+    registry, generations = discover(cfg)
+    return cfg, registry, generations
+
+
+def test_node_facts(inventory):
+    cfg, registry, generations = inventory
+    facts = node_facts(cfg, registry, generations)
+    assert facts == {
+        "cloud-tpus.google.com/v5p.chips": "4",
+        "cloud-tpus.google.com/v5p.torus": "2x2x1",
+        "cloud-tpus.google.com/vtpu.TPU_vhalf": "1",
+    }
+
+
+def test_feature_file_roundtrip(inventory, tmp_path):
+    cfg, registry, generations = inventory
+    facts = node_facts(cfg, registry, generations)
+    path = tmp_path / "features.d" / "tpu"
+    assert write_feature_file(str(path), facts)
+    lines = path.read_text().splitlines()
+    assert lines == [f"{k}={facts[k]}" for k in sorted(facts)]
+
+
+def test_feature_file_failure_tolerated(tmp_path):
+    blocked = tmp_path / "f"
+    blocked.write_text("")  # file where a directory is needed
+    assert not write_feature_file(str(blocked / "x" / "tpu"), {"a": "1"})
+
+
+class _FakeApiServer:
+    """Captures PATCH /api/v1/nodes/<name>; serves GET with `node_labels`."""
+
+    def __init__(self, node_labels=None):
+        self.patches = []
+        self.node_labels = dict(node_labels or {})
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(
+                    {"metadata": {"labels": outer.node_labels}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length", 0))
+                outer.patches.append({
+                    "path": self.path,
+                    "content_type": self.headers.get("Content-Type"),
+                    "auth": self.headers.get("Authorization"),
+                    "body": json.loads(self.rfile.read(length)),
+                })
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        self._httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def test_patch_node_labels(inventory, tmp_path):
+    cfg, registry, generations = inventory
+    api = _FakeApiServer()
+    token = tmp_path / "token"
+    token.write_text("sekret\n")
+    try:
+        labeler = NodeLabeler(node_name="node-a", api_server=api.url,
+                              token_path=str(token))
+        assert labeler.publish(node_facts(cfg, registry, generations))
+        assert len(api.patches) == 1
+        patch = api.patches[0]
+        assert patch["path"] == "/api/v1/nodes/node-a"
+        assert patch["content_type"] == "application/strategic-merge-patch+json"
+        assert patch["auth"] == "Bearer sekret"
+        labels = patch["body"]["metadata"]["labels"]
+        assert labels["cloud-tpus.google.com/v5p.chips"] == "4"
+    finally:
+        api.stop()
+
+
+def test_patch_failure_returns_false(inventory):
+    cfg, registry, generations = inventory
+    labeler = NodeLabeler(node_name="node-a",
+                          api_server="http://127.0.0.1:1")  # nothing listens
+    assert not labeler.publish(node_facts(cfg, registry, generations))
+
+
+def test_manager_publishes_on_inventory(short_root, tmp_path):
+    """The manager invokes the labeler seam on every (re)discovery, and a
+    failing callback never sinks plugin startup."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    seen = []
+
+    def on_inventory(registry, generations):
+        seen.append(node_facts(cfg, registry, generations))
+        raise RuntimeError("callback blew up")  # must be tolerated
+
+    manager = PluginManager(cfg, on_inventory=on_inventory)
+    manager.start()
+    try:
+        assert kubelet.wait_for(1)
+        assert seen and seen[0]["cloud-tpus.google.com/v4.chips"] == "1"
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_stale_labels_nulled_on_republish(inventory):
+    """Facts for disappeared inventory — including labels left by a previous
+    pod incarnation (discovered via GET) — must be deleted with null values
+    in the strategic-merge PATCH."""
+    cfg, registry, generations = inventory
+    api = _FakeApiServer(node_labels={
+        "cloud-tpus.google.com/ghost.chips": "2",   # previous incarnation
+        "kubernetes.io/hostname": "node-a",          # foreign: untouched
+    })
+    try:
+        labeler = NodeLabeler(node_name="node-a", api_server=api.url)
+        facts = node_facts(cfg, registry, generations)
+        assert labeler.publish(facts)
+        labels = api.patches[0]["body"]["metadata"]["labels"]
+        assert labels["cloud-tpus.google.com/ghost.chips"] is None
+        assert "kubernetes.io/hostname" not in labels
+        # partitions vanish -> their key nulled on the next publish
+        facts2 = {k: v for k, v in facts.items() if "vtpu" not in k}
+        assert labeler.publish(facts2)
+        labels2 = api.patches[1]["body"]["metadata"]["labels"]
+        assert labels2["cloud-tpus.google.com/vtpu.TPU_vhalf"] is None
+    finally:
+        api.stop()
+
+
+def test_require_api_warns_and_fails_without_node_name(inventory, tmp_path, caplog):
+    """--label-node without NODE_NAME must not be silently swallowed just
+    because a feature file is also configured."""
+    import logging
+    cfg, registry, generations = inventory
+    labeler = NodeLabeler(node_name=None, api_server=None,
+                          feature_file=str(tmp_path / "tpu"),
+                          require_api=True)
+    labeler.node_name = None  # defeat any NODE_NAME in the environment
+    with caplog.at_level(logging.WARNING):
+        assert labeler.publish(node_facts(cfg, registry, generations)) is False
+    assert any("NOT published" in r.message for r in caplog.records)
+    assert (tmp_path / "tpu").exists()  # feature path still written
+
+
+def test_manager_retries_failed_publish(short_root):
+    """A publish that fails at boot (API server down) is retried from the
+    run loop even though inventory never changes."""
+    import threading
+    import time as time_mod
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    calls = []
+
+    def on_inventory(registry, generations):
+        calls.append(time_mod.monotonic())
+        return len(calls) >= 2  # first attempt "fails"
+
+    manager = PluginManager(cfg, on_inventory=on_inventory)
+    manager._next_publish_retry = 0.0
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kubelet.wait_for(1)
+        manager._next_publish_retry = 0.0  # don't wait 30s in the test
+        deadline = time_mod.monotonic() + 10
+        while len(calls) < 2 and time_mod.monotonic() < deadline:
+            manager._next_publish_retry = 0.0
+            time_mod.sleep(0.1)
+        assert len(calls) >= 2, "failed publish was never retried"
+        assert manager._inventory_published
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        kubelet.stop()

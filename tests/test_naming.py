@@ -65,3 +65,17 @@ def test_resource_name_priority(tmp_path):
     # raw-id fallback
     assert naming.resource_name_for("dead", table, str(p)) == "TPU_DEAD"
     assert naming.resource_name_for("dead", table, None) == "TPU_DEAD"
+
+
+def test_bundled_subset_fallback_for_unknown_id():
+    """utils/README.md subset contract: an id absent from both the
+    generation table and the bundled pci.ids subset still yields a valid,
+    unique resource name (raw-id fallback), never an error."""
+    import os
+    bundled = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "utils", "pci.ids")
+    table = naming.load_generation_map(None)
+    # known to the bundled subset (display-name fallback path)
+    assert naming.resource_name_for("001f", table, bundled) == "NVME_DEVICE"
+    # outside the subset entirely
+    assert naming.resource_name_for("9999", table, bundled) == "TPU_9999"

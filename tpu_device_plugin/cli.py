@@ -50,6 +50,17 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
+    parser.add_argument("--label-node", action="store_true",
+                        help="publish per-node TPU facts (generation, chip "
+                             "count, torus dims) as node labels via the API "
+                             "server (needs NODE_NAME + patch-nodes RBAC)")
+    parser.add_argument("--node-name", default=None,
+                        help="this node's name (default: $NODE_NAME)")
+    parser.add_argument("--api-server", default=None,
+                        help="API server URL override (default: in-cluster)")
+    parser.add_argument("--feature-file", default=None,
+                        help="also/instead write facts as an NFD local "
+                             "feature file (key=value lines)")
     parser.add_argument("--status-port", type=int, default=0,
                         help="serve /healthz and /status on this port "
                              "(0 disables)")
@@ -96,7 +107,17 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
-    manager = PluginManager(cfg)
+    on_inventory = None
+    if args.label_node or args.feature_file:
+        from .labeler import NodeLabeler, node_facts
+        labeler = NodeLabeler(node_name=args.node_name,
+                              api_server=args.api_server,
+                              feature_file=args.feature_file,
+                              require_api=args.label_node,
+                              label_prefix=cfg.resource_namespace)
+        on_inventory = lambda reg, gens: labeler.publish(
+            node_facts(cfg, reg, gens))
+    manager = PluginManager(cfg, on_inventory=on_inventory)
     status = None
     if args.status_port:
         from .status import StatusServer

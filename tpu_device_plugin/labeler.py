@@ -1,0 +1,194 @@
+"""Node topology labeler — publish per-node TPU facts for multi-node slices.
+
+SURVEY.md §7 stage 8 / BASELINE config 5: a v5p-16 slice spans hosts, and the
+scheduler (or the human writing VMI templates) needs per-node facts —
+generation, chip count, host torus shape — to place one VMI per host without
+hand-rolled nodeSelectors. The reference has no analogue (it predates NFD);
+this is TPU-first capability on top of the same DaemonSet.
+
+Two publication paths, both dependency-free:
+
+1. **Node labels** via the API server: a strategic-merge PATCH of
+   `metadata.labels` on this node object, authenticated with the pod's
+   service-account token (stdlib urllib; no kubernetes client package).
+   The DaemonSet needs a Role allowing `patch` on `nodes` and the node name
+   from the downward API (`NODE_NAME`).
+2. **NFD feature file**: `key=value` lines under
+   `/etc/kubernetes/node-feature-discovery/features.d/`, picked up by
+   node-feature-discovery's local source for clusters that already run NFD
+   (no extra RBAC needed).
+
+Facts published (keys under the resource namespace):
+
+    cloud-tpus.google.com/<gen>.chips  = "4"        per discovered generation
+    cloud-tpus.google.com/<gen>.torus  = "2x2x1"    host-local ICI torus
+    cloud-tpus.google.com/vtpu.<type>  = "8"        per partition type
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+from .config import Config
+from .naming import GenerationInfo
+from .registry import Registry
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def node_facts(cfg: Config, registry: Registry,
+               generations: Dict[str, GenerationInfo]) -> Dict[str, str]:
+    """Label map describing this node's TPU inventory."""
+    facts: Dict[str, str] = {}
+    ns = cfg.resource_namespace
+    for model, devs in sorted(registry.devices_by_model.items()):
+        info = generations.get(model)
+        gen = info.name if info else f"tpu-{model}"
+        facts[f"{ns}/{gen}.chips"] = str(len(devs))
+        if info is not None:
+            facts[f"{ns}/{gen}.torus"] = "x".join(
+                str(d) for d in info.host_topology)
+    for type_name, parts in sorted(registry.partitions_by_type.items()):
+        facts[f"{ns}/vtpu.{type_name}"] = str(len(parts))
+    return facts
+
+
+def write_feature_file(path: str, facts: Dict[str, str]) -> bool:
+    """Atomically write the NFD local-source feature file; False on failure."""
+    tmp = None
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            for key in sorted(facts):
+                f.write(f"{key}={facts[key]}\n")
+        os.replace(tmp, path)
+    except OSError as exc:
+        log.error("could not write feature file %s: %s", path, exc)
+        if tmp is not None:
+            # NFD parses every file in features.d — never leave a half-
+            # written tmp behind
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+    log.info("wrote %d node facts to %s", len(facts), path)
+    return True
+
+
+class NodeLabeler:
+    """Publishes node facts; safe to call repeatedly (idempotent PATCH)."""
+
+    def __init__(
+        self,
+        node_name: Optional[str] = None,
+        api_server: Optional[str] = None,
+        token_path: str = os.path.join(SA_DIR, "token"),
+        ca_path: str = os.path.join(SA_DIR, "ca.crt"),
+        feature_file: Optional[str] = None,
+        require_api: bool = False,
+        label_prefix: str = "cloud-tpus.google.com",
+    ) -> None:
+        self.node_name = node_name or os.environ.get("NODE_NAME")
+        self.api_server = api_server or self._in_cluster_server()
+        self.token_path = token_path
+        self.ca_path = ca_path
+        self.feature_file = feature_file
+        # --label-node was explicitly requested: a missing NODE_NAME/API
+        # server must warn even when a feature file is also configured
+        self.require_api = require_api
+        self.label_prefix = label_prefix
+        self._published_keys: set = set()
+
+    @staticmethod
+    def _in_cluster_server() -> Optional[str]:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            return None
+        return f"https://{host}:{port}"
+
+    def publish(self, facts: Dict[str, str]) -> bool:
+        """Write the feature file and/or PATCH node labels; True only when
+        every *configured* path succeeded (False ⇒ caller should retry)."""
+        ok = True
+        any_path = False
+        if self.feature_file:
+            any_path = True
+            ok = write_feature_file(self.feature_file, facts) and ok
+        if self.node_name and self.api_server:
+            any_path = True
+            ok = self._patch_labels(facts) and ok
+        elif self.require_api:
+            log.warning("node labeling requested but %s is missing; labels "
+                        "NOT published",
+                        "NODE_NAME" if not self.node_name else "API server")
+            ok = False
+        if not any_path and not self.require_api:
+            log.warning("labeler has neither a feature file nor node name + "
+                        "API server; nothing published")
+            return False
+        return ok
+
+    def _patch_labels(self, facts: Dict[str, str]) -> bool:
+        # Strategic merge only adds/overwrites; facts for inventory that
+        # disappeared (or that a previous pod incarnation published) must be
+        # nulled out explicitly, so fetch our namespaced keys first.
+        labels: Dict[str, Optional[str]] = dict(facts)
+        stale = (self._published_keys | self._live_label_keys()) - set(facts)
+        for key in stale:
+            labels[key] = None
+        url = f"{self.api_server}/api/v1/nodes/{self.node_name}"
+        body = json.dumps({"metadata": {"labels": labels}}).encode()
+        try:
+            self._request(url, method="PATCH", body=body,
+                          content_type="application/strategic-merge-patch+json")
+        except (urllib.error.URLError, OSError) as exc:
+            log.error("node label PATCH %s failed: %s", url, exc)
+            return False
+        self._published_keys = set(facts)
+        log.info("labeled node %s with %d TPU facts (%d stale removed)",
+                 self.node_name, len(facts), len(stale))
+        return True
+
+    def _live_label_keys(self) -> set:
+        """This labeler's namespaced label keys currently on the node (so a
+        restarted pod can prune labels a previous incarnation published).
+        Empty set on any failure — pruning then degrades to session memory."""
+        url = f"{self.api_server}/api/v1/nodes/{self.node_name}"
+        try:
+            node = json.loads(self._request(url))
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            log.debug("node GET for label pruning failed: %s", exc)
+            return set()
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        return {k for k in labels if k.startswith(self.label_prefix + "/")}
+
+    def _request(self, url: str, method: str = "GET",
+                 body: Optional[bytes] = None,
+                 content_type: Optional[str] = None) -> bytes:
+        req = urllib.request.Request(url, data=body, method=method)
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        try:
+            with open(self.token_path, "r", encoding="ascii") as f:
+                req.add_header("Authorization", f"Bearer {f.read().strip()}")
+        except OSError:
+            pass  # no token (e.g. test server without auth)
+        ctx = None
+        if url.startswith("https"):
+            ctx = ssl.create_default_context(
+                cafile=self.ca_path if os.path.exists(self.ca_path) else None)
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            return resp.read()

@@ -29,8 +29,16 @@ log = logging.getLogger(__name__)
 
 
 class PluginManager:
-    def __init__(self, cfg: Config) -> None:
+    def __init__(self, cfg: Config, on_inventory=None) -> None:
         self.cfg = cfg
+        # called with (registry, generations) after every (re)discovery —
+        # the node labeler publishes per-node facts through this seam; a
+        # False return (e.g. API server unreachable at node boot) is retried
+        # from the run loop even when inventory never changes
+        self.on_inventory = on_inventory
+        self._last_inventory = None
+        self._inventory_published = True
+        self._next_publish_retry = 0.0
         self.plugins: List[TpuDevicePlugin] = []
         self.pending: List[TpuDevicePlugin] = []
         self.registry: Optional[Registry] = None
@@ -50,6 +58,9 @@ class PluginManager:
     def build_plugins(self, inventory=None) -> List[TpuDevicePlugin]:
         registry, generations = inventory if inventory else discover(self.cfg)
         self.registry = registry
+        if self.on_inventory is not None:
+            self._last_inventory = (registry, generations)
+            self._publish_inventory()
         plugins: List[TpuDevicePlugin] = []
         cdi_paths: List[str] = []
         passthrough_suffixes = set()
@@ -103,6 +114,17 @@ class PluginManager:
             from . import cdi
             cdi.prune_specs(self.cfg, cdi_paths)
         return plugins
+
+    def _publish_inventory(self) -> None:
+        registry, generations = self._last_inventory
+        try:
+            ok = self.on_inventory(registry, generations)
+        except Exception as exc:
+            log.error("inventory callback failed: %s", exc)
+            ok = False
+        self._inventory_published = ok is not False
+        if not self._inventory_published:
+            self._next_publish_retry = time.monotonic() + 30.0
 
     def start(self, inventory=None) -> None:
         self.plugins = self.build_plugins(inventory)
@@ -167,6 +189,12 @@ class PluginManager:
                     break
                 if self.pending:
                     self._try_start_pending()
+                if self.on_inventory is not None \
+                        and not self._inventory_published \
+                        and self._last_inventory is not None \
+                        and time.monotonic() >= self._next_publish_retry:
+                    log.info("retrying node fact publication")
+                    self._publish_inventory()
                 if next_rediscovery is not None \
                         and time.monotonic() >= next_rediscovery:
                     next_rediscovery = time.monotonic() + interval
