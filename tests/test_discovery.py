@@ -195,3 +195,23 @@ def test_colliding_partition_type_dropped_keeps_passthrough(tmp_path):
     registry, _ = discovery.discover(cfg)
     assert "v4" not in registry.partitions_by_type
     assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:04.0"]
+
+
+def test_vfio_driver_variants_accepted(tmp_path):
+    """A second VFIO driver variant is accepted when configured (reference
+    accepts nvgrace_gpu_vfio_pci alongside vfio-pci, device_plugin.go:75-78);
+    the --vfio-drivers CLI flag feeds Config.vfio_drivers."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="tpu_vfio_pci"))
+    # default config: unknown driver -> not discovered
+    registry, _ = discovery.discover_passthrough(make_cfg(host))
+    assert registry.devices_by_model == {}
+    # variant configured -> discovered
+    cfg = make_cfg(host, vfio_drivers=("vfio-pci", "tpu_vfio_pci"))
+    registry, _ = discovery.discover_passthrough(cfg)
+    assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:04.0"]
+    # CLI flag parses into the tuple
+    from tpu_device_plugin.cli import build_config
+    parsed, _ = build_config(["--vfio-drivers", "vfio-pci, tpu_vfio_pci"])
+    assert parsed.vfio_drivers == ("vfio-pci", "tpu_vfio_pci")

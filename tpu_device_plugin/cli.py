@@ -34,6 +34,11 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--pci-ids-path", default=cfg.pci_ids_path)
     parser.add_argument("--device-plugin-path", default=cfg.device_plugin_path)
     parser.add_argument("--resource-namespace", default=cfg.resource_namespace)
+    parser.add_argument("--vfio-drivers", default=",".join(cfg.vfio_drivers),
+                        help="comma-separated driver names accepted as VFIO "
+                             "bindings (the reference accepts a second "
+                             "variant driver the same way, "
+                             "device_plugin.go:75-78)")
     parser.add_argument("--generation-map", default=None,
                         help="JSON overriding the device-id → generation table")
     parser.add_argument("--topology-file", default=None,
@@ -84,6 +89,8 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         device_plugin_path=args.device_plugin_path,
         kubelet_socket=args.device_plugin_path.rstrip("/") + "/kubelet.sock",
         resource_namespace=args.resource_namespace,
+        vfio_drivers=tuple(
+            d.strip() for d in args.vfio_drivers.split(",") if d.strip()),
         generation_map_path=args.generation_map,
         topology_hints_path=args.topology_file,
         partition_config_path=args.partition_config,
