@@ -107,3 +107,24 @@ def test_unknown_path_404(rig):
     host, manager, status = rig
     code, _ = _get(status.port, "/nope")
     assert code == 404
+
+
+def test_metrics_exposition(rig):
+    host, manager, status = rig
+    manager.start()
+    code, body = _get(status.port, "/metrics")
+    assert code == 200
+    text = body.decode()
+    assert ('tpu_plugin_devices{resource="cloud-tpus.google.com/v4",'
+            'health="Healthy"} 1') in text
+    assert ('tpu_plugin_serving{resource="cloud-tpus.google.com/v4"} 1'
+            ) in text
+    assert "tpu_plugin_pending_plugins 0" in text
+    # gauge must reflect the live probe, whatever this host reports
+    expected = int(manager.native_info["libtpu_available"])
+    assert f"tpu_plugin_libtpu_available {expected}" in text
+    # health flip shows up in the gauge
+    manager.plugins[0].set_group_health("11", False, "fs")
+    code, body = _get(status.port, "/metrics")
+    assert ('tpu_plugin_devices{resource="cloud-tpus.google.com/v4",'
+            'health="Unhealthy"} 1') in body.decode()

@@ -11,6 +11,8 @@ dependency-free endpoint for liveness probes and debugging:
   GET /readyz   -> readiness: 200 once at least one plugin is serving
   GET /status   -> JSON: per-plugin resource name, socket, restart count,
                    device health table, pending (not-yet-registered) plugins
+  GET /metrics  -> Prometheus text format: device health gauges, serving
+                   flags, restart counters, pending count, native-shim facts
 
 Disabled by default (--status-port 0).
 """
@@ -56,6 +58,9 @@ class StatusServer:
                 elif self.path == "/status":
                     self._send(200, json.dumps(outer.status(),
                                                sort_keys=True).encode())
+                elif self.path == "/metrics":
+                    self._send(200, outer.metrics().encode(),
+                               "text/plain; version=0.0.4")
                 else:
                     self._send(404, b"not found", "text/plain")
 
@@ -86,3 +91,43 @@ class StatusServer:
             "pending": [p.resource_name for p in self.manager.pending],
             "native": getattr(self.manager, "native_info", {}),
         }
+
+    def metrics(self) -> str:
+        """Prometheus text exposition of the /status facts."""
+        s = self.status()
+        lines = [
+            "# HELP tpu_plugin_devices Devices by resource and health state.",
+            "# TYPE tpu_plugin_devices gauge",
+        ]
+        for p in s["plugins"]:
+            counts = {"Healthy": 0, "Unhealthy": 0}
+            for health in p["devices"].values():
+                counts[health] = counts.get(health, 0) + 1
+            for health, n in sorted(counts.items()):
+                lines.append(
+                    f'tpu_plugin_devices{{resource="{p["resource"]}",'
+                    f'health="{health}"}} {n}')
+        lines += ["# HELP tpu_plugin_serving Plugin serving state (1=serving).",
+                  "# TYPE tpu_plugin_serving gauge"]
+        for p in s["plugins"]:
+            lines.append(f'tpu_plugin_serving{{resource="{p["resource"]}"}} '
+                         f'{int(p["serving"])}')
+        lines += ["# HELP tpu_plugin_restarts_total Socket-loss restarts.",
+                  "# TYPE tpu_plugin_restarts_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_restarts_total{{resource="{p["resource"]}"}} '
+                f'{p["restarts"]}')
+        lines += [
+            "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",
+            "# TYPE tpu_plugin_pending_plugins gauge",
+            f"tpu_plugin_pending_plugins {len(s['pending'])}",
+            "# HELP tpu_plugin_native_shim Native libtpuhealth loaded (1=yes).",
+            "# TYPE tpu_plugin_native_shim gauge",
+            f"tpu_plugin_native_shim {int(s['native'].get('native_shim', False))}",
+            "# HELP tpu_plugin_libtpu_available libtpu.so loadable (1=yes).",
+            "# TYPE tpu_plugin_libtpu_available gauge",
+            "tpu_plugin_libtpu_available "
+            f"{int(s['native'].get('libtpu_available', False))}",
+        ]
+        return "\n".join(lines) + "\n"
