@@ -100,3 +100,31 @@ def test_microbench_failure_never_vetoes(monkeypatch):
     assert report.ok is True
     assert report.matmul_tflops == 0.0
     assert "microbench skipped" in report.error
+
+
+def test_validate_slice_infer_mode():
+    """Serving mode: forward-only latency percentiles, finite-logits gate."""
+    report = validate_slice(cfg=SMALL, steps=5, tp=2, devices=cpus(),
+                            mode="infer")
+    assert report.ok, report.error
+    assert report.infer_p50_ms > 0
+    assert report.infer_p99_ms >= report.infer_p50_ms
+    assert report.tokens_per_s > 0
+    assert report.loss_start == 0.0  # no training happened
+    assert report.mesh_shape == {"dp": 4, "sp": 1, "tp": 2}
+
+
+def test_infer_matches_workload_forward():
+    """build_infer must run the same model as the training forward."""
+    import jax.numpy as jnp
+    from tpu_device_plugin.validator.workload import (
+        build_infer, forward, init_params)
+    import jax
+    mesh = slice_mesh(cpus()[:1])
+    fwd, params, tokens = build_infer(SMALL, mesh, seed=11)
+    logits = fwd(params, tokens)
+    ref_params = init_params(jax.random.key(11), SMALL)
+    ref = forward(ref_params, tokens, SMALL, "einsum", True, mesh)
+    # bf16 matmuls: jit fusion order vs eager differs in the last few ulps,
+    # which is ~3e-2 at these logit magnitudes
+    assert float(jnp.max(jnp.abs(logits - ref))) < 1e-1

@@ -33,6 +33,10 @@ class SliceReport:
     hbm_gbps: float = 0.0            # single-chip memory bandwidth estimate
     loss_start: float = 0.0
     loss_end: float = 0.0
+    # serving mode (--mode infer): forward-only latency percentiles
+    infer_p50_ms: float = 0.0
+    infer_p99_ms: float = 0.0
+    tokens_per_s: float = 0.0
     error: str = ""
 
     def to_json(self) -> str:
@@ -92,6 +96,7 @@ def validate_slice(
     sp: Optional[int] = None,
     devices=None,
     attention: Optional[str] = None,
+    mode: str = "train",
 ) -> SliceReport:
     report = SliceReport(ok=False)
     try:
@@ -104,33 +109,58 @@ def validate_slice(
         report.device_kinds = sorted({d.device_kind for d in devices})
 
         from .mesh import slice_mesh
-        from .workload import ModelConfig, build_workload
+        from .workload import ModelConfig, build_infer, build_workload
         cfg = cfg or ModelConfig()
         mesh = slice_mesh(devices, tp=tp, sp=sp) if len(devices) > 1 else None
         if mesh is not None:
             report.mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-        step, params, momentum, tokens = build_workload(cfg, mesh,
-                                                        attention=attention)
 
-        params, momentum, loss = step(params, momentum, tokens)
-        report.loss_start = float(loss)
-        report.first_step_s = time.monotonic() - _PROCESS_START
+        if mode == "infer":
+            # serving path: forward-only latency distribution, no optimizer
+            steps = max(steps, 1)  # percentiles need >=1 sample
+            fwd, params, tokens = build_infer(cfg, mesh, attention=attention)
+            logits = fwd(params, tokens)
+            jax.block_until_ready(logits)
+            report.first_step_s = time.monotonic() - _PROCESS_START
+            lat = []
+            for _ in range(steps):
+                t0 = time.monotonic()
+                jax.block_until_ready(fwd(params, tokens))
+                lat.append(time.monotonic() - t0)
+            lat.sort()
+            report.infer_p50_ms = lat[len(lat) // 2] * 1e3
+            report.infer_p99_ms = lat[min(len(lat) - 1,
+                                          int(len(lat) * 0.99))] * 1e3
+            report.step_time_s = sum(lat) / len(lat)
+            report.tokens_per_s = cfg.batch * cfg.seq_len / report.step_time_s
+            # a serving slice is usable iff its logits are finite
+            report.ok = bool(jax.numpy.isfinite(logits).all())
+            if not report.ok:
+                report.error = "non-finite logits in serving forward"
+        else:
+            step, params, momentum, tokens = build_workload(cfg, mesh,
+                                                            attention=attention)
 
-        t0 = time.monotonic()
-        for _ in range(steps):
             params, momentum, loss = step(params, momentum, tokens)
-        jax.block_until_ready(loss)
-        elapsed = time.monotonic() - t0
-        report.loss_end = float(loss)
-        report.step_time_s = elapsed / steps
-        report.tflops_per_chip = (
-            _workload_flops(cfg) / report.step_time_s / 1e12 / max(report.n_devices, 1))
+            report.loss_start = float(loss)
+            report.first_step_s = time.monotonic() - _PROCESS_START
 
-        # a slice that cannot learn is broken even if it computes
-        report.ok = report.loss_end < report.loss_start
-        if not report.ok:
-            report.error = (f"loss did not decrease "
-                            f"({report.loss_start:.4f} -> {report.loss_end:.4f})")
+            t0 = time.monotonic()
+            for _ in range(steps):
+                params, momentum, loss = step(params, momentum, tokens)
+            jax.block_until_ready(loss)
+            elapsed = time.monotonic() - t0
+            report.loss_end = float(loss)
+            report.step_time_s = elapsed / steps
+            report.tflops_per_chip = (
+                _workload_flops(cfg) / report.step_time_s / 1e12
+                / max(report.n_devices, 1))
+
+            # a slice that cannot learn is broken even if it computes
+            report.ok = report.loss_end < report.loss_start
+            if not report.ok:
+                report.error = (f"loss did not decrease "
+                                f"({report.loss_start:.4f} -> {report.loss_end:.4f})")
 
         # Diagnostic-only numbers, never a veto: runs after the verdict, on a
         # device THIS process can address (in multi-VMI mode jax.devices()
@@ -155,6 +185,10 @@ def main(argv=None) -> int:
         prog="tpu-slice-validator",
         description="Validate a passed-through TPU slice from inside the guest.")
     parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--mode", choices=["train", "infer"], default="train",
+                        help="train = full step burn-in (loss must decrease); "
+                             "infer = forward-only serving latency "
+                             "percentiles (p50/p99, tokens/s)")
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
@@ -198,6 +232,6 @@ def main(argv=None) -> int:
         cfg = ModelConfig(seq_len=args.seq_len)
     attention = None if args.attention == "auto" else args.attention
     report = validate_slice(cfg=cfg, steps=args.steps, tp=args.tp, sp=args.sp,
-                            attention=attention)
+                            attention=attention, mode=args.mode)
     print(report.to_json())
     return 0 if report.ok else 1

@@ -216,23 +216,8 @@ def sgd_step(params: Params, momentum: Params, tokens: jax.Array,
     return new_params, new_momentum, loss
 
 
-def build_workload(
-    cfg: Optional[ModelConfig] = None,
-    mesh: Optional[Mesh] = None,
-    seed: int = 0,
-    attention: Optional[str] = None,
-):
-    """Returns (jitted step, params, momentum, tokens), device-placed.
-
-    Params/optimizer state follow `param_specs`, the batch is sharded
-    (dp, sp). Without a mesh a trivial 1x1x1 mesh over the first visible
-    device is used, so the same annotated program compiles single-chip.
-
-    attention: "flash" (Pallas kernel, needs sp == 1), "ring"
-    (sequence-parallel ring attention, K/V rotate over the sp axis),
-    "einsum" (KV all-gather). None auto-selects: ring when sp > 1, flash on
-    TPU when sp == 1, einsum otherwise.
-    """
+def _resolve(cfg, mesh, attention):
+    """Shared mesh/platform/attention selection for train and infer builds."""
     cfg = cfg or ModelConfig()
     if mesh is None:
         from .mesh import slice_mesh
@@ -250,22 +235,33 @@ def build_workload(
         raise ValueError("flash attention requires sp == 1 (full local sequence)")
     if attention not in ("flash", "ring", "einsum"):
         raise ValueError(f"unknown attention mode {attention!r}")
-    key = jax.random.key(seed)
-    params = init_params(key, cfg)
-    momentum = jax.tree.map(jnp.zeros_like, params)
-    tokens = jax.random.randint(
-        jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
-        dtype=jnp.int32)
+    return cfg, mesh, platform, attention
+
+
+def build_workload(
+    cfg: Optional[ModelConfig] = None,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    attention: Optional[str] = None,
+):
+    """Returns (jitted step, params, momentum, tokens), device-placed.
+
+    Params/optimizer state follow `param_specs`, the batch is sharded
+    (dp, sp). Without a mesh a trivial 1x1x1 mesh over the first visible
+    device is used, so the same annotated program compiles single-chip.
+
+    attention: "flash" (Pallas kernel, needs sp == 1), "ring"
+    (sequence-parallel ring attention, K/V rotate over the sp axis),
+    "einsum" (KV all-gather). None auto-selects: ring when sp > 1, flash on
+    TPU when sp == 1, einsum otherwise.
+    """
+    cfg, mesh, platform, attention = _resolve(cfg, mesh, attention)
+    params, tokens, param_sh, batch_sh = _place(cfg, mesh, seed)
+    momentum = jax.device_put(
+        jax.tree.map(jnp.zeros_like, params), param_sh)
 
     step = partial(sgd_step, cfg=cfg, attention=attention,
                    interpret=platform != "tpu", mesh=mesh)
-    pspecs = param_specs(cfg)
-    param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
-                            is_leaf=lambda x: isinstance(x, P))
-    batch_sh = NamedSharding(mesh, P("dp", "sp"))
-    params = jax.device_put(params, param_sh)
-    momentum = jax.device_put(momentum, param_sh)
-    tokens = jax.device_put(tokens, batch_sh)
     jitted = jax.jit(
         step,
         in_shardings=(param_sh, param_sh, batch_sh),
@@ -273,3 +269,40 @@ def build_workload(
         donate_argnums=(0, 1),
     )
     return jitted, params, momentum, tokens
+
+
+def _place(cfg: ModelConfig, mesh: Mesh, seed: int):
+    """Init + device-place params and a token batch per the mesh shardings."""
+    params = init_params(jax.random.key(seed), cfg)
+    tokens = jax.random.randint(
+        jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
+        dtype=jnp.int32)
+    pspecs = param_specs(cfg)
+    param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
+                            is_leaf=lambda x: isinstance(x, P))
+    batch_sh = NamedSharding(mesh, P("dp", "sp"))
+    return (jax.device_put(params, param_sh),
+            jax.device_put(tokens, batch_sh), param_sh, batch_sh)
+
+
+def build_infer(
+    cfg: Optional[ModelConfig] = None,
+    mesh: Optional[Mesh] = None,
+    seed: int = 0,
+    attention: Optional[str] = None,
+):
+    """Serving-path build: a jitted forward over the same sharded model.
+
+    Returns (jitted forward -> logits, params, tokens). Same mesh/attention
+    selection as `build_workload`; no optimizer state, no donation, so the
+    caller can invoke it repeatedly for latency percentiles.
+    """
+    cfg, mesh, platform, attention = _resolve(cfg, mesh, attention)
+    params, tokens, param_sh, batch_sh = _place(cfg, mesh, seed)
+    interpret = platform != "tpu"
+    jitted = jax.jit(
+        lambda p, t: forward(p, t, cfg, attention, interpret, mesh),
+        in_shardings=(param_sh, batch_sh),
+        out_shardings=NamedSharding(mesh, P("dp", "sp", None)),
+    )
+    return jitted, params, tokens
