@@ -128,3 +128,51 @@ def test_infer_matches_workload_forward():
     # bf16 matmuls: jit fusion order vs eager differs in the last few ulps,
     # which is ~3e-2 at these logit magnitudes
     assert float(jnp.max(jnp.abs(logits - ref))) < 1e-1
+
+
+def test_attn_bench_cpu_small():
+    """attn-bench sweep runs end-to-end in interpret mode on CPU."""
+    from tpu_device_plugin.validator.attn_bench import bench_attention
+    result = bench_attention(seq_lens=(64,), blocks=((32, 32), (64, 64)),
+                             hb=2, head_dim=32, iters=2)
+    assert result["platform"] == "cpu" and result["interpret"] is True
+    assert len(result["cells"]) == 2
+    for cell in result["cells"]:
+        assert cell["error"] == ""
+        assert cell["flash_fwd_ms"] > 0 and cell["einsum_train_ms"] > 0
+
+
+def test_attn_bench_cli_json_line(capsys):
+    from tpu_device_plugin.validator.probe import main
+    rc = main(["--mode", "attn-bench", "--seqs", "64", "--blocks", "32x32",
+               "--steps", "2"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    import json as json_mod
+    payload = json_mod.loads(out)
+    assert rc == 0 and payload["ok"] is True
+    assert payload["cells"][0]["seq"] == 64
+
+
+def test_attn_bench_partial_failure_keeps_cells(monkeypatch):
+    """An einsum OOM at one seq must not discard other seqs' cells, and
+    errored timings must serialize as JSON null, never NaN."""
+    import json as json_mod
+    from tpu_device_plugin.validator import attn_bench
+
+    real_time_fn = attn_bench._time_fn
+
+    def flaky(fn, args, iters):
+        if args[0].shape[1] == 128:  # the big seq "OOMs"
+            raise MemoryError("RESOURCE_EXHAUSTED")
+        return real_time_fn(fn, args, iters)
+
+    monkeypatch.setattr(attn_bench, "_time_fn", flaky)
+    result = attn_bench.bench_attention(
+        seq_lens=(64, 128), blocks=((32, 32),), hb=2, head_dim=32, iters=1)
+    assert len(result["cells"]) == 2
+    good, bad = result["cells"]
+    assert good["error"] == "" and good["flash_fwd_ms"] > 0
+    assert "MemoryError" in bad["error"]
+    text = json_mod.dumps(result)
+    assert "NaN" not in text
+    assert json_mod.loads(text)["cells"][1]["flash_fwd_ms"] is None

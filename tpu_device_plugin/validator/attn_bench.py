@@ -1,0 +1,137 @@
+"""Flash-vs-einsum attention benchmark (single device, one process claim).
+
+The build environment's TPU tunnel grants one exclusive claim per process
+and has historically been flaky, so this packs the whole kernel-tuning
+protocol — forward and train timings for the Pallas flash kernel against
+the einsum reference across sequence lengths and block sizes — into one
+command:
+
+    python -m tpu_device_plugin.validator --mode attn-bench \
+        --seqs 1024,2048,4096 --blocks 128x128,256x128
+
+Emits one JSON line per (seq, block) cell plus a winner summary, feeding
+BASELINE.md and the flash block-size tuning loop (roadmap item 2).
+On CPU the kernel runs in interpret mode (slow): keep seqs small there.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _time_fn(fn, args, iters: int) -> float:
+    """Median wall-clock seconds per call, after one warmup/compile call."""
+    import jax
+    jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.monotonic() - t0)
+    return _median(samples)
+
+
+def bench_attention(
+    seq_lens: Sequence[int] = (1024, 2048, 4096),
+    blocks: Sequence[Tuple[int, int]] = ((128, 128),),
+    hb: int = 8,
+    head_dim: int = 128,
+    iters: int = 10,
+    causal: bool = True,
+    device=None,
+    interpret: Optional[bool] = None,
+) -> dict:
+    """Compare Pallas flash vs einsum reference on one device.
+
+    Returns {"cells": [...], "flash_wins_at": [...], "device_kind": ...}.
+    Each cell: seq, block_q, block_k, flash/einsum forward + train (ms) and
+    speedups (>1 means flash is faster).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .flash_attention import _reference_attention, flash_attention
+
+    if device is None:
+        # local: in a multi-VMI slice jax.devices() spans other guests'
+        # non-addressable devices (same trap probe._microbench documents)
+        device = jax.local_devices()[0]
+    if interpret is None:
+        interpret = device.platform != "tpu"
+    iters = max(iters, 1)  # _median needs >=1 sample
+
+    def rand(shape, seed):
+        x = jax.random.normal(jax.random.key(seed), shape, jnp.float32)
+        return jax.device_put(x.astype(jnp.bfloat16), device)
+
+    sm = head_dim ** -0.5
+    cells = []
+    for seq in seq_lens:
+        q, k, v = (rand((hb, seq, head_dim), i) for i in (1, 2, 3))
+        ein_fwd = jax.jit(
+            lambda q, k, v: _reference_attention(q, k, v, sm, causal))
+        ein_train = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                _reference_attention(q, k, v, sm, causal)
+                .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+        try:
+            ein_fwd_s = _time_fn(ein_fwd, (q, k, v), iters)
+            ein_train_s = _time_fn(ein_train, (q, k, v), iters)
+            ein_err = ""
+        except Exception as exc:
+            # the einsum reference materializes the (S, S) matrix and can
+            # OOM at lengths flash handles fine — keep sweeping
+            ein_fwd_s = ein_train_s = None
+            ein_err = f"einsum: {type(exc).__name__}: {exc}"
+        for bq, bk in blocks:
+            fl_fwd = jax.jit(
+                lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                    q, k, v, None, causal, bq, bk, interpret))
+            fl_train = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                    flash_attention(q, k, v, None, causal, bq, bk, interpret)
+                    .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+            try:
+                fl_fwd_s = _time_fn(fl_fwd, (q, k, v), iters)
+                fl_train_s = _time_fn(fl_train, (q, k, v), iters)
+                err = ein_err
+            except Exception as exc:  # report the cell, keep sweeping
+                fl_fwd_s = fl_train_s = None  # None -> JSON null, never NaN
+                err = "; ".join(
+                    x for x in (ein_err,
+                                f"flash: {type(exc).__name__}: {exc}") if x)
+
+            def ms(s):
+                return None if s is None else s * 1e3
+
+            def speedup(ref_s, new_s):
+                return (ref_s / new_s
+                        if ref_s is not None and new_s else None)
+
+            cells.append({
+                "seq": seq, "block_q": bq, "block_k": bk,
+                "flash_fwd_ms": ms(fl_fwd_s),
+                "einsum_fwd_ms": ms(ein_fwd_s),
+                "flash_train_ms": ms(fl_train_s),
+                "einsum_train_ms": ms(ein_train_s),
+                "fwd_speedup": speedup(ein_fwd_s, fl_fwd_s),
+                "train_speedup": speedup(ein_train_s, fl_train_s),
+                "error": err,
+            })
+    wins = sorted({c["seq"] for c in cells
+                   if c["error"] == "" and (c["fwd_speedup"] or 0) > 1.0})
+    return {
+        "device_kind": device.device_kind,
+        "platform": device.platform,
+        "interpret": interpret,
+        "hb": hb,
+        "head_dim": head_dim,
+        "cells": cells,
+        "flash_wins_at": wins,
+    }

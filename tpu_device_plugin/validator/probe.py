@@ -185,10 +185,17 @@ def main(argv=None) -> int:
         prog="tpu-slice-validator",
         description="Validate a passed-through TPU slice from inside the guest.")
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--mode", choices=["train", "infer"], default="train",
+    parser.add_argument("--mode", choices=["train", "infer", "attn-bench"],
+                        default="train",
                         help="train = full step burn-in (loss must decrease); "
                              "infer = forward-only serving latency "
-                             "percentiles (p50/p99, tokens/s)")
+                             "percentiles (p50/p99, tokens/s); attn-bench = "
+                             "flash-vs-einsum kernel sweep on one device")
+    parser.add_argument("--seqs", default="1024,2048,4096",
+                        help="attn-bench sequence lengths, comma-separated")
+    parser.add_argument("--blocks", default="128x128",
+                        help="attn-bench flash block sizes, e.g. "
+                             "'128x128,256x128,128x256'")
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=None)
     parser.add_argument("--seq-len", type=int, default=None)
@@ -226,6 +233,24 @@ def main(argv=None) -> int:
                 ok=False, error=f"distributed init: {type(exc).__name__}: {exc}")
             print(report.to_json())
             return 1
+    if args.mode == "attn-bench":
+        from .attn_bench import bench_attention
+        try:
+            result = bench_attention(
+                seq_lens=tuple(int(s) for s in args.seqs.split(",") if s),
+                blocks=tuple(
+                    tuple(int(x) for x in b.split("x"))
+                    for b in args.blocks.split(",") if b),
+                iters=args.steps,
+            )
+        except Exception as exc:  # same report-don't-crash contract
+            print(json.dumps({"ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"}))
+            return 1
+        ok = bool(result["cells"]) and all(
+            not c["error"] for c in result["cells"])
+        print(json.dumps({"ok": ok, **result}, sort_keys=True))
+        return 0 if ok else 1
     cfg = None
     if args.seq_len is not None:
         from .workload import ModelConfig
