@@ -2,9 +2,14 @@
 
 Axes follow the scaling-book decomposition: `dp` (pure data parallel,
 gradient all-reduce), `tp` (tensor parallel, activation collectives on the
-fastest links), `sp` (sequence parallel for long context). On a passed-
-through slice all three ride ICI; the mesh construction puts `tp` innermost
-so its collectives land on nearest-neighbor links.
+fastest links), `sp` (sequence parallel for long context), plus two optional
+axes: `pp` (pipeline stages — layer-stacked weights sharded over it) and
+`ep` (expert parallel — MoE expert weights and dispatched tokens sharded
+over it). On a passed-through slice all of them ride ICI; the mesh
+construction puts `tp` innermost so its collectives land on
+nearest-neighbor links, and `pp` outermost (stage boundaries cross the
+least-frequent traffic). `pp`/`ep` axes only appear in the mesh when their
+size exceeds 1, so the common 3-axis shape is unchanged.
 """
 
 from __future__ import annotations
@@ -38,10 +43,24 @@ def infer_mesh_shape(n_devices: int,
 
 def slice_mesh(devices: Optional[Sequence[jax.Device]] = None,
                tp: Optional[int] = None,
-               sp: Optional[int] = None) -> Mesh:
-    """Build a ("dp", "sp", "tp") mesh over the visible slice."""
+               sp: Optional[int] = None,
+               pp: Optional[int] = None,
+               ep: Optional[int] = None) -> Mesh:
+    """Build a mesh over the visible slice.
+
+    Axis order (outermost→innermost): pp, dp, sp, ep, tp — pp/ep included
+    only when > 1, so the default is the 3-axis ("dp", "sp", "tp") mesh.
+    """
     if devices is None:
         devices = jax.devices()
-    dp, sp_, tp_ = infer_mesh_shape(len(devices), tp=tp, sp=sp)
-    grid = np.array(devices).reshape(dp, sp_, tp_)
-    return Mesh(grid, axis_names=("dp", "sp", "tp"))
+    pp = pp or 1
+    ep = ep or 1
+    n = len(devices)
+    if n % (pp * ep) != 0:
+        raise ValueError(f"{n} devices not divisible by pp={pp} * ep={ep}")
+    dp, sp_, tp_ = infer_mesh_shape(n // (pp * ep), tp=tp, sp=sp)
+    dims = [("pp", pp), ("dp", dp), ("sp", sp_), ("ep", ep), ("tp", tp_)]
+    dims = [(name, size) for name, size in dims
+            if size > 1 or name in ("dp", "sp", "tp")]
+    grid = np.array(devices).reshape([size for _, size in dims])
+    return Mesh(grid, axis_names=tuple(name for name, _ in dims))

@@ -94,6 +94,8 @@ def validate_slice(
     steps: int = 20,
     tp: Optional[int] = None,
     sp: Optional[int] = None,
+    pp: Optional[int] = None,
+    ep: Optional[int] = None,
     devices=None,
     attention: Optional[str] = None,
     mode: str = "train",
@@ -111,7 +113,8 @@ def validate_slice(
         from .mesh import slice_mesh
         from .workload import ModelConfig, build_infer, build_workload
         cfg = cfg or ModelConfig()
-        mesh = slice_mesh(devices, tp=tp, sp=sp) if len(devices) > 1 else None
+        mesh = (slice_mesh(devices, tp=tp, sp=sp, pp=pp, ep=ep)
+                if len(devices) > 1 else None)
         if mesh is not None:
             report.mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
 
@@ -198,6 +201,14 @@ def main(argv=None) -> int:
                              "'128x128,256x128,128x256'")
     parser.add_argument("--tp", type=int, default=None)
     parser.add_argument("--sp", type=int, default=None)
+    parser.add_argument("--pp", type=int, default=None,
+                        help="pipeline stages (layer-stacked weights sharded "
+                             "over a pp mesh axis; n_layers % pp must be 0)")
+    parser.add_argument("--ep", type=int, default=None,
+                        help="expert-parallel size (use with --experts)")
+    parser.add_argument("--experts", type=int, default=None,
+                        help="replace the MLP with a top-1 switch MoE of "
+                             "this many experts")
     parser.add_argument("--seq-len", type=int, default=None)
     parser.add_argument("--attention",
                         choices=["auto", "flash", "ring", "einsum"],
@@ -252,11 +263,31 @@ def main(argv=None) -> int:
         print(json.dumps({"ok": ok, **result}, sort_keys=True))
         return 0 if ok else 1
     cfg = None
-    if args.seq_len is not None:
+    if args.seq_len is not None or args.experts is not None:
         from .workload import ModelConfig
-        cfg = ModelConfig(seq_len=args.seq_len)
+        overrides = {}
+        if args.seq_len is not None:
+            overrides["seq_len"] = args.seq_len
+        if args.experts is not None:
+            overrides["n_experts"] = args.experts
+        cfg = ModelConfig(**overrides)
+    # Validate pp/ep against the model BEFORE touching devices: a sharding
+    # divisibility error inside validate_slice would be reported as a broken
+    # slice, which is exactly what this probe must not false-alarm on.
+    from .workload import ModelConfig as _MC
+    base = cfg or _MC()
+    if args.pp and args.pp > 1 and base.n_layers % args.pp:
+        parser.error(f"--pp {args.pp} does not divide n_layers={base.n_layers}")
+    if args.ep and args.ep > 1:
+        if not base.n_experts:
+            parser.error(f"--ep {args.ep} needs --experts (dense model has "
+                         "no expert dimension to shard)")
+        if base.n_experts % args.ep:
+            parser.error(f"--ep {args.ep} does not divide "
+                         f"--experts {base.n_experts}")
     attention = None if args.attention == "auto" else args.attention
     report = validate_slice(cfg=cfg, steps=args.steps, tp=args.tp, sp=args.sp,
+                            pp=args.pp, ep=args.ep,
                             attention=attention, mode=args.mode)
     print(report.to_json())
     return 0 if report.ok else 1

@@ -43,45 +43,78 @@ class ModelConfig:
     batch: int = 8
     lr: float = 1e-2
     momentum: float = 0.9
+    # Mixture-of-experts: 0 = dense MLP; >0 replaces the MLP with a top-1
+    # switch layer of n_experts experts (weights shardable over "ep").
+    n_experts: int = 0
+    capacity_factor: float = 1.25
 
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
-    keys = jax.random.split(key, 2 + cfg.n_layers)
+    """Layer weights are STACKED on a leading n_layers dim and consumed by
+    `lax.scan` in the forward — one traced layer body regardless of depth,
+    and the stacked dim is what "pp" shards (stage-partitioned weights)."""
+    keys = jax.random.split(key, 10)
     scale = cfg.d_model ** -0.5
+    L, d, ff, E = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.n_experts
 
     def dense(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * scale)
 
-    layers = []
-    for i in range(cfg.n_layers):
-        lk = jax.random.split(keys[2 + i], 6)
-        layers.append({
-            "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
-            "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
-            "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
-            "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
-            "w1": dense(lk[4], (cfg.d_model, cfg.d_ff)),
-            "w2": dense(lk[5], (cfg.d_ff, cfg.d_model)),
-        })
+    layers = {
+        "wq": dense(keys[2], (L, d, d)),
+        "wk": dense(keys[3], (L, d, d)),
+        "wv": dense(keys[4], (L, d, d)),
+        "wo": dense(keys[5], (L, d, d)),
+    }
+    if E:
+        layers["wr"] = dense(keys[6], (L, d, E))
+        layers["w1e"] = dense(keys[7], (L, E, d, ff))
+        layers["w2e"] = dense(keys[8], (L, E, ff, d))
+    else:
+        layers["w1"] = dense(keys[6], (L, d, ff))
+        layers["w2"] = dense(keys[7], (L, ff, d))
     return {
-        "embed": dense(keys[0], (cfg.vocab, cfg.d_model)),
-        "unembed": dense(keys[1], (cfg.d_model, cfg.vocab)),
+        "embed": dense(keys[0], (cfg.vocab, d)),
+        "unembed": dense(keys[1], (d, cfg.vocab)),
         "layers": layers,
     }
 
 
 def param_specs(cfg: ModelConfig) -> Params:
-    """PartitionSpecs: tensor-parallel over heads/ffn, replicated over dp/sp."""
-    layer = {
-        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
-        "wo": P("tp", None),
-        "w1": P(None, "tp"), "w2": P("tp", None),
+    """PartitionSpecs: "pp" on the stacked layer dim, "tp" over heads/ffn,
+    "ep" over experts; replicated over dp/sp. Axes absent from the actual
+    mesh are filtered out at sharding-build time (`_filter_spec`)."""
+    layers = {
+        "wq": P("pp", None, "tp"), "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"), "wo": P("pp", "tp", None),
     }
+    if cfg.n_experts:
+        layers["wr"] = P("pp", None, None)
+        layers["w1e"] = P("pp", "ep", None, "tp")
+        layers["w2e"] = P("pp", "ep", "tp", None)
+    else:
+        layers["w1"] = P("pp", None, "tp")
+        layers["w2"] = P("pp", "tp", None)
     return {
         "embed": P(None, "tp"),
         "unembed": P("tp", None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
     }
+
+
+def _filter_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axes the mesh doesn't have (pp/ep are optional mesh axes)."""
+    names = set(mesh.axis_names)
+
+    def keep(a):
+        if a is None:
+            return None
+        if isinstance(a, (tuple, list)):
+            kept = tuple(x for x in a if x in names)
+            return kept if kept else None
+        return a if a in names else None
+
+    return P(*[keep(a) for a in spec])
 
 
 def _constrain(x: jax.Array, spec: P, mesh: Optional[Mesh]) -> jax.Array:
@@ -92,7 +125,8 @@ def _constrain(x: jax.Array, spec: P, mesh: Optional[Mesh]) -> jax.Array:
     """
     if mesh is None:
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, _filter_spec(spec, mesh)))
 
 
 def _fold_heads(t: jax.Array):
@@ -174,6 +208,48 @@ def _mlp(x: jax.Array, layer: Params) -> jax.Array:
     return hidden @ layer["w2"].astype(jnp.bfloat16)
 
 
+def _moe(x: jax.Array, layer: Params, cfg: ModelConfig,
+         mesh: Optional[Mesh]) -> jax.Array:
+    """Top-1 switch MoE, expert-parallel over the "ep" mesh axis.
+
+    Static shapes throughout (capacity-based dispatch): tokens route to
+    their argmax expert via one-hot dispatch/combine einsums, so XLA sees
+    three batched matmuls and inserts the token all-to-alls implied by the
+    (tokens dp/sp-sharded) → (experts ep-sharded) resharding. Tokens over
+    an expert's capacity are dropped (standard switch behavior, fine for a
+    burn-in; no load-balancing aux loss).
+    """
+    import math
+    b, s, d = x.shape
+    t = b * s
+    e = cfg.n_experts
+    # per-expert capacity, padded to a lane-friendly multiple of 8
+    cap = min(t, max(8, math.ceil(math.ceil(t * cfg.capacity_factor / e) / 8) * 8))
+    xt = x.reshape(t, d)
+    logits = (xt @ layer["wr"].astype(jnp.bfloat16)).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)                    # (t, e)
+    top1 = jnp.argmax(gates, axis=-1)                          # (t,)
+    onehot = jax.nn.one_hot(top1, e, dtype=jnp.float32)        # (t, e)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot                  # (t, e), 1-based
+    within = (pos > 0) & (pos <= cap)
+    dispatch = jax.nn.one_hot(
+        (pos - 1).astype(jnp.int32), cap, dtype=jnp.float32) \
+        * within[..., None]                                    # (t, e, cap)
+    combine = dispatch * (jnp.sum(gates * onehot, axis=-1)[:, None, None])
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(jnp.bfloat16), xt)
+    expert_in = _constrain(expert_in, P("ep", None, None), mesh)
+    hidden = jax.nn.gelu(jnp.einsum(
+        "ecd,edf->ecf", expert_in, layer["w1e"].astype(jnp.bfloat16)))
+    hidden = _constrain(hidden, P("ep", None, "tp"), mesh)
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", hidden, layer["w2e"].astype(jnp.bfloat16))
+    expert_out = _constrain(expert_out, P("ep", None, None), mesh)
+    out = jnp.einsum("tec,ecd->td", combine.astype(jnp.bfloat16), expert_out)
+    return out.reshape(b, s, d)
+
+
 def _rms_norm(x: jax.Array) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
@@ -184,10 +260,20 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             mesh: Optional[Mesh] = None) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = _constrain(x, P("dp", "sp", None), mesh)
-    for layer in params["layers"]:
+
+    def body(x, layer):
         x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret, mesh)
-        x = x + _mlp(_rms_norm(x), layer)
+        if cfg.n_experts:
+            x = x + _moe(_rms_norm(x), layer, cfg, mesh)
+        else:
+            x = x + _mlp(_rms_norm(x), layer)
         x = _constrain(x, P("dp", "sp", None), mesh)
+        return x, None
+
+    # scan over the stacked layer dim: one traced body for any depth; with a
+    # "pp" mesh axis the stacked weights are stage-sharded and activations
+    # flow across stage boundaries between scan steps
+    x, _ = jax.lax.scan(body, x, params["layers"])
     logits = _rms_norm(x) @ params["unembed"].astype(jnp.bfloat16)
     return logits.astype(jnp.float32)
 
@@ -278,8 +364,9 @@ def _place(cfg: ModelConfig, mesh: Mesh, seed: int):
         jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
         dtype=jnp.int32)
     pspecs = param_specs(cfg)
-    param_sh = jax.tree.map(lambda spec: NamedSharding(mesh, spec), pspecs,
-                            is_leaf=lambda x: isinstance(x, P))
+    param_sh = jax.tree.map(
+        lambda spec: NamedSharding(mesh, _filter_spec(spec, mesh)), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
     batch_sh = NamedSharding(mesh, P("dp", "sp"))
     return (jax.device_put(params, param_sh),
             jax.device_put(tokens, batch_sh), param_sh, batch_sh)
