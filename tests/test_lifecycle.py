@@ -137,3 +137,20 @@ def test_rediscovery_restarts_on_inventory_change(kubelet):
     finally:
         stop.set()
         t.join(timeout=10)
+
+
+def test_discover_only_dumps_inventory(tmp_path, capsys):
+    """--discover-only prints the inventory JSON and exits without touching
+    the kubelet (no socket exists and nothing fails)."""
+    import json
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    from tpu_device_plugin.cli import main
+    rc = main(["--root", str(tmp_path), "--discover-only"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["devices"]["0062"][0]["bdf"] == "0000:00:04.0"
+    assert payload["partitions"]["TPU_vhalf"][0]["uuid"] == "uuid-1"
+    assert payload["iommu_groups"]["11"] == ["0000:00:04.0"]
+    assert payload["node_facts"]["cloud-tpus.google.com/v4.chips"] == "1"

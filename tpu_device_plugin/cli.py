@@ -73,6 +73,9 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="bind address for the status endpoint (the "
                              "default serves kubelet httpGet probes on the "
                              "pod IP)")
+    parser.add_argument("--discover-only", action="store_true",
+                        help="run discovery once, print the inventory as "
+                             "JSON, and exit (ops/debug; no kubelet contact)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
@@ -104,8 +107,35 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     return cfg, args
 
 
+def dump_inventory(cfg) -> str:
+    """One-shot discovery → JSON (the --discover-only surface)."""
+    import dataclasses
+    import json
+
+    from .discovery import discover
+    from .labeler import node_facts
+
+    registry, generations = discover(cfg)
+    return json.dumps({
+        "devices": {
+            model: [dataclasses.asdict(d) for d in devs]
+            for model, devs in registry.devices_by_model.items()
+        },
+        "partitions": {
+            t: [dataclasses.asdict(p) for p in ps]
+            for t, ps in registry.partitions_by_type.items()
+        },
+        "iommu_groups": {g: [d.bdf for d in ds]
+                         for g, ds in registry.iommu_map.items()},
+        "node_facts": node_facts(cfg, registry, generations),
+    }, indent=2, sort_keys=True)
+
+
 def main(argv=None) -> int:
     cfg, args = build_config(argv)
+    if args.discover_only:
+        print(dump_inventory(cfg))
+        return 0
     stop = threading.Event()
 
     def handle(signum, frame):
