@@ -136,3 +136,21 @@ def test_node_failure_isolated(two_nodes):
     # node 1 was actually observed, and saw no unhealthy transition at all
     assert updates1, "node 1 stream produced no updates"
     assert all(set(u.values()) == {"Healthy"} for u in updates1)
+
+
+def test_each_node_publishes_distinct_facts(short_root):
+    """Config-5 flow: each node's labeler facts reflect ITS local inventory,
+    so label-driven VMI placement can distinguish hosts."""
+    from tpu_device_plugin.discovery import discover
+    from tpu_device_plugin.labeler import node_facts
+    a = Node(os.path.join(short_root, "na"), n_chips=4)
+    b = Node(os.path.join(short_root, "nb"), n_chips=2)
+    reg_a, gens_a = discover(a.cfg)
+    reg_b, gens_b = discover(b.cfg)
+    fa = node_facts(a.cfg, reg_a, gens_a)
+    fb = node_facts(b.cfg, reg_b, gens_b)
+    a.kubelet.stop()
+    b.kubelet.stop()
+    assert fa["cloud-tpus.google.com/v5p.chips"] == "4"
+    assert fb["cloud-tpus.google.com/v5p.chips"] == "2"
+    assert fa["cloud-tpus.google.com/v5p.torus"] == "2x2x1"

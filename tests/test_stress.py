@@ -138,3 +138,74 @@ def test_restart_storm(rig):
     with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
         opts = api.DevicePluginStub(ch).GetDevicePluginOptions(pb.Empty(), timeout=5)
         assert opts.get_preferred_allocation_available is True
+
+
+def test_vtpu_parallel_rpcs_under_partition_churn(short_root):
+    """vTPU plugin under the same pressure: concurrent Allocate/Preferred
+    RPCs while mdev partitions' sysfs entries churn. No deadlock, every
+    response either succeeds or fails INVALID_ARGUMENT (never UNKNOWN)."""
+    from tpu_device_plugin.discovery import discover
+    from tpu_device_plugin.vtpu import VtpuDevicePlugin
+
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12"))
+    for i in range(4):
+        host.add_mdev(f"uuid-{i}", "TPU vhalf",
+                      f"0000:00:{4 + i % 2:02x}.0", iommu_group=str(21 + i))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    registry, _ = discover(cfg)
+    plugin = VtpuDevicePlugin(cfg, "TPU_vhalf", registry,
+                              registry.partitions_by_type["TPU_vhalf"])
+    plugin.start()
+    stop = threading.Event()
+    errors = []
+    uuids = [f"uuid-{i}" for i in range(4)]
+
+    def rpc_worker(seed):
+        rng = random.Random(seed)
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            while not stop.is_set():
+                try:
+                    picked = rng.sample(uuids, rng.choice([1, 2]))
+                    stub.Allocate(
+                        pb.AllocateRequest(container_requests=[
+                            pb.ContainerAllocateRequest(devices_ids=picked)]),
+                        timeout=5)
+                except grpc.RpcError as exc:
+                    if exc.code() != grpc.StatusCode.INVALID_ARGUMENT:
+                        errors.append(exc)
+
+    def churn_worker():
+        rng = random.Random(99)
+        while not stop.is_set():
+            uuid = rng.choice(uuids)
+            name = os.path.join(host.pci, f"0000:00:{4 + int(uuid[-1]) % 2:02x}.0",
+                                uuid, "mdev_type", "name")
+            try:
+                with open(name, "w") as f:
+                    f.write(rng.choice(["TPU vhalf\n", "TPU vother\n"]))
+            except OSError:
+                pass
+            time.sleep(0.002)
+
+    workers = [threading.Thread(target=rpc_worker, args=(i,), daemon=True)
+               for i in range(4)]
+    workers.append(threading.Thread(target=churn_worker, daemon=True))
+    try:
+        for w in workers:
+            w.start()
+        time.sleep(3)
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=5)
+        plugin.stop()
+        kubelet.stop()
+    assert not any(w.is_alive() for w in workers), "worker deadlocked"
+    assert not errors, errors[:3]
+    # terminal state clean: socket removed
+    assert not os.path.exists(plugin.socket_path)
