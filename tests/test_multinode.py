@@ -143,14 +143,63 @@ def test_each_node_publishes_distinct_facts(short_root):
     so label-driven VMI placement can distinguish hosts."""
     from tpu_device_plugin.discovery import discover
     from tpu_device_plugin.labeler import node_facts
-    a = Node(os.path.join(short_root, "na"), n_chips=4)
-    b = Node(os.path.join(short_root, "nb"), n_chips=2)
-    reg_a, gens_a = discover(a.cfg)
-    reg_b, gens_b = discover(b.cfg)
-    fa = node_facts(a.cfg, reg_a, gens_a)
-    fb = node_facts(b.cfg, reg_b, gens_b)
-    a.kubelet.stop()
-    b.kubelet.stop()
+    facts = []
+    for name, n_chips in (("na", 4), ("nb", 2)):
+        host = FakeHost(os.path.join(short_root, name))
+        for i in range(n_chips):
+            host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                                   device_id="0064", iommu_group=str(11 + i)))
+        cfg = Config().with_root(host.root)
+        registry, generations = discover(cfg)
+        facts.append(node_facts(cfg, registry, generations))
+    fa, fb = facts
     assert fa["cloud-tpus.google.com/v5p.chips"] == "4"
     assert fb["cloud-tpus.google.com/v5p.chips"] == "2"
     assert fa["cloud-tpus.google.com/v5p.torus"] == "2x2x1"
+
+
+def test_distributed_two_process_slice():
+    """The multi-VMI composition path for real: two OS processes rendezvous
+    via `validator --coordinator` (jax.distributed), each holding 2 local
+    CPU devices; the 4-device global slice must train with IDENTICAL losses
+    on both ranks (proof the gradient collectives actually crossed
+    processes)."""
+    import json
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    code = ("import jax; jax.config.update('jax_platforms','cpu'); "
+            "import sys; from tpu_device_plugin.validator.probe import main; "
+            "sys.exit(main(['--coordinator','127.0.0.1:%d',"
+            "'--num-processes','2','--process-id','%%d',"
+            "'--steps','2','--seq-len','32']))" % port)
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", code % rank],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank failed: {err[-800:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # never orphan a rank at the rendezvous barrier (a failed rank 0
+        # assert would otherwise leave rank 1 blocked with open pipes)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for report in outs:
+        assert report["ok"], report["error"]
+        assert report["n_devices"] == 4          # global slice, not local
+    assert outs[0]["loss_end"] == outs[1]["loss_end"]  # collectives synced
