@@ -6,8 +6,9 @@ device → 1 VMI: Allocate() RPC latency; devices advertised; plugin on CPU").
 This bench builds a fake 8-chip v5e host, serves a real plugin over a real
 unix-socket gRPC server, and measures the kubelet-visible critical path for
 a 4-chip ICI-adjacent allocation: GetPreferredAllocation + Allocate RPC
-round-trips. The reference publishes no numbers (SURVEY.md §6), so
-vs_baseline is 1.0 by definition against our own recorded protocol.
+round-trips. The reference publishes no numbers (SURVEY.md §6), so the
+baseline is this protocol's own recorded round-1 p50 (BENCH_r01.json):
+vs_baseline = round1_p50 / current_p50, >1.0 meaning faster than round 1.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -89,11 +90,15 @@ def main() -> int:
         server.stop(0)
 
         p50 = statistics.median(attach_us)
+        # The reference publishes no numbers (SURVEY §6); the recorded
+        # round-1 p50 of this same protocol is the baseline, so >1.0 means
+        # faster than round 1.
+        round1_p50_us = 820.3  # BENCH_r01.json
         result = {
             "metric": "vmi_attach_control_plane_p50",
             "value": round(p50, 1),
             "unit": "us",
-            "vs_baseline": 1.0,
+            "vs_baseline": round(round1_p50_us / p50, 3),
             "preferred_allocation_p50_us": round(statistics.median(pref_us), 1),
             "allocate_p50_us": round(p50 - statistics.median(pref_us), 1),
             "p99_us": round(statistics.quantiles(attach_us, n=100)[98], 1),

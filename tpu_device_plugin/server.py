@@ -77,6 +77,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
             for d in self.devices
         ]
+        self._allowed_bdfs = frozenset(d.bdf for d in self.devices)
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -330,8 +331,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         try:
             return allocate_mod.allocate_response(
                 self.cfg, self.registry, self.resource_suffix, request,
-                cdi_enabled=self.cdi_enabled,
-                allowed_bdfs=frozenset(d.bdf for d in self.devices))
+                cdi_enabled=self.cdi_enabled, allowed_bdfs=self._allowed_bdfs)
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
