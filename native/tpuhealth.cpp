@@ -72,6 +72,28 @@ int tpuhealth_probe_node(const char* dev_path) {
   return TPUHEALTH_OK;
 }
 
+// PCI status register (config offset 0x06), the passthrough analogue of
+// NVML's XID error events: parity/SERR/abort bits latch on bus errors even
+// while the chip is vfio-bound. Returns the raw 16-bit value (>= 0), or
+// -TPUHEALTH_MISSING / a negative error when unreadable. The caller decides
+// what to do with the bits — they can be sticky from boot-time probing, so
+// they are a diagnostic, not a liveness veto.
+int tpuhealth_pci_status(const char* config_path) {
+  int fd = open(config_path, O_RDONLY);
+  if (fd < 0) {
+    // TPUHEALTH_ERR is already negative; MISSING must be negated
+    return errno == ENOENT ? -TPUHEALTH_MISSING : TPUHEALTH_ERR;
+  }
+  uint8_t buf[2] = {0, 0};
+  ssize_t n = pread(fd, buf, sizeof(buf), 6);
+  close(fd);
+  if (n != static_cast<ssize_t>(sizeof(buf))) {
+    return TPUHEALTH_ERR;
+  }
+  return static_cast<int>(static_cast<uint16_t>(buf[0]) |
+                          (static_cast<uint16_t>(buf[1]) << 8));
+}
+
 // libtpu presence: dlopen + lazy symbol lookup, never initialization.
 // Returns 1 when libtpu.so is loadable and exports a known entry point,
 // 0 when absent. Handle is cached for the process lifetime.
@@ -90,6 +112,8 @@ int tpuhealth_libtpu_available(void) {
 }
 
 // ABI version tag so the Python side can detect stale .so builds.
-int tpuhealth_abi_version(void) { return 1; }
+// v2 added tpuhealth_pci_status; the Python loader accepts v1 shims and
+// falls back to its own reader for the missing symbol.
+int tpuhealth_abi_version(void) { return 2; }
 
 }  // extern "C"

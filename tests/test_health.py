@@ -288,3 +288,62 @@ def test_monitor_probe_receives_group_node_path(tmp_path):
         assert _wait(lambda: ("bdf0", str(node)) in seen)
     finally:
         mon.stop_event.set()
+
+
+def test_pci_status_register(shim, tmp_path):
+    """Offset-6 status read: clean register, latched error bits, unreadable."""
+    from tpu_device_plugin.native import PCI_STATUS_ERROR_MASK
+    cfgf = tmp_path / "config"
+    # 6 bytes header + status 0x0010 (cap list bit, no errors)
+    cfgf.write_bytes(bytes([0xE0, 0x1A, 0x00, 0x00, 0x06, 0x04, 0x10, 0x00]))
+    assert shim.pci_status(str(cfgf)) == 0x0010
+    bdf_dir = tmp_path / "devices" / "0000:00:04.0"
+    bdf_dir.mkdir(parents=True)
+    (bdf_dir / "config").write_bytes(
+        bytes([0xE0, 0x1A, 0, 0, 0, 0]) + (0x2010).to_bytes(2, "little"))
+    # received-master-abort (bit 13) is in the mask; cap-list bit is not
+    assert shim.chip_error_bits(str(tmp_path / "devices"),
+                                "0000:00:04.0") == 0x2000
+    assert 0x2000 & PCI_STATUS_ERROR_MASK
+    # unreadable/truncated -> None / 0 (never an exception)
+    assert shim.pci_status(str(tmp_path / "missing")) is None
+    (bdf_dir / "config").write_bytes(b"\x01\x02")
+    assert shim.chip_error_bits(str(tmp_path / "devices"), "0000:00:04.0") == 0
+
+
+def test_chip_alive_logs_error_bits_once(shim, tmp_path, caplog):
+    """Latched bus errors warn on change, never veto health."""
+    import logging
+    pci = tmp_path / "devices"
+    bdf_dir = pci / "0000:00:04.0"
+    bdf_dir.mkdir(parents=True)
+    (bdf_dir / "config").write_bytes(
+        bytes([0xE0, 0x1A, 0, 0, 0, 0]) + (0x4000).to_bytes(2, "little"))
+    with caplog.at_level(logging.WARNING):
+        assert shim.chip_alive(str(pci), "0000:00:04.0") is True
+        assert shim.chip_alive(str(pci), "0000:00:04.0") is True
+    warnings = [r for r in caplog.records if "error bits" in r.message]
+    assert len(warnings) == 1  # logged on change only
+    assert "0x4000" in warnings[0].message
+
+
+def test_pci_status_error_paths(shim, tmp_path):
+    """Unreadable/short/off-bus status reads never fabricate error bits."""
+    import os
+    # truncated at offset 6 -> native returns negative -> None
+    short = tmp_path / "short_config"
+    short.write_bytes(b"\x01\x02")
+    assert shim.pci_status(str(short)) is None
+    # all-FF (chip off the bus) -> status reads 0xFFFF -> bits suppressed
+    pci = tmp_path / "ffdev"
+    bdf = pci / "0000:00:04.0"
+    bdf.mkdir(parents=True)
+    (bdf / "config").write_bytes(b"\xff" * 8)
+    assert shim.pci_status(str(bdf / "config")) == 0xFFFF
+    assert shim.chip_error_bits(str(pci), "0000:00:04.0") == 0
+    # unreadable (permissions) -> None on the native path too
+    locked = tmp_path / "locked_config"
+    locked.write_bytes(b"\x00" * 8)
+    os.chmod(locked, 0)
+    if os.geteuid() != 0:  # root bypasses permissions
+        assert shim.pci_status(str(locked)) is None

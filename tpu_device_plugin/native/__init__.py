@@ -21,6 +21,12 @@ DEAD = 1
 MISSING = 2
 ERR = -1
 
+# PCI status-register error bits (config offset 0x06) — the passthrough
+# analogue of NVML XID events: master data parity error (8), signaled
+# target abort (11), received target/master abort (12/13), signaled system
+# error (14), detected parity error (15).
+PCI_STATUS_ERROR_MASK = 0xF900
+
 _SEARCH_PATHS = (
     os.path.join(os.path.dirname(__file__), "libtpuhealth.so"),
     os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -34,13 +40,15 @@ class TpuHealth:
 
     def __init__(self, lib_path: Optional[str] = None):
         self._lib = None
+        self._has_pci_status = False
+        self._err_logged: dict = {}  # bdf -> last-logged error bits
         candidates = (lib_path,) if lib_path else _SEARCH_PATHS
         for cand in candidates:
             if cand is None:
                 continue
             try:
                 lib = ctypes.CDLL(cand)
-                if lib.tpuhealth_abi_version() != 1:
+                if lib.tpuhealth_abi_version() not in (1, 2):
                     log.warning("libtpuhealth %s has unknown ABI; ignoring", cand)
                     continue
                 for fn in ("tpuhealth_probe_config", "tpuhealth_probe_node",
@@ -48,6 +56,13 @@ class TpuHealth:
                     getattr(lib, fn).restype = ctypes.c_int
                     if fn != "tpuhealth_libtpu_available":
                         getattr(lib, fn).argtypes = [ctypes.c_char_p]
+                # v2 symbol; a v1 shim just uses the Python reader for it
+                try:
+                    lib.tpuhealth_pci_status.restype = ctypes.c_int
+                    lib.tpuhealth_pci_status.argtypes = [ctypes.c_char_p]
+                    self._has_pci_status = True
+                except AttributeError:
+                    self._has_pci_status = False
                 self._lib = lib
                 log.info("loaded native libtpuhealth from %s", cand)
                 break
@@ -90,6 +105,34 @@ class TpuHealth:
             return bool(self._lib.tpuhealth_libtpu_available())
         return False
 
+    def pci_status(self, config_path: str) -> Optional[int]:
+        """Raw PCI status register (config offset 6), or None if unreadable."""
+        if self._lib is not None and self._has_pci_status:
+            value = self._lib.tpuhealth_pci_status(config_path.encode())
+            return None if value < 0 else value
+        try:
+            with open(config_path, "rb") as f:
+                f.seek(6)
+                data = f.read(2)
+        except OSError:
+            return None
+        if len(data) != 2:
+            return None
+        return data[0] | (data[1] << 8)
+
+    def chip_error_bits(self, pci_base_path: str, bdf: str) -> int:
+        """Latched PCI error bits for one chip (0 = clean/unreadable).
+
+        The XID-events analogue: parity/SERR/abort bits latch on bus errors
+        even while the chip is vfio-bound. Diagnostic, not a liveness veto —
+        the bits can be sticky from boot-time bus probing."""
+        status = self.pci_status(os.path.join(pci_base_path, bdf, "config"))
+        if status is None or status == 0xFFFF:
+            # all-FF is the no-response artifact of a chip off the bus
+            # (probe_config's DEAD case), not real latched error bits
+            return 0
+        return status & PCI_STATUS_ERROR_MASK
+
     def chip_alive(self, pci_base_path: str, bdf: str,
                    node_path: Optional[str] = None) -> bool:
         """Composite liveness for one chip (what HealthMonitor polls).
@@ -110,4 +153,13 @@ class TpuHealth:
             alive = status == OK
         if alive and node_path is not None:
             alive = self.probe_node(node_path) == OK
+        if alive:
+            # surface latched bus errors without vetoing; log on change only
+            bits = self.chip_error_bits(pci_base_path, bdf)
+            if bits != self._err_logged.get(bdf, 0):
+                self._err_logged[bdf] = bits
+                if bits:
+                    log.warning("chip %s: PCI status error bits 0x%04x "
+                                "latched (diagnostic, not vetoing health)",
+                                bdf, bits)
         return alive

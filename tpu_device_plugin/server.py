@@ -271,12 +271,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         """Public state snapshot for the status endpoint (/status)."""
         with self._cond:
             devices = {dev_id: d.health for dev_id, d in self._devs.items()}
+        # latched PCI bus-error bits (XID-events analogue): diagnostic only,
+        # read outside the lock — sysfs reads must never block RPC paths
+        errors = {}
+        for d in self.devices:
+            bits = self.health_shim.chip_error_bits(self.cfg.pci_base_path,
+                                                    d.bdf)
+            if bits:
+                errors[d.bdf] = f"0x{bits:04x}"
         return {
             "resource": self.resource_name,
             "socket": self.socket_path,
             "serving": self._serving,
             "restarts": self._restart_count,
             "devices": devices,
+            "pci_errors": errors,
         }
 
     @property
