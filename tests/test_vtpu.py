@@ -258,3 +258,35 @@ def test_vfio_backed_partition_sets_pci_resource_env(short_root, tmp_path):
                 "0000:00:04.0"
     finally:
         server.stop(0)
+
+
+def test_preferred_allocation_numa_tiebreak(short_root):
+    """Equal-occupancy parents: prefer the one on the must-include's NUMA
+    node (the reference stubs this RPC entirely)."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", numa_node=1))
+    host.add_chip(FakeChip("0000:00:06.0", iommu_group="13", numa_node=1))
+    for i, parent in enumerate(["0000:00:04.0", "0000:00:05.0", "0000:00:06.0"]):
+        host.add_mdev(f"uuid-{i}", "TPU vhalf", parent, iommu_group=str(21 + i))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    plugin = VtpuDevicePlugin(cfg, "TPU_vhalf", registry,
+                              registry.partitions_by_type["TPU_vhalf"])
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            # must-include uuid-1 (numa 1): the second pick should be uuid-2
+            # (the other numa-1 parent), not numa-0's uuid-0
+            resp = api.DevicePluginStub(ch).GetPreferredAllocation(
+                pb.PreferredAllocationRequest(container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=["uuid-0", "uuid-2"],
+                        must_include_deviceIDs=["uuid-1"],
+                        allocation_size=2)]),
+                timeout=5)
+            picked = list(resp.container_responses[0].deviceIDs)
+            assert picked == ["uuid-1", "uuid-2"]
+    finally:
+        server.stop(0)

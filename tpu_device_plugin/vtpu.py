@@ -197,7 +197,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         return resp
 
     def GetPreferredAllocation(self, request, context):
-        """Pack partitions onto the fewest parent chips (anti-fragmentation)."""
+        """Pack partitions onto the fewest parent chips (anti-fragmentation),
+        preferring parents on the NUMA node the allocation started on."""
         by_uuid = {p.uuid: p for p in self.partitions}
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
@@ -213,11 +214,23 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             buckets: Dict[str, List[str]] = {}
             for u in avail:
                 buckets.setdefault(by_uuid[u].parent_bdf, []).append(u)
-            # parents already pinned by must-include go first, then fullest-first
+            # parents already pinned by must-include go first, then
+            # fullest-first; NUMA locality to the anchor breaks ties (the
+            # reference stubs this RPC entirely for vGPUs)
             must_parents = [by_uuid[u].parent_bdf for u in must if u in by_uuid]
+            # anchor on the first KNOWN device, must-includes first (an
+            # unknown must uuid is skipped here like in must_parents above)
+            anchor = next((by_uuid[u].numa_node
+                           for u in (*must, *avail) if u in by_uuid), None)
+
+            def numa_of(parent: str) -> int:
+                uuids = buckets[parent]
+                return by_uuid[uuids[0]].numa_node
+
             order = sorted(
                 buckets.items(),
-                key=lambda kv: (kv[0] not in must_parents, -len(kv[1]), kv[0]))
+                key=lambda kv: (kv[0] not in must_parents, -len(kv[1]),
+                                numa_of(kv[0]) != anchor, kv[0]))
             chosen = list(must)
             for _, uuids in order:
                 for u in uuids:
