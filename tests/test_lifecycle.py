@@ -154,3 +154,95 @@ def test_discover_only_dumps_inventory(tmp_path, capsys):
     assert payload["partitions"]["TPU_vhalf"][0]["uuid"] == "uuid-1"
     assert payload["iommu_groups"]["11"] == ["0000:00:04.0"]
     assert payload["node_facts"]["cloud-tpus.google.com/v4.chips"] == "1"
+
+
+def test_incremental_rediscovery_spares_unchanged_resources(kubelet):
+    """Hotplugging a chip of model B must restart ONLY model B's plugin;
+    model A keeps serving with no re-registration (no advertisement blip)."""
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+    cfg = replace(cfg, rediscovery_interval_s=0.3)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(2)
+        plugin_a = next(p for p in manager.plugins
+                        if p.resource_suffix == "v4")
+        # hotplug a second v5e chip
+        host.add_chip(FakeChip("0000:01:01.0", device_id="0063",
+                               iommu_group="22"))
+        assert kub.wait_for(3, timeout=15)  # only v5e re-registers
+        time.sleep(0.5)  # a further tick must not churn anything
+        names = [r.resource_name for r in kub.registrations]
+        assert names.count("cloud-tpus.google.com/v4") == 1
+        assert names.count("cloud-tpus.google.com/v5e") == 2
+        # the v4 plugin OBJECT survived — same instance, still serving
+        assert any(p is plugin_a for p in manager.plugins)
+        assert plugin_a.serving
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_incremental_rediscovery_stops_removed_resource(kubelet):
+    """A vanished model's plugin is stopped (socket gone); others survive."""
+    import shutil
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+    cfg = replace(cfg, rediscovery_interval_s=0.3)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(2)
+        v4_sock = os.path.join(cfg.device_plugin_path, "tpukubevirt-v4.sock")
+        v5e_sock = os.path.join(cfg.device_plugin_path, "tpukubevirt-v5e.sock")
+        assert os.path.exists(v4_sock) and os.path.exists(v5e_sock)
+        # the v4 chip vanishes from sysfs
+        shutil.rmtree(os.path.join(host.pci, "0000:00:04.0"))
+        deadline = time.monotonic() + 10
+        while os.path.exists(v4_sock) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not os.path.exists(v4_sock), "removed resource still serving"
+        assert os.path.exists(v5e_sock)
+        # the socket vanishes inside stop() before _apply_inventory swaps
+        # the plugin list — poll rather than assert instantly
+        deadline = time.monotonic() + 5
+        while [p.resource_suffix for p in manager.plugins] != ["v5e"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert [p.resource_suffix for p in manager.plugins] == ["v5e"]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_shared_group_change_restarts_coupled_resource(kubelet):
+    """A chip of another model joining a group the v4 plugin allocates must
+    restart the v4 plugin too (its group expansion changed) — per-resource
+    signatures include full IOMMU group membership."""
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    cfg = replace(cfg, rediscovery_interval_s=0.3)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        # a v5e chip lands in the SAME iommu group (no ACS isolation)
+        host.add_chip(FakeChip("0000:01:00.0", device_id="0063",
+                               iommu_group="11"))
+        # BOTH plugins (re-)register: v4 restarted + v5e new
+        assert kub.wait_for(3, timeout=15)
+        names = [r.resource_name for r in kub.registrations]
+        assert names.count("cloud-tpus.google.com/v4") == 2
+        assert names.count("cloud-tpus.google.com/v5e") == 1
+    finally:
+        stop.set()
+        t.join(timeout=10)

@@ -42,6 +42,7 @@ class PluginManager:
         self.plugins: List[TpuDevicePlugin] = []
         self.pending: List[TpuDevicePlugin] = []
         self.registry: Optional[Registry] = None
+        self._sigs: dict = {}
         self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
         # Queried once at startup: whether the host can dlopen libtpu.so.
@@ -126,10 +127,89 @@ class PluginManager:
         if not self._inventory_published:
             self._next_publish_retry = time.monotonic() + 30.0
 
+    @staticmethod
+    def _plugin_key(plugin) -> tuple:
+        kind = "vtpu" if isinstance(plugin, VtpuDevicePlugin) else "pt"
+        return (kind, plugin.resource_suffix)
+
+    def _signatures(self, registry: Registry, generations) -> dict:
+        """Per-resource identity: a plugin only needs a restart when ITS
+        devices/partitions changed — including the FULL membership of every
+        IOMMU group it allocates (a chip of another model joining/leaving a
+        shared group changes this plugin's group expansion, so it must not
+        survive on a stale registry)."""
+        def group_members(groups):
+            return tuple(sorted(
+                (g, tuple(d.bdf for d in registry.iommu_map.get(g, ())))
+                for g in groups if g is not None))
+
+        sigs = {}
+        suffixes = set()
+        for model, devs in registry.devices_by_model.items():
+            suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
+            suffixes.add(suffix)
+            sigs[("pt", suffix)] = (
+                devs, group_members({d.iommu_group for d in devs}))
+        for type_name, parts in registry.partitions_by_type.items():
+            if type_name in suffixes:
+                continue  # collision: never built (see build_plugins)
+            parent_groups = tuple(sorted(
+                {(p.parent_bdf, registry.bdf_to_group.get(p.parent_bdf))
+                 for p in parts}))
+            sigs[("vtpu", type_name)] = (
+                parts, parent_groups,
+                group_members({g for _, g in parent_groups}))
+        return sigs
+
     def start(self, inventory=None) -> None:
+        inventory = inventory if inventory else discover(self.cfg)
+        self._sigs = self._signatures(*inventory)
         self.plugins = self.build_plugins(inventory)
         self.pending = list(self.plugins)
         self._try_start_pending()
+
+    def _apply_inventory(self, inventory) -> None:
+        """Incremental rediscovery: restart only resources whose signature
+        changed; unchanged plugins keep serving without an advertisement
+        blip (their registry snapshot stays valid for their own devices —
+        the whole-set restart the naive approach does would zero every
+        resource's allocatable count on any hotplug)."""
+        registry, generations = inventory
+        new_sigs = self._signatures(registry, generations)
+        if new_sigs == self._sigs:
+            return
+        # only a RUNNING plugin may survive on an unchanged signature; a
+        # pending one is torn down and rebuilt fresh so it is never lost
+        running_keys = {self._plugin_key(p) for p in self.plugins
+                        if p not in self.pending}
+        unchanged = {k for k, v in new_sigs.items()
+                     if self._sigs.get(k) == v and k in running_keys}
+        changed_keys = (set(new_sigs) | set(self._sigs)) - unchanged
+        log.info("host inventory changed; restarting %s",
+                 ", ".join("/".join(k) for k in sorted(changed_keys)))
+        survivors: List[TpuDevicePlugin] = []
+        casualties: List[TpuDevicePlugin] = list(self.pending)
+        for plugin in self.plugins:
+            if plugin in self.pending:
+                continue  # already a casualty; rebuilt below if still present
+            if self._plugin_key(plugin) in unchanged:
+                survivors.append(plugin)
+            else:
+                casualties.append(plugin)
+        for plugin in casualties:
+            try:
+                plugin.stop()
+            except Exception as exc:
+                log.error("plugin %s failed to stop cleanly: %s",
+                          plugin.resource_name, exc)
+        # full rebuild keeps CDI spec writing/pruning and fact publication
+        # correct for the complete resource set; only the fresh keys start
+        built = self.build_plugins(inventory)
+        fresh = [p for p in built if self._plugin_key(p) not in unchanged]
+        self.plugins = survivors + fresh
+        self.pending = list(fresh)
+        self._try_start_pending()
+        self._sigs = new_sigs
 
     def _try_start_pending(self) -> None:
         """Start plugins that are not serving yet; keep failures for retry.
@@ -156,18 +236,6 @@ class PluginManager:
                           plugin.resource_name, exc)
         self.plugins = []
         self.pending = []
-
-    def _inventory_changed(self, registry: Registry) -> bool:
-        old = self.registry
-        if old is None:
-            return True
-        return (
-            registry.bdf_to_group != old.bdf_to_group
-            or {t: tuple(p.uuid for p in ps)
-                for t, ps in registry.partitions_by_type.items()}
-            != {t: tuple(p.uuid for p in ps)
-                for t, ps in old.partitions_by_type.items()}
-        )
 
     def run(self, stop_event: threading.Event) -> None:
         """Start everything and block until `stop_event` (reference :166-175).
@@ -198,11 +266,7 @@ class PluginManager:
                 if next_rediscovery is not None \
                         and time.monotonic() >= next_rediscovery:
                     next_rediscovery = time.monotonic() + interval
-                    inventory = discover(self.cfg)  # one walk per interval
-                    if self._inventory_changed(inventory[0]):
-                        log.info("host inventory changed; restarting plugin set")
-                        self.stop()
-                        self.start(inventory)
+                    self._apply_inventory(discover(self.cfg))
         finally:
             self.running.clear()
             self.stop()
