@@ -1,0 +1,136 @@
+"""GPipe-style microbatched pipeline parallelism over the "pp" mesh axis.
+
+The workload's default pp regime stage-shards the stacked layer weights and
+lets XLA move data between scan steps. This module is the explicit-schedule
+alternative: inside `shard_map`, each pp rank holds ONLY its stage's layers
+(the stacked (L, ...) weights are sharded on L), and activations flow
+stage-to-stage with nearest-neighbor `ppermute` — the classic GPipe
+fill/drain schedule over `n_micro` microbatches, expressed as one
+`lax.scan` over schedule steps (static shapes, compiler-friendly, and
+differentiable: JAX transposes the ppermute schedule into the reverse-order
+backward sweep automatically).
+
+Scope: pipeline ranks run the dense per-stage computation locally, so the
+mesh's sp/tp axes must be 1 (dp composes freely — gradient psum over dp is
+inserted by shard_map's AD like in the non-pipelined path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .workload import ModelConfig, Params, _layer_body, _rms_norm
+
+
+def _stage_apply(x, layer_stack, cfg: ModelConfig):
+    """Run this rank's slice of the layer stack (same body as workload)."""
+    def body(x, layer):
+        return _layer_body(x, layer, cfg, "einsum", True, None), None
+    x, _ = jax.lax.scan(body, x, layer_stack)
+    return x
+
+
+def gpipe_loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
+                  mesh: Mesh, n_micro: int) -> jax.Array:
+    """Causal-LM loss computed with an explicit GPipe schedule.
+
+    `params` is the workload's stacked-layer tree; layers are sharded over
+    "pp" (each rank sees n_layers/pp of them), embed/unembed replicated,
+    tokens sharded over "dp". Loss is identical to `workload.loss_fn` up to
+    bf16 reduction order.
+    """
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if "pp" not in axis_sizes:
+        raise ValueError("gpipe path needs a 'pp' mesh axis "
+                         "(slice_mesh(..., pp=N) with N > 1)")
+    n_stages = axis_sizes["pp"]
+    if axis_sizes.get("sp", 1) != 1 or axis_sizes.get("tp", 1) != 1:
+        raise ValueError("gpipe path needs sp == tp == 1 (pp x dp mesh)")
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
+                         f"pp={n_stages}")
+
+    def body(layers, embed, unembed, tok):
+        stage = jax.lax.axis_index("pp")
+        last = n_stages - 1
+        b, s = tok.shape
+        if b % n_micro:
+            raise ValueError(f"local batch {b} not divisible by "
+                             f"n_micro={n_micro}")
+        mb = b // n_micro
+        micro = tok.reshape(n_micro, mb, s)
+        d = embed.shape[1]
+        outputs0 = jnp.zeros((n_micro, mb, s, d), jnp.bfloat16)
+        recv0 = jnp.zeros((mb, s, d), jnp.bfloat16)
+
+        def sched(carry, t):
+            recv, outputs = carry
+            # stage 0 feeds microbatch t into the pipe (clamped during drain)
+            feed = embed.astype(jnp.bfloat16)[
+                jnp.take(micro, jnp.clip(t, 0, n_micro - 1), axis=0)]
+            x_in = jnp.where(stage == 0, feed, recv)
+            y = _stage_apply(x_in, layers, cfg)
+            # hand to the next stage; rank 0 receives nothing (zeros stay)
+            recv_next = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(n_stages - 1)])
+            # the last stage's step-t output belongs to microbatch t-(pp-1)
+            out_idx = t - last
+            safe = jnp.clip(out_idx, 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, safe, 0,
+                                               keepdims=False)
+            take = (stage == last) & (out_idx >= 0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(take, y, cur), safe, 0)
+            return (recv_next, outputs), None
+
+        steps = jnp.arange(n_micro + n_stages - 1)
+        (_, outputs), _ = jax.lax.scan(sched, (recv0, outputs0), steps)
+
+        # loss on the last stage only; psum broadcasts it to every rank
+        logits = (_rms_norm(outputs) @ unembed.astype(jnp.bfloat16)
+                  ).astype(jnp.float32)                    # (M, mb, s, V)
+        targets = micro[:, :, 1:]
+        logprobs = jax.nn.log_softmax(logits[:, :, :-1])
+        nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+        local = jnp.where(stage == last, jnp.mean(nll), 0.0)
+        loss = jax.lax.psum(local, "pp")
+        # average over data-parallel ranks like the sharded-mean in loss_fn
+        return jax.lax.pmean(loss, "dp")
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P("pp"), params["layers"]),
+            P(),                      # embed replicated
+            P(),                      # unembed replicated
+            P("dp", None),            # tokens data-parallel
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(params["layers"], params["embed"], params["unembed"], tokens)
+
+
+def build_gpipe(cfg: ModelConfig, mesh: Mesh, n_micro: int, seed: int = 0,
+                lr=None):
+    """(jitted training step, params, momentum, tokens) for the GPipe path."""
+    from .workload import init_params
+    lr = cfg.lr if lr is None else lr
+    params = init_params(jax.random.key(seed), cfg)
+    momentum = jax.tree.map(jnp.zeros_like, params)
+    tokens = jax.random.randint(
+        jax.random.key(seed + 1), (cfg.batch, cfg.seq_len), 0, cfg.vocab,
+        dtype=jnp.int32)
+
+    def step(params, momentum, tokens):
+        loss, grads = jax.value_and_grad(gpipe_loss_fn)(
+            params, tokens, cfg, mesh, n_micro)
+        momentum = jax.tree.map(
+            lambda m, g: cfg.momentum * m + g, momentum, grads)
+        params = jax.tree.map(lambda p, m: p - lr * m, params, momentum)
+        return params, momentum, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), params, momentum, tokens

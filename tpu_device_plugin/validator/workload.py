@@ -255,6 +255,19 @@ def _rms_norm(x: jax.Array) -> jax.Array:
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype)
 
 
+def _layer_body(x: jax.Array, layer: Params, cfg: ModelConfig,
+                attention: str, interpret: bool,
+                mesh: Optional[Mesh]) -> jax.Array:
+    """One transformer block (attention + MoE/MLP residuals); shared by the
+    scanned forward and the GPipe per-stage apply so they cannot drift."""
+    x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret, mesh)
+    if cfg.n_experts:
+        x = x + _moe(_rms_norm(x), layer, cfg, mesh)
+    else:
+        x = x + _mlp(_rms_norm(x), layer)
+    return x
+
+
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attention: str = "einsum", interpret: bool = True,
             mesh: Optional[Mesh] = None) -> jax.Array:
@@ -262,11 +275,7 @@ def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
     x = _constrain(x, P("dp", "sp", None), mesh)
 
     def body(x, layer):
-        x = x + _attention(_rms_norm(x), layer, cfg, attention, interpret, mesh)
-        if cfg.n_experts:
-            x = x + _moe(_rms_norm(x), layer, cfg, mesh)
-        else:
-            x = x + _mlp(_rms_norm(x), layer)
+        x = _layer_body(x, layer, cfg, attention, interpret, mesh)
         x = _constrain(x, P("dp", "sp", None), mesh)
         return x, None
 
