@@ -21,13 +21,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .workload import ModelConfig, Params, _layer_body, _rms_norm
+from .workload import ModelConfig, Params, _rms_norm, layer_block
 
 
 def _stage_apply(x, layer_stack, cfg: ModelConfig):
-    """Run this rank's slice of the layer stack (same body as workload)."""
+    """Run this rank's slice of the layer stack (same body as workload).
+
+    With cfg.remat the shared block is checkpointed — GPipe stores one
+    activation per in-flight microbatch per schedule step, so remat keeps
+    that at O(1) per layer."""
+    block = layer_block(cfg)
+
     def body(x, layer):
-        return _layer_body(x, layer, cfg, "einsum", True, None), None
+        return block(x, layer, cfg, "einsum", True, None), None
     x, _ = jax.lax.scan(body, x, layer_stack)
     return x
 

@@ -209,6 +209,10 @@ def main(argv=None) -> int:
     parser.add_argument("--experts", type=int, default=None,
                         help="replace the MLP with a top-1 switch MoE of "
                              "this many experts")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize each layer in the backward "
+                             "(jax.checkpoint): O(1) activation memory in "
+                             "depth for one extra forward pass")
     parser.add_argument("--seq-len", type=int, default=None)
     parser.add_argument("--attention",
                         choices=["auto", "flash", "ring", "einsum"],
@@ -263,13 +267,15 @@ def main(argv=None) -> int:
         print(json.dumps({"ok": ok, **result}, sort_keys=True))
         return 0 if ok else 1
     cfg = None
-    if args.seq_len is not None or args.experts is not None:
+    if args.seq_len is not None or args.experts is not None or args.remat:
         from .workload import ModelConfig
         overrides = {}
         if args.seq_len is not None:
             overrides["seq_len"] = args.seq_len
         if args.experts is not None:
             overrides["n_experts"] = args.experts
+        if args.remat:
+            overrides["remat"] = True
         cfg = ModelConfig(**overrides)
     # Validate pp/ep against the model BEFORE touching devices: a sharding
     # divisibility error inside validate_slice would be reported as a broken

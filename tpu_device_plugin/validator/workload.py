@@ -47,6 +47,11 @@ class ModelConfig:
     # switch layer of n_experts experts (weights shardable over "ep").
     n_experts: int = 0
     capacity_factor: float = 1.25
+    # Rematerialize each layer in the backward (jax.checkpoint around the
+    # scanned block): activation memory drops from O(L) layers to O(1) at
+    # the cost of one extra forward — the standard HBM-for-FLOPs trade for
+    # deep models on TPU.
+    remat: bool = False
 
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
@@ -268,14 +273,27 @@ def _layer_body(x: jax.Array, layer: Params, cfg: ModelConfig,
     return x
 
 
+def layer_block(cfg: ModelConfig):
+    """The (possibly rematerialized) block both scan consumers use — the
+    static_argnums layout lives in exactly one place. prevent_cse=False per
+    the jax.checkpoint guidance for use under lax.scan (scan already blocks
+    the problematic CSE; the barriers would only cost performance)."""
+    if cfg.remat:
+        return jax.checkpoint(_layer_body, static_argnums=(2, 3, 4, 5),
+                              prevent_cse=False)
+    return _layer_body
+
+
 def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
             attention: str = "einsum", interpret: bool = True,
             mesh: Optional[Mesh] = None) -> jax.Array:
     x = params["embed"].astype(jnp.bfloat16)[tokens]
     x = _constrain(x, P("dp", "sp", None), mesh)
 
+    block = layer_block(cfg)
+
     def body(x, layer):
-        x = _layer_body(x, layer, cfg, attention, interpret, mesh)
+        x = block(x, layer, cfg, attention, interpret, mesh)
         x = _constrain(x, P("dp", "sp", None), mesh)
         return x, None
 
