@@ -69,6 +69,10 @@ class FakeChip:
     driver: Optional[str] = "vfio-pci"
     accel_index: Optional[int] = None  # also expose /sys/class/accel + /dev/accelN
     vfio_dev: Optional[str] = None     # e.g. "vfio3": create <bdf>/vfio-dev/vfio3
+    # upstream PCIe bridge BDF: materializes the device nested under
+    # /sys/devices/pci0000:00/<parent>/<bdf> with a symlink from the flat
+    # bus view, like real sysfs
+    pcie_parent: Optional[str] = None
 
 
 class FakeHost:
@@ -94,6 +98,12 @@ class FakeHost:
 
     def add_chip(self, chip: FakeChip) -> None:
         base = os.path.join(self.pci, chip.bdf)
+        if chip.pcie_parent:
+            real = os.path.join(self.root, "sys/devices/pci0000:00",
+                                chip.pcie_parent, chip.bdf)
+            os.makedirs(real, exist_ok=True)
+            if not os.path.islink(base):
+                os.symlink(real, base)
         os.makedirs(base, exist_ok=True)
         self._write(os.path.join(base, "vendor"), chip.vendor + "\n")
         self._write(os.path.join(base, "device"), "0x" + chip.device_id + "\n")

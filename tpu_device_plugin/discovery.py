@@ -63,6 +63,20 @@ def read_numa_node(path: str) -> int:
     return max(node, 0)
 
 
+def pcie_path(pci_base_path: str, bdf: str) -> str:
+    """Resolved sysfs hierarchy path for a chip (its PCIe position).
+
+    /sys/bus/pci/devices/<bdf> is a symlink into /sys/devices/...; sorting
+    chips by the resolved path groups co-packaged chips at ANY nesting
+    depth — chips behind one switch share the upstream-port prefix even
+    though each sits under its own downstream port. This is the host-side
+    ICI-adjacency signal assign_coords uses (SURVEY §7 hard part (a)). On
+    flat layouts (fixtures, no symlinks) the path order degenerates to BDF
+    order.
+    """
+    return os.path.realpath(os.path.join(pci_base_path, bdf))
+
+
 def scan_accel_class(accel_class_path: str) -> Dict[str, int]:
     """Map PCI BDF → /dev/accelN index via /sys/class/accel/accelN/device.
 
@@ -139,7 +153,9 @@ def discover_passthrough(
     iommu_map: Dict[str, List[TpuDevice]] = {}
     bdf_to_group: Dict[str, str] = {}
     for model, devs in by_model.items():
-        coords = assign_coords([d.bdf for d in devs], generations.get(model), hints)
+        paths = {d.bdf: pcie_path(cfg.pci_base_path, d.bdf) for d in devs}
+        coords = assign_coords([d.bdf for d in devs], generations.get(model),
+                               hints, pcie_paths=paths)
         stamped = tuple(
             TpuDevice(
                 bdf=d.bdf, device_id=d.device_id, iommu_group=d.iommu_group,

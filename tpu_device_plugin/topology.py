@@ -48,16 +48,25 @@ def assign_coords(
     bdfs: Sequence[str],
     info: Optional[GenerationInfo],
     hints: Optional[Dict[str, Coords]] = None,
+    pcie_paths: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Optional[Coords]]:
     """Place each BDF on the host-local torus.
 
-    Explicit hints win. Otherwise chips are laid out in sorted-BDF order along
-    lexicographic torus coordinates — on real hosts PCIe enumeration order
-    tracks physical chip order, and fleets with exotic routing supply hints
-    (Config.topology_hints_path). BDFs beyond the torus capacity get no
-    coordinates (and therefore only NUMA-level preference).
+    Explicit hints win. Otherwise chips are laid out along lexicographic
+    torus coordinates in resolved-PCIe-path order: co-packaged chips share
+    a hierarchy prefix (a switch's upstream port) at any nesting depth, so
+    they fill CONSECUTIVE grid slots (SURVEY §7 hard part (a): host-side
+    ICI adjacency without guest context). Consecutive slots are physically
+    adjacent when group sizes align with the innermost torus axis — the
+    common case for paired/quad trays; fleets where that heuristic (or
+    hint-perturbed slot packing) is wrong supply explicit hints
+    (Config.topology_hints_path), which always win. Without path info this
+    degrades to sorted-BDF order — PCIe enumeration order tracks physical
+    chip order. BDFs beyond the torus capacity get no coordinates (and
+    therefore only NUMA-level preference).
     """
     hints = hints or {}
+    pcie_paths = pcie_paths or {}
     out: Dict[str, Optional[Coords]] = {}
     if info is None:
         return {bdf: hints.get(bdf) for bdf in bdfs}
@@ -70,7 +79,9 @@ def assign_coords(
         log.warning("topology hint %s=%s invalid for torus %s; ignoring", b, c, dims)
     hints = {b: c for b, c in hints.items() if b not in bad}
     grid = list(itertools.product(*[range(d) for d in dims]))
-    unhinted = [b for b in sorted(bdfs) if b not in hints]
+    unhinted = [b for b in sorted(bdfs,
+                                  key=lambda b: (pcie_paths.get(b, b), b))
+                if b not in hints]
     taken = set(hints.values())
     free_slots = [c for c in grid if c not in taken]
     for bdf in bdfs:
