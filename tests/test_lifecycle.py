@@ -246,3 +246,32 @@ def test_shared_group_change_restarts_coupled_resource(kubelet):
     finally:
         stop.set()
         t.join(timeout=10)
+
+
+def test_daemon_sigterm_clean_shutdown(short_root):
+    """The real process contract: SIGTERM -> exit 0, sockets removed."""
+    import signal
+    import subprocess
+    import sys
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kub = FakeKubelet(cfg.kubelet_socket)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_device_plugin", "--root", host.root],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        assert kub.wait_for(1, timeout=15)
+        sock = os.path.join(cfg.device_plugin_path, "tpukubevirt-v4.sock")
+        assert os.path.exists(sock)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=15)
+        assert proc.returncode == 0, out[-500:]
+        assert not os.path.exists(sock), "socket left behind after SIGTERM"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        kub.stop()
