@@ -334,3 +334,34 @@ def test_gpipe_remat_matches():
     remat = gpipe_loss_fn(params, tokens, dc_replace(cfg, remat=True),
                           mesh, 4)
     assert abs(float(plain) - float(remat)) < 1e-5
+
+
+def test_validate_slice_gpipe_mode():
+    report = validate_slice(cfg=ModelConfig(
+        vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+        seq_len=16, batch=8), steps=3, pp=2, tp=1, sp=1,
+        devices=cpus()[:4], gpipe_microbatches=2)
+    assert report.ok, report.error
+    assert report.loss_end < report.loss_start
+    assert report.mesh_shape["pp"] == 2
+
+
+def test_cli_gpipe_requires_pp():
+    from tpu_device_plugin.validator.probe import main
+    with pytest.raises(SystemExit) as e:
+        main(["--gpipe-microbatches", "2"])
+    assert e.value.code == 2
+
+
+def test_cli_gpipe_rejects_incompatible_flags():
+    from tpu_device_plugin.validator.probe import main
+    for argv in (["--gpipe-microbatches", "2", "--pp", "2", "--tp", "2"],
+                 ["--gpipe-microbatches", "2", "--pp", "2",
+                  "--attention", "flash"],
+                 ["--gpipe-microbatches", "3", "--pp", "2"],  # 8 % 3 != 0
+                 ["--mode", "infer", "--pp", "2",
+                  "--gpipe-microbatches", "2"],
+                 ["--mode", "attn-bench", "--gpipe-microbatches", "2"]):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert e.value.code == 2, argv

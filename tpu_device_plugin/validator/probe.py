@@ -99,6 +99,7 @@ def validate_slice(
     devices=None,
     attention: Optional[str] = None,
     mode: str = "train",
+    gpipe_microbatches: int = 0,
 ) -> SliceReport:
     report = SliceReport(ok=False)
     try:
@@ -141,8 +142,16 @@ def validate_slice(
             if not report.ok:
                 report.error = "non-finite logits in serving forward"
         else:
-            step, params, momentum, tokens = build_workload(cfg, mesh,
-                                                            attention=attention)
+            if gpipe_microbatches:
+                # explicit GPipe schedule (pipeline.py); runs einsum
+                # attention by construction — the CLI rejects --attention
+                # combined with it
+                from .pipeline import build_gpipe
+                step, params, momentum, tokens = build_gpipe(
+                    cfg, mesh, n_micro=gpipe_microbatches)
+            else:
+                step, params, momentum, tokens = build_workload(
+                    cfg, mesh, attention=attention)
 
             params, momentum, loss = step(params, momentum, tokens)
             report.loss_start = float(loss)
@@ -209,6 +218,10 @@ def main(argv=None) -> int:
     parser.add_argument("--experts", type=int, default=None,
                         help="replace the MLP with a top-1 switch MoE of "
                              "this many experts")
+    parser.add_argument("--gpipe-microbatches", type=int, default=0,
+                        help="train with the explicit GPipe schedule "
+                             "(pipeline.py) using this many microbatches; "
+                             "needs --pp > 1 and sp == tp == 1")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize each layer in the backward "
                              "(jax.checkpoint): O(1) activation memory in "
@@ -249,6 +262,8 @@ def main(argv=None) -> int:
             print(report.to_json())
             return 1
     if args.mode == "attn-bench":
+        if args.gpipe_microbatches:
+            parser.error("--gpipe-microbatches only applies to --mode train")
         from .attn_bench import bench_attention
         try:
             result = bench_attention(
@@ -291,9 +306,23 @@ def main(argv=None) -> int:
         if base.n_experts % args.ep:
             parser.error(f"--ep {args.ep} does not divide "
                          f"--experts {base.n_experts}")
+    if args.gpipe_microbatches:
+        if args.mode != "train":
+            parser.error("--gpipe-microbatches only applies to --mode train")
+        if (args.pp or 0) < 2:
+            parser.error("--gpipe-microbatches needs --pp >= 2")
+        if (args.tp or 1) != 1 or (args.sp or 1) != 1:
+            parser.error("--gpipe-microbatches needs tp == sp == 1")
+        if args.attention != "auto":
+            parser.error("the GPipe schedule runs einsum attention; "
+                         "drop --attention")
+        if base.batch % args.gpipe_microbatches:
+            parser.error(f"batch {base.batch} not divisible by "
+                         f"--gpipe-microbatches {args.gpipe_microbatches}")
     attention = None if args.attention == "auto" else args.attention
     report = validate_slice(cfg=cfg, steps=args.steps, tp=args.tp, sp=args.sp,
                             pp=args.pp, ep=args.ep,
-                            attention=attention, mode=args.mode)
+                            attention=attention, mode=args.mode,
+                            gpipe_microbatches=args.gpipe_microbatches)
     print(report.to_json())
     return 0 if report.ok else 1
