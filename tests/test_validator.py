@@ -365,3 +365,13 @@ def test_cli_gpipe_rejects_incompatible_flags():
         with pytest.raises(SystemExit) as e:
             main(argv)
         assert e.value.code == 2, argv
+
+
+def test_slice_mesh_pp_ep_divisibility_errors():
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        slice_mesh(cpus()[:6], pp=4)
+    with pytest.raises(ValueError, match="not divisible by pp"):
+        slice_mesh(cpus()[:6], ep=4)
+    # pp/ep axes appear only when > 1
+    assert slice_mesh(cpus()[:8], pp=1, ep=1).axis_names == ("dp", "sp", "tp")
+    assert slice_mesh(cpus()[:8], ep=2).axis_names == ("dp", "sp", "ep", "tp")
