@@ -275,3 +275,109 @@ def test_daemon_sigterm_clean_shutdown(short_root):
             proc.kill()
             proc.communicate()
         kub.stop()
+
+
+def test_drain_and_undrain(kubelet):
+    """Drain marks every device Unhealthy via an ANDed source; undrain
+    restores — unless another source is genuinely unhealthy."""
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kub.wait_for(1)
+        plugin = manager.plugins[0]
+        manager.drain(True)
+        assert plugin.status_snapshot()["devices"]["0000:00:04.0"] == "Unhealthy"
+        # a real failure during the drain window
+        plugin.set_group_health("11", False, "fs")
+        manager.drain(False)
+        # undrain must NOT mask the real failure
+        assert plugin.status_snapshot()["devices"]["0000:00:04.0"] == "Unhealthy"
+        plugin.set_group_health("11", True, "fs")
+        assert plugin.status_snapshot()["devices"]["0000:00:04.0"] == "Healthy"
+    finally:
+        manager.stop()
+
+
+def test_drain_applies_to_plugins_born_during_drain(kubelet):
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kub.wait_for(1)
+        manager.drain(True)
+        # hotplug a new model while draining
+        host.add_chip(FakeChip("0000:01:00.0", device_id="0063",
+                               iommu_group="21"))
+        from tpu_device_plugin.discovery import discover
+        manager._apply_inventory(discover(cfg))
+        assert kub.wait_for(2)
+        v5e = next(p for p in manager.plugins if p.resource_suffix == "v5e")
+        assert v5e.status_snapshot()["devices"]["0000:01:00.0"] == "Unhealthy"
+        manager.drain(False)
+        assert v5e.status_snapshot()["devices"]["0000:01:00.0"] == "Healthy"
+    finally:
+        manager.stop()
+
+
+def test_daemon_sigusr_drain_cycle(short_root):
+    """Real process: SIGUSR1 drains (visible on /status), SIGUSR2 restores."""
+    import json
+    import signal as signal_mod
+    import subprocess
+    import sys
+    import urllib.request
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kub = FakeKubelet(cfg.kubelet_socket)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_device_plugin", "--root", host.root,
+         "--status-port", "18095", "--status-host", "127.0.0.1", "--log-json"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def get_status():
+        return json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:18095/status", timeout=2).read())
+
+    try:
+        assert kub.wait_for(1, timeout=15)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                if get_status()["plugins"]:
+                    break
+            except OSError:
+                time.sleep(0.1)
+        proc.send_signal(signal_mod.SIGUSR1)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            s = get_status()
+            if s["draining"] and s["plugins"][0]["devices"][
+                    "0000:00:04.0"] == "Unhealthy":
+                break
+            time.sleep(0.1)
+        assert s["draining"] is True
+        assert s["plugins"][0]["devices"]["0000:00:04.0"] == "Unhealthy"
+        proc.send_signal(signal_mod.SIGUSR2)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            s = get_status()
+            if not s["draining"] and s["plugins"][0]["devices"][
+                    "0000:00:04.0"] == "Healthy":
+                break
+            time.sleep(0.1)
+        assert s["draining"] is False
+        assert s["plugins"][0]["devices"]["0000:00:04.0"] == "Healthy"
+    finally:
+        proc.terminate()
+        out, _ = proc.communicate(timeout=15)
+        kub.stop()
+    # --log-json: every line parses as JSON
+    for line in out.splitlines():
+        if line.strip():
+            json.loads(line)

@@ -76,13 +76,36 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--discover-only", action="store_true",
                         help="run discovery once, print the inventory as "
                              "JSON, and exit (ops/debug; no kubelet contact)")
+    parser.add_argument("--log-json", action="store_true",
+                        help="emit one JSON object per log line (fleet log "
+                             "pipelines)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    level = logging.DEBUG if args.verbose else logging.INFO
+    if args.log_json:
+        import json as json_mod
+
+        class _JsonFormatter(logging.Formatter):
+            def format(self, record):
+                entry = {
+                    "ts": self.formatTime(record),
+                    "level": record.levelname,
+                    "logger": record.name,
+                    "msg": record.getMessage(),
+                }
+                if record.exc_info:
+                    entry["exc"] = self.formatException(record.exc_info)
+                return json_mod.dumps(entry)
+
+        handler = logging.StreamHandler()
+        handler.setFormatter(_JsonFormatter())
+        logging.basicConfig(level=level, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
     cfg = replace(
         cfg,
         pci_base_path=args.pci_base_path,
@@ -155,6 +178,16 @@ def main(argv=None) -> int:
         on_inventory = lambda reg, gens: labeler.publish(
             node_facts(cfg, reg, gens))
     manager = PluginManager(cfg, on_inventory=on_inventory)
+
+    def handle_drain(signum, frame):
+        # flag-set only: drain() takes locks the interrupted main thread
+        # may hold; the manager run loop applies the request next tick
+        manager.request_drain(signum == signal.SIGUSR1)
+
+    # SIGUSR1 = drain (all devices administratively Unhealthy; kubelet stops
+    # placing new VMIs), SIGUSR2 = undrain
+    signal.signal(signal.SIGUSR1, handle_drain)
+    signal.signal(signal.SIGUSR2, handle_drain)
     status = None
     if args.status_port:
         from .status import StatusServer

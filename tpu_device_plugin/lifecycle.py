@@ -43,6 +43,14 @@ class PluginManager:
         self.pending: List[TpuDevicePlugin] = []
         self.registry: Optional[Registry] = None
         self._sigs: dict = {}
+        # drain: an administrative health source ANDed with the observed
+        # ones; kubelet stops placing new VMIs while existing ones keep
+        # their devices (the Device Plugin API cannot revoke grants)
+        self.draining = False
+        # set from signal handlers (plain assignment only — drain() itself
+        # takes locks the interrupted main thread may hold); the run loop
+        # applies it on the next tick
+        self._drain_request: Optional[bool] = None
         self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
         # Queried once at startup: whether the host can dlopen libtpu.so.
@@ -220,12 +228,33 @@ class PluginManager:
         still_pending: List[TpuDevicePlugin] = []
         for plugin in self.pending:
             try:
+                if self.draining:
+                    # BEFORE start(): the kubelet must never see an initial
+                    # Healthy snapshot from a plugin born during a drain
+                    plugin.set_all_health(False, "drain")
                 plugin.start()
             except Exception as exc:
                 log.error("plugin %s failed to start (%s); will retry",
                           plugin.resource_name, exc)
                 still_pending.append(plugin)
         self.pending = still_pending
+
+    def request_drain(self, draining: bool) -> None:
+        """Async-signal-safe drain request: just records the wish; the run
+        loop performs the actual (lock-taking) drain on its next tick."""
+        self._drain_request = draining
+
+    def drain(self, draining: bool) -> None:
+        """Administratively mark every device (un)healthy for maintenance.
+
+        The reference has no drain story; here SIGUSR1/SIGUSR2 (cli.py)
+        toggle it at runtime. Implemented as one more ANDed health source,
+        so undraining never masks a genuinely dead chip."""
+        self.draining = draining
+        log.warning("node %s", "DRAINING: all devices -> Unhealthy"
+                    if draining else "undrained: device health restored")
+        for plugin in self.plugins:
+            plugin.set_all_health(not draining, "drain")
 
     def stop(self) -> None:
         for plugin in self.plugins:
@@ -257,6 +286,9 @@ class PluginManager:
                     break
                 if self.pending:
                     self._try_start_pending()
+                if self._drain_request is not None \
+                        and self._drain_request != self.draining:
+                    self.drain(self._drain_request)
                 if self.on_inventory is not None \
                         and not self._inventory_published \
                         and self._last_inventory is not None \

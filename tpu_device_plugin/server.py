@@ -100,6 +100,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         members = [d.bdf for d in self.registry.iommu_map.get(group, ())]
         self.set_devices_health(members, healthy, source)
 
+    def set_all_health(self, healthy: bool, source: str) -> None:
+        """One source's verdict for every advertised device (drain path)."""
+        with self._cond:
+            ids = list(self._devs)
+        self.set_devices_health(ids, healthy, source)
+
     def set_devices_health(self, device_ids: Sequence[str], healthy: bool,
                            source: str = "fs") -> None:
         """Record one source's verdict; a device is Healthy iff ALL sources agree.

@@ -90,6 +90,7 @@ class StatusServer:
             "plugins": [p.status_snapshot() for p in self.manager.plugins],
             "pending": [p.resource_name for p in self.manager.pending],
             "native": getattr(self.manager, "native_info", {}),
+            "draining": getattr(self.manager, "draining", False),
         }
 
     def metrics(self) -> str:
