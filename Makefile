@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image dryrun
+.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun
 
 all: native proto
 
@@ -49,6 +49,12 @@ update-pcidb:
 
 image:
 	docker build -f deployments/container/Dockerfile -t $(IMAGE):$(VERSION) .
+
+# Push the built image (reference: README.md:199-206 / container Makefile's
+# push target). CI's images.yml does the multi-arch publish; this target is
+# the manual single-arch escape hatch.
+push: image
+	docker push $(IMAGE):$(VERSION)
 
 clean:
 	rm -f native/libtpuhealth.so
