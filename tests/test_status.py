@@ -128,3 +128,21 @@ def test_metrics_exposition(rig):
     code, body = _get(status.port, "/metrics")
     assert ('tpu_plugin_devices{resource="cloud-tpus.google.com/v4",'
             'health="Unhealthy"} 1') in body.decode()
+
+
+def test_recent_allocations_surface_on_status(rig):
+    import grpc
+    from tpu_device_plugin import kubeletapi as api
+    from tpu_device_plugin.kubeletapi import pb
+    host, manager, status = rig
+    manager.start()
+    plugin = manager.plugins[0]
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        api.DevicePluginStub(ch).Allocate(
+            pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])]),
+            timeout=5)
+    code, body = _get(status.port, "/status")
+    recent = json.loads(body)["plugins"][0]["recent_allocations"]
+    assert recent and recent[0]["devices"] == [["0000:00:04.0"]]
+    assert "T" in recent[0]["time"]  # ISO timestamp

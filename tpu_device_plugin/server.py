@@ -20,7 +20,9 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from concurrent import futures
+from datetime import datetime, timezone
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
@@ -78,6 +80,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             for d in self.devices
         ]
         self._allowed_bdfs = frozenset(d.bdf for d in self.devices)
+        # last few successful allocations, surfaced on /status for debugging
+        # VMI attach issues (what was handed out, when)
+        self._recent_allocs: deque = deque(maxlen=16)
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -292,7 +297,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "restarts": self._restart_count,
             "devices": devices,
             "pci_errors": errors,
+            "recent_allocations": list(self._recent_allocs),
         }
+
+    def record_allocation(self, per_container_ids) -> None:
+        self._recent_allocs.append({
+            "time": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+            "devices": per_container_ids,
+        })
 
     @property
     def serving(self) -> bool:
@@ -341,8 +353,15 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         return resp
 
     def Allocate(self, request, context):
-        log.info("%s: Allocate(%s)", self.resource_name,
-                 [list(c.devices_ids) for c in request.container_requests])
+        """Template method: log → subclass impl → record for /status.
+        Failed allocations abort inside the impl and are never recorded."""
+        ids = [list(c.devices_ids) for c in request.container_requests]
+        log.info("%s: Allocate(%s)", self.resource_name, ids)
+        resp = self._allocate_impl(request, context)
+        self.record_allocation(ids)
+        return resp
+
+    def _allocate_impl(self, request, context):
         try:
             return allocate_mod.allocate_response(
                 self.cfg, self.registry, self.resource_suffix, request,

@@ -10,7 +10,9 @@ dependency-free endpoint for liveness probes and debugging:
                    the manager's own retry loop); 503 only when the loop died
   GET /readyz   -> readiness: 200 once at least one plugin is serving
   GET /status   -> JSON: per-plugin resource name, socket, restart count,
-                   device health table, pending (not-yet-registered) plugins
+                   device health table, latched PCI error bits, recent
+                   allocations, pending (not-yet-registered) plugins,
+                   native-shim facts, draining flag
   GET /metrics  -> Prometheus text format: device health gauges, serving
                    flags, restart counters, pending count, native-shim facts
 

@@ -114,9 +114,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             raise AllocationError(
                 f"partition {p.uuid}: live type {live!r} != {self.resource_suffix!r}")
 
-    def Allocate(self, request, context):
-        log.info("%s: Allocate(%s)", self.resource_name,
-                 [list(c.devices_ids) for c in request.container_requests])
+    def _allocate_impl(self, request, context):
         by_uuid = {p.uuid: p for p in self.partitions}
         resp = pb.AllocateResponse()
         try:
