@@ -108,3 +108,37 @@ def test_registration_service(tmp_path):
         assert kubelet.requests[0].version == "v1beta1"
     finally:
         server.stop(0)
+
+
+def test_wire_contract_field_numbers():
+    """Lock the kubelet v1beta1 wire contract as a test: field numbers and
+    service names ARE the protocol (the reference vendors them from
+    k8s.io/kubelet; any drift breaks interop with every kubelet)."""
+    from tpu_device_plugin.kubeletapi import pb
+    from tpu_device_plugin.kubeletapi.api import (
+        _DEVICE_PLUGIN_SERVICE, _REGISTRATION_SERVICE, API_VERSION)
+
+    def nums(msg):
+        return {f.name: f.number for f in msg.DESCRIPTOR.fields}
+
+    assert nums(pb.Device) == {"ID": 1, "health": 2, "topology": 3}
+    assert nums(pb.TopologyInfo) == {"nodes": 1}
+    assert nums(pb.NUMANode) == {"ID": 1}
+    assert nums(pb.DeviceSpec) == {"container_path": 1, "host_path": 2,
+                                   "permissions": 3}
+    assert nums(pb.RegisterRequest) == {"version": 1, "endpoint": 2,
+                                        "resource_name": 3, "options": 4}
+    assert nums(pb.DevicePluginOptions) == {
+        "pre_start_required": 1, "get_preferred_allocation_available": 2}
+    cresp = nums(pb.ContainerAllocateResponse)
+    assert cresp["envs"] == 1 and cresp["mounts"] == 2
+    assert cresp["devices"] == 3 and cresp["annotations"] == 4
+    assert cresp["cdi_devices"] == 5
+    assert nums(pb.CDIDevice) == {"name": 1}
+    assert nums(pb.ContainerAllocateRequest) == {"devices_ids": 1}
+    pref = nums(pb.ContainerPreferredAllocationRequest)
+    assert pref == {"available_deviceIDs": 1, "must_include_deviceIDs": 2,
+                    "allocation_size": 3}
+    assert _DEVICE_PLUGIN_SERVICE == "v1beta1.DevicePlugin"
+    assert _REGISTRATION_SERVICE == "v1beta1.Registration"
+    assert API_VERSION == "v1beta1"
