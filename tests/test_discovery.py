@@ -215,3 +215,33 @@ def test_vfio_driver_variants_accepted(tmp_path):
     from tpu_device_plugin.cli import build_config
     parsed, _ = build_config(["--vfio-drivers", "vfio-pci, tpu_vfio_pci"])
     assert parsed.vfio_drivers == ("vfio-pci", "tpu_vfio_pci")
+
+
+def test_vfio_parent_backs_at_most_one_partition(tmp_path):
+    """A VFIO group attaches to one VM at a time: extra logical partitions
+    on a vfio-bound parent are dropped so advertised capacity is usable."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "vslice", "parent_bdf": "0000:00:04.0"},
+        {"uuid": "p1", "type": "vslice", "parent_bdf": "0000:00:04.0"}]}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
+
+
+def test_accel_parent_still_backs_many_partitions(tmp_path):
+    """Accel-driver chips multiplex: per-core partitions all survive."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    assert len(registry.partitions_by_type["v4-core"]) == 2  # cores_per_chip

@@ -221,3 +221,17 @@ def test_manager_retries_failed_publish(short_root):
         stop.set()
         t.join(timeout=10)
         kubelet.stop()
+
+
+def test_feature_file_only_never_touches_ambient_api(inventory, tmp_path,
+                                                     monkeypatch):
+    """Feature-file-only mode with ambient in-cluster env + NODE_NAME must
+    NOT attempt API PATCHes (no RBAC there; each would 403 and fail the
+    publish forever)."""
+    cfg, registry, generations = inventory
+    monkeypatch.setenv("NODE_NAME", "node-a")
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    labeler = NodeLabeler(feature_file=str(tmp_path / "tpu"))
+    assert labeler.api_server  # ambient env present...
+    assert labeler.publish(node_facts(cfg, registry, generations))  # ...unused
+    assert (tmp_path / "tpu").exists()

@@ -375,3 +375,15 @@ def test_slice_mesh_pp_ep_divisibility_errors():
     # pp/ep axes appear only when > 1
     assert slice_mesh(cpus()[:8], pp=1, ep=1).axis_names == ("dp", "sp", "tp")
     assert slice_mesh(cpus()[:8], ep=2).axis_names == ("dp", "sp", "ep", "tp")
+
+
+def test_gpipe_local_batch_mismatch_is_config_error():
+    """Non-dividing LOCAL batch (only knowable once dp is known) must be a
+    config verdict with exit code 2, never a broken-slice report."""
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                      seq_len=16, batch=8)
+    report = validate_slice(cfg=cfg, steps=2, pp=2, tp=1, sp=1,
+                            devices=cpus(), gpipe_microbatches=4)
+    # pp2 x dp4 -> local batch 2, not divisible by 4
+    assert report.invalid_config and not report.ok
+    assert "invalid configuration" in report.error

@@ -290,3 +290,42 @@ def test_preferred_allocation_numa_tiebreak(short_root):
             assert picked == ["uuid-1", "uuid-2"]
     finally:
         server.stop(0)
+
+
+def test_parent_chip_death_fans_out_to_all_partitions(short_root):
+    """One probe per DISTINCT parent; a dead chip (all-FF config space)
+    marks every partition of that chip Unhealthy."""
+    import time
+    from dataclasses import replace
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    import json
+    pc = os.path.join(host.root, "partitions.json")
+    with open(pc, "w") as f:
+        f.write(json.dumps({"per_core": True}))
+    cfg = replace(Config().with_root(host.root),
+                  partition_config_path=pc, health_poll_s=0.2)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    from tests.fakehost import FakeKubelet
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    registry, _ = discover(cfg)
+    parts = registry.partitions_by_type["v4-core"]
+    assert len(parts) == 2
+    plugin = VtpuDevicePlugin(cfg, "v4-core", registry, parts)
+    plugin.start()
+    try:
+        # chip falls off the bus: config space reads all-FF
+        with open(os.path.join(host.pci, "0000:00:04.0", "config"), "wb") as f:
+            f.write(b"\xff" * 4)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            devs = plugin.status_snapshot()["devices"]
+            if set(devs.values()) == {"Unhealthy"}:
+                break
+            time.sleep(0.05)
+        assert set(devs.values()) == {"Unhealthy"}, devs
+        assert len(devs) == 2
+    finally:
+        plugin.stop()
+        kubelet.stop()

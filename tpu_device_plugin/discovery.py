@@ -303,15 +303,29 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
     # A logical partition is only allocatable through its parent's accel node
     # or VFIO group; one with neither would hand a VMI zero DeviceSpecs —
     # refuse it here with a reason instead of failing at Allocate time.
+    # And a VFIO group attaches to exactly ONE container at a time, so a
+    # vfio-bound parent can back at most ONE advertised partition: a second
+    # VMI's VFIO_GROUP_SET_CONTAINER would fail EBUSY, making any extra
+    # advertised capacity unusable. (Accel-node partitions CAN share — the
+    # accel driver multiplexes.)
     allocatable: List[TpuPartition] = []
+    vfio_parent_seen: Dict[str, str] = {}
     for p in partitions:
-        if (p.provider == "logical" and p.accel_index is None
-                and p.parent_bdf not in registry.bdf_to_group):
-            log.warning(
-                "partition %s (type %s): parent %s has no accel node and is "
-                "not vfio-bound; refusing to advertise an unallocatable "
-                "partition", p.uuid, p.type_name, p.parent_bdf)
-            continue
+        if p.provider == "logical" and p.accel_index is None:
+            if p.parent_bdf not in registry.bdf_to_group:
+                log.warning(
+                    "partition %s (type %s): parent %s has no accel node and "
+                    "is not vfio-bound; refusing to advertise an "
+                    "unallocatable partition", p.uuid, p.type_name, p.parent_bdf)
+                continue
+            holder = vfio_parent_seen.setdefault(p.parent_bdf, p.uuid)
+            if holder != p.uuid:
+                log.warning(
+                    "partition %s (type %s): parent %s is vfio-bound and its "
+                    "group is already backing partition %s — a VFIO group "
+                    "attaches to one VM at a time, dropping the extra "
+                    "partition", p.uuid, p.type_name, p.parent_bdf, holder)
+                continue
         allocatable.append(p)
     partitions = allocatable
     # A vfio-bound chip that backs logical partitions is consumed by the vTPU

@@ -101,6 +101,11 @@ class NodeLabeler:
         label_prefix: str = "cloud-tpus.google.com",
     ) -> None:
         self.node_name = node_name or os.environ.get("NODE_NAME")
+        # only an explicit api_server or --label-node (require_api) may use
+        # the API path; ambient in-cluster env must NOT trigger PATCHes in
+        # feature-file-only mode (no RBAC there — every attempt would 403
+        # and poison the retry loop)
+        self._api_requested = api_server is not None
         self.api_server = api_server or self._in_cluster_server()
         self.token_path = token_path
         self.ca_path = ca_path
@@ -127,7 +132,8 @@ class NodeLabeler:
         if self.feature_file:
             any_path = True
             ok = write_feature_file(self.feature_file, facts) and ok
-        if self.node_name and self.api_server:
+        want_api = self.require_api or self._api_requested
+        if want_api and self.node_name and self.api_server:
             any_path = True
             ok = self._patch_labels(facts) and ok
         elif self.require_api:

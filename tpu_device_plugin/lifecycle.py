@@ -72,10 +72,8 @@ class PluginManager:
             self._publish_inventory()
         plugins: List[TpuDevicePlugin] = []
         cdi_paths: List[str] = []
-        passthrough_suffixes = set()
         for model, devs in sorted(registry.devices_by_model.items()):
             suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
-            passthrough_suffixes.add(suffix)
             info = generations.get(model)
             cdi_enabled = False
             if self.cfg.cdi_spec_dir:
@@ -93,14 +91,10 @@ class PluginManager:
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
                      info.host_topology if info else None)
+        # colliding partition types never reach here: discovery.discover is
+        # the single authority that drops them (with the parent chips kept
+        # as passthrough)
         for type_name, parts in sorted(registry.partitions_by_type.items()):
-            if type_name in passthrough_suffixes:
-                # both plugins would register the same extended-resource name
-                # with the kubelet (sockets are namespaced but resource names
-                # are not) — a partition-config author error, not recoverable
-                log.error("vTPU type %r collides with a passthrough resource "
-                          "suffix; skipping its plugin", type_name)
-                continue
             cdi_enabled = False
             cdi_uuids: frozenset = frozenset()
             if self.cfg.cdi_spec_dir:
@@ -152,15 +146,11 @@ class PluginManager:
                 for g in groups if g is not None))
 
         sigs = {}
-        suffixes = set()
         for model, devs in registry.devices_by_model.items():
             suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
-            suffixes.add(suffix)
             sigs[("pt", suffix)] = (
                 devs, group_members({d.iommu_group for d in devs}))
         for type_name, parts in registry.partitions_by_type.items():
-            if type_name in suffixes:
-                continue  # collision: never built (see build_plugins)
             parent_groups = tuple(sorted(
                 {(p.parent_bdf, registry.bdf_to_group.get(p.parent_bdf))
                  for p in parts}))
@@ -282,7 +272,22 @@ class PluginManager:
                 tick = interval if interval > 0 else 1.0
                 if self.pending:
                     tick = min(tick, 2.0)
-                if stop_event.wait(timeout=tick):
+                # sleep in ≤1s slices so a signal-set drain request (which
+                # cannot wake an Event the handler's own thread is waiting
+                # on) is applied within ~1s even under long rediscovery
+                # intervals
+                stopped = False
+                waited = 0.0
+                while waited < tick:
+                    step_s = min(1.0, tick - waited)
+                    if stop_event.wait(timeout=step_s):
+                        stopped = True
+                        break
+                    waited += step_s
+                    if self._drain_request is not None \
+                            and self._drain_request != self.draining:
+                        break
+                if stopped:
                     break
                 if self.pending:
                     self._try_start_pending()

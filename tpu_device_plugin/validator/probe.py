@@ -37,6 +37,10 @@ class SliceReport:
     infer_p50_ms: float = 0.0
     infer_p99_ms: float = 0.0
     tokens_per_s: float = 0.0
+    # True when the failure is the CALLER's configuration (bad flag combo
+    # only detectable once the mesh is known), not a broken slice — probes
+    # gating VMI admission must not treat these as hardware failures
+    invalid_config: bool = False
     error: str = ""
 
     def to_json(self) -> str:
@@ -145,10 +149,28 @@ def validate_slice(
             if gpipe_microbatches:
                 # explicit GPipe schedule (pipeline.py); runs einsum
                 # attention by construction — the CLI rejects --attention
-                # combined with it
+                # combined with it. Constraints only checkable now that the
+                # mesh (hence dp, hence the local batch) is known are
+                # config errors, never broken-slice verdicts.
                 from .pipeline import build_gpipe
-                step, params, momentum, tokens = build_gpipe(
-                    cfg, mesh, n_micro=gpipe_microbatches)
+                axis = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                        if mesh is not None else {})
+                dp = axis.get("dp", 1)
+                if cfg.batch % dp or (cfg.batch // dp) % gpipe_microbatches:
+                    report.invalid_config = True
+                    report.error = (
+                        f"invalid configuration: batch {cfg.batch} over "
+                        f"dp={dp} gives local batch {cfg.batch // dp}, not "
+                        f"divisible by --gpipe-microbatches "
+                        f"{gpipe_microbatches}")
+                    return report
+                try:
+                    step, params, momentum, tokens = build_gpipe(
+                        cfg, mesh, n_micro=gpipe_microbatches)
+                except ValueError as exc:
+                    report.invalid_config = True
+                    report.error = f"invalid configuration: {exc}"
+                    return report
             else:
                 step, params, momentum, tokens = build_workload(
                     cfg, mesh, attention=attention)
@@ -277,8 +299,7 @@ def main(argv=None) -> int:
             print(json.dumps({"ok": False,
                               "error": f"{type(exc).__name__}: {exc}"}))
             return 1
-        ok = bool(result["cells"]) and all(
-            not c["error"] for c in result["cells"])
+        ok = result["flash_ok"]
         print(json.dumps({"ok": ok, **result}, sort_keys=True))
         return 0 if ok else 1
     cfg = None
@@ -325,4 +346,6 @@ def main(argv=None) -> int:
                             attention=attention, mode=args.mode,
                             gpipe_microbatches=args.gpipe_microbatches)
     print(report.to_json())
+    if report.invalid_config:
+        return 2  # caller error, not a broken slice
     return 0 if report.ok else 1

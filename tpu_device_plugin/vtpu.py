@@ -73,7 +73,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
 
     def _start_monitor(self) -> None:
         paths: Dict[str, str] = {}
-        parents: Dict[str, List[str]] = {}
+        children: Dict[str, List[str]] = {}
         for p in self.partitions:
             if p.provider == "mdev":
                 paths[p.uuid] = os.path.join(self.cfg.mdev_base_path, p.uuid)
@@ -85,13 +85,20 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     # vfio-backed logical partition: watch the group node the
                     # allocation will mount
                     paths[p.uuid] = self.cfg.dev_path("dev/vfio", group)
-            parents[p.uuid] = [p.parent_bdf]
+            children.setdefault(p.parent_bdf, []).append(p.uuid)
+
+        def on_health(key: str, ok: bool, src: str) -> None:
+            # fs events arrive keyed by partition uuid; probe verdicts by
+            # parent BDF and fan out to every partition of that chip
+            self.set_devices_health(children.get(key, [key]), ok, src)
+
         self._monitor = HealthMonitor(
             socket_path=self.socket_path,
             group_paths=paths,
-            group_bdfs=parents,
-            on_device_health=lambda uuid, ok, src: self.set_devices_health(
-                [uuid], ok, src),
+            # probe each DISTINCT parent chip once per poll (64 per-core
+            # partitions of 8 chips = 8 probes, not 64), XID-fan-out style
+            group_bdfs={parent: [parent] for parent in children},
+            on_device_health=on_health,
             on_socket_removed=self._restart_async,
             probe=lambda bdf, node: self.health_shim.chip_alive(
                 self.cfg.pci_base_path, bdf, node),
@@ -153,13 +160,13 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     else:
                         # Logical partition of a vfio-bound parent: the guest
                         # can only reach the chip through its VFIO group, so
-                        # mount it whole (chip sharing is then a scheduling
-                        # construct, not hardware isolation). Discovery drops
-                        # partitions with neither an accel node nor a
-                        # vfio-bound parent, so an allocation NEVER returns
-                        # zero DeviceSpecs. plan_allocation supplies the same
-                        # sysfs revalidation + iommufd handling passthrough
-                        # gets.
+                        # mount it whole. Discovery guarantees at most ONE
+                        # such partition per parent (a VFIO group attaches to
+                        # one VM at a time) and drops partitions with neither
+                        # an accel node nor a vfio-bound parent, so an
+                        # allocation NEVER returns zero DeviceSpecs.
+                        # plan_allocation supplies the same sysfs
+                        # revalidation + iommufd handling passthrough gets.
                         if p.parent_bdf not in self.registry.bdf_to_group:
                             raise AllocationError(
                                 f"partition {uuid}: parent {p.parent_bdf} has "
