@@ -146,3 +146,25 @@ def test_recent_allocations_surface_on_status(rig):
     recent = json.loads(body)["plugins"][0]["recent_allocations"]
     assert recent and recent[0]["devices"] == [["0000:00:04.0"]]
     assert "T" in recent[0]["time"]  # ISO timestamp
+
+
+def test_allocation_counter_in_metrics(rig):
+    import grpc
+    from tpu_device_plugin import kubeletapi as api
+    from tpu_device_plugin.kubeletapi import pb
+    host, manager, status = rig
+    manager.start()
+    plugin = manager.plugins[0]
+    with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+        stub = api.DevicePluginStub(ch)
+        for _ in range(2):
+            stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])]),
+                timeout=5)
+        # failed allocations are never counted
+        with pytest.raises(grpc.RpcError):
+            stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["nope"])]), timeout=5)
+    _, body = _get(status.port, "/metrics")
+    assert ('tpu_plugin_allocations_total'
+            '{resource="cloud-tpus.google.com/v4"} 2') in body.decode()

@@ -83,6 +83,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # last few successful allocations, surfaced on /status for debugging
         # VMI attach issues (what was handed out, when)
         self._recent_allocs: deque = deque(maxlen=16)
+        self._alloc_count = 0  # monotonic, for the Prometheus counter
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -297,10 +298,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "restarts": self._restart_count,
             "devices": devices,
             "pci_errors": errors,
+            "allocations_total": self._alloc_count,
             "recent_allocations": list(self._recent_allocs),
         }
 
     def record_allocation(self, per_container_ids) -> None:
+        with self._cond:  # int += is not atomic across the RPC thread pool
+            self._alloc_count += 1
         self._recent_allocs.append({
             "time": datetime.now(timezone.utc).isoformat(timespec="seconds"),
             "devices": per_container_ids,

@@ -121,6 +121,13 @@ class StatusServer:
             lines.append(
                 f'tpu_plugin_restarts_total{{resource="{p["resource"]}"}} '
                 f'{p["restarts"]}')
+        lines += ["# HELP tpu_plugin_allocations_total Successful Allocate "
+                  "RPCs since plugin start.",
+                  "# TYPE tpu_plugin_allocations_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_allocations_total{{resource="{p["resource"]}"}} '
+                f'{p["allocations_total"]}')
         lines += [
             "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",
             "# TYPE tpu_plugin_pending_plugins gauge",
