@@ -1,0 +1,168 @@
+"""Grand integration: every feature composed against ONE real daemon.
+
+Passthrough + mdev + logical partitions + CDI + labeler feature file +
+metrics + incremental rediscovery + drain + clean shutdown, driven through
+the actual `python -m tpu_device_plugin` process the DaemonSet runs — the
+closest this repo gets to a cluster e2e without a kubelet.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import pb
+
+PORT = 18099
+
+
+def _get(path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{PORT}{path}",
+                                timeout=2) as r:
+        return r.read().decode()
+
+
+def _wait(pred, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except (OSError, KeyError, IndexError, StopIteration):
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def _stub(cfg, sock_name):
+    sock = os.path.join(cfg.device_plugin_path, sock_name)
+    ch = grpc.insecure_channel(f"unix://{sock}")
+    return ch, api.DevicePluginStub(ch)
+
+
+def test_everything_composes(short_root, tmp_path):
+    host = FakeHost(short_root)
+    # two vfio-bound v4 chips (passthrough), one accel-owned v4 chip
+    # (per-core logical partitions), one mdev on a vfio parent
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", numa_node=0))
+    host.add_chip(FakeChip("0000:00:06.0", iommu_group="13",
+                           driver="google-tpu", accel_index=0))
+    host.add_mdev("uuid-m", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    ff = str(tmp_path / "features.d" / "tpu")
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_device_plugin", "--root", host.root,
+         "--partition-config", str(pc),
+         "--cdi-spec-dir", str(tmp_path / "cdi"),
+         "--feature-file", ff,
+         "--rediscovery-seconds", "0.5",
+         "--status-port", str(PORT), "--status-host", "127.0.0.1",
+         "--log-json"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        # 1. three resources register: v4, v4-core, TPU_vhalf
+        assert kubelet.wait_for(3, timeout=20)
+        assert sorted(kubelet.resource_names) == [
+            "cloud-tpus.google.com/TPU_vhalf",
+            "cloud-tpus.google.com/v4",
+            "cloud-tpus.google.com/v4-core",
+        ]
+
+        # 2. labeler feature file reflects the whole inventory
+        assert _wait(lambda: os.path.exists(ff))
+        facts = dict(l.split("=", 1) for l in open(ff).read().splitlines())
+        assert facts["cloud-tpus.google.com/v4.chips"] == "2"
+        assert facts["cloud-tpus.google.com/vtpu.TPU_vhalf"] == "1"
+        assert facts["cloud-tpus.google.com/vtpu.v4-core"] == "2"
+
+        # 3. passthrough Allocate: CDI names + classic specs + env
+        ch, stub = _stub(cfg, "tpukubevirt-v4.sock")
+        with ch:
+            resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["0000:00:05.0"])]),
+                timeout=5)
+            c = resp.container_responses[0]
+            assert [d.container_path for d in c.devices] == \
+                ["/dev/vfio/vfio", "/dev/vfio/12"]
+            assert [x.name for x in c.cdi_devices] == \
+                ["cloud-tpus.google.com/tpu=0000:00:05.0"]
+            assert c.envs["PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4"] == \
+                "0000:00:05.0"
+
+        # 4. mdev + logical allocations through their own plugins
+        ch, stub = _stub(cfg, "tpukubevirt-vtpu-TPU_vhalf.sock")
+        with ch:
+            resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=["uuid-m"])]),
+                timeout=5)
+            assert [d.container_path for d in
+                    resp.container_responses[0].devices] == \
+                ["/dev/vfio/vfio", "/dev/vfio/21"]
+        ch, stub = _stub(cfg, "tpukubevirt-vtpu-v4-core.sock")
+        with ch:
+            resp = stub.Allocate(pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(
+                    devices_ids=["0000:00:06.0-core0"])]), timeout=5)
+            assert [d.container_path for d in
+                    resp.container_responses[0].devices] == ["/dev/accel0"]
+
+        # 5. observability: counters + recent allocations
+        metrics = _get("/metrics")
+        assert ('tpu_plugin_allocations_total'
+                '{resource="cloud-tpus.google.com/v4"} 1') in metrics
+        status = json.loads(_get("/status"))
+        v4 = next(p for p in status["plugins"]
+                  if p["resource"].endswith("/v4"))
+        assert v4["recent_allocations"][0]["devices"] == [["0000:00:05.0"]]
+
+        # 6. incremental rediscovery: hotplug a v5e chip; ONLY v5e registers
+        host.add_chip(FakeChip("0000:01:00.0", device_id="0063",
+                               iommu_group="31"))
+        assert kubelet.wait_for(4, timeout=15)
+        names = kubelet.resource_names
+        assert names.count("cloud-tpus.google.com/v4") == 1
+        assert names.count("cloud-tpus.google.com/v5e") == 1
+        # labeler republished with the new chip
+        assert _wait(lambda: "v5e.chips=1" in open(ff).read())
+
+        # 7. drain -> every device on every plugin Unhealthy; undrain heals
+        proc.send_signal(signal.SIGUSR1)
+        assert _wait(lambda: json.loads(_get("/status"))["draining"] and all(
+            h == "Unhealthy"
+            for p in json.loads(_get("/status"))["plugins"]
+            for h in p["devices"].values()))
+        proc.send_signal(signal.SIGUSR2)
+        assert _wait(lambda: not json.loads(_get("/status"))["draining"] and
+                     all(h == "Healthy"
+                         for p in json.loads(_get("/status"))["plugins"]
+                         for h in p["devices"].values()))
+
+        # 8. clean shutdown: exit 0, sockets gone, JSON logs parse
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=15)
+        assert proc.returncode == 0, out[-500:]
+        assert not any(n.endswith(".sock") and n != "kubelet.sock"
+                       for n in os.listdir(cfg.device_plugin_path))
+        for line in out.splitlines():
+            if line.strip():
+                json.loads(line)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+        kubelet.stop()
