@@ -233,6 +233,45 @@ def test_vfio_parent_backs_at_most_one_partition(tmp_path):
     assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
 
 
+def test_group_mate_of_consumed_parent_excluded_from_passthrough(tmp_path):
+    """Passthrough exclusion is by IOMMU group: a kept chip sharing a group
+    with a consumed partition parent would group-expand in plan_allocation
+    and mount the same /dev/vfio/<group> the vTPU plugin hands out — the
+    kubelet could then grant one VFIO group to two VMIs."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="11"))  # group mate
+    host.add_chip(FakeChip("0000:00:06.0", iommu_group="12"))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "vslice", "parent_bdf": "0000:00:04.0"}]}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    # 04 is consumed AND its group mate 05 must go with it; 06 survives
+    assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:06.0"]
+    assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
+
+
+def test_shared_group_partitions_deduped_across_parents(tmp_path):
+    """VFIO exclusivity is per IOMMU group, not per parent chip: two logical
+    partitions on different parents that share one group still collide in
+    VFIO_GROUP_SET_CONTAINER, so only the first is advertised."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="11"))  # same group
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"partitions": [
+        {"uuid": "p0", "type": "vslice", "parent_bdf": "0000:00:04.0"},
+        {"uuid": "p1", "type": "vslice", "parent_bdf": "0000:00:05.0"}]}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
+    registry, _ = discovery.discover(cfg)
+    assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
+
+
 def test_accel_parent_still_backs_many_partitions(tmp_path):
     """Accel-driver chips multiplex: per-core partitions all survive."""
     import json

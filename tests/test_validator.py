@@ -361,10 +361,29 @@ def test_cli_gpipe_rejects_incompatible_flags():
                  ["--gpipe-microbatches", "3", "--pp", "2"],  # 8 % 3 != 0
                  ["--mode", "infer", "--pp", "2",
                   "--gpipe-microbatches", "2"],
-                 ["--mode", "attn-bench", "--gpipe-microbatches", "2"]):
+                 ["--mode", "attn-bench", "--gpipe-microbatches", "2"],
+                 # ep would replicate the whole pipeline per expert rank
+                 ["--gpipe-microbatches", "2", "--pp", "2",
+                  "--ep", "2", "--experts", "4"]):
         with pytest.raises(SystemExit) as e:
             main(argv)
         assert e.value.code == 2, argv
+
+
+def test_gpipe_loss_fn_rejects_ep_axis():
+    """gpipe_loss_fn must reject an ep mesh axis like it rejects sp/tp —
+    the schedule has no expert dispatch, so ep ranks would silently run
+    identical replicated pipelines."""
+    from tpu_device_plugin.validator.pipeline import gpipe_loss_fn
+    from tpu_device_plugin.validator.workload import init_params
+    import jax.numpy as jnp
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, d_ff=64, n_layers=2,
+                      seq_len=16, batch=8)
+    mesh = slice_mesh(cpus()[:4], pp=2, ep=2)
+    params = init_params(jax.random.key(5), cfg)
+    tokens = jnp.zeros((cfg.batch, cfg.seq_len), jnp.int32)
+    with pytest.raises(ValueError, match="ep"):
+        gpipe_loss_fn(params, tokens, cfg, mesh, n_micro=4)
 
 
 def test_slice_mesh_pp_ep_divisibility_errors():

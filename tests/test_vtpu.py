@@ -292,6 +292,49 @@ def test_preferred_allocation_numa_tiebreak(short_root):
         server.stop(0)
 
 
+def test_probe_receives_parent_node_path(short_root):
+    """Probes run per parent BDF while watch paths are keyed by partition
+    uuid; the probe must still see a representative child's device node so
+    chip_alive's node-presence AND (the degraded-inotify backstop) runs."""
+    import time
+    from dataclasses import replace
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    import json
+    pc = os.path.join(host.root, "partitions.json")
+    with open(pc, "w") as f:
+        f.write(json.dumps({"per_core": True}))
+    cfg = replace(Config().with_root(host.root),
+                  partition_config_path=pc, health_poll_s=0.1)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    from tests.fakehost import FakeKubelet
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    registry, _ = discover(cfg)
+    parts = registry.partitions_by_type["v4-core"]
+    calls = []
+
+    class RecordingShim:
+        def chip_alive(self, pci_base, bdf, node=None):
+            calls.append((bdf, node))
+            return True
+
+    plugin = VtpuDevicePlugin(cfg, "v4-core", registry, parts,
+                              health_shim=RecordingShim())
+    plugin.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not calls:
+            time.sleep(0.02)
+        assert calls, "probe never ran"
+        bdf, node = calls[0]
+        assert bdf == "0000:00:04.0"
+        assert node is not None and node.endswith("accel0")
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
 def test_parent_chip_death_fans_out_to_all_partitions(short_root):
     """One probe per DISTINCT parent; a dead chip (all-FF config space)
     marks every partition of that chip Unhealthy."""

@@ -304,46 +304,54 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
     # or VFIO group; one with neither would hand a VMI zero DeviceSpecs —
     # refuse it here with a reason instead of failing at Allocate time.
     # And a VFIO group attaches to exactly ONE container at a time, so a
-    # vfio-bound parent can back at most ONE advertised partition: a second
-    # VMI's VFIO_GROUP_SET_CONTAINER would fail EBUSY, making any extra
-    # advertised capacity unusable. (Accel-node partitions CAN share — the
-    # accel driver multiplexes.)
+    # vfio-bound IOMMU group can back at most ONE advertised partition —
+    # keyed by group, not parent BDF: two partitions on different parents
+    # that share a group would still collide in VFIO_GROUP_SET_CONTAINER
+    # (EBUSY), making any extra advertised capacity unusable. (Accel-node
+    # partitions CAN share — the accel driver multiplexes.)
     allocatable: List[TpuPartition] = []
-    vfio_parent_seen: Dict[str, str] = {}
+    vfio_group_seen: Dict[str, str] = {}
     for p in partitions:
         if p.provider == "logical" and p.accel_index is None:
-            if p.parent_bdf not in registry.bdf_to_group:
+            parent_group = registry.bdf_to_group.get(p.parent_bdf)
+            if parent_group is None:
                 log.warning(
                     "partition %s (type %s): parent %s has no accel node and "
                     "is not vfio-bound; refusing to advertise an "
                     "unallocatable partition", p.uuid, p.type_name, p.parent_bdf)
                 continue
-            holder = vfio_parent_seen.setdefault(p.parent_bdf, p.uuid)
+            holder = vfio_group_seen.setdefault(parent_group, p.uuid)
             if holder != p.uuid:
                 log.warning(
-                    "partition %s (type %s): parent %s is vfio-bound and its "
-                    "group is already backing partition %s — a VFIO group "
-                    "attaches to one VM at a time, dropping the extra "
-                    "partition", p.uuid, p.type_name, p.parent_bdf, holder)
+                    "partition %s (type %s): parent %s's VFIO group %s is "
+                    "already backing partition %s — a VFIO group attaches to "
+                    "one VM at a time, dropping the extra partition",
+                    p.uuid, p.type_name, p.parent_bdf, parent_group, holder)
                 continue
         allocatable.append(p)
     partitions = allocatable
     # A vfio-bound chip that backs logical partitions is consumed by the vTPU
     # resource: advertising it as passthrough too would let the kubelet grant
-    # the same VFIO group to two VMIs. Remove such chips from the passthrough
-    # device lists (lookup maps stay intact — the vTPU plugin resolves the
-    # parent's group through them). The reference never faces this: mdev
-    # parents are bound to the vendor driver, so the sets are disjoint there.
+    # the same VFIO group to two VMIs. Exclusion is by IOMMU GROUP, not BDF —
+    # plan_allocation expands a passthrough request to its whole group, so a
+    # kept chip sharing a group with a consumed parent would mount the same
+    # /dev/vfio/<group> the vTPU plugin hands out (lookup maps stay intact —
+    # the vTPU plugin resolves the parent's group through them). The
+    # reference never faces this: mdev parents are bound to the vendor
+    # driver, so the sets are disjoint there.
     consumed = {p.parent_bdf for p in partitions
                 if p.provider == "logical" and p.accel_index is None}
-    if consumed:
+    consumed_groups = {registry.bdf_to_group[b] for b in consumed
+                       if b in registry.bdf_to_group}
+    if consumed_groups:
         devices_by_model = {}
         for model, devs in registry.devices_by_model.items():
-            kept = tuple(d for d in devs if d.bdf not in consumed)
+            kept = tuple(d for d in devs
+                         if d.iommu_group not in consumed_groups)
             if kept:
                 devices_by_model[model] = kept
-        log.info("chips %s back logical partitions; excluded from passthrough",
-                 ",".join(sorted(consumed)))
+        log.info("VFIO groups %s back logical partitions; their chips are "
+                 "excluded from passthrough", ",".join(sorted(consumed_groups)))
         registry = Registry(
             devices_by_model=devices_by_model,
             iommu_map=registry.iommu_map,

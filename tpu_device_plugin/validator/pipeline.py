@@ -52,8 +52,11 @@ def gpipe_loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig,
         raise ValueError("gpipe path needs a 'pp' mesh axis "
                          "(slice_mesh(..., pp=N) with N > 1)")
     n_stages = axis_sizes["pp"]
-    if axis_sizes.get("sp", 1) != 1 or axis_sizes.get("tp", 1) != 1:
-        raise ValueError("gpipe path needs sp == tp == 1 (pp x dp mesh)")
+    if axis_sizes.get("sp", 1) != 1 or axis_sizes.get("tp", 1) != 1 \
+            or axis_sizes.get("ep", 1) != 1:
+        # ep would silently replicate the whole pipeline per expert rank
+        # (no expert dispatch in this schedule) — reject like sp/tp
+        raise ValueError("gpipe path needs sp == tp == ep == 1 (pp x dp mesh)")
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by "
                          f"pp={n_stages}")

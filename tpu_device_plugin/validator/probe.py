@@ -332,8 +332,8 @@ def main(argv=None) -> int:
             parser.error("--gpipe-microbatches only applies to --mode train")
         if (args.pp or 0) < 2:
             parser.error("--gpipe-microbatches needs --pp >= 2")
-        if (args.tp or 1) != 1 or (args.sp or 1) != 1:
-            parser.error("--gpipe-microbatches needs tp == sp == 1")
+        if (args.tp or 1) != 1 or (args.sp or 1) != 1 or (args.ep or 1) != 1:
+            parser.error("--gpipe-microbatches needs tp == sp == ep == 1")
         if args.attention != "auto":
             parser.error("the GPipe schedule runs einsum attention; "
                          "drop --attention")

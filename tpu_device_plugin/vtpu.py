@@ -86,6 +86,15 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     # allocation will mount
                     paths[p.uuid] = self.cfg.dev_path("dev/vfio", group)
             children.setdefault(p.parent_bdf, []).append(p.uuid)
+        # Probes are keyed by parent BDF while `paths` is keyed by partition
+        # uuid — resolve a representative child node per parent so the
+        # node-presence AND inside chip_alive (the degraded-inotify backstop)
+        # actually sees the node the allocation mounts.
+        parent_node: Dict[str, str] = {}
+        for p in self.partitions:
+            node = paths.get(p.uuid)
+            if node is not None:
+                parent_node.setdefault(p.parent_bdf, node)
 
         def on_health(key: str, ok: bool, src: str) -> None:
             # fs events arrive keyed by partition uuid; probe verdicts by
@@ -100,8 +109,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             group_bdfs={parent: [parent] for parent in children},
             on_device_health=on_health,
             on_socket_removed=self._restart_async,
-            probe=lambda bdf, node: self.health_shim.chip_alive(
-                self.cfg.pci_base_path, bdf, node),
+            probe=lambda bdf, _node: self.health_shim.chip_alive(
+                self.cfg.pci_base_path, bdf, parent_node.get(bdf)),
             poll_interval_s=self.cfg.health_poll_s,
             stop_event=self._stop,
         )
