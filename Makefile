@@ -25,14 +25,15 @@ test:
 	$(PYTHON) -m pytest tests/ -q
 
 # Enforced coverage (reference: Makefile:59-61 + golang.yml Coveralls job).
-# No silent fallback: a missing pytest-cov or a coverage drop below the
-# threshold fails the target, and CI runs this as a required job.
-# 75 is a conservative floor chosen without a local measurement (the build
-# image lacks pytest-cov); ratchet it up once CI reports the real number.
-COV_MIN ?= 75
+# The image ships no pytest-cov, so the collector is a stdlib sys.monitoring
+# harness (scripts/stdlib_coverage.py). Floor = 91: measured 92.1%
+# (3151/3421 lines) on 2026-07-29, rounded down one point. The 0%-covered
+# __main__ stubs and the generated *_pb2 module are inside that number, not
+# excluded.
+COV_MIN ?= 91
 coverage:
-	$(PYTHON) -m pytest tests/ -q --cov=tpu_device_plugin \
-		--cov-report=term-missing --cov-fail-under=$(COV_MIN)
+	$(PYTHON) scripts/stdlib_coverage.py --fail-under $(COV_MIN) \
+		--json-out coverage.json
 
 bench:
 	$(PYTHON) bench.py

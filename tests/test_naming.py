@@ -16,6 +16,22 @@ def test_builtin_generations():
     assert table["0063"].host_topology == (2, 4)
 
 
+def test_defaults_are_the_packaged_json():
+    """DEFAULT_GENERATIONS must be exactly the packaged data file — the
+    single-source contract (no duplicated literal to drift)."""
+    from importlib import resources
+    raw = json.loads((resources.files("tpu_device_plugin") / "data" /
+                      "tpu_ids.json").read_text(encoding="utf-8"))
+    data_ids = {k.lower() for k in raw if not k.startswith("_")}
+    assert data_ids == set(naming.DEFAULT_GENERATIONS)
+    for dev_id in data_ids:
+        info = naming.DEFAULT_GENERATIONS[dev_id]
+        assert info.name == raw[dev_id]["name"]
+        assert info.host_topology == tuple(raw[dev_id]["host_topology"])
+        assert info.chips_per_host == raw[dev_id]["chips_per_host"]
+        assert info.cores_per_chip == raw[dev_id].get("cores_per_chip", 1)
+
+
 def test_generation_map_override(tmp_path):
     p = tmp_path / "gens.json"
     p.write_text(json.dumps({

@@ -50,7 +50,7 @@ class Config:
     vendor_ids: tuple[str, ...] = ("1ae0",)  # Google, Inc.
     vfio_drivers: tuple[str, ...] = ("vfio-pci",)
     # Optional JSON file overriding the built-in device-id → generation table
-    # (utils/tpu_ids.json ships the defaults; real fleets may override).
+    # (tpu_device_plugin/data/tpu_ids.json ships the defaults; fleets override).
     generation_map_path: Optional[str] = None
     # Optional JSON file mapping BDF → ICI torus coordinates for hosts whose
     # physical chip order differs from BDF order.

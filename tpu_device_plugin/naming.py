@@ -29,19 +29,36 @@ class GenerationInfo:
     cores_per_chip: int = 1       # logical vTPU partitions a chip supports
 
 
-# Built-in defaults. pci.ids has no Cloud TPU ids, and Google does not publish
-# a PCI-id table for TPUs, so these ids are *placeholders chosen for tests and
-# examples*; production fleets override via utils/tpu_ids.json or
-# --generation-map (Config.generation_map_path). The table shape — id →
-# generation + host torus — is the contract; the key values are data.
-DEFAULT_GENERATIONS: Dict[str, GenerationInfo] = {
-    # 3D-torus generations: 4 chips/host arranged 2x2x1.
-    "0062": GenerationInfo("v4", 4, (2, 2, 1), cores_per_chip=2),
-    "0064": GenerationInfo("v5p", 4, (2, 2, 1), cores_per_chip=2),
-    # 2D-torus generations: v5e-8 hosts expose 8 chips as 2x4.
-    "0063": GenerationInfo("v5e", 8, (2, 4), cores_per_chip=1),
-    "0065": GenerationInfo("v6e", 8, (2, 4), cores_per_chip=1),
-}
+def _parse_generation(info: dict) -> GenerationInfo:
+    return GenerationInfo(
+        name=str(info["name"]),
+        chips_per_host=int(info["chips_per_host"]),
+        host_topology=tuple(int(d) for d in info["host_topology"]),
+        cores_per_chip=int(info.get("cores_per_chip", 1)),
+    )
+
+
+def _load_packaged_defaults() -> Dict[str, GenerationInfo]:
+    """Parse the packaged tpu_ids.json — the ONE authoritative table.
+
+    pci.ids has no Cloud TPU ids, and Google does not publish a PCI-id table
+    for TPUs, so the ids in data/tpu_ids.json are *placeholders chosen for
+    tests and examples*; production fleets override via --generation-map
+    (Config.generation_map_path). The table shape — id → generation + host
+    torus — is the contract; the key values are data. Strict parse: a broken
+    packaged file is a broken install and should fail loudly at import.
+    """
+    from importlib import resources
+    text = (resources.files(__package__) / "data" / "tpu_ids.json") \
+        .read_text(encoding="utf-8")
+    return {
+        dev_id.lower(): _parse_generation(info)
+        for dev_id, info in json.loads(text).items()
+        if not dev_id.startswith("_")  # "_comment" documentation key
+    }
+
+
+DEFAULT_GENERATIONS: Dict[str, GenerationInfo] = _load_packaged_defaults()
 
 _SANITIZE_KEEP = re.compile(r"[^A-Z0-9_]")
 
@@ -76,13 +93,10 @@ def load_generation_map(path: Optional[str]) -> Dict[str, GenerationInfo]:
         log.warning("generation map %s unreadable (%s); using built-ins", path, exc)
         return table
     for dev_id, info in raw.items():
+        if dev_id.startswith("_"):
+            continue  # "_comment" documentation key
         try:
-            table[dev_id.lower()] = GenerationInfo(
-                name=str(info["name"]),
-                chips_per_host=int(info["chips_per_host"]),
-                host_topology=tuple(int(d) for d in info["host_topology"]),
-                cores_per_chip=int(info.get("cores_per_chip", 1)),
-            )
+            table[dev_id.lower()] = _parse_generation(info)
         except (KeyError, TypeError, ValueError) as exc:
             log.warning("generation map entry %r invalid (%s); skipped", dev_id, exc)
     return table
