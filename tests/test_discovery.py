@@ -198,23 +198,27 @@ def test_colliding_partition_type_dropped_keeps_passthrough(tmp_path):
 
 
 def test_vfio_driver_variants_accepted(tmp_path):
-    """A second VFIO driver variant is accepted when configured (reference
-    accepts nvgrace_gpu_vfio_pci alongside vfio-pci, device_plugin.go:75-78);
-    the --vfio-drivers CLI flag feeds Config.vfio_drivers."""
+    """The vendor-variant driver name works OUT OF THE BOX (reference accepts
+    nvgrace_gpu_vfio_pci alongside vfio-pci by default, device_plugin.go:75-78);
+    further variants come via the --vfio-drivers CLI flag."""
     host = FakeHost(tmp_path)
     host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
                            driver="tpu_vfio_pci"))
-    # default config: unknown driver -> not discovered
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12",
+                           driver="future_tpu_vfio"))
+    # default config: built-in variant discovered, unknown driver is not
     registry, _ = discovery.discover_passthrough(make_cfg(host))
-    assert registry.devices_by_model == {}
-    # variant configured -> discovered
-    cfg = make_cfg(host, vfio_drivers=("vfio-pci", "tpu_vfio_pci"))
-    registry, _ = discovery.discover_passthrough(cfg)
     assert [d.bdf for d in registry.devices_by_model["0062"]] == ["0000:00:04.0"]
+    # extra variant configured -> both discovered
+    cfg = make_cfg(host, vfio_drivers=("vfio-pci", "tpu_vfio_pci",
+                                       "future_tpu_vfio"))
+    registry, _ = discovery.discover_passthrough(cfg)
+    assert [d.bdf for d in registry.devices_by_model["0062"]] == [
+        "0000:00:04.0", "0000:00:05.0"]
     # CLI flag parses into the tuple
     from tpu_device_plugin.cli import build_config
-    parsed, _ = build_config(["--vfio-drivers", "vfio-pci, tpu_vfio_pci"])
-    assert parsed.vfio_drivers == ("vfio-pci", "tpu_vfio_pci")
+    parsed, _ = build_config(["--vfio-drivers", "vfio-pci, future_tpu_vfio"])
+    assert parsed.vfio_drivers == ("vfio-pci", "future_tpu_vfio")
 
 
 def test_vfio_parent_backs_at_most_one_partition(tmp_path):

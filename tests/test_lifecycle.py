@@ -154,6 +154,29 @@ def test_discover_only_dumps_inventory(tmp_path, capsys):
     assert payload["partitions"]["TPU_vhalf"][0]["uuid"] == "uuid-1"
     assert payload["iommu_groups"]["11"] == ["0000:00:04.0"]
     assert payload["node_facts"]["cloud-tpus.google.com/v4.chips"] == "1"
+    assert payload["unmatched_device_ids"] == []
+
+
+def test_discover_only_warns_per_unmatched_id(tmp_path, capsys, caplog):
+    """An id outside the generation table gets a per-id warning naming the
+    fallback resource (the packaged ids are placeholders — operators must
+    learn they need --generation-map before names mean anything)."""
+    import json
+    import logging
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           device_id="00ff"))
+    from tpu_device_plugin.cli import main
+    with caplog.at_level(logging.WARNING):
+        rc = main(["--root", str(tmp_path), "--discover-only"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["unmatched_device_ids"] == ["00ff"]
+    warnings = [r for r in caplog.records
+                if "not in the generation table" in r.getMessage()]
+    assert len(warnings) == 1
+    assert "00ff" in warnings[0].getMessage()
+    assert "TPU_00FF" in warnings[0].getMessage()  # the fallback name
 
 
 def test_incremental_rediscovery_spares_unchanged_resources(kubelet):

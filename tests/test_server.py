@@ -97,6 +97,32 @@ def test_list_and_watch_health_transitions(rig):
     assert _wait(lambda: updates[-1]["0000:00:06.0"] == "Healthy")
 
 
+def test_list_and_watch_client_cancel_frees_worker(rig):
+    """The event-driven stream sleeps on the condvar with no timeout; a
+    client cancel must wake it via the RPC-termination callback so the
+    worker thread is freed (not pinned until the next health event)."""
+    host, cfg, kubelet, plugin = rig
+    before = {t.name for t in threading.enumerate()}
+    calls = []
+    for i in range(3):
+        ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+        call = api.DevicePluginStub(ch).ListAndWatch(pb.Empty())
+        next(call)  # initial list delivered; stream now parked on condvar
+        calls.append((ch, call))
+    for ch, call in calls:
+        call.cancel()
+        ch.close()
+    # the freed workers must be able to serve new RPCs: the pool has 8
+    # threads, so burn through 8 fresh streams to prove none stayed pinned
+    for i in range(8):
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            call = api.DevicePluginStub(ch).ListAndWatch(pb.Empty())
+            assert len(next(call).devices) == 4
+            call.cancel()
+    assert _wait(
+        lambda: len({t.name for t in threading.enumerate()} - before) <= 8)
+
+
 def test_allocate_and_preferred_over_socket(rig):
     host, cfg, kubelet, plugin = rig
     with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:

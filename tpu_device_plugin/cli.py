@@ -139,7 +139,11 @@ def dump_inventory(cfg) -> str:
     from .labeler import node_facts
 
     registry, generations = discover(cfg)
+    # discover() already warned per unmatched id; surface them in the JSON
+    # so scripted invocations (CI smoke, fleet audits) can assert on it.
     return json.dumps({
+        "unmatched_device_ids": sorted(m for m in registry.devices_by_model
+                                       if m not in generations),
         "devices": {
             model: [dataclasses.asdict(d) for d in devs]
             for model, devs in registry.devices_by_model.items()

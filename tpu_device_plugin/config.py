@@ -48,7 +48,13 @@ class Config:
 
     # --- discovery filters --------------------------------------------------
     vendor_ids: tuple[str, ...] = ("1ae0",)  # Google, Inc.
-    vfio_drivers: tuple[str, ...] = ("vfio-pci",)
+    # Accepted out of the box: the generic driver plus a vendor-variant name,
+    # mirroring the reference's built-in second driver (nvgrace_gpu_vfio_pci,
+    # device_plugin.go:75-78). No TPU vfio variant driver is public today;
+    # accepting the plausible name is harmless (the vendor-id filter still
+    # gates discovery) and saves operator action if one ships. More via
+    # --vfio-drivers.
+    vfio_drivers: tuple[str, ...] = ("vfio-pci", "tpu_vfio_pci")
     # Optional JSON file overriding the built-in device-id → generation table
     # (tpu_device_plugin/data/tpu_ids.json ships the defaults; fleets override).
     generation_map_path: Optional[str] = None

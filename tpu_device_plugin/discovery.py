@@ -288,10 +288,20 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
     # parent chips stay advertised as passthrough instead of being consumed
     # by a plugin that can never be built.
     from .naming import resource_name_for
-    passthrough_suffixes = {
-        resource_name_for(m, generations, cfg.pci_ids_path)
-        for m in registry.devices_by_model
-    }
+    passthrough_suffixes = set()
+    for m in registry.devices_by_model:
+        suffix = resource_name_for(m, generations, cfg.pci_ids_path)
+        passthrough_suffixes.add(suffix)
+        if m not in generations:
+            # The packaged ids are documented placeholders (no public Cloud
+            # TPU PCI-id table): an unmatched id on a real fleet means the
+            # operator must supply --generation-map before resource names
+            # mean anything. Warn on BOTH entry points (daemon and
+            # --discover-only) — this is the shared path.
+            log.warning(
+                "device id %s is not in the generation table; advertising "
+                "fallback resource name %r — supply --generation-map with "
+                "this fleet's real ids (see utils/README.md)", m, suffix)
     kept: List[TpuPartition] = []
     for p in partitions:
         if p.type_name in passthrough_suffixes:

@@ -321,18 +321,29 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def ListAndWatch(self, request, context):
         """Initial full list, then a re-send on every health transition
-        (reference :312-349)."""
+        (reference :312-349). Purely event-driven: the stream thread sleeps
+        on the condvar with NO timeout — wakeups come from health
+        transitions (_cond.notify_all), teardown, and an RPC-termination
+        callback that fires when the kubelet drops the stream (otherwise a
+        dead stream would pin its worker thread on the condvar forever)."""
         version, devices = self._snapshot()
         log.info("%s: ListAndWatch stream opened (%d devices)",
                  self.resource_name, len(devices))
         yield pb.ListAndWatchResponse(devices=devices)
-        while not self._stop.is_set() and context.is_active():
+
+        def wake() -> None:
+            with self._cond:
+                self._cond.notify_all()
+
+        if not context.add_callback(wake):
+            return  # RPC already terminated
+        while True:
             with self._cond:
                 self._cond.wait_for(
-                    lambda: self._version != version or self._stop.is_set(),
-                    timeout=0.5)
-                if self._stop.is_set() or self._version == version:
-                    continue
+                    lambda: self._version != version or self._stop.is_set()
+                    or not context.is_active())
+                if self._stop.is_set() or not context.is_active():
+                    return
             version, devices = self._snapshot()
             log.info("%s: device state changed; re-sending %d devices",
                      self.resource_name, len(devices))
