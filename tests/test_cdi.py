@@ -195,6 +195,22 @@ def test_accel_partitions_get_cdi_names(short_root, tmp_path):
         kubelet.stop()
 
 
+def test_partition_cdi_entries_carry_node_permissions(tmp_path):
+    """The CDI spec must carry --partition-node-permissions: without it a
+    CDI-aware kubelet injects the accel node with runtime-default (rwm)
+    access, bypassing the operator's read-only policy."""
+    from tpu_device_plugin.cdi import partition_entries
+    from tpu_device_plugin.registry import TpuPartition
+    cfg = replace(Config().with_root(str(tmp_path)),
+                  partition_node_permissions="r")
+    parts = [TpuPartition(uuid="u0", type_name="v4-core",
+                          parent_bdf="0000:00:04.0", numa_node=0,
+                          provider="logical", accel_index=0)]
+    entries = partition_entries(cfg, parts)
+    node = entries[0]["containerEdits"]["deviceNodes"][0]
+    assert node["permissions"] == "r"
+
+
 def test_prune_stale_specs(host2, tmp_path):
     cfg = replace(Config().with_root(host2.root),
                   cdi_spec_dir=str(tmp_path / "cdi"))

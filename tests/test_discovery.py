@@ -276,6 +276,40 @@ def test_shared_group_partitions_deduped_across_parents(tmp_path):
     assert [p.uuid for p in registry.partitions_by_type["vslice"]] == ["p0"]
 
 
+def test_max_partitions_per_chip_caps_accel_backed(tmp_path):
+    """--max-partitions-per-chip bounds the blast radius of unisolated
+    accel-node sharing regardless of what the partition config declares;
+    mdev partitions (kernel-mediated) are not capped."""
+    import json
+    from dataclasses import replace
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", numa_node=0))
+    host.add_mdev("m0", "TPU vhalf", "0000:00:05.0")
+    host.add_mdev("m1", "TPU vhalf", "0000:00:05.0")
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json.dumps({"per_core": True}))
+    cfg = replace(Config().with_root(host.root), partition_config_path=str(pc),
+                  max_partitions_per_chip=1)
+    registry, _ = discovery.discover(cfg)
+    # per_core would advertise cores_per_chip=2; the cap keeps only core0
+    assert [p.uuid for p in registry.partitions_by_type["v4-core"]] == \
+        ["0000:00:04.0-core0"]
+    # mdev partitions are untouched by the cap
+    assert len(registry.partitions_by_type["TPU_vhalf"]) == 2
+    # cap=0 (default) leaves everything advertised
+    cfg0 = replace(cfg, max_partitions_per_chip=0)
+    registry0, _ = discovery.discover(cfg0)
+    assert len(registry0.partitions_by_type["v4-core"]) == 2
+    # CLI flags parse into Config
+    from tpu_device_plugin.cli import build_config
+    parsed, _ = build_config(["--max-partitions-per-chip", "3",
+                              "--partition-node-permissions", "r"])
+    assert parsed.max_partitions_per_chip == 3
+    assert parsed.partition_node_permissions == "r"
+
+
 def test_accel_parent_still_backs_many_partitions(tmp_path):
     """Accel-driver chips multiplex: per-core partitions all survive."""
     import json

@@ -182,6 +182,36 @@ def test_logical_partition_allocate_mounts_accel(short_root, tmp_path):
         server.stop(0)
 
 
+def test_logical_partition_readonly_node_permissions(short_root, tmp_path):
+    """--partition-node-permissions r: accel-backed partitions hand the VMI
+    a read-only node (docs/design.md, vTPU trust boundary)."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=3))
+    pc = tmp_path / "partitions.json"
+    import json
+    pc.write_text(json.dumps({"per_core": True}))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root),
+                  partition_config_path=str(pc),
+                  partition_node_permissions="r")
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover(cfg)
+    parts = registry.partitions_by_type["v4-core"]
+    plugin = VtpuDevicePlugin(cfg, "v4-core", registry, parts)
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            resp = api.DevicePluginStub(ch).Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(
+                        devices_ids=["0000:00:04.0-core0"])]),
+                timeout=5)
+            assert resp.container_responses[0].devices[0].permissions == "r"
+    finally:
+        server.stop(0)
+
+
 def test_logical_partition_without_accel_mounts_parent_group(short_root, tmp_path):
     """Explicit partition of a vfio-bound parent with no accel node: the VMI
     must still receive DeviceSpecs — the parent's VFIO group (VERDICT r1 #4)."""

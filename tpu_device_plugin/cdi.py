@@ -75,8 +75,13 @@ def partition_entries(cfg: Config, partitions: Sequence[TpuPartition],
     for p in partitions:
         nodes = []
         if p.accel_index is not None:
+            # carry the operator's node-permission policy into the CDI path
+            # too — otherwise a CDI-aware kubelet would inject the node with
+            # runtime-default (rwm) access, bypassing
+            # --partition-node-permissions r
             nodes.append({"path": f"/dev/accel{p.accel_index}",
-                          "hostPath": cfg.dev_path("dev", f"accel{p.accel_index}")})
+                          "hostPath": cfg.dev_path("dev", f"accel{p.accel_index}"),
+                          "permissions": cfg.partition_node_permissions})
         elif p.provider != "mdev" and bdf_to_group is not None:
             group = bdf_to_group.get(p.parent_bdf)
             # legacy VFIO group node only (iommufd-only hosts have no

@@ -45,6 +45,17 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="JSON mapping BDF → ICI torus coordinates")
     parser.add_argument("--partition-config", default=None,
                         help="JSON declaring logical vTPU partitions")
+    parser.add_argument("--max-partitions-per-chip", type=int,
+                        default=cfg.max_partitions_per_chip,
+                        help="cap advertised accel-backed logical partitions "
+                             "per parent chip (0 = no extra cap); bounds the "
+                             "blast radius of unisolated chip sharing (see "
+                             "docs/design.md, vTPU trust boundary)")
+    parser.add_argument("--partition-node-permissions",
+                        choices=("r", "rw"),
+                        default=cfg.partition_node_permissions,
+                        help="device-node permissions VMIs get for "
+                             "accel-backed logical partitions")
     parser.add_argument("--native-lib", default=None,
                         help="path to libtpuhealth.so")
     parser.add_argument("--cdi-spec-dir", default=None,
@@ -81,6 +92,10 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              "pipelines)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
+    if args.max_partitions_per_chip < 0:
+        parser.error("--max-partitions-per-chip must be >= 0 "
+                     "(0 = no extra cap); negative values would silently "
+                     "disable the cap")
 
     level = logging.DEBUG if args.verbose else logging.INFO
     if args.log_json:
@@ -120,6 +135,8 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         generation_map_path=args.generation_map,
         topology_hints_path=args.topology_file,
         partition_config_path=args.partition_config,
+        max_partitions_per_chip=args.max_partitions_per_chip,
+        partition_node_permissions=args.partition_node_permissions,
         native_lib_path=args.native_lib,
         cdi_spec_dir=args.cdi_spec_dir,
         health_poll_s=args.health_poll_seconds,

@@ -66,6 +66,17 @@ class Config:
     # Optional JSON file declaring logical partitions of physical chips for
     # hosts without mdev support (see vtpu.py).
     partition_config_path: Optional[str] = None
+    # Hard cap on advertised logical partitions per parent chip (0 = only
+    # the generation's cores_per_chip / the explicit list applies). Logical
+    # partitions share one /dev/accelN with NO hardware isolation
+    # (docs/design.md "vTPU trust boundary") — the cap bounds the blast
+    # radius of one chip's tenants.
+    max_partitions_per_chip: int = 0
+    # Device-node permissions handed to VMIs for accel-backed logical
+    # partitions: "rw" (default) or "r" where the guest stack tolerates a
+    # read-only node. mdev/vfio-backed partitions keep "mrw" — VFIO needs
+    # mmap, and isolation there is kernel-mediated anyway.
+    partition_node_permissions: str = "rw"
 
     # --- shared host devices (EGM analogue, reference #9) -------------------
     # sysfs class dirs scanned for shared devices spanning multiple chips;

@@ -340,6 +340,26 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
                 continue
         allocatable.append(p)
     partitions = allocatable
+    # Operator-set blast-radius cap: accel-backed logical partitions share
+    # one /dev/accelN with no hardware isolation (docs/design.md "vTPU
+    # trust boundary"), so a fleet can bound tenants-per-chip regardless of
+    # what the partition config declares. mdev (kernel-mediated) and
+    # vfio-backed (already 1/group) partitions are not capped.
+    if cfg.max_partitions_per_chip > 0:
+        per_parent: Dict[str, int] = {}
+        capped: List[TpuPartition] = []
+        for p in partitions:
+            if p.provider == "logical" and p.accel_index is not None:
+                n = per_parent.get(p.parent_bdf, 0)
+                if n >= cfg.max_partitions_per_chip:
+                    log.warning(
+                        "partition %s (type %s): parent %s already has %d "
+                        "advertised partitions (--max-partitions-per-chip); "
+                        "dropping", p.uuid, p.type_name, p.parent_bdf, n)
+                    continue
+                per_parent[p.parent_bdf] = n + 1
+            capped.append(p)
+        partitions = capped
     # A vfio-bound chip that backs logical partitions is consumed by the vTPU
     # resource: advertising it as passthrough too would let the kubelet grant
     # the same VFIO group to two VMIs. Exclusion is by IOMMU GROUP, not BDF —

@@ -164,8 +164,12 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                             # wide mount of the vfio dir (:229-233)
                             add(self.cfg.dev_path("dev/vfio"), "/dev/vfio")
                     elif p.accel_index is not None:
+                        # permissions are operator policy (docs/design.md
+                        # "vTPU trust boundary"): "rw" default, "r" for
+                        # fleets whose guest stack tolerates it
                         add(self.cfg.dev_path("dev", f"accel{p.accel_index}"),
-                            f"/dev/accel{p.accel_index}", "rw")
+                            f"/dev/accel{p.accel_index}",
+                            self.cfg.partition_node_permissions)
                     else:
                         # Logical partition of a vfio-bound parent: the guest
                         # can only reach the chip through its VFIO group, so
