@@ -1,4 +1,12 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh before any jax import."""
+"""Test env: force JAX onto a virtual 8-device CPU mesh before any jax import.
+
+Exception: TDP_TPU_TESTS=1 leaves the platform un-pinned so the `-m tpu`
+Mosaic-compile gate (tests/test_tpu_gate.py) can claim the real chip. Use it
+only for that file — running the whole suite that way would put every jax
+test in contention for the single exclusive-claim TPU:
+
+    TDP_TPU_TESTS=1 python -m pytest tests/test_tpu_gate.py -v
+"""
 
 import os
 import shutil
@@ -7,7 +15,9 @@ import tempfile
 
 import pytest
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_want_tpu = os.environ.get("TDP_TPU_TESTS") == "1"
+if not _want_tpu:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -16,14 +26,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 # sitecustomize, overriding JAX_PLATFORMS; initializing it would contend for
 # the (single) real chip from every test process. Pin the config to CPU
 # before any backend initialization.
-try:
-    import jax
+if not _want_tpu:
+    try:
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs a real TPU backend (TDP_TPU_TESTS=1)")
 
 
 @pytest.fixture

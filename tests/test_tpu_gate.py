@@ -1,0 +1,125 @@
+"""Mosaic-compile gate: the Pallas kernels on the REAL TPU backend.
+
+The rest of the suite runs the flash/ring kernels in `interpret=True` or on
+the CPU mesh, which does not exercise Mosaic lowering constraints (tiling,
+scratch layouts, VMEM limits). This gate AOT-lowers + compiles + runs:
+
+  - flash_attention forward at blocks 128x128 and 256x128
+  - flash_attention forward+backward (custom-VJP Pallas bwd kernels)
+  - one ring_attention step under shard_map on a TPU mesh
+
+It skips cleanly off-TPU (the conftest pins CPU unless TDP_TPU_TESTS=1), so
+plain CI never touches hardware; in a healthy-chip window it runs in minutes:
+
+    TDP_TPU_TESTS=1 python -m pytest tests/test_tpu_gate.py -v
+
+Reference analogue: the NVML-verified health path is the reference's only
+hardware-touching claim (generic_vgpu_device_plugin.go:387-433); here the
+hardware-touching claims are the Mosaic kernels, so this is their gate.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_device_plugin.validator.flash_attention import flash_attention  # noqa: E402
+from tpu_device_plugin.validator.ring_attention import ring_attention  # noqa: E402
+
+
+def _tpu_devices():
+    try:
+        return [d for d in jax.devices() if d.platform == "tpu"]
+    except Exception:
+        return []
+
+
+requires_tpu = pytest.mark.skipif(
+    not _tpu_devices(),
+    reason="no TPU backend (run with TDP_TPU_TESTS=1 on a TPU host)")
+
+HB, SEQ, D = 4, 512, 128
+
+
+def _qkv(seed=0, dtype=jnp.bfloat16):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((HB, SEQ, D), dtype=np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+def _reference(q, k, v):
+    """Plain einsum causal attention in f32 (the oracle)."""
+    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * (D ** -0.5)
+    mask = jnp.tril(jnp.ones((SEQ, SEQ), jnp.bool_))[None]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, vf)
+
+
+@requires_tpu
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (256, 128)])
+def test_flash_forward_mosaic_compiles_and_matches(block_q, block_k):
+    q, k, v = _qkv()
+    fn = jax.jit(functools.partial(
+        flash_attention, causal=True, block_q=block_q, block_k=block_k))
+    compiled = fn.lower(q, k, v).compile()   # Mosaic lowering happens here
+    out = np.asarray(compiled(q, k, v), np.float32)
+    ref = np.asarray(_reference(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
+
+
+@requires_tpu
+@pytest.mark.parametrize("block_q,block_k", [(128, 128), (256, 128)])
+def test_flash_backward_mosaic_compiles_and_matches(block_q, block_k):
+    q, k, v = _qkv(seed=1)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=block_q,
+                               block_k=block_k).astype(jnp.float32).sum()
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    compiled = grad_fn.lower(q, k, v).compile()  # bwd dkv + dq kernels
+    dq, dk, dv = compiled(q, k, v)
+
+    def ref_loss(q, k, v):
+        return _reference(q, k, v).sum()
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want in ((dq, rq), (dk, rk), (dv, rv)):
+        got = np.asarray(got, np.float32)
+        want = np.asarray(want, np.float32)
+        assert np.isfinite(got).all()
+        # bf16 grads over 512-long softmax rows: loose but real agreement
+        np.testing.assert_allclose(got, want, atol=1e-1, rtol=1e-1)
+
+
+@requires_tpu
+def test_ring_attention_step_compiles_on_tpu_mesh():
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = _tpu_devices()
+    mesh = Mesh(np.array(devs[:1]), ("sp",))
+    q, k, v = _qkv(seed=2)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(None, "sp", None),) * 3,
+                       out_specs=P(None, "sp", None))
+    def step(q, k, v):
+        return ring_attention(q, k, v, D ** -0.5, axis_name="sp")
+
+    fn = jax.jit(step)
+    compiled = fn.lower(q, k, v).compile()
+    out = np.asarray(compiled(q, k, v), np.float32)
+    ref = np.asarray(_reference(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
