@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun
+.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements
 
 all: native proto
 
@@ -47,6 +47,20 @@ dryrun:
 # a curated subset — see utils/README.md).
 update-pcidb:
 	curl -fsSL -o utils/pci.ids https://pci-ids.ucw.cz/v2.2/pci.ids
+
+# Pin sha256 hashes into the image requirements (network required). The
+# hashed file is installed by BOTH the image build (cp311, distroless base)
+# and the CI unit job (cp312), so download wheels for each target and merge
+# every hash per distribution (scripts/hash_requirements.py dedupes).
+REQS = deployments/container/requirements.txt
+hash-requirements:
+	rm -rf build/wheels && mkdir -p build/wheels
+	for pyver in 311 312; do \
+	    $(PYTHON) -m pip download --no-deps --only-binary :all: \
+	        --implementation cp --python-version $$pyver \
+	        --platform manylinux2014_x86_64 -d build/wheels -r $(REQS); \
+	done
+	$(PYTHON) scripts/hash_requirements.py $(REQS) build/wheels
 
 image:
 	docker build -f deployments/container/Dockerfile -t $(IMAGE):$(VERSION) .

@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Rewrite a requirements file with sha256 --hash lines from a wheel dir.
+
+Usage: python scripts/hash_requirements.py <requirements.txt> <wheel-dir>
+
+`make hash-requirements` drives this: `pip download --no-deps` fills the
+wheel dir (network needed), then every `name==version` line gains the
+downloaded artifacts' hashes. Once any --hash line is present, pip enforces
+hashes for the whole file at install time, so the image build gets integrity
+pinning with no Dockerfile change.
+"""
+
+import hashlib
+import os
+import re
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    req_path, wheel_dir = sys.argv[1], sys.argv[2]
+
+    hashes = {}
+    for fname in sorted(os.listdir(wheel_dir)):
+        if not fname.endswith((".whl", ".tar.gz", ".zip")):
+            continue
+        dist = re.split(r"-\d", fname, maxsplit=1)[0]
+        key = dist.lower().replace("_", "-")
+        with open(os.path.join(wheel_dir, fname), "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        if digest not in hashes.setdefault(key, []):  # pure-py wheels repeat
+            hashes[key].append(digest)
+
+    out = []
+    with open(req_path, encoding="utf-8") as f:
+        for line in f:
+            stripped = line.strip()
+            m = re.match(r"^([A-Za-z0-9._-]+)==\S+", stripped)
+            if not m:
+                # keep comments/blank lines; drop stale continuation hashes
+                if not stripped.startswith("--hash="):
+                    out.append(line.rstrip("\n"))
+                continue
+            key = m.group(1).lower().replace("_", "-")
+            # idempotent: strip any line-continuation backslash left by a
+            # previous run before re-emitting the pin
+            pinned = (stripped.split("#", 1)[0].split("--hash=", 1)[0]
+                      .strip().rstrip("\\").strip())
+            if key not in hashes:
+                print(f"error: no downloaded artifact for {key}",
+                      file=sys.stderr)
+                return 1
+            out.append(pinned + " \\")
+            digests = [f"    --hash=sha256:{h}" for h in hashes[key]]
+            out.extend(d + " \\" for d in digests[:-1])
+            out.append(digests[-1])
+    with open(req_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"hashed {len(hashes)} distribution(s) into {req_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

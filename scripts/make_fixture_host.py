@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Materialize a fake TPU host tree for the image smoke test.
+
+Usage: python scripts/make_fixture_host.py <root>
+
+Builds the same sysfs/devfs shape the unit suites use (tests/fakehost.py,
+modeled on the reference's tmpdir fixtures,
+pkg/device_plugin/device_plugin_test.go:279-323): four passthrough chips
+across two IOMMU groups with accel nodes, one mdev partition, one
+EGM-analogue shared device, and the iommufd cdev. CI mounts the tree at
+/fixture (read-only) and asserts that `--root /fixture --discover-only`
+inventories it from inside the distroless image as the nonroot user.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "tests"))
+from fakehost import FakeChip, FakeHost  # noqa: E402
+
+
+def build(root: str) -> None:
+    host = FakeHost(root)
+    chips = [
+        ("0000:01:00.0", "7"),
+        ("0000:01:01.0", "7"),   # same group as .0 — exercises group expansion
+        ("0000:02:00.0", "8"),
+        ("0000:02:01.0", "9"),
+    ]
+    for i, (bdf, group) in enumerate(chips):
+        host.add_chip(FakeChip(bdf=bdf, iommu_group=group, accel_index=i,
+                               numa_node=i // 2))
+    host.add_mdev("a1b2c3d4-0000-1111-2222-333344445555", "tpu-v4-1c",
+                  "0000:02:00.0", iommu_group="12")
+    host.add_shared_device("egm0", ["0000:01:00.0", "0000:01:01.0"])
+    host.enable_iommufd()
+    # world-readable so the image's nonroot uid (65532) can walk it
+    for dirpath, dirnames, filenames in os.walk(root):
+        os.chmod(dirpath, 0o755)
+        for f in filenames:
+            p = os.path.join(dirpath, f)
+            if not os.path.islink(p):
+                os.chmod(p, 0o644)
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    build(sys.argv[1])
+    print(sys.argv[1])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
