@@ -13,16 +13,31 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .config import Config
-from .discovery import read_id_from_file, read_link_basename
 from .kubeletapi import pb
 from .naming import sanitize_name
 from .registry import Registry, SharedDevice
 
 log = logging.getLogger(__name__)
+
+
+def _read_small(path: str) -> Optional[bytes]:
+    """Raw low-level read of a small sysfs attribute (hot-path variant of
+    read_id_from_file: no TextIOWrapper construction per call)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return None
+    try:
+        return os.read(fd, 80)
+    except OSError:
+        return None
+    finally:
+        os.close(fd)
 
 
 class AllocationError(Exception):
@@ -80,27 +95,207 @@ def discover_shared_devices(cfg: Config) -> List[SharedDevice]:
     return out
 
 
-def _revalidate(cfg: Config, bdf: str, expected_group: str) -> None:
-    """Live sysfs must still agree with the discovery snapshot (TOCTOU guard).
-
-    Mirrors the reference's re-reads inside Allocate (:388-397): the iommu
-    group link must be unchanged and the vendor must still be a TPU.
-    """
-    base = os.path.join(cfg.pci_base_path, bdf)
-    live_group = read_link_basename(os.path.join(base, "iommu_group"))
-    if live_group != expected_group:
-        raise AllocationError(
-            f"device {bdf}: iommu group changed ({expected_group!r} -> {live_group!r})")
-    vendor = read_id_from_file(os.path.join(base, "vendor"))
-    if vendor is None or vendor.lower() not in cfg.vendor_ids:
-        raise AllocationError(f"device {bdf}: vendor {vendor!r} is not a TPU")
-
-
 @dataclass
 class AllocationPlan:
     device_specs: List[pb.DeviceSpec]
     envs: Dict[str, str]
     expanded_bdfs: List[str]
+
+
+class AllocationPlanner:
+    """Per-plugin Allocate fast path.
+
+    Plugin servers are rebuilt on every rediscovery signature change
+    (lifecycle.py), so anything deterministic given (cfg, registry,
+    resource) is precomputed once here: the KubeVirt env-var key, the
+    leading /dev/vfio/vfio DeviceSpec, one /dev/vfio/<group> DeviceSpec
+    template per IOMMU group, and each device's revalidation paths.
+
+    What stays LIVE, by design: the TOCTOU guard still re-reads every
+    allocated device's iommu_group link and vendor id from sysfs on every
+    Allocate (reference behavior, generic_device_plugin.go:388-397), the
+    iommufd probe re-stats /dev/iommu (:362,692-701), and vfio cdev names
+    are re-listed. The shared-device (EGM-analogue) scan is cached for
+    cfg.shared_scan_ttl_s (0 = the reference's rescan-every-Allocate
+    behavior, :366,120-157).
+
+    `allowed_bdfs` (fixed at construction) scopes every request to the
+    owning plugin's devices: the reference resolves any BDF in its global
+    map, so its v-something plugin would allocate another model's GPUs
+    (generic_device_plugin.go:376-380) — here a cross-model BDF is an
+    AllocationError. None = unscoped (vTPU parent expansion).
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        registry: Registry,
+        resource_suffix: str,
+        allowed_bdfs: Optional[frozenset] = None,
+        cdi_enabled: Optional[bool] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.registry = registry
+        self.resource_suffix = resource_suffix
+        self.allowed_bdfs = allowed_bdfs
+        self.cdi_enabled = (bool(cfg.cdi_spec_dir) if cdi_enabled is None
+                            else cdi_enabled)
+        self.env_key = f"{cfg.env_prefix}_{sanitize_name(resource_suffix)}"
+        self._vfio_spec = pb.DeviceSpec(
+            host_path=cfg.dev_path("dev/vfio/vfio"),
+            container_path="/dev/vfio/vfio",
+            permissions="mrw",
+        )
+        self._group_specs: Dict[str, pb.DeviceSpec] = {
+            group: pb.DeviceSpec(
+                host_path=cfg.dev_path("dev/vfio", group),
+                container_path=f"/dev/vfio/{group}",
+                permissions="mrw",
+            )
+            for group in registry.iommu_map
+        }
+        self._iommu_spec = pb.DeviceSpec(
+            host_path=cfg.dev_path("dev/iommu"),
+            container_path="/dev/iommu",
+            permissions="mrw",
+        )
+        # bdf → (iommu_group symlink path, vendor attribute path)
+        self._reval_paths: Dict[str, Tuple[str, str]] = {
+            bdf: (os.path.join(cfg.pci_base_path, bdf, "iommu_group"),
+                  os.path.join(cfg.pci_base_path, bdf, "vendor"))
+            for bdf in registry.bdf_to_group
+        }
+        self._vendor_ok = frozenset(v.lower() for v in cfg.vendor_ids)
+        self._shared_cache: Optional[List[SharedDevice]] = None
+        self._shared_expires = 0.0
+
+    def _revalidate_live(self, bdf: str, expected_group: str) -> None:
+        """TOCTOU guard (NEVER cached): live sysfs must still agree with the
+        discovery snapshot — group link unchanged, vendor still a TPU."""
+        paths = self._reval_paths.get(bdf)
+        if paths is None:  # device outside this registry snapshot
+            base = os.path.join(self.cfg.pci_base_path, bdf)
+            paths = (os.path.join(base, "iommu_group"),
+                     os.path.join(base, "vendor"))
+        glink, vpath = paths
+        try:
+            target = os.readlink(glink)
+        except OSError:
+            target = ""
+        if target.rsplit("/", 1)[-1] != expected_group:
+            live = target.rsplit("/", 1)[-1] or None
+            raise AllocationError(
+                f"device {bdf}: iommu group changed "
+                f"({expected_group!r} -> {live!r})")
+        raw = _read_small(vpath)
+        vendor = (raw.strip().lower().decode("ascii", "replace")
+                  if raw is not None else None)
+        if vendor is not None and vendor.startswith("0x"):
+            vendor = vendor[2:]
+        if vendor is None or vendor not in self._vendor_ok:
+            raise AllocationError(f"device {bdf}: vendor {vendor!r} is not a TPU")
+
+    def shared_devices(self) -> List[SharedDevice]:
+        ttl = getattr(self.cfg, "shared_scan_ttl_s", 0.0)
+        now = time.monotonic()
+        if self._shared_cache is None or ttl <= 0 or now >= self._shared_expires:
+            self._shared_cache = discover_shared_devices(self.cfg)
+            self._shared_expires = now + ttl
+        return self._shared_cache
+
+    def plan(
+        self,
+        requested_bdfs: Sequence[str],
+        shared_devices: Optional[Sequence[SharedDevice]] = None,
+    ) -> AllocationPlan:
+        """Build the DeviceSpec list + env map for one container request.
+
+        DeviceSpec order matches the reference's: the shared /dev/vfio/vfio
+        container node first, then one /dev/vfio/<group> per IOMMU group,
+        then iommufd cdevs + /dev/iommu, then qualifying shared devices.
+        """
+        cfg = self.cfg
+        registry = self.registry
+        iommufd = supports_iommufd(cfg)
+        if shared_devices is None:
+            shared_devices = self.shared_devices()
+
+        specs: List[pb.DeviceSpec] = [self._vfio_spec]
+        expanded: List[str] = []
+        seen_groups: List[str] = []
+        iommufd_specs: List[pb.DeviceSpec] = []
+        for bdf in requested_bdfs:
+            group = registry.bdf_to_group.get(bdf)
+            if group is None:
+                raise AllocationError(
+                    f"requested device {bdf} is not a known TPU")
+            if self.allowed_bdfs is not None and bdf not in self.allowed_bdfs:
+                raise AllocationError(
+                    f"requested device {bdf} is not managed by resource "
+                    f"{self.resource_suffix!r}")
+            if group in seen_groups:
+                continue
+            seen_groups.append(group)
+            for dev in registry.iommu_map[group]:
+                self._revalidate_live(dev.bdf, group)
+                expanded.append(dev.bdf)
+                if iommufd:
+                    node = vfio_device_node(cfg, dev.bdf)
+                    if node is None:
+                        # On an iommufd host every vfio-bound device has a
+                        # cdev; an unreadable vfio-dev entry would boot the
+                        # VM with an incomplete device set — fail fast like
+                        # the reference (generic_device_plugin.go:702-716
+                        # errors the Allocate).
+                        raise AllocationError(
+                            f"device {dev.bdf}: iommufd host but no "
+                            f"vfio-dev cdev")
+                    iommufd_specs.append(pb.DeviceSpec(
+                        host_path=cfg.dev_path("dev/vfio/devices", node),
+                        container_path=f"/dev/vfio/devices/{node}",
+                        permissions="mrw",
+                    ))
+            specs.append(self._group_specs[group])
+        specs.extend(iommufd_specs)
+        if iommufd and seen_groups:
+            specs.append(self._iommu_spec)
+
+        # Shared devices ride along iff every member chip is in this
+        # allocation (all-or-nothing, reference :159-184).
+        allocated = set(expanded)
+        for shared in shared_devices:
+            if shared.member_bdfs and set(shared.member_bdfs) <= allocated:
+                specs.append(pb.DeviceSpec(
+                    host_path=shared.dev_path,
+                    container_path=f"/dev/{shared.name}",
+                    permissions="mrw",
+                ))
+                log.info("allocation includes shared device %s (members %s)",
+                         shared.name, ",".join(shared.member_bdfs))
+
+        envs = {self.env_key: ",".join(expanded)}
+        log.info("allocate %s: groups=%s devices=%s iommufd=%s cdi=%s",
+                 self.resource_suffix, seen_groups, expanded, iommufd,
+                 self.cdi_enabled)
+        return AllocationPlan(device_specs=specs, envs=envs,
+                              expanded_bdfs=expanded)
+
+    def allocate_response(self, request: pb.AllocateRequest) -> pb.AllocateResponse:
+        """Full Allocate handler body: one ContainerAllocateResponse per
+        container request in the AllocateRequest."""
+        shared = self.shared_devices()
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            plan = self.plan(list(creq.devices_ids), shared)
+            cresp = pb.ContainerAllocateResponse(
+                envs=plan.envs, devices=plan.device_specs)
+            if self.cdi_enabled:
+                from .cdi import cdi_device_name
+                cresp.cdi_devices.extend(
+                    pb.CDIDevice(name=cdi_device_name(self.cfg, bdf))
+                    for bdf in plan.expanded_bdfs)
+            resp.container_responses.append(cresp)
+        return resp
 
 
 def plan_allocation(
@@ -111,91 +306,16 @@ def plan_allocation(
     shared_devices: Optional[Sequence[SharedDevice]] = None,
     allowed_bdfs: Optional[frozenset] = None,
 ) -> AllocationPlan:
-    """Build the DeviceSpec list + env map for one container request.
+    """One-shot form of AllocationPlanner.plan (tests, ad-hoc callers).
 
-    DeviceSpec order matches the reference's: the shared /dev/vfio/vfio
-    container node first, then one /dev/vfio/<group> per IOMMU group, then
-    iommufd cdevs + /dev/iommu, then qualifying shared devices.
-
-    `allowed_bdfs` scopes the request to the calling plugin's own devices:
-    the reference resolves any BDF in its global map, so its v-something
-    plugin would allocate another model's GPUs (generic_device_plugin.go:376-380)
-    — here a cross-model BDF is an AllocationError.
+    Long-lived callers (the plugin servers) hold an AllocationPlanner so the
+    per-(cfg, registry) precomputation is paid once, not per RPC.
     """
-    iommufd = supports_iommufd(cfg)
+    planner = AllocationPlanner(cfg, registry, resource_suffix,
+                                allowed_bdfs=allowed_bdfs)
     if shared_devices is None:
         shared_devices = discover_shared_devices(cfg)
-
-    specs: List[pb.DeviceSpec] = [
-        pb.DeviceSpec(
-            host_path=cfg.dev_path("dev/vfio/vfio"),
-            container_path="/dev/vfio/vfio",
-            permissions="mrw",
-        )
-    ]
-    expanded: List[str] = []
-    seen_groups: List[str] = []
-    iommufd_specs: List[pb.DeviceSpec] = []
-    for bdf in requested_bdfs:
-        group = registry.bdf_to_group.get(bdf)
-        if group is None:
-            raise AllocationError(f"requested device {bdf} is not a known TPU")
-        if allowed_bdfs is not None and bdf not in allowed_bdfs:
-            raise AllocationError(
-                f"requested device {bdf} is not managed by resource "
-                f"{resource_suffix!r}")
-        if group in seen_groups:
-            continue
-        seen_groups.append(group)
-        for dev in registry.iommu_map[group]:
-            _revalidate(cfg, dev.bdf, group)
-            expanded.append(dev.bdf)
-            if iommufd:
-                node = vfio_device_node(cfg, dev.bdf)
-                if node is None:
-                    # On an iommufd host every vfio-bound device has a cdev;
-                    # an unreadable vfio-dev entry would boot the VM with an
-                    # incomplete device set — fail fast like the reference
-                    # (generic_device_plugin.go:702-716 errors the Allocate).
-                    raise AllocationError(
-                        f"device {dev.bdf}: iommufd host but no vfio-dev cdev")
-                iommufd_specs.append(pb.DeviceSpec(
-                    host_path=cfg.dev_path("dev/vfio/devices", node),
-                    container_path=f"/dev/vfio/devices/{node}",
-                    permissions="mrw",
-                ))
-        specs.append(pb.DeviceSpec(
-            host_path=cfg.dev_path("dev/vfio", group),
-            container_path=f"/dev/vfio/{group}",
-            permissions="mrw",
-        ))
-    specs.extend(iommufd_specs)
-    if iommufd and seen_groups:
-        specs.append(pb.DeviceSpec(
-            host_path=cfg.dev_path("dev/iommu"),
-            container_path="/dev/iommu",
-            permissions="mrw",
-        ))
-
-    # Shared devices ride along iff every member chip is in this allocation
-    # (all-or-nothing, reference :159-184).
-    allocated = set(expanded)
-    for shared in shared_devices:
-        if shared.member_bdfs and set(shared.member_bdfs) <= allocated:
-            specs.append(pb.DeviceSpec(
-                host_path=shared.dev_path,
-                container_path=f"/dev/{shared.name}",
-                permissions="mrw",
-            ))
-            log.info("allocation includes shared device %s (members %s)",
-                     shared.name, ",".join(shared.member_bdfs))
-
-    env_key = f"{cfg.env_prefix}_{sanitize_name(resource_suffix)}"
-    envs = {env_key: ",".join(expanded)}
-    log.info("allocate %s: groups=%s devices=%s iommufd=%s cdi=%s",
-             resource_suffix, seen_groups, expanded, iommufd,
-             bool(cfg.cdi_spec_dir))
-    return AllocationPlan(device_specs=specs, envs=envs, expanded_bdfs=expanded)
+    return planner.plan(requested_bdfs, shared_devices)
 
 
 def allocate_response(
@@ -206,26 +326,13 @@ def allocate_response(
     cdi_enabled: Optional[bool] = None,
     allowed_bdfs: Optional[frozenset] = None,
 ) -> pb.AllocateResponse:
-    """Full Allocate handler body: one ContainerAllocateResponse per request.
+    """One-shot form of AllocationPlanner.allocate_response.
 
     `cdi_enabled=None` falls back to `bool(cfg.cdi_spec_dir)`; the plugin
     server passes an explicit value reflecting whether this resource's CDI
     spec file was actually written (unresolvable names are worse than none).
     """
-    if cdi_enabled is None:
-        cdi_enabled = bool(cfg.cdi_spec_dir)
-    shared = discover_shared_devices(cfg)
-    resp = pb.AllocateResponse()
-    for creq in request.container_requests:
-        plan = plan_allocation(cfg, registry, resource_suffix,
-                               list(creq.devices_ids), shared,
-                               allowed_bdfs=allowed_bdfs)
-        cresp = pb.ContainerAllocateResponse(
-            envs=plan.envs, devices=plan.device_specs)
-        if cdi_enabled:
-            from .cdi import cdi_device_name
-            cresp.cdi_devices.extend(
-                pb.CDIDevice(name=cdi_device_name(cfg, bdf))
-                for bdf in plan.expanded_bdfs)
-        resp.container_responses.append(cresp)
-    return resp
+    planner = AllocationPlanner(cfg, registry, resource_suffix,
+                                allowed_bdfs=allowed_bdfs,
+                                cdi_enabled=cdi_enabled)
+    return planner.allocate_response(request)

@@ -66,6 +66,11 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
+    parser.add_argument("--shared-scan-ttl", type=float,
+                        default=cfg.shared_scan_ttl_s,
+                        help="cache the shared-device (EGM-analogue) sysfs "
+                             "scan for this many seconds inside Allocate "
+                             "(0 = rescan every RPC, reference behavior)")
     parser.add_argument("--label-node", action="store_true",
                         help="publish per-node TPU facts (generation, chip "
                              "count, torus dims) as node labels via the API "
@@ -141,6 +146,7 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         cdi_spec_dir=args.cdi_spec_dir,
         health_poll_s=args.health_poll_seconds,
         rediscovery_interval_s=args.rediscovery_seconds,
+        shared_scan_ttl_s=args.shared_scan_ttl,
     )
     if args.root:
         cfg = cfg.with_root(args.root)

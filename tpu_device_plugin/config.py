@@ -87,6 +87,11 @@ class Config:
     grpc_timeout_s: float = 5.0      # registration dial bound (reference :53)
     health_poll_s: float = 5.0       # native liveness probe cadence (NVML parity)
     rediscovery_interval_s: float = 0.0  # 0 disables periodic re-discovery
+    # Shared-device (EGM-analogue) scan cache TTL inside a plugin server's
+    # Allocate path. 0 = rescan every Allocate (the reference's behavior,
+    # generic_device_plugin.go:366); a small TTL keeps hotplug visible within
+    # seconds while taking the sysfs walk off the per-RPC critical path.
+    shared_scan_ttl_s: float = 1.0
 
     # --- native shim --------------------------------------------------------
     native_lib_path: Optional[str] = None  # override libtpuhealth.so location

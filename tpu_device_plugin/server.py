@@ -80,6 +80,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             for d in self.devices
         ]
         self._allowed_bdfs = frozenset(d.bdf for d in self.devices)
+        # per-(cfg, registry, resource) precomputation for the Allocate hot
+        # path; rebuilt with the server on every rediscovery restart
+        self._planner = allocate_mod.AllocationPlanner(
+            cfg, registry, resource_suffix,
+            allowed_bdfs=self._allowed_bdfs, cdi_enabled=cdi_enabled)
         # last few successful allocations, surfaced on /status for debugging
         # VMI attach issues (what was handed out, when)
         self._recent_allocs: deque = deque(maxlen=16)
@@ -378,9 +383,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def _allocate_impl(self, request, context):
         try:
-            return allocate_mod.allocate_response(
-                self.cfg, self.registry, self.resource_suffix, request,
-                cdi_enabled=self.cdi_enabled, allowed_bdfs=self._allowed_bdfs)
+            return self._planner.allocate_response(request)
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))

@@ -111,9 +111,13 @@ class MustIncludeTooLarge(ValueError):
 
 
 @functools.lru_cache(maxsize=64)
-def _boxes(dims: Coords) -> Tuple[Tuple[int, Tuple[Tuple[int, int], ...]], ...]:
-    """All axis-aligned sub-boxes as (volume, per-axis (start, length)),
-    smallest volume first (so the scan can stop at the first feasible tier).
+def _boxes(dims: Coords) -> Tuple[Tuple[int, Tuple[Tuple[int, int], ...],
+                                        frozenset], ...]:
+    """All axis-aligned sub-boxes as (volume, per-axis (start, length),
+    covered-coordinate set), smallest volume first (so the scan can stop at
+    the first feasible tier). The precomputed coordinate set turns the
+    per-device containment test into one hash lookup on the Allocate/
+    GetPreferredAllocation hot path.
 
     Non-wrapping: a host's chips are a *slice* of the pod torus, so partial
     axes have no wraparound ICI link — a "wrapped" pair would really be
@@ -128,12 +132,12 @@ def _boxes(dims: Coords) -> Tuple[Tuple[int, Tuple[Tuple[int, int], ...]], ...]:
         for _, length in box:
             v *= length
         return v
-    return tuple(sorted(((volume(b), b) for b in itertools.product(*per_axis)),
+    def coordset(box):
+        return frozenset(itertools.product(
+            *[range(start, start + length) for start, length in box]))
+    return tuple(sorted(((volume(b), b, coordset(b))
+                         for b in itertools.product(*per_axis)),
                         key=lambda vb: vb[0]))
-
-
-def _in_box(coords: Coords, box: Tuple[Tuple[int, int], ...]) -> bool:
-    return all(start <= c < start + length for c, (start, length) in zip(coords, box))
 
 
 def preferred_allocation(
@@ -160,22 +164,25 @@ def preferred_allocation(
 
     # Tier 1: smallest ICI sub-box covering must-include with enough chips.
     if torus_dims:
-        def placed(i: str) -> bool:
-            d = by_id.get(i)
-            return (d is not None and d.coords is not None
-                    and len(d.coords) == len(torus_dims))
+        ndims = len(torus_dims)
+        # id → coords for every placed device (one dict; the box scan below
+        # is then pure hash lookups against each box's precomputed coordset)
+        coords_of = {
+            i: d.coords for i, d in by_id.items()
+            if d.coords is not None and len(d.coords) == ndims
+        }
 
-        if all(placed(i) for i in must):
+        if all(i in coords_of for i in must):
+            placed_pool = [i for i in fill_pool if i in coords_of]
             best: Optional[Tuple[Tuple[int, int], List[str]]] = None
-            for volume, box in _boxes(torus_dims):
+            for volume, _box, boxset in _boxes(torus_dims):
                 if best is not None and volume > best[0][0]:
                     break  # boxes are volume-sorted; no better score ahead
                 if volume < size:
                     continue
-                in_box = [i for i in fill_pool
-                          if placed(i) and _in_box(by_id[i].coords, box)]
-                if not all(_in_box(by_id[i].coords, box) for i in must):
+                if not all(coords_of[i] in boxset for i in must):
                     continue
+                in_box = [i for i in placed_pool if coords_of[i] in boxset]
                 if len(in_box) < need:
                     continue
                 chosen = must + in_box[:need]

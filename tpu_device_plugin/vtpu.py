@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence
 import grpc
 
 from . import kubeletapi as api
-from .allocate import AllocationError, plan_allocation
+from .allocate import AllocationError, AllocationPlanner
 from .config import Config
 from .discovery import read_link_basename
 from .health import HealthMonitor
@@ -55,6 +55,12 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
+        # passthrough planner for vfio-backed logical partitions (parent-BDF
+        # group expansion). The inherited self._planner was built from
+        # devices=[] (allowed_bdfs=frozenset()) and would reject every
+        # parent; this one is unscoped — partition membership is already
+        # validated against self.partitions before plan() is called.
+        self._parent_planner = AllocationPlanner(cfg, registry, type_name)
 
     # ------------------------------------------------------------------ state
 
@@ -178,14 +184,13 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                         # one VM at a time) and drops partitions with neither
                         # an accel node nor a vfio-bound parent, so an
                         # allocation NEVER returns zero DeviceSpecs.
-                        # plan_allocation supplies the same sysfs
+                        # the parent planner supplies the same sysfs
                         # revalidation + iommufd handling passthrough gets.
                         if p.parent_bdf not in self.registry.bdf_to_group:
                             raise AllocationError(
                                 f"partition {uuid}: parent {p.parent_bdf} has "
                                 "no accel node and is not vfio-bound")
-                        plan = plan_allocation(
-                            self.cfg, self.registry, self.resource_suffix,
+                        plan = self._parent_planner.plan(
                             [p.parent_bdf], shared_devices=[])
                         for s in plan.device_specs:
                             add(s.host_path, s.container_path, s.permissions)
