@@ -1,0 +1,86 @@
+#!/usr/bin/env bash
+# Real-kubelet e2e (VERDICT r2 next-item #3): run the plugin against an
+# actual kubelet in a kind cluster and assert the full resource lifecycle:
+#
+#   register -> node allocatable cloud-tpus.google.com/v4: 4 -> pod
+#   requesting 2 admitted by the devicemanager -> container starts with the
+#   VFIO DeviceSpecs mounted and the PCI_RESOURCE env var injected.
+#
+# The TPU "hardware" is a fixture sysfs/devfs tree (scripts/
+# make_fixture_host.py) mounted into the kind node; its /dev entries are
+# replaced with real char-device nodes (mknod c 1 3) inside the node so the
+# container runtime can actually mount them. Requires: docker, kind, kubectl.
+#
+# Run locally:  scripts/e2e_kind.sh
+# CI: .github/workflows/e2e.yml (nightly + manual dispatch).
+set -euo pipefail
+
+CLUSTER=${CLUSTER:-tpu-dp-e2e}
+IMG=tpu-kubevirt-device-plugin:e2e
+FIXTURE=/tmp/tpu-fixture-e2e
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+
+cleanup() { kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "--- build image"
+docker build -f "$REPO/deployments/container/Dockerfile" -t "$IMG" "$REPO"
+
+echo "--- fixture host tree"
+rm -rf "$FIXTURE"
+python3 "$REPO/scripts/make_fixture_host.py" "$FIXTURE"
+
+echo "--- kind cluster (fixture mounted into the node)"
+cat <<EOF | kind create cluster --name "$CLUSTER" --config=-
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+    extraMounts:
+      - hostPath: $FIXTURE
+        containerPath: $FIXTURE
+EOF
+kind load docker-image "$IMG" --name "$CLUSTER"
+NODE="${CLUSTER}-control-plane"
+
+echo "--- real device nodes for the runtime to mount"
+docker exec "$NODE" bash -c '
+  set -e
+  for f in '"$FIXTURE"'/dev/vfio/vfio '"$FIXTURE"'/dev/vfio/[0-9]* \
+           '"$FIXTURE"'/dev/accel* '"$FIXTURE"'/dev/iommu \
+           '"$FIXTURE"'/dev/vfio/devices/vfio*; do
+    [ -e "$f" ] || continue
+    rm -f "$f" && mknod "$f" c 1 3 && chmod 666 "$f"
+  done'
+
+echo "--- deploy plugin"
+sed "s|IMAGE_PLACEHOLDER|$IMG|; s|FIXTURE_PLACEHOLDER|$FIXTURE|" \
+    "$REPO/manifests/e2e/tpu-device-plugin-e2e.yaml" | kubectl apply -f -
+kubectl -n kube-system rollout status ds/tpu-device-plugin-e2e --timeout=120s
+
+echo "--- node allocatable"
+for i in $(seq 1 30); do
+  GOT=$(kubectl get node "$NODE" \
+        -o jsonpath='{.status.allocatable.cloud-tpus\.google\.com/v4}' || true)
+  [ "$GOT" = "4" ] && break
+  sleep 2
+done
+[ "$GOT" = "4" ] || { echo "FAIL: allocatable v4=$GOT (want 4)"; \
+  kubectl -n kube-system logs ds/tpu-device-plugin-e2e --tail=50; exit 1; }
+echo "allocatable OK: cloud-tpus.google.com/v4=$GOT"
+
+echo "--- pod admission + device mount + env"
+kubectl apply -f "$REPO/manifests/e2e/tpu-consumer-pod.yaml"
+kubectl wait --for=condition=Ready pod/tpu-consumer --timeout=120s || {
+  kubectl describe pod tpu-consumer; exit 1; }
+ENVV=$(kubectl exec tpu-consumer -- sh -c 'env | grep PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4')
+echo "env: $ENVV"
+echo "$ENVV" | grep -q "0000:" || { echo "FAIL: no BDFs in env"; exit 1; }
+kubectl exec tpu-consumer -- sh -c 'ls /dev/vfio/vfio' >/dev/null
+GROUPS_IN_POD=$(kubectl exec tpu-consumer -- sh -c \
+  'ls /dev/vfio | grep -E "^[0-9]+$" | wc -l')
+[ "$GROUPS_IN_POD" -ge 1 ] || {
+  echo "FAIL: no per-IOMMU-group /dev/vfio/<group> node mounted in the pod"
+  kubectl exec tpu-consumer -- ls /dev/vfio; exit 1; }
+echo "group mounts OK: $GROUPS_IN_POD /dev/vfio/<group> node(s)"
+echo "E2E PASS: real kubelet admitted the pod with TPU VFIO devices"

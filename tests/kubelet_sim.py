@@ -1,0 +1,229 @@
+"""Kubelet devicemanager simulator: the kubelet SIDE of the plugin protocol.
+
+FakeKubelet (fakehost.py) only records Register calls; every other suite
+drives the plugin's RPCs directly. This harness instead behaves like the
+kubelet's devicemanager does (upstream semantics:
+pkg/kubelet/cm/devicemanager, consumed by the reference through the same
+v1beta1 contract its vendored api.proto locks):
+
+  - serves `Registration` on kubelet.sock and VALIDATES the request
+    (version, resource-name form, endpoint socket exists),
+  - on Register, DIALS BACK the plugin's endpoint, fetches
+    GetDevicePluginOptions, and holds a long-lived ListAndWatch stream in a
+    background thread, maintaining the per-resource healthy/unhealthy device
+    view that backs node allocatable,
+  - admits pods devicemanager-style: pick from healthy unallocated devices
+    (registration order), consult GetPreferredAllocation when the plugin's
+    options advertise it (validating the response is a subset of the offered
+    pool at the requested size), then Allocate — marking devices in use only
+    on success, so a failed Allocate leaves the pool untouched,
+  - handles RE-registration of the same resource by replacing the old
+    endpoint state (the kubelet does this when a plugin restarts).
+
+This is still an in-repo stand-in, not a real kubelet — the kind-based
+nightly job (.github/workflows/e2e.yml + scripts/e2e_kind.sh) covers that;
+this harness is the strongest conformance check that runs with no cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.kubeletapi import pb
+
+
+class ConformanceError(AssertionError):
+    """A plugin behavior that a real kubelet would reject."""
+
+
+class _Endpoint:
+    """One registered plugin: options + live device view from ListAndWatch."""
+
+    def __init__(self, resource: str, channel, stub, options):
+        self.resource = resource
+        self.channel = channel
+        self.stub = stub
+        self.options = options
+        self.devices: Dict[str, str] = {}   # id -> Healthy/Unhealthy
+        self.in_use: set = set()
+        self.updates = 0
+        self.stream_error: Optional[Exception] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stream = None
+
+    def close(self):
+        if self._stream is not None:
+            self._stream.cancel()
+        self.channel.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class DeviceManagerSim:
+    """See module docstring. Thread-safe; one instance per fake node."""
+
+    def __init__(self, device_plugin_dir: str):
+        self.dir = device_plugin_dir
+        self.cond = threading.Condition()
+        self.endpoints: Dict[str, _Endpoint] = {}
+        self.rejections: List[str] = []
+        outer = self
+
+        class Reg(api.RegistrationServicer):
+            def Register(self, request, context):
+                try:
+                    outer._register(request)
+                except ConformanceError as exc:
+                    outer.rejections.append(str(exc))
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+                return pb.Empty()
+
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        api.add_registration_servicer(self._server, Reg())
+        sock = os.path.join(device_plugin_dir, "kubelet.sock")
+        self._server.add_insecure_port(f"unix://{sock}")
+        self._server.start()
+
+    # ------------------------------------------------------------ registration
+
+    def _register(self, request) -> None:
+        if request.version != api.API_VERSION:
+            raise ConformanceError(
+                f"unsupported API version {request.version!r}")
+        if "/" not in request.resource_name:
+            raise ConformanceError(
+                f"resource name {request.resource_name!r} lacks a namespace")
+        endpoint_path = os.path.join(self.dir, request.endpoint)
+        if not os.path.exists(endpoint_path):
+            raise ConformanceError(
+                f"endpoint socket {endpoint_path} does not exist")
+
+        channel = grpc.insecure_channel(f"unix://{endpoint_path}")
+        stub = api.DevicePluginStub(channel)
+        options = stub.GetDevicePluginOptions(pb.Empty(), timeout=5)
+        ep = _Endpoint(request.resource_name, channel, stub, options)
+
+        with self.cond:
+            old = self.endpoints.pop(request.resource_name, None)
+            self.endpoints[request.resource_name] = ep
+            self.cond.notify_all()
+        if old is not None:
+            old.close()   # kubelet replaces a re-registering plugin's endpoint
+
+        ep._stream = stub.ListAndWatch(pb.Empty())
+
+        def watch():
+            try:
+                for msg in ep._stream:
+                    with self.cond:
+                        ep.devices = {d.ID: d.health for d in msg.devices}
+                        ep.updates += 1
+                        self.cond.notify_all()
+            except grpc.RpcError as exc:
+                if exc.code() != grpc.StatusCode.CANCELLED:
+                    ep.stream_error = exc
+
+        ep._thread = threading.Thread(target=watch, daemon=True,
+                                      name=f"law-{request.resource_name}")
+        ep._thread.start()
+
+    # ------------------------------------------------------------ node state
+
+    def wait_for_resource(self, resource: str, timeout: float = 15) -> bool:
+        with self.cond:
+            return self.cond.wait_for(
+                lambda: resource in self.endpoints
+                and self.endpoints[resource].updates > 0,
+                timeout=timeout)
+
+    def wait_for_allocatable(self, resource: str, n: int,
+                             timeout: float = 15) -> bool:
+        with self.cond:
+            return self.cond.wait_for(
+                lambda: self.allocatable(resource) == n, timeout=timeout)
+
+    def allocatable(self, resource: str) -> int:
+        """Healthy device count = what the node would advertise."""
+        ep = self.endpoints.get(resource)
+        if ep is None:
+            return 0
+        return sum(1 for h in ep.devices.values() if h == api.HEALTHY)
+
+    # ------------------------------------------------------------ admission
+
+    def admit_pod(self, resource: str, n: int) -> Tuple[List[str], object]:
+        """Devicemanager admission: returns (device_ids, AllocateResponse).
+
+        Raises ConformanceError on any plugin response a kubelet would
+        reject, grpc.RpcError if the plugin errors the RPC (pod stays
+        Pending; pool untouched).
+
+        The lock is held across pick → GetPreferredAllocation → Allocate →
+        commit, like the real devicemanager's admission lock: concurrent
+        admissions serialize rather than double-booking devices. (Holding it
+        blocks ListAndWatch view updates for the RPC's duration — the
+        devicemanager has the same property.)
+        """
+        with self.cond:
+            ep = self.endpoints.get(resource)
+            if ep is None:
+                raise ConformanceError(f"no plugin for {resource}")
+            free = [i for i, h in ep.devices.items()
+                    if h == api.HEALTHY and i not in ep.in_use]
+            if len(free) < n:
+                raise ConformanceError(
+                    f"insufficient {resource}: want {n}, have {len(free)}")
+            picked = free[:n]
+            if ep.options.get_preferred_allocation_available:
+                pref = ep.stub.GetPreferredAllocation(
+                    pb.PreferredAllocationRequest(container_requests=[
+                        pb.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=free,
+                            must_include_deviceIDs=[],
+                            allocation_size=n)]),
+                    timeout=5)
+                got = list(pref.container_responses[0].deviceIDs)
+                if len(got) != n:
+                    raise ConformanceError(
+                        f"GetPreferredAllocation returned {len(got)} ids, "
+                        f"requested {n}")
+                if not set(got) <= set(free):
+                    raise ConformanceError(
+                        f"GetPreferredAllocation returned ids outside the "
+                        f"offered pool: {sorted(set(got) - set(free))}")
+                picked = got
+            resp = ep.stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=picked)]),
+                timeout=5)
+            if len(resp.container_responses) != 1:
+                raise ConformanceError(
+                    f"Allocate returned {len(resp.container_responses)} "
+                    f"container responses for 1 request")
+            for spec in resp.container_responses[0].devices:
+                if not spec.host_path or not spec.container_path:
+                    raise ConformanceError(
+                        f"DeviceSpec with empty path: {spec}")
+                if not os.path.exists(spec.host_path):
+                    raise ConformanceError(
+                        f"DeviceSpec host path missing: {spec.host_path}")
+            ep.in_use.update(picked)
+        return picked, resp
+
+    def release_pod(self, resource: str, device_ids: List[str]) -> None:
+        with self.cond:
+            ep = self.endpoints.get(resource)
+            if ep is not None:
+                ep.in_use.difference_update(device_ids)
+                self.cond.notify_all()
+
+    def stop(self) -> None:
+        self._server.stop(0)
+        for ep in list(self.endpoints.values()):
+            ep.close()

@@ -322,3 +322,19 @@ def test_accel_parent_still_backs_many_partitions(tmp_path):
     cfg = replace(Config().with_root(host.root), partition_config_path=str(pc))
     registry, _ = discovery.discover(cfg)
     assert len(registry.partitions_by_type["v4-core"]) == 2  # cores_per_chip
+
+
+def test_explicit_device_plugin_path_wins_over_root():
+    """The kind e2e mixes fixture sysfs (--root) with the REAL kubelet
+    socket dir: an explicit --device-plugin-path must survive re-rooting."""
+    from tpu_device_plugin.cli import build_config
+    parsed, _ = build_config(["--root", "/fixture",
+                              "--device-plugin-path",
+                              "/var/lib/kubelet/device-plugins"])
+    assert parsed.device_plugin_path == "/var/lib/kubelet/device-plugins"
+    assert parsed.kubelet_socket == \
+        "/var/lib/kubelet/device-plugins/kubelet.sock"
+    assert parsed.pci_base_path == "/fixture/sys/bus/pci/devices"
+
+    parsed2, _ = build_config(["--root", "/fixture"])
+    assert parsed2.device_plugin_path == "/fixture/device-plugins/"

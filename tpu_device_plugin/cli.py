@@ -32,7 +32,11 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--mdev-base-path", default=cfg.mdev_base_path)
     parser.add_argument("--accel-class-path", default=cfg.accel_class_path)
     parser.add_argument("--pci-ids-path", default=cfg.pci_ids_path)
-    parser.add_argument("--device-plugin-path", default=cfg.device_plugin_path)
+    # default=None sentinel: "explicitly passed" must be detectable so an
+    # explicit value (even one equal to the default) survives --root
+    parser.add_argument("--device-plugin-path", default=None,
+                        help=f"kubelet device-plugin socket dir (default: "
+                             f"{cfg.device_plugin_path})")
     parser.add_argument("--resource-namespace", default=cfg.resource_namespace)
     parser.add_argument("--vfio-drivers", default=",".join(cfg.vfio_drivers),
                         help="comma-separated driver names accepted as VFIO "
@@ -126,14 +130,16 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             level=level,
             format="%(asctime)s %(levelname)s %(name)s: %(message)s",
         )
+    dpp = (args.device_plugin_path if args.device_plugin_path is not None
+           else cfg.device_plugin_path)
     cfg = replace(
         cfg,
         pci_base_path=args.pci_base_path,
         mdev_base_path=args.mdev_base_path,
         accel_class_path=args.accel_class_path,
         pci_ids_path=args.pci_ids_path,
-        device_plugin_path=args.device_plugin_path,
-        kubelet_socket=args.device_plugin_path.rstrip("/") + "/kubelet.sock",
+        device_plugin_path=dpp,
+        kubelet_socket=dpp.rstrip("/") + "/kubelet.sock",
         resource_namespace=args.resource_namespace,
         vfio_drivers=tuple(
             d.strip() for d in args.vfio_drivers.split(",") if d.strip()),
@@ -150,6 +156,16 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     )
     if args.root:
         cfg = cfg.with_root(args.root)
+        if args.device_plugin_path is not None:
+            # An explicit --device-plugin-path wins over --root's re-rooting:
+            # the kind e2e runs fixture sysfs/devfs under --root while
+            # registering with the REAL kubelet socket dir.
+            cfg = replace(
+                cfg,
+                device_plugin_path=args.device_plugin_path,
+                kubelet_socket=(args.device_plugin_path.rstrip("/")
+                                + "/kubelet.sock"),
+            )
     return cfg, args
 
 
