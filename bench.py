@@ -10,11 +10,13 @@ round-trips. The reference publishes no numbers (SURVEY.md §6), so the
 baseline is this protocol's own recorded round-1 p50 (BENCH_r01.json):
 vs_baseline = round1_p50 / current_p50, >1.0 meaning faster than round 1.
 
-Methodology: the build/CI host is a single shared CPU core, so wall-clock
-latency jitters with co-tenant load. The run is split into EPOCHS epochs and
-the headline p50 is the MINIMUM epoch p50 — the standard microbenchmark
-estimator for achievable latency under transient interference; p99 is
-reported over all samples (worst-case, not denoised).
+Methodology: the headline `value`/`vs_baseline` use the PLAIN overall
+median — the same estimator rounds 1-2 recorded — so the baseline ratio
+compares like against like. The build/CI host is a single shared CPU core,
+so wall-clock latency jitters with co-tenant load; `best_epoch_p50_us`
+(minimum of 4 epoch medians) is reported alongside as the achievable-
+latency estimate under transient interference, and p99 is over all samples
+(worst-case, not denoised).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -61,9 +63,13 @@ def _min_epoch_p50(samples, epochs=EPOCHS):
 def _build_host(root, n_devices, device_id="0063"):
     host = FakeHost(root)
     for i in range(n_devices):
+        # two NUMA nodes, split in halves — the same layout rounds 1-2
+        # measured (i//4 on 8 chips), kept so vs_baseline compares like
+        # against like
         host.add_chip(FakeChip(f"0000:{i // 32:02x}:{4 + i % 32:02x}.0",
                                device_id=device_id,
-                               iommu_group=str(11 + i), numa_node=i % 2))
+                               iommu_group=str(11 + i),
+                               numa_node=i // max(1, n_devices // 2)))
     return host
 
 
@@ -147,7 +153,7 @@ def run_config1(root):
                 vtpu_us.append((time.perf_counter() - t1) * 1e6)
     vserver.stop(0)
 
-    p50 = _min_epoch_p50(attach_us)
+    p50 = statistics.median(attach_us)   # same estimator as rounds 1-2
     round1_p50_us = 820.3
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -155,7 +161,7 @@ def run_config1(root):
             round1_p50_us = float(json.load(f)["parsed"]["value"])
     except (OSError, KeyError, ValueError, TypeError):
         pass  # keep the recorded constant if the file is gone/reshaped
-    pref_p50 = _min_epoch_p50(pref_us)
+    pref_p50 = statistics.median(pref_us)
     return {
         "metric": "vmi_attach_control_plane_p50",
         "value": round(p50, 1),
@@ -164,7 +170,8 @@ def run_config1(root):
         "preferred_allocation_p50_us": round(pref_p50, 1),
         "allocate_p50_us": round(p50 - pref_p50, 1),
         "p99_us": round(statistics.quantiles(attach_us, n=100)[98], 1),
-        "vtpu_allocate_p50_us": round(_min_epoch_p50(vtpu_us), 1),
+        "best_epoch_p50_us": round(_min_epoch_p50(attach_us), 1),
+        "vtpu_allocate_p50_us": round(statistics.median(vtpu_us), 1),
         "discovery_ms": round(discovery_ms, 2),
         "devices_advertised": len(devices),
         "allocation_size": 4,
@@ -214,8 +221,8 @@ def run_matrix():
                         "n_devices": n, "allocation_size": alloc,
                         "torus": tori[n],
                         "discovery_ms": round(discovery_ms, 2),
-                        "attach_p50_us": round(_min_epoch_p50(attach_us), 1),
-                        "pref_p50_us": round(_min_epoch_p50(pref_us), 1),
+                        "attach_p50_us": round(statistics.median(attach_us), 1),
+                        "pref_p50_us": round(statistics.median(pref_us), 1),
                         "p99_us": round(
                             statistics.quantiles(attach_us, n=100)[98], 1),
                     })
@@ -257,7 +264,8 @@ def run_matrix():
                             vtpu_us.append((time.perf_counter() - t1) * 1e6)
                 vserver.stop(0)
                 row["advertised"] = len(parts)
-                row["vtpu_allocate_p50_us"] = round(_min_epoch_p50(vtpu_us), 1)
+                row["vtpu_allocate_p50_us"] = round(
+                    statistics.median(vtpu_us), 1)
             results["partitions"].append(row)
         finally:
             shutil.rmtree(root, ignore_errors=True)
