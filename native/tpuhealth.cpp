@@ -94,6 +94,94 @@ int tpuhealth_pci_status(const char* config_path) {
                           (static_cast<uint16_t>(buf[1]) << 8));
 }
 
+// PCIe link status vs capability: detects DEGRADED links — current speed/
+// width trained below the device maximum (connector faults, thermal
+// retraining) — the passthrough analogue of NVML's
+// nvmlDeviceGetCurrPcieLinkWidth/Generation family. Walks the PCI
+// capability list (pointer at config 0x34) to the PCI Express capability
+// (id 0x10), reading Link Capabilities (+0x0C) and Link Status (+0x12).
+// Speeds are PCIe generation codes (1=2.5GT/s .. 6=64GT/s), widths are
+// lane counts. Returns TPUHEALTH_OK with all four outputs filled, DEAD for
+// an off-bus chip, MISSING when the path is gone, ERR when the capability
+// is unreachable (short sysfs read — non-root sees only 64 bytes — or no
+// PCIe capability, e.g. fixture trees).
+int tpuhealth_pcie_link(const char* config_path, int* cur_speed,
+                        int* cur_width, int* max_speed, int* max_width) {
+  int fd = open(config_path, O_RDONLY);
+  if (fd < 0) {
+    return errno == ENOENT ? TPUHEALTH_MISSING : TPUHEALTH_ERR;
+  }
+  uint8_t cfg[256];
+  ssize_t n = read(fd, cfg, sizeof(cfg));
+  close(fd);
+  if (n < 64) return TPUHEALTH_ERR;
+  if (cfg[0] == 0xFF && cfg[1] == 0xFF) return TPUHEALTH_DEAD;
+  if (!(cfg[0x06] & 0x10)) return TPUHEALTH_ERR;  // no capability list
+  uint8_t off = cfg[0x34] & 0xFC;
+  for (int guard = 0; guard < 48; ++guard) {
+    if (off < 0x40 || static_cast<ssize_t>(off) + 0x14 > n) break;
+    if (cfg[off] == 0x10) {
+      uint32_t linkcap = static_cast<uint32_t>(cfg[off + 0x0C]) |
+                         (static_cast<uint32_t>(cfg[off + 0x0D]) << 8) |
+                         (static_cast<uint32_t>(cfg[off + 0x0E]) << 16) |
+                         (static_cast<uint32_t>(cfg[off + 0x0F]) << 24);
+      uint16_t linkstat = static_cast<uint16_t>(cfg[off + 0x12]) |
+                          (static_cast<uint16_t>(cfg[off + 0x13]) << 8);
+      *max_speed = static_cast<int>(linkcap & 0xF);
+      *max_width = static_cast<int>((linkcap >> 4) & 0x3F);
+      *cur_speed = static_cast<int>(linkstat & 0xF);
+      *cur_width = static_cast<int>((linkstat >> 4) & 0x3F);
+      return TPUHEALTH_OK;
+    }
+    off = cfg[off + 1] & 0xFC;
+  }
+  return TPUHEALTH_ERR;
+}
+
+// One-read diagnostics: status-register error bits AND PCIe link state
+// from a single open+read of the config file (the /status-/metrics scrape
+// and the 5 s health poll call this per device — two separate probes would
+// double the syscalls). Outputs: *status_reg = raw 16-bit status (offset
+// 0x06) or -1 when unreadable; link outputs as in tpuhealth_pcie_link,
+// all -1 when the PCIe capability is unreachable. Returns tpuhealth_status
+// for the config read itself.
+int tpuhealth_chip_diag(const char* config_path, int* status_reg,
+                        int* cur_speed, int* cur_width,
+                        int* max_speed, int* max_width) {
+  *status_reg = *cur_speed = *cur_width = *max_speed = *max_width = -1;
+  int fd = open(config_path, O_RDONLY);
+  if (fd < 0) {
+    return errno == ENOENT ? TPUHEALTH_MISSING : TPUHEALTH_ERR;
+  }
+  uint8_t cfg[256];
+  ssize_t n = read(fd, cfg, sizeof(cfg));
+  close(fd);
+  if (n < 8) return TPUHEALTH_ERR;
+  if (cfg[0] == 0xFF && cfg[1] == 0xFF) return TPUHEALTH_DEAD;
+  *status_reg = static_cast<int>(static_cast<uint16_t>(cfg[0x06]) |
+                                 (static_cast<uint16_t>(cfg[0x07]) << 8));
+  if (n < 64 || !(cfg[0x06] & 0x10)) return TPUHEALTH_OK;
+  uint8_t off = cfg[0x34] & 0xFC;
+  for (int guard = 0; guard < 48; ++guard) {
+    if (off < 0x40 || static_cast<ssize_t>(off) + 0x14 > n) break;
+    if (cfg[off] == 0x10) {
+      uint32_t linkcap = static_cast<uint32_t>(cfg[off + 0x0C]) |
+                         (static_cast<uint32_t>(cfg[off + 0x0D]) << 8) |
+                         (static_cast<uint32_t>(cfg[off + 0x0E]) << 16) |
+                         (static_cast<uint32_t>(cfg[off + 0x0F]) << 24);
+      uint16_t linkstat = static_cast<uint16_t>(cfg[off + 0x12]) |
+                          (static_cast<uint16_t>(cfg[off + 0x13]) << 8);
+      *max_speed = static_cast<int>(linkcap & 0xF);
+      *max_width = static_cast<int>((linkcap >> 4) & 0x3F);
+      *cur_speed = static_cast<int>(linkstat & 0xF);
+      *cur_width = static_cast<int>((linkstat >> 4) & 0x3F);
+      break;
+    }
+    off = cfg[off + 1] & 0xFC;
+  }
+  return TPUHEALTH_OK;
+}
+
 // libtpu presence: dlopen + lazy symbol lookup, never initialization.
 // Returns 1 when libtpu.so is loadable and exports a known entry point,
 // 0 when absent. Handle is cached for the process lifetime.
@@ -112,8 +200,10 @@ int tpuhealth_libtpu_available(void) {
 }
 
 // ABI version tag so the Python side can detect stale .so builds.
-// v2 added tpuhealth_pci_status; the Python loader accepts v1 shims and
-// falls back to its own reader for the missing symbol.
-int tpuhealth_abi_version(void) { return 2; }
+// v2 added tpuhealth_pci_status, v3 tpuhealth_pcie_link, v4
+// tpuhealth_chip_diag (one-read combination of the two); the Python loader
+// accepts older shims and falls back to its own readers for missing
+// symbols.
+int tpuhealth_abi_version(void) { return 4; }
 
 }  // extern "C"

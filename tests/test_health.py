@@ -347,3 +347,72 @@ def test_pci_status_error_paths(shim, tmp_path):
     os.chmod(locked, 0)
     if os.geteuid() != 0:  # root bypasses permissions
         assert shim.pci_status(str(locked)) is None
+
+
+def _pcie_config(cur_speed, cur_width, max_speed, max_width,
+                 cap_at=0x40, vendor=(0xE0, 0x1A)) -> bytes:
+    """A minimal 256-byte PCI config blob with one PCIe capability."""
+    cfg = bytearray(256)
+    cfg[0], cfg[1] = vendor
+    cfg[0x06] = 0x10                       # status: capability list present
+    cfg[0x34] = cap_at                     # first capability pointer
+    cfg[cap_at] = 0x10                     # PCI Express capability id
+    cfg[cap_at + 1] = 0x00                 # end of chain
+    linkcap = (max_speed & 0xF) | ((max_width & 0x3F) << 4)
+    cfg[cap_at + 0x0C:cap_at + 0x10] = linkcap.to_bytes(4, "little")
+    linkstat = (cur_speed & 0xF) | ((cur_width & 0x3F) << 4)
+    cfg[cap_at + 0x12:cap_at + 0x14] = linkstat.to_bytes(2, "little")
+    return bytes(cfg)
+
+
+def test_pcie_link_full_speed(shim, tmp_path):
+    cfg = tmp_path / "config"
+    cfg.write_bytes(_pcie_config(4, 16, 4, 16))
+    link = shim.pcie_link(str(cfg))
+    assert link == {"cur_speed": 4, "cur_width": 16,
+                    "max_speed": 4, "max_width": 16}
+
+
+def test_pcie_link_degraded_detected(shim, tmp_path):
+    pci = tmp_path / "devices"
+    bdf = pci / "0000:00:04.0"
+    bdf.mkdir(parents=True)
+    # trained gen1 x8 on a gen4 x16 part: degraded on both axes
+    (bdf / "config").write_bytes(_pcie_config(1, 8, 4, 16))
+    assert shim.chip_link_degraded(str(pci), "0000:00:04.0") is True
+    # liveness must NOT be vetoed by a degraded link
+    assert shim.chip_alive(str(pci), "0000:00:04.0") is True
+    (bdf / "config").write_bytes(_pcie_config(4, 16, 4, 16))
+    assert shim.chip_link_degraded(str(pci), "0000:00:04.0") is False
+
+
+def test_pcie_link_capability_chain_walk(shim, tmp_path):
+    """PCIe capability found behind another capability in the chain."""
+    cfg = bytearray(_pcie_config(3, 8, 3, 8, cap_at=0x60))
+    cfg[0x34] = 0x40
+    cfg[0x40] = 0x01       # PM capability first
+    cfg[0x41] = 0x60       # -> PCIe capability next
+    p = tmp_path / "config"
+    p.write_bytes(bytes(cfg))
+    link = shim.pcie_link(str(p))
+    assert link and link["cur_width"] == 8 and link["max_speed"] == 3
+
+
+def test_pcie_link_unreachable_cases(shim, tmp_path):
+    # fixture-tree config too short for the capability area
+    short = tmp_path / "short"
+    short.write_bytes(bytes([0xE0, 0x1A]))
+    assert shim.pcie_link(str(short)) is None
+    # no capability list bit
+    nocap = tmp_path / "nocap"
+    nocap.write_bytes(bytes(256))
+    assert shim.pcie_link(str(nocap)) is None
+    # off-bus chip
+    dead = tmp_path / "dead"
+    dead.write_bytes(b"\xff" * 256)
+    assert shim.pcie_link(str(dead)) is None
+    assert shim.pcie_link(str(tmp_path / "missing")) is None
+    # degraded check never vetoes or errors on unreachable links
+    pci = tmp_path / "devices"
+    (pci / "0000:00:05.0").mkdir(parents=True)
+    assert shim.chip_link_degraded(str(pci), "0000:00:05.0") is False

@@ -168,3 +168,29 @@ def test_allocation_counter_in_metrics(rig):
     _, body = _get(status.port, "/metrics")
     assert ('tpu_plugin_allocations_total'
             '{resource="cloud-tpus.google.com/v4"} 2') in body.decode()
+
+
+def test_degraded_link_surfaces_on_status_and_metrics(rig):
+    """A chip whose PCIe link trained below max (gen1x8 on a gen4x16 part)
+    shows on /status per-BDF and in the tpu_plugin_degraded_links gauge —
+    without affecting device health (diagnostic, not a liveness veto)."""
+    from tests.test_health import _pcie_config
+    host, manager, status = rig
+    manager.start()
+    cfg_path = os.path.join(host.pci, "0000:00:04.0", "config")
+    with open(cfg_path, "wb") as f:
+        f.write(_pcie_config(1, 8, 4, 16))
+    code, body = _get(status.port, "/status")
+    payload = json.loads(body)
+    (plugin,) = payload["plugins"]
+    assert plugin["degraded_links"] == {"0000:00:04.0": "gen1x8 of gen4x16"}
+    assert plugin["devices"] == {"0000:00:04.0": "Healthy"}  # no veto
+    code, body = _get(status.port, "/metrics")
+    assert ('tpu_plugin_degraded_links{resource="cloud-tpus.google.com/v4"}'
+            ' 1') in body.decode()
+    # link back at full speed -> gauge drops to 0
+    with open(cfg_path, "wb") as f:
+        f.write(_pcie_config(4, 16, 4, 16))
+    code, body = _get(status.port, "/metrics")
+    assert ('tpu_plugin_degraded_links{resource="cloud-tpus.google.com/v4"}'
+            ' 0') in body.decode()

@@ -159,14 +159,14 @@ def test_attn_bench_partial_failure_keeps_cells(monkeypatch):
     import json as json_mod
     from tpu_device_plugin.validator import attn_bench
 
-    real_time_fn = attn_bench._time_fn
+    real_paired = attn_bench._paired_time
 
-    def flaky(fn, args, iters):
+    def flaky(build, args, iters, repeats):
         if args[0].shape[1] == 128:  # the big seq "OOMs"
             raise MemoryError("RESOURCE_EXHAUSTED")
-        return real_time_fn(fn, args, iters)
+        return real_paired(build, args, iters, repeats)
 
-    monkeypatch.setattr(attn_bench, "_time_fn", flaky)
+    monkeypatch.setattr(attn_bench, "_paired_time", flaky)
     result = attn_bench.bench_attention(
         seq_lens=(64, 128), blocks=((32, 32),), hb=2, head_dim=32, iters=1)
     assert len(result["cells"]) == 2

@@ -12,7 +12,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -26,6 +26,16 @@ ERR = -1
 # target abort (11), received target/master abort (12/13), signaled system
 # error (14), detected parity error (15).
 PCI_STATUS_ERROR_MASK = 0xF900
+
+
+def link_is_degraded(link: Optional[dict]) -> bool:
+    """THE degraded-link predicate (single source for probe/status/metrics):
+    trained speed or width below the device maximum. None (unreadable
+    capability) is not degraded — no signal, no alarm."""
+    if link is None:
+        return False
+    return (link["cur_speed"] < link["max_speed"]
+            or link["cur_width"] < link["max_width"])
 
 _SEARCH_PATHS = (
     os.path.join(os.path.dirname(__file__), "libtpuhealth.so"),
@@ -41,14 +51,17 @@ class TpuHealth:
     def __init__(self, lib_path: Optional[str] = None):
         self._lib = None
         self._has_pci_status = False
-        self._err_logged: dict = {}  # bdf -> last-logged error bits
+        self._has_pcie_link = False
+        self._has_chip_diag = False
+        self._err_logged: dict = {}   # bdf -> last-logged error bits
+        self._link_logged: dict = {}  # bdf -> last-logged degraded tuple
         candidates = (lib_path,) if lib_path else _SEARCH_PATHS
         for cand in candidates:
             if cand is None:
                 continue
             try:
                 lib = ctypes.CDLL(cand)
-                if lib.tpuhealth_abi_version() not in (1, 2):
+                if lib.tpuhealth_abi_version() not in (1, 2, 3, 4):
                     log.warning("libtpuhealth %s has unknown ABI; ignoring", cand)
                     continue
                 for fn in ("tpuhealth_probe_config", "tpuhealth_probe_node",
@@ -56,13 +69,27 @@ class TpuHealth:
                     getattr(lib, fn).restype = ctypes.c_int
                     if fn != "tpuhealth_libtpu_available":
                         getattr(lib, fn).argtypes = [ctypes.c_char_p]
-                # v2 symbol; a v1 shim just uses the Python reader for it
+                # v2/v3 symbols; older shims use the Python readers instead
                 try:
                     lib.tpuhealth_pci_status.restype = ctypes.c_int
                     lib.tpuhealth_pci_status.argtypes = [ctypes.c_char_p]
                     self._has_pci_status = True
                 except AttributeError:
                     self._has_pci_status = False
+                try:
+                    lib.tpuhealth_pcie_link.restype = ctypes.c_int
+                    lib.tpuhealth_pcie_link.argtypes = [
+                        ctypes.c_char_p] + [ctypes.POINTER(ctypes.c_int)] * 4
+                    self._has_pcie_link = True
+                except AttributeError:
+                    self._has_pcie_link = False
+                try:
+                    lib.tpuhealth_chip_diag.restype = ctypes.c_int
+                    lib.tpuhealth_chip_diag.argtypes = [
+                        ctypes.c_char_p] + [ctypes.POINTER(ctypes.c_int)] * 5
+                    self._has_chip_diag = True
+                except AttributeError:
+                    self._has_chip_diag = False
                 self._lib = lib
                 log.info("loaded native libtpuhealth from %s", cand)
                 break
@@ -120,18 +147,86 @@ class TpuHealth:
             return None
         return data[0] | (data[1] << 8)
 
+    def pcie_link(self, config_path: str) -> Optional[dict]:
+        """PCIe link state: {cur_speed, cur_width, max_speed, max_width}
+        (speeds are PCIe generation codes, widths lane counts), or None when
+        the capability is unreachable (device gone, short non-root sysfs
+        read, fixture trees with no config/capability list)."""
+        if self._lib is not None and self._has_pcie_link:
+            outs = [ctypes.c_int() for _ in range(4)]
+            rc = self._lib.tpuhealth_pcie_link(
+                config_path.encode(), *[ctypes.byref(o) for o in outs])
+            if rc != OK:
+                return None
+            cs, cw, ms_, mw = (o.value for o in outs)
+            return {"cur_speed": cs, "cur_width": cw,
+                    "max_speed": ms_, "max_width": mw}
+        try:
+            with open(config_path, "rb") as f:
+                cfg = f.read(256)
+        except OSError:
+            return None
+        if len(cfg) < 64 or cfg[0:2] == b"\xff\xff":
+            return None
+        if not cfg[0x06] & 0x10:   # no capability list
+            return None
+        off = cfg[0x34] & 0xFC
+        for _ in range(48):
+            if off < 0x40 or off + 0x14 > len(cfg):
+                return None
+            if cfg[off] == 0x10:   # PCI Express capability
+                linkcap = int.from_bytes(cfg[off + 0x0C:off + 0x10], "little")
+                linkstat = int.from_bytes(cfg[off + 0x12:off + 0x14], "little")
+                return {"cur_speed": linkstat & 0xF,
+                        "cur_width": (linkstat >> 4) & 0x3F,
+                        "max_speed": linkcap & 0xF,
+                        "max_width": (linkcap >> 4) & 0x3F}
+            off = cfg[off + 1] & 0xFC
+        return None
+
+    def chip_diagnostics(self, pci_base_path: str,
+                         bdf: str) -> "Tuple[int, Optional[dict]]":
+        """(latched error bits, PCIe link state) from ONE config read.
+
+        The /status and /metrics scrapes and the 5 s health poll want both
+        facts per device; reading the config file once per device halves
+        their syscall load versus separate pci_status + pcie_link probes.
+        Error bits are the XID-events analogue (0 = clean/unreadable;
+        all-FF no-response reads count as clean — that's the off-bus
+        artifact, probe_config's DEAD case, not latched errors). The link
+        dict is None when the PCIe capability is unreachable."""
+        path = os.path.join(pci_base_path, bdf, "config")
+        if self._lib is not None and self._has_chip_diag:
+            outs = [ctypes.c_int() for _ in range(5)]
+            rc = self._lib.tpuhealth_chip_diag(
+                path.encode(), *[ctypes.byref(o) for o in outs])
+            status, cs, cw, ms_, mw = (o.value for o in outs)
+            if rc != OK or status < 0:
+                return 0, None
+            link = (None if ms_ < 0 else
+                    {"cur_speed": cs, "cur_width": cw,
+                     "max_speed": ms_, "max_width": mw})
+            return status & PCI_STATUS_ERROR_MASK, link
+        status = self.pci_status(path)
+        bits = (0 if status is None or status == 0xFFFF
+                else status & PCI_STATUS_ERROR_MASK)
+        return bits, self.pcie_link(path)
+
+    def chip_link_degraded(self, pci_base_path: str, bdf: str) -> bool:
+        """True when the chip's PCIe link trained below its maximum —
+        connector fault / thermal retrain signal (NVML's
+        CurrPcieLinkWidth/Generation analogue). Diagnostic, never a
+        liveness veto: a degraded chip still works, just slower."""
+        return link_is_degraded(
+            self.chip_diagnostics(pci_base_path, bdf)[1])
+
     def chip_error_bits(self, pci_base_path: str, bdf: str) -> int:
         """Latched PCI error bits for one chip (0 = clean/unreadable).
 
         The XID-events analogue: parity/SERR/abort bits latch on bus errors
         even while the chip is vfio-bound. Diagnostic, not a liveness veto —
         the bits can be sticky from boot-time bus probing."""
-        status = self.pci_status(os.path.join(pci_base_path, bdf, "config"))
-        if status is None or status == 0xFFFF:
-            # all-FF is the no-response artifact of a chip off the bus
-            # (probe_config's DEAD case), not real latched error bits
-            return 0
-        return status & PCI_STATUS_ERROR_MASK
+        return self.chip_diagnostics(pci_base_path, bdf)[0]
 
     def chip_alive(self, pci_base_path: str, bdf: str,
                    node_path: Optional[str] = None) -> bool:
@@ -154,12 +249,27 @@ class TpuHealth:
         if alive and node_path is not None:
             alive = self.probe_node(node_path) == OK
         if alive:
-            # surface latched bus errors without vetoing; log on change only
-            bits = self.chip_error_bits(pci_base_path, bdf)
+            # surface latched bus errors + link degradation without
+            # vetoing; one config read for both, logged on change only
+            bits, link = self.chip_diagnostics(pci_base_path, bdf)
             if bits != self._err_logged.get(bdf, 0):
                 self._err_logged[bdf] = bits
                 if bits:
                     log.warning("chip %s: PCI status error bits 0x%04x "
                                 "latched (diagnostic, not vetoing health)",
                                 bdf, bits)
+            if link is not None:
+                degraded = link_is_degraded(link)
+                if degraded != self._link_logged.get(bdf, False):
+                    self._link_logged[bdf] = degraded
+                    if degraded:
+                        log.warning(
+                            "chip %s: PCIe link degraded — gen%d x%d trained"
+                            " vs gen%d x%d capable (diagnostic, not vetoing"
+                            " health)", bdf, link["cur_speed"],
+                            link["cur_width"], link["max_speed"],
+                            link["max_width"])
+                    else:
+                        log.info("chip %s: PCIe link recovered to gen%d x%d",
+                                 bdf, link["cur_speed"], link["cur_width"])
         return alive

@@ -32,7 +32,7 @@ from . import kubeletapi as api
 from .config import Config
 from .health import HealthMonitor
 from .kubeletapi import pb
-from .native import TpuHealth
+from .native import TpuHealth, link_is_degraded
 from .registry import Registry, TpuDevice
 from .topology import AllocatableDevice, MustIncludeTooLarge, preferred_allocation
 
@@ -292,14 +292,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         """Public state snapshot for the status endpoint (/status)."""
         with self._cond:
             devices = {dev_id: d.health for dev_id, d in self._devs.items()}
-        # latched PCI bus-error bits (XID-events analogue): diagnostic only,
-        # read outside the lock — sysfs reads must never block RPC paths
+        # latched PCI bus-error bits (XID-events analogue) + PCIe link
+        # training state (CurrPcieLinkWidth analogue): diagnostic only, ONE
+        # config read per device, outside the lock — sysfs reads must never
+        # block RPC paths
         errors = {}
+        degraded_links = {}
         for d in self.devices:
-            bits = self.health_shim.chip_error_bits(self.cfg.pci_base_path,
-                                                    d.bdf)
+            bits, link = self.health_shim.chip_diagnostics(
+                self.cfg.pci_base_path, d.bdf)
             if bits:
                 errors[d.bdf] = f"0x{bits:04x}"
+            if link_is_degraded(link):
+                degraded_links[d.bdf] = (
+                    f"gen{link['cur_speed']}x{link['cur_width']} of "
+                    f"gen{link['max_speed']}x{link['max_width']}")
         return {
             "resource": self.resource_name,
             "socket": self.socket_path,
@@ -307,6 +314,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "restarts": self._restart_count,
             "devices": devices,
             "pci_errors": errors,
+            "degraded_links": degraded_links,
             "allocations_total": self._alloc_count,
             "recent_allocations": list(self._recent_allocs),
         }

@@ -115,6 +115,13 @@ class StatusServer:
         for p in s["plugins"]:
             lines.append(f'tpu_plugin_serving{{resource="{p["resource"]}"}} '
                          f'{int(p["serving"])}')
+        lines += ["# HELP tpu_plugin_degraded_links Chips whose PCIe link "
+                  "trained below its maximum (diagnostic).",
+                  "# TYPE tpu_plugin_degraded_links gauge"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_degraded_links{{resource="{p["resource"]}"}} '
+                f'{len(p.get("degraded_links", {}))}')
         lines += ["# HELP tpu_plugin_restarts_total Socket-loss restarts.",
                   "# TYPE tpu_plugin_restarts_total counter"]
         for p in s["plugins"]:

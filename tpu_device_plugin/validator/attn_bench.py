@@ -20,21 +20,37 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 
-def _median(xs: List[float]) -> float:
-    xs = sorted(xs)
-    return xs[len(xs) // 2]
+# module-level names so tests can monkeypatch the timing seam
+from .timing import median as _median  # noqa: E402
+from .timing import paired_time as _paired_time  # noqa: E402
 
 
-def _time_fn(fn, args, iters: int) -> float:
-    """Median wall-clock seconds per call, after one warmup/compile call."""
+def _chain_fwd(fn_one, repeats: int):
+    """jit(q,k,v) -> scalar: `repeats` serially-dependent forwards (each
+    output feeds the next call's q, so XLA can neither DCE nor overlap
+    them), reduced to one float so fetching it forces full execution."""
     import jax
-    jax.block_until_ready(fn(*args))
-    samples = []
-    for _ in range(iters):
-        t0 = time.monotonic()
-        jax.block_until_ready(fn(*args))
-        samples.append(time.monotonic() - t0)
-    return _median(samples)
+    import jax.numpy as jnp
+
+    def run(q, k, v):
+        out = jax.lax.fori_loop(
+            0, max(repeats, 1), lambda i, qq: fn_one(qq, k, v), q)
+        return jnp.sum(out.astype(jnp.float32))
+    return jax.jit(run)
+
+
+def _chain_train(grad_fn, repeats: int):
+    """Same, for a grad fn returning (dq, dk, dv): dq feeds the next q."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(q, k, v):
+        def body(i, qq):
+            dq, _, _ = grad_fn(qq, k, v)
+            return dq
+        out = jax.lax.fori_loop(0, max(repeats, 1), body, q)
+        return jnp.sum(out.astype(jnp.float32))
+    return jax.jit(run)
 
 
 def bench_attention(
@@ -46,6 +62,8 @@ def bench_attention(
     causal: bool = True,
     device=None,
     interpret: Optional[bool] = None,
+    bwd_blocks: Sequence[Optional[Tuple[int, int]]] = (None,),
+    repeats: int = 1,
 ) -> dict:
     """Compare Pallas flash vs einsum reference on one device.
 
@@ -73,16 +91,26 @@ def bench_attention(
     sm = head_dim ** -0.5
     cells = []
     for seq in seq_lens:
+        # Differencing cancels the fixed relay overhead but its run-to-run
+        # noise (~ms) remains: scale the chain length so R x t_iter stays
+        # well above it at every seq (attention compute ~ seq^2). Floor of
+        # 2 — collapsing to 1 would silently re-enter the plain-timing
+        # path this module documents as untrustworthy on relayed devices.
+        reps = (max(2, min(2048, int(repeats * (4096 / seq) ** 2)))
+                if repeats > 1 else repeats)
         q, k, v = (rand((hb, seq, head_dim), i) for i in (1, 2, 3))
-        ein_fwd = jax.jit(
-            lambda q, k, v: _reference_attention(q, k, v, sm, causal))
-        ein_train = jax.jit(jax.grad(
+        # cast to q.dtype so the chained carry type matches q's
+        ein_fwd_one = (lambda q, k, v: _reference_attention(q, k, v, sm, causal)
+                       .astype(q.dtype))
+        ein_grad = jax.grad(
             lambda q, k, v: jnp.sum(
                 _reference_attention(q, k, v, sm, causal)
-                .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
+                .astype(jnp.float32) ** 2), argnums=(0, 1, 2))
         try:
-            ein_fwd_s = _time_fn(ein_fwd, (q, k, v), iters)
-            ein_train_s = _time_fn(ein_train, (q, k, v), iters)
+            ein_fwd_s = _paired_time(
+                lambda r: _chain_fwd(ein_fwd_one, r), (q, k, v), iters, reps)
+            ein_train_s = _paired_time(
+                lambda r: _chain_train(ein_grad, r), (q, k, v), iters, reps)
             ein_err = ""
         except Exception as exc:
             # the einsum reference materializes the (S, S) matrix and can
@@ -90,40 +118,50 @@ def bench_attention(
             ein_fwd_s = ein_train_s = None
             ein_err = f"einsum: {type(exc).__name__}: {exc}"
         for bq, bk in blocks:
-            fl_fwd = jax.jit(
-                lambda q, k, v, bq=bq, bk=bk: flash_attention(
-                    q, k, v, None, causal, bq, bk, interpret))
-            fl_train = jax.jit(jax.grad(
-                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
-                    flash_attention(q, k, v, None, causal, bq, bk, interpret)
-                    .astype(jnp.float32) ** 2), argnums=(0, 1, 2)))
-            try:
-                fl_fwd_s = _time_fn(fl_fwd, (q, k, v), iters)
-                fl_train_s = _time_fn(fl_train, (q, k, v), iters)
-                err = ein_err
-            except Exception as exc:  # report the cell, keep sweeping
-                fl_fwd_s = fl_train_s = None  # None -> JSON null, never NaN
-                err = "; ".join(
-                    x for x in (ein_err,
-                                f"flash: {type(exc).__name__}: {exc}") if x)
+            for bwd in bwd_blocks:
+                bwq, bwk = bwd if bwd is not None else (None, None)
+                fl_fwd_one = (
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, None, causal, bq, bk, interpret))
+                fl_grad = jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk, bwq=bwq, bwk=bwk: jnp.sum(
+                        flash_attention(q, k, v, None, causal, bq, bk,
+                                        interpret, bwq, bwk)
+                        .astype(jnp.float32) ** 2), argnums=(0, 1, 2))
+                try:
+                    fl_fwd_s = _paired_time(
+                        lambda r: _chain_fwd(fl_fwd_one, r),
+                        (q, k, v), iters, reps)
+                    fl_train_s = _paired_time(
+                        lambda r: _chain_train(fl_grad, r),
+                        (q, k, v), iters, reps)
+                    err = ein_err
+                except Exception as exc:  # report the cell, keep sweeping
+                    fl_fwd_s = fl_train_s = None  # None -> JSON null
+                    err = "; ".join(
+                        x for x in (ein_err,
+                                    f"flash: {type(exc).__name__}: {exc}")
+                        if x)
 
-            def ms(s):
-                return None if s is None else s * 1e3
+                def ms(s):
+                    return None if s is None else s * 1e3
 
-            def speedup(ref_s, new_s):
-                return (ref_s / new_s
-                        if ref_s is not None and new_s else None)
+                def speedup(ref_s, new_s):
+                    return (ref_s / new_s
+                            if ref_s is not None and new_s else None)
 
-            cells.append({
-                "seq": seq, "block_q": bq, "block_k": bk,
-                "flash_fwd_ms": ms(fl_fwd_s),
-                "einsum_fwd_ms": ms(ein_fwd_s),
-                "flash_train_ms": ms(fl_train_s),
-                "einsum_train_ms": ms(ein_train_s),
-                "fwd_speedup": speedup(ein_fwd_s, fl_fwd_s),
-                "train_speedup": speedup(ein_train_s, fl_train_s),
-                "error": err,
-            })
+                cells.append({
+                    "seq": seq, "block_q": bq, "block_k": bk,
+                    "bwd_block_q": bwq or bq, "bwd_block_k": bwk or bk,
+                    "reps": reps,  # effective chain length for this seq
+                    "flash_fwd_ms": ms(fl_fwd_s),
+                    "einsum_fwd_ms": ms(ein_fwd_s),
+                    "flash_train_ms": ms(fl_train_s),
+                    "einsum_train_ms": ms(ein_train_s),
+                    "fwd_speedup": speedup(ein_fwd_s, fl_fwd_s),
+                    "train_speedup": speedup(ein_train_s, fl_train_s),
+                    "error": err,
+                })
     wins = sorted({c["seq"] for c in cells
                    if c["flash_fwd_ms"] is not None
                    and (c["fwd_speedup"] or 0) > 1.0})
@@ -133,6 +171,7 @@ def bench_attention(
         "interpret": interpret,
         "hb": hb,
         "head_dim": head_dim,
+        "repeats": repeats,
         "cells": cells,
         "flash_wins_at": wins,
         # the verdict the CLI uses: the FLASH kernel must have run in every

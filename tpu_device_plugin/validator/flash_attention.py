@@ -318,17 +318,27 @@ def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """Blockwise causal attention. q, k, v: (heads_batch, seq, head_dim)."""
+                    block_k: int = 128, interpret: bool = False,
+                    bwd_block_q: Optional[int] = None,
+                    bwd_block_k: Optional[int] = None):
+    """Blockwise causal attention. q, k, v: (heads_batch, seq, head_dim).
+
+    `bwd_block_q`/`bwd_block_k` tile the backward kernels independently of
+    the forward (None = same as forward). The backward touches ~2.5x the
+    operands per tile (FA-2 two-pass: dkv then dq), so its MXU-optimal
+    block shape differs — the hardware sweep (attn_bench --bwd-blocks)
+    picks per-seq winners.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _flash_3d(q, k, v, sm_scale, causal, block_q, block_k, interpret)
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+         bwd_block_q, bwd_block_k):
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     out, lse = _flash_3d(q, k, v, sm_scale, causal, block_q, block_k,
@@ -336,12 +346,14 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, d_out):
+def _bwd(sm_scale, causal, block_q, block_k, interpret,
+         bwd_block_q, bwd_block_k, residuals, d_out):
     q, k, v, o, lse = residuals
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
-                         block_q, block_k, interpret)
+                         bwd_block_q or block_q, bwd_block_k or block_k,
+                         interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

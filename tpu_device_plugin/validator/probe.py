@@ -57,39 +57,55 @@ def _workload_flops(cfg) -> float:
     return 3.0 * per_token * cfg.batch * cfg.seq_len
 
 
+def _diff_time(make_chain, arg, n: int) -> float:
+    """Per-iteration seconds of a chained computation by paired-repeats
+    differencing — thin adapter over the shared estimator
+    (validator/timing.py, also used by attn_bench) so the methodology
+    cannot drift between the two benchmark surfaces."""
+    from .timing import paired_time
+    return paired_time(make_chain, (arg,), 3, n)
+
+
 def _microbench(device) -> tuple:
     """Single-chip sanity numbers: bf16 matmul TFLOP/s and memory GB/s.
 
     Small enough to finish in seconds; meant to catch a chip running at a
     fraction of expected speed (thermal clamp, degraded HBM), not to be a
-    rigorous peak benchmark.
+    rigorous peak benchmark. Uses chained differencing (_diff_time) so the
+    relay's fixed sync cost does not masquerade as compute time.
     """
     import jax
     import jax.numpy as jnp
     on_tpu = device.platform == "tpu"
     n = 4096 if on_tpu else 512
-    x = jax.device_put(jnp.ones((n, n), jnp.bfloat16), device)
-    mm = jax.jit(lambda a: a @ a)
-    mm(x).block_until_ready()
-    iters = 8
-    t0 = time.monotonic()
-    y = x
-    for _ in range(iters):
-        y = mm(y)
-    y.block_until_ready()
-    tflops = 2.0 * n ** 3 * iters / (time.monotonic() - t0) / 1e12
+    # row-stochastic so the chained products stay finite in bf16
+    x = jax.device_put(jnp.full((n, n), 1.0 / n, jnp.bfloat16), device)
+
+    def mm_chain(k):
+        def run(a):
+            out = jax.lax.fori_loop(0, k, lambda i, y: y @ x, a)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.jit(run)
+
+    iters = 16 if on_tpu else 2
+    mm_s = _diff_time(mm_chain, x, iters)
+    tflops = 2.0 * n ** 3 / mm_s / 1e12 if mm_s > 0 else 0.0
 
     m = (256 if on_tpu else 16) * 1024 * 1024 // 4
     big = jax.device_put(jnp.ones((m,), jnp.float32), device)
-    add = jax.jit(lambda a: a + 1.0)
-    add(big).block_until_ready()
-    t0 = time.monotonic()
-    z = big
-    for _ in range(iters):
-        z = add(z)
-    z.block_until_ready()
+
+    def add_chain(k):
+        # fma, not a pure increment: z+1.0 k times is algebraically z+k
+        # and a compiler could in principle collapse the loop
+        def run(a):
+            out = jax.lax.fori_loop(
+                0, k, lambda i, z: z * 1.000001 + 1.0, a)
+            return out[0]
+        return jax.jit(run)
+
+    add_s = _diff_time(add_chain, big, iters)
     # one read + one write of m float32 per iteration
-    gbps = 2.0 * m * 4 * iters / (time.monotonic() - t0) / 1e9
+    gbps = 2.0 * m * 4 / add_s / 1e9 if add_s > 0 else 0.0
     return tflops, gbps
 
 
@@ -125,21 +141,37 @@ def validate_slice(
 
         if mode == "infer":
             # serving path: forward-only latency distribution, no optimizer
+            import jax.numpy as jnp
             steps = max(steps, 1)  # percentiles need >=1 sample
             fwd, params, tokens = build_infer(cfg, mesh, attention=attention)
             logits = fwd(params, tokens)
-            jax.block_until_ready(logits)
+            float(logits.astype(jnp.float32)[0, 0, 0])  # trusted sync
             report.first_step_s = time.monotonic() - _PROCESS_START
+            # End-to-end percentiles: submit -> one fetched element. Inside
+            # a VMI with local chips this IS serving latency; on a relayed
+            # device it includes the relay's fixed sync cost (the
+            # differenced step_time below is the pure device time).
             lat = []
             for _ in range(steps):
                 t0 = time.monotonic()
-                jax.block_until_ready(fwd(params, tokens))
+                float(fwd(params, tokens).astype(jnp.float32)[0, 0, 0])
                 lat.append(time.monotonic() - t0)
             lat.sort()
             report.infer_p50_ms = lat[len(lat) // 2] * 1e3
             report.infer_p99_ms = lat[min(len(lat) - 1,
                                           int(len(lat) * 0.99))] * 1e3
-            report.step_time_s = sum(lat) / len(lat)
+
+            # pure per-forward device time by chained differencing
+            # (_diff_time): each iteration's argmax feeds the next tokens
+            def infer_chain(k):
+                def run(tok):
+                    def body(i, t):
+                        lg = fwd(params, t)
+                        return jnp.argmax(lg, axis=-1).astype(t.dtype)
+                    return jnp.sum(jax.lax.fori_loop(0, k, body, tok))
+                return jax.jit(run)
+            fwd_s = _diff_time(infer_chain, tokens, max(steps // 2, 4))
+            report.step_time_s = fwd_s if fwd_s > 0 else sum(lat) / len(lat)
             report.tokens_per_s = cfg.batch * cfg.seq_len / report.step_time_s
             # a serving slice is usable iff its logits are finite
             report.ok = bool(jax.numpy.isfinite(logits).all())
@@ -179,16 +211,29 @@ def validate_slice(
             report.loss_start = float(loss)
             report.first_step_s = time.monotonic() - _PROCESS_START
 
-            t0 = time.monotonic()
-            for _ in range(steps):
-                params, momentum, loss = step(params, momentum, tokens)
-            jax.block_until_ready(loss)
-            elapsed = time.monotonic() - t0
-            report.loss_end = float(loss)
-            report.step_time_s = elapsed / steps
-            report.tflops_per_chip = (
-                _workload_flops(cfg) / report.step_time_s / 1e12
-                / max(report.n_devices, 1))
+            # Differenced steady-state step time: time a block of N steps
+            # and a block of 2N (each synced by FETCHING the loss — the
+            # only sync trusted on relayed devices), divide the difference
+            # by N. Cancels the fixed per-fetch cost that would otherwise
+            # inflate step_time by sync_cost/steps.
+            steps = max(steps, 1)
+
+            def run_block(k):
+                nonlocal params, momentum, loss
+                t0 = time.monotonic()
+                for _ in range(k):
+                    params, momentum, loss = step(params, momentum, tokens)
+                val = float(loss)
+                return time.monotonic() - t0, val
+
+            t_n, _ = run_block(steps)
+            t_2n, loss_val = run_block(2 * steps)
+            report.loss_end = loss_val
+            report.step_time_s = max(t_2n - t_n, 0.0) / steps
+            if report.step_time_s > 0:
+                report.tflops_per_chip = (
+                    _workload_flops(cfg) / report.step_time_s / 1e12
+                    / max(report.n_devices, 1))
 
             # a slice that cannot learn is broken even if it computes
             report.ok = report.loss_end < report.loss_start
@@ -227,6 +272,18 @@ def main(argv=None) -> int:
                              "flash-vs-einsum kernel sweep on one device")
     parser.add_argument("--seqs", default="1024,2048,4096",
                         help="attn-bench sequence lengths, comma-separated")
+    parser.add_argument("--bwd-blocks", default="",
+                        help="attn-bench BACKWARD block sizes (e.g. "
+                             "256x256,512x256); empty = same as forward. "
+                             "Swept cross-product with --blocks")
+    parser.add_argument("--hb", type=int, default=8,
+                        help="attn-bench heads*batch (folded leading dim)")
+    parser.add_argument("--repeats", type=int, default=1,
+                        help="attn-bench: chain this many dependent "
+                             "evaluations inside one jit and amortize — "
+                             "REQUIRED for truthful numbers on tunneled "
+                             "devices whose per-dispatch floor (~40 us) "
+                             "exceeds small-kernel compute time")
     parser.add_argument("--blocks", default="128x128",
                         help="attn-bench flash block sizes, e.g. "
                              "'128x128,256x128,128x256'")
@@ -288,12 +345,18 @@ def main(argv=None) -> int:
             parser.error("--gpipe-microbatches only applies to --mode train")
         from .attn_bench import bench_attention
         try:
+            bwd = tuple(
+                tuple(int(x) for x in b.split("x"))
+                for b in args.bwd_blocks.split(",") if b) or (None,)
             result = bench_attention(
                 seq_lens=tuple(int(s) for s in args.seqs.split(",") if s),
                 blocks=tuple(
                     tuple(int(x) for x in b.split("x"))
                     for b in args.blocks.split(",") if b),
                 iters=args.steps,
+                hb=args.hb,
+                bwd_blocks=bwd,
+                repeats=args.repeats,
             )
         except Exception as exc:  # same report-don't-crash contract
             print(json.dumps({"ok": False,

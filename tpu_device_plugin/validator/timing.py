@@ -1,0 +1,53 @@
+"""Device-timing helpers that survive relayed/tunneled backends.
+
+Two traps poison naive timing on the build environment's tunneled TPU (and
+any remote PJRT relay):
+
+  (a) jax.block_until_ready can return BEFORE the relayed computation
+      finishes — observed as "8192-long attention in 1 us". The only sync
+      this module trusts is fetching a data-dependent scalar to host.
+  (b) a forced-sync fetch carries a FIXED per-call cost (~70 ms observed),
+      swamping ms-scale kernels.
+
+The methodology: chain R serially-dependent iterations inside one jit,
+reduce to a scalar, time the fetch at R and 2R, and divide the difference
+by R — the fixed cost cancels exactly. Shared by attn_bench and probe so
+the estimator cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Sequence
+
+
+def median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def time_total(fn, args, iters: int) -> float:
+    """Median wall-clock seconds per call, after one warmup/compile call.
+
+    `fn(*args)` must return a scalar depending on the full computation;
+    float() fetches it (the trusted sync, see module docstring)."""
+    samples: List[float] = []
+    float(fn(*args))   # warmup/compile
+    for _ in range(max(iters, 1)):
+        t0 = time.monotonic()
+        float(fn(*args))
+        samples.append(time.monotonic() - t0)
+    return median(samples)
+
+
+def paired_time(build, args, iters: int, repeats: int) -> float:
+    """Per-iteration seconds via paired-repeats differencing.
+
+    `build(k)` returns a jitted fn of `args` chaining k dependent
+    iterations into one scalar. repeats<=1 falls back to plain per-call
+    timing — only correct on local devices (tests, interpret mode)."""
+    if repeats <= 1:
+        return time_total(build(1), args, iters)
+    t1 = time_total(build(repeats), args, iters)
+    t2 = time_total(build(2 * repeats), args, iters)
+    return max((t2 - t1) / repeats, 0.0)
