@@ -177,6 +177,28 @@ def test_pallas_backward_matches_reference(causal, seq, block):
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
+@pytest.mark.parametrize("bwd_block", [(64, 32), (32, 64), (None, None)])
+def test_pallas_backward_decoupled_blocks(bwd_block):
+    """Backward blocks decoupled from the forward's (incl. the None default,
+    which resolves to DEFAULT_BWD_BLOCK and must clamp to short seqs) still
+    reproduce reference gradients."""
+    bwq, bwk = bwd_block
+    hb, seq, d = 2, 96, 32
+    q, k, v = rand((hb, seq, d), 7), rand((hb, seq, d), 8), rand((hb, seq, d), 9)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, None, True, 32, 32, True,
+                                       bwq, bwk) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, d ** -0.5, True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
 def test_pallas_backward_bfloat16():
     hb, seq, d = 2, 64, 32
     q = rand((hb, seq, d), 1).astype(jnp.bfloat16)

@@ -166,6 +166,12 @@ class TpuHealth:
                 cfg = f.read(256)
         except OSError:
             return None
+        return self._parse_link_cfg(cfg)
+
+    @staticmethod
+    def _parse_link_cfg(cfg: bytes) -> Optional[dict]:
+        """Walk the capability list in raw config bytes for the PCIe link
+        registers (shared by pcie_link and the chip_diagnostics fallback)."""
         if len(cfg) < 64 or cfg[0:2] == b"\xff\xff":
             return None
         if not cfg[0x06] & 0x10:   # no capability list
@@ -207,10 +213,17 @@ class TpuHealth:
                     {"cur_speed": cs, "cur_width": cw,
                      "max_speed": ms_, "max_width": mw})
             return status & PCI_STATUS_ERROR_MASK, link
-        status = self.pci_status(path)
-        bits = (0 if status is None or status == 0xFFFF
-                else status & PCI_STATUS_ERROR_MASK)
-        return bits, self.pcie_link(path)
+        # fallback: one 256-byte read serves both facts, same as the C side
+        try:
+            with open(path, "rb") as f:
+                cfg = f.read(256)
+        except OSError:
+            return 0, None
+        if len(cfg) < 8:
+            return 0, None
+        status = cfg[6] | (cfg[7] << 8)
+        bits = 0 if status == 0xFFFF else status & PCI_STATUS_ERROR_MASK
+        return bits, self._parse_link_cfg(cfg)
 
     def chip_link_degraded(self, pci_base_path: str, bdf: str) -> bool:
         """True when the chip's PCIe link trained below its maximum —

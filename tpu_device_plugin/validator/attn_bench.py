@@ -16,12 +16,9 @@ On CPU the kernel runs in interpret mode (slow): keep seqs small there.
 
 from __future__ import annotations
 
-import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
-
-# module-level names so tests can monkeypatch the timing seam
-from .timing import median as _median  # noqa: E402
+# module-level name so tests can monkeypatch the timing seam
 from .timing import paired_time as _paired_time  # noqa: E402
 
 
@@ -40,16 +37,22 @@ def _chain_fwd(fn_one, repeats: int):
 
 
 def _chain_train(grad_fn, repeats: int):
-    """Same, for a grad fn returning (dq, dk, dv): dq feeds the next q."""
+    """Same, for a grad fn returning (dq, dk, dv). ALL THREE grads feed the
+    next iteration's inputs (dq becomes q; dk/dv perturb k/v) — carrying dq
+    alone would let XLA dead-code-eliminate the entire dk/dv computation
+    (the dkv backward kernel), silently timing a partial backward."""
     import jax
     import jax.numpy as jnp
 
     def run(q, k, v):
-        def body(i, qq):
-            dq, _, _ = grad_fn(qq, k, v)
-            return dq
-        out = jax.lax.fori_loop(0, max(repeats, 1), body, q)
-        return jnp.sum(out.astype(jnp.float32))
+        def body(i, qkv):
+            qq, kk, vv = qkv
+            dq, dk, dv = grad_fn(qq, kk, vv)
+            return (dq,
+                    kk + (0.001 * dk).astype(kk.dtype),
+                    vv + (0.001 * dv).astype(vv.dtype))
+        out = jax.lax.fori_loop(0, max(repeats, 1), body, (q, k, v))
+        return sum(jnp.sum(x.astype(jnp.float32)) for x in out)
     return jax.jit(run)
 
 
@@ -74,7 +77,8 @@ def bench_attention(
     import jax
     import jax.numpy as jnp
 
-    from .flash_attention import _reference_attention, flash_attention
+    from .flash_attention import (DEFAULT_BWD_BLOCK, _reference_attention,
+                                  flash_attention)
 
     if device is None:
         # local: in a multi-VMI slice jax.devices() spans other guests'
@@ -152,7 +156,10 @@ def bench_attention(
 
                 cells.append({
                     "seq": seq, "block_q": bq, "block_k": bk,
-                    "bwd_block_q": bwq or bq, "bwd_block_k": bwk or bk,
+                    # record the EFFECTIVE backward tiling: None resolves to
+                    # DEFAULT_BWD_BLOCK in _bwd, and both axes clamp to seq
+                    "bwd_block_q": min(bwq or DEFAULT_BWD_BLOCK, seq),
+                    "bwd_block_k": min(bwk or DEFAULT_BWD_BLOCK, seq),
                     "reps": reps,  # effective chain length for this seq
                     "flash_fwd_ms": ms(fl_fwd_s),
                     "einsum_fwd_ms": ms(ein_fwd_s),

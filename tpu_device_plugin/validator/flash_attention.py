@@ -318,6 +318,13 @@ def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
     return dq, dk, dv
 
 
+# Backward-block default from the TPU v5 lite hardware sweep
+# (docs/validator_tpu_bwd_sweep_r03.json): 256x256 wins at every measured
+# seq — train speedup vs einsum 0.87->1.40 at 2048 and 1.95->3.13 at 4096
+# relative to inheriting the forward's 128-blocks. Clamped to seq below.
+DEFAULT_BWD_BLOCK = 256
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     causal: bool = True, block_q: int = 128,
@@ -327,10 +334,11 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     """Blockwise causal attention. q, k, v: (heads_batch, seq, head_dim).
 
     `bwd_block_q`/`bwd_block_k` tile the backward kernels independently of
-    the forward (None = same as forward). The backward touches ~2.5x the
-    operands per tile (FA-2 two-pass: dkv then dq), so its MXU-optimal
-    block shape differs — the hardware sweep (attn_bench --bwd-blocks)
-    picks per-seq winners.
+    the forward (None = the hardware-swept DEFAULT_BWD_BLOCK, clamped to
+    seq). The backward touches ~2.5x the operands per tile (FA-2 two-pass:
+    dkv then dq), so its MXU-optimal block shape differs from the
+    forward's — larger tiles amortize the lse/di reloads across more MXU
+    work (sweep: attn_bench --bwd-blocks).
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -352,7 +360,8 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
-                         bwd_block_q or block_q, bwd_block_k or block_k,
+                         bwd_block_q or DEFAULT_BWD_BLOCK,
+                         bwd_block_k or DEFAULT_BWD_BLOCK,
                          interpret)
 
 
