@@ -17,9 +17,11 @@ native: native/libtpuhealth.so
 native/libtpuhealth.so: native/tpuhealth.cpp
 	$(CXX) $(CXXFLAGS) -shared -o $@ $< -ldl
 
-# Regenerate kubelet v1beta1 protobuf messages (generated file is committed).
-proto: proto/deviceplugin_v1beta1.proto
-	protoc --python_out=tpu_device_plugin/kubeletapi -Iproto proto/deviceplugin_v1beta1.proto
+# Regenerate kubelet protobuf messages (generated files are committed).
+proto: proto/deviceplugin_v1beta1.proto proto/dra_v1beta1.proto proto/pluginregistration_v1.proto
+	protoc --python_out=tpu_device_plugin/kubeletapi -Iproto \
+	  proto/deviceplugin_v1beta1.proto proto/dra_v1beta1.proto \
+	  proto/pluginregistration_v1.proto
 
 test:
 	$(PYTHON) -m pytest tests/ -q

@@ -1,0 +1,457 @@
+"""DRA driver tests: ResourceSlice publishing, kubelet registration
+handshake, NodePrepareResources/NodeUnprepareResources over real gRPC,
+CDI spec lifecycle, checkpoint restart recovery.
+
+The API server is a stdlib HTTP server faking exactly the endpoints the
+driver touches (nodes GET, resourceslices CRUD, resourceclaims GET); the
+kubelet side is a real gRPC client dialing the driver's sockets the way
+kubelet's pluginwatcher + DRA manager do.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover
+from tpu_device_plugin.dra import DraDriver, slice_device_name
+from tpu_device_plugin.kubeapi import ApiClient
+from tpu_device_plugin.kubeletapi import draapi, drapb, regpb
+
+
+class FakeApiServer:
+    """Just enough of the kube-apiserver for the DRA driver."""
+
+    def __init__(self):
+        self.slices = {}      # name -> object (with resourceVersion)
+        self.claims = {}      # (ns, name) -> object
+        self.requests = []    # (method, path) log
+        self._rv = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj=None):
+                body = json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                outer.requests.append(("GET", self.path))
+                if self.path.startswith("/api/v1/nodes/"):
+                    name = self.path.rsplit("/", 1)[-1]
+                    return self._send(200, {"metadata": {
+                        "name": name, "uid": f"uid-{name}"}})
+                if "/resourceslices/" in self.path:
+                    name = self.path.rsplit("/", 1)[-1]
+                    if name in outer.slices:
+                        return self._send(200, outer.slices[name])
+                    return self._send(404, {"reason": "NotFound"})
+                if "/resourceclaims/" in self.path:
+                    parts = self.path.split("/")
+                    ns, name = parts[-3], parts[-1]
+                    obj = outer.claims.get((ns, name))
+                    if obj is not None:
+                        return self._send(200, obj)
+                    return self._send(404, {"reason": "NotFound"})
+                return self._send(404, {})
+
+            def do_POST(self):
+                outer.requests.append(("POST", self.path))
+                obj = self._body()
+                name = obj["metadata"]["name"]
+                outer._rv += 1
+                obj["metadata"]["resourceVersion"] = str(outer._rv)
+                outer.slices[name] = obj
+                return self._send(201, obj)
+
+            def do_PUT(self):
+                outer.requests.append(("PUT", self.path))
+                name = self.path.rsplit("/", 1)[-1]
+                obj = self._body()
+                live = outer.slices.get(name)
+                if live is None:
+                    return self._send(404, {})
+                if (obj["metadata"].get("resourceVersion")
+                        != live["metadata"]["resourceVersion"]):
+                    return self._send(409, {"reason": "Conflict"})
+                outer._rv += 1
+                obj["metadata"]["resourceVersion"] = str(outer._rv)
+                outer.slices[name] = obj
+                return self._send(200, obj)
+
+            def do_DELETE(self):
+                outer.requests.append(("DELETE", self.path))
+                name = self.path.rsplit("/", 1)[-1]
+                if outer.slices.pop(name, None) is None:
+                    return self._send(404, {})
+                return self._send(200, {})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def add_claim(self, ns, name, uid, driver, results):
+        self.claims[(ns, name)] = {
+            "metadata": {"namespace": ns, "name": name, "uid": uid},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": r.get("request", "tpu"), "driver": driver,
+                 "pool": r.get("pool", "node-a"), "device": r["device"]}
+                for r in results
+            ]}}},
+        }
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def apiserver():
+    s = FakeApiServer()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def host():
+    # short root: unix socket paths cap at ~107 chars and pytest's tmp_path
+    # nesting blows past it for the plugins_registry socket
+    root = tempfile.mkdtemp(prefix="tdpdra-")
+    h = FakeHost(root)
+    for i in range(4):
+        h.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                            iommu_group=str(11 + i), numa_node=i // 2))
+    cfg = Config().with_root(root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    yield h, cfg
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def make_driver(cfg, apiserver, node="node-a"):
+    registry, generations = discover(cfg)
+    api = ApiClient(apiserver.url, token_path="/nonexistent-token")
+    return DraDriver(cfg, registry, generations, node_name=node, api=api)
+
+
+def chip_name(i):
+    return slice_device_name(f"0000:00:{4 + i:02x}.0")
+
+
+# --------------------------------------------------------------- slices
+
+
+def test_publish_resource_slice(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    assert len(apiserver.slices) == 1
+    obj = next(iter(apiserver.slices.values()))
+    assert obj["spec"]["driver"] == "cloud-tpus.google.com"
+    assert obj["spec"]["nodeName"] == "node-a"
+    assert obj["spec"]["pool"]["generation"] == 1
+    devices = obj["spec"]["devices"]
+    assert len(devices) == 4
+    by_name = {d["name"]: d for d in devices}
+    attrs = by_name[chip_name(0)]["basic"]["attributes"]
+    assert attrs["generation"] == {"string": "v5e"}
+    assert attrs["bdf"] == {"string": "0000:00:04.0"}
+    assert attrs["iommuGroup"] == {"string": "11"}
+    assert attrs["numaNode"] == {"int": 0}
+    assert attrs["type"] == {"string": "passthrough"}
+    # ICI coordinates are published for CEL selectors
+    assert "iciX" in attrs and "iciY" in attrs
+    # garbage-collection anchor on the Node object
+    owner = obj["metadata"]["ownerReferences"][0]
+    assert owner["kind"] == "Node" and owner["uid"] == "uid-node-a"
+
+
+def test_republish_unchanged_keeps_generation(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    assert driver.publish_resource_slices()
+    obj = next(iter(apiserver.slices.values()))
+    assert obj["spec"]["pool"]["generation"] == 1
+    # no PUT happened for the unchanged republish
+    assert [m for m, _ in apiserver.requests].count("PUT") == 0
+
+
+def test_republish_changed_inventory_bumps_generation(host, apiserver, tmp_path):
+    h, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    h.add_chip(FakeChip("0000:00:09.0", device_id="0063",
+                        iommu_group="19", numa_node=1))
+    registry, generations = discover(cfg)
+    driver.set_inventory(registry, generations)
+    assert driver.publish_resource_slices()
+    obj = next(iter(apiserver.slices.values()))
+    assert obj["spec"]["pool"]["generation"] == 2
+    assert len(obj["spec"]["devices"]) == 5
+
+
+def test_empty_inventory_withdraws_slice(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    assert apiserver.slices
+    from tpu_device_plugin.registry import Registry
+    driver.set_inventory(Registry(), {})
+    assert driver.publish_resource_slices()
+    assert not apiserver.slices
+
+
+# --------------------------------------------------- registration handshake
+
+
+def test_registration_handshake(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    driver.start()
+    try:
+        with grpc.insecure_channel(
+                f"unix://{driver.registration_socket_path}") as ch:
+            stub = draapi.PluginRegistrationStub(ch)
+            info = stub.GetInfo(regpb.InfoRequest(), timeout=5)
+            assert info.type == "DRAPlugin"
+            assert info.name == "cloud-tpus.google.com"
+            assert info.endpoint == driver.dra_socket_path
+            assert list(info.supported_versions) == ["v1beta1"]
+            stub.NotifyRegistrationStatus(
+                regpb.RegistrationStatus(plugin_registered=True), timeout=5)
+        assert driver.registered.wait(2)
+        assert driver.registration_error is None
+    finally:
+        driver.stop()
+
+
+def test_registration_rejection_recorded(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    driver.start()
+    try:
+        with grpc.insecure_channel(
+                f"unix://{driver.registration_socket_path}") as ch:
+            stub = draapi.PluginRegistrationStub(ch)
+            stub.NotifyRegistrationStatus(
+                regpb.RegistrationStatus(plugin_registered=False,
+                                         error="version mismatch"), timeout=5)
+        assert driver.registered.wait(2)
+        assert driver.registration_error == "version mismatch"
+    finally:
+        driver.stop()
+
+
+# ------------------------------------------------------ prepare/unprepare
+
+
+def prepare(driver, claim):
+    return driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=[claim]), None)
+
+
+def test_prepare_and_unprepare_claim(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}, {"device": chip_name(1)}])
+    claim = drapb.Claim(namespace="ns1", name="claim1", uid="uid-1")
+    resp = prepare(driver, claim)
+    out = resp.claims["uid-1"]
+    assert out.error == ""
+    assert len(out.devices) == 2
+    assert out.devices[0].device_name == chip_name(0)
+    assert out.devices[0].pool_name == "node-a"
+    assert list(out.devices[0].request_names) == ["tpu"]
+    # the composite claim CDI id rides on EVERY device entry so containers
+    # referencing any request of the claim get the nodes (kubelet filters
+    # prepared devices by request, then set-aggregates the ids)
+    for d in out.devices:
+        assert list(d.cdi_device_ids) == ["cloud-tpus.google.com/claim=uid-1"]
+
+    # the CDI spec must carry the vfio nodes + the KubeVirt env contract
+    spec_path = driver._claim_spec_path("uid-1")
+    with open(spec_path) as f:
+        spec = json.load(f)
+    assert spec["kind"] == "cloud-tpus.google.com/claim"
+    dev = spec["devices"][0]
+    assert dev["name"] == "uid-1"
+    paths = [n["path"] for n in dev["containerEdits"]["deviceNodes"]]
+    assert "/dev/vfio/vfio" in paths
+    assert "/dev/vfio/11" in paths and "/dev/vfio/12" in paths
+    env = dev["containerEdits"]["env"]
+    assert env == [
+        "PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V5E=0000:00:04.0,0000:00:05.0"]
+
+    # unprepare removes spec + checkpoint
+    resp = driver.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[claim]), None)
+    assert resp.claims["uid-1"].error == ""
+    assert not os.path.exists(spec_path)
+    assert driver._checkpoint == {}
+
+
+def test_prepare_is_idempotent(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(2)}])
+    claim = drapb.Claim(namespace="ns1", name="claim1", uid="uid-1")
+    first = prepare(driver, claim)
+    n_gets = len(apiserver.requests)
+    second = prepare(driver, claim)
+    assert second.claims["uid-1"].devices == first.claims["uid-1"].devices
+    # checkpoint hit: no second ResourceClaim GET
+    assert len(apiserver.requests) == n_gets
+
+
+def test_prepare_uid_mismatch_errors(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-NEW", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    resp = prepare(driver, drapb.Claim(
+        namespace="ns1", name="claim1", uid="uid-OLD"))
+    assert "UID mismatch" in resp.claims["uid-OLD"].error
+    assert not os.path.exists(driver._claim_spec_path("uid-OLD"))
+
+
+def test_prepare_unknown_device_errors(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": "no-such-device"}])
+    resp = prepare(driver, drapb.Claim(
+        namespace="ns1", name="claim1", uid="uid-1"))
+    assert "not in this node's inventory" in resp.claims["uid-1"].error
+
+
+def test_unprepare_unknown_claim_is_ok(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    resp = driver.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[
+            drapb.Claim(namespace="x", name="y", uid="never-prepared")]),
+        None)
+    assert resp.claims["never-prepared"].error == ""
+
+
+def test_checkpoint_survives_driver_restart(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    claim = drapb.Claim(namespace="ns1", name="claim1", uid="uid-1")
+    first = prepare(driver, claim)
+
+    # new process: fresh driver over the same filesystem state
+    driver2 = make_driver(cfg, apiserver)
+    resp = prepare(driver2, claim)
+    assert resp.claims["uid-1"].devices == first.claims["uid-1"].devices
+    resp = driver2.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[claim]), None)
+    assert resp.claims["uid-1"].error == ""
+    assert not os.path.exists(driver2._claim_spec_path("uid-1"))
+
+
+def test_prepare_rewrites_lost_cdi_spec(host, apiserver):
+    """Reboot wipes /var/run: an idempotent re-prepare must re-materialize
+    the CDI spec file, not just echo the checkpoint."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    claim = drapb.Claim(namespace="ns1", name="claim1", uid="uid-1")
+    prepare(driver, claim)
+    os.unlink(driver._claim_spec_path("uid-1"))
+    resp = prepare(driver, claim)
+    assert resp.claims["uid-1"].error == ""
+    assert os.path.exists(driver._claim_spec_path("uid-1"))
+
+
+def test_prepare_partitions_mdev_and_logical(host, apiserver, tmp_path):
+    h, cfg = host
+    h.add_mdev("uuid-mdev-1", "TPU vhalf", "0000:00:04.0", iommu_group="31")
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim(
+        "ns1", "claim1", "uid-1", driver.driver_name,
+        [{"device": slice_device_name("uuid-mdev-1"), "request": "vtpu"}])
+    resp = prepare(driver, drapb.Claim(
+        namespace="ns1", name="claim1", uid="uid-1"))
+    out = resp.claims["uid-1"]
+    assert out.error == ""
+    with open(driver._claim_spec_path("uid-1")) as f:
+        spec = json.load(f)
+    edits = spec["devices"][0]["containerEdits"]
+    paths = [n["path"] for n in edits["deviceNodes"]]
+    assert "/dev/vfio/vfio" in paths and "/dev/vfio/31" in paths
+    env = dict(e.split("=", 1) for e in edits["env"])
+    assert env["MDEV_PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_TPU_VHALF"] == \
+        "uuid-mdev-1"
+
+
+def test_prepare_mdev_retyped_errors(host, apiserver):
+    """vtpu.py parity: a live mdev whose type changed since discovery must
+    fail prepare (TOCTOU), not hand the VMI a different partition type."""
+    h, cfg = host
+    h.add_mdev("uuid-mdev-2", "TPU vhalf", "0000:00:05.0", iommu_group="32")
+    driver = make_driver(cfg, apiserver)
+    name_path = os.path.join(cfg.mdev_base_path, "uuid-mdev-2",
+                             "mdev_type", "name")
+    with open(name_path, "w") as f:
+        f.write("TPU vquarter\n")
+    apiserver.add_claim(
+        "ns1", "claim1", "uid-1", driver.driver_name,
+        [{"device": slice_device_name("uuid-mdev-2")}])
+    resp = prepare(driver, drapb.Claim(
+        namespace="ns1", name="claim1", uid="uid-1"))
+    assert "live type" in resp.claims["uid-1"].error
+
+
+def test_prepare_over_grpc_socket(host, apiserver):
+    """Full wire path: kubelet-side stub against the served dra.sock."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(3)}])
+    driver.start()
+    try:
+        with grpc.insecure_channel(
+                f"unix://{driver.dra_socket_path}") as ch:
+            stub = draapi.DraPluginStub(ch)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(claims=[
+                    drapb.Claim(namespace="ns1", name="claim1",
+                                uid="uid-1")]), timeout=5)
+            assert resp.claims["uid-1"].error == ""
+            assert resp.claims["uid-1"].devices[0].device_name == chip_name(3)
+            resp = stub.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(claims=[
+                    drapb.Claim(namespace="ns1", name="claim1",
+                                uid="uid-1")]), timeout=5)
+            assert resp.claims["uid-1"].error == ""
+    finally:
+        driver.stop()

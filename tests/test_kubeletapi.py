@@ -142,3 +142,37 @@ def test_wire_contract_field_numbers():
     assert _DEVICE_PLUGIN_SERVICE == "v1beta1.DevicePlugin"
     assert _REGISTRATION_SERVICE == "v1beta1.Registration"
     assert API_VERSION == "v1beta1"
+
+
+def test_dra_wire_contract_field_numbers():
+    """Lock the DRA v1beta1 + pluginregistration v1 wire contracts: the
+    local descriptor package differs from upstream (see
+    proto/dra_v1beta1.proto for why), so the method paths and field
+    numbers asserted here are the ONLY wire-visible surface — they must
+    match the published k8s.io/kubelet contracts exactly."""
+    from tpu_device_plugin.kubeletapi import drapb, regpb
+    from tpu_device_plugin.kubeletapi.draapi import (
+        _DRA_SERVICE, _PLUGIN_REGISTRATION_SERVICE, DRA_API_VERSION,
+        DRA_PLUGIN_TYPE)
+
+    def nums(msg):
+        return {f.name: f.number for f in msg.DESCRIPTOR.fields}
+
+    assert nums(drapb.Claim) == {"namespace": 1, "uid": 2, "name": 3}
+    assert nums(drapb.Device) == {"request_names": 1, "pool_name": 2,
+                                  "device_name": 3, "cdi_device_ids": 4}
+    assert nums(drapb.NodePrepareResourcesRequest) == {"claims": 1}
+    assert nums(drapb.NodePrepareResourcesResponse) == {"claims": 1}
+    assert nums(drapb.NodePrepareResourceResponse) == {"devices": 1,
+                                                       "error": 2}
+    assert nums(drapb.NodeUnprepareResourcesRequest) == {"claims": 1}
+    assert nums(drapb.NodeUnprepareResourcesResponse) == {"claims": 1}
+    assert nums(drapb.NodeUnprepareResourceResponse) == {"error": 1}
+    assert nums(regpb.PluginInfo) == {"type": 1, "name": 2, "endpoint": 3,
+                                      "supported_versions": 4}
+    assert nums(regpb.RegistrationStatus) == {"plugin_registered": 1,
+                                              "error": 2}
+    assert _DRA_SERVICE == "v1beta1.DRAPlugin"
+    assert _PLUGIN_REGISTRATION_SERVICE == "pluginregistration.Registration"
+    assert DRA_API_VERSION == "v1beta1"
+    assert DRA_PLUGIN_TYPE == "DRAPlugin"

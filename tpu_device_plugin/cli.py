@@ -86,6 +86,20 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--feature-file", default=None,
                         help="also/instead write facts as an NFD local "
                              "feature file (key=value lines)")
+    parser.add_argument("--dra", action="store_true",
+                        help="ALSO serve the DRA (Dynamic Resource "
+                             "Allocation) driver: publish this node's chips "
+                             "and partitions as a ResourceSlice and answer "
+                             "NodePrepareResources with per-claim CDI specs. "
+                             "Runs alongside the device-plugin API so a "
+                             "cluster can migrate gradually (needs "
+                             "resourceslices + resourceclaims RBAC)")
+    parser.add_argument("--dra-plugins-path", default=None,
+                        help=f"kubelet plugins dir for the DRA service "
+                             f"socket (default: {cfg.dra_plugins_path})")
+    parser.add_argument("--dra-registry-path", default=None,
+                        help=f"kubelet plugin-registration watch dir "
+                             f"(default: {cfg.dra_registry_path})")
     parser.add_argument("--status-port", type=int, default=0,
                         help="serve /healthz and /status on this port "
                              "(0 disables)")
@@ -166,6 +180,11 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                 kubelet_socket=(args.device_plugin_path.rstrip("/")
                                 + "/kubelet.sock"),
             )
+    # explicit DRA paths win over --root's re-rooting, same rule as above
+    if args.dra_plugins_path is not None:
+        cfg = replace(cfg, dra_plugins_path=args.dra_plugins_path)
+    if args.dra_registry_path is not None:
+        cfg = replace(cfg, dra_registry_path=args.dra_registry_path)
     return cfg, args
 
 
@@ -210,7 +229,7 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
-    on_inventory = None
+    inventory_sinks = []
     if args.label_node or args.feature_file:
         from .labeler import NodeLabeler, node_facts
         labeler = NodeLabeler(node_name=args.node_name,
@@ -218,8 +237,36 @@ def main(argv=None) -> int:
                               feature_file=args.feature_file,
                               require_api=args.label_node,
                               label_prefix=cfg.resource_namespace)
-        on_inventory = lambda reg, gens: labeler.publish(
-            node_facts(cfg, reg, gens))
+        inventory_sinks.append(lambda reg, gens: labeler.publish(
+            node_facts(cfg, reg, gens)))
+    dra_driver = None
+    if args.dra:
+        from .dra import DraDriver
+        from .kubeapi import ApiClient, in_cluster_server
+        from .registry import Registry
+        server_url = args.api_server or in_cluster_server()
+        api = ApiClient(server_url) if server_url else None
+        dra_driver = DraDriver(cfg, Registry(), {}, node_name=args.node_name,
+                               api=api)
+
+        def dra_sink(reg, gens, _d=dra_driver):
+            _d.set_inventory(reg, gens)
+            ok = _d.publish_resource_slices()
+            # sockets come up only AFTER the first discovery has filled the
+            # inventory: the kubelet may call NodePrepareResources the
+            # moment the registration socket appears, and an empty
+            # inventory would fail claims that are perfectly preparable
+            if not _d.serving:
+                _d.start()
+            return ok
+        inventory_sinks.append(dra_sink)
+    on_inventory = None
+    if inventory_sinks:
+        def on_inventory(reg, gens):
+            ok = True
+            for sink in inventory_sinks:
+                ok = sink(reg, gens) and ok
+            return ok
     manager = PluginManager(cfg, on_inventory=on_inventory)
 
     def handle_drain(signum, frame):
@@ -239,6 +286,8 @@ def main(argv=None) -> int:
     try:
         manager.run(stop)
     finally:
+        if dra_driver is not None:
+            dra_driver.stop()
         if status is not None:
             status.stop()
     return 0

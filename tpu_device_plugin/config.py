@@ -34,6 +34,10 @@ class Config:
     device_plugin_path: str = _DEVICE_PLUGIN_PATH
     kubelet_socket: str = _KUBELET_SOCKET
     socket_prefix: str = "tpukubevirt"
+    # DRA (dra.py): the kubelet watches dra_registry_path for registration
+    # sockets; the driver's service socket lives under dra_plugins_path.
+    dra_plugins_path: str = "/var/lib/kubelet/plugins/"
+    dra_registry_path: str = "/var/lib/kubelet/plugins_registry/"
 
     # --- resource naming ----------------------------------------------------
     # Extended-resource namespace: devices surface as
@@ -115,5 +119,7 @@ class Config:
             root_path=root,
             device_plugin_path=os.path.join(root, "device-plugins/"),
             kubelet_socket=os.path.join(root, "device-plugins/kubelet.sock"),
+            dra_plugins_path=os.path.join(root, "plugins/"),
+            dra_registry_path=os.path.join(root, "plugins_registry/"),
             shared_device_classes=(os.path.join(root, "sys/class/egm"),),
         )

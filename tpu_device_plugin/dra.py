@@ -1,0 +1,608 @@
+"""DRA (Dynamic Resource Allocation) driver — the successor kubelet API.
+
+The device-plugin API (server.py / vtpu.py) advertises opaque counted
+resources; DRA instead publishes every chip/partition as a structured
+device in a `ResourceSlice` (attributes: generation, NUMA node, ICI torus
+coordinates, IOMMU group), lets the *scheduler* allocate specific devices
+against `ResourceClaims`, and has the kubelet call this node-local driver
+to prepare them. That moves topology-aware placement from our
+GetPreferredAllocation heuristic (topology.py) into cluster-wide CEL
+selectors over the published ICI attributes — the long-term home for
+slice-aware scheduling.
+
+The reference plugin predates DRA entirely (its nearest analogues:
+registration generic_device_plugin.go:288-309, Allocate :352-444); NVIDIA
+ships DRA support as the separate k8s-dra-driver-gpu project. Here it is a
+third server inside the same binary, sharing discovery, the
+AllocationPlanner (TOCTOU revalidation, IOMMU-group expansion, iommufd,
+shared-device injection) and the CDI writer with the device-plugin path, so
+a cluster can run either API — or both during migration — from one
+DaemonSet.
+
+Flow:
+  1. `publish_resource_slices()` — POST/PUT one ResourceSlice for this node
+     (stdlib ApiClient; pool generation bumps on inventory change).
+  2. kubelet discovers the registration socket under plugins_registry/ and
+     calls GetInfo (pluginregistration/v1) → we answer type=DRAPlugin.
+  3. Scheduler allocates claim → kubelet calls NodePrepareResources
+     (dra/v1beta1): we fetch the ResourceClaim's allocation from the API
+     server, plan device nodes exactly like Allocate would, write ONE
+     per-claim CDI spec carrying deviceNodes + the KubeVirt
+     PCI_RESOURCE_* env contract, checkpoint it, and return the CDI id.
+  4. NodeUnprepareResources removes the spec + checkpoint entry.
+Prepare/unprepare are idempotent across kubelet and driver restarts (the
+checkpoint file is the source of truth, like upstream DRA drivers).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import tempfile
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+
+from .allocate import AllocationError, AllocationPlanner
+from .config import Config
+from .discovery import read_link_basename
+from .kubeapi import ApiClient, ApiError
+from .kubeletapi import draapi, drapb, regpb
+from .naming import GenerationInfo, sanitize_name
+from .registry import Registry, TpuDevice, TpuPartition
+
+log = logging.getLogger(__name__)
+
+RESOURCE_API = "/apis/resource.k8s.io/v1beta1"
+CDI_VERSION = "0.6.0"
+# Distinct CDI class from cdi.py's per-chip "tpu" kind: claim devices are
+# composite (all of a claim's nodes + env in one entry) and live in
+# per-claim spec files created/removed at prepare/unprepare time.
+CDI_CLAIM_CLASS = "claim"
+
+
+def slice_device_name(raw: str) -> str:
+    """DNS-label device name for a ResourceSlice entry.
+
+    BDFs ("0000:00:04.0") and mdev UUIDs contain characters outside the
+    [a-z0-9-] label alphabet; the mapping must stay injective enough to
+    invert via the name→object map built at publish time.
+    """
+    name = re.sub(r"[^a-z0-9-]", "-", raw.lower())
+    name = name.strip("-") or "dev"
+    if not name[0].isalpha():
+        name = "d" + name
+    return name[:63]
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
+    """Node-local DRA driver sharing the plugin's discovery snapshot."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        registry: Registry,
+        generations: Dict[str, GenerationInfo],
+        node_name: Optional[str] = None,
+        api: Optional[ApiClient] = None,
+        driver_name: Optional[str] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.node_name = node_name or os.environ.get("NODE_NAME") or "node"
+        self.api = api
+        self.driver_name = driver_name or cfg.resource_namespace
+        self._driver_fs = sanitize_name(self.driver_name).lower().replace(
+            "_", "-")
+        self.driver_dir = os.path.join(cfg.dra_plugins_path, self.driver_name)
+        self.dra_socket_path = os.path.join(self.driver_dir, "dra.sock")
+        self.registration_socket_path = os.path.join(
+            cfg.dra_registry_path, f"{self._driver_fs}-reg.sock")
+        self.checkpoint_path = os.path.join(self.driver_dir, "checkpoint.json")
+        self.cdi_dir = cfg.cdi_spec_dir or os.path.join(
+            cfg.root_path, "var/run/cdi")
+        self.registered = threading.Event()
+        self.registration_error: Optional[str] = None
+        self._lock = threading.Lock()
+        self._dra_server: Optional[grpc.Server] = None
+        self._reg_server: Optional[grpc.Server] = None
+        self._node_uid: Optional[str] = None
+        self.set_inventory(registry, generations)
+        self._checkpoint: Dict[str, dict] = self._load_checkpoint()
+
+    # ---------------------------------------------------------- inventory
+
+    def set_inventory(self, registry: Registry,
+                      generations: Dict[str, GenerationInfo]) -> None:
+        """Swap the discovery snapshot (rediscovery path)."""
+        with self._lock:
+            self.registry = registry
+            self.generations = generations
+            self._by_name: Dict[str, Tuple[str, object]] = {}
+            self._planners: Dict[str, AllocationPlanner] = {}
+            for model, devs in sorted(registry.devices_by_model.items()):
+                info = generations.get(model)
+                gen = info.name if info else f"tpu-{model}"
+                if gen not in self._planners:
+                    self._planners[gen] = AllocationPlanner(
+                        self.cfg, registry, gen)
+                for d in devs:
+                    self._by_name[slice_device_name(d.bdf)] = ("chip", gen, d)
+            for type_name, parts in sorted(registry.partitions_by_type.items()):
+                for p in parts:
+                    self._by_name[slice_device_name(p.uuid)] = (
+                        "partition", type_name, p)
+            # vfio-backed logical partitions ride their parent's planner
+            self._parent_planner = AllocationPlanner(
+                self.cfg, registry, "vtpu-parent")
+
+    def _device_entry(self, kind: str, group_name: str, obj) -> dict:
+        if kind == "chip":
+            d: TpuDevice = obj
+            attrs = {
+                "type": {"string": "passthrough"},
+                "generation": {"string": group_name},
+                "bdf": {"string": d.bdf},
+                "iommuGroup": {"string": d.iommu_group},
+                "numaNode": {"int": d.numa_node},
+            }
+            if d.accel_index is not None:
+                attrs["accelIndex"] = {"int": d.accel_index}
+            if d.ici_coords is not None:
+                for axis, coord in zip("xyz", d.ici_coords):
+                    attrs[f"ici{axis.upper()}"] = {"int": coord}
+            name = slice_device_name(d.bdf)
+        else:
+            p: TpuPartition = obj
+            attrs = {
+                "type": {"string": "partition"},
+                "partitionType": {"string": group_name},
+                "parentBdf": {"string": p.parent_bdf},
+                "numaNode": {"int": p.numa_node},
+                "provider": {"string": p.provider},
+            }
+            if p.accel_index is not None:
+                attrs["accelIndex"] = {"int": p.accel_index}
+            name = slice_device_name(p.uuid)
+        return {"name": name, "basic": {"attributes": attrs}}
+
+    def build_slice(self, pool_generation: int = 1) -> dict:
+        """The ResourceSlice object for this node's inventory."""
+        with self._lock:
+            devices = [self._device_entry(kind, group_name, obj)
+                       for kind, group_name, obj in self._by_name.values()]
+        slice_obj = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": self.slice_name()},
+            "spec": {
+                "driver": self.driver_name,
+                "nodeName": self.node_name,
+                "pool": {
+                    "name": self.node_name,
+                    "generation": pool_generation,
+                    "resourceSliceCount": 1,
+                },
+                "devices": devices,
+            },
+        }
+        owner = self._node_owner_ref()
+        if owner is not None:
+            slice_obj["metadata"]["ownerReferences"] = [owner]
+        return slice_obj
+
+    def slice_name(self) -> str:
+        return slice_device_name(f"{self.node_name}-{self._driver_fs}")
+
+    def _node_owner_ref(self) -> Optional[dict]:
+        """Owner reference to the Node so slices are garbage-collected when
+        the node goes away. Best-effort: published without one if the node
+        GET fails (RBAC may only grant resourceslices)."""
+        if self.api is None:
+            return None
+        if self._node_uid is None:
+            try:
+                node = self.api.get_json(f"/api/v1/nodes/{self.node_name}")
+                self._node_uid = (node.get("metadata") or {}).get("uid")
+            except (ApiError, ValueError) as exc:
+                log.debug("node GET for ownerReference failed: %s", exc)
+                return None
+        if not self._node_uid:
+            return None
+        return {"apiVersion": "v1", "kind": "Node", "name": self.node_name,
+                "uid": self._node_uid, "controller": True}
+
+    def publish_resource_slices(self) -> bool:
+        """Create-or-update this node's ResourceSlice; True on success.
+
+        Pool generation semantics: an unchanged inventory republishes the
+        live object untouched; a changed one bumps spec.pool.generation so
+        the scheduler knows older allocations reference a stale pool.
+        """
+        if self.api is None:
+            log.warning("DRA: no API client; ResourceSlice not published")
+            return False
+        name = self.slice_name()
+        path = f"{RESOURCE_API}/resourceslices/{name}"
+        desired = self.build_slice()
+        if not desired["spec"]["devices"]:
+            # empty inventory: withdraw the slice entirely
+            try:
+                self.api.delete(path)
+                log.info("DRA: deleted ResourceSlice %s (no devices)", name)
+            except ApiError as exc:
+                if exc.code != 404:
+                    log.error("DRA: slice delete failed: %s", exc)
+                    return False
+            return True
+        try:
+            live = self.api.get_json(path)
+        except ApiError as exc:
+            if exc.code != 404:
+                log.error("DRA: slice GET failed: %s", exc)
+                return False
+            try:
+                self.api.post_json(f"{RESOURCE_API}/resourceslices", desired)
+            except ApiError as exc2:
+                log.error("DRA: slice POST failed: %s", exc2)
+                return False
+            log.info("DRA: published ResourceSlice %s (%d devices)",
+                     name, len(desired["spec"]["devices"]))
+            return True
+        live_spec = live.get("spec") or {}
+        live_gen = ((live_spec.get("pool") or {}).get("generation")) or 1
+        desired = self.build_slice(pool_generation=live_gen)
+        if live_spec == desired["spec"]:
+            return True
+        desired = self.build_slice(pool_generation=live_gen + 1)
+        desired["metadata"]["resourceVersion"] = (
+            (live.get("metadata") or {}).get("resourceVersion"))
+        try:
+            self.api.put_json(path, desired)
+        except ApiError as exc:
+            log.error("DRA: slice PUT failed: %s", exc)
+            return False
+        log.info("DRA: updated ResourceSlice %s to pool generation %d "
+                 "(%d devices)", name, live_gen + 1,
+                 len(desired["spec"]["devices"]))
+        return True
+
+    # ------------------------------------------------------- checkpointing
+
+    def _load_checkpoint(self) -> Dict[str, dict]:
+        try:
+            with open(self.checkpoint_path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                return data
+        except (OSError, ValueError):
+            pass
+        return {}
+
+    def _save_checkpoint(self) -> None:
+        _atomic_write_json(self.checkpoint_path, self._checkpoint)
+
+    # ------------------------------------------------------------ prepare
+
+    def _claim_cdi_id(self, uid: str) -> str:
+        return f"{self.cfg.resource_namespace}/{CDI_CLAIM_CLASS}={uid}"
+
+    def _claim_spec_path(self, uid: str) -> str:
+        return os.path.join(self.cdi_dir,
+                            f"{self._driver_fs}-claim-{uid}.json")
+
+    def _write_claim_spec(self, uid: str, device_specs, envs) -> str:
+        nodes = [{"path": s.container_path, "hostPath": s.host_path,
+                  "permissions": s.permissions} for s in device_specs]
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": f"{self.cfg.resource_namespace}/{CDI_CLAIM_CLASS}",
+            "devices": [{
+                "name": uid,
+                "containerEdits": {
+                    "deviceNodes": nodes,
+                    "env": [f"{k}={v}" for k, v in sorted(envs.items())],
+                },
+            }],
+        }
+        path = self._claim_spec_path(uid)
+        _atomic_write_json(path, spec)
+        return path
+
+    def _allocation_results(self, claim: drapb.Claim) -> List[dict]:
+        """This driver's device results from the claim's live allocation."""
+        if self.api is None:
+            raise AllocationError("no API server client configured")
+        path = (f"{RESOURCE_API}/namespaces/{claim.namespace}"
+                f"/resourceclaims/{claim.name}")
+        try:
+            obj = self.api.get_json(path)
+        except (ApiError, ValueError) as exc:
+            raise AllocationError(f"ResourceClaim GET failed: {exc}")
+        uid = (obj.get("metadata") or {}).get("uid")
+        if uid != claim.uid:
+            # the claim was deleted and recreated under the same name; the
+            # kubelet's request is for the OLD object — preparing the new
+            # one's allocation would hand the pod the wrong devices
+            raise AllocationError(
+                f"ResourceClaim UID mismatch (live {uid!r} != "
+                f"requested {claim.uid!r})")
+        alloc = ((obj.get("status") or {}).get("allocation") or {})
+        results = ((alloc.get("devices") or {}).get("results")) or []
+        return [r for r in results if r.get("driver") == self.driver_name]
+
+    def _plan_devices(self, results: Sequence[dict]):
+        """(device_specs, envs) for a claim's allocated devices.
+
+        Chips group by generation through the same AllocationPlanner the
+        device-plugin Allocate uses (TOCTOU revalidation, group expansion,
+        iommufd, shared devices); partitions follow vtpu.py's node rules.
+        """
+        specs: List = []
+        envs: Dict[str, str] = {}
+        seen_paths: set = set()
+
+        def add_specs(new_specs) -> None:
+            for s in new_specs:
+                if s.host_path not in seen_paths:
+                    seen_paths.add(s.host_path)
+                    specs.append(s)
+
+        chips_by_gen: Dict[str, List[str]] = {}
+        partitions: List[Tuple[str, TpuPartition]] = []
+        for r in results:
+            entry = self._by_name.get(r.get("device", ""))
+            if entry is None:
+                raise AllocationError(
+                    f"allocated device {r.get('device')!r} is not in this "
+                    "node's inventory (stale ResourceSlice?)")
+            kind, group_name, obj = entry
+            if kind == "chip":
+                chips_by_gen.setdefault(group_name, []).append(obj.bdf)
+            else:
+                partitions.append((group_name, obj))
+
+        from .kubeletapi import pb
+        for gen, bdfs in sorted(chips_by_gen.items()):
+            plan = self._planners[gen].plan(bdfs)
+            add_specs(plan.device_specs)
+            envs.update(plan.envs)
+
+        for type_name, p in partitions:
+            env_key = (f"{self.cfg.vtpu_env_prefix}_"
+                       f"{sanitize_name(type_name)}")
+            envs[env_key] = ",".join(
+                x for x in (envs.get(env_key), p.uuid) if x)
+            if p.provider == "mdev":
+                # mirror vtpu.py exactly: live mdev-type TOCTOU check, then
+                # the per-mdev group — or the reference-compatible wide
+                # /dev/vfio mount when the group link is not visible
+                # (vtpu.py:169-172); diverging here would let the two APIs
+                # prepare the same partition differently
+                name_path = os.path.join(self.cfg.mdev_base_path, p.uuid,
+                                         "mdev_type", "name")
+                try:
+                    with open(name_path, "r", encoding="ascii",
+                              errors="replace") as f:
+                        live = f.read().strip().replace(" ", "_")
+                except OSError as exc:
+                    raise AllocationError(
+                        f"partition {p.uuid}: mdev vanished ({exc})")
+                if live != type_name:
+                    raise AllocationError(
+                        f"partition {p.uuid}: live type {live!r} != "
+                        f"{type_name!r}")
+                mdev_specs = [pb.DeviceSpec(
+                    host_path=self.cfg.dev_path("dev/vfio/vfio"),
+                    container_path="/dev/vfio/vfio", permissions="mrw")]
+                group = read_link_basename(os.path.join(
+                    self.cfg.mdev_base_path, p.uuid, "iommu_group"))
+                if group is not None:
+                    mdev_specs.append(pb.DeviceSpec(
+                        host_path=self.cfg.dev_path("dev/vfio", group),
+                        container_path=f"/dev/vfio/{group}",
+                        permissions="mrw"))
+                else:
+                    mdev_specs.append(pb.DeviceSpec(
+                        host_path=self.cfg.dev_path("dev/vfio"),
+                        container_path="/dev/vfio", permissions="mrw"))
+                add_specs(mdev_specs)
+            elif p.accel_index is not None:
+                add_specs([pb.DeviceSpec(
+                    host_path=self.cfg.dev_path("dev", f"accel{p.accel_index}"),
+                    container_path=f"/dev/accel{p.accel_index}",
+                    permissions=self.cfg.partition_node_permissions)])
+            else:
+                plan = self._parent_planner.plan([p.parent_bdf],
+                                                 shared_devices=[])
+                add_specs(plan.device_specs)
+                pci_key = (f"{self.cfg.env_prefix}_"
+                           f"{sanitize_name(type_name)}")
+                joined = ",".join(plan.expanded_bdfs)
+                envs[pci_key] = ",".join(
+                    x for x in (envs.get(pci_key), joined) if x)
+        return specs, envs
+
+    def _prepare_claim(self, claim: drapb.Claim) -> List[drapb.Device]:
+        # The API-server round-trip stays OUTSIDE the lock: a slow or
+        # unreachable API server must not stall set_inventory / slice
+        # republish (the PluginManager's on_inventory callback) or other
+        # claims' prepares behind one stuck HTTP call. Only checkpoint
+        # mutation and device planning (fast sysfs reads against the
+        # locked inventory maps) hold it.
+        with self._lock:
+            entry = self._checkpoint.get(claim.uid)
+        if entry is not None:
+            # idempotent retry: re-materialize the CDI spec if the file
+            # was lost (node reboot wipes /var/run) and echo the result
+            if not os.path.exists(entry["spec_path"]):
+                results = self._allocation_results(claim)
+                with self._lock:
+                    specs, envs = self._plan_devices(results)
+                self._write_claim_spec(claim.uid, specs, envs)
+            return [drapb.Device(**d) for d in entry["devices"]]
+        results = self._allocation_results(claim)
+        with self._lock:
+            # another worker may have prepared the claim while we fetched
+            entry = self._checkpoint.get(claim.uid)
+            if entry is not None:
+                return [drapb.Device(**d) for d in entry["devices"]]
+            specs, envs = self._plan_devices(results)
+            spec_path = self._write_claim_spec(claim.uid, specs, envs)
+            devices = []
+            for r in results:
+                devices.append({
+                    "request_names": (
+                        [r["request"]] if r.get("request") else []),
+                    "pool_name": r.get("pool", self.node_name),
+                    "device_name": r.get("device", ""),
+                    # the one composite CDI device (all nodes + env) rides
+                    # on EVERY entry: the kubelet filters prepared devices
+                    # by the container's claim request, so an id attached
+                    # to only one entry would leave containers referencing
+                    # the claim's other requests with no nodes at all. The
+                    # kubelet aggregates CDI ids as a set, so the repeats
+                    # collapse before reaching the runtime.
+                    "cdi_device_ids": [self._claim_cdi_id(claim.uid)],
+                })
+            self._checkpoint[claim.uid] = {
+                "name": claim.name,
+                "namespace": claim.namespace,
+                "spec_path": spec_path,
+                "devices": devices,
+            }
+            self._save_checkpoint()
+            log.info("DRA: prepared claim %s/%s (%d devices)",
+                     claim.namespace, claim.name, len(devices))
+            return [drapb.Device(**d) for d in devices]
+
+    # ------------------------------------------------------------- RPCs
+
+    def NodePrepareResources(self, request, context):
+        resp = drapb.NodePrepareResourcesResponse()
+        for claim in request.claims:
+            out = resp.claims[claim.uid]
+            try:
+                out.devices.extend(self._prepare_claim(claim))
+            except (AllocationError, ApiError, OSError) as exc:
+                log.error("DRA: prepare %s/%s failed: %s",
+                          claim.namespace, claim.name, exc)
+                out.error = str(exc)
+        return resp
+
+    def NodeUnprepareResources(self, request, context):
+        resp = drapb.NodeUnprepareResourcesResponse()
+        for claim in request.claims:
+            out = resp.claims[claim.uid]
+            try:
+                with self._lock:
+                    entry = self._checkpoint.get(claim.uid)
+                    spec_path = (entry or {}).get(
+                        "spec_path", self._claim_spec_path(claim.uid))
+                    # unlink BEFORE dropping the checkpoint entry: a failed
+                    # unlink must leave the claim recorded so the kubelet's
+                    # retry reaches the spec again instead of resurrecting
+                    # a stale entry on the next driver restart
+                    try:
+                        os.unlink(spec_path)
+                    except FileNotFoundError:
+                        pass
+                    if entry is not None:
+                        del self._checkpoint[claim.uid]
+                        self._save_checkpoint()
+                log.info("DRA: unprepared claim %s/%s%s",
+                         claim.namespace, claim.name,
+                         "" if entry else " (not prepared; idempotent ok)")
+            except OSError as exc:
+                log.error("DRA: unprepare %s failed: %s", claim.uid, exc)
+                out.error = str(exc)
+        return resp
+
+    def GetInfo(self, request, context):
+        return regpb.PluginInfo(
+            type=draapi.DRA_PLUGIN_TYPE,
+            name=self.driver_name,
+            endpoint=self.dra_socket_path,
+            supported_versions=[draapi.DRA_API_VERSION],
+        )
+
+    def NotifyRegistrationStatus(self, request, context):
+        if request.plugin_registered:
+            log.info("DRA: kubelet registered driver %s", self.driver_name)
+            self.registration_error = None
+            self.registered.set()
+        else:
+            log.error("DRA: kubelet REJECTED driver %s: %s",
+                      self.driver_name, request.error)
+            self.registration_error = request.error or "rejected"
+            self.registered.set()
+        return regpb.RegistrationStatusResponse()
+
+    # ----------------------------------------------------------- serving
+
+    @property
+    def serving(self) -> bool:
+        return self._dra_server is not None
+
+    def start(self) -> None:
+        """Serve the DRAPlugin + Registration sockets (kubelet dials both)."""
+        os.makedirs(self.driver_dir, exist_ok=True)
+        os.makedirs(self.cfg.dra_registry_path, exist_ok=True)
+        for path in (self.dra_socket_path, self.registration_socket_path):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        self._dra_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4),
+            options=[("grpc.optimization_target", "latency")])
+        draapi.add_dra_plugin_servicer(self._dra_server, self)
+        self._dra_server.add_insecure_port(f"unix://{self.dra_socket_path}")
+        self._dra_server.start()
+        # the registration socket comes up only after the service socket is
+        # live: the kubelet may dial the advertised endpoint immediately
+        self._reg_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2))
+        draapi.add_plugin_registration_servicer(self._reg_server, self)
+        self._reg_server.add_insecure_port(
+            f"unix://{self.registration_socket_path}")
+        self._reg_server.start()
+        log.info("DRA: serving %s (registration %s)",
+                 self.dra_socket_path, self.registration_socket_path)
+
+    def stop(self, withdraw_slice: bool = False) -> None:
+        for server in (self._reg_server, self._dra_server):
+            if server is not None:
+                server.stop(grace=1).wait()
+        self._reg_server = self._dra_server = None
+        for path in (self.dra_socket_path, self.registration_socket_path):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        if withdraw_slice and self.api is not None:
+            try:
+                self.api.delete(
+                    f"{RESOURCE_API}/resourceslices/{self.slice_name()}")
+            except ApiError as exc:
+                if exc.code != 404:
+                    log.warning("DRA: slice withdraw failed: %s", exc)

@@ -1,0 +1,108 @@
+"""Minimal Kubernetes API client — stdlib only, no `kubernetes` package.
+
+Shared by the node labeler (PATCH node labels) and the DRA driver
+(ResourceSlice publish, ResourceClaim reads). Authenticates with the pod's
+service-account token and trusts the in-cluster CA, exactly like the
+labeler always has; the dependency-free stance mirrors the reference's
+single-static-binary posture (its only runtime deps are grpc + sysfs,
+reference: go.mod:1-12 — it never talks to the API server at all).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_server() -> Optional[str]:
+    """https://host:port of the API server from the in-cluster env, if any."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        return None
+    return f"https://{host}:{port}"
+
+
+class ApiError(Exception):
+    """HTTP-level API failure carrying the status code (0 = transport)."""
+
+    def __init__(self, message: str, code: int = 0):
+        super().__init__(message)
+        self.code = code
+
+
+class ApiClient:
+    """Bearer-token REST client for one API server."""
+
+    def __init__(self, server: str,
+                 token_path: str = os.path.join(SA_DIR, "token"),
+                 ca_path: str = os.path.join(SA_DIR, "ca.crt"),
+                 timeout_s: float = 10.0):
+        self.server = server.rstrip("/")
+        self.token_path = token_path
+        self.ca_path = ca_path
+        self.timeout_s = timeout_s
+
+    def request(self, path: str, method: str = "GET",
+                body: Optional[bytes] = None,
+                content_type: Optional[str] = None) -> bytes:
+        """Raw request against an API path; raises ApiError on failure."""
+        url = self.server + path
+        req = urllib.request.Request(url, data=body, method=method)
+        if content_type:
+            req.add_header("Content-Type", content_type)
+        try:
+            with open(self.token_path, "r", encoding="ascii") as f:
+                req.add_header("Authorization", f"Bearer {f.read().strip()}")
+        except OSError:
+            pass  # no token (e.g. test server without auth)
+        ctx = None
+        if url.startswith("https"):
+            ctx = ssl.create_default_context(
+                cafile=self.ca_path if os.path.exists(self.ca_path) else None)
+        try:
+            with urllib.request.urlopen(
+                    req, context=ctx, timeout=self.timeout_s) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as exc:
+            detail = ""
+            try:
+                detail = exc.read().decode("utf-8", "replace")[:300]
+            except OSError:
+                pass
+            raise ApiError(f"{method} {url}: HTTP {exc.code} {detail}",
+                           code=exc.code) from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise ApiError(f"{method} {url}: {exc}") from exc
+
+    # -- JSON convenience wrappers against resource paths ---------------------
+
+    def get_json(self, path: str) -> dict:
+        return json.loads(self.request(path))
+
+    def post_json(self, path: str, obj: dict) -> dict:
+        return json.loads(self.request(
+            path, method="POST", body=json.dumps(obj).encode(),
+            content_type="application/json"))
+
+    def put_json(self, path: str, obj: dict) -> dict:
+        return json.loads(self.request(
+            path, method="PUT", body=json.dumps(obj).encode(),
+            content_type="application/json"))
+
+    def delete(self, path: str) -> None:
+        self.request(path, method="DELETE")
+
+    def patch_strategic(self, path: str, obj: dict) -> bytes:
+        return self.request(
+            path, method="PATCH", body=json.dumps(obj).encode(),
+            content_type="application/strategic-merge-patch+json")

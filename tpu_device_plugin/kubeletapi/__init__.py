@@ -8,6 +8,8 @@ vendor/k8s.io/kubelet/pkg/apis/deviceplugin/v1beta1/api.proto).
 """
 
 from . import deviceplugin_v1beta1_pb2 as pb  # noqa: F401
+from . import dra_v1beta1_pb2 as drapb  # noqa: F401
+from . import pluginregistration_v1_pb2 as regpb  # noqa: F401
 from .api import (  # noqa: F401
     API_VERSION,
     DEVICE_PLUGIN_PATH,

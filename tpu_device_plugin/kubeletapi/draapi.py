@@ -1,0 +1,130 @@
+"""Hand-rolled gRPC wiring for the kubelet DRA + plugin-registration APIs.
+
+Same approach as api.py (no grpc codegen plugin in the image): generic
+handlers registered under the UPSTREAM service paths. The local descriptor
+package for the DRA messages is `dra.v1beta1` (see proto/dra_v1beta1.proto
+for why), but the wire method paths below carry the published service names
+`v1beta1.DRAPlugin` and `pluginregistration.Registration` — those, plus the
+field numbers, ARE the kubelet contract (locked by tests/test_kubeletapi.py).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import dra_v1beta1_pb2 as drapb
+from . import pluginregistration_v1_pb2 as regpb
+
+# -- kubelet contract constants ------------------------------------------------
+DRA_API_VERSION = "v1beta1"
+# The kubelet watches this directory for registration sockets.
+PLUGINS_REGISTRY_PATH = "/var/lib/kubelet/plugins_registry/"
+# Per-driver service sockets live under here.
+PLUGINS_PATH = "/var/lib/kubelet/plugins/"
+DRA_PLUGIN_TYPE = "DRAPlugin"
+
+_DRA_SERVICE = "v1beta1.DRAPlugin"
+_PLUGIN_REGISTRATION_SERVICE = "pluginregistration.Registration"
+
+
+class DraPluginServicer:
+    """Server-side interface for the DRAPlugin service (2 RPCs)."""
+
+    def NodePrepareResources(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "NodePrepareResources")
+
+    def NodeUnprepareResources(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "NodeUnprepareResources")
+
+
+def add_dra_plugin_servicer(server: grpc.Server,
+                            servicer: DraPluginServicer) -> None:
+    handlers = {
+        "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodePrepareResources,
+            request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
+            response_serializer=(
+                drapb.NodePrepareResourcesResponse.SerializeToString),
+        ),
+        "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+            servicer.NodeUnprepareResources,
+            request_deserializer=(
+                drapb.NodeUnprepareResourcesRequest.FromString),
+            response_serializer=(
+                drapb.NodeUnprepareResourcesResponse.SerializeToString),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_DRA_SERVICE, handlers),))
+
+
+class DraPluginStub:
+    """Client stub for the DRAPlugin service (what the kubelet dials)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.NodePrepareResources = channel.unary_unary(
+            f"/{_DRA_SERVICE}/NodePrepareResources",
+            request_serializer=(
+                drapb.NodePrepareResourcesRequest.SerializeToString),
+            response_deserializer=(
+                drapb.NodePrepareResourcesResponse.FromString),
+        )
+        self.NodeUnprepareResources = channel.unary_unary(
+            f"/{_DRA_SERVICE}/NodeUnprepareResources",
+            request_serializer=(
+                drapb.NodeUnprepareResourcesRequest.SerializeToString),
+            response_deserializer=(
+                drapb.NodeUnprepareResourcesResponse.FromString),
+        )
+
+
+class PluginRegistrationServicer:
+    """Server-side interface for pluginregistration.Registration.
+
+    Served by the PLUGIN on its plugins_registry socket; the kubelet dials
+    it (the inverse of the device-plugin flow, where the plugin dials
+    kubelet.sock — reference: generic_device_plugin.go:288-309).
+    """
+
+    def GetInfo(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetInfo")
+
+    def NotifyRegistrationStatus(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                      "NotifyRegistrationStatus")
+
+
+def add_plugin_registration_servicer(
+        server: grpc.Server, servicer: PluginRegistrationServicer) -> None:
+    handlers = {
+        "GetInfo": grpc.unary_unary_rpc_method_handler(
+            servicer.GetInfo,
+            request_deserializer=regpb.InfoRequest.FromString,
+            response_serializer=regpb.PluginInfo.SerializeToString,
+        ),
+        "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+            servicer.NotifyRegistrationStatus,
+            request_deserializer=regpb.RegistrationStatus.FromString,
+            response_serializer=(
+                regpb.RegistrationStatusResponse.SerializeToString),
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(
+            _PLUGIN_REGISTRATION_SERVICE, handlers),))
+
+
+class PluginRegistrationStub:
+    """Client stub for pluginregistration.Registration (fake kubelet in tests)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetInfo = channel.unary_unary(
+            f"/{_PLUGIN_REGISTRATION_SERVICE}/GetInfo",
+            request_serializer=regpb.InfoRequest.SerializeToString,
+            response_deserializer=regpb.PluginInfo.FromString,
+        )
+        self.NotifyRegistrationStatus = channel.unary_unary(
+            f"/{_PLUGIN_REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+            request_serializer=regpb.RegistrationStatus.SerializeToString,
+            response_deserializer=regpb.RegistrationStatusResponse.FromString,
+        )

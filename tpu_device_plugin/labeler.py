@@ -30,19 +30,15 @@ from __future__ import annotations
 import json
 import logging
 import os
-import ssl
 import tempfile
-import urllib.error
-import urllib.request
 from typing import Dict, Optional
 
 from .config import Config
+from .kubeapi import SA_DIR, ApiClient, ApiError, in_cluster_server
 from .naming import GenerationInfo
 from .registry import Registry
 
 log = logging.getLogger(__name__)
-
-SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 def node_facts(cfg: Config, registry: Registry,
@@ -118,11 +114,11 @@ class NodeLabeler:
 
     @staticmethod
     def _in_cluster_server() -> Optional[str]:
-        host = os.environ.get("KUBERNETES_SERVICE_HOST")
-        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        if not host:
-            return None
-        return f"https://{host}:{port}"
+        return in_cluster_server()
+
+    def _client(self) -> ApiClient:
+        return ApiClient(self.api_server, token_path=self.token_path,
+                         ca_path=self.ca_path)
 
     def publish(self, facts: Dict[str, str]) -> bool:
         """Write the feature file and/or PATCH node labels; True only when
@@ -155,13 +151,12 @@ class NodeLabeler:
         stale = (self._published_keys | self._live_label_keys()) - set(facts)
         for key in stale:
             labels[key] = None
-        url = f"{self.api_server}/api/v1/nodes/{self.node_name}"
-        body = json.dumps({"metadata": {"labels": labels}}).encode()
+        path = f"/api/v1/nodes/{self.node_name}"
         try:
-            self._request(url, method="PATCH", body=body,
-                          content_type="application/strategic-merge-patch+json")
-        except (urllib.error.URLError, OSError) as exc:
-            log.error("node label PATCH %s failed: %s", url, exc)
+            self._client().patch_strategic(
+                path, {"metadata": {"labels": labels}})
+        except ApiError as exc:
+            log.error("node label PATCH %s failed: %s", path, exc)
             return False
         self._published_keys = set(facts)
         log.info("labeled node %s with %d TPU facts (%d stale removed)",
@@ -172,29 +167,10 @@ class NodeLabeler:
         """This labeler's namespaced label keys currently on the node (so a
         restarted pod can prune labels a previous incarnation published).
         Empty set on any failure — pruning then degrades to session memory."""
-        url = f"{self.api_server}/api/v1/nodes/{self.node_name}"
         try:
-            node = json.loads(self._request(url))
-        except (urllib.error.URLError, OSError, ValueError) as exc:
+            node = self._client().get_json(f"/api/v1/nodes/{self.node_name}")
+        except (ApiError, ValueError) as exc:
             log.debug("node GET for label pruning failed: %s", exc)
             return set()
         labels = (node.get("metadata") or {}).get("labels") or {}
         return {k for k in labels if k.startswith(self.label_prefix + "/")}
-
-    def _request(self, url: str, method: str = "GET",
-                 body: Optional[bytes] = None,
-                 content_type: Optional[str] = None) -> bytes:
-        req = urllib.request.Request(url, data=body, method=method)
-        if content_type:
-            req.add_header("Content-Type", content_type)
-        try:
-            with open(self.token_path, "r", encoding="ascii") as f:
-                req.add_header("Authorization", f"Bearer {f.read().strip()}")
-        except OSError:
-            pass  # no token (e.g. test server without auth)
-        ctx = None
-        if url.startswith("https"):
-            ctx = ssl.create_default_context(
-                cafile=self.ca_path if os.path.exists(self.ca_path) else None)
-        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
-            return resp.read()

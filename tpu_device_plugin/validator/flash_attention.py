@@ -320,8 +320,8 @@ def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
 
 # Backward-block default from the TPU v5 lite hardware sweep
 # (docs/validator_tpu_bwd_sweep_r03.json): 256x256 wins at every measured
-# seq — train speedup vs einsum 0.87->1.40 at 2048 and 1.95->3.13 at 4096
-# relative to inheriting the forward's 128-blocks. Clamped to seq below.
+# seq — full-train speedup vs einsum 0.89->1.56 at 2048 and 1.53->2.89 at
+# 4096 relative to inheriting the forward's 128-blocks. Clamped to seq.
 DEFAULT_BWD_BLOCK = 256
 
 
