@@ -455,3 +455,29 @@ def test_prepare_over_grpc_socket(host, apiserver):
             assert resp.claims["uid-1"].error == ""
     finally:
         driver.stop()
+
+
+def test_status_surfaces_dra(host, apiserver):
+    """/status and /metrics carry DRA registration + prepared-claim facts."""
+    from tpu_device_plugin.status import StatusServer
+
+    class FakeManager:
+        plugins = []
+        pending = []
+        native_info = {}
+        draining = False
+
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    prepare(driver, drapb.Claim(namespace="ns1", name="claim1", uid="uid-1"))
+    status = StatusServer(FakeManager(), dra_driver=driver)
+    s = status.status()
+    assert s["dra"]["driver"] == "cloud-tpus.google.com"
+    assert s["dra"]["prepared_claims"] == 1
+    assert s["dra"]["serving"] is False          # not started in this test
+    assert s["dra"]["kubelet_registered"] is False
+    metrics = status.metrics()
+    assert "tpu_plugin_dra_prepared_claims 1" in metrics
+    assert "tpu_plugin_dra_registered 0" in metrics

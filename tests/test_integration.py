@@ -18,9 +18,11 @@ import grpc
 import pytest
 
 from tests.fakehost import FakeChip, FakeHost, FakeKubelet
+from tests.test_dra import FakeApiServer
 from tpu_device_plugin import kubeletapi as api
 from tpu_device_plugin.config import Config
-from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.dra import slice_device_name
+from tpu_device_plugin.kubeletapi import draapi, drapb, pb
 
 PORT = 18099
 
@@ -64,6 +66,7 @@ def test_everything_composes(short_root, tmp_path):
     cfg = Config().with_root(host.root)
     os.makedirs(cfg.device_plugin_path, exist_ok=True)
     kubelet = FakeKubelet(cfg.kubelet_socket)
+    apiserver = FakeApiServer()
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpu_device_plugin", "--root", host.root,
          "--partition-config", str(pc),
@@ -71,6 +74,7 @@ def test_everything_composes(short_root, tmp_path):
          "--feature-file", ff,
          "--rediscovery-seconds", "0.5",
          "--status-port", str(PORT), "--status-host", "127.0.0.1",
+         "--dra", "--node-name", "int-node", "--api-server", apiserver.url,
          "--log-json"],
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
@@ -130,6 +134,27 @@ def test_everything_composes(short_root, tmp_path):
                   if p["resource"].endswith("/v4"))
         assert v4["recent_allocations"][0]["devices"] == [["0000:00:05.0"]]
 
+        # 5b. DRA composes with everything above: slice published with the
+        # full inventory, claims prepare over the served dra.sock, and the
+        # status surface reports it
+        assert _wait(lambda: apiserver.slices)
+        slice_obj = next(iter(apiserver.slices.values()))
+        slice_devs = {d["name"] for d in slice_obj["spec"]["devices"]}
+        assert slice_device_name("0000:00:05.0") in slice_devs
+        assert slice_device_name("uuid-m") in slice_devs
+        apiserver.add_claim("ns1", "c1", "uid-i1", "cloud-tpus.google.com",
+                            [{"device": slice_device_name("0000:00:05.0")}])
+        dra_sock = os.path.join(cfg.dra_plugins_path,
+                                "cloud-tpus.google.com/dra.sock")
+        with grpc.insecure_channel(f"unix://{dra_sock}") as dch:
+            dresp = draapi.DraPluginStub(dch).NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(claims=[
+                    drapb.Claim(namespace="ns1", name="c1", uid="uid-i1")]),
+                timeout=5)
+            assert dresp.claims["uid-i1"].error == ""
+        assert "tpu_plugin_dra_prepared_claims 1" in _get("/metrics")
+        assert json.loads(_get("/status"))["dra"]["serving"] is True
+
         # 6. incremental rediscovery: hotplug a v5e chip; ONLY v5e registers
         host.add_chip(FakeChip("0000:01:00.0", device_id="0063",
                                iommu_group="31"))
@@ -139,6 +164,14 @@ def test_everything_composes(short_root, tmp_path):
         assert names.count("cloud-tpus.google.com/v5e") == 1
         # labeler republished with the new chip
         assert _wait(lambda: "v5e.chips=1" in open(ff).read())
+        # DRA slice republished too: new device present, pool generation
+        # bumped so the scheduler can tell stale allocations from current
+        assert _wait(lambda: slice_device_name("0000:01:00.0") in {
+            d["name"]
+            for s in apiserver.slices.values()
+            for d in s["spec"]["devices"]})
+        assert next(iter(apiserver.slices.values()))["spec"]["pool"][
+            "generation"] >= 2
 
         # 7. drain -> every device on every plugin Unhealthy; undrain heals
         proc.send_signal(signal.SIGUSR1)
@@ -158,6 +191,10 @@ def test_everything_composes(short_root, tmp_path):
         assert proc.returncode == 0, out[-500:]
         assert not any(n.endswith(".sock") and n != "kubelet.sock"
                        for n in os.listdir(cfg.device_plugin_path))
+        assert not os.path.exists(dra_sock)
+        assert not os.listdir(cfg.dra_registry_path)
+        # the slice deliberately SURVIVES shutdown (a DaemonSet restart
+        # must not churn scheduler state); only explicit withdraw deletes
         for line in out.splitlines():
             if line.strip():
                 json.loads(line)
@@ -166,3 +203,4 @@ def test_everything_composes(short_root, tmp_path):
             proc.kill()
             proc.communicate()
         kubelet.stop()
+        apiserver.stop()

@@ -281,7 +281,8 @@ def main(argv=None) -> int:
     status = None
     if args.status_port:
         from .status import StatusServer
-        status = StatusServer(manager, args.status_port, host=args.status_host)
+        status = StatusServer(manager, args.status_port, host=args.status_host,
+                              dra_driver=dra_driver)
         status.start()
     try:
         manager.run(stop)

@@ -557,6 +557,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             self.registered.set()
         return regpb.RegistrationStatusResponse()
 
+    def prepared_claim_count(self) -> int:
+        with self._lock:
+            return len(self._checkpoint)
+
     # ----------------------------------------------------------- serving
 
     @property

@@ -30,8 +30,10 @@ log = logging.getLogger(__name__)
 
 
 class StatusServer:
-    def __init__(self, manager, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, manager, port: int = 0, host: str = "127.0.0.1",
+                 dra_driver=None):
         self.manager = manager
+        self.dra_driver = dra_driver
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -88,12 +90,23 @@ class StatusServer:
         return bool(plugins) and any(p.serving for p in plugins)
 
     def status(self) -> dict:
-        return {
+        out = {
             "plugins": [p.status_snapshot() for p in self.manager.plugins],
             "pending": [p.resource_name for p in self.manager.pending],
             "native": getattr(self.manager, "native_info", {}),
             "draining": getattr(self.manager, "draining", False),
         }
+        d = self.dra_driver
+        if d is not None:
+            out["dra"] = {
+                "driver": d.driver_name,
+                "serving": d.serving,
+                "kubelet_registered": (d.registered.is_set()
+                                       and d.registration_error is None),
+                "registration_error": d.registration_error,
+                "prepared_claims": d.prepared_claim_count(),
+            }
+        return out
 
     def metrics(self) -> str:
         """Prometheus text exposition of the /status facts."""
@@ -147,4 +160,16 @@ class StatusServer:
             "tpu_plugin_libtpu_available "
             f"{int(s['native'].get('libtpu_available', False))}",
         ]
+        if "dra" in s:
+            lines += [
+                "# HELP tpu_plugin_dra_prepared_claims ResourceClaims "
+                "currently prepared by the DRA driver.",
+                "# TYPE tpu_plugin_dra_prepared_claims gauge",
+                f"tpu_plugin_dra_prepared_claims {s['dra']['prepared_claims']}",
+                "# HELP tpu_plugin_dra_registered DRA driver registered "
+                "with the kubelet (1=yes).",
+                "# TYPE tpu_plugin_dra_registered gauge",
+                f"tpu_plugin_dra_registered "
+                f"{int(s['dra']['kubelet_registered'])}",
+            ]
         return "\n".join(lines) + "\n"
