@@ -7,6 +7,8 @@ scratch layouts, VMEM limits). This gate AOT-lowers + compiles + runs:
   - flash_attention forward at blocks 128x128 and 256x128
   - flash_attention forward+backward (custom-VJP Pallas bwd kernels)
   - one ring_attention step under shard_map on a TPU mesh
+  - one ring_flash_attention step (Pallas kernels behind lax.switch)
+    forward+backward under shard_map
 
 It skips cleanly off-TPU (the conftest pins CPU unless TDP_TPU_TESTS=1), so
 plain CI never touches hardware; in a healthy-chip window it runs in minutes:
@@ -29,7 +31,8 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
 from tpu_device_plugin.validator.flash_attention import flash_attention  # noqa: E402
-from tpu_device_plugin.validator.ring_attention import ring_attention  # noqa: E402
+from tpu_device_plugin.validator.ring_attention import (  # noqa: E402
+    ring_attention, ring_flash_attention)
 
 
 def _tpu_devices():
@@ -123,3 +126,43 @@ def test_ring_attention_step_compiles_on_tpu_mesh():
     out = np.asarray(compiled(q, k, v), np.float32)
     ref = np.asarray(_reference(q, k, v))
     np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
+
+
+@requires_tpu
+def test_ring_flash_step_compiles_on_tpu_mesh():
+    """ring_flash (Pallas kernel per ring step behind lax.switch) must
+    Mosaic-compile fwd+bwd and match the oracle — the switch puts three
+    compiled kernel variants in one program, which only hardware lowering
+    can validate."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = _tpu_devices()
+    mesh = Mesh(np.array(devs[:1]), ("sp",))
+    q, k, v = _qkv(seed=3)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(None, "sp", None),) * 3,
+                       out_specs=P(None, "sp", None),
+                       # pallas out_shape carries no varying-mesh-axes
+                       # metadata (same reason as workload.py's shard_maps)
+                       check_vma=False)
+    def step(q, k, v):
+        return ring_flash_attention(q, k, v, D ** -0.5, "sp", 128, 128)
+
+    fn = jax.jit(step)
+    compiled = fn.lower(q, k, v).compile()
+    out = np.asarray(compiled(q, k, v), np.float32)
+    ref = np.asarray(_reference(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=3e-2, rtol=3e-2)
+
+    def loss(q, k, v):
+        return step(q, k, v).astype(jnp.float32).sum()
+
+    grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+        q, k, v).compile()(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g, np.float32)).all()

@@ -271,8 +271,13 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, di_ref, k_ref, v_ref,
 
 
 def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
-                  block_q, block_k, interpret):
-    """Pallas backward: dq, dk, dv with O(S) memory (no (S, S) in HBM)."""
+                  block_q, block_k, interpret, out_dtype=None):
+    """Pallas backward: dq, dk, dv with O(S) memory (no (S, S) in HBM).
+
+    `out_dtype` overrides the gradient output dtype (the kernels accumulate
+    in f32 VMEM scratch regardless; this only controls the final cast).
+    Ring flash passes f32 so per-step partials are not rounded to bf16
+    before being summed across ring steps."""
     hb, seq, d = q.shape
     block_q = min(block_q, seq)
     block_k = min(block_k, seq)
@@ -294,8 +299,8 @@ def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
         grid=(hb, num_k, num_q),
         in_specs=[q_spec, q_spec, row_spec, row_spec, kv_spec, kv_spec],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((hb, seq, d), k.dtype),
-                   jax.ShapeDtypeStruct((hb, seq, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((hb, seq, d), out_dtype or k.dtype),
+                   jax.ShapeDtypeStruct((hb, seq, d), out_dtype or v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
@@ -311,7 +316,7 @@ def _flash_bwd_3d(q, k, v, o, lse, d_out, sm_scale, causal,
         grid=(hb, num_q, num_k),
         in_specs=[q_spec2, q_spec2, row_spec2, row_spec2, kv_spec2, kv_spec2],
         out_specs=q_spec2,
-        out_shape=jax.ShapeDtypeStruct((hb, seq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((hb, seq, d), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, d_out, lse, di, k, v)

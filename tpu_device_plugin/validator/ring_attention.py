@@ -133,3 +133,158 @@ def _ring_bwd(sm_scale, axis_name, residuals, d_out):
 
 
 ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+# --- ring flash attention: the Pallas kernel inside each ring step ----------
+#
+# ring_attention above computes every step's (s_local, s_local) score matrix
+# with einsums — simple, but it materializes O(S_local^2) f32 in HBM per
+# step, the exact pattern the flash kernel exists to avoid. ring_flash runs
+# the blockwise Pallas kernel on each step's LOCAL KV block instead: scores
+# never leave VMEM, the MXU sees the same two matmuls per tile as
+# single-device flash, and the ring merge happens at block granularity on
+# the kernel's (out, logsumexp) pair. The causality mode of a step depends
+# on the block's origin shard (full for src < my, causal for src == my,
+# skipped for src > my), which is a traced value under shard_map — so the
+# three statically-compiled kernel variants sit behind a lax.switch.
+#
+# Merging a finished block into the running state uses the block's
+# logsumexp directly (no separate max needed): a block with normalized
+# output o_b and logsumexp lse_b contributes exp(lse_b) total weight and
+# o_b * exp(lse_b) weighted sum, so
+#   m'   = max(m, lse_b)
+#   l'   = l * exp(m - m') + exp(lse_b - m')
+#   acc' = acc * exp(m - m') + o_b * exp(lse_b - m')
+# The schedule visits the local (causal) block first, so m is finite from
+# step 0 and every row has at least its diagonal key; skipped steps carry
+# lse_b = NEG_INF and contribute exactly zero.
+
+
+def _step_mode(src, my_idx):
+    """0 = skip (future block), 1 = causal (own block), 2 = full (past)."""
+    return jnp.where(src > my_idx, 0, jnp.where(src == my_idx, 1, 2))
+
+
+def _flash_block(q, k, v, sm_scale, mode, block_q, block_k, interpret):
+    """(o_b f32, lse_b (bh, s, 1) f32) for one ring step via lax.switch."""
+    from .flash_attention import _flash_3d
+
+    def _run(causal):
+        def branch(q, k, v):
+            o, lse = _flash_3d(q, k, v, sm_scale, causal, block_q, block_k,
+                               interpret, return_lse=True)
+            return o.astype(jnp.float32), lse[:, :, :1]
+        return branch
+
+    def _skip(q, k, v):
+        bh, s, d = q.shape
+        return (jnp.zeros((bh, s, d), jnp.float32),
+                jnp.full((bh, s, 1), NEG_INF, jnp.float32))
+
+    return jax.lax.switch(mode, (_skip, _run(True), _run(False)), q, k, v)
+
+
+def _ring_flash_forward(q, k, v, sm_scale, axis_name,
+                        block_q, block_k, interpret):
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    bh, s_local, d = q.shape
+
+    m = jnp.full((bh, s_local, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((bh, s_local, 1), jnp.float32)
+    acc = jnp.zeros((bh, s_local, d), jnp.float32)
+
+    k_cur, v_cur = k, v
+    for step in range(n):
+        src = (my_idx - step) % n
+        o_b, lse_b = _flash_block(q, k_cur, v_cur, sm_scale,
+                                  _step_mode(src, my_idx),
+                                  block_q, block_k, interpret)
+        m_new = jnp.maximum(m, lse_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(lse_b - m_new)
+        l = alpha * l + beta
+        acc = acc * alpha + o_b * beta
+        m = m_new
+        if step != n - 1:
+            k_cur = _rotate(k_cur, axis_name, n)
+            v_cur = _rotate(v_cur, axis_name, n)
+    lse = m + jnp.log(l)
+    return (acc / l).astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         sm_scale: float, axis_name: str = "sp",
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: bool = False,
+                         bwd_block_q=None, bwd_block_k=None) -> jax.Array:
+    """ring_attention with the Pallas flash kernel inside each step.
+
+    Same contract and residency (O(S/sp) per chip, both directions) as
+    ring_attention; the per-step score matrix never exists in HBM. Backward
+    reuses the FA-2 dkv/dq Pallas kernel pair per step against the saved
+    GLOBAL logsumexp (p = exp(s - lse_global) is each tile's true global
+    probability, so per-step partial grads sum exactly like the einsum
+    path's).
+    """
+    out, _ = _ring_flash_forward(q, k, v, sm_scale, axis_name,
+                                 block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, sm_scale, axis_name, block_q, block_k,
+                    interpret, bwd_block_q, bwd_block_k):
+    out, lse = _ring_flash_forward(q, k, v, sm_scale, axis_name,
+                                   block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(sm_scale, axis_name, block_q, block_k, interpret,
+                    bwd_block_q, bwd_block_k, residuals, d_out):
+    from .flash_attention import DEFAULT_BWD_BLOCK, LANES, _flash_bwd_3d
+    bq = bwd_block_q or DEFAULT_BWD_BLOCK
+    bk = bwd_block_k or DEFAULT_BWD_BLOCK
+    q, k, v, out, lse = residuals
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    bh, s_local, d = q.shape
+    lse_l = jnp.broadcast_to(lse, (bh, s_local, LANES))
+
+    def _run(causal):
+        def branch(q, k, v):
+            # f32 outputs: each step's partials join a cross-step f32 sum;
+            # rounding them to bf16 first would grow gradient noise with
+            # ring size (the einsum _ring_bwd accumulates in f32 too)
+            return _flash_bwd_3d(q, k, v, out, lse_l, d_out, sm_scale,
+                                 causal, bq, bk, interpret,
+                                 out_dtype=jnp.float32)
+        return branch
+
+    def _skip(q, k, v):
+        zeros = jnp.zeros(q.shape, jnp.float32)
+        return (zeros, zeros, zeros)
+
+    dq = jnp.zeros((bh, s_local, d), jnp.float32)
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros((bh, s_local, d), jnp.float32)
+    dv_cur = jnp.zeros((bh, s_local, d), jnp.float32)
+    for step in range(n):
+        src = (my_idx - step) % n
+        dq_b, dk_b, dv_b = jax.lax.switch(
+            _step_mode(src, my_idx), (_skip, _run(True), _run(False)),
+            q, k_cur, v_cur)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_cur = dk_cur + dk_b.astype(jnp.float32)
+        dv_cur = dv_cur + dv_b.astype(jnp.float32)
+        # same homing schedule as _ring_bwd: blocks die after the last
+        # tile, accumulators take the final hop back to their origin
+        if step != n - 1:
+            k_cur = _rotate(k_cur, axis_name, n)
+            v_cur = _rotate(v_cur, axis_name, n)
+        dk_cur = _rotate(dk_cur, axis_name, n)
+        dv_cur = _rotate(dv_cur, axis_name, n)
+    return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)

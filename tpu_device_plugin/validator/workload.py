@@ -154,13 +154,24 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
     v = (x @ layer["wv"].astype(jnp.bfloat16)).reshape(b, s, h, dh)
     if attention == "ring":
         # sequence-parallel ring attention: K/V stay sharded along sp and
-        # rotate around the ICI ring (O(S/sp) memory vs the all-gather's O(S))
-        from .ring_attention import ring_attention
+        # rotate around the ICI ring (O(S/sp) memory vs the all-gather's
+        # O(S)). On real TPU each ring step runs the Pallas flash kernel on
+        # its local block (scores never hit HBM); interpret mode keeps the
+        # einsum inner loop — Pallas interpretation is orders of magnitude
+        # slower than XLA:CPU einsums and the two are merge-identical
+        # (tests/test_flash_attention.py::test_ring_flash_matches_einsum_ring)
+        from .ring_attention import ring_attention, ring_flash_attention
 
         def local_ring(q_, k_, v_):
             bl, _, hl, _ = q_.shape
-            o = ring_attention(_fold_heads(q_), _fold_heads(k_),
-                               _fold_heads(v_), dh ** -0.5, axis_name="sp")
+            if interpret:
+                o = ring_attention(_fold_heads(q_), _fold_heads(k_),
+                                   _fold_heads(v_), dh ** -0.5,
+                                   axis_name="sp")
+            else:
+                o = ring_flash_attention(_fold_heads(q_), _fold_heads(k_),
+                                         _fold_heads(v_), dh ** -0.5, "sp",
+                                         128, 128, False)
             return _unfold_heads(o, bl, hl)
 
         out4 = jax.shard_map(
@@ -329,6 +340,15 @@ def sgd_step(params: Params, momentum: Params, tokens: jax.Array,
     return new_params, new_momentum, loss
 
 
+# Below this GLOBAL sequence length the XLA-fused einsum attention beats the
+# Pallas flash kernel on real hardware (honest chained sweep,
+# docs/validator_tpu_attn_r03b.json: flash fwd 0.30x / train 0.43x at 1024,
+# ~parity fwd / 1.56x train at 2048, 2.5x/2.8x at 4096, 37x/18x at 8192 —
+# einsum's (S, S) materialization collapses once it blows past VMEM-friendly
+# sizes). Auto mode dispatches on it; explicit "flash" is always honored.
+FLASH_MIN_SEQ = 2048
+
+
 def _resolve(cfg, mesh, attention):
     """Shared mesh/platform/attention selection for train and infer builds."""
     cfg = cfg or ModelConfig()
@@ -340,7 +360,7 @@ def _resolve(cfg, mesh, attention):
     if attention is None:
         if sp_size > 1:
             attention = "ring"
-        elif platform == "tpu":
+        elif platform == "tpu" and cfg.seq_len >= FLASH_MIN_SEQ:
             attention = "flash"
         else:
             attention = "einsum"
@@ -365,8 +385,9 @@ def build_workload(
 
     attention: "flash" (Pallas kernel, needs sp == 1), "ring"
     (sequence-parallel ring attention, K/V rotate over the sp axis),
-    "einsum" (KV all-gather). None auto-selects: ring when sp > 1, flash on
-    TPU when sp == 1, einsum otherwise.
+    "einsum" (KV all-gather). None auto-selects: ring when sp > 1; flash on
+    TPU when sp == 1 AND cfg.seq_len >= FLASH_MIN_SEQ (the hardware sweep's
+    crossover — XLA's fused einsum wins below it); einsum otherwise.
     """
     cfg, mesh, platform, attention = _resolve(cfg, mesh, attention)
     params, tokens, param_sh, batch_sh = _place(cfg, mesh, seed)
