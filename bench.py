@@ -74,7 +74,10 @@ def _build_host(root, n_devices, device_id="0063"):
 
 
 def _serve(plugin, workers=4):
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=workers))
+    # same channel options as the production server (server.py:169): the
+    # bench must measure the config the kubelet actually talks to
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=workers),
+                         options=(("grpc.optimization_target", "latency"),))
     api.add_device_plugin_servicer(server, plugin)
     server.add_insecure_port(f"unix://{plugin.socket_path}")
     server.start()

@@ -338,3 +338,21 @@ def test_explicit_device_plugin_path_wins_over_root():
 
     parsed2, _ = build_config(["--root", "/fixture"])
     assert parsed2.device_plugin_path == "/fixture/device-plugins/"
+
+
+def test_registry_device_lookup_paths():
+    """Registry.device(): hit, group-mismatch miss, and unknown-BDF miss."""
+    from tpu_device_plugin.registry import Registry, TpuDevice
+    d = TpuDevice(bdf="0000:00:04.0", device_id="0063", iommu_group="11",
+                  numa_node=0)
+    other = TpuDevice(bdf="0000:00:05.0", device_id="0063", iommu_group="11",
+                      numa_node=0)
+    reg = Registry(devices_by_model={"0063": (d, other)},
+                   iommu_map={"11": (d, other)},
+                   bdf_to_group={"0000:00:04.0": "11",
+                                 "0000:00:05.0": "11",
+                                 "0000:00:06.0": "99"})
+    assert reg.device("0000:00:04.0") is d
+    assert reg.device("0000:00:07.0") is None        # unknown bdf
+    assert reg.device("0000:00:06.0") is None        # group has no entry
+    assert {x.bdf for x in reg.all_devices()} == {d.bdf, other.bdf}

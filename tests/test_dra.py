@@ -481,3 +481,158 @@ def test_status_surfaces_dra(host, apiserver):
     metrics = status.metrics()
     assert "tpu_plugin_dra_prepared_claims 1" in metrics
     assert "tpu_plugin_dra_registered 0" in metrics
+
+
+# ------------------------------------------------ failure / degraded paths
+
+
+def test_publish_without_api_client(host):
+    _, cfg = host
+    registry, generations = discover(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="n", api=None)
+    assert driver.publish_resource_slices() is False
+
+
+def test_publish_api_unreachable(host):
+    """Transport-level API failure: publish reports False (run loop retries)."""
+    _, cfg = host
+    registry, generations = discover(cfg)
+    api = ApiClient("http://127.0.0.1:1", timeout_s=0.3)   # closed port
+    driver = DraDriver(cfg, registry, generations, node_name="n", api=api)
+    assert driver.publish_resource_slices() is False
+
+
+def test_prepare_api_unreachable_errors(host):
+    _, cfg = host
+    registry, generations = discover(cfg)
+    api = ApiClient("http://127.0.0.1:1", timeout_s=0.3)
+    driver = DraDriver(cfg, registry, generations, node_name="n", api=api)
+    resp = prepare(driver, drapb.Claim(namespace="x", name="y", uid="u"))
+    assert "ResourceClaim GET failed" in resp.claims["u"].error
+
+
+def test_prepare_claim_not_found_errors(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="ghost",
+                                       uid="u"))
+    assert "ResourceClaim GET failed" in resp.claims["u"].error
+
+
+def test_prepare_foreign_driver_results_prepare_nothing(host, apiserver):
+    """A claim whose allocation names only ANOTHER driver's devices prepares
+    zero devices without error (the kubelet calls every driver the claim's
+    allocation mentions; ours may legitimately have no share)."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "claim1", "uid-1", "gpu.example.com",
+                        [{"device": "some-gpu"}])
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="claim1",
+                                       uid="uid-1"))
+    out = resp.claims["uid-1"]
+    assert out.error == ""
+    assert len(out.devices) == 0
+
+
+def test_corrupt_checkpoint_degrades_to_empty(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    os.makedirs(os.path.dirname(driver.checkpoint_path), exist_ok=True)
+    with open(driver.checkpoint_path, "w") as f:
+        f.write("{not json")
+    driver2 = make_driver(cfg, apiserver)
+    assert driver2.prepared_claim_count() == 0
+
+
+def test_stop_with_withdraw_deletes_slice(host, apiserver):
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    assert apiserver.slices
+    driver.start()
+    driver.stop(withdraw_slice=True)
+    assert not apiserver.slices
+
+
+def test_node_owner_ref_degrades_without_rbac(host, apiserver):
+    """Node GET failing (no `get nodes` RBAC) publishes an un-owned slice
+    rather than failing the publish."""
+    _, cfg = host
+    registry, generations = discover(cfg)
+
+    class NoNodesClient(ApiClient):
+        def get_json(self, path):
+            if path.startswith("/api/v1/nodes/"):
+                from tpu_device_plugin.kubeapi import ApiError
+                raise ApiError("forbidden", code=403)
+            return super().get_json(path)
+
+    api = NoNodesClient(apiserver.url)
+    driver = DraDriver(cfg, registry, generations, node_name="n", api=api)
+    assert driver.publish_resource_slices()
+    obj = next(iter(apiserver.slices.values()))
+    assert "ownerReferences" not in obj["metadata"]
+
+
+def test_prepare_logical_partitions_accel_and_vfio_parent(host, apiserver,
+                                                          tmp_path):
+    """vtpu.py parity for the logical providers: accel-backed partitions get
+    the accel node under the operator's permission policy; a vfio-parent
+    partition rides the parent planner (group expansion + PCI env)."""
+    from dataclasses import replace
+    h, cfg = host
+    # chip 4 is vfio-bound (from the fixture); add an accel-owned chip
+    h.add_chip(FakeChip("0000:00:09.0", device_id="0063", iommu_group="19",
+                        driver="google-tpu", accel_index=3))
+    pc = tmp_path / "partitions.json"
+    # per_core splits the accel-owned chip; the explicit entry declares one
+    # partition on a vfio-bound parent (the parent-planner prepare path)
+    pc.write_text(json.dumps({
+        "per_core": True,
+        "partitions": [{"uuid": "lp-vfio-0", "type": "v5e_half",
+                        "parent_bdf": "0000:00:04.0"}],
+    }))
+    cfg = replace(cfg, partition_config_path=str(pc),
+                  partition_node_permissions="r")
+    driver = make_driver(cfg, apiserver)
+    part_names = [n for n, (kind, _, _) in driver._by_name.items()
+                  if kind == "partition"]
+    accel_parts = [n for n in part_names if "-09-0" in n]
+    vfio_parts = [n for n in part_names if n == slice_device_name("lp-vfio-0")]
+    assert accel_parts and vfio_parts
+    apiserver.add_claim(
+        "ns1", "claim1", "uid-1", driver.driver_name,
+        [{"device": accel_parts[0]}, {"device": vfio_parts[0]}])
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="claim1",
+                                       uid="uid-1"))
+    assert resp.claims["uid-1"].error == ""
+    with open(driver._claim_spec_path("uid-1")) as f:
+        spec = json.load(f)
+    edits = spec["devices"][0]["containerEdits"]
+    nodes = {n["path"]: n["permissions"] for n in edits["deviceNodes"]}
+    assert nodes["/dev/accel3"] == "r"       # policy carried into CDI
+    assert "/dev/vfio/11" in nodes           # parent group of chip 04
+    env = dict(e.split("=", 1) for e in edits["env"])
+    # vfio-parent partitions attach as PCI passthrough of the parent
+    assert env["PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V5E_HALF"] == \
+        "0000:00:04.0"
+
+
+def test_prepare_mdev_without_group_falls_back_to_wide_mount(host, apiserver):
+    """vtpu.py:169-172 parity: an mdev whose iommu_group link is not
+    visible degrades to the reference-compatible wide /dev/vfio mount
+    instead of failing the prepare."""
+    h, cfg = host
+    h.add_mdev("uuid-wide", "TPU vhalf", "0000:00:06.0")   # no group link
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim(
+        "ns1", "claim1", "uid-1", driver.driver_name,
+        [{"device": slice_device_name("uuid-wide")}])
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="claim1",
+                                       uid="uid-1"))
+    assert resp.claims["uid-1"].error == ""
+    with open(driver._claim_spec_path("uid-1")) as f:
+        spec = json.load(f)
+    paths = [n["path"] for n in
+             spec["devices"][0]["containerEdits"]["deviceNodes"]]
+    assert "/dev/vfio" in paths
