@@ -240,6 +240,7 @@ def main(argv=None) -> int:
         inventory_sinks.append(lambda reg, gens: labeler.publish(
             node_facts(cfg, reg, gens)))
     dra_driver = None
+    health_listener = None
     if args.dra:
         from .dra import DraDriver
         from .kubeapi import ApiClient, in_cluster_server
@@ -260,6 +261,10 @@ def main(argv=None) -> int:
                 _d.start()
             return ok
         inventory_sinks.append(dra_sink)
+        # the plugin servers' ANDed health verdict prunes dead devices from
+        # the published ResourceSlice on the same transition that flips
+        # them Unhealthy on ListAndWatch (no second health watcher)
+        health_listener = dra_driver.apply_health
     on_inventory = None
     if inventory_sinks:
         def on_inventory(reg, gens):
@@ -267,7 +272,8 @@ def main(argv=None) -> int:
             for sink in inventory_sinks:
                 ok = sink(reg, gens) and ok
             return ok
-    manager = PluginManager(cfg, on_inventory=on_inventory)
+    manager = PluginManager(cfg, on_inventory=on_inventory,
+                            health_listener=health_listener)
 
     def handle_drain(signum, frame):
         # flag-set only: drain() takes locks the interrupted main thread

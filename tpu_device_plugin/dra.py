@@ -36,6 +36,7 @@ checkpoint file is the source of truth, like upstream DRA drivers).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -59,6 +60,10 @@ log = logging.getLogger(__name__)
 
 RESOURCE_API = "/apis/resource.k8s.io/v1beta1"
 CDI_VERSION = "0.6.0"
+# retry cadence for a health-triggered republish that failed (transient
+# apiserver blip / resourceVersion conflict); mirrors the PluginManager's
+# 30 s inventory-publish retry
+HEALTH_REPUBLISH_RETRY_S = 30.0
 # Distinct CDI class from cdi.py's per-chip "tpu" kind: claim devices are
 # composite (all of a claim's nodes + env in one entry) and live in
 # per-claim spec files created/removed at prepare/unprepare time.
@@ -126,10 +131,57 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
         self._node_uid: Optional[str] = None
+        # raw ids (BDF / partition uuid) currently Unhealthy per the plugin
+        # servers' ANDed health verdict; such devices are pruned from the
+        # published ResourceSlice so a DRA-only scheduler can never allocate
+        # dead hardware (parity with the classic path's one-ListAndWatch-send
+        # propagation, server.py set_devices_health). Keyed by raw id so the
+        # set survives set_inventory() swaps.
+        self._unhealthy: set = set()
+        self._republish_timer: Optional[threading.Timer] = None
+        self._stopped = False
+        # serializes slice publishes against each other AND against
+        # stop(withdraw_slice=True): an in-flight retry publish racing the
+        # withdraw could otherwise POST the slice back after the delete
+        self._publish_lock = threading.Lock()
         self.set_inventory(registry, generations)
         self._checkpoint: Dict[str, dict] = self._load_checkpoint()
 
     # ---------------------------------------------------------- inventory
+
+    @staticmethod
+    def _assign_slice_names(raws) -> Dict[str, str]:
+        """raw id → collision-safe DNS-label name.
+
+        slice_device_name() is lossy (lowercasing + non-[a-z0-9-] collapse
+        + 63-char truncation), so two distinct raw ids can map to one label
+        — silently overwriting the earlier device in _by_name and
+        publishing duplicate names in one ResourceSlice, after which a
+        prepare could hand out the WRONG device. Every member of a
+        colliding label group gets a digest suffix — including the first,
+        so a device's published name is a pure function of the raw id set's
+        collisions, never of iteration order (an order-dependent plain
+        label could be inherited by a DIFFERENT device after an inventory
+        swap, silently re-pointing old claims)."""
+        labels: Dict[str, List[str]] = {}
+        for raw in raws:
+            labels.setdefault(slice_device_name(raw), []).append(raw)
+        names: Dict[str, str] = {}
+        for label, members in labels.items():
+            if len(members) == 1:
+                names[members[0]] = label
+                continue
+            for raw in members:
+                digest = hashlib.sha256(
+                    raw.encode("utf-8", "replace")).hexdigest()[:8]
+                names[raw] = f"{label[:63 - 9]}-{digest}"
+            log.warning("DRA: device name collision on %r; publishing %s",
+                        label, sorted(names[r] for r in members))
+        return names
+
+    @staticmethod
+    def _raw_id(kind: str, obj) -> str:
+        return obj.bdf if kind == "chip" else obj.uuid
 
     def set_inventory(self, registry: Registry,
                       generations: Dict[str, GenerationInfo]) -> None:
@@ -137,7 +189,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         with self._lock:
             self.registry = registry
             self.generations = generations
-            self._by_name: Dict[str, Tuple[str, object]] = {}
+            entries: List[Tuple[str, str, str, object]] = []  # raw,kind,grp,obj
             self._planners: Dict[str, AllocationPlanner] = {}
             for model, devs in sorted(registry.devices_by_model.items()):
                 info = generations.get(model)
@@ -145,17 +197,22 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 if gen not in self._planners:
                     self._planners[gen] = AllocationPlanner(
                         self.cfg, registry, gen)
-                for d in devs:
-                    self._by_name[slice_device_name(d.bdf)] = ("chip", gen, d)
+                entries.extend((d.bdf, "chip", gen, d) for d in devs)
             for type_name, parts in sorted(registry.partitions_by_type.items()):
-                for p in parts:
-                    self._by_name[slice_device_name(p.uuid)] = (
-                        "partition", type_name, p)
+                entries.extend((p.uuid, "partition", type_name, p)
+                               for p in parts)
+            names = self._assign_slice_names([raw for raw, *_ in entries])
+            self._by_name: Dict[str, Tuple[str, str, object]] = {
+                names[raw]: (kind, group, obj)
+                for raw, kind, group, obj in entries}
+            # devices that left the inventory take their health state along
+            self._unhealthy &= set(names)
             # vfio-backed logical partitions ride their parent's planner
             self._parent_planner = AllocationPlanner(
                 self.cfg, registry, "vtpu-parent")
 
-    def _device_entry(self, kind: str, group_name: str, obj) -> dict:
+    def _device_entry(self, name: str, kind: str, group_name: str,
+                      obj) -> dict:
         if kind == "chip":
             d: TpuDevice = obj
             attrs = {
@@ -170,7 +227,6 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             if d.ici_coords is not None:
                 for axis, coord in zip("xyz", d.ici_coords):
                     attrs[f"ici{axis.upper()}"] = {"int": coord}
-            name = slice_device_name(d.bdf)
         else:
             p: TpuPartition = obj
             attrs = {
@@ -182,14 +238,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             }
             if p.accel_index is not None:
                 attrs["accelIndex"] = {"int": p.accel_index}
-            name = slice_device_name(p.uuid)
         return {"name": name, "basic": {"attributes": attrs}}
 
     def build_slice(self, pool_generation: int = 1) -> dict:
-        """The ResourceSlice object for this node's inventory."""
+        """The ResourceSlice object for this node's HEALTHY inventory.
+
+        Unhealthy devices are pruned, not attribute-marked: a scheduler
+        needs no CEL opt-in to avoid dead hardware, matching the classic
+        path where an Unhealthy device simply stops being allocatable.
+        """
         with self._lock:
-            devices = [self._device_entry(kind, group_name, obj)
-                       for kind, group_name, obj in self._by_name.values()]
+            devices = [self._device_entry(name, kind, group_name, obj)
+                       for name, (kind, group_name, obj)
+                       in self._by_name.items()
+                       if self._raw_id(kind, obj) not in self._unhealthy]
         slice_obj = {
             "apiVersion": "resource.k8s.io/v1beta1",
             "kind": "ResourceSlice",
@@ -212,6 +274,68 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def slice_name(self) -> str:
         return slice_device_name(f"{self.node_name}-{self._driver_fs}")
+
+    # ---------------------------------------------------------------- health
+
+    def apply_health(self, transitions: Dict[str, bool]) -> bool:
+        """Plugin-server health transitions ({raw id: healthy}) → slice.
+
+        Wired as the plugin servers' health_listener (cli.py): the same
+        ANDed fs+probe verdict that flips a device Unhealthy on the
+        ListAndWatch stream prunes it from (or restores it to) the
+        published ResourceSlice, bumping the pool generation. Returns True
+        when the slice changed (and a republish was attempted).
+        """
+        with self._lock:
+            before = set(self._unhealthy)
+            known = {self._raw_id(kind, obj)
+                     for kind, _, obj in self._by_name.values()}
+            for raw, healthy in transitions.items():
+                if raw not in known:
+                    continue
+                if healthy:
+                    self._unhealthy.discard(raw)
+                else:
+                    self._unhealthy.add(raw)
+            changed = self._unhealthy != before
+            if changed:
+                dead = sorted(self._unhealthy)
+        if not changed:
+            return False
+        log.warning("DRA: health transition; unhealthy devices now %s",
+                    dead or "none")
+        if not self.publish_resource_slices():
+            # unlike inventory publishes (retried by the PluginManager run
+            # loop), nothing re-fires a health transition — a dropped
+            # republish would leave a dead device allocatable until some
+            # unrelated change. Self-arm a retry.
+            self._arm_republish_retry()
+        return True
+
+    def _arm_republish_retry(self) -> None:
+        with self._lock:
+            # a stopped driver must never re-arm: an in-flight retry racing
+            # stop(withdraw_slice=True) would POST the slice back for a
+            # driver that no longer exists
+            if self._republish_timer is not None or self._stopped:
+                return
+            t = threading.Timer(HEALTH_REPUBLISH_RETRY_S,
+                                self._republish_retry)
+            t.daemon = True
+            self._republish_timer = t
+        t.start()
+
+    def _republish_retry(self) -> None:
+        with self._lock:
+            self._republish_timer = None
+            if self._stopped:
+                return
+        if not self.publish_resource_slices():
+            self._arm_republish_retry()
+
+    def unhealthy_devices(self) -> List[str]:
+        with self._lock:
+            return sorted(self._unhealthy)
 
     def _node_owner_ref(self) -> Optional[dict]:
         """Owner reference to the Node so slices are garbage-collected when
@@ -241,11 +365,24 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         if self.api is None:
             log.warning("DRA: no API client; ResourceSlice not published")
             return False
+        with self._publish_lock:
+            return self._publish_locked()
+
+    def _publish_locked(self) -> bool:
+        with self._lock:
+            if self._stopped:
+                return False
+            inventory_empty = not self._by_name
         name = self.slice_name()
         path = f"{RESOURCE_API}/resourceslices/{name}"
         desired = self.build_slice()
-        if not desired["spec"]["devices"]:
-            # empty inventory: withdraw the slice entirely
+        if inventory_empty:
+            # empty INVENTORY: withdraw the slice entirely. All-devices-
+            # unhealthy is NOT this case — that publishes an empty device
+            # list under a bumped generation, because a delete/recreate
+            # cycle would reset pool.generation to 1 and make allocations
+            # from the old generation look newer than the live pool
+            # (breaking stale-allocation detection).
             try:
                 self.api.delete(path)
                 log.info("DRA: deleted ResourceSlice %s (no devices)", name)
@@ -270,8 +407,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             return True
         live_spec = live.get("spec") or {}
         live_gen = ((live_spec.get("pool") or {}).get("generation")) or 1
-        desired = self.build_slice(pool_generation=live_gen)
-        if live_spec == desired["spec"]:
+        if self._spec_projection(live_spec) == \
+                self._spec_projection(desired["spec"]):
             return True
         desired = self.build_slice(pool_generation=live_gen + 1)
         desired["metadata"]["resourceVersion"] = (
@@ -285,6 +422,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                  "(%d devices)", name, live_gen + 1,
                  len(desired["spec"]["devices"]))
         return True
+
+    @staticmethod
+    def _spec_projection(spec: dict) -> tuple:
+        """The fields THIS driver owns, for change detection. Comparing the
+        raw spec dict against the live object would see any apiserver-side
+        defaulting/normalization as a permanent diff — bumping
+        pool.generation (and PUTting) on every republish and churning
+        scheduler state. pool.generation itself is excluded (it is the
+        version, not the content)."""
+        devices = tuple(
+            (d.get("name"),
+             json.dumps(d.get("basic") or {}, sort_keys=True))
+            for d in (spec.get("devices") or []))
+        return (spec.get("driver"), spec.get("nodeName"), devices)
 
     # ------------------------------------------------------- checkpointing
 
@@ -449,6 +600,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # claims' prepares behind one stuck HTTP call. Only checkpoint
         # mutation and device planning (fast sysfs reads against the
         # locked inventory maps) hold it.
+        results = None
         with self._lock:
             entry = self._checkpoint.get(claim.uid)
         if entry is not None:
@@ -457,10 +609,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             if not os.path.exists(entry["spec_path"]):
                 results = self._allocation_results(claim)
                 with self._lock:
-                    specs, envs = self._plan_devices(results)
-                self._write_claim_spec(claim.uid, specs, envs)
-            return [drapb.Device(**d) for d in entry["devices"]]
-        results = self._allocation_results(claim)
+                    # re-check under the lock (mirroring the fresh-prepare
+                    # double-check): a concurrent NodeUnprepareResources may
+                    # have deleted the checkpoint entry while we fetched —
+                    # rewriting the spec then would orphan a per-claim CDI
+                    # file no checkpoint entry tracks
+                    entry = self._checkpoint.get(claim.uid)
+                    if entry is not None:
+                        specs, envs = self._plan_devices(results)
+                        self._write_claim_spec(claim.uid, specs, envs)
+            if entry is not None:
+                return [drapb.Device(**d) for d in entry["devices"]]
+            # unprepared concurrently: fall through to a fresh prepare,
+            # reusing the allocation already fetched (immutable per UID)
+        if results is None:
+            results = self._allocation_results(claim)
         with self._lock:
             # another worker may have prepared the claim while we fetched
             entry = self._checkpoint.get(claim.uid)
@@ -569,6 +732,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def start(self) -> None:
         """Serve the DRAPlugin + Registration sockets (kubelet dials both)."""
+        with self._lock:
+            self._stopped = False
         os.makedirs(self.driver_dir, exist_ok=True)
         os.makedirs(self.cfg.dra_registry_path, exist_ok=True)
         for path in (self.dra_socket_path, self.registration_socket_path):
@@ -594,6 +759,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                  self.dra_socket_path, self.registration_socket_path)
 
     def stop(self, withdraw_slice: bool = False) -> None:
+        with self._lock:
+            self._stopped = True
+            timer, self._republish_timer = self._republish_timer, None
+        if timer is not None:
+            timer.cancel()
         for server in (self._reg_server, self._dra_server):
             if server is not None:
                 server.stop(grace=1).wait()
@@ -604,9 +774,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             except FileNotFoundError:
                 pass
         if withdraw_slice and self.api is not None:
-            try:
-                self.api.delete(
-                    f"{RESOURCE_API}/resourceslices/{self.slice_name()}")
-            except ApiError as exc:
-                if exc.code != 404:
-                    log.warning("DRA: slice withdraw failed: %s", exc)
+            # _publish_lock waits out any in-flight publish (a retry timer
+            # callback that already passed its _stopped check), so the
+            # delete below cannot be overwritten by a late POST
+            with self._publish_lock:
+                try:
+                    self.api.delete(
+                        f"{RESOURCE_API}/resourceslices/{self.slice_name()}")
+                except ApiError as exc:
+                    if exc.code != 404:
+                        log.warning("DRA: slice withdraw failed: %s", exc)

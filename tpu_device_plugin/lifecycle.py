@@ -29,13 +29,18 @@ log = logging.getLogger(__name__)
 
 
 class PluginManager:
-    def __init__(self, cfg: Config, on_inventory=None) -> None:
+    def __init__(self, cfg: Config, on_inventory=None,
+                 health_listener=None) -> None:
         self.cfg = cfg
         # called with (registry, generations) after every (re)discovery —
         # the node labeler publishes per-node facts through this seam; a
         # False return (e.g. API server unreachable at node boot) is retried
         # from the run loop even when inventory never changes
         self.on_inventory = on_inventory
+        # forwarded to every plugin server: called with
+        # {device_id: healthy} on effective health transitions (the DRA
+        # driver prunes dead devices from its ResourceSlice through this)
+        self.health_listener = health_listener
         self._last_inventory = None
         self._inventory_published = True
         self._next_publish_retry = 0.0
@@ -87,6 +92,7 @@ class PluginManager:
                 self.cfg, suffix, registry, devs,
                 torus_dims=info.host_topology if info else None,
                 health_shim=self._shim, cdi_enabled=cdi_enabled,
+                health_listener=self.health_listener,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
@@ -111,7 +117,8 @@ class PluginManager:
                     cdi_uuids = frozenset(e["name"] for e in entries)
             plugins.append(VtpuDevicePlugin(
                 self.cfg, type_name, registry, parts, health_shim=self._shim,
-                cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids))
+                cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids,
+                health_listener=self.health_listener))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi

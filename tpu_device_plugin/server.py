@@ -51,8 +51,18 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         torus_dims: Optional[Tuple[int, ...]] = None,
         health_shim: Optional[TpuHealth] = None,
         cdi_enabled: bool = False,
+        health_listener=None,
     ) -> None:
         self.cfg = cfg
+        # Optional observer called with {device_id: effective_health} on
+        # every EFFECTIVE transition (after the ANDed-sources verdict flips),
+        # outside the device-table lock. The DRA driver subscribes here so a
+        # dead chip leaves the published ResourceSlice on the same event
+        # that marks it Unhealthy on the ListAndWatch stream — without a
+        # second, driftable health watcher.
+        self._health_listener = health_listener
+        # serializes listener deliveries; see set_devices_health
+        self._listener_lock = threading.Lock()
         # CDI names are only valid when this resource's spec file was written
         self.cdi_enabled = cdi_enabled
         self.resource_suffix = resource_suffix
@@ -126,12 +136,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         vfio node is invisible to a config-space read and vice versa), so
         their verdicts are ANDed rather than last-writer-wins.
         """
+        touched = []
         with self._cond:
             changed = False
             for dev_id in device_ids:
                 dev = self._devs.get(dev_id)
                 if dev is None:
                     continue
+                touched.append(dev_id)
                 sources = self._health_sources.setdefault(dev_id, {})
                 sources[source] = healthy
                 state = api.HEALTHY if all(sources.values()) else api.UNHEALTHY
@@ -141,6 +153,27 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             if changed:
                 self._version += 1
                 self._cond.notify_all()
+        if touched and self._health_listener is not None:
+            # Outside _cond: the listener may do slow work (the DRA driver
+            # republishes over HTTP) and must never stall ListAndWatch
+            # wakeups. Deliveries are serialized under _listener_lock and
+            # re-read the CURRENT effective health inside it — sending the
+            # per-call delta instead would let two racing verdicts arrive
+            # out of order and leave the listener's state permanently
+            # inverted vs the device table. Every touched id is delivered
+            # (not just table transitions): a plugin rebuilt on rediscovery
+            # starts all-HEALTHY, so a chip that recovered while pruned
+            # produces NO transition on the first probe poll — only the
+            # unconditional snapshot reconciles the listener. The listener
+            # treats repeats as no-ops.
+            with self._listener_lock:
+                with self._cond:
+                    current = {i: self._devs[i].health == api.HEALTHY
+                               for i in touched if i in self._devs}
+                try:
+                    self._health_listener(current)
+                except Exception as exc:
+                    log.error("health listener failed: %s", exc)
 
     def _snapshot(self) -> Tuple[int, List[pb.Device]]:
         with self._cond:

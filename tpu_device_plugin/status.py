@@ -105,6 +105,7 @@ class StatusServer:
                                        and d.registration_error is None),
                 "registration_error": d.registration_error,
                 "prepared_claims": d.prepared_claim_count(),
+                "unhealthy_devices": d.unhealthy_devices(),
             }
         return out
 
@@ -171,5 +172,10 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_registered gauge",
                 f"tpu_plugin_dra_registered "
                 f"{int(s['dra']['kubelet_registered'])}",
+                "# HELP tpu_plugin_dra_unhealthy_devices Devices pruned "
+                "from the ResourceSlice by health.",
+                "# TYPE tpu_plugin_dra_unhealthy_devices gauge",
+                f"tpu_plugin_dra_unhealthy_devices "
+                f"{len(s['dra']['unhealthy_devices'])}",
             ]
         return "\n".join(lines) + "\n"

@@ -1,0 +1,89 @@
+"""Per-generation TPU datasheet peaks: the physics check for every perf claim.
+
+Round 3 published a 289 TFLOP/s bf16 microbench from a chip whose own
+`device_kind` said "TPU v5 lite" (peak ~197): the relay-noise-corrupted
+timing sailed into BASELINE.md because nothing compared measurements against
+what the silicon can do. This table is that comparison. Numbers are the
+public Google Cloud TPU datasheet figures (peak dense bf16 TFLOP/s and HBM
+bandwidth GB/s per chip); `check()` flags any measurement above
+`SUSPECT_FACTOR` x peak as a timing artifact, and the validator refuses to
+record such a run as ok (VERDICT r3 item 1).
+
+The reference plugin has no analogue (it runs no compute); this serves the
+repo's own north-star metric (BASELINE.md Target): guest-side perf numbers
+must be physically honest before they are comparable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+# A real chip can transiently clock-boost measurement noise a few percent
+# above nominal; anything past this factor is a broken estimator, not a
+# fast chip.
+SUSPECT_FACTOR = 1.05
+
+
+@dataclass(frozen=True)
+class Peak:
+    generation: str        # canonical short name: v2/v3/v4/v5e/v5p/v6e
+    bf16_tflops: float     # peak dense bf16 TFLOP/s per chip
+    hbm_gbps: float        # peak HBM bandwidth GB/s per chip
+
+
+# Public datasheet values (cloud.google.com/tpu/docs/system-architecture):
+# per-chip peak dense bf16 and HBM BW.
+PEAKS = {
+    "v2": Peak("v2", 45.0, 700.0),
+    "v3": Peak("v3", 123.0, 900.0),
+    "v4": Peak("v4", 275.0, 1228.0),
+    "v5e": Peak("v5e", 197.0, 819.0),
+    "v5p": Peak("v5p", 459.0, 2765.0),
+    "v6e": Peak("v6e", 918.0, 1640.0),
+}
+
+
+def lookup(device_kind: str) -> Optional[Peak]:
+    """Map a PJRT `device_kind` string to its datasheet peak.
+
+    Observed kinds: "TPU v2".."TPU v4", "TPU v5 lite" (v5e), "TPU v5"/"TPU
+    v5p" (v5p), "TPU v6 lite"/"TPU v6e" (Trillium). Unknown kinds (CPU,
+    future generations) return None — no peak means no physics check, never
+    a false veto.
+    """
+    kind = (device_kind or "").lower()
+    if "tpu" not in kind:
+        return None
+    if "v6" in kind:
+        return PEAKS["v6e"]
+    if "v5" in kind:
+        if "lite" in kind or "v5e" in kind:
+            return PEAKS["v5e"]
+        return PEAKS["v5p"]
+    for gen in ("v4", "v3", "v2"):
+        if gen in kind:
+            return PEAKS[gen]
+    return None
+
+
+def check(device_kind: str, tflops: float = 0.0, gbps: float = 0.0):
+    """Physics-check measurements against the chip's datasheet peak.
+
+    Returns (peak or None, suspect: bool, reason: str). suspect=True means
+    a measurement exceeded SUSPECT_FACTOR x peak — the number is a timing
+    artifact and must not be recorded as a valid result.
+    """
+    peak = lookup(device_kind)
+    if peak is None:
+        return None, False, ""
+    reasons = []
+    if tflops > SUSPECT_FACTOR * peak.bf16_tflops:
+        reasons.append(
+            f"measured {tflops:.1f} TFLOP/s > {SUSPECT_FACTOR:g}x the "
+            f"{peak.generation} datasheet peak {peak.bf16_tflops:g}")
+    if gbps > SUSPECT_FACTOR * peak.hbm_gbps:
+        reasons.append(
+            f"measured {gbps:.1f} GB/s > {SUSPECT_FACTOR:g}x the "
+            f"{peak.generation} datasheet HBM peak {peak.hbm_gbps:g}")
+    return peak, bool(reasons), "; ".join(reasons)

@@ -46,12 +46,14 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         health_shim=None,
         cdi_enabled: bool = False,
         cdi_uuids: frozenset = frozenset(),
+        health_listener=None,
     ) -> None:
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
         self.cdi_uuids = cdi_uuids
         super().__init__(cfg, type_name, registry, devices=[],
-                         health_shim=health_shim, cdi_enabled=cdi_enabled)
+                         health_shim=health_shim, cdi_enabled=cdi_enabled,
+                         health_listener=health_listener)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
