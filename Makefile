@@ -33,7 +33,7 @@ test:
 # additions (was 92.1% of 3421 lines before them). The 0%-covered __main__
 # stubs and all three generated *_pb2 modules are inside that number, not
 # excluded.
-COV_MIN ?= 91
+COV_MIN ?= 92
 coverage:
 	$(PYTHON) scripts/stdlib_coverage.py --fail-under $(COV_MIN) \
 		--json-out coverage.json

@@ -10,13 +10,18 @@ round-trips. The reference publishes no numbers (SURVEY.md §6), so the
 baseline is this protocol's own recorded round-1 p50 (BENCH_r01.json):
 vs_baseline = round1_p50 / current_p50, >1.0 meaning faster than round 1.
 
-Methodology: the headline `value`/`vs_baseline` use the PLAIN overall
-median — the same estimator rounds 1-2 recorded — so the baseline ratio
-compares like against like. The build/CI host is a single shared CPU core,
-so wall-clock latency jitters with co-tenant load; `best_epoch_p50_us`
-(minimum of 4 epoch medians) is reported alongside as the achievable-
-latency estimate under transient interference, and p99 is over all samples
-(worst-case, not denoised).
+Methodology (round 4, VERDICT r3 item 8): the HEADLINE `value`/
+`vs_baseline` is now the load-insensitive HANDLER COMPUTE number — direct
+in-process servicer calls (GetPreferredAllocation + Allocate), no gRPC
+RTTs — because the wall-clock path on this single shared CPU core is
+hostage to co-tenant load (observed 804-1062 us same-code spread in round
+3, with two gRPC RTTs ~460-740 us of it). Its baseline is round 3's
+recorded handler measurement (41 us, BASELINE.md config 1):
+vs_baseline = 41.0 / current, >1.0 meaning faster than round 3. The full
+kubelet-visible wall path is still measured and reported alongside
+(`wall_p50_us`, `wall_vs_round1` against BENCH_r01's 820.3 us,
+`best_epoch_p50_us` = min of 4 epoch medians as the achievable-latency
+estimate, p99 over all samples).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -109,6 +114,46 @@ def _attach_path(stub, all_ids, alloc_size, iterations, warmup):
     return pref_us, attach_us
 
 
+def _handler_compute(plugin, all_ids, alloc_size, iterations=2000,
+                     warmup=100):
+    """Deterministic handler-compute medians via DIRECT servicer calls.
+
+    No sockets, no serialization round-trips, no scheduler handoffs: this
+    is the plugin's own CPU work on the attach path, the only number on a
+    shared core that round-over-round comparisons can trust. (Context is
+    None: the happy path never touches it.)"""
+    pref_req = pb.PreferredAllocationRequest(container_requests=[
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=all_ids, allocation_size=alloc_size)])
+    pref_us, alloc_us = [], []
+    for i in range(iterations + warmup):
+        t1 = time.perf_counter()
+        pref = plugin.GetPreferredAllocation(pref_req, None)
+        t2 = time.perf_counter()
+        alloc_req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(
+                devices_ids=list(pref.container_responses[0].deviceIDs))])
+        t3 = time.perf_counter()
+        resp = plugin.Allocate(alloc_req, None)
+        t4 = time.perf_counter()
+        assert len(resp.container_responses[0].devices) >= 1 + alloc_size
+        if i >= warmup:
+            pref_us.append((t2 - t1) * 1e6)
+            alloc_us.append((t4 - t3) * 1e6)
+    # cold-path preferred allocation: the memo cache cleared every call, so
+    # the number reflects a first-seen availability set (full box scan)
+    cold_us = []
+    for i in range(iterations // 4 + warmup // 4):
+        plugin._pref_cache.clear()
+        t1 = time.perf_counter()
+        plugin.GetPreferredAllocation(pref_req, None)
+        t2 = time.perf_counter()
+        if i >= warmup // 4:
+            cold_us.append((t2 - t1) * 1e6)
+    return (statistics.median(pref_us), statistics.median(alloc_us),
+            statistics.median(cold_us))
+
+
 def run_config1(root):
     """The headline config-1 measurement on an 8-chip v5e host."""
     host = _build_host(root, 8)
@@ -127,6 +172,8 @@ def run_config1(root):
     with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
         stub = api.DevicePluginStub(ch)
         pref_us, attach_us = _attach_path(stub, all_ids, 4, ITERATIONS, WARMUP)
+    handler_pref_us, handler_alloc_us, handler_pref_cold_us = \
+        _handler_compute(plugin, all_ids, 4)
     server.stop(0)
 
     # secondary: vTPU partition Allocate p50 (mdev path with live sysfs
@@ -165,11 +212,25 @@ def run_config1(root):
     except (OSError, KeyError, ValueError, TypeError):
         pass  # keep the recorded constant if the file is gone/reshaped
     pref_p50 = statistics.median(pref_us)
+    # HEADLINE uses the COLD preferred-allocation path (memo cache cleared
+    # per call): round 3's 41 us baseline was measured without the cache, so
+    # a warm-hit headline would compare a ~1 us lookup against a 12 us scan
+    # and claim a speedup a real kubelet (changing availability between
+    # allocations) would rarely see. The warm number is reported alongside.
+    handler_us = handler_pref_cold_us + handler_alloc_us
+    # round 3's recorded handler-compute measurement (BASELINE.md config 1:
+    # preferred_allocation 12 us + allocate_response 29 us on this host)
+    round3_handler_us = 41.0
     return {
-        "metric": "vmi_attach_control_plane_p50",
-        "value": round(p50, 1),
+        "metric": "attach_handler_compute_p50",
+        "value": round(handler_us, 1),
         "unit": "us",
-        "vs_baseline": round(round1_p50_us / p50, 3),
+        "vs_baseline": round(round3_handler_us / handler_us, 3),
+        "handler_preferred_cold_us": round(handler_pref_cold_us, 1),
+        "handler_preferred_warm_us": round(handler_pref_us, 1),
+        "handler_allocate_us": round(handler_alloc_us, 1),
+        "wall_p50_us": round(p50, 1),
+        "wall_vs_round1": round(round1_p50_us / p50, 3),
         "preferred_allocation_p50_us": round(pref_p50, 1),
         "allocate_p50_us": round(p50 - pref_p50, 1),
         "p99_us": round(statistics.quantiles(attach_us, n=100)[98], 1),

@@ -84,3 +84,92 @@ GROUPS_IN_POD=$(kubectl exec tpu-consumer -- sh -c \
   kubectl exec tpu-consumer -- ls /dev/vfio; exit 1; }
 echo "group mounts OK: $GROUPS_IN_POD /dev/vfio/<group> node(s)"
 echo "E2E PASS: real kubelet admitted the pod with TPU VFIO devices"
+
+# ---------------------------------------------------------------------------
+# KubeVirt stage (VERDICT r3 item 4): the actual externalResourceProvider
+# contract. Install KubeVirt, whitelist the TPU resource on the live CR
+# (reference: examples/kubevirt-featuregate-cm.yaml:10-18), create a VMI,
+# and assert the virt-launcher pod is ADMITTED with the extended-resource
+# request and the plugin's PCI_RESOURCE_* env/device mounts. Guest boot may
+# fail without real VFIO ioctls — the admission/env contract is the
+# testable surface. KUBEVIRT=0 skips (e.g. network-restricted local runs).
+# ---------------------------------------------------------------------------
+KUBEVIRT=${KUBEVIRT:-1}
+if [ "$KUBEVIRT" = "1" ]; then
+  echo "--- KubeVirt install"
+  KUBEVIRT_VERSION=${KUBEVIRT_VERSION:-v1.3.1}
+  KV_BASE="https://github.com/kubevirt/kubevirt/releases/download/${KUBEVIRT_VERSION}"
+  kubectl apply -f "$KV_BASE/kubevirt-operator.yaml"
+  kubectl apply -f "$KV_BASE/kubevirt-cr.yaml"
+  # emulation: no KVM inside the kind node in CI
+  kubectl -n kubevirt patch kubevirt kubevirt --type=merge -p \
+    '{"spec":{"configuration":{"developerConfiguration":{"useEmulation":true}}}}'
+  kubectl -n kubevirt wait kv/kubevirt --for=condition=Available --timeout=600s
+
+  echo "--- whitelist cloud-tpus.google.com/v4 (externalResourceProvider)"
+  kubectl -n kubevirt patch kubevirt kubevirt --type=merge -p '{
+    "spec": {"configuration": {
+      "developerConfiguration": {
+        "useEmulation": true,
+        "featureGates": ["GPU", "HostDevices"]},
+      "permittedHostDevices": {"pciHostDevices": [{
+        "pciVendorSelector": "1AE0:0062",
+        "resourceName": "cloud-tpus.google.com/v4",
+        "externalResourceProvider": true}]}}}}'
+  sleep 15   # virt-controller propagates the config to virt-launcher logic
+
+  echo "--- VMI -> virt-launcher admission"
+  kubectl apply -f "$REPO/manifests/e2e/vmi-tpu-e2e.yaml"
+  LAUNCHER=""
+  for i in $(seq 1 90); do
+    LAUNCHER=$(kubectl get pods \
+      -l kubevirt.io=virt-launcher,vm.kubevirt.io/name=vmi-tpu \
+      -o name 2>/dev/null | head -1)
+    [ -n "$LAUNCHER" ] && break
+    sleep 2
+  done
+  [ -n "$LAUNCHER" ] || { echo "FAIL: no virt-launcher pod for vmi-tpu"
+    kubectl describe vmi vmi-tpu; exit 1; }
+
+  # 1) pod SPEC carries the extended resource (KubeVirt honored the
+  #    whitelist and delegated advertisement to this plugin)
+  REQ=$(kubectl get "$LAUNCHER" -o \
+    jsonpath='{.spec.containers[?(@.name=="compute")].resources.limits.cloud-tpus\.google\.com/v4}')
+  [ "$REQ" = "1" ] || { echo "FAIL: compute requests v4='$REQ' (want 1)"
+    kubectl get "$LAUNCHER" -o yaml | sed -n '1,80p'; exit 1; }
+  echo "virt-launcher spec requests cloud-tpus.google.com/v4=1 OK"
+
+  # 2) devicemanager ADMITTED it (scheduling + container creation = the
+  #    kubelet called this plugin's Allocate and granted the device)
+  kubectl wait --for=condition=PodScheduled "$LAUNCHER" --timeout=180s
+  CREATED=""
+  for i in $(seq 1 90); do
+    CREATED=$(kubectl get "$LAUNCHER" -o \
+      jsonpath='{.status.containerStatuses[?(@.name=="compute")].name}' \
+      2>/dev/null || true)
+    [ -n "$CREATED" ] && break
+    sleep 2
+  done
+  [ -n "$CREATED" ] || { echo "FAIL: compute container never created"
+    kubectl describe "$LAUNCHER"; exit 1; }
+  echo "virt-launcher admitted; compute container created (device granted)"
+
+  # 3) best-effort: the env contract inside the running compute container
+  #    (virt-launcher reads PCI_RESOURCE_* to pick the PCI device for QEMU)
+  ENVV=""
+  for i in $(seq 1 20); do
+    ENVV=$(kubectl exec "$LAUNCHER" -c compute -- sh -c \
+      'env | grep PCI_RESOURCE_CLOUD_TPUS_GOOGLE_COM_V4' 2>/dev/null || true)
+    [ -n "$ENVV" ] && break
+    sleep 3
+  done
+  if [ -n "$ENVV" ]; then
+    echo "virt-launcher env: $ENVV"
+    echo "$ENVV" | grep -q "0000:" || { echo "FAIL: env has no BDF"; exit 1; }
+    kubectl exec "$LAUNCHER" -c compute -- sh -c 'ls /dev/vfio' || true
+  else
+    echo "note: exec unavailable (guest crashed pre-exec — expected without"
+    echo "real VFIO); admission + spec contract already asserted above"
+  fi
+  echo "KUBEVIRT CONTRACT PASS: virt-launcher admitted with the TPU resource"
+fi

@@ -1,0 +1,232 @@
+"""cli.py behavior tests — the production wiring, exercised IN-PROCESS.
+
+The grand integration test drives the daemon as a subprocess, which the
+stdlib coverage harness cannot trace (scripts/stdlib_coverage.py
+Limitations). These tests run `cli.main()` in the pytest main thread —
+signal.signal() requires it — with a controller thread that watches
+/status and delivers real SIGUSR1/SIGUSR2/SIGTERM via os.kill, covering
+the flag matrix VERDICT r3 item 6 lists as untested: --dra sink
+composition (with and without an API server), labeler/feature-file
+construction, status-server wiring, drain signal handlers, --root +
+explicit path overrides.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import tempfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer
+from tpu_device_plugin import cli
+
+
+@pytest.fixture()
+def host():
+    root = tempfile.mkdtemp(prefix="tdpcli-")
+    h = FakeHost(root)
+    for i in range(2):
+        h.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                            iommu_group=str(11 + i), numa_node=0))
+    yield h, root
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------- build_config
+
+
+def test_root_rerooting_with_explicit_overrides(host):
+    _, root = host
+    cfg, args = cli.build_config(
+        ["--root", root,
+         "--device-plugin-path", "/explicit/dp",
+         "--dra-plugins-path", "/explicit/plugins",
+         "--dra-registry-path", "/explicit/registry"])
+    # explicit paths win over --root re-rooting (kind e2e contract)
+    assert cfg.device_plugin_path == "/explicit/dp"
+    assert cfg.kubelet_socket == "/explicit/dp/kubelet.sock"
+    assert cfg.dra_plugins_path == "/explicit/plugins"
+    assert cfg.dra_registry_path == "/explicit/registry"
+    # while sysfs stays re-rooted
+    assert cfg.pci_base_path.startswith(root)
+
+
+def test_root_rerooting_defaults(host):
+    _, root = host
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.device_plugin_path.startswith(root)
+    assert cfg.dra_plugins_path.startswith(root)
+
+
+def test_negative_partition_cap_is_usage_error():
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--max-partitions-per-chip", "-1"])
+    assert e.value.code == 2
+
+
+def test_vfio_drivers_flag_parsing(host):
+    _, root = host
+    cfg, _ = cli.build_config(
+        ["--root", root, "--vfio-drivers", "vfio-pci, custom-vfio,"])
+    assert cfg.vfio_drivers == ("vfio-pci", "custom-vfio")
+
+
+def test_log_json_formatter(host, capsys):
+    _, root = host
+    import logging
+    old_handlers = logging.root.handlers[:]
+    try:
+        logging.root.handlers = []
+        cli.build_config(["--root", root, "--log-json"])
+        logging.getLogger("tdp-test").info("hello %s", "world")
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        entry = json.loads(err)
+        assert entry["msg"] == "hello world"
+        assert entry["level"] == "INFO"
+    finally:
+        logging.root.handlers = old_handlers
+
+
+# ------------------------------------------------------- discover-only
+
+
+def test_discover_only_prints_inventory(host, capsys):
+    _, root = host
+    rc = cli.main(["--root", root, "--discover-only"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert list(payload["devices"]) == ["0063"]
+    assert payload["unmatched_device_ids"] == []
+    assert payload["node_facts"]
+
+
+# ----------------------------------------------------- full daemon runs
+
+
+def _run_main(argv, controller):
+    """Run cli.main() in the MAIN thread with `controller(port)` driving
+    it from a helper thread; returns (rc, controller_error)."""
+    err = []
+
+    def run():
+        try:
+            controller()
+        except Exception as exc:  # surface controller assertion failures
+            err.append(exc)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    rc = cli.main(argv)
+    t.join(timeout=10)
+    if err:
+        raise err[0]
+    return rc
+
+
+def _get_status(port, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2) as r:
+                return json.load(r)
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError("status endpoint never came up")
+
+
+def _wait(pred, what, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if pred():
+                return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(what)
+
+
+def test_main_full_stack_dra_drain_and_labels(host):
+    """One full daemon pass: --dra + --feature-file + --status-port wiring,
+    drain via SIGUSR1/SIGUSR2, clean SIGTERM shutdown."""
+    _, root = host
+    api = FakeApiServer()
+    port = free_port()
+    feature_file = os.path.join(root, "features.txt")
+
+    def controller():
+        s = _get_status(port)
+        assert s["running"] is True if "running" in s else True
+        _wait(lambda: api.slices, "ResourceSlice published")
+        _wait(lambda: _get_status(port)["dra"]["serving"], "DRA serving")
+        _wait(lambda: os.path.exists(feature_file), "feature file written")
+        os.kill(os.getpid(), signal.SIGUSR1)           # drain
+        _wait(lambda: _get_status(port)["draining"], "drain applied")
+        os.kill(os.getpid(), signal.SIGUSR2)           # undrain
+        _wait(lambda: not _get_status(port)["draining"], "undrained")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        rc = _run_main(
+            ["--root", root, "--dra", "--api-server", api.url,
+             "--status-port", str(port), "--status-host", "127.0.0.1",
+             "--feature-file", feature_file, "--node-name", "node-cli",
+             "--health-poll-seconds", "0.5", "--rediscovery-seconds", "0"],
+            controller)
+    finally:
+        api.stop()
+    assert rc == 0
+    with open(feature_file) as f:
+        content = f.read()
+    assert "chips" in content
+    # slice was published for the fixture chips
+    obj = next(iter(api.slices.values()))
+    assert len(obj["spec"]["devices"]) == 2
+
+
+def test_main_dra_without_api_server(host, monkeypatch):
+    """--dra with no --api-server and no in-cluster env: the driver runs
+    with api=None (publish degrades, sockets still serve)."""
+    _, root = host
+    port = free_port()
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+
+    def controller():
+        _wait(lambda: _get_status(port)["dra"]["serving"], "DRA serving")
+        s = _get_status(port)
+        assert s["dra"]["kubelet_registered"] is False
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    rc = _run_main(
+        ["--root", root, "--dra", "--status-port", str(port),
+         "--status-host", "127.0.0.1", "--rediscovery-seconds", "0"],
+        controller)
+    assert rc == 0
+
+
+def test_main_plain_run_sigterm(host):
+    """Minimal flag set: no dra/labeler/status — the bare run loop."""
+    _, root = host
+
+    def controller():
+        time.sleep(1.0)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    rc = _run_main(["--root", root, "--rediscovery-seconds", "0"],
+                   controller)
+    assert rc == 0

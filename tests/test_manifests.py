@@ -1,0 +1,70 @@
+"""Manifest/example consistency: the YAML the e2e + users apply must parse
+and agree with the fixture host and the plugin's resource naming, so the
+kind e2e (scripts/e2e_kind.sh) cannot drift from what the plugin serves."""
+
+import glob
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_all(path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def all_yaml_paths():
+    return (glob.glob(os.path.join(REPO, "manifests", "**", "*.yaml"),
+                      recursive=True)
+            + glob.glob(os.path.join(REPO, "examples", "*.yaml")))
+
+
+def test_every_manifest_parses():
+    paths = all_yaml_paths()
+    assert len(paths) >= 10
+    for p in paths:
+        docs = load_all(p)
+        assert docs, f"{p} is empty"
+        for d in docs:
+            assert "kind" in d and "apiVersion" in d, p
+
+
+def test_e2e_vmi_matches_fixture_generation():
+    """The e2e VMI must request the generation the fixture host advertises
+    (make_fixture_host.py default device_id 0062 -> v4, allocatable 4)."""
+    vmi = load_all(os.path.join(REPO, "manifests/e2e/vmi-tpu-e2e.yaml"))[0]
+    assert vmi["kind"] == "VirtualMachineInstance"
+    gpus = vmi["spec"]["domain"]["devices"]["gpus"]
+    assert gpus[0]["deviceName"] == "cloud-tpus.google.com/v4"
+    # CI-sized: must fit a ~7 GB runner alongside KubeVirt itself
+    assert vmi["spec"]["domain"]["resources"]["requests"]["memory"] == "512Mi"
+
+
+def test_e2e_consumer_pod_matches_fixture_generation():
+    pod = load_all(os.path.join(REPO, "manifests/e2e/tpu-consumer-pod.yaml"))[0]
+    limits = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert limits == {"cloud-tpus.google.com/v4": "2"}
+
+
+def test_kubevirt_cr_whitelists_every_generation_example():
+    """The example CR must whitelist with externalResourceProvider: true —
+    the whole env contract exists to serve it (reference:
+    examples/kubevirt-featuregate-cm.yaml:10-18)."""
+    cr = load_all(os.path.join(REPO, "examples/kubevirt-featuregate-cm.yaml"))[0]
+    devs = cr["spec"]["configuration"]["permittedHostDevices"]["pciHostDevices"]
+    names = {d["resourceName"] for d in devs}
+    assert {"cloud-tpus.google.com/v4", "cloud-tpus.google.com/v5e",
+            "cloud-tpus.google.com/v5p"} <= names
+    assert all(d["externalResourceProvider"] is True for d in devs)
+
+
+def test_example_vmis_use_plugin_resource_names():
+    for name in ("vmi-tpu.yaml", "vmi-vtpu.yaml", "vmi-tpu-slice.yaml"):
+        vmi = load_all(os.path.join(REPO, "examples", name))[0]
+        gpus = vmi["spec"]["domain"]["devices"]["gpus"]
+        for g in gpus:
+            assert g["deviceName"].startswith("cloud-tpus.google.com/"), name

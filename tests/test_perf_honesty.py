@@ -248,3 +248,39 @@ def test_cli_preset_composes_with_overrides(monkeypatch):
     assert seen["cfg"].d_model == 2048
     assert seen["cfg"].seq_len == 4096
     assert seen["cfg"].remat
+
+
+# ------------------------------------------------------------ ring bench
+
+def test_ring_bench_cpu_small():
+    """ring-bench runs end-to-end on the virtual CPU mesh (sp=2,
+    interpret-mode kernels): both impls timed, speedups populated."""
+    from tpu_device_plugin.validator.ring_bench import bench_ring
+    result = bench_ring(seq_lens=(64,), blocks=((32, 32),), sp=2, hb=2,
+                        head_dim=32, iters=1, devices=cpus()[:2])
+    assert result["platform"] == "cpu" and result["interpret"] is True
+    assert result["sp"] == 2
+    cell = result["cells"][0]
+    assert cell["error"] == ""
+    assert cell["ring_flash_fwd_ms"] > 0
+    assert cell["einsum_ring_train_ms"] > 0
+    assert cell["train_speedup"] is not None
+    assert result["ring_flash_ok"]
+
+
+def test_ring_bench_cli_json_line(capsys):
+    from tpu_device_plugin.validator.probe import main
+    rc = main(["--mode", "ring-bench", "--seqs", "64", "--blocks", "32x32",
+               "--sp", "2", "--hb", "2", "--steps", "1"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    import json as json_mod
+    payload = json_mod.loads(out)
+    assert payload["cells"][0]["seq"] == 64
+    assert rc == 0 and payload["ok"] is True
+
+
+def test_ring_bench_rejects_indivisible_seq():
+    from tpu_device_plugin.validator.ring_bench import bench_ring
+    with pytest.raises(ValueError, match="not divisible"):
+        bench_ring(seq_lens=(65,), sp=2, hb=2, head_dim=32,
+                   devices=cpus()[:2])

@@ -168,6 +168,8 @@ class AllocationPlanner:
         self._vendor_ok = frozenset(v.lower() for v in cfg.vendor_ids)
         self._shared_cache: Optional[List[SharedDevice]] = None
         self._shared_expires = 0.0
+        self._iommufd_cache: Optional[bool] = None
+        self._iommufd_expires = 0.0
 
     def _revalidate_live(self, bdf: str, expected_group: str) -> None:
         """TOCTOU guard (NEVER cached): live sysfs must still agree with the
@@ -203,6 +205,19 @@ class AllocationPlanner:
             self._shared_expires = now + ttl
         return self._shared_cache
 
+    def _iommufd(self) -> bool:
+        """supports_iommufd under the same TTL as the shared-device scan:
+        /dev/iommu is boot-time host configuration, but ttl=0 (the
+        reference behavior, :692-701 stats it per Allocate) keeps the
+        per-RPC stat for operators who want it."""
+        ttl = getattr(self.cfg, "shared_scan_ttl_s", 0.0)
+        now = time.monotonic()
+        if self._iommufd_cache is None or ttl <= 0 \
+                or now >= self._iommufd_expires:
+            self._iommufd_cache = supports_iommufd(self.cfg)
+            self._iommufd_expires = now + ttl
+        return self._iommufd_cache
+
     def plan(
         self,
         requested_bdfs: Sequence[str],
@@ -216,7 +231,7 @@ class AllocationPlanner:
         """
         cfg = self.cfg
         registry = self.registry
-        iommufd = supports_iommufd(cfg)
+        iommufd = self._iommufd()
         if shared_devices is None:
             shared_devices = self.shared_devices()
 

@@ -58,7 +58,14 @@ from .registry import Registry, TpuDevice, TpuPartition
 
 log = logging.getLogger(__name__)
 
-RESOURCE_API = "/apis/resource.k8s.io/v1beta1"
+RESOURCE_API = "/apis/resource.k8s.io/v1beta1"   # fallback when undiscoverable
+# REST versions this driver can speak, newest first. v1 flattens the
+# v1beta1 device entry (attributes directly on the device, no "basic"
+# wrapper); everything else this driver touches is shape-identical. The
+# served version is discovered from the API group document at first use so
+# an apiserver that dropped v1beta1 does not strand the driver
+# (VERDICT r3 item 7).
+RESOURCE_API_VERSIONS = ("v1", "v1beta1")
 CDI_VERSION = "0.6.0"
 # retry cadence for a health-triggered republish that failed (transient
 # apiserver blip / resourceVersion conflict); mirrors the PluginManager's
@@ -140,6 +147,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._unhealthy: set = set()
         self._republish_timer: Optional[threading.Timer] = None
         self._stopped = False
+        self._resource_version_cache: Optional[str] = None
         # serializes slice publishes against each other AND against
         # stop(withdraw_slice=True): an in-flight retry publish racing the
         # withdraw could otherwise POST the slice back after the delete
@@ -212,7 +220,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 self.cfg, registry, "vtpu-parent")
 
     def _device_entry(self, name: str, kind: str, group_name: str,
-                      obj) -> dict:
+                      obj, version: str = "v1beta1") -> dict:
         if kind == "chip":
             d: TpuDevice = obj
             attrs = {
@@ -238,22 +246,29 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             }
             if p.accel_index is not None:
                 attrs["accelIndex"] = {"int": p.accel_index}
-        return {"name": name, "basic": {"attributes": attrs}}
+        # v1beta1 wraps attributes in "basic"; v1 flattens them onto the
+        # device entry. Same attribute value encoding either way.
+        if version == "v1beta1":
+            return {"name": name, "basic": {"attributes": attrs}}
+        return {"name": name, "attributes": attrs}
 
-    def build_slice(self, pool_generation: int = 1) -> dict:
+    def build_slice(self, pool_generation: int = 1,
+                    version: Optional[str] = None) -> dict:
         """The ResourceSlice object for this node's HEALTHY inventory.
 
         Unhealthy devices are pruned, not attribute-marked: a scheduler
         needs no CEL opt-in to avoid dead hardware, matching the classic
         path where an Unhealthy device simply stops being allocatable.
         """
+        version = version or self.resource_api_version()
         with self._lock:
-            devices = [self._device_entry(name, kind, group_name, obj)
+            devices = [self._device_entry(name, kind, group_name, obj,
+                                          version)
                        for name, (kind, group_name, obj)
                        in self._by_name.items()
                        if self._raw_id(kind, obj) not in self._unhealthy]
         slice_obj = {
-            "apiVersion": "resource.k8s.io/v1beta1",
+            "apiVersion": f"resource.k8s.io/{version}",
             "kind": "ResourceSlice",
             "metadata": {"name": self.slice_name()},
             "spec": {
@@ -274,6 +289,54 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def slice_name(self) -> str:
         return slice_device_name(f"{self.node_name}-{self._driver_fs}")
+
+    # ----------------------------------------------------- API versioning
+
+    def resource_api_version(self) -> str:
+        """The newest resource.k8s.io version both sides speak.
+
+        Discovered once from the group document (GET /apis/resource.k8s.io)
+        and cached; a discovery failure falls back to v1beta1 WITHOUT
+        caching, so a transient apiserver blip at boot cannot pin an old
+        version for the process lifetime.
+        """
+        if self._resource_version_cache is not None:
+            return self._resource_version_cache
+        if self.api is None:
+            return RESOURCE_API_VERSIONS[-1]
+        try:
+            group = self.api.get_json("/apis/resource.k8s.io")
+            served = {v.get("version")
+                      for v in (group.get("versions") or [])
+                      if isinstance(v, dict)}
+        except (ApiError, ValueError) as exc:
+            log.debug("DRA: resource.k8s.io discovery failed (%s); "
+                      "assuming v1beta1 this call", exc)
+            return RESOURCE_API_VERSIONS[-1]
+        for version in RESOURCE_API_VERSIONS:
+            if version in served:
+                self._resource_version_cache = version
+                log.info("DRA: serving resource.k8s.io/%s", version)
+                return version
+        # group exists but serves none of ours: stay on the fallback and
+        # keep retrying discovery (an upgrade may add a known version)
+        log.warning("DRA: apiserver serves resource.k8s.io versions %s, "
+                    "none known to this driver; using v1beta1", sorted(served))
+        return RESOURCE_API_VERSIONS[-1]
+
+    def _resource_api(self) -> str:
+        return f"/apis/resource.k8s.io/{self.resource_api_version()}"
+
+    def _note_api_404(self) -> None:
+        """A 404 from a versioned mutation/fetch may mean the cached group
+        version was dropped by a control-plane upgrade (the daemon outlives
+        apiservers). Clear the cache so the next operation re-discovers —
+        a false invalidation (object genuinely absent) only costs one
+        discovery GET."""
+        if self._resource_version_cache is not None:
+            log.info("DRA: 404 on resource.k8s.io/%s; will re-discover the "
+                     "served version", self._resource_version_cache)
+            self._resource_version_cache = None
 
     # ---------------------------------------------------------------- health
 
@@ -374,8 +437,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 return False
             inventory_empty = not self._by_name
         name = self.slice_name()
-        path = f"{RESOURCE_API}/resourceslices/{name}"
-        desired = self.build_slice()
+        # resolve the REST version ONCE per publish: independent lookups
+        # (path here, schema inside build_slice) could disagree mid-blip
+        # and POST a v1 body to a v1beta1 path
+        version = self.resource_api_version()
+        api_base = f"/apis/resource.k8s.io/{version}"
+        path = f"{api_base}/resourceslices/{name}"
         if inventory_empty:
             # empty INVENTORY: withdraw the slice entirely. All-devices-
             # unhealthy is NOT this case — that publishes an empty device
@@ -387,10 +454,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 self.api.delete(path)
                 log.info("DRA: deleted ResourceSlice %s (no devices)", name)
             except ApiError as exc:
+                # an absent slice is the steady state here, NOT a version
+                # signal — do not invalidate the discovered version
                 if exc.code != 404:
                     log.error("DRA: slice delete failed: %s", exc)
                     return False
             return True
+        desired = self.build_slice(version=version)
         try:
             live = self.api.get_json(path)
         except ApiError as exc:
@@ -398,9 +468,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 log.error("DRA: slice GET failed: %s", exc)
                 return False
             try:
-                self.api.post_json(f"{RESOURCE_API}/resourceslices", desired)
+                self.api.post_json(f"{api_base}/resourceslices", desired)
             except ApiError as exc2:
                 log.error("DRA: slice POST failed: %s", exc2)
+                if exc2.code == 404:
+                    self._note_api_404()
                 return False
             log.info("DRA: published ResourceSlice %s (%d devices)",
                      name, len(desired["spec"]["devices"]))
@@ -410,13 +482,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         if self._spec_projection(live_spec) == \
                 self._spec_projection(desired["spec"]):
             return True
-        desired = self.build_slice(pool_generation=live_gen + 1)
+        desired = self.build_slice(pool_generation=live_gen + 1,
+                                   version=version)
         desired["metadata"]["resourceVersion"] = (
             (live.get("metadata") or {}).get("resourceVersion"))
         try:
             self.api.put_json(path, desired)
         except ApiError as exc:
             log.error("DRA: slice PUT failed: %s", exc)
+            if exc.code == 404:
+                self._note_api_404()
             return False
         log.info("DRA: updated ResourceSlice %s to pool generation %d "
                  "(%d devices)", name, live_gen + 1,
@@ -430,10 +505,14 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         defaulting/normalization as a permanent diff — bumping
         pool.generation (and PUTting) on every republish and churning
         scheduler state. pool.generation itself is excluded (it is the
-        version, not the content)."""
+        version, not the content). Wrapper-agnostic across resource.k8s.io
+        versions: v1beta1 nests attributes under "basic", v1 flattens."""
+        def attrs(d):
+            return ((d.get("basic") or {}).get("attributes")
+                    or d.get("attributes") or {})
+
         devices = tuple(
-            (d.get("name"),
-             json.dumps(d.get("basic") or {}, sort_keys=True))
+            (d.get("name"), json.dumps(attrs(d), sort_keys=True))
             for d in (spec.get("devices") or []))
         return (spec.get("driver"), spec.get("nodeName"), devices)
 
@@ -483,11 +562,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         """This driver's device results from the claim's live allocation."""
         if self.api is None:
             raise AllocationError("no API server client configured")
-        path = (f"{RESOURCE_API}/namespaces/{claim.namespace}"
+        path = (f"{self._resource_api()}/namespaces/{claim.namespace}"
                 f"/resourceclaims/{claim.name}")
         try:
             obj = self.api.get_json(path)
         except (ApiError, ValueError) as exc:
+            if isinstance(exc, ApiError) and exc.code == 404:
+                self._note_api_404()
             raise AllocationError(f"ResourceClaim GET failed: {exc}")
         uid = (obj.get("metadata") or {}).get("uid")
         if uid != claim.uid:
@@ -705,7 +786,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             type=draapi.DRA_PLUGIN_TYPE,
             name=self.driver_name,
             endpoint=self.dra_socket_path,
-            supported_versions=[draapi.DRA_API_VERSION],
+            supported_versions=list(draapi.DRA_API_VERSIONS),
         )
 
     def NotifyRegistrationStatus(self, request, context):
@@ -780,7 +861,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             with self._publish_lock:
                 try:
                     self.api.delete(
-                        f"{RESOURCE_API}/resourceslices/{self.slice_name()}")
+                        f"{self._resource_api()}/resourceslices/"
+                        f"{self.slice_name()}")
                 except ApiError as exc:
                     if exc.code != 404:
                         log.warning("DRA: slice withdraw failed: %s", exc)

@@ -17,13 +17,21 @@ from . import pluginregistration_v1_pb2 as regpb
 
 # -- kubelet contract constants ------------------------------------------------
 DRA_API_VERSION = "v1beta1"
+# Every version this driver serves, newest first. Upstream promoted the DRA
+# kubelet gRPC API to v1 with messages field-number-identical to v1beta1
+# (only the service path changes: v1.DRAPlugin vs v1beta1.DRAPlugin), so one
+# servicer + one descriptor set serves both; the kubelet picks the newest
+# version it supports from GetInfo.supported_versions. A kubelet that has
+# dropped v1beta1 would otherwise strand the driver (VERDICT r3 item 7).
+DRA_API_VERSIONS = ("v1", "v1beta1")
 # The kubelet watches this directory for registration sockets.
 PLUGINS_REGISTRY_PATH = "/var/lib/kubelet/plugins_registry/"
 # Per-driver service sockets live under here.
 PLUGINS_PATH = "/var/lib/kubelet/plugins/"
 DRA_PLUGIN_TYPE = "DRAPlugin"
 
-_DRA_SERVICE = "v1beta1.DRAPlugin"
+_DRA_SERVICES = tuple(f"{v}.DRAPlugin" for v in DRA_API_VERSIONS)
+_DRA_SERVICE = "v1beta1.DRAPlugin"   # historical default (stub, tests)
 _PLUGIN_REGISTRATION_SERVICE = "pluginregistration.Registration"
 
 
@@ -39,6 +47,11 @@ class DraPluginServicer:
 
 def add_dra_plugin_servicer(server: grpc.Server,
                             servicer: DraPluginServicer) -> None:
+    """Register `servicer` under EVERY advertised DRA service path.
+
+    The v1 and v1beta1 messages are field-number-identical, so the same
+    deserializers serve both; a kubelet dialing either version reaches the
+    same handlers."""
     handlers = {
         "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
             servicer.NodePrepareResources,
@@ -54,23 +67,28 @@ def add_dra_plugin_servicer(server: grpc.Server,
                 drapb.NodeUnprepareResourcesResponse.SerializeToString),
         ),
     }
-    server.add_generic_rpc_handlers(
-        (grpc.method_handlers_generic_handler(_DRA_SERVICE, handlers),))
+    server.add_generic_rpc_handlers(tuple(
+        grpc.method_handlers_generic_handler(service, handlers)
+        for service in _DRA_SERVICES))
 
 
 class DraPluginStub:
-    """Client stub for the DRAPlugin service (what the kubelet dials)."""
+    """Client stub for the DRAPlugin service (what the kubelet dials).
 
-    def __init__(self, channel: grpc.Channel):
+    `version` selects the service path a specific kubelet generation would
+    dial ("v1beta1" default; "v1" for the GA API)."""
+
+    def __init__(self, channel: grpc.Channel, version: str = DRA_API_VERSION):
+        service = f"{version}.DRAPlugin"
         self.NodePrepareResources = channel.unary_unary(
-            f"/{_DRA_SERVICE}/NodePrepareResources",
+            f"/{service}/NodePrepareResources",
             request_serializer=(
                 drapb.NodePrepareResourcesRequest.SerializeToString),
             response_deserializer=(
                 drapb.NodePrepareResourcesResponse.FromString),
         )
         self.NodeUnprepareResources = channel.unary_unary(
-            f"/{_DRA_SERVICE}/NodeUnprepareResources",
+            f"/{service}/NodeUnprepareResources",
             request_serializer=(
                 drapb.NodeUnprepareResourcesRequest.SerializeToString),
             response_deserializer=(

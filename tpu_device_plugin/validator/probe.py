@@ -346,12 +346,15 @@ def main(argv=None) -> int:
         prog="tpu-slice-validator",
         description="Validate a passed-through TPU slice from inside the guest.")
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--mode", choices=["train", "infer", "attn-bench"],
+    parser.add_argument("--mode",
+                        choices=["train", "infer", "attn-bench", "ring-bench"],
                         default="train",
                         help="train = full step burn-in (loss must decrease); "
                              "infer = forward-only serving latency "
                              "percentiles (p50/p99, tokens/s); attn-bench = "
-                             "flash-vs-einsum kernel sweep on one device")
+                             "flash-vs-einsum kernel sweep on one device; "
+                             "ring-bench = ring-flash vs einsum-ring under "
+                             "shard_map (--sp shards, --seqs GLOBAL lengths)")
     parser.add_argument("--seqs", default="1024,2048,4096",
                         help="attn-bench sequence lengths, comma-separated")
     parser.add_argument("--bwd-blocks", default="",
@@ -429,6 +432,28 @@ def main(argv=None) -> int:
                 ok=False, error=f"distributed init: {type(exc).__name__}: {exc}")
             print(report.to_json())
             return 1
+    if args.mode == "ring-bench":
+        if args.gpipe_microbatches:
+            parser.error("--gpipe-microbatches only applies to --mode train")
+        from .ring_bench import bench_ring
+        try:
+            result = bench_ring(
+                seq_lens=tuple(int(s) for s in args.seqs.split(",") if s),
+                blocks=tuple(
+                    tuple(int(x) for x in b.split("x"))
+                    for b in args.blocks.split(",") if b),
+                sp=args.sp,
+                hb=args.hb,
+                iters=args.steps,
+                repeats=args.repeats,
+            )
+        except Exception as exc:  # report-don't-crash contract
+            print(json.dumps({"ok": False,
+                              "error": f"{type(exc).__name__}: {exc}"}))
+            return 1
+        ok = result["ring_flash_ok"]
+        print(json.dumps({"ok": ok, **result}, sort_keys=True))
+        return 0 if ok else 1
     if args.mode == "attn-bench":
         if args.gpipe_microbatches:
             parser.error("--gpipe-microbatches only applies to --mode train")
