@@ -160,6 +160,14 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 # lse_b = NEG_INF and contribute exactly zero.
 
 
+# Forward block size for the Pallas kernel inside each ring step. 128x128
+# matches the single-device flash forward default (the hardware sweep found
+# forward insensitive to 128-vs-256 at these shapes, bwd tuned separately
+# via flash_attention.DEFAULT_BWD_BLOCK); ring-bench (--mode ring-bench)
+# re-measures this cell so the choice stays evidence-backed per round.
+RING_STEP_BLOCK = (128, 128)
+
+
 def _step_mode(src, my_idx):
     """0 = skip (future block), 1 = causal (own block), 2 = full (past)."""
     return jnp.where(src > my_idx, 0, jnp.where(src == my_idx, 1, 2))

@@ -160,7 +160,8 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
         # einsum inner loop — Pallas interpretation is orders of magnitude
         # slower than XLA:CPU einsums and the two are merge-identical
         # (tests/test_flash_attention.py::test_ring_flash_matches_einsum_ring)
-        from .ring_attention import ring_attention, ring_flash_attention
+        from .ring_attention import (RING_STEP_BLOCK, ring_attention,
+                                     ring_flash_attention)
 
         def local_ring(q_, k_, v_):
             bl, _, hl, _ = q_.shape
@@ -169,9 +170,12 @@ def _attention(x: jax.Array, layer: Params, cfg: ModelConfig,
                                    _fold_heads(v_), dh ** -0.5,
                                    axis_name="sp")
             else:
+                # forward blocks from the tuned constant; backward blocks
+                # default to flash_attention.DEFAULT_BWD_BLOCK (256x256,
+                # hardware-swept) inside _ring_flash_bwd
                 o = ring_flash_attention(_fold_heads(q_), _fold_heads(k_),
                                          _fold_heads(v_), dh ** -0.5, "sp",
-                                         128, 128, False)
+                                         *RING_STEP_BLOCK, False)
             return _unfold_heads(o, bl, hl)
 
         out4 = jax.shard_map(
