@@ -50,6 +50,7 @@ dryrun:
 # a curated subset — see utils/README.md).
 update-pcidb:
 	curl -fsSL -o utils/pci.ids https://pci-ids.ucw.cz/v2.2/pci.ids
+	$(PYTHON) scripts/merge_tpu_pciids.py utils/pci.ids
 
 # Pin sha256 hashes into the image requirements (network required). The
 # hashed file is installed by BOTH the image build (cp311, distroless base)

@@ -226,6 +226,16 @@ def run_config1(root):
         "value": round(handler_us, 1),
         "unit": "us",
         "vs_baseline": round(round3_handler_us / handler_us, 3),
+        # vs_baseline was re-based in round 4: rounds 1-3 compared wall p50
+        # against round 1's 820 us wall capture (still emitted as
+        # wall_p50_us / wall_vs_round1); the headline ratio now divides the
+        # round-3 handler-compute constant below by this round's
+        # handler-compute. Ratios across BENCH_r0{1..3}.json are therefore
+        # NOT comparable with r04+ without this field.
+        "baseline_source": ("round-3 handler-compute constant 41.0 us "
+                            "(BASELINE.md config 1: preferred 12 us + "
+                            "allocate 29 us); wall_vs_round1 keeps the "
+                            "rounds-1-3 wall-clock basis"),
         "handler_preferred_cold_us": round(handler_pref_cold_us, 1),
         "handler_preferred_warm_us": round(handler_pref_us, 1),
         "handler_allocate_us": round(handler_alloc_us, 1),

@@ -1,0 +1,57 @@
+#!/bin/sh
+# Serialized real-TPU validator attempts (round 5).
+#
+# Protocol (docs/roadmap.md item 1, learned in rounds 1-4): exactly ONE TPU
+# process at a time, NEVER killed — SIGKILLing a mid-claim process wedges
+# the exclusive-claim PJRT relay, after which attempts fail naturally
+# (~40 min in backend init) until the relay recovers. Stop the loop
+# gracefully between attempts:
+#     touch /root/repo/.stop_tpu_attempts
+#
+# Round-5 change (VERDICT r4 item 1): launched in the round's first minutes
+# so a mid-round relay recovery is caught. On the first train success the
+# packed protocol runs inside the same window: infer, ring-bench
+# (ring-flash vs einsum ring, VERDICT r4 item 5), attn-bench under the
+# hardened estimator, then the sized-up --preset mfu capture (VERDICT r4
+# item 3; unbounded time — the relay compiles big models slowly).
+set -u
+cd /root/repo
+LOG=docs/tpu_attempts_r05.log
+if [ -f .stop_tpu_attempts ]; then
+    echo "=== sentinel .stop_tpu_attempts present at launch; not starting" \
+         "(rm it and relaunch to run) $(date -u +%FT%TZ) ===" >>"$LOG"
+fi
+N=0
+while [ ! -f .stop_tpu_attempts ]; do
+    N=$((N + 1))
+    echo "=== attempt $N start $(date -u +%FT%TZ) ===" >>"$LOG"
+    python -m tpu_device_plugin.validator --steps 20 \
+        >docs/validator_tpu_train_r05.json 2>>"$LOG"
+    rc=$?
+    tail -c 400 docs/validator_tpu_train_r05.json >>"$LOG"
+    echo "" >>"$LOG"
+    echo "=== attempt $N end rc=$rc $(date -u +%FT%TZ) ===" >>"$LOG"
+    if [ "$rc" -eq 0 ]; then
+        echo "SUCCESS: running packed round-5 protocol" >>"$LOG"
+        python -m tpu_device_plugin.validator --mode infer --steps 30 \
+            >docs/validator_tpu_infer_r05.json 2>>"$LOG"
+        echo "infer rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+        python -m tpu_device_plugin.validator --mode ring-bench \
+            --seqs 4096,8192 --blocks 128x128,256x256 --repeats 4 \
+            --steps 5 \
+            >docs/validator_tpu_ring_r05.json 2>>"$LOG"
+        echo "ring-bench rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+        python -m tpu_device_plugin.validator --mode attn-bench \
+            --seqs 2048,4096 --blocks 128x128 --repeats 4 --steps 5 \
+            >docs/validator_tpu_attn_r05.json 2>>"$LOG"
+        echo "attn-bench rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+        echo "mfu preset start $(date -u +%FT%TZ) (may take a while)" >>"$LOG"
+        python -m tpu_device_plugin.validator --preset mfu --steps 3 \
+            >docs/validator_tpu_mfu_r05.json 2>>"$LOG"
+        echo "mfu rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+        echo "=== loop exit $(date -u +%FT%TZ) ===" >>"$LOG"
+        exit 0
+    fi
+    sleep 30
+done
+echo "=== stopped by sentinel $(date -u +%FT%TZ) ===" >>"$LOG"

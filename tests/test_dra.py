@@ -159,7 +159,8 @@ def host():
 
 def make_driver(cfg, apiserver, node="node-a"):
     registry, generations = discover(cfg)
-    api = ApiClient(apiserver.url, token_path="/nonexistent-token")
+    api = (ApiClient(apiserver.url, token_path="/nonexistent-token")
+           if apiserver is not None else None)
     return DraDriver(cfg, registry, generations, node_name=node, api=api)
 
 
@@ -843,18 +844,32 @@ def test_failed_health_republish_arms_retry(host, apiserver):
     _, cfg = host
     driver = make_driver(cfg, apiserver)
     assert driver.publish_resource_slices()
-    api = driver.api
-    driver.api = None                            # publish now fails
+    real_publish = driver.publish_resource_slices
+    driver.publish_resource_slices = lambda: False   # apiserver blip
     try:
         assert driver.apply_health({"0000:00:04.0": False}) is True
         assert driver._republish_timer is not None
     finally:
-        driver.api = api
+        driver.publish_resource_slices = real_publish
     driver._republish_retry()                    # the timer's action
     obj = next(iter(apiserver.slices.values()))
     assert chip_name(0) not in [d["name"] for d in obj["spec"]["devices"]]
     assert driver._republish_timer is None       # success disarms
     driver.stop()
+
+
+def test_no_api_client_never_arms_republish_retry(host):
+    """Without an API client publish_resource_slices can never succeed, so
+    a failed health republish must NOT arm the 30 s retry — it would re-arm
+    and log 'no API client' every 30 s forever (ADVICE r4)."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver=None)
+    assert driver.api is None
+    try:
+        assert driver.apply_health({"0000:00:04.0": False}) is True
+        assert driver._republish_timer is None
+    finally:
+        driver.stop()
 
 
 def test_colliding_names_are_order_independent(host, apiserver):
@@ -882,14 +897,60 @@ def test_colliding_names_are_order_independent(host, apiserver):
     plain = slice_device_name(a.bdf)
     assert plain not in names.values()           # both suffixed
     name_b_full = names[b.bdf]
-    # drop A: B's published name must not change
+    # drop A: B's published name must not change (ADVICE r4 — a name is
+    # sticky for the process lifetime once published suffixed, so a claim
+    # allocated under name_b_full still resolves on a post-swap prepare
+    # retry)
     driver.set_inventory(reg([b]), {})
-    only = next(iter(driver._by_name))
-    assert only == slice_device_name(b.bdf)  # no collision -> plain label
-    # ...but the plain label of a FORMER collision pair never aliases:
-    # the old claim referenced name_b_full or A's suffixed name, neither of
-    # which resolves to B's new entry
-    assert name_b_full not in driver._by_name
+    assert set(driver._by_name) == {name_b_full}
+    # ...and even if A returns, names stay exactly as first published
+    driver.set_inventory(reg([a, b]), {})
+    assert {driver._raw_id(k, o): n
+            for n, (k, g, o) in driver._by_name.items()} == names
+    # ...and the guarantee survives a driver restart (sticky set persisted
+    # beside the claim checkpoint): a FRESH process that discovers only B
+    # must still publish B under its suffixed name
+    driver2 = DraDriver(cfg, reg([b]), {}, node_name="node-a", api=api)
+    assert set(driver2._by_name) == {name_b_full}
+
+
+def test_plain_label_never_inherited_by_different_device(host, apiserver):
+    """A plain label ever published for raw id X must never later name a
+    DIFFERENT raw id that sanitizes to the same label, even when the two
+    never coexist (no collision is ever seen): an old claim against the
+    label would silently resolve to the wrong device. The newcomer is
+    suffixed; the original owner keeps the plain label if it returns."""
+    from tpu_device_plugin.registry import Registry, TpuDevice
+    _, cfg = host
+
+    def reg(devs):
+        return Registry(
+            devices_by_model={"0063": tuple(devs)},
+            iommu_map={d.iommu_group: (d,) for d in devs},
+            bdf_to_group={d.bdf: d.iommu_group for d in devs},
+        )
+
+    a = TpuDevice(bdf="0000:00:04.0", device_id="0063", iommu_group="11",
+                  numa_node=0)
+    imposter = TpuDevice(bdf="0000:00:04_0", device_id="0063",
+                         iommu_group="12", numa_node=0)
+    plain = slice_device_name(a.bdf)
+    assert slice_device_name(imposter.bdf) == plain  # same sanitized label
+    api = ApiClient(apiserver.url, token_path="/nonexistent-token")
+    driver = DraDriver(cfg, reg([a]), {}, node_name="node-a", api=api)
+    assert set(driver._by_name) == {plain}          # A owns the plain label
+    # swap A out, imposter in — never coexisting
+    driver.set_inventory(reg([imposter]), {})
+    (imp_name,) = driver._by_name
+    assert imp_name != plain                        # suffixed, not inherited
+    # the owner returns: it still gets its plain label, imposter stays
+    # suffixed — and the same holds in a fresh process (persisted)
+    for d in (driver, DraDriver(cfg, reg([a, imposter]), {},
+                                node_name="node-a", api=api)):
+        if d is driver:
+            d.set_inventory(reg([a, imposter]), {})
+        assert d._by_name[plain][2].bdf == a.bdf
+        assert d._by_name[imp_name][2].bdf == imposter.bdf
 
 
 def test_rebuilt_plugin_first_poll_unprunes_recovered_chip(host, apiserver):
@@ -965,6 +1026,29 @@ def test_v1beta1_apiserver_keeps_wrapped_schema(host, apiserver):
     obj = next(iter(apiserver.slices.values()))
     assert obj["apiVersion"] == "resource.k8s.io/v1beta1"
     assert "basic" in obj["spec"]["devices"][0]
+
+
+def test_v1beta2_apiserver_uses_flattened_schema(host, apiserver):
+    """A k8s-1.33-era apiserver serving ONLY v1beta2 (v1beta1 disabled,
+    v1 not yet served) must not strand the driver on the v1beta1 fallback
+    (ADVICE r4): v1beta2 is schema-identical to v1, so the driver publishes
+    the flattened device shape under /apis/resource.k8s.io/v1beta2."""
+    _, cfg = host
+    apiserver.versions = ["v1beta2"]
+    driver = make_driver(cfg, apiserver)
+    assert driver.resource_api_version() == "v1beta2"
+    assert driver.publish_resource_slices()
+    assert any(p.startswith("/apis/resource.k8s.io/v1beta2/resourceslices")
+               for m, p in apiserver.requests if m == "POST")
+    obj = next(iter(apiserver.slices.values()))
+    assert obj["apiVersion"] == "resource.k8s.io/v1beta2"
+    dev = obj["spec"]["devices"][0]
+    assert "basic" not in dev
+    assert dev["attributes"]["bdf"] == {"string": "0000:00:04.0"}
+    # v1 outranks v1beta2 when both are served
+    apiserver.versions = ["v1beta2", "v1"]
+    driver._note_api_404()                     # force re-discovery
+    assert driver.resource_api_version() == "v1"
 
 
 def test_version_discovery_failure_is_not_cached(host, apiserver):

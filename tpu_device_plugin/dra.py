@@ -61,11 +61,13 @@ log = logging.getLogger(__name__)
 RESOURCE_API = "/apis/resource.k8s.io/v1beta1"   # fallback when undiscoverable
 # REST versions this driver can speak, newest first. v1 flattens the
 # v1beta1 device entry (attributes directly on the device, no "basic"
-# wrapper); everything else this driver touches is shape-identical. The
-# served version is discovered from the API group document at first use so
-# an apiserver that dropped v1beta1 does not strand the driver
+# wrapper); v1beta2 already uses the flattened v1 shape (it is
+# schema-identical to v1 for everything this driver touches, covering a
+# k8s 1.33 apiserver with v1beta1 disabled before v1 exists). The served
+# version is discovered from the API group document at first use so an
+# apiserver that dropped v1beta1 does not strand the driver
 # (VERDICT r3 item 7).
-RESOURCE_API_VERSIONS = ("v1", "v1beta1")
+RESOURCE_API_VERSIONS = ("v1", "v1beta2", "v1beta1")
 CDI_VERSION = "0.6.0"
 # retry cadence for a health-triggered republish that failed (transient
 # apiserver blip / resourceVersion conflict); mirrors the PluginManager's
@@ -152,13 +154,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # stop(withdraw_slice=True): an in-flight retry publish racing the
         # withdraw could otherwise POST the slice back after the delete
         self._publish_lock = threading.Lock()
+        # name-stability records (see _assign_slice_names), persisted
+        # beside the claim checkpoint so neither an inventory swap nor a
+        # driver restart (DaemonSet upgrade) can re-point a published name
+        # under a live claim
+        self.sticky_names_path = os.path.join(self.driver_dir,
+                                              "sticky-names.json")
+        self._sticky_suffixed, self._label_owners = self._load_sticky_names()
         self.set_inventory(registry, generations)
         self._checkpoint: Dict[str, dict] = self._load_checkpoint()
 
     # ---------------------------------------------------------- inventory
 
     @staticmethod
-    def _assign_slice_names(raws) -> Dict[str, str]:
+    def _assign_slice_names(raws, sticky=frozenset(),
+                            owners=None) -> Dict[str, str]:
         """raw id → collision-safe DNS-label name.
 
         slice_device_name() is lossy (lowercasing + non-[a-z0-9-] collapse
@@ -170,21 +180,53 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         so a device's published name is a pure function of the raw id set's
         collisions, never of iteration order (an order-dependent plain
         label could be inherited by a DIFFERENT device after an inventory
-        swap, silently re-pointing old claims)."""
+        swap, silently re-pointing old claims).
+
+        Two sticky records close the across-swap/restart holes, both
+        persisted in sticky-names.json beside the claim checkpoint and
+        kept for the driver's installed lifetime:
+
+        - `sticky` raws are suffixed unconditionally: once a raw id has
+          ever been published digest-suffixed, a later swap that removes
+          the rest of its collision group must NOT flip the survivor back
+          to the plain label, or a ResourceClaim allocated under the old
+          suffixed name would fail the _plan_devices lookup on a
+          post-swap prepare retry.
+        - `owners` maps each plain label ever published to the raw id it
+          named. A DIFFERENT raw id arriving later with the same
+          sanitized label (whether or not the two ever coexist) must not
+          take the plain label — an old claim against it would silently
+          resolve to the WRONG device. Non-owners are suffixed; the
+          recorded owner keeps the plain label whenever present, even
+          inside a live collision group (its claims predate the
+          collision). A collision among raws with NO recorded owner
+          suffixes every member, including the first — deterministic in
+          the raw id set, never in iteration order."""
+        owners = owners or {}
         labels: Dict[str, List[str]] = {}
         for raw in raws:
             labels.setdefault(slice_device_name(raw), []).append(raw)
         names: Dict[str, str] = {}
         for label, members in labels.items():
-            if len(members) == 1:
-                names[members[0]] = label
-                continue
+            owner = owners.get(label)
+            if owner is None and len(members) == 1 \
+                    and members[0] not in sticky:
+                plain_raw = members[0]
+            elif owner in members and owner not in sticky:
+                plain_raw = owner
+            else:
+                plain_raw = None
             for raw in members:
+                if raw == plain_raw:
+                    names[raw] = label
+                    continue
                 digest = hashlib.sha256(
                     raw.encode("utf-8", "replace")).hexdigest()[:8]
                 names[raw] = f"{label[:63 - 9]}-{digest}"
-            log.warning("DRA: device name collision on %r; publishing %s",
-                        label, sorted(names[r] for r in members))
+            if len(members) > 1:
+                log.warning("DRA: device name collision on %r; publishing "
+                            "%s", label,
+                            sorted(names[r] for r in members))
         return names
 
     @staticmethod
@@ -209,7 +251,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             for type_name, parts in sorted(registry.partitions_by_type.items()):
                 entries.extend((p.uuid, "partition", type_name, p)
                                for p in parts)
-            names = self._assign_slice_names([raw for raw, *_ in entries])
+            names = self._assign_slice_names(
+                [raw for raw, *_ in entries], self._sticky_suffixed,
+                self._label_owners)
+            suffixed = {raw for raw, name in names.items()
+                        if name != slice_device_name(raw)}
+            owned = {name: raw for raw, name in names.items()
+                     if raw not in suffixed}
+            if (not suffixed <= self._sticky_suffixed
+                    or any(self._label_owners.get(lb) != rw
+                           for lb, rw in owned.items())):
+                self._sticky_suffixed |= suffixed
+                self._label_owners.update(owned)
+                self._save_sticky_names()
             self._by_name: Dict[str, Tuple[str, str, object]] = {
                 names[raw]: (kind, group, obj)
                 for raw, kind, group, obj in entries}
@@ -246,8 +300,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             }
             if p.accel_index is not None:
                 attrs["accelIndex"] = {"int": p.accel_index}
-        # v1beta1 wraps attributes in "basic"; v1 flattens them onto the
-        # device entry. Same attribute value encoding either way.
+        # v1beta1 wraps attributes in "basic"; v1 (and the shape-identical
+        # v1beta2) flatten them onto the device entry. Same attribute value
+        # encoding either way.
         if version == "v1beta1":
             return {"name": name, "basic": {"attributes": attrs}}
         return {"name": name, "attributes": attrs}
@@ -376,6 +431,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return True
 
     def _arm_republish_retry(self) -> None:
+        # without an API client publish_resource_slices always returns
+        # False — a retry can never accomplish anything, it would just
+        # re-arm and log "no API client" every 30 s forever
+        if self.api is None:
+            return
         with self._lock:
             # a stopped driver must never re-arm: an in-flight retry racing
             # stop(withdraw_slice=True) would POST the slice back for a
@@ -530,6 +590,33 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def _save_checkpoint(self) -> None:
         _atomic_write_json(self.checkpoint_path, self._checkpoint)
+
+    def _load_sticky_names(self):
+        """→ (suffixed raw-id set, plain-label → owning raw-id map)."""
+        try:
+            with open(self.sticky_names_path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                suffixed = {r for r in data.get("suffixed", ())
+                            if isinstance(r, str)}
+                owners = {lb: rw
+                          for lb, rw in (data.get("label_owners") or
+                                         {}).items()
+                          if isinstance(lb, str) and isinstance(rw, str)}
+                return suffixed, owners
+        except (OSError, ValueError):
+            pass
+        return set(), {}
+
+    def _save_sticky_names(self) -> None:
+        try:
+            _atomic_write_json(self.sticky_names_path,
+                               {"suffixed": sorted(self._sticky_suffixed),
+                                "label_owners": self._label_owners})
+        except OSError as exc:
+            # a failed persist degrades to process-lifetime stickiness;
+            # names stay correct until the next restart
+            log.warning("DRA: could not persist sticky name set: %s", exc)
 
     # ------------------------------------------------------------ prepare
 

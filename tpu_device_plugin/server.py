@@ -99,8 +99,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # VMI attach issues (what was handed out, when)
         self._recent_allocs: deque = deque(maxlen=16)
         self._alloc_count = 0  # monotonic, for the Prometheus counter
-        # memo for the GetPreferredAllocation box scan (see handler)
+        # memo for the GetPreferredAllocation box scan (see handler);
+        # guarded by its own lock — handlers run on concurrent gRPC worker
+        # threads, and the wholesale clear() racing an insert must not rely
+        # on CPython dict atomicity. Invariant: the scan result depends on
+        # (availability, must-include, size, version), never health, so a
+        # stale hit is impossible while the version is in the key.
         self._pref_cache: Dict[tuple, list] = {}
+        self._pref_lock = threading.Lock()
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -411,11 +417,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             # the device-table version (health flips change nothing the
             # scan reads, but the version key keeps the cache honest if
             # that ever changes). Measured: 16 -> ~1 us on the repeat path.
-            key = (self._version,
+            with self._cond:
+                version = self._version
+            key = (version,
                    tuple(creq.available_deviceIDs),
                    tuple(creq.must_include_deviceIDs),
                    creq.allocation_size)
-            ids = self._pref_cache.get(key)
+            with self._pref_lock:
+                ids = self._pref_cache.get(key)
             if ids is None:
                 try:
                     ids = preferred_allocation(
@@ -427,9 +436,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     )
                 except MustIncludeTooLarge as exc:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-                if len(self._pref_cache) >= 128:
-                    self._pref_cache.clear()
-                self._pref_cache[key] = ids
+                with self._pref_lock:
+                    if len(self._pref_cache) >= 128:
+                        self._pref_cache.clear()
+                    self._pref_cache[key] = ids
             resp.container_responses.append(
                 pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
         return resp
