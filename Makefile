@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements
+.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local
 
 all: native proto
 
@@ -25,6 +25,13 @@ proto: proto/deviceplugin_v1beta1.proto proto/dra_v1beta1.proto proto/pluginregi
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# KubeVirt externalResourceProvider contract, no cluster required: real
+# daemon + faithful kubelet sim + simulated virt-controller render
+# (scripts/e2e_kubevirt_local.py). The full-cluster stage is
+# scripts/e2e_kind.sh KUBEVIRT=1.
+e2e-kubevirt-local:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/e2e_kubevirt_local.py
 
 # Enforced coverage (reference: Makefile:59-61 + golang.yml Coveralls job).
 # The image ships no pytest-cov, so the collector is a stdlib sys.monitoring

@@ -29,8 +29,11 @@ def build(root: str) -> None:
         ("0000:02:01.0", "9"),
     ]
     for i, (bdf, group) in enumerate(chips):
+        # every vfio-bound device on an iommufd host has a cdev; without
+        # one the plugin (correctly) fails the Allocate, which the local
+        # KubeVirt contract run flushed out (scripts/e2e_kubevirt_local.py)
         host.add_chip(FakeChip(bdf=bdf, iommu_group=group, accel_index=i,
-                               numa_node=i // 2))
+                               numa_node=i // 2, vfio_dev=f"vfio{i}"))
     host.add_mdev("a1b2c3d4-0000-1111-2222-333344445555", "tpu-v4-1c",
                   "0000:02:00.0", iommu_group="12")
     host.add_shared_device("egm0", ["0000:01:00.0", "0000:01:01.0"])
