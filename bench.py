@@ -29,7 +29,7 @@ Prints ONE JSON line:
 `python bench.py --matrix` additionally runs the scaling matrix
 ({8,16,64} devices × allocation sizes {1,4,8} × {0,128} partitions),
 prints a human-readable table on stderr, and writes
-docs/bench_matrix_r04.json (scaling matrix, VERDICT r2 next-item #5).
+docs/bench_matrix_r05.json (scaling matrix, VERDICT r2 next-item #5).
 """
 
 import json
@@ -344,8 +344,9 @@ def run_matrix():
         finally:
             shutil.rmtree(root, ignore_errors=True)
 
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "docs", "bench_matrix_r04.json")
+    out = os.environ.get("BENCH_MATRIX_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "bench_matrix_r05.json")
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
     for row in results["devices"]:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -25,19 +26,6 @@ from .registry import Registry, SharedDevice
 log = logging.getLogger(__name__)
 
 
-def _read_small(path: str) -> Optional[bytes]:
-    """Raw low-level read of a small sysfs attribute (hot-path variant of
-    read_id_from_file: no TextIOWrapper construction per call)."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return None
-    try:
-        return os.read(fd, 80)
-    except OSError:
-        return None
-    finally:
-        os.close(fd)
 
 
 class AllocationError(Exception):
@@ -166,10 +154,72 @@ class AllocationPlanner:
             for bdf in registry.bdf_to_group
         }
         self._vendor_ok = frozenset(v.lower() for v in cfg.vendor_ids)
+        # raw sysfs spellings accepted without the slow-path decode
+        self._vendor_ok_raw = frozenset(
+            s for v in self._vendor_ok
+            for s in (v.encode("ascii"), b"0x" + v.encode("ascii")))
+        # bdf → kept-open fd on <bdf>/vendor: pread(fd, …, 0) re-runs the
+        # sysfs show() each call, so the TOCTOU read stays LIVE while
+        # costing one syscall instead of open+read+close. A removed or
+        # replaced device invalidates the inode (pread errors or returns
+        # b""), which falls back to a fresh open — a genuinely new device
+        # at the same BDF is still re-validated from scratch.
+        self._vendor_fds: Dict[str, int] = {}
+        self._vendor_fds_lock = threading.Lock()
         self._shared_cache: Optional[List[SharedDevice]] = None
         self._shared_expires = 0.0
         self._iommufd_cache: Optional[bool] = None
         self._iommufd_expires = 0.0
+
+    def __del__(self, _close=os.close):
+        # _close bound at def time: os.close may already be torn down when
+        # a planner is collected at interpreter shutdown
+        for fd in getattr(self, "_vendor_fds", {}).values():
+            try:
+                _close(fd)
+            except OSError:
+                pass
+
+    def _read_vendor_live(self, bdf: str, vpath: str) -> Optional[bytes]:
+        # get + pread + (stale-path close) all under the lock: a close
+        # outside it could free the fd NUMBER for reuse by a concurrent
+        # open while another thread still preads it — silently reading an
+        # unrelated file where the TOCTOU guard expects this device's
+        # vendor. The held-lock pread is ~1-2 us; contention only
+        # serializes concurrent Allocates of the same planner, which the
+        # kubelet's admission lock serializes anyway.
+        with self._vendor_fds_lock:
+            fd = self._vendor_fds.get(bdf)
+            if fd is not None:
+                try:
+                    raw = os.pread(fd, 80, 0)
+                    if raw:
+                        return raw
+                except OSError:
+                    pass
+                # stale fd (device removed/replaced): drop it and reopen
+                del self._vendor_fds[bdf]
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        try:
+            fd = os.open(vpath, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            raw = os.pread(fd, 80, 0)
+        except OSError:
+            os.close(fd)
+            return None
+        with self._vendor_fds_lock:
+            prev = self._vendor_fds.get(bdf)
+            if prev is None:
+                self._vendor_fds[bdf] = fd
+                fd = None   # ownership transferred to the cache
+        if fd is not None:   # lost the race; another thread cached one
+            os.close(fd)
+        return raw
 
     def _revalidate_live(self, bdf: str, expected_group: str) -> None:
         """TOCTOU guard (NEVER cached): live sysfs must still agree with the
@@ -189,7 +239,10 @@ class AllocationPlanner:
             raise AllocationError(
                 f"device {bdf}: iommu group changed "
                 f"({expected_group!r} -> {live!r})")
-        raw = _read_small(vpath)
+        raw = self._read_vendor_live(bdf, vpath)
+        if raw is not None and raw.strip().lower() in self._vendor_ok_raw:
+            return
+        # slow path only to produce the same diagnostic as before
         vendor = (raw.strip().lower().decode("ascii", "replace")
                   if raw is not None else None)
         if vendor is not None and vendor.startswith("0x"):

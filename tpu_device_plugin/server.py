@@ -34,7 +34,7 @@ from .health import HealthMonitor
 from .kubeletapi import pb
 from .native import TpuHealth, link_is_degraded
 from .registry import Registry, TpuDevice
-from .topology import AllocatableDevice, MustIncludeTooLarge, preferred_allocation
+from .topology import AllocatableDevice, AllocationIndex, MustIncludeTooLarge
 
 log = logging.getLogger(__name__)
 
@@ -89,6 +89,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
             for d in self.devices
         ]
+        # device set + torus are fixed for this server's lifetime, so the
+        # box-membership precompute happens once, not per RPC
+        self._alloc_index = AllocationIndex(self._allocatable,
+                                            torus_dims=self.torus_dims)
         self._allowed_bdfs = frozenset(d.bdf for d in self.devices)
         # per-(cfg, registry, resource) precomputation for the Allocate hot
         # path; rebuilt with the server on every rediscovery restart
@@ -357,14 +361,24 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "pci_errors": errors,
             "degraded_links": degraded_links,
             "allocations_total": self._alloc_count,
-            "recent_allocations": list(self._recent_allocs),
+            # timestamps are stored as epoch floats (record_allocation is
+            # on the Allocate hot path) and rendered ISO here, off it.
+            # list() first: it snapshots the deque in one atomic C call,
+            # where iterating the live deque would race concurrent
+            # record_allocation appends (RuntimeError: mutated during
+            # iteration)
+            "recent_allocations": [
+                {"time": datetime.fromtimestamp(
+                    e["ts"], timezone.utc).isoformat(timespec="seconds"),
+                 "devices": e["devices"]}
+                for e in list(self._recent_allocs)],
         }
 
     def record_allocation(self, per_container_ids) -> None:
         with self._cond:  # int += is not atomic across the RPC thread pool
             self._alloc_count += 1
         self._recent_allocs.append({
-            "time": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+            "ts": time.time(),
             "devices": per_container_ids,
         })
 
@@ -409,7 +423,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def GetPreferredAllocation(self, request, context):
         resp = pb.PreferredAllocationResponse()
-        allocatable = self._allocatable
+        index = self._alloc_index
         for creq in request.container_requests:
             # The ICI sub-box scan is pure in (availability, must-include,
             # size) over a static torus, and the kubelet re-asks with the
@@ -427,12 +441,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 ids = self._pref_cache.get(key)
             if ids is None:
                 try:
-                    ids = preferred_allocation(
-                        allocatable,
-                        list(creq.available_deviceIDs),
-                        list(creq.must_include_deviceIDs),
+                    ids = index.preferred(
+                        creq.available_deviceIDs,
+                        creq.must_include_deviceIDs,
                         creq.allocation_size,
-                        torus_dims=self.torus_dims,
                     )
                 except MustIncludeTooLarge as exc:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
