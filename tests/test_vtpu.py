@@ -99,6 +99,39 @@ def test_mdev_type_mismatch_rejected(mdev_rig):
         server.stop(0)
 
 
+def test_mdev_unlink_recreate_different_type_rejected(mdev_rig):
+    """The kept-fd live-type read must not serve the DELETED inode's bytes
+    after the mdev is removed and recreated at the same uuid with another
+    type: on a regular-file root (this test, --root re-rooting) unlink
+    does not invalidate an open fd, so the reader's st_nlink staleness
+    check is what catches it (LiveAttrReader)."""
+    host, cfg, plugin = mdev_rig
+    server = _serve(plugin)
+    try:
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            stub = api.DevicePluginStub(ch)
+            # successful allocate primes the cached fd for uuid-a1
+            stub.Allocate(
+                pb.AllocateRequest(container_requests=[
+                    pb.ContainerAllocateRequest(devices_ids=["uuid-a1"])]),
+                timeout=5)
+            # remove + recreate the mdev at the same uuid, different type
+            name_path = os.path.join(host.pci, "0000:00:04.0", "uuid-a1",
+                                     "mdev_type", "name")
+            os.unlink(name_path)
+            with open(name_path, "w") as f:
+                f.write("TPU vother\n")
+            with pytest.raises(grpc.RpcError) as exc_info:
+                stub.Allocate(
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(devices_ids=["uuid-a1"])]),
+                    timeout=5)
+            assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            assert "live type" in exc_info.value.details()
+    finally:
+        server.stop(0)
+
+
 def test_unknown_partition_rejected(mdev_rig):
     host, cfg, plugin = mdev_rig
     server = _serve(plugin)

@@ -32,6 +32,83 @@ class AllocationError(Exception):
     """Request references devices this plugin cannot serve (unknown/invalid)."""
 
 
+class LiveAttrReader:
+    """Kept-open-fd live reads of small sysfs attributes.
+
+    pread(fd, …, 0) re-runs the attribute's sysfs show() on every call, so
+    the read stays LIVE (TOCTOU-guard grade) at fstat+pread cost instead
+    of open+read+close. Staleness is detected two ways, because the
+    plugin also runs over regular-file roots (tests, --root re-rooting)
+    where an unlinked file's fd would otherwise keep serving old bytes
+    forever: st_nlink == 0 on the cached fd catches unlink/replace on ANY
+    filesystem, and pread errors/empty reads catch sysfs inode
+    invalidation. Either falls back to a fresh open, so a genuinely new
+    device at the same path is still re-validated from scratch.
+    get + fstat + pread + stale-path close happen under one lock: a close
+    outside it could free the fd NUMBER for reuse by a concurrent open
+    while another thread still preads it, silently reading an unrelated
+    file.
+
+    read() returns non-empty fresh bytes or None — an empty file is
+    reported as None (and never cached), keeping the contract single-faced
+    for callers that treat None as "attribute gone".
+    """
+
+    def __init__(self) -> None:
+        self._fds: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __del__(self, _close=os.close):
+        # _close bound at def time: os.close may already be torn down when
+        # a reader is collected at interpreter shutdown
+        for fd in getattr(self, "_fds", {}).values():
+            try:
+                _close(fd)
+            except OSError:
+                pass
+
+    def read(self, key: str, path: str) -> Optional[bytes]:
+        """Fresh non-empty bytes of `path` (cached fd keyed by `key`);
+        None if gone/unreadable/empty."""
+        with self._lock:
+            fd = self._fds.get(key)
+            if fd is not None:
+                try:
+                    if os.fstat(fd).st_nlink > 0:
+                        raw = os.pread(fd, 256, 0)
+                        if raw:
+                            return raw
+                except OSError:
+                    pass
+                # stale fd (file unlinked/replaced, inode invalidated, or
+                # content gone): drop it and reopen
+                del self._fds[key]
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            raw = os.pread(fd, 256, 0)
+        except OSError:
+            os.close(fd)
+            return None
+        if not raw:
+            os.close(fd)   # empty attribute: report None, never cache
+            return None
+        with self._lock:
+            prev = self._fds.get(key)
+            if prev is None:
+                self._fds[key] = fd
+                fd = None   # ownership transferred to the cache
+        if fd is not None:   # lost the race; another thread cached one
+            os.close(fd)
+        return raw
+
+
 def supports_iommufd(cfg: Config) -> bool:
     """iommufd-capable host: /dev/iommu exists (reference :692-701)."""
     return os.path.exists(cfg.dev_path("dev/iommu"))
@@ -158,68 +235,13 @@ class AllocationPlanner:
         self._vendor_ok_raw = frozenset(
             s for v in self._vendor_ok
             for s in (v.encode("ascii"), b"0x" + v.encode("ascii")))
-        # bdf → kept-open fd on <bdf>/vendor: pread(fd, …, 0) re-runs the
-        # sysfs show() each call, so the TOCTOU read stays LIVE while
-        # costing one syscall instead of open+read+close. A removed or
-        # replaced device invalidates the inode (pread errors or returns
-        # b""), which falls back to a fresh open — a genuinely new device
-        # at the same BDF is still re-validated from scratch.
-        self._vendor_fds: Dict[str, int] = {}
-        self._vendor_fds_lock = threading.Lock()
+        # live <bdf>/vendor reads for the TOCTOU guard (see LiveAttrReader)
+        self._vendor_reader = LiveAttrReader()
         self._shared_cache: Optional[List[SharedDevice]] = None
         self._shared_expires = 0.0
         self._iommufd_cache: Optional[bool] = None
         self._iommufd_expires = 0.0
 
-    def __del__(self, _close=os.close):
-        # _close bound at def time: os.close may already be torn down when
-        # a planner is collected at interpreter shutdown
-        for fd in getattr(self, "_vendor_fds", {}).values():
-            try:
-                _close(fd)
-            except OSError:
-                pass
-
-    def _read_vendor_live(self, bdf: str, vpath: str) -> Optional[bytes]:
-        # get + pread + (stale-path close) all under the lock: a close
-        # outside it could free the fd NUMBER for reuse by a concurrent
-        # open while another thread still preads it — silently reading an
-        # unrelated file where the TOCTOU guard expects this device's
-        # vendor. The held-lock pread is ~1-2 us; contention only
-        # serializes concurrent Allocates of the same planner, which the
-        # kubelet's admission lock serializes anyway.
-        with self._vendor_fds_lock:
-            fd = self._vendor_fds.get(bdf)
-            if fd is not None:
-                try:
-                    raw = os.pread(fd, 80, 0)
-                    if raw:
-                        return raw
-                except OSError:
-                    pass
-                # stale fd (device removed/replaced): drop it and reopen
-                del self._vendor_fds[bdf]
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
-        try:
-            fd = os.open(vpath, os.O_RDONLY)
-        except OSError:
-            return None
-        try:
-            raw = os.pread(fd, 80, 0)
-        except OSError:
-            os.close(fd)
-            return None
-        with self._vendor_fds_lock:
-            prev = self._vendor_fds.get(bdf)
-            if prev is None:
-                self._vendor_fds[bdf] = fd
-                fd = None   # ownership transferred to the cache
-        if fd is not None:   # lost the race; another thread cached one
-            os.close(fd)
-        return raw
 
     def _revalidate_live(self, bdf: str, expected_group: str) -> None:
         """TOCTOU guard (NEVER cached): live sysfs must still agree with the
@@ -239,7 +261,7 @@ class AllocationPlanner:
             raise AllocationError(
                 f"device {bdf}: iommu group changed "
                 f"({expected_group!r} -> {live!r})")
-        raw = self._read_vendor_live(bdf, vpath)
+        raw = self._vendor_reader.read(bdf, vpath)
         if raw is not None and raw.strip().lower() in self._vendor_ok_raw:
             return
         # slow path only to produce the same diagnostic as before

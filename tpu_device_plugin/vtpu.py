@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence
 import grpc
 
 from . import kubeletapi as api
-from .allocate import AllocationError, AllocationPlanner
+from .allocate import AllocationError, AllocationPlanner, LiveAttrReader
 from .config import Config
 from .discovery import read_link_basename
 from .health import HealthMonitor
@@ -63,6 +63,11 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         # parent; this one is unscoped — partition membership is already
         # validated against self.partitions before plan() is called.
         self._parent_planner = AllocationPlanner(cfg, registry, type_name)
+        # partition set is fixed for this server's lifetime (rediscovery
+        # rebuilds the server) — index it once, not per RPC
+        self._by_uuid = {p.uuid: p for p in self.partitions}
+        # live mdev_type/name reads for _validate_mdev (see LiveAttrReader)
+        self._mdev_name_reader = LiveAttrReader()
 
     # ------------------------------------------------------------------ state
 
@@ -129,17 +134,24 @@ class VtpuDevicePlugin(TpuDevicePlugin):
     def _validate_mdev(self, p: TpuPartition) -> None:
         """Live mdev type must still match this plugin (reference :216-221)."""
         name_path = os.path.join(self.cfg.mdev_base_path, p.uuid, "mdev_type", "name")
-        try:
-            with open(name_path, "r", encoding="ascii", errors="replace") as f:
-                live = f.read().strip().replace(" ", "_")
-        except OSError as exc:
-            raise AllocationError(f"partition {p.uuid}: mdev vanished ({exc})")
+        raw = self._mdev_name_reader.read(p.uuid, name_path)
+        if raw is None:
+            # failure path only: one diagnostic open to recover the errno
+            # the operator needs (EACCES mount misconfig vs ENOENT gone)
+            try:
+                with open(name_path, "rb"):
+                    detail = "empty or unreadable"
+            except OSError as exc:
+                detail = str(exc)
+            raise AllocationError(
+                f"partition {p.uuid}: mdev vanished ({detail})")
+        live = raw.decode("ascii", "replace").strip().replace(" ", "_")
         if live != self.resource_suffix:
             raise AllocationError(
                 f"partition {p.uuid}: live type {live!r} != {self.resource_suffix!r}")
 
     def _allocate_impl(self, request, context):
-        by_uuid = {p.uuid: p for p in self.partitions}
+        by_uuid = self._by_uuid
         resp = pb.AllocateResponse()
         try:
             for creq in request.container_requests:
@@ -224,7 +236,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
     def GetPreferredAllocation(self, request, context):
         """Pack partitions onto the fewest parent chips (anti-fragmentation),
         preferring parents on the NUMA node the allocation started on."""
-        by_uuid = {p.uuid: p for p in self.partitions}
+        by_uuid = self._by_uuid
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
             must = list(creq.must_include_deviceIDs)
