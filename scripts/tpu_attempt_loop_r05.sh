@@ -45,6 +45,13 @@ while [ ! -f .stop_tpu_attempts ]; do
             --seqs 2048,4096 --blocks 128x128 --repeats 4 --steps 5 \
             >docs/validator_tpu_attn_r05.json 2>>"$LOG"
         echo "attn-bench rc=$? $(date -u +%FT%TZ)" >>"$LOG"
+        # mfu-lite FIRST: the relay compiles big models very slowly and a
+        # hung compile cannot be killed without wedging the claim — the
+        # lite run banks a valid sustained-MFU number before the
+        # unbounded full-size attempt
+        python -m tpu_device_plugin.validator --preset mfu-lite --steps 3 \
+            >docs/validator_tpu_mfulite_r05.json 2>>"$LOG"
+        echo "mfu-lite rc=$? $(date -u +%FT%TZ)" >>"$LOG"
         echo "mfu preset start $(date -u +%FT%TZ) (may take a while)" >>"$LOG"
         python -m tpu_device_plugin.validator --preset mfu --steps 3 \
             >docs/validator_tpu_mfu_r05.json 2>>"$LOG"

@@ -234,6 +234,37 @@ def test_cli_preset_builds_sized_config(monkeypatch):
     assert not seen["cfg"].remat
 
 
+def test_cli_preset_mfu_lite_builds_reduced_config(monkeypatch):
+    """mfu-lite: ~7x fewer FLOPs/step than mfu — matmul FLOPs 8x lighter,
+    the 4*S^2*d attention term only 4x at the unchanged seq (capture
+    insurance: it runs BEFORE the unbounded full-size attempt, because a
+    hung relay compile cannot be killed without wedging the claim); same
+    MXU-friendly head_dim 128 and flash-eligible seq. MFU itself is
+    size-independent, so nothing is ever scaled back up."""
+    from tpu_device_plugin.validator import probe as probe_mod
+    from tpu_device_plugin.validator.workload import FLASH_MIN_SEQ
+    seen = {}
+
+    def fake_validate(cfg=None, **kw):
+        seen["cfg"] = cfg
+        return SliceReport(ok=True)
+
+    monkeypatch.setattr(probe_mod, "validate_slice", fake_validate)
+    assert probe_mod.main(["--preset", "mfu-lite", "--steps", "1"]) == 0
+    cfg = seen["cfg"]
+    assert cfg.d_model == 1024 and cfg.n_layers == 4
+    assert cfg.d_model // cfg.n_heads == 128     # MXU/flash head dim kept
+    assert cfg.seq_len >= FLASH_MIN_SEQ          # auto mode -> flash kernel
+    full, lite = probe_mod.PRESETS["mfu"], probe_mod.PRESETS["mfu-lite"]
+    # matmul-FLOP proxy (d_model^2 * layers) is 8x lighter; the attention
+    # term (4*S^2*d per layer) only 4x at the shared seq — so the true
+    # step ratio is ~7x, and MFU (measured/peak) needs no scale-up anyway
+    matmul = lambda p: (p["d_model"] ** 2 * p["n_layers"])
+    attn = lambda p: (p["seq_len"] ** 2 * p["d_model"] * p["n_layers"])
+    assert matmul(full) == 8 * matmul(lite)
+    assert attn(full) == 4 * attn(lite)
+
+
 def test_cli_preset_composes_with_overrides(monkeypatch):
     from tpu_device_plugin.validator import probe as probe_mod
     seen = {}

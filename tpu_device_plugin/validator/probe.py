@@ -337,6 +337,17 @@ PRESETS = {
     "burnin": {},  # the ModelConfig defaults: tiny, correctness-first
     "mfu": dict(d_model=2048, n_heads=16, d_ff=8192, n_layers=8,
                 seq_len=2048, batch=8),
+    # ~7x fewer FLOPs/step than "mfu" (halved d_model/d_ff/heads/layers:
+    # matmul FLOPs drop 8x but the 4*S^2*d attention term only 4x at the
+    # unchanged seq 2048; same MXU-friendly shapes + flash-eligible seq).
+    # The relay compiles big models very slowly and a hung full-size
+    # compile cannot be killed without wedging the claim (docs/roadmap.md
+    # item 1), so the capture protocol runs this first — a valid
+    # sustained-MFU number lands even if the full-size run never returns.
+    # MFU itself is size-independent (measured/peak); only absolute
+    # TFLOP/s differ, so no scale-back-up factor is ever needed.
+    "mfu-lite": dict(d_model=1024, n_heads=8, d_ff=4096, n_layers=4,
+                     seq_len=2048, batch=8),
 }
 
 
@@ -396,8 +407,10 @@ def main(argv=None) -> int:
                              "(correctness), mfu = sized-up config for "
                              "sustained-MFU measurement (d_model 2048, "
                              "seq 2048, 8 layers; auto-selects the flash "
-                             "kernel). --seq-len/--experts/--remat compose "
-                             "on top")
+                             "kernel), mfu-lite = ~7x-lighter MFU config "
+                             "(d_model 1024, 4 layers) run FIRST on "
+                             "hardware as compile-hang insurance. "
+                             "--seq-len/--experts/--remat compose on top")
     parser.add_argument("--attention",
                         choices=["auto", "flash", "ring", "einsum"],
                         default="auto",
