@@ -154,6 +154,56 @@ def _handler_compute(plugin, all_ids, alloc_size, iterations=2000,
             statistics.median(cold_us))
 
 
+def _dra_prepare_bench(root, registry, generations, iterations=150,
+                       warmup=15):
+    """Cold NodePrepareResources / NodeUnprepareResources handler p50.
+
+    The DRA driver is the successor API surface (PARITY #15, no reference
+    counterpart) — this keeps its kubelet-visible prepare path measured
+    alongside the classic Allocate. Each iteration prepares a FRESH claim
+    (API fetch over localhost HTTP + device planning + per-claim CDI spec
+    write + checkpoint write) and unprepares it (spec unlink + checkpoint
+    write), so every sample is the cold path a real first-prepare pays.
+    """
+    from tests.test_dra import FakeApiServer
+    from tpu_device_plugin.dra import DraDriver, slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+    from tpu_device_plugin.kubeapi import ApiClient
+
+    apiserver = FakeApiServer()
+    try:
+        api_client = ApiClient(apiserver.url, token_path="/nonexistent")
+        driver = DraDriver(Config().with_root(root), registry, generations,
+                           node_name="bench-node", api=api_client)
+        devs = next(iter(registry.devices_by_model.values()))
+        names = [slice_device_name(devs[0].bdf),
+                 slice_device_name(devs[1].bdf)]
+        prep_us, unprep_us = [], []
+        for i in range(iterations + warmup):
+            uid = f"bench-claim-{i}"
+            apiserver.add_claim("bench", f"c{i}", uid, driver.driver_name,
+                                [{"device": n} for n in names])
+            claim = drapb.Claim(namespace="bench", name=f"c{i}", uid=uid)
+            t0 = time.perf_counter()
+            resp = driver.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(claims=[claim]), None)
+            t1 = time.perf_counter()
+            assert resp.claims[uid].error == "", resp.claims[uid].error
+            assert len(resp.claims[uid].devices) == 2
+            t2 = time.perf_counter()
+            driver.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(claims=[claim]), None)
+            t3 = time.perf_counter()
+            if i >= warmup:
+                prep_us.append((t1 - t0) * 1e6)
+                unprep_us.append((t3 - t2) * 1e6)
+        driver.stop()
+        return (round(statistics.median(prep_us), 1),
+                round(statistics.median(unprep_us), 1))
+    finally:
+        apiserver.stop()
+
+
 def run_config1(root):
     """The headline config-1 measurement on an 8-chip v5e host."""
     host = _build_host(root, 8)
@@ -203,6 +253,10 @@ def run_config1(root):
                 vtpu_us.append((time.perf_counter() - t1) * 1e6)
     vserver.stop(0)
 
+    # successor API surface: cold DRA prepare/unprepare handler p50
+    dra_prep_us, dra_unprep_us = _dra_prepare_bench(root, registry,
+                                                    generations)
+
     p50 = statistics.median(attach_us)   # same estimator as rounds 1-2
     round1_p50_us = 820.3
     try:
@@ -246,6 +300,8 @@ def run_config1(root):
         "p99_us": round(statistics.quantiles(attach_us, n=100)[98], 1),
         "best_epoch_p50_us": round(_min_epoch_p50(attach_us), 1),
         "vtpu_allocate_p50_us": round(statistics.median(vtpu_us), 1),
+        "dra_prepare_p50_us": dra_prep_us,
+        "dra_unprepare_p50_us": dra_unprep_us,
         "discovery_ms": round(discovery_ms, 2),
         "devices_advertised": len(devices),
         "allocation_size": 4,

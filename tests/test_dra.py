@@ -33,13 +33,29 @@ class FakeApiServer:
         self.slices = {}      # name -> object (with resourceVersion)
         self.claims = {}      # (ns, name) -> object
         self.requests = []    # (method, path) log
+        self.connections = 0  # distinct TCP connections accepted
         self.versions = list(versions)  # served resource.k8s.io versions
         self._rv = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 like a real apiserver, so the ApiClient's
+            # keep-alive pool is actually exercised (Content-Length is
+            # always sent by _send, which 1.1 keep-alive requires).
+            # Buffered writes + no Nagle: BaseHTTPRequestHandler's default
+            # unbuffered wfile emits each header line as its own packet,
+            # which on a reused connection interacts with delayed ACK into
+            # ~40 ms per-request stalls.
+            protocol_version = "HTTP/1.1"
+            wbufsize = 65536
+            disable_nagle_algorithm = True
+
             def log_message(self, *a):
                 pass
+
+            def setup(self):
+                outer.connections += 1
+                super().setup()
 
             def _send(self, code, obj=None):
                 body = json.dumps(obj or {}).encode()
@@ -1026,6 +1042,25 @@ def test_v1beta1_apiserver_keeps_wrapped_schema(host, apiserver):
     obj = next(iter(apiserver.slices.values()))
     assert obj["apiVersion"] == "resource.k8s.io/v1beta1"
     assert "basic" in obj["spec"]["devices"][0]
+
+
+def test_api_client_reuses_keepalive_connections(host, apiserver):
+    """The ApiClient pools keep-alive connections: repeated publishes
+    (GET + POST/PUT each) must ride a handful of TCP connections, not one
+    per request — per-request TLS handshakes are the dominant cost of a
+    real claim prepare."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    for _ in range(5):
+        assert driver.publish_resource_slices()
+    n_requests = len(apiserver.requests)
+    # discovery + node uid + first GET+POST + 4 change-free GETs
+    assert n_requests >= 7
+    # sequential single-threaded use: everything after the first request
+    # should reuse the pooled connection
+    assert apiserver.connections <= 2, (
+        f"{apiserver.connections} connections for {n_requests} requests")
+    driver.stop()
 
 
 def test_v1beta2_apiserver_uses_flattened_schema(host, apiserver):

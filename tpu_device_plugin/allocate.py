@@ -109,6 +109,26 @@ class LiveAttrReader:
         return raw
 
 
+def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
+    """Live mdev_type/name read (TOCTOU-grade, kept-fd) for Allocate-time
+    validation; raises AllocationError when the mdev is gone. Shared by the
+    classic vTPU server and the DRA prepare path so the two APIs can never
+    validate the same partition differently (reference analogue:
+    generic_vgpu_device_plugin.go:216-221)."""
+    name_path = os.path.join(cfg.mdev_base_path, uuid, "mdev_type", "name")
+    raw = reader.read(uuid, name_path)
+    if raw is None:
+        # failure path only: one diagnostic open to recover the errno the
+        # operator needs (EACCES mount misconfig vs ENOENT gone)
+        try:
+            with open(name_path, "rb"):
+                detail = "empty or unreadable"
+        except OSError as exc:
+            detail = str(exc)
+        raise AllocationError(f"partition {uuid}: mdev vanished ({detail})")
+    return raw.decode("ascii", "replace").strip().replace(" ", "_")
+
+
 def supports_iommufd(cfg: Config) -> bool:
     """iommufd-capable host: /dev/iommu exists (reference :692-701)."""
     return os.path.exists(cfg.dev_path("dev/iommu"))

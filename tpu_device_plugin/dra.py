@@ -48,7 +48,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
-from .allocate import AllocationError, AllocationPlanner
+from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
+                       live_mdev_type)
 from .config import Config
 from .discovery import read_link_basename
 from .kubeapi import ApiClient, ApiError
@@ -161,6 +162,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self.sticky_names_path = os.path.join(self.driver_dir,
                                               "sticky-names.json")
         self._sticky_suffixed, self._label_owners = self._load_sticky_names()
+        # live mdev_type/name reads for the prepare-path TOCTOU check
+        self._mdev_name_reader = LiveAttrReader()
         self.set_inventory(registry, generations)
         self._checkpoint: Dict[str, dict] = self._load_checkpoint()
 
@@ -712,20 +715,14 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             envs[env_key] = ",".join(
                 x for x in (envs.get(env_key), p.uuid) if x)
             if p.provider == "mdev":
-                # mirror vtpu.py exactly: live mdev-type TOCTOU check, then
-                # the per-mdev group — or the reference-compatible wide
-                # /dev/vfio mount when the group link is not visible
-                # (vtpu.py:169-172); diverging here would let the two APIs
-                # prepare the same partition differently
-                name_path = os.path.join(self.cfg.mdev_base_path, p.uuid,
-                                         "mdev_type", "name")
-                try:
-                    with open(name_path, "r", encoding="ascii",
-                              errors="replace") as f:
-                        live = f.read().strip().replace(" ", "_")
-                except OSError as exc:
-                    raise AllocationError(
-                        f"partition {p.uuid}: mdev vanished ({exc})")
+                # mirror vtpu.py exactly: the SHARED live mdev-type TOCTOU
+                # check (allocate.live_mdev_type), then the per-mdev group
+                # — or the reference-compatible wide /dev/vfio mount when
+                # the group link is not visible (vtpu.py:169-172);
+                # diverging here would let the two APIs prepare the same
+                # partition differently
+                live = live_mdev_type(self._mdev_name_reader, self.cfg,
+                                      p.uuid)
                 if live != type_name:
                     raise AllocationError(
                         f"partition {p.uuid}: live type {live!r} != "

@@ -10,15 +10,31 @@ reference: go.mod:1-12 — it never talks to the API server at all).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
 import ssl
-import urllib.error
-import urllib.request
+import threading
 from typing import Optional
+from urllib.parse import urlsplit
 
 log = logging.getLogger(__name__)
+
+# idle keep-alive connections retained per client; excess connections from
+# concurrency bursts are closed on return rather than pooled
+MAX_IDLE_CONNECTIONS = 4
+
+# failures whose signature is a stale keep-alive connection the server
+# idled out — retried ONCE on a brand-new connection when the failed one
+# was a reused pool member. Deliberately NARROW: a response-read timeout
+# (TimeoutError) means the server may have processed the request, and
+# replaying a POST/PUT there would duplicate apiserver writes, so it is
+# wrapped as ApiError without retry like every other transport failure.
+_RETRYABLE_STALE = (http.client.BadStatusLine,
+                    http.client.CannotSendRequest,
+                    http.client.ResponseNotReady, BrokenPipeError,
+                    ConnectionResetError, ConnectionAbortedError)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -41,7 +57,25 @@ class ApiError(Exception):
 
 
 class ApiClient:
-    """Bearer-token REST client for one API server."""
+    """Bearer-token REST client for one API server.
+
+    Connections are keep-alive and pooled (up to MAX_IDLE_CONNECTIONS
+    idle): a node agent talks to one apiserver for its whole life, and
+    per-request TCP+TLS handshakes are both the dominant cost of a DRA
+    claim prepare and pointless apiserver load. The pool never blocks —
+    a concurrency burst simply opens extra connections and closes them on
+    return — so a slow publish cannot stall a claim prepare (the dra.py
+    lock-scope rationale). A request that fails at send/first-byte on a
+    REUSED connection is retried once on a brand-new one (the server
+    idled out the keep-alive); a fresh-connection failure propagates,
+    matching the one-attempt behavior this client always had.
+
+    Connections are DIRECT (http.client): HTTP(S)_PROXY env vars, which
+    the pre-pool urllib implementation honored, are intentionally not —
+    an in-cluster node agent talks straight to its apiserver. A path
+    component in the server URL (e.g. an apiserver proxy prefix) is
+    preserved and prepended to every request path.
+    """
 
     def __init__(self, server: str,
                  token_path: str = os.path.join(SA_DIR, "token"),
@@ -51,38 +85,86 @@ class ApiClient:
         self.token_path = token_path
         self.ca_path = ca_path
         self.timeout_s = timeout_s
+        split = urlsplit(self.server)
+        self._https = split.scheme == "https"
+        self._host = split.hostname or self.server
+        self._port = split.port
+        self._base_path = split.path.rstrip("/")
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        self._idle: list = []
+        self._pool_lock = threading.Lock()
+
+    def _new_conn(self) -> http.client.HTTPConnection:
+        if self._https:
+            if self._ssl_ctx is None:
+                self._ssl_ctx = ssl.create_default_context(
+                    cafile=self.ca_path if os.path.exists(self.ca_path)
+                    else None)
+            return http.client.HTTPSConnection(
+                self._host, self._port, context=self._ssl_ctx,
+                timeout=self.timeout_s)
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout_s)
+
+    def _get_conn(self):
+        """→ (connection, was_reused)."""
+        with self._pool_lock:
+            if self._idle:
+                return self._idle.pop(), True
+        return self._new_conn(), False
+
+    def _put_conn(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._idle) < MAX_IDLE_CONNECTIONS:
+                self._idle.append(conn)
+                return
+        conn.close()
 
     def request(self, path: str, method: str = "GET",
                 body: Optional[bytes] = None,
                 content_type: Optional[str] = None) -> bytes:
         """Raw request against an API path; raises ApiError on failure."""
         url = self.server + path
-        req = urllib.request.Request(url, data=body, method=method)
+        headers = {}
         if content_type:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
+        # token re-read per request: in-cluster tokens rotate
         try:
             with open(self.token_path, "r", encoding="ascii") as f:
-                req.add_header("Authorization", f"Bearer {f.read().strip()}")
+                headers["Authorization"] = f"Bearer {f.read().strip()}"
         except OSError:
             pass  # no token (e.g. test server without auth)
-        ctx = None
-        if url.startswith("https"):
-            ctx = ssl.create_default_context(
-                cafile=self.ca_path if os.path.exists(self.ca_path) else None)
-        try:
-            with urllib.request.urlopen(
-                    req, context=ctx, timeout=self.timeout_s) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as exc:
-            detail = ""
+        for attempt in (0, 1):
+            if attempt == 0:
+                conn, reused = self._get_conn()
+            else:
+                # retry leg: ALWAYS a brand-new connection — popping
+                # another pool member could hit a second stale keep-alive
+                # (apiserver restart with several idle conns) and fail a
+                # request a fresh connection would serve
+                conn, reused = self._new_conn(), False
             try:
-                detail = exc.read().decode("utf-8", "replace")[:300]
-            except OSError:
-                pass
-            raise ApiError(f"{method} {url}: HTTP {exc.code} {detail}",
-                           code=exc.code) from exc
-        except (urllib.error.URLError, OSError) as exc:
-            raise ApiError(f"{method} {url}: {exc}") from exc
+                conn.request(method, self._base_path + path, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as exc:
+                conn.close()
+                if (attempt == 0 and reused
+                        and isinstance(exc, _RETRYABLE_STALE)):
+                    continue   # idled-out keep-alive: one fresh retry
+                raise ApiError(f"{method} {url}: {exc}") from exc
+            if resp.will_close:
+                conn.close()
+            else:
+                self._put_conn(conn)
+            if resp.status >= 400:
+                detail = data.decode("utf-8", "replace")[:300]
+                raise ApiError(
+                    f"{method} {url}: HTTP {resp.status} {detail}",
+                    code=resp.status)
+            return data
+        raise ApiError(f"{method} {url}: retry fell through")  # unreachable
 
     # -- JSON convenience wrappers against resource paths ---------------------
 

@@ -24,7 +24,8 @@ from typing import Dict, List, Sequence
 import grpc
 
 from . import kubeletapi as api
-from .allocate import AllocationError, AllocationPlanner, LiveAttrReader
+from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
+                       live_mdev_type)
 from .config import Config
 from .discovery import read_link_basename
 from .health import HealthMonitor
@@ -133,19 +134,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
 
     def _validate_mdev(self, p: TpuPartition) -> None:
         """Live mdev type must still match this plugin (reference :216-221)."""
-        name_path = os.path.join(self.cfg.mdev_base_path, p.uuid, "mdev_type", "name")
-        raw = self._mdev_name_reader.read(p.uuid, name_path)
-        if raw is None:
-            # failure path only: one diagnostic open to recover the errno
-            # the operator needs (EACCES mount misconfig vs ENOENT gone)
-            try:
-                with open(name_path, "rb"):
-                    detail = "empty or unreadable"
-            except OSError as exc:
-                detail = str(exc)
-            raise AllocationError(
-                f"partition {p.uuid}: mdev vanished ({detail})")
-        live = raw.decode("ascii", "replace").strip().replace(" ", "_")
+        live = live_mdev_type(self._mdev_name_reader, self.cfg, p.uuid)
         if live != self.resource_suffix:
             raise AllocationError(
                 f"partition {p.uuid}: live type {live!r} != {self.resource_suffix!r}")
