@@ -1,0 +1,173 @@
+"""ApiClient unit tests: pool/retry semantics and URL handling.
+
+The keep-alive pool's failure model is load-bearing (duplicate apiserver
+writes on a wrong retry; spurious failures on a right one withheld), so
+the legs are pinned with fake connections rather than a live server —
+tests/test_dra.py covers the live HTTP/1.1 reuse behavior.
+"""
+import http.client
+
+import pytest
+
+from tpu_device_plugin.kubeapi import ApiClient, ApiError
+
+
+class FakeResponse:
+    def __init__(self, status=200, data=b"{}", will_close=False):
+        self.status = status
+        self._data = data
+        self.will_close = will_close
+
+    def read(self):
+        return self._data
+
+
+class FakeConn:
+    """Scripted connection: raises `error` on request, else responds."""
+
+    def __init__(self, error=None, status=200, data=b"ok"):
+        self.error = error
+        self.status = status
+        self.data = data
+        self.requests = []
+        self.closed = False
+
+    def request(self, method, path, body=None, headers=None):
+        self.requests.append((method, path))
+        if self.error is not None:
+            raise self.error
+
+    def getresponse(self):
+        return FakeResponse(self.status, self.data)
+
+    def close(self):
+        self.closed = True
+
+
+def client():
+    return ApiClient("http://example.invalid:1", token_path="/nonexistent")
+
+
+def test_stale_reused_connection_retries_on_brand_new_conn(monkeypatch):
+    """A stale-signature failure on a REUSED conn retries exactly once on
+    a brand-new connection — never on another pool member (a second stale
+    keep-alive after an apiserver restart would fail a request a fresh
+    connection serves)."""
+    c = client()
+    stale = FakeConn(error=BrokenPipeError("server idled out"))
+    fresh = FakeConn(data=b"payload")
+    monkeypatch.setattr(c, "_get_conn", lambda: (stale, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: fresh)
+    assert c.request("/x") == b"payload"
+    assert stale.closed
+    assert fresh.requests == [("GET", "/x")]
+
+
+def test_fresh_connection_failure_does_not_retry(monkeypatch):
+    """The one-attempt contract for fresh connections is kept: retrying
+    would mask a genuinely down server and double every timeout."""
+    c = client()
+    fresh = FakeConn(error=BrokenPipeError("down"))
+    calls = []
+    monkeypatch.setattr(c, "_get_conn",
+                        lambda: (calls.append(1) or fresh, False))
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert len(calls) == 1
+
+
+def test_response_timeout_never_retries_a_write(monkeypatch):
+    """TimeoutError on a reused conn is NOT a stale-keep-alive signature:
+    the server may have processed the request, and replaying a POST would
+    duplicate the write. It surfaces as ApiError without retry."""
+    c = client()
+    conn = FakeConn(error=TimeoutError("read timed out"))
+    news = []
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: news.append(1) or FakeConn())
+    with pytest.raises(ApiError):
+        c.request("/slices", method="POST", body=b"{}")
+    assert news == []          # no second attempt
+
+
+class FakeConnResponsePhaseError(FakeConn):
+    """Send succeeds; the failure happens at getresponse() — the server
+    may have processed the request."""
+
+    def request(self, method, path, body=None, headers=None):
+        self.requests.append((method, path))   # send phase succeeds
+
+    def getresponse(self):
+        raise self.error
+
+
+def test_response_phase_reset_never_retries_a_write(monkeypatch):
+    """A ConnectionResetError AFTER the request was sent may mean the
+    server processed it (restart mid-response): replaying a POST would
+    duplicate the write, so only GET retries in the response phase."""
+    c = client()
+    conn = FakeConnResponsePhaseError(error=ConnectionResetError("reset"))
+    news = []
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: news.append(1) or FakeConn())
+    with pytest.raises(ApiError):
+        c.request("/slices", method="POST", body=b"{}")
+    assert news == []          # POST: no second attempt
+    # ...but a GET retries: its replay cannot duplicate a write
+    conn2 = FakeConnResponsePhaseError(error=ConnectionResetError("reset"))
+    fresh = FakeConn(data=b"payload")
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn2, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: fresh)
+    assert c.request("/slices") == b"payload"
+
+
+def test_redirect_is_an_apierror_not_a_body(monkeypatch):
+    """http.client does not follow redirects (urllib did): a 3xx must
+    surface as ApiError, never as a successful HTML body that get_json
+    would feed to json.loads."""
+    c = client()
+    conn = FakeConn(status=302, data=b"<html>moved</html>")
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, False))
+    with pytest.raises(ApiError) as exc_info:
+        c.request("/x")
+    assert exc_info.value.code == 302
+
+
+def test_http_exception_wrapped_as_apierror(monkeypatch):
+    """IncompleteRead and friends must surface as ApiError (the callers'
+    exception contract), not leak as raw HTTPException."""
+    c = client()
+    conn = FakeConn(error=http.client.IncompleteRead(b"partial"))
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, False))
+    with pytest.raises(ApiError):
+        c.request("/x")
+
+
+def test_http_error_status_carries_code(monkeypatch):
+    c = client()
+    conn = FakeConn(status=404, data=b"not found")
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, False))
+    with pytest.raises(ApiError) as exc_info:
+        c.request("/x")
+    assert exc_info.value.code == 404
+    assert "not found" in str(exc_info.value)
+
+
+def test_server_path_prefix_is_preserved(monkeypatch):
+    """--api-server https://host:6443/apiproxy must hit
+    /apiproxy/apis/..., matching what the pre-pool urllib client sent."""
+    c = ApiClient("http://host:1/apiproxy/", token_path="/nonexistent")
+    conn = FakeConn()
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, False))
+    c.request("/apis/resource.k8s.io")
+    assert conn.requests == [("GET", "/apiproxy/apis/resource.k8s.io")]
+
+
+def test_pool_keeps_bounded_idle_connections():
+    from tpu_device_plugin.kubeapi import MAX_IDLE_CONNECTIONS
+    c = client()
+    conns = [FakeConn() for _ in range(MAX_IDLE_CONNECTIONS + 2)]
+    for conn in conns:
+        c._put_conn(conn)
+    assert len(c._idle) == MAX_IDLE_CONNECTIONS
+    assert sum(1 for x in conns if x.closed) == 2  # overflow closed

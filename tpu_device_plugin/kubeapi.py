@@ -143,14 +143,22 @@ class ApiClient:
                 # (apiserver restart with several idle conns) and fail a
                 # request a fresh connection would serve
                 conn, reused = self._new_conn(), False
+            # The SEND phase and the RESPONSE phase have different retry
+            # safety: a send-phase failure means the server never got the
+            # full request (any method can retry); a response-phase
+            # failure means it may have PROCESSED it, so only GET — whose
+            # replay cannot duplicate a write — retries there.
+            sent = False
             try:
                 conn.request(method, self._base_path + path, body=body,
                              headers=headers)
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
             except (http.client.HTTPException, OSError) as exc:
                 conn.close()
-                if (attempt == 0 and reused
+                retry_safe = (not sent) or method == "GET"
+                if (attempt == 0 and reused and retry_safe
                         and isinstance(exc, _RETRYABLE_STALE)):
                     continue   # idled-out keep-alive: one fresh retry
                 raise ApiError(f"{method} {url}: {exc}") from exc
@@ -163,6 +171,15 @@ class ApiClient:
                 raise ApiError(
                     f"{method} {url}: HTTP {resp.status} {detail}",
                     code=resp.status)
+            if resp.status >= 300:
+                # the pre-pool urllib client auto-followed redirects;
+                # http.client does not, and silently returning a redirect
+                # body would feed HTML into json.loads — surface it as
+                # the transport error it is
+                raise ApiError(
+                    f"{method} {url}: HTTP {resp.status} redirect "
+                    f"(redirects unsupported; point --api-server at the "
+                    f"final URL)", code=resp.status)
             return data
         raise ApiError(f"{method} {url}: retry fell through")  # unreachable
 

@@ -111,14 +111,20 @@ class NodeLabeler:
         self.require_api = require_api
         self.label_prefix = label_prefix
         self._published_keys: set = set()
+        self._api_client: Optional[ApiClient] = None
 
     @staticmethod
     def _in_cluster_server() -> Optional[str]:
         return in_cluster_server()
 
     def _client(self) -> ApiClient:
-        return ApiClient(self.api_server, token_path=self.token_path,
-                         ca_path=self.ca_path)
+        # one client for the labeler's lifetime: the keep-alive pool only
+        # pays off when the publish-retry PATCHes ride the same client
+        if self._api_client is None:
+            self._api_client = ApiClient(self.api_server,
+                                         token_path=self.token_path,
+                                         ca_path=self.ca_path)
+        return self._api_client
 
     def publish(self, facts: Dict[str, str]) -> bool:
         """Write the feature file and/or PATCH node labels; True only when
