@@ -1,0 +1,147 @@
+"""DRA driver concurrency stress: prepares + health churn + inventory swaps.
+
+tests/test_stress.py pressure-tests the classic plugin servers; this suite
+does the same for the DRA driver, whose shared mutable state (checkpoint,
+prune set, sticky name records, publish lock, republish timer) is touched
+from gRPC workers, the plugin servers' health listener, the PluginManager's
+rediscovery callback, and a retry timer thread. Invariants asserted after
+the storm: no exceptions or deadlocks, a prepared claim always resolves to
+the same devices, the final slice reflects the final health state, and the
+checkpoint drains to empty.
+"""
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover
+from tpu_device_plugin.dra import DraDriver, slice_device_name
+from tpu_device_plugin.kubeapi import ApiClient
+from tpu_device_plugin.kubeletapi import drapb
+
+N_CHIPS = 4
+
+
+@pytest.fixture
+def rig(short_root):
+    host = FakeHost(short_root)
+    for i in range(N_CHIPS):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 2))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    apiserver = FakeApiServer()
+    registry, generations = discover(cfg)
+    api = ApiClient(apiserver.url, token_path="/nonexistent-token")
+    driver = DraDriver(cfg, registry, generations, node_name="node-a",
+                       api=api)
+    yield host, cfg, driver, apiserver, registry, generations
+    driver.stop()
+    apiserver.stop()
+
+
+def test_prepare_health_swap_storm(rig):
+    host, cfg, driver, apiserver, registry, generations = rig
+    bdfs = [f"0000:00:{4 + i:02x}.0" for i in range(N_CHIPS)]
+    names = [slice_device_name(b) for b in bdfs]
+    assert driver.publish_resource_slices()
+    stop = threading.Event()
+    errors = []
+
+    def record(exc):
+        errors.append(repr(exc))
+
+    def prepare_worker(seed):
+        """Fresh claim per iteration: prepare must yield exactly the
+        claim's devices, then unprepare must drop the checkpoint entry."""
+        rng = random.Random(seed)
+        n = 0
+        while not stop.is_set():
+            n += 1
+            uid = f"storm-{seed}-{n}"
+            picked = rng.sample(names, 2)
+            apiserver.add_claim("ns", f"c{seed}-{n}", uid,
+                                driver.driver_name,
+                                [{"device": x} for x in picked])
+            claim = drapb.Claim(namespace="ns", name=f"c{seed}-{n}",
+                                uid=uid)
+            try:
+                resp = driver.NodePrepareResources(
+                    drapb.NodePrepareResourcesRequest(claims=[claim]), None)
+                out = resp.claims[uid]
+                if out.error:
+                    record(AssertionError(f"prepare failed: {out.error}"))
+                elif sorted(d.device_name for d in out.devices) \
+                        != sorted(picked):
+                    record(AssertionError(
+                        f"prepare returned wrong devices for {picked}: "
+                        f"{[d.device_name for d in out.devices]}"))
+                driver.NodeUnprepareResources(
+                    drapb.NodeUnprepareResourcesRequest(claims=[claim]),
+                    None)
+            except Exception as exc:
+                record(exc)
+
+    def health_worker():
+        rng = random.Random(7)
+        while not stop.is_set():
+            bdf = rng.choice(bdfs)
+            try:
+                driver.apply_health({bdf: rng.random() < 0.5})
+            except Exception as exc:
+                record(exc)
+            time.sleep(0.005)
+
+    def swap_worker():
+        while not stop.is_set():
+            try:
+                driver.set_inventory(registry, generations)
+            except Exception as exc:
+                record(exc)
+            time.sleep(0.02)
+
+    def publish_worker():
+        while not stop.is_set():
+            try:
+                driver.publish_resource_slices()
+            except Exception as exc:
+                record(exc)
+            time.sleep(0.01)
+
+    threads = ([threading.Thread(target=prepare_worker, args=(i,),
+                                 daemon=True) for i in range(4)]
+               + [threading.Thread(target=health_worker, daemon=True),
+                  threading.Thread(target=swap_worker, daemon=True),
+                  threading.Thread(target=publish_worker, daemon=True)])
+    for t in threads:
+        t.start()
+    time.sleep(3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), "worker deadlocked"
+    assert not errors, errors[:3]
+
+    # names never re-pointed: every published name still maps to its bdf
+    with driver._lock:
+        assert {n: driver._raw_id(k, o)
+                for n, (k, g, o) in driver._by_name.items()} == \
+            dict(zip(names, bdfs))
+    # converge: all healthy again -> final slice carries all devices
+    driver.apply_health({b: True for b in bdfs})
+    assert driver.publish_resource_slices()
+    obj = next(iter(apiserver.slices.values()))
+    assert sorted(d["name"] for d in obj["spec"]["devices"]) == \
+        sorted(names)
+    # checkpoint drained (every prepared claim was unprepared)
+    with driver._lock:
+        assert driver._checkpoint == {}
+    # no orphaned per-claim CDI spec files
+    leftovers = [f for f in os.listdir(driver.cdi_dir)
+                 if "claim" in f] if os.path.isdir(driver.cdi_dir) else []
+    assert leftovers == []

@@ -69,11 +69,14 @@ def test_fresh_connection_failure_does_not_retry(monkeypatch):
     c = client()
     fresh = FakeConn(error=BrokenPipeError("down"))
     calls = []
+    news = []
     monkeypatch.setattr(c, "_get_conn",
                         lambda: (calls.append(1) or fresh, False))
+    monkeypatch.setattr(c, "_new_conn", lambda: news.append(1) or FakeConn())
     with pytest.raises(ApiError):
         c.request("/x")
     assert len(calls) == 1
+    assert news == []   # the retry leg (_new_conn) was never taken
 
 
 def test_response_timeout_never_retries_a_write(monkeypatch):

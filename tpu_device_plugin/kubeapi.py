@@ -90,18 +90,22 @@ class ApiClient:
         self._host = split.hostname or self.server
         self._port = split.port
         self._base_path = split.path.rstrip("/")
-        self._ssl_ctx: Optional[ssl.SSLContext] = None
         self._idle: list = []
         self._pool_lock = threading.Lock()
 
     def _new_conn(self) -> http.client.HTTPConnection:
         if self._https:
-            if self._ssl_ctx is None:
-                self._ssl_ctx = ssl.create_default_context(
-                    cafile=self.ca_path if os.path.exists(self.ca_path)
-                    else None)
+            # context rebuilt per NEW connection (cheap — pooling makes
+            # new connections rare): the projected ca.crt rotates like
+            # the token does, and a cached context would pin the old CA,
+            # failing every handshake after a cluster CA rotation until
+            # pod restart. Established pooled connections are unaffected
+            # by rotation (their handshake is done).
+            ctx = ssl.create_default_context(
+                cafile=self.ca_path if os.path.exists(self.ca_path)
+                else None)
             return http.client.HTTPSConnection(
-                self._host, self._port, context=self._ssl_ctx,
+                self._host, self._port, context=ctx,
                 timeout=self.timeout_s)
         return http.client.HTTPConnection(
             self._host, self._port, timeout=self.timeout_s)
