@@ -150,8 +150,15 @@ def _handler_compute(plugin, all_ids, alloc_size, iterations=2000,
         t2 = time.perf_counter()
         if i >= warmup // 4:
             cold_us.append((t2 - t1) * 1e6)
+    # best-epoch variant alongside the medians: even direct-call numbers
+    # swing with co-tenant load on this single shared core. The alloc and
+    # cold series are timed in SEPARATE loops, so the sum of their minima
+    # is a lower bound no single quiet window necessarily achieved —
+    # slightly optimistic vs best_epoch_p50_us (min over one contiguous
+    # series). NOT the headline — the 41 us round-3 anchor was a median.
+    best = (_min_epoch_p50(alloc_us), _min_epoch_p50(cold_us))
     return (statistics.median(pref_us), statistics.median(alloc_us),
-            statistics.median(cold_us))
+            statistics.median(cold_us), best)
 
 
 def _dra_prepare_bench(root, registry, generations, iterations=150,
@@ -222,8 +229,8 @@ def run_config1(root):
     with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
         stub = api.DevicePluginStub(ch)
         pref_us, attach_us = _attach_path(stub, all_ids, 4, ITERATIONS, WARMUP)
-    handler_pref_us, handler_alloc_us, handler_pref_cold_us = \
-        _handler_compute(plugin, all_ids, 4)
+    (handler_pref_us, handler_alloc_us, handler_pref_cold_us,
+     handler_best) = _handler_compute(plugin, all_ids, 4)
     server.stop(0)
 
     # secondary: vTPU partition Allocate p50 (mdev path with live sysfs
@@ -293,6 +300,10 @@ def run_config1(root):
         "handler_preferred_cold_us": round(handler_pref_cold_us, 1),
         "handler_preferred_warm_us": round(handler_pref_us, 1),
         "handler_allocate_us": round(handler_alloc_us, 1),
+        # min of per-epoch medians per series (cold pref + allocate, timed
+        # in separate loops — a jointly-optimistic lower bound), reported
+        # alongside the median headline, never as it
+        "handler_best_epoch_us": round(sum(handler_best), 1),
         "wall_p50_us": round(p50, 1),
         "wall_vs_round1": round(round1_p50_us / p50, 3),
         "preferred_allocation_p50_us": round(pref_p50, 1),
