@@ -31,6 +31,7 @@ PCI devices for QEMU).
 
 Output: docs/e2e_kubevirt_r05.log; exit 0 iff every assertion held.
 """
+import glob
 import json
 import os
 import re
@@ -43,10 +44,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
+import grpc  # noqa: E402
 import yaml  # noqa: E402
 
 from make_fixture_host import build as build_fixture  # noqa: E402
 from kubelet_sim import DeviceManagerSim  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
 
 # The same whitelist e2e_kind.sh patches into the KubeVirt CR.
 PERMITTED_HOST_DEVICES = {
@@ -117,11 +120,14 @@ def main():
     sim = DeviceManagerSim(kubelet_dir)
     log("kubelet devicemanager sim listening (tests/kubelet_sim.py)")
 
-    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    apiserver = FakeApiServer()
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               NODE_NAME="kv-e2e-node")
     daemon = subprocess.Popen(
-        [sys.executable, "-m", "tpu_device_plugin", "--root", root, "-v"],
+        [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+         "--dra", "--api-server", apiserver.url, "-v"],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    log("real plugin daemon launched (python -m tpu_device_plugin)")
+    log("real plugin daemon launched (python -m tpu_device_plugin --dra)")
 
     try:
         resource = "cloud-tpus.google.com/v4"
@@ -183,8 +189,78 @@ def main():
             fail(f"no per-IOMMU-group /dev/vfio/<group> mount: {mounts}")
         log(f"device mounts OK: {mounts}")
 
+        # ---- DRA leg: KubeVirt's forward path (structured resources).
+        # The same daemon publishes a ResourceSlice; a scheduler-sim
+        # allocates one chip to a claim, and the kubelet-side prepare is
+        # driven over the daemon's REAL dra.sock. The prepared claim must
+        # carry the same PCI_RESOURCE env contract through its CDI spec.
+        from tpu_device_plugin.config import Config
+        from tpu_device_plugin.kubeletapi import draapi, drapb
+
+        cfg = Config().with_root(root)
+        # matches DraDriver's default (dra.py: cfg.cdi_spec_dir fallback)
+        cdi_dir = cfg.cdi_spec_dir or os.path.join(root, "var/run/cdi")
+        deadline = time.time() + 30
+        while time.time() < deadline and not apiserver.slices:
+            time.sleep(0.25)
+        if not apiserver.slices:
+            fail("daemon never published a ResourceSlice")
+        slice_obj = next(iter(apiserver.slices.values()))
+        slice_devs = [d["name"] for d in slice_obj["spec"]["devices"]]
+        if not slice_devs:
+            fail("published ResourceSlice carries zero devices")
+        chip = slice_devs[0]
+        log(f"ResourceSlice published; scheduler-sim allocates {chip!r}")
+        apiserver.add_claim("default", "vmi-tpu-claim", "uid-kv-1",
+                            "cloud-tpus.google.com",
+                            [{"device": chip}])
+        dra_sock = os.path.join(cfg.dra_plugins_path,
+                                "cloud-tpus.google.com", "dra.sock")
+        claim = drapb.Claim(namespace="default", name="vmi-tpu-claim",
+                            uid="uid-kv-1")
+        try:
+            with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+                stub = draapi.DraPluginStub(ch)
+                dresp = stub.NodePrepareResources(
+                    drapb.NodePrepareResourcesRequest(claims=[claim]),
+                    timeout=10)
+                out = dresp.claims["uid-kv-1"]
+                if out.error:
+                    fail(f"DRA prepare failed: {out.error}")
+                if len(out.devices) != 1:
+                    fail(f"DRA prepare returned {len(out.devices)} devices")
+                log(f"DRA claim PREPARED over dra.sock: {chip!r} "
+                    f"(cdi {list(out.devices[0].cdi_device_ids)})")
+                specs = glob.glob(
+                    os.path.join(cdi_dir, "*claim-uid-kv-1.json"))
+                if len(specs) != 1:
+                    fail(f"expected one per-claim CDI spec, found {specs}")
+                with open(specs[0], encoding="utf-8") as f:
+                    spec = json.load(f)
+                spec_envs = [
+                    e for d in spec.get("devices", [])
+                    for e in d.get("containerEdits", {}).get("env", [])]
+                if not any(e.startswith(key + "=") and "0000:" in e
+                           for e in spec_envs):
+                    fail(f"per-claim CDI spec lacks the {key} env: "
+                         f"{spec_envs}")
+                log(f"per-claim CDI spec carries the env contract: "
+                    f"{[e for e in spec_envs if e.startswith(key)]}")
+                uresp = stub.NodeUnprepareResources(
+                    drapb.NodeUnprepareResourcesRequest(claims=[claim]),
+                    timeout=10)
+                if uresp.claims["uid-kv-1"].error:
+                    fail(f"DRA unprepare failed: "
+                         f"{uresp.claims['uid-kv-1'].error}")
+                if glob.glob(os.path.join(cdi_dir, "*claim-uid-kv-1.json")):
+                    fail("CDI spec not removed on unprepare")
+                log("DRA claim UNPREPARED; per-claim CDI spec removed")
+        except grpc.RpcError as exc:
+            fail(f"DRA leg RPC failed: {exc.code()}: {exc.details()}")
+
         log("KUBEVIRT CONTRACT PASS: virt-launcher admitted with the TPU "
-            "resource + PCI_RESOURCE env (LOCAL SUBSET: real daemon + "
+            "resource + PCI_RESOURCE env on BOTH the classic device-plugin "
+            "path and the DRA claim path (LOCAL SUBSET: real daemon + "
             "faithful kubelet sim + simulated virt-controller render; "
             "kind/docker unavailable in this build env — the full-cluster "
             "stage remains scripts/e2e_kind.sh KUBEVIRT=1)")
@@ -196,6 +272,7 @@ def main():
             daemon.wait(timeout=10)
         except subprocess.TimeoutExpired:
             daemon.kill()
+        apiserver.stop()
 
 
 if __name__ == "__main__":
