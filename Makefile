@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local
+.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive
 
 all: native proto
 
@@ -32,6 +32,12 @@ test:
 # scripts/e2e_kind.sh KUBEVIRT=1.
 e2e-kubevirt-local:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/e2e_kubevirt_local.py
+
+# Canonical build-and-drive check: full daemon against a fake host, driven
+# as the kubelet would, asserting the end-to-end health prune/restore loop
+# across ListAndWatch AND the published ResourceSlice.
+verify-drive:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/verify_drive.py
 
 # Enforced coverage (reference: Makefile:59-61 + golang.yml Coveralls job).
 # The image ships no pytest-cov, so the collector is a stdlib sys.monitoring

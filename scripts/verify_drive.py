@@ -1,0 +1,115 @@
+"""Full-daemon verify drive: registration + DRA + health prune/restore.
+
+The repo's canonical build-and-drive check (`make verify-drive`): launch
+the real daemon against a fake host, drive it as the kubelet would
+(tests/kubelet_sim.py), and assert the end-to-end health loop — a deleted
+vfio group node prunes the chip from both the ListAndWatch stream and the
+published ResourceSlice; recreating it restores both. Exit 0 iff every
+stage passed.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from fakehost import FakeChip, FakeHost  # noqa: E402
+from kubelet_sim import DeviceManagerSim  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
+
+root = tempfile.mkdtemp(prefix="vfy-", dir="/tmp")
+fh = FakeHost(root)
+for i in range(4):
+    fh.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                         iommu_group=str(10 + i), numa_node=i // 2))
+
+os.makedirs(os.path.join(root, "device-plugins"), exist_ok=True)
+sim = DeviceManagerSim(os.path.join(root, "device-plugins"))
+api = FakeApiServer()
+
+port = 18123
+env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+           NODE_NAME="node-a")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+     "--dra", "--api-server", api.url, "--status-port", str(port),
+     "--health-poll-seconds", "0.3", "-v"],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def status():
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=2) as r:
+        return json.load(r)
+
+
+def wait_for(pred, what, timeout=30):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        try:
+            if pred():
+                print(f"OK: {what}")
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise SystemExit(f"FAIL: timeout waiting for {what}")
+
+
+try:
+    wait_for(lambda: status(), "daemon up (/status serving)")
+    rname = None
+
+    def have_resource():
+        global rname
+        eps = list(sim.endpoints)
+        if eps:
+            rname = eps[0]
+            return sim.endpoints[rname].updates > 0
+        return False
+
+    wait_for(have_resource, "plugin registered + ListAndWatch streaming")
+    wait_for(lambda: sim.allocatable(rname) == 4, "4 healthy devices")
+    wait_for(lambda: api.slices, "ResourceSlice published")
+    obj = next(iter(api.slices.values()))
+    devs = [d["name"] for d in obj["spec"]["devices"]]
+    assert len(devs) == 4, devs
+    print("OK: slice has 4 devices:", devs)
+
+    ids, resp = sim.admit_pod(rname, 2)
+    nspecs = len(resp.container_responses[0].devices)
+    assert nspecs >= 2, nspecs
+    print(f"OK: pod admission allocated {ids} -> {nspecs} device specs")
+
+    victim = os.path.join(root, "dev/vfio/10")
+    os.unlink(victim)
+    wait_for(lambda: status()["dra"]["unhealthy_devices"],
+             "DRA prunes dead chip", timeout=20)
+    wait_for(lambda: sim.allocatable(rname) == 3,
+             "kubelet sees 3 healthy after fault")
+    wait_for(lambda: len(next(iter(api.slices.values()))
+                         ["spec"]["devices"]) == 3,
+             "slice devices -> 3 after prune")
+    with open(victim, "w"):
+        pass
+    wait_for(lambda: not status()["dra"]["unhealthy_devices"],
+             "chip restored after node recreate", timeout=20)
+    wait_for(lambda: len(next(iter(api.slices.values()))
+                         ["spec"]["devices"]) == 4,
+             "slice devices -> 4 after restore")
+    wait_for(lambda: sim.allocatable(rname) == 4,
+             "kubelet sees 4 healthy again")
+    print("VERIFY PASS")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    api.stop()
