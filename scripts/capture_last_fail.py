@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Preserve the latest COMPLETE validator attempt record.
+
+The attempt loop truncates docs/validator_tpu_train_r05.json at attempt
+start, so a complete record only exists in the ~30 s window between
+attempts. This watcher polls and copies any parseable record to
+docs/validator_tpu_train_r05_last.json so the round always ends with a
+full artifact (success or the structured failure signature), not a
+zero-byte truncation snapshot. Exits when .stop_tpu_attempts appears and
+the loop has wound down, or after --max-hours.
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "docs", "validator_tpu_train_r05.json")
+DST = os.path.join(REPO, "docs", "validator_tpu_train_r05_last.json")
+SENTINEL = os.path.join(REPO, ".stop_tpu_attempts")
+
+
+def main() -> int:
+    max_s = float(sys.argv[sys.argv.index("--max-hours") + 1]) * 3600 \
+        if "--max-hours" in sys.argv else 12 * 3600
+    deadline = time.time() + max_s
+    last = None
+    while time.time() < deadline:
+        try:
+            with open(SRC, encoding="utf-8") as f:
+                obj = json.load(f)
+            blob = json.dumps(obj, sort_keys=True)
+            if blob != last:
+                shutil.copyfile(SRC, DST)
+                last = blob
+        except (OSError, ValueError):
+            pass   # absent, truncated, or mid-write — try again
+        if os.path.exists(SENTINEL):
+            break
+        time.sleep(5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

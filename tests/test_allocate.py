@@ -185,3 +185,49 @@ def test_iommufd_missing_cdev_fails_fast(tmp_path):
     cfg, registry = setup(host)
     with pytest.raises(allocate.AllocationError, match="no vfio-dev cdev"):
         allocate.plan_allocation(cfg, registry, "v4", ["0000:00:04.0"])
+
+
+# ------------------------------------------------------- LiveAttrReader
+
+
+def test_live_attr_reader_rereads_in_place_writes(tmp_path):
+    """pread on the kept fd sees content rewritten IN PLACE (same inode)
+    — the live-read property the TOCTOU guards rely on."""
+    p = str(tmp_path / "vendor")
+    with open(p, "w") as f:
+        f.write("0x1ae0\n")
+    r = allocate.LiveAttrReader()
+    assert r.read("k", p) == b"0x1ae0\n"
+    with open(p, "w") as f:           # truncate+write: same inode
+        f.write("0xdead\n")
+    assert r.read("k", p) == b"0xdead\n"
+    assert len(r._fds) == 1           # still the cached fd
+
+
+def test_live_attr_reader_detects_unlink_recreate(tmp_path):
+    """unlink does not invalidate an open fd on a regular filesystem; the
+    st_nlink==0 check must force a fresh open so the NEW inode is read."""
+    p = str(tmp_path / "vendor")
+    with open(p, "w") as f:
+        f.write("old\n")
+    r = allocate.LiveAttrReader()
+    assert r.read("k", p) == b"old\n"
+    os.unlink(p)
+    with open(p, "w") as f:
+        f.write("new\n")
+    assert r.read("k", p) == b"new\n"
+
+
+def test_live_attr_reader_gone_and_empty_are_none(tmp_path):
+    p = str(tmp_path / "vendor")
+    r = allocate.LiveAttrReader()
+    assert r.read("k", p) is None     # absent
+    with open(p, "w"):
+        pass
+    assert r.read("k", p) is None     # empty: None, never cached
+    assert r._fds == {}
+    with open(p, "w") as f:
+        f.write("now\n")
+    assert r.read("k", p) == b"now\n"
+    os.unlink(p)
+    assert r.read("k", p) is None     # gone again after being cached
