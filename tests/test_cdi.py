@@ -226,3 +226,59 @@ def test_prune_stale_specs(host2, tmp_path):
     assert os.path.basename(kept) in left
     assert os.path.basename(stale) not in left
     assert "other-vendor.json" in left  # never touches foreign specs
+
+
+# --------------------------------------------------- failure degradation
+
+
+def test_write_spec_unwritable_dir_degrades_to_none(host2, tmp_path):
+    """A failed spec write returns None (the resource then stays on the
+    classic DeviceSpec path) instead of raising into plugin startup."""
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("file where the spec dir should be")
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(blocker))
+    assert cdi.write_spec(cfg, [], "v5e") is None
+
+
+def test_write_spec_replace_failure_cleans_tmp(host2, tmp_path,
+                                               monkeypatch):
+    """os.replace failing mid-write must return None AND remove the temp
+    file — a litter of .tmp files in /var/run/cdi would confuse CDI-spec
+    scanners."""
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated replace failure")
+
+    monkeypatch.setattr(os, "replace", boom)
+    assert cdi.write_spec(cfg, [], "v5e") is None
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert [f for f in os.listdir(tmp_path / "cdi")
+            if f.endswith(".tmp")] == []
+
+
+def test_prune_specs_missing_dir_is_quiet(host2, tmp_path):
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "never-created"))
+    cdi.prune_specs(cfg, [])          # must not raise
+
+
+def test_prune_specs_unlink_failure_is_nonfatal(host2, tmp_path,
+                                                monkeypatch):
+    """One stubborn stale spec must not abort pruning (or the plugin)."""
+    cfg = replace(Config().with_root(host2.root),
+                  cdi_spec_dir=str(tmp_path / "cdi"))
+    os.makedirs(cfg.cdi_spec_dir, exist_ok=True)
+    stale = os.path.join(cfg.cdi_spec_dir,
+                         "cloud-tpus.google.com-stale.json")
+    with open(stale, "w") as f:
+        f.write("{}")
+
+    def boom(path):
+        raise OSError("simulated unlink failure")
+
+    monkeypatch.setattr(os, "unlink", boom)
+    cdi.prune_specs(cfg, [])          # must not raise
