@@ -113,6 +113,21 @@ def test_discover_only_prints_inventory(host, capsys):
     assert payload["node_facts"]
 
 
+def test_python_dash_m_entrypoint(host, capsys, monkeypatch):
+    """`python -m tpu_device_plugin` (the deployed invocation,
+    manifests/*.yaml command) reaches cli.main through the __main__ shim."""
+    import runpy
+    import sys
+    _, root = host
+    monkeypatch.setattr(sys, "argv",
+                        ["tpu_device_plugin", "--root", root,
+                         "--discover-only"])
+    with pytest.raises(SystemExit) as exc_info:
+        runpy.run_module("tpu_device_plugin", run_name="__main__")
+    assert exc_info.value.code == 0
+    assert json.loads(capsys.readouterr().out)["node_facts"]
+
+
 # ----------------------------------------------------- full daemon runs
 
 
