@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive
+.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
 
 all: native proto
 
@@ -25,6 +25,19 @@ proto: proto/deviceplugin_v1beta1.proto proto/dra_v1beta1.proto proto/pluginregi
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# Seeded chaos suite (docs/fault-injection.md): randomized kubelet-restart
+# storms, flapping /dev/vfio nodes, apiserver 5xx/timeout bursts — fixed
+# seed so failures replay. The long soak variant is @pytest.mark.slow and
+# env-gated; `chaos` runs the fast schedule that tier-1 also includes.
+CHAOS_SEED ?= 1337
+chaos:
+	TDP_CHAOS_SEED=$(CHAOS_SEED) JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_chaos.py -q
+
+chaos-soak:
+	TDP_CHAOS_SOAK=1 TDP_CHAOS_SEED=$(CHAOS_SEED) JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_chaos.py -q
 
 # KubeVirt externalResourceProvider contract, no cluster required: real
 # daemon + faithful kubelet sim + simulated virt-controller render

@@ -40,6 +40,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs a real TPU backend (TDP_TPU_TESTS=1)")
+    config.addinivalue_line(
+        "markers", "slow: long randomized chaos soak (TDP_CHAOS_SOAK=1; "
+                   "run via `make chaos-soak`)")
+
+
+class FakeClock:
+    """Injectable monotonic clock for CircuitBreaker tests — advance time
+    without sleeping (used by test_resilience.py and test_kubeapi.py)."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
 
 
 @pytest.fixture

@@ -1,0 +1,407 @@
+"""Chaos suite: seeded, randomized failure schedules asserting invariants.
+
+Turns the repo's robustness claims into executed tests. Failures are
+injected two ways — from outside (deleting sockets and device nodes,
+stopping the fake kubelet, like a hostile node would) and from inside via
+the faults registry (tpu_device_plugin/faults.py) at the named sites the
+production code consults. Every schedule is drawn from a seeded RNG
+($TDP_CHAOS_SEED, default 1337) so a failure replays exactly.
+
+Invariants checked:
+  - kubelet restart storm → every plugin eventually re-registers and
+    advertises its full healthy device set;
+  - injected registration failures → the jittered restart loop retries
+    until the kubelet accepts (and the typed-error path logs it right);
+  - flapping /dev/vfio nodes (with inotify event drops injected) → no
+    device is permanently lost once its node is back;
+  - API-server failure bursts → the ApiClient circuit breaker trips,
+    publishes fail fast while open, recovery goes through the half-open
+    probe, and NO apiserver write is ever duplicated;
+  - drain state survives a kubelet restart storm.
+
+The long randomized soak variant is @pytest.mark.slow and additionally
+gated on TDP_CHAOS_SOAK=1 so `make test` (tier-1) stays fast; run it via
+`make chaos-soak`.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import replace
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost, FakeKubelet
+from tests.kubelet_sim import DeviceManagerSim
+from tpu_device_plugin import faults
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.lifecycle import PluginManager
+from tpu_device_plugin.resilience import BackoffPolicy, CircuitBreaker
+
+SEED = int(os.environ.get("TDP_CHAOS_SEED", "1337"))
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    faults.seed(SEED)
+    yield
+    faults.reset()
+
+
+def _wait(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _fast_restart_policies(manager, rng):
+    """Swap each plugin's restart backoff for a seeded, fast policy so a
+    storm round resolves in tenths of seconds instead of tens."""
+    for plugin in manager.plugins:
+        plugin._restart_backoff = BackoffPolicy(
+            base_s=0.05, cap_s=0.4, rng=random.Random(rng.random()))
+
+
+def _make_node(root, chips):
+    host = FakeHost(root)
+    for chip in chips:
+        host.add_chip(chip)
+    cfg = replace(Config().with_root(root),
+                  health_poll_s=0.1, grpc_timeout_s=1.0)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    return host, cfg
+
+
+TWO_MODEL_CHIPS = [
+    FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"),
+    FakeChip("0000:00:05.0", device_id="0062", iommu_group="12"),
+    FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"),
+    FakeChip("0000:01:01.0", device_id="0063", iommu_group="22"),
+]
+
+
+@pytest.fixture
+def node(short_root):
+    """Two-resource node (2 chips each) + devicemanager sim + manager."""
+    host, cfg = _make_node(short_root, TWO_MODEL_CHIPS)
+    sim = DeviceManagerSim(cfg.device_plugin_path)
+    manager = PluginManager(cfg)
+    manager.start()
+    assert not manager.pending
+    yield host, cfg, sim, manager
+    manager.stop()
+    sim.stop()
+
+
+def _kubelet_restart(cfg, sim, manager, rng, down_s):
+    """One kubelet bounce: server gone, socket dir wiped, then back."""
+    sim.stop()
+    try:
+        os.unlink(cfg.kubelet_socket)
+    except FileNotFoundError:
+        pass
+    for plugin in manager.plugins:
+        try:
+            os.unlink(plugin.socket_path)
+        except FileNotFoundError:
+            pass
+    time.sleep(down_s)
+    return DeviceManagerSim(cfg.device_plugin_path)
+
+
+def test_kubelet_restart_storm_every_plugin_reregisters(node):
+    host, cfg, sim, manager = node
+    rng = random.Random(SEED)
+    _fast_restart_policies(manager, rng)
+    resources = sorted(p.resource_name for p in manager.plugins)
+    assert len(resources) == 2
+    for r in resources:
+        assert sim.wait_for_resource(r), f"{r} never registered"
+
+    for round_no in range(3):
+        sim = _kubelet_restart(cfg, sim, manager, rng,
+                               down_s=rng.uniform(0.05, 0.4))
+        for r in resources:
+            assert sim.wait_for_resource(r, timeout=20), \
+                f"round {round_no}: {r} did not re-register"
+            assert sim.wait_for_allocatable(r, 2, timeout=20), \
+                f"round {round_no}: {r} lost devices after re-registration"
+    # the node still admits pods after the storm
+    picked, resp = sim.admit_pod(resources[0], 2)
+    assert len(picked) == 2
+    assert resp.container_responses[0].devices
+    sim.stop()
+
+
+def test_injected_registration_failures_eventually_register(short_root):
+    """faults: kubelet.register armed for 3 failures — the restart loop's
+    jittered backoff must absorb them and register on the 4th try."""
+    host, cfg = _make_node(short_root, TWO_MODEL_CHIPS[:1])
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert not manager.pending
+        (plugin,) = manager.plugins
+        plugin._restart_backoff = BackoffPolicy(
+            base_s=0.02, cap_s=0.1, rng=random.Random(SEED))
+        assert kubelet.wait_for(1, timeout=5)
+        faults.arm("kubelet.register", kind="error", count=3)
+        os.unlink(plugin.socket_path)          # kubelet "restart"
+        assert kubelet.wait_for(2, timeout=15), \
+            "plugin never re-registered through the injected failures"
+        assert faults.stats().get("kubelet.register") == 3
+        assert plugin.status_snapshot()[
+            "restart_backoff"]["total_attempts"] >= 3
+    finally:
+        manager.stop()
+        kubelet.stop()
+
+
+def test_boot_race_uses_typed_kubelet_unavailable(short_root, caplog):
+    """No kubelet at start(): the failure must surface as the typed
+    KubeletUnavailable (routine boot race, logged at INFO by lifecycle),
+    not a bare grpc exception logged as an error."""
+    import logging
+
+    host, cfg = _make_node(short_root, TWO_MODEL_CHIPS[:1])
+    manager = PluginManager(cfg)
+    with caplog.at_level(logging.INFO, logger="tpu_device_plugin.lifecycle"):
+        manager.start()                         # no kubelet listening
+    try:
+        assert manager.pending, "start should have left the plugin pending"
+        records = [r for r in caplog.records
+                   if "kubelet not ready" in r.getMessage()]
+        assert records and all(r.levelno == logging.INFO for r in records)
+        # kubelet appears -> pending start succeeds
+        kubelet = FakeKubelet(cfg.kubelet_socket)
+        try:
+            manager._try_start_pending()
+            assert not manager.pending
+            assert kubelet.wait_for(1, timeout=5)
+        finally:
+            kubelet.stop()
+    finally:
+        manager.stop()
+
+
+def test_vfio_flap_no_device_permanently_lost(node):
+    """Seeded flapping of /dev/vfio group nodes — with inotify event drops
+    injected underneath — must never lose a device whose node came back:
+    the periodic existence scan reconciles what inotify missed."""
+    host, cfg, sim, manager = node
+    rng = random.Random(SEED + 1)
+    resources = sorted(p.resource_name for p in manager.plugins)
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=10)
+
+    groups = ["11", "12", "21", "22"]
+    # drop ~30% of inotify batches for the whole flap schedule
+    faults.arm("inotify.poll", kind="drop", count=None, probability=0.3)
+    down: set = set()
+    for _ in range(12):
+        g = rng.choice(groups)
+        if g in down:
+            with open(os.path.join(host.devfs, "vfio", g), "w"):
+                pass
+            down.discard(g)
+        else:
+            host.remove_vfio_group(g)
+            down.add(g)
+        time.sleep(rng.uniform(0.01, 0.15))
+    # restore everything; every device must come back
+    for g in sorted(down):
+        with open(os.path.join(host.devfs, "vfio", g), "w"):
+            pass
+    faults.disarm("inotify.poll")
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=20), \
+            f"{r} lost devices permanently after flapping stopped"
+
+
+def test_native_probe_fault_prunes_then_recovers(node):
+    """An injected native-probe failure marks the chip Unhealthy on the
+    stream; once the fault budget is exhausted the next poll restores it."""
+    host, cfg, sim, manager = node
+    resources = sorted(p.resource_name for p in manager.plugins)
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=10)
+    # each poll probes every bdf; 2 polls x 4 chips — bound the budget so
+    # exactly one resource's chips flap for ~2 poll cycles
+    faults.arm("native.probe", kind="false", count=8)
+    dropped = _wait(lambda: any(sim.allocatable(r) < 2 for r in resources),
+                    timeout=10)
+    assert dropped, "injected probe failures never surfaced as Unhealthy"
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=20), \
+            f"{r} did not recover after probe faults exhausted"
+
+
+def test_drain_survives_kubelet_restart_storm(node):
+    host, cfg, sim, manager = node
+    rng = random.Random(SEED + 2)
+    _fast_restart_policies(manager, rng)
+    resources = sorted(p.resource_name for p in manager.plugins)
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=10)
+    manager.drain(True)
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 0, timeout=10)
+    sim = _kubelet_restart(cfg, sim, manager, rng, down_s=0.1)
+    for r in resources:
+        assert sim.wait_for_resource(r, timeout=20)
+        # re-registered, but the drain verdict must still hold: the fresh
+        # initial ListAndWatch snapshot advertises every device Unhealthy
+        assert sim.allocatable(r) == 0, \
+            f"{r}: drain state lost across kubelet restart"
+    manager.drain(False)
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=10)
+    sim.stop()
+
+
+# ------------------------------------------------------- apiserver chaos
+
+
+@pytest.fixture
+def dra_rig(short_root):
+    from tests.test_dra import FakeApiServer
+    from tpu_device_plugin.discovery import discover
+    from tpu_device_plugin.dra import DraDriver
+    from tpu_device_plugin.kubeapi import ApiClient
+
+    host, cfg = _make_node(short_root, TWO_MODEL_CHIPS[:2])
+    apiserver = FakeApiServer()
+    breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.15,
+                             name="chaos")
+    api = ApiClient(apiserver.url, token_path="/nonexistent-token",
+                    breaker=breaker)
+    registry, generations = discover(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="chaos-node",
+                       api=api)
+    driver.republish_backoff = BackoffPolicy(
+        base_s=0.02, cap_s=0.1, rng=random.Random(SEED))
+    yield host, cfg, apiserver, driver, breaker
+    driver.stop()
+    apiserver.stop()
+
+
+def _slice_writes(apiserver):
+    return [(m, p) for (m, p) in apiserver.requests
+            if m in ("POST", "PUT") and "resourceslices" in p]
+
+
+def test_apiserver_burst_trips_breaker_and_recovers_without_dup_writes(
+        dra_rig):
+    from tpu_device_plugin.kubeapi import ApiError
+
+    host, cfg, apiserver, driver, breaker = dra_rig
+    assert driver.publish_resource_slices()
+    assert len(_slice_writes(apiserver)) == 1          # the initial POST
+
+    # 5xx burst: every request fails before the wire until disarmed
+    faults.arm("kubeapi.request", count=None,
+               exc=lambda: ApiError("injected 503", code=503))
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    # the failed republish self-arms its jittered retry; successive retry
+    # failures must trip the breaker
+    assert _wait(lambda: breaker.snapshot()["state"] == "open", timeout=10), \
+        "breaker never tripped under the injected failure burst"
+
+    # while open: publishes fail fast without touching the apiserver
+    requests_at_open = len(apiserver.requests)
+    assert driver.publish_resource_slices() is False
+    assert len(apiserver.requests) == requests_at_open
+
+    # burst ends; the retry loop must recover through the half-open probe
+    faults.disarm("kubeapi.request")
+    assert _wait(lambda: len(_slice_writes(apiserver)) >= 2, timeout=10), \
+        "slice never republished after the burst ended"
+    assert _wait(lambda: breaker.snapshot()["state"] == "closed", timeout=5)
+    assert _wait(lambda: driver._republish_timer is None, timeout=5)
+
+    # invariants: the pruned slice landed, exactly once — one POST
+    # (create) + one PUT (prune), zero duplicated writes
+    (slice_obj,) = apiserver.slices.values()
+    assert len(slice_obj["spec"]["devices"]) == 1
+    assert slice_obj["spec"]["pool"]["generation"] == 2
+    assert _slice_writes(apiserver) == [
+        ("POST", w[1]) if i == 0 else ("PUT", w[1])
+        for i, w in enumerate(_slice_writes(apiserver))]
+    assert len(_slice_writes(apiserver)) == 2
+
+
+def test_apiserver_timeout_burst_never_duplicates_writes(dra_rig):
+    """TimeoutError-kind faults at the transport: the client must never
+    replay a write (the kubeapi.py:30 duplicate-write hazard), so after
+    recovery each logical publish still lands exactly once."""
+    host, cfg, apiserver, driver, breaker = dra_rig
+    assert driver.publish_resource_slices()
+    faults.arm("kubeapi.request", kind="timeout", count=4)
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    # retries burn the 4-fault budget, then the next retry succeeds
+    assert _wait(lambda: len(_slice_writes(apiserver)) >= 2, timeout=10)
+    assert _wait(lambda: driver._republish_timer is None, timeout=5)
+    writes = _slice_writes(apiserver)
+    assert len(writes) == 2                      # POST + exactly one PUT
+    (slice_obj,) = apiserver.slices.values()
+    assert len(slice_obj["spec"]["devices"]) == 1
+
+
+# --------------------------------------------------------------- soak
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("TDP_CHAOS_SOAK") != "1",
+                    reason="soak: set TDP_CHAOS_SOAK=1 (make chaos-soak)")
+def test_soak_mixed_failure_schedule(node):
+    """Long randomized mix of kubelet bounces, vfio flaps, probe faults
+    and inotify drops. Invariant at the end of every round: all resources
+    re-register and recover their full device set."""
+    host, cfg, sim, manager = node
+    rng = random.Random(SEED + 3)
+    _fast_restart_policies(manager, rng)
+    resources = sorted(p.resource_name for p in manager.plugins)
+    groups = ["11", "12", "21", "22"]
+    for r in resources:
+        assert sim.wait_for_allocatable(r, 2, timeout=10)
+
+    for round_no in range(8):
+        action = rng.choice(["kubelet", "flap", "probe", "inotify"])
+        if action == "kubelet":
+            sim = _kubelet_restart(cfg, sim, manager, rng,
+                                   down_s=rng.uniform(0.05, 0.5))
+        elif action == "flap":
+            downed = rng.sample(groups, k=rng.randint(1, len(groups)))
+            for g in downed:
+                host.remove_vfio_group(g)
+            time.sleep(rng.uniform(0.05, 0.3))
+            for g in downed:
+                with open(os.path.join(host.devfs, "vfio", g), "w"):
+                    pass
+        elif action == "probe":
+            faults.arm("native.probe", kind="false",
+                       count=rng.randint(1, 8), probability=0.5)
+            time.sleep(rng.uniform(0.1, 0.4))
+            faults.disarm("native.probe")
+        else:
+            faults.arm("inotify.poll", kind="drop", count=None,
+                       probability=0.5)
+            g = rng.choice(groups)
+            host.remove_vfio_group(g)
+            time.sleep(rng.uniform(0.05, 0.3))
+            with open(os.path.join(host.devfs, "vfio", g), "w"):
+                pass
+            faults.disarm("inotify.poll")
+        for r in resources:
+            assert sim.wait_for_resource(r, timeout=30), \
+                f"soak round {round_no} ({action}): {r} not registered"
+            assert sim.wait_for_allocatable(r, 2, timeout=30), \
+                f"soak round {round_no} ({action}): {r} degraded"
+    sim.stop()

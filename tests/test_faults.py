@@ -1,0 +1,162 @@
+"""Unit tests for the fault-injection registry (faults.py): arming
+semantics (kind/count/probability), the disarmed fast path, env/spec
+parsing, and the instrumented production sites' local behavior.
+"""
+
+import pytest
+
+from tpu_device_plugin import faults
+from tpu_device_plugin.faults import FaultInjected
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def test_disarmed_fire_is_false_noop():
+    assert faults.fire("anything") is False
+    assert faults.stats() == {}
+
+
+def test_error_kind_raises_and_count_exhausts():
+    faults.arm("site.a", kind="error", count=2)
+    with pytest.raises(FaultInjected):
+        faults.fire("site.a")
+    with pytest.raises(FaultInjected):
+        faults.fire("site.a")
+    assert faults.fire("site.a") is False       # budget exhausted, disarmed
+    assert faults.stats() == {"site.a": 2}
+    assert faults.armed_sites() == {}
+
+
+def test_value_kind_returns_true_without_raising():
+    faults.arm("site.b", kind="drop", count=1)
+    assert faults.fire("site.b") is True
+    assert faults.fire("site.b") is False
+
+
+def test_timeout_and_oserror_kinds():
+    faults.arm("t", kind="timeout")
+    with pytest.raises(TimeoutError):
+        faults.fire("t")
+    faults.arm("o", kind="oserror")
+    with pytest.raises(ConnectionResetError):
+        faults.fire("o")
+
+
+def test_custom_exception_factory():
+    faults.arm("c", exc=lambda: ValueError("custom"))
+    with pytest.raises(ValueError, match="custom"):
+        faults.fire("c")
+
+
+def test_probability_schedule_is_seeded():
+    faults.seed(1234)
+    faults.arm("p", kind="drop", count=None, probability=0.5)
+    first = [faults.fire("p") for _ in range(100)]
+    faults.reset()
+    faults.seed(1234)
+    faults.arm("p", kind="drop", count=None, probability=0.5)
+    assert [faults.fire("p") for _ in range(100)] == first
+    assert 20 < sum(first) < 80                  # actually probabilistic
+
+
+def test_unlimited_count():
+    faults.arm("u", kind="drop", count=None)
+    assert all(faults.fire("u") for _ in range(10))
+
+
+def test_injected_context_manager_disarms_on_exit():
+    with faults.injected("cm", kind="drop", count=None):
+        assert faults.fire("cm") is True
+    assert faults.fire("cm") is False
+
+
+def test_arm_rejects_unknown_kind_and_bad_count():
+    with pytest.raises(ValueError):
+        faults.arm("x", kind="nope")
+    with pytest.raises(ValueError):
+        faults.arm("x", count=0)
+
+
+def test_configure_spec_grammar():
+    faults.configure("kubelet.register:error:count=3,"
+                     "native.probe:drop:p=0.25,inotify.poll")
+    armed = faults.armed_sites()
+    assert armed["kubelet.register"] == {"kind": "error", "remaining": 3,
+                                         "probability": 1.0, "fires": 0}
+    assert armed["native.probe"]["probability"] == 0.25
+    assert armed["native.probe"]["remaining"] is None
+    # bare site: defaults to the site's natural kind, not blanket "error"
+    assert armed["inotify.poll"]["kind"] == "drop"
+
+
+def test_configure_rejects_unknown_option():
+    with pytest.raises(ValueError):
+        faults.configure("kubelet.register:error:bogus=1")
+
+
+def test_configure_rejects_unknown_site():
+    # a typo'd env spec must abort the run, not silently inject nothing
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.configure("kubelet.regster:error")
+
+
+def test_arm_rejects_mismatched_kind_category():
+    # raising kind on a value site would kill the daemon thread that
+    # consults it (HealthMonitor, watcher loop) instead of simulating
+    # the documented failure
+    with pytest.raises(ValueError, match="honors only value"):
+        faults.arm("native.probe", kind="error")
+    with pytest.raises(ValueError, match="honors only value"):
+        faults.arm("inotify.poll", exc=lambda: RuntimeError("boom"))
+    # value kind on a raising site is ignored by the call site: the run
+    # would count fires while injecting nothing
+    with pytest.raises(ValueError, match="honors only raising"):
+        faults.arm("kubeapi.request", kind="drop")
+    assert faults.armed_sites() == {}
+
+
+def test_configure_from_env(monkeypatch):
+    monkeypatch.setenv("TDP_FAULTS", "dra.publish:drop:count=1")
+    monkeypatch.setenv("TDP_FAULTS_SEED", "99")
+    assert faults.configure_from_env() is True
+    assert faults.fire("dra.publish") is True
+    monkeypatch.delenv("TDP_FAULTS")
+    faults.reset()
+    assert faults.configure_from_env() is False
+
+
+# ------------------------------------------- instrumented production sites
+
+
+def test_kubeapi_request_site_fires_as_apierror():
+    """An armed kubeapi.request fault surfaces as ApiError (the client's
+    one exception contract) and feeds the breaker."""
+    from tpu_device_plugin.kubeapi import ApiClient, ApiError
+    c = ApiClient("http://example.invalid:1", token_path="/nonexistent")
+    faults.arm("kubeapi.request", kind="timeout", count=1)
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert c.breaker.snapshot()["consecutive_failures"] == 1
+
+
+def test_inotify_poll_site_drops_events(short_root):
+    """A fired inotify.poll fault swallows a real event batch."""
+    import os
+
+    from tpu_device_plugin.health import InotifyWatcher
+    w = InotifyWatcher()
+    try:
+        w.watch_dir(short_root)
+        faults.arm("inotify.poll", kind="drop", count=1)
+        open(os.path.join(short_root, "f1"), "w").close()
+        assert w.poll(1.0) == []                  # batch dropped
+        open(os.path.join(short_root, "f2"), "w").close()
+        events = w.poll(1.0)                      # next batch delivered
+        assert any(name == "f2" for _, name, _ in events)
+    finally:
+        w.close()

@@ -166,6 +166,102 @@ def test_server_path_prefix_is_preserved(monkeypatch):
     assert conn.requests == [("GET", "/apiproxy/apis/resource.k8s.io")]
 
 
+def test_stale_retry_is_exactly_once(monkeypatch):
+    """When the brand-new retry connection ALSO fails with a stale
+    signature, the request surfaces as ApiError — there is never a third
+    attempt (the retry loop is (0, 1), not open-ended)."""
+    c = client()
+    stale = FakeConn(error=BrokenPipeError("idled out"))
+    fresh = FakeConn(error=BrokenPipeError("really down"))
+    news = []
+    monkeypatch.setattr(c, "_get_conn", lambda: (stale, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: news.append(1) or fresh)
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert len(news) == 1                       # exactly one retry leg
+    assert stale.closed and fresh.closed
+
+
+def test_timeout_on_reused_get_does_not_retry(monkeypatch):
+    """TimeoutError is outside _RETRYABLE_STALE for EVERY method — even a
+    GET on a reused pool member: a response-read timeout means the server
+    may still be processing, and hammering it with a replay doubles its
+    load exactly when it is slowest (the hazard documented at
+    kubeapi.py:30)."""
+    c = client()
+    conn = FakeConn(error=TimeoutError("read timed out"))
+    news = []
+    monkeypatch.setattr(c, "_get_conn", lambda: (conn, True))
+    monkeypatch.setattr(c, "_new_conn", lambda: news.append(1) or FakeConn())
+    with pytest.raises(ApiError):
+        c.request("/x")                          # GET
+    assert news == []
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_trips_after_consecutive_transport_failures(monkeypatch):
+    """Five consecutive transport failures open the breaker; the next
+    request fails fast WITHOUT touching the connection pool."""
+    c = client()
+    attempts = []
+    monkeypatch.setattr(
+        c, "_get_conn",
+        lambda: (attempts.append(1) or FakeConn(error=ConnectionRefusedError(
+            "down")), False))
+    for _ in range(c.breaker.failure_threshold):
+        with pytest.raises(ApiError):
+            c.request("/x")
+    assert c.breaker.snapshot()["state"] == "open"
+    before = len(attempts)
+    with pytest.raises(ApiError, match="circuit breaker open"):
+        c.request("/x")
+    assert len(attempts) == before               # no network attempt
+
+
+def test_breaker_counts_5xx_as_failure_but_4xx_as_success(monkeypatch):
+    from tpu_device_plugin.resilience import CircuitBreaker
+    c = ApiClient("http://example.invalid:1", token_path="/nonexistent",
+                  breaker=CircuitBreaker(failure_threshold=2,
+                                         reset_timeout_s=60.0))
+    monkeypatch.setattr(c, "_get_conn",
+                        lambda: (FakeConn(status=500, data=b"boom"), False))
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert c.breaker.snapshot()["consecutive_failures"] == 1
+    # a 404 means the apiserver answered: the streak resets
+    monkeypatch.setattr(c, "_get_conn",
+                        lambda: (FakeConn(status=404, data=b"nf"), False))
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert c.breaker.snapshot()["consecutive_failures"] == 0
+    assert c.breaker.snapshot()["state"] == "closed"
+
+
+def test_breaker_half_open_probe_recovers(monkeypatch):
+    """After the cooldown, exactly one probe goes through; its success
+    closes the breaker for everyone."""
+    from conftest import FakeClock
+    from tpu_device_plugin.resilience import CircuitBreaker
+
+    clock = FakeClock()
+    c = ApiClient("http://example.invalid:1", token_path="/nonexistent",
+                  breaker=CircuitBreaker(failure_threshold=1,
+                                         reset_timeout_s=10.0, clock=clock))
+    monkeypatch.setattr(c, "_get_conn",
+                        lambda: (FakeConn(error=ConnectionRefusedError()),
+                                 False))
+    with pytest.raises(ApiError):
+        c.request("/x")
+    assert c.breaker.snapshot()["state"] == "open"
+    clock.now = 10.0
+    monkeypatch.setattr(c, "_get_conn",
+                        lambda: (FakeConn(data=b"recovered"), False))
+    assert c.request("/x") == b"recovered"
+    assert c.breaker.snapshot()["state"] == "closed"
+
+
 def test_pool_keeps_bounded_idle_connections():
     from tpu_device_plugin.kubeapi import MAX_IDLE_CONNECTIONS
     c = client()

@@ -1,0 +1,143 @@
+"""Unit tests for the shared backoff/circuit-breaker policy (resilience.py).
+
+These pin the *distributional* contract (decorrelated jitter: every delay
+in [base, cap], growth bounded by 3x the previous) with a seeded RNG and
+the breaker's full state machine with a fake clock — no sleeping.
+"""
+
+import random
+
+import pytest
+
+from conftest import FakeClock
+from tpu_device_plugin.resilience import (BackoffPolicy, CircuitBreaker,
+                                          CircuitOpen)
+
+
+# ------------------------------------------------------------- BackoffPolicy
+
+
+def test_backoff_delays_within_bounds_and_deterministic():
+    rng = random.Random(42)
+    p = BackoffPolicy(base_s=1.0, cap_s=30.0, rng=rng)
+    delays = [p.next_delay() for _ in range(50)]
+    assert all(1.0 <= d <= 30.0 for d in delays)
+    # decorrelated jitter: each delay is at most 3x its predecessor
+    prev = 1.0
+    for d in delays:
+        assert d <= max(prev * 3.0, 1.0) + 1e-9
+        prev = d
+    # seeded: the schedule replays exactly
+    p2 = BackoffPolicy(base_s=1.0, cap_s=30.0, rng=random.Random(42))
+    assert [p2.next_delay() for _ in range(50)] == delays
+
+
+def test_backoff_grows_under_sustained_failure():
+    p = BackoffPolicy(base_s=1.0, cap_s=30.0, rng=random.Random(7))
+    delays = [p.next_delay() for _ in range(30)]
+    # by the tail of a long failure run, delays should be near the cap far
+    # more often than near the base (the whole point of growth)
+    assert max(delays[10:]) > 10.0
+
+
+def test_backoff_reset_returns_to_base():
+    p = BackoffPolicy(base_s=1.0, cap_s=30.0, rng=random.Random(7))
+    for _ in range(10):
+        p.next_delay()
+    assert p.attempts == 10
+    p.reset()
+    assert p.attempts == 0
+    assert p.total_attempts == 10          # lifetime counter survives
+    assert p.next_delay() <= 3.0           # back to U(base, 3*base)
+
+
+def test_backoff_rejects_bad_params():
+    with pytest.raises(ValueError):
+        BackoffPolicy(base_s=0)
+    with pytest.raises(ValueError):
+        BackoffPolicy(base_s=5.0, cap_s=1.0)
+
+
+def test_backoff_snapshot_counts():
+    p = BackoffPolicy(base_s=0.1, cap_s=1.0, rng=random.Random(1))
+    p.next_delay()
+    snap = p.snapshot()
+    assert snap["attempts"] == 1
+    assert snap["total_attempts"] == 1
+    assert 0.1 <= snap["current_delay_s"] <= 1.0
+
+
+# ------------------------------------------------------------ CircuitBreaker
+
+
+def test_breaker_trips_after_threshold_and_half_opens():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0, clock=clock)
+    assert b.state == "closed"
+    for _ in range(2):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == "closed"             # threshold not reached
+    assert b.allow()
+    b.record_failure()                     # third consecutive failure
+    assert b.state == "open"
+    assert b.trips == 1
+    assert not b.allow()                   # fails fast while open
+    clock.advance(10.0)
+    assert b.allow()                       # cooldown elapsed: the ONE probe
+    assert b.state == "half-open"
+    assert not b.allow()                   # second caller is still rejected
+    b.record_success()                     # probe succeeded
+    assert b.state == "closed"
+    assert b.allow()
+
+
+def test_breaker_failed_probe_reopens_with_fresh_cooldown():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open"
+    clock.advance(5.0)
+    assert b.allow()                       # half-open probe
+    b.record_failure()                     # probe failed
+    assert b.state == "open"
+    assert b.trips == 2
+    clock.advance(4.9)
+    assert not b.allow()                   # cooldown restarted at the probe
+    clock.advance(0.2)
+    assert b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(failure_threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()                     # streak broken
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"             # never 3 consecutive
+
+
+def test_breaker_call_wrapper():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clock)
+
+    def boom():
+        raise RuntimeError("no")
+
+    with pytest.raises(RuntimeError):
+        b.call(boom)
+    with pytest.raises(CircuitOpen):
+        b.call(lambda: "never runs")
+    assert b.rejected == 1
+    clock.advance(5.0)
+    assert b.call(lambda: "ok") == "ok"    # half-open probe succeeds
+    assert b.state == "closed"
+
+
+def test_breaker_snapshot_shape():
+    b = CircuitBreaker(failure_threshold=2, name="t")
+    b.record_failure()
+    snap = b.snapshot()
+    assert snap == {"state": "closed", "consecutive_failures": 1,
+                    "trips": 0, "rejected": 0}

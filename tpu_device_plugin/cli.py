@@ -218,6 +218,14 @@ def dump_inventory(cfg) -> str:
 
 def main(argv=None) -> int:
     cfg, args = build_config(argv)
+    # chaos/soak runs arm named fault points from $TDP_FAULTS (see
+    # faults.py for the grammar and docs/fault-injection.md for the sites);
+    # unset, this is one getenv and every fault point stays a no-op
+    from . import faults
+    if faults.configure_from_env():
+        logging.getLogger(__name__).warning(
+            "FAULT INJECTION ARMED from $TDP_FAULTS: %s",
+            sorted(faults.armed_sites()))
     if args.discover_only:
         print(dump_inventory(cfg))
         return 0

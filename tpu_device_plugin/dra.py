@@ -48,11 +48,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from . import faults
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
 from .discovery import read_link_basename
 from .kubeapi import ApiClient, ApiError
+from .resilience import BackoffPolicy
 from .kubeletapi import draapi, drapb, regpb
 from .naming import GenerationInfo, sanitize_name
 from .registry import Registry, TpuDevice, TpuPartition
@@ -70,10 +72,13 @@ RESOURCE_API = "/apis/resource.k8s.io/v1beta1"   # fallback when undiscoverable
 # (VERDICT r3 item 7).
 RESOURCE_API_VERSIONS = ("v1", "v1beta2", "v1beta1")
 CDI_VERSION = "0.6.0"
-# retry cadence for a health-triggered republish that failed (transient
-# apiserver blip / resourceVersion conflict); mirrors the PluginManager's
-# 30 s inventory-publish retry
+# retry cadence CAP for a health-triggered republish that failed (transient
+# apiserver blip / resourceVersion conflict). The actual delay is drawn by
+# a decorrelated-jitter BackoffPolicy (resilience.py) between
+# HEALTH_REPUBLISH_BASE_S and this cap, so a fleet of nodes that lost the
+# apiserver together does not republish in lockstep when it returns.
 HEALTH_REPUBLISH_RETRY_S = 30.0
+HEALTH_REPUBLISH_BASE_S = 5.0
 # Distinct CDI class from cdi.py's per-chip "tpu" kind: claim devices are
 # composite (all of a claim's nodes + env in one entry) and live in
 # per-claim spec files created/removed at prepare/unprepare time.
@@ -149,6 +154,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # set survives set_inventory() swaps.
         self._unhealthy: set = set()
         self._republish_timer: Optional[threading.Timer] = None
+        # jittered delay for the self-armed republish retry; reset by any
+        # successful publish. Chaos tests inject a seeded/faster policy.
+        self.republish_backoff = BackoffPolicy(
+            base_s=HEALTH_REPUBLISH_BASE_S, cap_s=HEALTH_REPUBLISH_RETRY_S)
         self._stopped = False
         self._resource_version_cache: Optional[str] = None
         # serializes slice publishes against each other AND against
@@ -445,7 +454,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # driver that no longer exists
             if self._republish_timer is not None or self._stopped:
                 return
-            t = threading.Timer(HEALTH_REPUBLISH_RETRY_S,
+            t = threading.Timer(self.republish_backoff.next_delay(),
                                 self._republish_retry)
             t.daemon = True
             self._republish_timer = t
@@ -492,13 +501,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             log.warning("DRA: no API client; ResourceSlice not published")
             return False
         with self._publish_lock:
-            return self._publish_locked()
+            ok = self._publish_locked()
+        if ok:
+            self.republish_backoff.reset()
+        return ok
 
     def _publish_locked(self) -> bool:
         with self._lock:
             if self._stopped:
                 return False
             inventory_empty = not self._by_name
+        # fault point "dra.publish" (value kind): simulate an apiserver
+        # refusing the publish, exercising the self-armed republish retry
+        if faults.fire("dra.publish"):
+            return False
         name = self.slice_name()
         # resolve the REST version ONCE per publish: independent lookups
         # (path here, schema inside build_slice) could disagree mid-blip

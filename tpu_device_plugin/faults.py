@@ -1,0 +1,273 @@
+"""Injectable fault points: named failure sites, armed by tests or env.
+
+The robustness claims in this repo (plugins survive kubelet restarts,
+devices recover when nodes return, apiserver blips never duplicate writes)
+used to be exercised only by whatever failures a test could induce from
+outside the process — deleting sockets, killing fake servers. This
+registry lets failures be injected *at the seam where they occur* with a
+deterministic, seedable schedule, so tests/test_chaos.py can script
+"registration fails 3 times, then works" without monkeypatching internals.
+
+A fault point is a named call site that the production code consults:
+
+    faults.fire("kubelet.register")       # raising site
+    if faults.fire("dra.publish"): ...    # value site: True = fault fired
+
+When nothing is armed (production), `fire()` is a single module-global
+boolean check — no locks, no dict lookups.
+
+Instrumented sites and their semantics:
+
+  kubelet.register   raising — register() fails with the armed exception
+  kubeapi.request    raising — the HTTP request fails before the wire
+                     (the ApiClient wraps non-ApiError kinds as ApiError)
+  native.probe       value   — the liveness probe reports the chip dead
+  inotify.poll       value   — the poll's inotify events are dropped
+                     (exercises the periodic existence-scan reconciliation)
+  dra.publish        value   — the slice publish fails as if the API
+                     server had refused it (exercises the republish retry)
+
+Arming — programmatic:
+
+    faults.arm("kubelet.register", kind="error", count=3)
+    with faults.injected("dra.publish", count=1): ...
+
+or via environment (read by cli.main at startup):
+
+    TDP_FAULTS='kubelet.register:error:count=3,kubeapi.request:timeout:p=0.5'
+    TDP_FAULTS_SEED=1337
+
+Spec grammar: `site[:kind][:count=N][:p=F]` joined by commas. `kind` is
+one of error (FaultInjected), timeout (TimeoutError), oserror
+(ConnectionResetError), or drop/false (non-raising; `fire` returns True),
+defaulting to the site's natural kind (error for raising sites, drop for
+value sites). Each site honors only its own category — see
+`_SITE_CATEGORY` — and env specs reject unknown sites outright, so a
+typo'd schedule aborts the run instead of silently injecting nothing.
+`count` bounds how many times the fault fires (default unlimited);
+`p` is the per-call fire probability (default 1.0), drawn from the module
+RNG so a seeded run replays the same schedule.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["FaultInjected", "arm", "disarm", "reset", "fire", "stats",
+           "seed", "configure", "configure_from_env", "injected"]
+
+
+class FaultInjected(Exception):
+    """Default exception raised by a fault point armed with kind='error'."""
+
+
+_RAISING_KINDS: Dict[str, Callable[[str], BaseException]] = {
+    "error": lambda site: FaultInjected(f"injected fault at {site}"),
+    "timeout": lambda site: TimeoutError(f"injected timeout at {site}"),
+    "oserror": lambda site: ConnectionResetError(
+        f"injected connection reset at {site}"),
+}
+_VALUE_KINDS = ("drop", "false")
+
+# What each instrumented production site can honor. A raising kind armed
+# on a value site would not simulate the documented failure — it would
+# propagate out of a daemon thread (HealthMonitor, watcher loop) and kill
+# it; a value kind on a raising site is ignored by the call site, so the
+# run reports fires while injecting nothing. arm() enforces the category
+# for known sites (unknown sites stay open for tests to invent).
+_SITE_CATEGORY: Dict[str, str] = {
+    "kubelet.register": "raising",
+    "kubeapi.request": "raising",
+    "native.probe": "value",
+    "inotify.poll": "value",
+    "dra.publish": "value",
+}
+_DEFAULT_KIND = {"raising": "error", "value": "drop"}
+
+
+class _FaultPoint:
+    __slots__ = ("kind", "remaining", "probability", "exc_factory", "fires")
+
+    def __init__(self, kind: str, remaining: Optional[int],
+                 probability: float,
+                 exc_factory: Optional[Callable[[], BaseException]]):
+        self.kind = kind
+        self.remaining = remaining    # None = unlimited
+        self.probability = probability
+        self.exc_factory = exc_factory
+        self.fires = 0
+
+
+_lock = threading.Lock()
+_points: Dict[str, _FaultPoint] = {}
+_fired: Dict[str, int] = {}     # per-site lifetime fire counts (stats)
+_rng = random.Random()
+_armed = False                  # fast-path flag: False ⇒ fire() is a no-op
+
+
+def seed(n: int) -> None:
+    """Seed the probability RNG so probabilistic schedules replay."""
+    _rng.seed(n)
+
+
+def arm(site: str, kind: str = "error", count: Optional[int] = 1,
+        probability: float = 1.0,
+        exc: Optional[Callable[[], BaseException]] = None) -> None:
+    """Arm `site`: the next `count` consultations fire (raise or return
+    True per kind) with the given probability. `exc` overrides the kind's
+    exception factory (a zero-arg callable returning the exception)."""
+    global _armed
+    if exc is None and kind not in _RAISING_KINDS and kind not in _VALUE_KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} "
+                         f"(known: {sorted(_RAISING_KINDS) + list(_VALUE_KINDS)})")
+    if count is not None and count < 1:
+        raise ValueError("count must be >= 1 (or None for unlimited)")
+    category = "raising" if (exc is not None or kind in _RAISING_KINDS) \
+        else "value"
+    expected = _SITE_CATEGORY.get(site)
+    if expected is not None and category != expected:
+        raise ValueError(
+            f"site {site!r} honors only {expected} kinds, not {kind!r} — "
+            f"a mismatched kind would {'kill the daemon thread' if expected == 'value' else 'inject nothing while counting fires'}")
+    factory = exc
+    if factory is None and kind in _RAISING_KINDS:
+        maker = _RAISING_KINDS[kind]
+        factory = lambda: maker(site)  # noqa: E731 — site-bound closure
+    with _lock:
+        _points[site] = _FaultPoint(kind, count, probability, factory)
+        _armed = True
+    log.warning("fault point ARMED: %s kind=%s count=%s p=%g",
+                site, kind, count if count is not None else "inf", probability)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or all when site is None). Stats are kept."""
+    global _armed
+    with _lock:
+        if site is None:
+            _points.clear()
+        else:
+            _points.pop(site, None)
+        _armed = bool(_points)
+
+
+def reset() -> None:
+    """Disarm everything and clear the stats (test teardown)."""
+    global _armed
+    with _lock:
+        _points.clear()
+        _fired.clear()
+        _armed = False
+
+
+def fire(site: str, **ctx) -> bool:
+    """Consult fault point `site`. Disarmed: returns False (one bool read).
+
+    Armed with a raising kind: raises the armed exception. Armed with a
+    value kind (drop/false): returns True. Either way the fault's budget
+    (`count`) is decremented and the fire recorded for `stats()`.
+    """
+    if not _armed:
+        return False
+    with _lock:
+        point = _points.get(site)
+        if point is None:
+            return False
+        if point.probability < 1.0 and _rng.random() >= point.probability:
+            return False
+        if point.remaining is not None:
+            point.remaining -= 1
+            if point.remaining <= 0:
+                _points.pop(site, None)
+                _refresh_armed_locked()
+        point.fires += 1
+        _fired[site] = _fired.get(site, 0) + 1
+        factory = point.exc_factory
+    log.warning("fault point FIRED: %s%s", site,
+                f" ({ctx})" if ctx else "")
+    if factory is not None:
+        raise factory()
+    return True
+
+
+def _refresh_armed_locked() -> None:
+    global _armed
+    _armed = bool(_points)
+
+
+def stats() -> Dict[str, int]:
+    """Per-site lifetime fire counts (survive disarm; cleared by reset)."""
+    with _lock:
+        return dict(_fired)
+
+
+def armed_sites() -> Dict[str, dict]:
+    """Currently armed points, for the /status debugging surface."""
+    with _lock:
+        return {site: {"kind": p.kind, "remaining": p.remaining,
+                       "probability": p.probability, "fires": p.fires}
+                for site, p in _points.items()}
+
+
+@contextmanager
+def injected(site: str, kind: str = "error", count: Optional[int] = 1,
+             probability: float = 1.0,
+             exc: Optional[Callable[[], BaseException]] = None):
+    """Scope-bound arming for tests: disarms the site on exit even when
+    the fault's budget was not exhausted."""
+    arm(site, kind=kind, count=count, probability=probability, exc=exc)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def configure(spec: str) -> None:
+    """Arm fault points from a spec string (see module docstring grammar)."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        site = fields[0]
+        category = _SITE_CATEGORY.get(site)
+        if category is None:
+            # env specs address production sites only — a typo'd site
+            # must abort the run, not silently inject nothing
+            raise ValueError(f"unknown fault site {site!r} in {part!r} "
+                             f"(known: {sorted(_SITE_CATEGORY)})")
+        kind = (fields[1] if len(fields) > 1 and fields[1]
+                else _DEFAULT_KIND[category])
+        count: Optional[int] = None
+        probability = 1.0
+        for opt in fields[2:]:
+            key, _, value = opt.partition("=")
+            if key == "count":
+                count = int(value)
+            elif key == "p":
+                probability = float(value)
+            else:
+                raise ValueError(f"unknown fault option {opt!r} in {part!r}")
+        arm(site, kind=kind, count=count, probability=probability)
+
+
+def configure_from_env(env: str = "TDP_FAULTS",
+                       seed_env: str = "TDP_FAULTS_SEED") -> bool:
+    """Arm from $TDP_FAULTS (and seed from $TDP_FAULTS_SEED); True if any
+    spec was found. Called once by cli.main — a production pod without the
+    variable pays one getenv."""
+    seed_val = os.environ.get(seed_env)
+    if seed_val:
+        seed(int(seed_val))
+    spec = os.environ.get(env)
+    if not spec:
+        return False
+    configure(spec)
+    return True

@@ -28,6 +28,8 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import faults
+
 log = logging.getLogger(__name__)
 
 IN_CREATE = 0x00000100
@@ -64,6 +66,15 @@ class InotifyWatcher:
     def poll(self, timeout_s: float) -> List[Tuple[str, str, int]]:
         ready, _, _ = select.select([self._fd], [], [], timeout_s)
         if not ready:
+            return []
+        # fault point "inotify.poll" (value kind): drop this batch of
+        # events unread-from-the-caller's-view, simulating lost inotify
+        # delivery — the periodic existence scan must reconcile
+        if faults.fire("inotify.poll"):
+            try:
+                os.read(self._fd, 65536)   # consume so the fd doesn't spin
+            except BlockingIOError:
+                pass
             return []
         try:
             buf = os.read(self._fd, 65536)

@@ -16,8 +16,12 @@ import logging
 import os
 import ssl
 import threading
+import time
 from typing import Optional
 from urllib.parse import urlsplit
+
+from . import faults
+from .resilience import BackoffPolicy, CircuitBreaker
 
 log = logging.getLogger(__name__)
 
@@ -80,7 +84,8 @@ class ApiClient:
     def __init__(self, server: str,
                  token_path: str = os.path.join(SA_DIR, "token"),
                  ca_path: str = os.path.join(SA_DIR, "ca.crt"),
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0,
+                 breaker: Optional[CircuitBreaker] = None):
         self.server = server.rstrip("/")
         self.token_path = token_path
         self.ca_path = ca_path
@@ -92,6 +97,19 @@ class ApiClient:
         self._base_path = split.path.rstrip("/")
         self._idle: list = []
         self._pool_lock = threading.Lock()
+        # Circuit breaker over the whole client (resilience.py): transport
+        # failures and 5xx count as failures, any response < 500 (including
+        # 4xx — the server answered) as success. While open, request()
+        # fails fast with ApiError instead of burning a connect timeout per
+        # call — the callers' own retry loops (lifecycle publish retry, dra
+        # republish timer) keep running and land on the half-open probe.
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=5, reset_timeout_s=15.0,
+            name=f"kubeapi:{self._host}")
+        # brief jittered pause before the single stale-keep-alive retry
+        # (below): lets a restarting apiserver finish its listen() instead
+        # of immediately eating the one retry the contract allows
+        self._stale_backoff = BackoffPolicy(base_s=0.02, cap_s=0.2)
 
     def _new_conn(self) -> http.client.HTTPConnection:
         if self._https:
@@ -127,8 +145,40 @@ class ApiClient:
     def request(self, path: str, method: str = "GET",
                 body: Optional[bytes] = None,
                 content_type: Optional[str] = None) -> bytes:
-        """Raw request against an API path; raises ApiError on failure."""
+        """Raw request against an API path; raises ApiError on failure.
+
+        Fails fast (without touching the network) while the circuit
+        breaker is open; every attempt's outcome feeds the breaker.
+        """
         url = self.server + path
+        if not self.breaker.allow():
+            raise ApiError(f"{method} {url}: circuit breaker open "
+                           f"(apiserver failing; next probe within "
+                           f"{self.breaker.reset_timeout_s:.0f}s)", code=0)
+        try:
+            # fault point "kubeapi.request" (raising): an armed fault fails
+            # the request before the wire, as a transport error would
+            faults.fire("kubeapi.request", method=method, path=path)
+            data = self._request_once(path, method, body, content_type, url)
+        except ApiError as exc:
+            if exc.code == 0 or exc.code >= 500:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()  # 3xx/4xx: server is alive
+            raise
+        except Exception as exc:
+            # injected fault of a non-ApiError kind: surface it under the
+            # client's one exception contract
+            self.breaker.record_failure()
+            raise ApiError(f"{method} {url}: {exc}") from exc
+        self.breaker.record_success()
+        self._stale_backoff.reset()
+        return data
+
+    def _request_once(self, path: str, method: str, body: Optional[bytes],
+                      content_type: Optional[str], url: str) -> bytes:
+        """One logical request: pool checkout, send, narrow stale-keep-alive
+        retry, status handling. Raises ApiError on any failure."""
         headers = {}
         if content_type:
             headers["Content-Type"] = content_type
@@ -164,7 +214,10 @@ class ApiClient:
                 retry_safe = (not sent) or method == "GET"
                 if (attempt == 0 and reused and retry_safe
                         and isinstance(exc, _RETRYABLE_STALE)):
-                    continue   # idled-out keep-alive: one fresh retry
+                    # idled-out keep-alive: one fresh retry, after a short
+                    # jittered pause (BackoffPolicy; reset on any success)
+                    time.sleep(self._stale_backoff.next_delay())
+                    continue
                 raise ApiError(f"{method} {url}: {exc}") from exc
             if resp.will_close:
                 conn.close()

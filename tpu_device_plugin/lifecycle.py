@@ -22,7 +22,9 @@ from .discovery import discover
 from .naming import resource_name_for
 from .native import TpuHealth
 from .registry import Registry
-from .server import TpuDevicePlugin
+from .resilience import BackoffPolicy
+from .server import (KubeletUnavailable, RegistrationRejected,
+                     TpuDevicePlugin)
 from .vtpu import VtpuDevicePlugin
 
 log = logging.getLogger(__name__)
@@ -44,6 +46,11 @@ class PluginManager:
         self._last_inventory = None
         self._inventory_published = True
         self._next_publish_retry = 0.0
+        # jittered inventory-publish retry (was a flat 30 s re-arm): every
+        # node in a cluster hits "apiserver unreachable at boot" together,
+        # so the retries must decorrelate. Reset on success; surfaced via
+        # status.py so operators can see publish-retry pressure.
+        self.publish_backoff = BackoffPolicy(base_s=5.0, cap_s=60.0)
         self.plugins: List[TpuDevicePlugin] = []
         self.pending: List[TpuDevicePlugin] = []
         self.registry: Optional[Registry] = None
@@ -133,8 +140,11 @@ class PluginManager:
             log.error("inventory callback failed: %s", exc)
             ok = False
         self._inventory_published = ok is not False
-        if not self._inventory_published:
-            self._next_publish_retry = time.monotonic() + 30.0
+        if self._inventory_published:
+            self.publish_backoff.reset()
+        else:
+            self._next_publish_retry = (
+                time.monotonic() + self.publish_backoff.next_delay())
 
     @staticmethod
     def _plugin_key(plugin) -> tuple:
@@ -230,6 +240,20 @@ class PluginManager:
                     # Healthy snapshot from a plugin born during a drain
                     plugin.set_all_health(False, "drain")
                 plugin.start()
+            except KubeletUnavailable as exc:
+                # the expected boot race: the pod came up before the
+                # kubelet's socket — routine, not an error
+                log.info("plugin %s: kubelet not ready (%s); will retry",
+                         plugin.resource_name, exc)
+                still_pending.append(plugin)
+            except RegistrationRejected as exc:
+                # the kubelet answered and said no (version mismatch, bad
+                # resource name): retrying without a fix is futile — make
+                # the log say what actually needs fixing
+                log.error("plugin %s: kubelet REJECTED registration (%s); "
+                          "will retry, but this needs operator attention",
+                          plugin.resource_name, exc)
+                still_pending.append(plugin)
             except Exception as exc:
                 log.error("plugin %s failed to start (%s); will retry",
                           plugin.resource_name, exc)

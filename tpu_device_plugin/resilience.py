@@ -1,0 +1,177 @@
+"""Shared failure-handling policy: jittered backoff + circuit breaker.
+
+Every recovery path in this daemon used to improvise its own retry timing:
+`server.py:restart` doubled a fixed backoff (so N plugins restarting after
+one kubelet bounce re-dialed in lockstep — a thundering herd against a
+kubelet that just came back), `lifecycle.py` re-armed a flat 30 s
+inventory-publish retry, and `dra.py` re-armed a flat 30 s republish timer.
+This module is the one place those decisions live now:
+
+- `BackoffPolicy` implements decorrelated jitter (each delay is drawn
+  uniformly from [base, 3×previous], capped), which both spreads
+  simultaneous retriers apart and grows the interval under sustained
+  failure. The RNG is injectable so chaos tests (tests/test_chaos.py) are
+  seeded and reproducible.
+
+- `CircuitBreaker` trips OPEN after N consecutive failures, fails fast
+  while open, and HALF-OPENs a single probe after a cooldown — success
+  closes it, failure re-opens. It protects the API server (and our own
+  latency) from retry storms the backoff alone cannot prevent when many
+  call sites share one dependency.
+
+Both keep counters (attempts, trips, state) that `status.py` surfaces so
+operators can see recovery activity per resource instead of inferring it
+from log volume.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["BackoffPolicy", "CircuitBreaker", "CircuitOpen"]
+
+
+class BackoffPolicy:
+    """Decorrelated-jitter backoff: delay_n = min(cap, U(base, 3*delay_{n-1})).
+
+    Thread-safe. `reset()` returns to the base interval (call it after a
+    success); `attempts` counts delays issued since the last reset,
+    `total_attempts` over the object's lifetime (the status counter).
+    """
+
+    def __init__(self, base_s: float = 1.0, cap_s: float = 30.0,
+                 rng: Optional[random.Random] = None) -> None:
+        if base_s <= 0 or cap_s < base_s:
+            raise ValueError(f"need 0 < base_s <= cap_s "
+                             f"(got base={base_s}, cap={cap_s})")
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._prev = base_s
+        self.attempts = 0
+        self.total_attempts = 0
+
+    def next_delay(self) -> float:
+        with self._lock:
+            delay = min(self.cap_s, self._rng.uniform(self.base_s,
+                                                      self._prev * 3.0))
+            self._prev = delay
+            self.attempts += 1
+            self.total_attempts += 1
+            return delay
+
+    def reset(self) -> None:
+        with self._lock:
+            self._prev = self.base_s
+            self.attempts = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"attempts": self.attempts,
+                    "total_attempts": self.total_attempts,
+                    "current_delay_s": round(self._prev, 3)}
+
+
+class CircuitOpen(Exception):
+    """Raised by CircuitBreaker.call() when the circuit is open."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a half-open probe.
+
+    States: "closed" (all calls pass) → after `failure_threshold`
+    consecutive `record_failure()`s → "open" (allow() is False) → after
+    `reset_timeout_s` → "half-open": exactly ONE caller gets allow()=True
+    as the probe; its `record_success()` closes the circuit, its
+    `record_failure()` re-opens it (and restarts the cooldown). The clock
+    is injectable so the state machine is unit-testable without sleeping.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half-open"
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "") -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self.trips = 0            # lifetime CLOSED/HALF_OPEN -> OPEN count
+        self.rejected = 0         # calls refused while open
+
+    @property
+    def state(self) -> str:
+        # OPEN past its cooldown still reads as open; only allow() performs
+        # the OPEN -> HALF_OPEN transition, when it hands out the probe.
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """True if a call may proceed. Hands out at most one half-open probe
+        per cooldown window; record_success/record_failure MUST follow every
+        allowed call or the breaker's failure count goes stale."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at >= self.reset_timeout_s:
+                    self._state = self.HALF_OPEN
+                    return True
+                self.rejected += 1
+                return False
+            # HALF_OPEN: a probe is already in flight
+            self.rejected += 1
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._state = self.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == self.HALF_OPEN:
+                # failed probe: straight back to open, cooldown restarts
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self.trips += 1
+            elif (self._state == self.CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self.trips += 1
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run fn through the breaker; raises CircuitOpen when rejected."""
+        if not self.allow():
+            raise CircuitOpen(
+                f"circuit {self.name or 'breaker'} open "
+                f"({self._consecutive_failures} consecutive failures)")
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state,
+                    "consecutive_failures": self._consecutive_failures,
+                    "trips": self.trips,
+                    "rejected": self.rejected}

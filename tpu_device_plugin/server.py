@@ -28,15 +28,34 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 
 from . import allocate as allocate_mod
+from . import faults
 from . import kubeletapi as api
 from .config import Config
 from .health import HealthMonitor
 from .kubeletapi import pb
 from .native import TpuHealth, link_is_degraded
 from .registry import Registry, TpuDevice
+from .resilience import BackoffPolicy
 from .topology import AllocatableDevice, AllocationIndex, MustIncludeTooLarge
 
 log = logging.getLogger(__name__)
+
+
+class RegistrationError(Exception):
+    """register() failed. Subclasses tell callers whether the failure is
+    the expected boot race (kubelet socket not up yet — retry quietly) or
+    a protocol-level rejection (version mismatch, bad resource name —
+    retrying without a fix is futile and the log should say so)."""
+
+
+class KubeletUnavailable(RegistrationError):
+    """The kubelet did not answer: socket missing, dial timeout, or
+    UNAVAILABLE/DEADLINE_EXCEEDED from the transport."""
+
+
+class RegistrationRejected(RegistrationError):
+    """The kubelet answered and refused the registration (e.g. version
+    mismatch) — a retry will fail the same way until something changes."""
 
 
 class TpuDevicePlugin(api.DevicePluginServicer):
@@ -85,6 +104,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._lifecycle_lock = threading.RLock()  # serializes start/teardown
         self._serving = False
         self._restart_count = 0
+        # shared restart backoff (decorrelated jitter): N plugins bounced by
+        # one kubelet restart must not re-dial in lockstep. Reset at the top
+        # of each restart() so the first retry is always near base; chaos
+        # tests swap in a seeded/faster policy before injecting storms.
+        self._restart_backoff = BackoffPolicy(base_s=1.0, cap_s=30.0)
         self._allocatable = [
             AllocatableDevice(d.bdf, d.numa_node, d.ici_coords)
             for d in self.devices
@@ -232,19 +256,40 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             grpc.channel_ready_future(ch).result(timeout=self.cfg.grpc_timeout_s)
 
     def register(self) -> None:
-        """Announce this plugin to the kubelet (reference :288-309)."""
-        with grpc.insecure_channel(f"unix://{self.cfg.kubelet_socket}") as ch:
-            grpc.channel_ready_future(ch).result(timeout=self.cfg.grpc_timeout_s)
-            api.RegistrationStub(ch).Register(
-                pb.RegisterRequest(
-                    version=api.API_VERSION,
-                    endpoint=os.path.basename(self.socket_path),
-                    resource_name=self.resource_name,
-                    options=pb.DevicePluginOptions(
-                        get_preferred_allocation_available=True),
-                ),
-                timeout=self.cfg.grpc_timeout_s,
-            )
+        """Announce this plugin to the kubelet (reference :288-309).
+
+        Raises typed errors so lifecycle.py can tell the boot race
+        (KubeletUnavailable: socket not up yet, retry quietly) from a
+        protocol rejection (RegistrationRejected: version mismatch — loud)."""
+        faults.fire("kubelet.register", resource=self.resource_name)
+        try:
+            with grpc.insecure_channel(
+                    f"unix://{self.cfg.kubelet_socket}") as ch:
+                grpc.channel_ready_future(ch).result(
+                    timeout=self.cfg.grpc_timeout_s)
+                api.RegistrationStub(ch).Register(
+                    pb.RegisterRequest(
+                        version=api.API_VERSION,
+                        endpoint=os.path.basename(self.socket_path),
+                        resource_name=self.resource_name,
+                        options=pb.DevicePluginOptions(
+                            get_preferred_allocation_available=True),
+                    ),
+                    timeout=self.cfg.grpc_timeout_s,
+                )
+        except grpc.FutureTimeoutError as exc:
+            raise KubeletUnavailable(
+                f"kubelet socket {self.cfg.kubelet_socket} not answering"
+            ) from exc
+        except grpc.RpcError as exc:
+            code = exc.code()
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED):
+                raise KubeletUnavailable(
+                    f"kubelet Register RPC failed: {code.name}") from exc
+            raise RegistrationRejected(
+                f"kubelet rejected {self.resource_name}: {code.name} "
+                f"{exc.details()}") from exc
         log.info("registered %s with kubelet", self.resource_name)
 
     def _start_monitor(self) -> None:
@@ -258,8 +303,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             group_bdfs=group_bdfs,
             on_device_health=self.set_group_health,
             on_socket_removed=self._restart_async,
-            probe=lambda bdf, node: self.health_shim.chip_alive(
-                self.cfg.pci_base_path, bdf, node),
+            # fault point "native.probe" (value kind): a fired fault reports
+            # the chip dead, exercising the Unhealthy -> recovery path
+            probe=lambda bdf, node: (
+                not faults.fire("native.probe", bdf=bdf)
+                and self.health_shim.chip_alive(
+                    self.cfg.pci_base_path, bdf, node)),
             poll_interval_s=self.cfg.health_poll_s,
             stop_event=self._stop,
         )
@@ -286,7 +335,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         log.info("%s: restarting (count=%d)", self.resource_name, self._restart_count)
         with self._lifecycle_lock:
             self._teardown()
-        backoff = 1.0
+        self._restart_backoff.reset()
         while not self._closed.is_set():
             deadline = time.monotonic() + self.cfg.grpc_timeout_s
             while not os.path.exists(self.cfg.kubelet_socket) \
@@ -300,11 +349,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     self.start()
                     return
                 except Exception as exc:
-                    log.error("%s: restart attempt failed (%s); retrying in %.0fs",
-                              self.resource_name, exc, backoff)
+                    # jittered, growing delay (resilience.BackoffPolicy):
+                    # sibling plugins bounced by the same kubelet restart
+                    # spread out instead of re-dialing in lockstep
+                    backoff = self._restart_backoff.next_delay()
+                    log.error("%s: restart attempt failed (%s); retrying "
+                              "in %.1fs", self.resource_name, exc, backoff)
             if self._closed.wait(timeout=backoff):
                 return
-            backoff = min(backoff * 2, 30.0)
 
     def stop(self) -> None:
         """Terminal stop: no restart may resurrect the plugin afterwards."""
@@ -357,6 +409,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "socket": self.socket_path,
             "serving": self._serving,
             "restarts": self._restart_count,
+            # recovery-activity counters (resilience.BackoffPolicy): how many
+            # backoff delays restart() has issued, lifetime and current-run
+            "restart_backoff": self._restart_backoff.snapshot(),
             "devices": devices,
             "pci_errors": errors,
             "degraded_links": degraded_links,

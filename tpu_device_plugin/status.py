@@ -90,12 +90,23 @@ class StatusServer:
         return bool(plugins) and any(p.serving for p in plugins)
 
     def status(self) -> dict:
+        from . import faults
         out = {
             "plugins": [p.status_snapshot() for p in self.manager.plugins],
             "pending": [p.resource_name for p in self.manager.pending],
             "native": getattr(self.manager, "native_info", {}),
             "draining": getattr(self.manager, "draining", False),
         }
+        # recovery-activity counters (resilience.py): publish-retry backoff
+        # state plus any armed/fired fault points, so chaos behavior is
+        # observable from the same surface operators already scrape
+        publish_backoff = getattr(self.manager, "publish_backoff", None)
+        if publish_backoff is not None:
+            out["inventory_publish_backoff"] = publish_backoff.snapshot()
+        fault_stats = faults.stats()
+        armed = faults.armed_sites()
+        if fault_stats or armed:
+            out["faults"] = {"armed": armed, "fired": fault_stats}
         d = self.dra_driver
         if d is not None:
             out["dra"] = {
@@ -106,7 +117,10 @@ class StatusServer:
                 "registration_error": d.registration_error,
                 "prepared_claims": d.prepared_claim_count(),
                 "unhealthy_devices": d.unhealthy_devices(),
+                "republish_backoff": d.republish_backoff.snapshot(),
             }
+            if d.api is not None:
+                out["dra"]["api_breaker"] = d.api.breaker.snapshot()
         return out
 
     def metrics(self) -> str:
@@ -142,6 +156,14 @@ class StatusServer:
             lines.append(
                 f'tpu_plugin_restarts_total{{resource="{p["resource"]}"}} '
                 f'{p["restarts"]}')
+        lines += ["# HELP tpu_plugin_restart_retries_total Backoff delays "
+                  "issued while re-registering after socket loss.",
+                  "# TYPE tpu_plugin_restart_retries_total counter"]
+        for p in s["plugins"]:
+            retries = p.get("restart_backoff", {}).get("total_attempts", 0)
+            lines.append(
+                f'tpu_plugin_restart_retries_total'
+                f'{{resource="{p["resource"]}"}} {retries}')
         lines += ["# HELP tpu_plugin_allocations_total Successful Allocate "
                   "RPCs since plugin start.",
                   "# TYPE tpu_plugin_allocations_total counter"]
@@ -177,5 +199,24 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_unhealthy_devices gauge",
                 f"tpu_plugin_dra_unhealthy_devices "
                 f"{len(s['dra']['unhealthy_devices'])}",
+                "# HELP tpu_plugin_dra_republish_retries_total Backoff "
+                "delays issued by the slice republish retry.",
+                "# TYPE tpu_plugin_dra_republish_retries_total counter",
+                f"tpu_plugin_dra_republish_retries_total "
+                f"{s['dra']['republish_backoff']['total_attempts']}",
             ]
+            breaker = s["dra"].get("api_breaker")
+            if breaker is not None:
+                lines += [
+                    "# HELP tpu_plugin_kubeapi_breaker_open API-client "
+                    "circuit breaker state (1=open/half-open).",
+                    "# TYPE tpu_plugin_kubeapi_breaker_open gauge",
+                    f"tpu_plugin_kubeapi_breaker_open "
+                    f"{int(breaker['state'] != 'closed')}",
+                    "# HELP tpu_plugin_kubeapi_breaker_trips_total Times "
+                    "the API-client circuit breaker tripped open.",
+                    "# TYPE tpu_plugin_kubeapi_breaker_trips_total counter",
+                    f"tpu_plugin_kubeapi_breaker_trips_total "
+                    f"{breaker['trips']}",
+                ]
         return "\n".join(lines) + "\n"
