@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
+.PHONY: all native proto test coverage bench bench-discovery clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
 
 all: native proto
 
@@ -66,6 +66,13 @@ coverage:
 
 bench:
 	$(PYTHON) bench.py
+
+# Incremental-discovery + churn bench (docs/perf.md): cold full scan vs
+# warm dirty-set rescan read counts at {8,64,256} devices x {0,128}
+# partitions, plus the 100-flip ListAndWatch coalescing storm. Writes
+# docs/bench_discovery_r06.json.
+bench-discovery:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --discovery
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

@@ -84,6 +84,52 @@ def test_vfio_drivers_flag_parsing(host):
     assert cfg.vfio_drivers == ("vfio-pci", "custom-vfio")
 
 
+def test_lw_debounce_flag_env_parity_and_validation(host, monkeypatch):
+    _, root = host
+    # default
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.lw_debounce_s == pytest.approx(0.05)
+    assert cfg.incremental_rediscovery is True
+    # flag (ms -> s)
+    cfg, _ = cli.build_config(["--root", root, "--lw-debounce-ms", "200"])
+    assert cfg.lw_debounce_s == pytest.approx(0.2)
+    # env parity; explicit flag wins over env
+    monkeypatch.setenv("TDP_LW_DEBOUNCE_MS", "75")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.lw_debounce_s == pytest.approx(0.075)
+    cfg, _ = cli.build_config(["--root", root, "--lw-debounce-ms", "0"])
+    assert cfg.lw_debounce_s == 0.0
+    # arm-time validation: negative / NaN / unparseable env all fail loudly
+    for bad in (["--lw-debounce-ms", "-5"], ["--lw-debounce-ms", "nan"],
+                ["--lw-debounce-ms", "inf"]):
+        with pytest.raises(SystemExit) as e:
+            cli.build_config(["--root", root] + bad)
+        assert e.value.code == 2
+    monkeypatch.setenv("TDP_LW_DEBOUNCE_MS", "not-a-number")
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--root", root])
+    assert e.value.code == 2
+
+
+def test_full_rescan_flag_env_parity(host, monkeypatch):
+    _, root = host
+    cfg, _ = cli.build_config(["--root", root, "--full-rescan"])
+    assert cfg.incremental_rediscovery is False
+    monkeypatch.setenv("TDP_FULL_RESCAN", "1")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.incremental_rediscovery is False
+    monkeypatch.setenv("TDP_FULL_RESCAN", "0")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.incremental_rediscovery is True
+    monkeypatch.setenv("TDP_FULL_RESCAN", "true")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.incremental_rediscovery is False
+    # fail-loud: a typo'd value must not silently keep incremental mode
+    monkeypatch.setenv("TDP_FULL_RESCAN", "ture")
+    with pytest.raises(SystemExit):
+        cli.build_config(["--root", root])
+
+
 def test_log_json_formatter(host, capsys):
     _, root = host
     import logging

@@ -340,6 +340,116 @@ def test_explicit_device_plugin_path_wins_over_root():
     assert parsed2.device_plugin_path == "/fixture/device-plugins/"
 
 
+# ------------------------------------------------- HostSnapshot (dirty-set)
+
+
+def test_snapshot_full_scan_matches_discover(tmp_path):
+    """First rescan() is the full walk and must equal discover() exactly —
+    same devices, coords, partitions, group maps."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=0))
+    host.add_chip(FakeChip("0000:00:05.0", iommu_group="12", numa_node=1))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    cfg = make_cfg(host)
+    snap = discovery.HostSnapshot(cfg)
+    reg_a, gens_a = snap.rescan()
+    reg_b, gens_b = discovery.discover(cfg)
+    assert reg_a == reg_b
+    assert gens_a.keys() == gens_b.keys()
+    assert snap.stats["full_scans"] == 1
+
+
+def test_snapshot_warm_rescan_reads_no_unchanged_device(tmp_path):
+    """A change-free warm rescan costs only the class listdirs — zero
+    per-device reads — and returns the identical cached registry object."""
+    host = FakeHost(tmp_path)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i)))
+    snap = discovery.HostSnapshot(make_cfg(host))
+    reg1, _ = snap.rescan()
+    with discovery.count_reads() as w:
+        reg2, _ = snap.rescan()
+    assert reg2 is reg1                      # cached: nothing changed
+    assert not [p for p in w.paths if "/devices/0000:" in p]
+    assert snap.stats["dirty_rescans"] == 1
+
+
+def test_snapshot_sees_hotplug_and_remove_via_listdir_diff(tmp_path):
+    import shutil
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    snap = discovery.HostSnapshot(make_cfg(host))
+    snap.rescan()
+    host.add_chip(FakeChip("0000:00:05.0", device_id="0063",
+                           iommu_group="12"))
+    registry, _ = snap.rescan()               # no dirty hint needed
+    assert [d.bdf for d in registry.devices_by_model["0063"]] == \
+        ["0000:00:05.0"]
+    shutil.rmtree(os.path.join(host.pci, "0000:00:04.0"))
+    registry, _ = snap.rescan()
+    assert "0062" not in registry.devices_by_model
+    assert [d.bdf for d in registry.devices_by_model["0063"]] == \
+        ["0000:00:05.0"]
+
+
+def test_snapshot_dirty_hint_rereads_rebound_driver(tmp_path):
+    """A driver rebind changes no listing — only a dirty hint (or full
+    rescan) makes the snapshot see it; an unhinted warm rescan must NOT."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    snap = discovery.HostSnapshot(make_cfg(host))
+    registry, _ = snap.rescan()
+    assert len(registry.all_devices()) == 1
+    # rebind: vfio-pci -> gvnic (symlink swap, listing unchanged)
+    link = os.path.join(host.pci, "0000:00:04.0", "driver")
+    os.unlink(link)
+    os.symlink(os.path.join(host.drivers, "gvnic"), link)
+    registry, _ = snap.rescan()
+    assert len(registry.all_devices()) == 1   # cache: documented blindness
+    registry, _ = snap.rescan(dirty={"0000:00:04.0"})
+    assert registry.all_devices() == []       # dirty re-read saw the rebind
+    registry, _ = snap.rescan(full=True)
+    assert registry.all_devices() == []
+    assert snap.stats["full_scans"] == 2
+
+
+def test_snapshot_mdev_add_remove_and_dirty(tmp_path):
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", numa_node=1))
+    snap = discovery.HostSnapshot(make_cfg(host))
+    snap.rescan()
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="21")
+    registry, _ = snap.rescan()
+    parts = registry.partitions_by_type["TPU_vhalf"]
+    assert [p.uuid for p in parts] == ["uuid-1"]
+    assert parts[0].numa_node == 1            # served from the chip cache
+    os.unlink(os.path.join(host.mdev, "uuid-1"))
+    registry, _ = snap.rescan()
+    assert registry.partitions_by_type == {}
+
+
+def test_snapshot_partition_spec_mtime_triggers_reload(tmp_path):
+    import json as json_mod
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json_mod.dumps({}))
+    cfg = make_cfg(host, partition_config_path=str(pc))
+    snap = discovery.HostSnapshot(cfg)
+    registry, _ = snap.rescan()
+    assert registry.partitions_by_type == {}
+    pc.write_text(json_mod.dumps({"per_core": True}))
+    os.utime(pc, ns=(1, 10**15))              # force a visible mtime move
+    registry, _ = snap.rescan()
+    assert len(registry.partitions_by_type["v4-core"]) == 2
+    # logical partition synthesis on the warm path reads no chip files
+    with discovery.count_reads() as w:
+        snap.rescan()
+    assert not [p for p in w.paths if "/devices/0000:" in p]
+
+
 def test_registry_device_lookup_paths():
     """Registry.device(): hit, group-mismatch miss, and unknown-BDF miss."""
     from tpu_device_plugin.registry import Registry, TpuDevice
@@ -356,3 +466,67 @@ def test_registry_device_lookup_paths():
     assert reg.device("0000:00:07.0") is None        # unknown bdf
     assert reg.device("0000:00:06.0") is None        # group has no entry
     assert {x.bdf for x in reg.all_devices()} == {d.bdf, other.bdf}
+
+
+def test_logical_partition_flap_dirties_parent_chip(tmp_path):
+    """A vtpu health flap carries the partition uuid ("<bdf>-coreN"), not
+    the parent BDF: the dirty path must translate it so the parent chip's
+    record is re-read (otherwise the dirty mechanism is inert on
+    logical-partition hosts)."""
+    import json as json_mod
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11",
+                           driver="google-tpu", accel_index=0))
+    pc = tmp_path / "partitions.json"
+    pc.write_text(json_mod.dumps({"per_core": True}))
+    snap = discovery.HostSnapshot(
+        make_cfg(host, partition_config_path=str(pc)))
+    registry, _ = snap.rescan()
+    uuid = registry.partitions_by_type["v4-core"][0].uuid
+    assert uuid == "0000:00:04.0-core0"
+    with discovery.count_reads() as w:
+        snap.rescan(dirty={uuid})
+    assert [p for p in w.paths if "/devices/0000:00:04.0/" in p], \
+        "parent chip was not re-read for a flapped logical partition"
+
+
+def test_dirty_hints_survive_transient_bus_listdir_failure(tmp_path, monkeypatch):
+    """A failed PCI listdir defers the tick's dirty hints instead of
+    dropping them: the next successful tick still re-reads the flapped
+    chip."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    snap = discovery.HostSnapshot(make_cfg(host))
+    reg1, _ = snap.rescan()
+    real_listdir = os.listdir
+
+    def failing(path):
+        if path == snap.cfg.pci_base_path:
+            raise OSError(5, "Input/output error")
+        return real_listdir(path)
+
+    monkeypatch.setattr(discovery.os, "listdir", failing)
+    reg2, _ = snap.rescan(dirty={"0000:00:04.0"})
+    assert reg2 is reg1                       # last-known-good served
+    monkeypatch.setattr(discovery.os, "listdir", real_listdir)
+    with discovery.count_reads() as w:
+        snap.rescan()                         # no new hints this tick
+    assert [p for p in w.paths if "0000:00:04.0" in p], \
+        "deferred dirty hint was lost"
+
+
+def test_accel_entry_removed_under_dirty_hint_is_detected(tmp_path):
+    """A dirty hint must not mask accel-class removal: when the flapped
+    chip's accelN entry vanished in the same tick, the rebuilt registry
+    drops the accel_index instead of serving the stale cached build."""
+    import shutil
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11", accel_index=0))
+    cfg = make_cfg(host)
+    snap = discovery.HostSnapshot(cfg)
+    reg1, _ = snap.rescan()
+    assert reg1.all_devices()[0].accel_index == 0
+    shutil.rmtree(os.path.join(cfg.accel_class_path, "accel0"))
+    reg2, _ = snap.rescan(dirty={"0000:00:04.0"})
+    assert reg2 is not reg1
+    assert reg2.all_devices()[0].accel_index is None

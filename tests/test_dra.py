@@ -689,6 +689,71 @@ def test_health_transition_prunes_device_and_bumps_generation(host, apiserver):
     assert driver.unhealthy_devices() == []
 
 
+def test_health_republish_is_one_guarded_put_no_get(host, apiserver):
+    """Generation-keyed delta: a health-only change publishes as ONE PUT
+    under the cached resourceVersion — no read-modify-write GET."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    before = len(apiserver.requests)
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    new = apiserver.requests[before:]
+    assert [m for m, _ in new] == ["PUT"], new
+    obj = next(iter(apiserver.slices.values()))
+    assert obj["spec"]["pool"]["generation"] == 2
+    assert driver.publish_stats["delta"] == 1
+    assert driver.publish_stats["delta_conflicts"] == 0
+
+
+def test_delta_conflict_falls_back_to_read_modify_write(host, apiserver):
+    """An interleaved writer moves the slice's resourceVersion: the delta
+    PUT 409s, and the classic GET+PUT reconciles without losing the
+    health prune (exactly-once: no duplicate write of the same state)."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    # another writer bumps the rv behind the driver's back
+    name = next(iter(apiserver.slices))
+    apiserver._rv += 1
+    apiserver.slices[name]["metadata"]["resourceVersion"] = \
+        str(apiserver._rv)
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    assert driver.publish_stats["delta_conflicts"] == 1
+    obj = apiserver.slices[name]
+    assert obj["spec"]["pool"]["generation"] == 2
+    assert chip_name(0) not in [d["name"] for d in obj["spec"]["devices"]]
+    # cache re-primed by the fallback: the next flip deltas again
+    assert driver.apply_health({"0000:00:04.0": True}) is True
+    assert driver.publish_stats["delta"] == 1
+    assert apiserver.slices[name]["spec"]["pool"]["generation"] == 3
+
+
+def test_delta_after_slice_deleted_behind_driver_restores_it(host,
+                                                             apiserver):
+    """A slice wiped externally (operator/GC) turns the delta PUT into a
+    404; the fallback POST must restore it rather than dropping the
+    publish."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    apiserver.slices.clear()
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    obj = next(iter(apiserver.slices.values()))
+    assert chip_name(0) not in [d["name"] for d in obj["spec"]["devices"]]
+
+
+def test_change_free_republish_still_heals_deleted_slice(host, apiserver):
+    """The delta fast path must not skip the liveness GET on a change-free
+    republish: a slice wiped externally between publishes is recreated
+    even when nothing this driver owns changed (pre-delta behavior)."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    apiserver.slices.clear()
+    assert driver.publish_resource_slices()
+    assert apiserver.slices, "deleted slice not recreated by no-op republish"
+
+
 def test_apply_health_noop_transitions_do_not_publish(host, apiserver):
     _, cfg = host
     driver = make_driver(cfg, apiserver)
@@ -1051,10 +1116,13 @@ def test_api_client_reuses_keepalive_connections(host, apiserver):
     real claim prepare."""
     _, cfg = host
     driver = make_driver(cfg, apiserver)
-    for _ in range(5):
-        assert driver.publish_resource_slices()
+    assert driver.publish_resource_slices()
+    # flip a device's health so every publish is a real write (change-free
+    # republishes cost only a single liveness GET on the delta path)
+    for i in range(4):
+        assert driver.apply_health({"0000:00:04.0": i % 2 == 1})
     n_requests = len(apiserver.requests)
-    # discovery + node uid + first GET+POST + 4 change-free GETs
+    # discovery + node uid + first GET+POST + 4 delta PUTs
     assert n_requests >= 7
     # sequential single-threaded use: everything after the first request
     # should reuse the pooled connection

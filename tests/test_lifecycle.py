@@ -271,6 +271,132 @@ def test_shared_group_change_restarts_coupled_resource(kubelet):
         t.join(timeout=10)
 
 
+def test_timer_ticks_use_dirty_set_rescan_not_full_walk(kubelet):
+    """Steady-state rediscovery ticks must go through the HostSnapshot's
+    dirty-set path: after the boot full walk, a change-free tick reads NO
+    per-device sysfs files (asserted via the discovery module's
+    read-counting shim) and restarts nothing."""
+    from tpu_device_plugin import discovery as disc
+    host, cfg, kub = kubelet
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i)))
+    cfg = replace(cfg, rediscovery_interval_s=0.2)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        # let the boot full walk finish, then watch two+ steady ticks
+        time.sleep(0.3)
+        with disc.count_reads() as w:
+            time.sleep(0.7)
+        per_device = [p for p in w.paths if "/devices/0000:" in p]
+        assert per_device == [], per_device
+        stats = manager.discovery_stats()
+        assert stats["incremental"] is True
+        assert stats["full_scans"] == 1
+        assert stats["dirty_rescans"] >= 2
+        assert len(kub.registrations) == 1        # nothing churned
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_timer_hotplug_reads_only_the_new_bdf(kubelet):
+    """A chip added between ticks is picked up via the listdir diff: the
+    rescan reads the NEW chip's files and no unchanged BDF's."""
+    from tpu_device_plugin import discovery as disc
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    cfg = replace(cfg, rediscovery_interval_s=0.2)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        time.sleep(0.3)
+        with disc.count_reads() as w:
+            host.add_chip(FakeChip("0000:01:00.0", device_id="0063",
+                                   iommu_group="21"))
+            assert kub.wait_for(2, timeout=15)    # v5e plugin came up
+        touched = {p for p in w.paths if "/devices/0000:" in p}
+        assert touched, "rescan never read the hotplugged chip"
+        assert all("0000:01:00.0" in p for p in touched), touched
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_timer_flap_dirties_only_flapped_device_and_recovers(kubelet):
+    """A vfio flap between ticks feeds the flapped chip into the dirty set
+    (via the manager's health-listener seam): the next rescans re-read ONLY
+    that BDF, the device is never permanently lost (chaos invariant), and
+    no plugin restarts (the record itself never changed)."""
+    from tpu_device_plugin import discovery as disc
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:00:05.0", device_id="0062", iommu_group="12"))
+    cfg = replace(cfg, rediscovery_interval_s=0.2, health_poll_s=0.1)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        plugin = manager.plugins[0]
+        time.sleep(0.3)
+        with disc.count_reads() as w:
+            host.remove_vfio_group("11")          # chip 04 flaps Unhealthy
+            deadline = time.monotonic() + 5
+            while plugin.status_snapshot()["devices"]["0000:00:04.0"] \
+                    != "Unhealthy" and time.monotonic() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.5)                       # a tick drains the hint
+        touched = {p for p in w.paths if "/devices/0000:" in p}
+        assert touched, "flap never dirtied a rescan"
+        assert all("0000:00:04.0" in p for p in touched), touched
+        # chaos invariant: the node restores, no permanent device loss
+        with open(os.path.join(host.devfs, "vfio", "11"), "w"):
+            pass
+        deadline = time.monotonic() + 10
+        while plugin.status_snapshot()["devices"]["0000:00:04.0"] \
+                != "Healthy" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert plugin.status_snapshot()["devices"]["0000:00:04.0"] == \
+            "Healthy"
+        assert len(kub.registrations) == 1        # flap != inventory change
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_full_rescan_flag_disables_snapshot(kubelet):
+    """--full-rescan (incremental_rediscovery=False) keeps the classic full
+    walk on every tick — per-device reads on each one."""
+    from tpu_device_plugin import discovery as disc
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = replace(cfg, rediscovery_interval_s=0.2,
+                  incremental_rediscovery=False)
+    manager = PluginManager(cfg)
+    stop = threading.Event()
+    t = threading.Thread(target=manager.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert kub.wait_for(1)
+        with disc.count_reads() as w:
+            time.sleep(0.7)
+        assert [p for p in w.paths if "/devices/0000:" in p]
+        assert manager.discovery_stats() == {"incremental": False}
+        assert manager.snapshot is None
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
 def test_daemon_sigterm_clean_shutdown(short_root):
     """The real process contract: SIGTERM -> exit 0, sockets removed."""
     import signal

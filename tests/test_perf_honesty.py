@@ -9,6 +9,11 @@ item 1). These tests pin the three defenses added in round 4:
   3. probe.validate_slice — refuses (ok=False, perf_suspect=True) any run
      whose microbench exceeds ~1.05x the chip's datasheet peak, and reports
      mfu / microbench_mfu / hbm_frac against the peak otherwise.
+
+Round 6 adds the incremental-discovery honesty guard: the warm dirty-set
+rescan must do strictly fewer — and at least 5x fewer — SYSFS READS than
+the cold full scan at 64 devices. Counted, not timed, so the guard is
+load-insensitive and CI-safe.
 """
 
 import pytest
@@ -315,3 +320,42 @@ def test_ring_bench_rejects_indivisible_seq():
     with pytest.raises(ValueError, match="not divisible"):
         bench_ring(seq_lens=(65,), sp=2, hb=2, head_dim=32,
                    devices=cpus()[:2])
+
+
+# ------------------------------------------------- incremental discovery
+
+
+def test_warm_dirty_rescan_reads_strictly_fewer_than_cold(tmp_path):
+    """bench.py --discovery honesty floor at 64 devices: the warm dirty-set
+    rescan (one flapped chip) must do STRICTLY fewer sysfs reads than the
+    cold full scan — and hold the 5x acceptance ratio. Read counts come
+    from discovery.count_reads (every listdir/readlink/stat/file-read in
+    the discovery module), so the assertion is immune to CI load."""
+    from tests.fakehost import FakeChip, FakeHost
+    from tpu_device_plugin.config import Config
+    from tpu_device_plugin.discovery import HostSnapshot, count_reads
+
+    host = FakeHost(tmp_path)
+    for i in range(64):
+        host.add_chip(FakeChip(f"0000:{i // 32:02x}:{4 + i % 32:02x}.0",
+                               device_id="0063", iommu_group=str(11 + i),
+                               numa_node=i // 32))
+    cfg = Config().with_root(host.root)
+
+    snap = HostSnapshot(cfg)
+    with count_reads() as cold:
+        registry, _ = snap.rescan()
+    assert len(registry.all_devices()) == 64
+
+    with count_reads() as warm:
+        warm_registry, _ = snap.rescan(dirty={"0000:00:04.0"})
+    assert len(warm_registry.all_devices()) == 64
+    assert warm.reads < cold.reads, (warm.reads, cold.reads)
+    assert cold.reads >= 5 * warm.reads, \
+        f"warm rescan {warm.reads} reads vs cold {cold.reads}: ratio " \
+        f"{cold.reads / warm.reads:.1f}x below the 5x acceptance floor"
+    # the warm window touched ONLY the dirty chip's files (plus the three
+    # class listdirs); no other BDF was read
+    other_bdf_reads = [p for p in warm.paths
+                      if "/devices/0000:" in p and "0000:00:04.0" not in p]
+    assert other_bdf_reads == [], other_bdf_reads

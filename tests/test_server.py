@@ -197,6 +197,120 @@ def test_stop_removes_socket(rig):
     assert not os.path.exists(plugin.socket_path)
 
 
+def test_list_and_watch_coalesces_flap_storm(rig):
+    """A burst of health flips inside the debounce window must reach the
+    stream as ONE re-send carrying the final state — and a trailing lone
+    flip must still propagate (no lost final transition)."""
+    host, cfg, kubelet, plugin = rig
+    updates = []
+
+    def consume():
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            try:
+                for resp in api.DevicePluginStub(ch).ListAndWatch(pb.Empty()):
+                    updates.append({d.ID: d.health for d in resp.devices})
+            except grpc.RpcError:
+                pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert _wait(lambda: len(updates) >= 1)
+    # 40 flips back-to-back, ending with group 11 Unhealthy (i=39 -> False)
+    for i in range(40):
+        plugin.set_devices_health(["0000:00:04.0", "0000:00:05.0"],
+                                  healthy=(i % 2 == 0), source="storm")
+    assert _wait(lambda: updates[-1].get("0000:00:04.0") == "Unhealthy")
+    assert len(updates) == 2, updates  # initial + ONE coalesced re-send
+    assert plugin.status_snapshot()["lw_resends"] == 1
+    # a single trailing flip still goes out on its own
+    plugin.set_devices_health(["0000:00:04.0", "0000:00:05.0"],
+                              healthy=True, source="storm")
+    assert _wait(lambda: updates[-1].get("0000:00:04.0") == "Healthy")
+    assert len(updates) == 3
+
+
+def test_lw_debounce_zero_sends_per_flip(short_root):
+    """cfg.lw_debounce_s=0 restores the send-per-transition behavior."""
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    from dataclasses import replace
+    cfg = replace(Config().with_root(host.root), health_poll_s=60,
+                  lw_debounce_s=0.0)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    kubelet = FakeKubelet(cfg.kubelet_socket)
+    registry, _ = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"])
+    plugin.start()
+    updates = []
+    try:
+        def consume():
+            with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+                try:
+                    for resp in api.DevicePluginStub(ch).ListAndWatch(
+                            pb.Empty()):
+                        updates.append(
+                            {d.ID: d.health for d in resp.devices})
+                except grpc.RpcError:
+                    pass
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        assert _wait(lambda: len(updates) >= 1)
+        plugin.set_devices_health(["0000:00:04.0"], False, "storm")
+        assert _wait(lambda: len(updates) >= 2)
+        plugin.set_devices_health(["0000:00:04.0"], True, "storm")
+        assert _wait(lambda: len(updates) >= 3)
+        assert updates[-1]["0000:00:04.0"] == "Healthy"
+    finally:
+        plugin.stop()
+        kubelet.stop()
+
+
+def test_nan_or_negative_debounce_rejected_at_arm_time(short_root):
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    from dataclasses import replace
+    base = Config().with_root(host.root)
+    registry, _ = discover_passthrough(base)
+    devs = registry.devices_by_model["0062"]
+    for bad in (float("nan"), -0.5, float("inf")):
+        with pytest.raises(ValueError, match="lw_debounce_s"):
+            TpuDevicePlugin(replace(base, lw_debounce_s=bad), "v4",
+                            registry, devs)
+
+
+def test_preferred_cache_is_lru_not_wholesale_clear(rig):
+    """Filling the memo past capacity must evict ONLY the oldest entry: a
+    recently-used key stays a hit (the old clear() dumped all 128)."""
+    from tpu_device_plugin import server as server_mod
+    host, cfg, kubelet, plugin = rig
+
+    def ask(ids, size=1):
+        plugin.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=ids, allocation_size=size)]), None)
+
+    hot = ["0000:00:04.0", "0000:00:05.0"]
+    ask(hot)                                   # miss 1: the hot key
+    misses0 = plugin._pref_misses
+    # fill the cache past capacity with distinct keys (unknown ids are
+    # filtered from the scan but stay in the memo key), touching the hot
+    # key along the way so LRU keeps it
+    for i in range(server_mod.PREF_CACHE_SIZE + 10):
+        ask(["0000:00:04.0", f"filler-{i}"])
+        ask(hot)                               # keep the hot key fresh
+    assert len(plugin._pref_cache) <= server_mod.PREF_CACHE_SIZE
+    before_hits = plugin._pref_hits
+    ask(hot)
+    assert plugin._pref_hits == before_hits + 1   # survived eviction: hit
+    snap = plugin.status_snapshot()["preferred_cache"]
+    assert snap["hits"] == plugin._pref_hits
+    assert snap["misses"] >= misses0
+    assert snap["capacity"] == server_mod.PREF_CACHE_SIZE
+
+
 def test_allocate_rejects_other_models_bdf(short_root):
     """The v5e plugin must refuse a v4 BDF even though both live in the same
     registry (the reference's global map would hand it out)."""

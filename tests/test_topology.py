@@ -231,3 +231,25 @@ def test_switch_topology_with_downstream_ports(tmp_path):
     # path order pairs each switch's own chips instead
     assert adjacent("0000:02:00.0", "0000:03:00.0"), coords
     assert adjacent("0000:02:01.0", "0000:03:01.0"), coords
+
+
+def test_boxes_memoized_across_index_rebuilds():
+    """Plugin restarts / rediscovery rebuilds construct a fresh
+    AllocationIndex for the same torus; the sub-box enumeration (the
+    expensive, purely dims-derived part) must be served from the _boxes
+    memo, not re-enumerated per construction."""
+    from tpu_device_plugin.topology import AllocationIndex, _boxes
+
+    dims = (4, 4, 4)
+    devs = [AllocatableDevice(f"d{i}", numa_node=0,
+                              coords=(i // 16, (i // 4) % 4, i % 4))
+            for i in range(64)]
+    _boxes.cache_clear()
+    AllocationIndex(devs, dims)
+    after_first = _boxes.cache_info()
+    assert after_first.misses == 1
+    for _ in range(3):  # rediscovery rebuilds on the same torus
+        AllocationIndex(devs, dims)
+    after = _boxes.cache_info()
+    assert after.misses == 1, "sub-box enumeration re-paid on rebuild"
+    assert after.hits >= after_first.hits + 3

@@ -99,7 +99,8 @@ def partition_entries(cfg: Config, partitions: Sequence[TpuPartition],
     return entries
 
 
-def _spec_path(cfg: Config, suffix: str) -> str:
+def spec_path(cfg: Config, suffix: str) -> str:
+    """Where a resource's CDI spec file lives (whether or not it exists)."""
     return os.path.join(
         cfg.cdi_spec_dir,
         f"{cfg.resource_namespace.replace('/', '_')}-{suffix}.json")
@@ -118,7 +119,7 @@ def write_spec(cfg: Config, entries: Sequence[dict], suffix: str) -> Optional[st
         },
         "devices": list(entries),
     }
-    path = _spec_path(cfg, suffix)
+    path = spec_path(cfg, suffix)
     try:
         os.makedirs(cfg.cdi_spec_dir, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=cfg.cdi_spec_dir, suffix=".tmp")

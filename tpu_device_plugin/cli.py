@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import argparse
 import logging
+import math
+import os
 import signal
 import threading
 from dataclasses import replace
@@ -70,6 +72,17 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
+    # default=None sentinel so the env var ($TDP_LW_DEBOUNCE_MS) can supply
+    # the value when the flag is absent, with the SAME validation either way
+    parser.add_argument("--lw-debounce-ms", type=float, default=None,
+                        help="coalesce ListAndWatch health re-sends within "
+                             "this window (ms; 0 = send per flip; default "
+                             f"{cfg.lw_debounce_s * 1000:g}; env "
+                             "TDP_LW_DEBOUNCE_MS)")
+    parser.add_argument("--full-rescan", action="store_true",
+                        help="disable dirty-set incremental rediscovery: "
+                             "every rediscovery tick walks all of sysfs "
+                             "(env TDP_FULL_RESCAN=1)")
     parser.add_argument("--shared-scan-ttl", type=float,
                         default=cfg.shared_scan_ttl_s,
                         help="cache the shared-device (EGM-analogue) sysfs "
@@ -119,6 +132,33 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         parser.error("--max-partitions-per-chip must be >= 0 "
                      "(0 = no extra cap); negative values would silently "
                      "disable the cap")
+    if not args.full_rescan:
+        env_full = os.environ.get("TDP_FULL_RESCAN")
+        if env_full is not None:
+            val = env_full.strip().lower()
+            if val in ("1", "true", "yes", "on"):
+                args.full_rescan = True
+            elif val not in ("", "0", "false", "no", "off"):
+                # fail loudly like the other env knobs: a typo'd truthy
+                # value silently keeping incremental mode is the worst case
+                parser.error(f"$TDP_FULL_RESCAN={env_full!r} is not a "
+                             "boolean (use 1/0, true/false, yes/no, on/off)")
+    if args.lw_debounce_ms is None:
+        env_debounce = os.environ.get("TDP_LW_DEBOUNCE_MS")
+        if env_debounce is not None:
+            try:
+                args.lw_debounce_ms = float(env_debounce)
+            except ValueError:
+                parser.error(f"$TDP_LW_DEBOUNCE_MS={env_debounce!r} is not "
+                             "a number")
+        else:
+            args.lw_debounce_ms = cfg.lw_debounce_s * 1000.0
+    # reject bad windows HERE, not deep in a plugin thread mid-flap: a NaN
+    # window would make every condvar timeout comparison silently false
+    if math.isnan(args.lw_debounce_ms) or math.isinf(args.lw_debounce_ms) \
+            or args.lw_debounce_ms < 0:
+        parser.error("--lw-debounce-ms must be a finite number >= 0, got "
+                     f"{args.lw_debounce_ms!r}")
 
     level = logging.DEBUG if args.verbose else logging.INFO
     if args.log_json:
@@ -167,6 +207,8 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         health_poll_s=args.health_poll_seconds,
         rediscovery_interval_s=args.rediscovery_seconds,
         shared_scan_ttl_s=args.shared_scan_ttl,
+        lw_debounce_s=args.lw_debounce_ms / 1000.0,
+        incremental_rediscovery=not args.full_rescan,
     )
     if args.root:
         cfg = cfg.with_root(args.root)

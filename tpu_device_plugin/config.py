@@ -91,6 +91,16 @@ class Config:
     grpc_timeout_s: float = 5.0      # registration dial bound (reference :53)
     health_poll_s: float = 5.0       # native liveness probe cadence (NVML parity)
     rediscovery_interval_s: float = 0.0  # 0 disables periodic re-discovery
+    # ListAndWatch coalesce window: health transitions landing within this
+    # window are folded into ONE re-send (a vfio flap storm otherwise
+    # re-streams the whole device list N times). Trailing-edge: a lone flip
+    # still propagates after one quiet window; 0 restores send-per-flip.
+    # Validated at plugin arm time (server.py rejects negative/NaN loudly).
+    lw_debounce_s: float = 0.05
+    # Dirty-set rediscovery (discovery.HostSnapshot): the periodic timer
+    # rescans only changed/flapped devices instead of walking all of sysfs.
+    # False (--full-rescan) restores the full walk on every tick.
+    incremental_rediscovery: bool = True
     # Shared-device (EGM-analogue) scan cache TTL inside a plugin server's
     # Allocate path. 0 = rescan every Allocate (the reference's behavior,
     # generic_device_plugin.go:366); a small TTL keeps hotplug visible within

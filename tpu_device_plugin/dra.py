@@ -160,6 +160,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             base_s=HEALTH_REPUBLISH_BASE_S, cap_s=HEALTH_REPUBLISH_RETRY_S)
         self._stopped = False
         self._resource_version_cache: Optional[str] = None
+        # Last successful slice write: {rv, generation, projection, version}.
+        # Lets a health-only change publish as ONE guarded PUT keyed by the
+        # locally-tracked pool generation (generation+1 under the cached
+        # resourceVersion) instead of the whole GET+diff+PUT read-modify-
+        # write; any interleaved writer surfaces as a 409 and falls back.
+        # Guarded by _publish_lock (only _publish_locked touches it).
+        self._last_publish: Optional[dict] = None
+        # delta vs full publish counters for /status + /metrics
+        self.publish_stats = {"full": 0, "delta": 0, "delta_conflicts": 0}
         # serializes slice publishes against each other AND against
         # stop(withdraw_slice=True): an in-flight retry publish racing the
         # withdraw could otherwise POST the slice back after the delete
@@ -538,7 +547,53 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 if exc.code != 404:
                     log.error("DRA: slice delete failed: %s", exc)
                     return False
+            self._last_publish = None
             return True
+        # Delta fast path: this driver is the slice's only legitimate
+        # writer, so the rv/generation/projection of OUR last write is
+        # normally still live — publish the new state as one PUT keyed by
+        # the local pool generation, skipping the GET. The resourceVersion
+        # guard keeps it exactly-once: an interleaved writer (or a slice
+        # wiped behind our back) turns into a 409/404 and the classic
+        # read-modify-write below reconciles.
+        cached = self._last_publish
+        if cached is not None and cached["version"] == version:
+            desired = self.build_slice(
+                pool_generation=cached["generation"] + 1, version=version)
+            proj = self._spec_projection(desired["spec"])
+            # On an unchanged projection fall through to the classic path
+            # below instead: its GET doubles as the liveness check that
+            # recreates a slice wiped behind our back (a change-free
+            # republish healed that before the delta path existed, and
+            # must keep doing so).
+            if proj != cached["projection"]:
+                desired["metadata"]["resourceVersion"] = cached["rv"]
+                try:
+                    live = self.api.put_json(path, desired)
+                except ApiError as exc:
+                    self._last_publish = None
+                    if exc.code == 409:
+                        self.publish_stats["delta_conflicts"] += 1
+                        log.info("DRA: delta publish of %s conflicted; "
+                                 "falling back to read-modify-write", name)
+                    elif exc.code == 404:
+                        # slice wiped behind our back (operator/GC) — NOT
+                        # an API-version signal (same 404 semantics as the
+                        # delete and classic-GET paths); the
+                        # read-modify-write below recreates it
+                        log.info("DRA: slice %s vanished under delta "
+                                 "publish; recreating", name)
+                    else:
+                        log.error("DRA: delta slice PUT failed: %s", exc)
+                        return False
+                else:
+                    self.publish_stats["delta"] += 1
+                    self._remember_publish(live, desired, proj, version)
+                    log.info("DRA: updated ResourceSlice %s to pool "
+                             "generation %d (%d devices, delta)", name,
+                             desired["spec"]["pool"]["generation"],
+                             len(desired["spec"]["devices"]))
+                    return True
         desired = self.build_slice(version=version)
         try:
             live = self.api.get_json(path)
@@ -547,12 +602,17 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 log.error("DRA: slice GET failed: %s", exc)
                 return False
             try:
-                self.api.post_json(f"{api_base}/resourceslices", desired)
+                created = self.api.post_json(f"{api_base}/resourceslices",
+                                             desired)
             except ApiError as exc2:
                 log.error("DRA: slice POST failed: %s", exc2)
                 if exc2.code == 404:
                     self._note_api_404()
                 return False
+            self.publish_stats["full"] += 1
+            self._remember_publish(
+                created, desired, self._spec_projection(desired["spec"]),
+                version)
             log.info("DRA: published ResourceSlice %s (%d devices)",
                      name, len(desired["spec"]["devices"]))
             return True
@@ -560,22 +620,44 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         live_gen = ((live_spec.get("pool") or {}).get("generation")) or 1
         if self._spec_projection(live_spec) == \
                 self._spec_projection(desired["spec"]):
+            # adopt the live object as the delta baseline: the next health
+            # flip can go straight to the guarded-PUT path
+            self._remember_publish(live, live, self._spec_projection(
+                live_spec), version, generation=live_gen)
             return True
         desired = self.build_slice(pool_generation=live_gen + 1,
                                    version=version)
         desired["metadata"]["resourceVersion"] = (
             (live.get("metadata") or {}).get("resourceVersion"))
         try:
-            self.api.put_json(path, desired)
+            updated = self.api.put_json(path, desired)
         except ApiError as exc:
             log.error("DRA: slice PUT failed: %s", exc)
             if exc.code == 404:
                 self._note_api_404()
             return False
+        self.publish_stats["full"] += 1
+        self._remember_publish(
+            updated, desired, self._spec_projection(desired["spec"]), version)
         log.info("DRA: updated ResourceSlice %s to pool generation %d "
                  "(%d devices)", name, live_gen + 1,
                  len(desired["spec"]["devices"]))
         return True
+
+    def _remember_publish(self, live_obj: dict, desired: dict,
+                          projection: tuple, version: str,
+                          generation: Optional[int] = None) -> None:
+        """Record the apiserver's view of our last write for the delta path;
+        an apiserver that returns no resourceVersion just disables it."""
+        rv = ((live_obj or {}).get("metadata") or {}).get("resourceVersion")
+        if generation is None:
+            generation = ((desired.get("spec") or {}).get("pool")
+                          or {}).get("generation") or 1
+        if not rv:
+            self._last_publish = None
+            return
+        self._last_publish = {"rv": rv, "generation": generation,
+                              "projection": projection, "version": version}
 
     @staticmethod
     def _spec_projection(spec: dict) -> tuple:

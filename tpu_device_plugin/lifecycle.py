@@ -18,7 +18,7 @@ import time
 from typing import List, Optional
 
 from .config import Config
-from .discovery import discover
+from .discovery import HostSnapshot, discover
 from .naming import resource_name_for
 from .native import TpuHealth
 from .registry import Registry
@@ -39,10 +39,22 @@ class PluginManager:
         # False return (e.g. API server unreachable at node boot) is retried
         # from the run loop even when inventory never changes
         self.on_inventory = on_inventory
-        # forwarded to every plugin server: called with
-        # {device_id: healthy} on effective health transitions (the DRA
-        # driver prunes dead devices from its ResourceSlice through this)
-        self.health_listener = health_listener
+        # Every plugin server gets _observe_health as its listener: it feeds
+        # flap events into the dirty-rescan hint set, then forwards to the
+        # caller-provided listener (the DRA driver prunes dead devices from
+        # its ResourceSlice through this).
+        self._downstream_health_listener = health_listener
+        self.health_listener = self._observe_health
+        # Dirty-set incremental rediscovery (discovery.HostSnapshot):
+        # device ids whose health CHANGED since the last tick are re-read
+        # from sysfs on the next rescan; everything else rides the cache.
+        # _health_baseline filters the listener's unconditional snapshot
+        # deliveries (every probe poll re-delivers every id) down to real
+        # transitions so steady-state polls never dirty anything.
+        self.snapshot: Optional[HostSnapshot] = None
+        self._dirty: set = set()
+        self._dirty_lock = threading.Lock()
+        self._health_baseline: dict = {}
         self._last_inventory = None
         self._inventory_published = True
         self._next_publish_retry = 0.0
@@ -76,7 +88,55 @@ class PluginManager:
                  self.native_info["native_shim"],
                  self.native_info["libtpu_available"])
 
-    def build_plugins(self, inventory=None) -> List[TpuDevicePlugin]:
+    def _observe_health(self, transitions) -> None:
+        """Plugin-server health listener: record real transitions as dirty
+        rescan hints, then forward to the external listener (if any)."""
+        with self._dirty_lock:
+            for dev_id, healthy in transitions.items():
+                if self._health_baseline.get(dev_id) != healthy:
+                    self._health_baseline[dev_id] = healthy
+                    self._dirty.add(dev_id)
+        if self._downstream_health_listener is not None:
+            self._downstream_health_listener(transitions)
+
+    def _seed_health_baseline(self, registry: Registry) -> None:
+        """Plugins are (re)built all-Healthy: align the baseline so the
+        first unconditional listener snapshot after a rebuild does not mark
+        every unchanged device dirty; ids that left the inventory drop out."""
+        ids = {d.bdf for devs in registry.devices_by_model.values()
+               for d in devs}
+        ids |= {p.uuid for ps in registry.partitions_by_type.values()
+                for p in ps}
+        with self._dirty_lock:
+            self._health_baseline = {
+                i: self._health_baseline.get(i, True) for i in ids}
+
+    def _rediscover(self):
+        """The run loop's discovery: dirty-set rescan through the
+        HostSnapshot when enabled, the classic full walk otherwise."""
+        if not self.cfg.incremental_rediscovery:
+            return discover(self.cfg)
+        if self.snapshot is None:
+            self.snapshot = HostSnapshot(self.cfg)
+        with self._dirty_lock:
+            dirty, self._dirty = self._dirty, set()
+        return self.snapshot.rescan(dirty=dirty)
+
+    def discovery_stats(self) -> dict:
+        """Snapshot scan counters for /status + /metrics."""
+        out = {"incremental": self.cfg.incremental_rediscovery}
+        if self.snapshot is not None:
+            out.update(self.snapshot.stats)
+        return out
+
+    def build_plugins(self, inventory=None,
+                      skip_keys=frozenset()) -> List[TpuDevicePlugin]:
+        """Build plugin servers for the inventory, returning only those
+        whose key is NOT in `skip_keys` (resources whose running plugin
+        survives a rediscovery unchanged — they keep their device tables,
+        AllocationIndex and planner; their already-written CDI specs are
+        merely kept off the prune list). CDI publication and fact
+        publication still cover the complete resource set."""
         registry, generations = inventory if inventory else discover(self.cfg)
         self.registry = registry
         if self.on_inventory is not None:
@@ -87,6 +147,22 @@ class PluginManager:
         for model, devs in sorted(registry.devices_by_model.items()):
             suffix = resource_name_for(model, generations, self.cfg.pci_ids_path)
             info = generations.get(model)
+            if ("pt", suffix) in skip_keys:
+                # unchanged signature: the running plugin survives with
+                # zero table rebuilds, but its spec file is still
+                # re-written (identical content, atomic replace) so
+                # on-disk drift/corruption heals exactly as the old full
+                # rebuild did
+                if self.cfg.cdi_spec_dir:
+                    from . import cdi
+                    path = cdi.write_spec(
+                        self.cfg, cdi.device_entries(self.cfg, devs),
+                        suffix)
+                    # a failed re-write must not let prune_specs delete the
+                    # still-valid existing file the surviving plugin's CDI
+                    # annotations reference
+                    cdi_paths.append(path or cdi.spec_path(self.cfg, suffix))
+                continue
             cdi_enabled = False
             if self.cfg.cdi_spec_dir:
                 from . import cdi
@@ -108,6 +184,17 @@ class PluginManager:
         # the single authority that drops them (with the parent chips kept
         # as passthrough)
         for type_name, parts in sorted(registry.partitions_by_type.items()):
+            if ("vtpu", type_name) in skip_keys:
+                if self.cfg.cdi_spec_dir:
+                    from . import cdi
+                    path = cdi.write_spec(
+                        self.cfg,
+                        cdi.partition_entries(self.cfg, parts,
+                                              registry.bdf_to_group),
+                        f"vtpu-{type_name}")
+                    cdi_paths.append(
+                        path or cdi.spec_path(self.cfg, f"vtpu-{type_name}"))
+                continue
             cdi_enabled = False
             cdi_uuids: frozenset = frozenset()
             if self.cfg.cdi_spec_dir:
@@ -177,8 +264,11 @@ class PluginManager:
         return sigs
 
     def start(self, inventory=None) -> None:
-        inventory = inventory if inventory else discover(self.cfg)
+        # first boot pays the one full walk; subsequent timer ticks go
+        # through the snapshot's dirty-set path
+        inventory = inventory if inventory else self._rediscover()
         self._sigs = self._signatures(*inventory)
+        self._seed_health_baseline(inventory[0])
         self.plugins = self.build_plugins(inventory)
         self.pending = list(self.plugins)
         self._try_start_pending()
@@ -191,6 +281,7 @@ class PluginManager:
         resource's allocatable count on any hotplug)."""
         registry, generations = inventory
         new_sigs = self._signatures(registry, generations)
+        self._seed_health_baseline(registry)
         if new_sigs == self._sigs:
             return
         # only a RUNNING plugin may survive on an unchanged signature; a
@@ -217,10 +308,10 @@ class PluginManager:
             except Exception as exc:
                 log.error("plugin %s failed to stop cleanly: %s",
                           plugin.resource_name, exc)
-        # full rebuild keeps CDI spec writing/pruning and fact publication
-        # correct for the complete resource set; only the fresh keys start
-        built = self.build_plugins(inventory)
-        fresh = [p for p in built if self._plugin_key(p) not in unchanged]
+        # CDI prune bookkeeping and fact publication cover the complete
+        # resource set, but ONLY changed keys get their tables rebuilt —
+        # an unchanged resource costs zero plugin/index construction
+        fresh = self.build_plugins(inventory, skip_keys=unchanged)
         self.plugins = survivors + fresh
         self.pending = list(fresh)
         self._try_start_pending()
@@ -334,7 +425,7 @@ class PluginManager:
                 if next_rediscovery is not None \
                         and time.monotonic() >= next_rediscovery:
                     next_rediscovery = time.monotonic() + interval
-                    self._apply_inventory(discover(self.cfg))
+                    self._apply_inventory(self._rediscover())
         finally:
             self.running.clear()
             self.stop()

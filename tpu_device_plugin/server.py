@@ -17,10 +17,11 @@ the kubelet wipes its socket dir. Differences by design:
 from __future__ import annotations
 
 import logging
+import math
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent import futures
 from datetime import datetime, timezone
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -39,6 +40,16 @@ from .resilience import BackoffPolicy
 from .topology import AllocatableDevice, AllocationIndex, MustIncludeTooLarge
 
 log = logging.getLogger(__name__)
+
+# GetPreferredAllocation memo capacity (see _pref_cache): a true LRU, so
+# hitting the cap evicts only the single coldest entry instead of the old
+# wholesale clear() whose next 128 calls all recomputed the box scan.
+PREF_CACHE_SIZE = 128
+# Starvation cap for the ListAndWatch coalesce window: a relentless flap
+# storm may never produce a quiet window, so after this many windows of
+# deferral the current state is sent anyway (the trailing edge still
+# re-sends the final state afterwards).
+LW_MAX_DEFER_WINDOWS = 10
 
 
 class RegistrationError(Exception):
@@ -72,6 +83,16 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         cdi_enabled: bool = False,
         health_listener=None,
     ) -> None:
+        # arm-time validation, matching faults.py's fail-loud convention: a
+        # NaN window makes every condvar timeout comparison silently false
+        # and a negative one raises deep inside a stream thread mid-flap —
+        # refuse to build the server instead
+        debounce = cfg.lw_debounce_s
+        if not isinstance(debounce, (int, float)) or math.isnan(debounce) \
+                or math.isinf(debounce) or debounce < 0:
+            raise ValueError(
+                f"lw_debounce_s must be a finite number >= 0, got "
+                f"{debounce!r}")
         self.cfg = cfg
         # Optional observer called with {device_id: effective_health} on
         # every EFFECTIVE transition (after the ANDed-sources verdict flips),
@@ -127,14 +148,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # VMI attach issues (what was handed out, when)
         self._recent_allocs: deque = deque(maxlen=16)
         self._alloc_count = 0  # monotonic, for the Prometheus counter
-        # memo for the GetPreferredAllocation box scan (see handler);
+        # LRU memo for the GetPreferredAllocation box scan (see handler);
         # guarded by its own lock — handlers run on concurrent gRPC worker
-        # threads, and the wholesale clear() racing an insert must not rely
-        # on CPython dict atomicity. Invariant: the scan result depends on
+        # threads. At capacity the single oldest entry is evicted
+        # (move-to-end on hit), never the whole table: the old wholesale
+        # clear() made call 129 a thundering recompute for every cached
+        # availability set. Invariant: the scan result depends on
         # (availability, must-include, size, version), never health, so a
         # stale hit is impossible while the version is in the key.
-        self._pref_cache: Dict[tuple, list] = {}
+        self._pref_cache: "OrderedDict[tuple, list]" = OrderedDict()
         self._pref_lock = threading.Lock()
+        self._pref_hits = 0
+        self._pref_misses = 0
+        # ListAndWatch re-sends since start (initial snapshots excluded):
+        # the observable cost of health churn on the kubelet stream
+        self._lw_resends = 0
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -404,11 +432,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 degraded_links[d.bdf] = (
                     f"gen{link['cur_speed']}x{link['cur_width']} of "
                     f"gen{link['max_speed']}x{link['max_width']}")
+        with self._pref_lock:
+            pref_cache = {"hits": self._pref_hits,
+                          "misses": self._pref_misses,
+                          "size": len(self._pref_cache),
+                          "capacity": PREF_CACHE_SIZE}
         return {
             "resource": self.resource_name,
             "socket": self.socket_path,
             "serving": self._serving,
             "restarts": self._restart_count,
+            # GetPreferredAllocation LRU memo effectiveness + ListAndWatch
+            # re-send count (how much health churn reached the kubelet
+            # stream after coalescing)
+            "preferred_cache": pref_cache,
+            "lw_resends": self._lw_resends,
             # recovery-activity counters (resilience.BackoffPolicy): how many
             # backoff delays restart() has issued, lifetime and current-run
             "restart_backoff": self._restart_backoff.snapshot(),
@@ -447,12 +485,21 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         return pb.DevicePluginOptions(get_preferred_allocation_available=True)
 
     def ListAndWatch(self, request, context):
-        """Initial full list, then a re-send on every health transition
+        """Initial full list, then a re-send on health transitions
         (reference :312-349). Purely event-driven: the stream thread sleeps
         on the condvar with NO timeout — wakeups come from health
         transitions (_cond.notify_all), teardown, and an RPC-termination
         callback that fires when the kubelet drops the stream (otherwise a
-        dead stream would pin its worker thread on the condvar forever)."""
+        dead stream would pin its worker thread on the condvar forever).
+
+        Re-sends are COALESCED on the trailing edge of a quiet window
+        (cfg.lw_debounce_s): a vfio flap storm that flips N times inside the
+        window produces one re-send carrying the final state, while a lone
+        flip still goes out after a single window. LW_MAX_DEFER_WINDOWS
+        bounds deferral so a relentless storm cannot starve the stream; the
+        loop re-compares versions after every send, so the LAST state always
+        reaches the kubelet (the exactly-once/no-lost-final-state chaos
+        guarantees ride on this)."""
         version, devices = self._snapshot()
         log.info("%s: ListAndWatch stream opened (%d devices)",
                  self.resource_name, len(devices))
@@ -471,7 +518,24 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     or not context.is_active())
                 if self._stop.is_set() or not context.is_active():
                     return
+            debounce = self.cfg.lw_debounce_s
+            if debounce > 0:
+                deadline = time.monotonic() + debounce * LW_MAX_DEFER_WINDOWS
+                while time.monotonic() < deadline:
+                    with self._cond:
+                        v0 = self._version
+                        moved = self._cond.wait_for(
+                            lambda: self._version != v0
+                            or self._stop.is_set()
+                            or not context.is_active(),
+                            timeout=debounce)
+                        if self._stop.is_set() or not context.is_active():
+                            return
+                    if not moved:
+                        break  # one full quiet window: trailing edge
             version, devices = self._snapshot()
+            with self._cond:
+                self._lw_resends += 1
             log.info("%s: device state changed; re-sending %d devices",
                      self.resource_name, len(devices))
             yield pb.ListAndWatchResponse(devices=devices)
@@ -494,6 +558,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                    creq.allocation_size)
             with self._pref_lock:
                 ids = self._pref_cache.get(key)
+                if ids is not None:
+                    self._pref_cache.move_to_end(key)
+                    self._pref_hits += 1
+                else:
+                    self._pref_misses += 1
             if ids is None:
                 try:
                     ids = index.preferred(
@@ -504,9 +573,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 except MustIncludeTooLarge as exc:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
                 with self._pref_lock:
-                    if len(self._pref_cache) >= 128:
-                        self._pref_cache.clear()
+                    while key not in self._pref_cache \
+                            and len(self._pref_cache) >= PREF_CACHE_SIZE:
+                        self._pref_cache.popitem(last=False)
                     self._pref_cache[key] = ids
+                    self._pref_cache.move_to_end(key)
             resp.container_responses.append(
                 pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
         return resp

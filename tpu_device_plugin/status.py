@@ -103,6 +103,11 @@ class StatusServer:
         publish_backoff = getattr(self.manager, "publish_backoff", None)
         if publish_backoff is not None:
             out["inventory_publish_backoff"] = publish_backoff.snapshot()
+        # incremental-discovery scan counters (full walks vs dirty-set
+        # rescans + sysfs reads of the last scan)
+        discovery_stats = getattr(self.manager, "discovery_stats", None)
+        if discovery_stats is not None:
+            out["discovery"] = discovery_stats()
         fault_stats = faults.stats()
         armed = faults.armed_sites()
         if fault_stats or armed:
@@ -118,6 +123,9 @@ class StatusServer:
                 "prepared_claims": d.prepared_claim_count(),
                 "unhealthy_devices": d.unhealthy_devices(),
                 "republish_backoff": d.republish_backoff.snapshot(),
+                # delta (generation-keyed guarded PUT) vs full
+                # (read-modify-write) slice publishes
+                "publish_stats": dict(d.publish_stats),
             }
             if d.api is not None:
                 out["dra"]["api_breaker"] = d.api.breaker.snapshot()
@@ -171,6 +179,39 @@ class StatusServer:
             lines.append(
                 f'tpu_plugin_allocations_total{{resource="{p["resource"]}"}} '
                 f'{p["allocations_total"]}')
+        lines += ["# HELP tpu_plugin_pref_cache_total GetPreferredAllocation "
+                  "LRU memo lookups by outcome.",
+                  "# TYPE tpu_plugin_pref_cache_total counter"]
+        for p in s["plugins"]:
+            cache = p.get("preferred_cache", {})
+            for outcome, key in (("hit", "hits"), ("miss", "misses")):
+                lines.append(
+                    f'tpu_plugin_pref_cache_total{{resource='
+                    f'"{p["resource"]}",outcome="{outcome}"}} '
+                    f'{cache.get(key, 0)}')
+        lines += ["# HELP tpu_plugin_lw_resends_total ListAndWatch re-sends "
+                  "after debounce coalescing (initial snapshots excluded).",
+                  "# TYPE tpu_plugin_lw_resends_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_lw_resends_total{{resource="{p["resource"]}"}} '
+                f'{p.get("lw_resends", 0)}')
+        disc = s.get("discovery")
+        if disc:
+            lines += [
+                "# HELP tpu_plugin_discovery_scans_total Discovery walks by "
+                "kind (full sysfs walk vs dirty-set rescan).",
+                "# TYPE tpu_plugin_discovery_scans_total counter",
+                f'tpu_plugin_discovery_scans_total{{kind="full"}} '
+                f'{disc.get("full_scans", 0)}',
+                f'tpu_plugin_discovery_scans_total{{kind="dirty"}} '
+                f'{disc.get("dirty_rescans", 0)}',
+                "# HELP tpu_plugin_discovery_last_scan_reads Sysfs reads "
+                "made by the most recent discovery scan.",
+                "# TYPE tpu_plugin_discovery_last_scan_reads gauge",
+                f'tpu_plugin_discovery_last_scan_reads '
+                f'{disc.get("last_scan_reads", 0)}',
+            ]
         lines += [
             "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",
             "# TYPE tpu_plugin_pending_plugins gauge",
@@ -204,6 +245,14 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_republish_retries_total counter",
                 f"tpu_plugin_dra_republish_retries_total "
                 f"{s['dra']['republish_backoff']['total_attempts']}",
+                "# HELP tpu_plugin_dra_slice_publishes_total Successful "
+                "ResourceSlice publishes by kind (delta = generation-keyed "
+                "guarded PUT, full = read-modify-write).",
+                "# TYPE tpu_plugin_dra_slice_publishes_total counter",
+                f'tpu_plugin_dra_slice_publishes_total{{kind="delta"}} '
+                f"{s['dra']['publish_stats']['delta']}",
+                f'tpu_plugin_dra_slice_publishes_total{{kind="full"}} '
+                f"{s['dra']['publish_stats']['full']}",
             ]
             breaker = s["dra"].get("api_breaker")
             if breaker is not None:
