@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
+.PHONY: all native proto test coverage bench bench-discovery bench-health clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
 
 all: native proto
 
@@ -73,6 +73,13 @@ bench:
 # docs/bench_discovery_r06.json.
 bench-discovery:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --discovery
+
+# Shared-health-plane bench (docs/perf.md "health plane"): probe-cycle wall
+# at {8,64,256} devices with 0/1 injected 1s-slow chips (must be bounded by
+# the per-cycle deadline, not the serial sum) + inotify-fd/thread gauges vs
+# resource count (one fd per HOST). Writes docs/bench_health_r07.json.
+bench-health:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --health
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

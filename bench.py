@@ -633,12 +633,162 @@ def run_discovery():
     }
 
 
+def _health_cell(n_devices, slow_chips, deadline_s=0.25, workers=8,
+                 cycles=3, slow_hang_s=1.0):
+    """One shared-health-plane matrix point.
+
+    Builds a hub with one subscription per 8 devices (mirroring one plugin
+    server per resource), real watched socket/node files (so the inotify-fd
+    gauge measures the production shape), and a probe where `slow_chips`
+    chips hang their config-space read for `slow_hang_s`. The headline per
+    cell is the probe-cycle WALL vs the per-cycle deadline — with the old
+    serial loop the cycle would cost slow_chips x slow_hang_s.
+    """
+    from tpu_device_plugin.healthhub import HealthHub, HubSubscription
+
+    root = tempfile.mkdtemp(prefix=f"tdphlt{n_devices}-")
+    try:
+        vfio = os.path.join(root, "dev", "vfio")
+        sockdir = os.path.join(root, "plugins")
+        os.makedirs(vfio)
+        os.makedirs(sockdir)
+        n_resources = max(1, n_devices // 8)
+        # slow chips sit mid-fleet, not first, so submission order cannot
+        # accidentally front-load the hang
+        slow = {f"bdf-{n_devices // 2 + i}" for i in range(slow_chips)}
+
+        def probe(bdf, node):
+            if bdf in slow:
+                time.sleep(slow_hang_s)
+            return True
+
+        hub = HealthHub(poll_interval_s=3600.0, probe_workers=workers,
+                        probe_deadline_s=deadline_s)
+        idx = 0
+        per_res = n_devices // n_resources
+        for r in range(n_resources):
+            sock = os.path.join(sockdir, f"r{r}.sock")
+            open(sock, "w").close()
+            paths, bdfs = {}, {}
+            for _ in range(per_res):
+                node = os.path.join(vfio, str(idx))
+                open(node, "w").close()
+                paths[f"g{idx}"] = node
+                bdfs[f"g{idx}"] = [f"bdf-{idx}"]
+                idx += 1
+            hub.subscribe(HubSubscription(
+                name=f"r{r}", socket_path=sock,
+                on_socket_removed=lambda: None,
+                group_paths=paths, group_bdfs=bdfs,
+                on_device_health=lambda *a: None, probe=probe))
+        walls = []
+        for _ in range(cycles):
+            t0 = time.perf_counter()
+            hub.probe_cycle()
+            walls.append((time.perf_counter() - t0) * 1e3)
+            if slow_chips:
+                # let the hung workers drain so each sample starts with a
+                # full pool (steady state between 5 s poll ticks)
+                time.sleep(slow_hang_s + 0.1)
+        stats = hub.stats()
+        hub.stop()
+        return {
+            "n_devices": n_devices,
+            "n_resources": n_resources,
+            "slow_chips": slow_chips,
+            "slow_hang_ms": round(slow_hang_s * 1e3, 1),
+            "deadline_ms": round(deadline_s * 1e3, 1),
+            "probe_workers": workers,
+            "cycle_wall_ms_p50": round(statistics.median(walls), 2),
+            "cycle_wall_ms_max": round(max(walls), 2),
+            # what the old per-server serial loop would have paid for the
+            # same cycle: every slow chip's full hang, back to back
+            "serial_sum_est_ms": round(slow_chips * slow_hang_s * 1e3
+                                       + statistics.median(walls)
+                                       * (0 if slow_chips else 1), 2),
+            "probe_timeouts": stats["probe_timeouts_total"],
+            "inotify_fds": stats["inotify_fds"],
+            "hub_threads": stats["threads"],
+            # the replaced shape: one monitor thread + one inotify fd PER
+            # resource
+            "legacy_threads": n_resources,
+            "legacy_inotify_fds": n_resources,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def run_health():
+    """`bench.py --health`: shared-health-plane bench (make bench-health).
+
+    Matrix: {8, 64, 256} devices x {0, 1} injected-slow chips — probe-cycle
+    wall vs the per-cycle deadline — plus the inotify-fd/thread gauges vs
+    resource count. Writes docs/bench_health_r07.json and prints the
+    one-line headline (deadline-bounded cycle at 64 devices + 1 slow chip;
+    exactly one fd at 8 vs 256 resources).
+    """
+    deadline_s = 0.25
+    cells = []
+    for n in (8, 64, 256):
+        for slow_chips in (0, 1):
+            cell = _health_cell(n, slow_chips, deadline_s=deadline_s)
+            cells.append(cell)
+            print(f"  {n:3d} devices ({cell['n_resources']:2d} resources) "
+                  f"{slow_chips} slow: cycle p50 "
+                  f"{cell['cycle_wall_ms_p50']:7.2f} ms (deadline "
+                  f"{cell['deadline_ms']:.0f} ms, serial est "
+                  f"{cell['serial_sum_est_ms']:7.2f} ms) | fds "
+                  f"{cell['inotify_fds']} (was {cell['legacy_inotify_fds']})"
+                  f" | threads {cell['hub_threads']} "
+                  f"(was {cell['legacy_threads']})", file=sys.stderr)
+    matrix = {"deadline_ms": deadline_s * 1e3, "cells": cells}
+    out_path = os.environ.get("BENCH_HEALTH_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "bench_health_r07.json")
+    with open(out_path, "w") as f:
+        json.dump(matrix, f, indent=1)
+    key = next(c for c in cells
+               if c["n_devices"] == 64 and c["slow_chips"] == 1)
+    fd8 = next(c for c in cells
+               if c["n_devices"] == 8 and c["slow_chips"] == 0)
+    fd256 = next(c for c in cells
+                 if c["n_devices"] == 256 and c["slow_chips"] == 0)
+    # acceptance: the 1-slow-chip cycle is bounded by deadline + epsilon
+    # (pool handoff + fast probes), NOT the 1 s x chips serial sum
+    eps_ms = 250.0
+    bounded = key["cycle_wall_ms_p50"] <= key["deadline_ms"] + eps_ms
+    return {
+        "metric": "health_probe_cycle_wall_64dev_1slow_ms",
+        "value": key["cycle_wall_ms_p50"],
+        "unit": "ms",
+        # >1.0 means the deduped parallel cycle beat the serial-loop
+        # estimate for the same fleet + fault
+        "vs_baseline": round(key["serial_sum_est_ms"]
+                             / max(0.001, key["cycle_wall_ms_p50"]), 3),
+        "baseline_source": "serial per-server probe loop estimate for the "
+                           "same cycle (1 slow chip x 1000 ms hang, "
+                           "health.py:_run_probes before the hub)",
+        "deadline_ms": key["deadline_ms"],
+        "deadline_bounded": bounded,
+        "probe_timeouts": key["probe_timeouts"],
+        "inotify_fds_8dev": fd8["inotify_fds"],
+        "inotify_fds_256dev": fd256["inotify_fds"],
+        "hub_threads_256dev": fd256["hub_threads"],
+        "legacy_threads_256dev": fd256["legacy_threads"],
+        "matrix_file": os.path.relpath(
+            out_path, os.path.dirname(os.path.abspath(__file__))),
+    }
+
+
 def main() -> int:
     import logging
     logging.disable(logging.CRITICAL)  # keep the one-line contract
 
     if "--discovery" in sys.argv:
         print(json.dumps(run_discovery()))
+        return 0
+    if "--health" in sys.argv:
+        print(json.dumps(run_health()))
         return 0
     root = tempfile.mkdtemp(prefix="tdpbench-")
     try:

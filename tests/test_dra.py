@@ -289,6 +289,42 @@ def test_registration_rejection_recorded(host, apiserver):
         driver.stop()
 
 
+def test_registration_socket_recovery_via_health_hub(host, apiserver):
+    """A wiped plugins_registry socket (kubelet restart) must be noticed by
+    the shared health hub and both sockets re-served — the old shape left
+    the gRPC server bound to a dangling inode the kubelet can never find."""
+    import time as time_mod
+
+    from tpu_device_plugin.healthhub import HealthHub
+
+    _, cfg = host
+    hub = HealthHub(poll_interval_s=0.1, probe_workers=1)
+    driver = make_driver(cfg, apiserver)
+    driver.attach_health_hub(hub)
+    driver.start()
+    try:
+        assert os.path.exists(driver.registration_socket_path)
+        os.unlink(driver.registration_socket_path)
+        deadline = time_mod.monotonic() + 10
+        while not os.path.exists(driver.registration_socket_path) \
+                and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.05)
+        assert os.path.exists(driver.registration_socket_path), \
+            "registration socket never re-served after the wipe"
+        # the re-served socket answers GetInfo
+        with grpc.insecure_channel(
+                f"unix://{driver.registration_socket_path}") as ch:
+            info = draapi.PluginRegistrationStub(ch).GetInfo(
+                regpb.InfoRequest(), timeout=5)
+            assert info.type == "DRAPlugin"
+    finally:
+        driver.stop()
+        hub.stop()
+    # stop() unsubscribed: recreating then unlinking the socket path fires
+    # nothing (the driver is gone, not restarting)
+    assert driver._health_sub is None
+
+
 # ------------------------------------------------------ prepare/unprepare
 
 

@@ -89,6 +89,116 @@ def test_monitor_probe_drives_health(tmp_path):
         mon.stop_event.set()
 
 
+def test_monitor_probe_exception_scores_unhealthy_not_thread_death(tmp_path):
+    """Satellite bugfix: a raising probe used to propagate out of run() and
+    silently kill the monitor thread. It must score the group Unhealthy,
+    bump probe_errors (the tdp_probe_errors_total seam), and keep the
+    monitor alive — a later clean probe recovers the group."""
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    sock = sock_dir / "p.sock"
+    sock.write_text("")
+    behavior = {"raise": True}
+
+    def probe(bdf, node):
+        if behavior["raise"]:
+            raise RuntimeError("sysfs went away mid-read")
+        return True
+
+    hits = []
+    mon = HealthMonitor(
+        socket_path=str(sock),
+        group_paths={},
+        group_bdfs={"g": ["bdf0"]},
+        on_device_health=lambda g, ok, src: hits.append((g, ok, src)),
+        on_socket_removed=lambda: None,
+        probe=probe,
+        poll_interval_s=0.1,
+    )
+    mon.start()
+    try:
+        assert _wait(lambda: ("g", False, "probe") in hits)
+        assert mon.is_alive(), "probe exception killed the monitor thread"
+        assert mon.probe_errors >= 1
+        behavior["raise"] = False
+        assert _wait(lambda: ("g", True, "probe") in hits)
+        assert mon.is_alive()
+    finally:
+        mon.stop_event.set()
+
+
+def _quiesce_health_threads(timeout=3.0):
+    """Wait out stray monitor/hub threads from earlier tests: the partial-
+    event tests monkeypatch module-global select/os.read, and a straggler
+    polling concurrently would consume the scripted chunks."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not any(t.name.startswith(("health-", "healthhub"))
+                   for t in threading.enumerate()):
+            return
+        time.sleep(0.05)
+
+
+def test_inotify_partial_trailing_event_carried_across_reads(monkeypatch):
+    """Satellite bugfix: an event split at the 64 KiB read boundary (header
+    or name truncated) must be carried into the next read, not discarded."""
+    import struct
+
+    from tpu_device_plugin import health as health_mod
+
+    _quiesce_health_threads()
+    w = InotifyWatcher()
+    try:
+        w.watch_dir("/tmp")
+        wd = next(iter(w._wd_to_dir))
+        name = b"node-x\0\0"
+        whole = (struct.pack("iIII", wd, 0x100, 0, len(name)) + name
+                 + struct.pack("iIII", wd, 0x200, 0, len(name)) + name)
+        # split mid-way through the SECOND event's name bytes
+        cut = len(whole) - 3
+        chunks = [whole[:cut], whole[cut:]]
+        monkeypatch.setattr(health_mod.select, "select",
+                            lambda r, _w, x, t: (r, [], []))
+        monkeypatch.setattr(health_mod.os, "read",
+                            lambda fd, n: chunks.pop(0))
+        first = w.poll(0)
+        assert [(n, m) for _, n, m in first] == [("node-x", 0x100)]
+        assert w._pending, "partial trailing event was discarded"
+        second = w.poll(0)
+        assert [(n, m) for _, n, m in second] == [("node-x", 0x200)]
+        assert w._pending == b""
+    finally:
+        monkeypatch.undo()
+        w.close()
+
+
+def test_inotify_partial_header_carried(monkeypatch):
+    """Even a split inside the 16-byte event header must survive the
+    boundary."""
+    import struct
+
+    from tpu_device_plugin import health as health_mod
+
+    _quiesce_health_threads()
+    w = InotifyWatcher()
+    try:
+        w.watch_dir("/tmp")
+        wd = next(iter(w._wd_to_dir))
+        name = b"n\0\0\0"
+        whole = struct.pack("iIII", wd, 0x100, 0, len(name)) + name
+        chunks = [whole[:7], whole[7:]]  # cut inside the header
+        monkeypatch.setattr(health_mod.select, "select",
+                            lambda r, _w, x, t: (r, [], []))
+        monkeypatch.setattr(health_mod.os, "read",
+                            lambda fd, n: chunks.pop(0))
+        assert w.poll(0) == []
+        events = w.poll(0)
+        assert [(n, m) for _, n, m in events] == [("n", 0x100)]
+    finally:
+        monkeypatch.undo()
+        w.close()
+
+
 # --- native shim -------------------------------------------------------------
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,355 @@
+"""HealthHub: shared inotify plane, probe dedup, deadlines, fallback poller."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_device_plugin import faults, healthhub
+from tpu_device_plugin.healthhub import HealthHub, HubSubscription
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _hub(**kw):
+    kw.setdefault("poll_interval_s", 0.1)
+    kw.setdefault("probe_workers", 4)
+    kw.setdefault("probe_deadline_s", 1.0)
+    return HealthHub(**kw)
+
+
+def test_fs_event_fans_out_to_every_subscription(tmp_path):
+    """Two resources watching the same node (a chip exposed through two
+    plugin servers) must BOTH hear its removal from the one shared fd."""
+    node = tmp_path / "vfio" / "11"
+    node.parent.mkdir()
+    node.write_text("")
+    hub = _hub(poll_interval_s=60)  # inotify only: no existence-scan assist
+    hits_a, hits_b = [], []
+    try:
+        hub.subscribe(HubSubscription(
+            name="a", group_paths={"ga": str(node)},
+            on_device_health=lambda k, ok, src: hits_a.append((k, ok, src))))
+        hub.subscribe(HubSubscription(
+            name="b", group_paths={"gb": str(node)},
+            on_device_health=lambda k, ok, src: hits_b.append((k, ok, src))))
+        assert hub.stats()["inotify_fds"] == 1
+        node.unlink()
+        assert _wait(lambda: ("ga", False, "fs") in hits_a)
+        assert _wait(lambda: ("gb", False, "fs") in hits_b)
+        node.write_text("")
+        assert _wait(lambda: ("ga", True, "fs") in hits_a)
+        assert _wait(lambda: ("gb", True, "fs") in hits_b)
+    finally:
+        hub.stop()
+
+
+def test_one_inotify_fd_regardless_of_subscription_count(tmp_path):
+    (tmp_path / "vfio").mkdir()
+    hub = _hub()
+    try:
+        for i in range(32):
+            p = tmp_path / "vfio" / f"n{i}"
+            p.write_text("")
+            hub.subscribe(HubSubscription(
+                name=f"r{i}", group_paths={f"g{i}": str(p)},
+                on_device_health=lambda *a: None))
+        stats = hub.stats()
+        assert stats["subscriptions"] == 32
+        assert stats["inotify_fds"] == 1
+    finally:
+        hub.stop()
+
+
+def test_probe_dedup_across_subscriptions():
+    """A BDF exposed through two resources (chip advertised as passthrough
+    AND parent of partitions) is probed ONCE per cycle; both subscribers
+    still get their own keyed verdicts."""
+    probed = []
+    hub = _hub(poll_interval_s=3600)
+    verdicts_a, verdicts_b = [], []
+    try:
+        hub.subscribe(HubSubscription(
+            name="pt", group_bdfs={"g1": ["bdf0", "bdf1"]},
+            on_device_health=lambda k, ok, src: verdicts_a.append((k, ok, src)),
+            probe=lambda b, n: probed.append(b) or True))
+        hub.subscribe(HubSubscription(
+            name="vtpu", group_bdfs={"bdf0": ["bdf0"]},
+            on_device_health=lambda k, ok, src: verdicts_b.append((k, ok, src)),
+            probe=lambda b, n: probed.append(b) or True))
+        verdicts = hub.probe_cycle()
+        assert sorted(probed) == ["bdf0", "bdf1"]  # bdf0 NOT probed twice
+        assert verdicts == {"bdf0": True, "bdf1": True}
+        assert ("g1", True, "probe") in verdicts_a
+        assert ("bdf0", True, "probe") in verdicts_b
+        stats = hub.stats()
+        assert stats["probes_last_cycle"] == 2
+        assert stats["probes_deduped_last_cycle"] == 1
+    finally:
+        hub.stop()
+
+
+def test_probe_deadline_bounds_cycle_and_scores_timeout_dead():
+    """One hung probe must cost ~the deadline, not its full hang — and the
+    hung chip's group scores Unhealthy (counted) while every other chip's
+    verdict lands on time."""
+    release = threading.Event()
+
+    def probe(bdf, node):
+        if bdf == "bdf-slow":
+            release.wait(5.0)
+        return True
+
+    hub = _hub(poll_interval_s=3600, probe_deadline_s=0.2)
+    hits = []
+    try:
+        hub.subscribe(HubSubscription(
+            name="r",
+            group_bdfs={"fast": ["bdf0", "bdf1"], "slow": ["bdf-slow"]},
+            on_device_health=lambda k, ok, src: hits.append((k, ok)),
+            probe=probe))
+        t0 = time.monotonic()
+        verdicts = hub.probe_cycle()
+        wall = time.monotonic() - t0
+        assert wall < 2.0, wall        # nowhere near the 5 s hang
+        assert verdicts == {"bdf0": True, "bdf1": True, "bdf-slow": False}
+        assert ("fast", True) in hits
+        assert ("slow", False) in hits
+        assert hub.stats()["probe_timeouts_total"] == 1
+        # the chip answers next cycle -> recovers
+        release.set()
+        time.sleep(0.1)
+        hub.probe_cycle()
+        assert _wait(lambda: ("slow", True) in hits)
+    finally:
+        release.set()
+        hub.stop()
+
+
+def test_stuck_probe_not_resubmitted_every_cycle():
+    """A probe hung past its deadline must NOT be resubmitted while still
+    running — each resubmission would strand one more pool worker until the
+    shared pool is exhausted and EVERY chip on the host times out. The
+    hung chip keeps its dead verdict; fast chips keep probing on time; once
+    the read returns the chip is probed fresh and recovers."""
+    release = threading.Event()
+    calls = {"slow": 0, "fast": 0}
+
+    def probe(bdf, node):
+        if bdf == "bdf-slow":
+            calls["slow"] += 1
+            release.wait(30.0)
+        else:
+            calls["fast"] += 1
+        return True
+
+    hub = _hub(poll_interval_s=3600, probe_workers=2, probe_deadline_s=0.1)
+    try:
+        hub.subscribe(HubSubscription(
+            name="r", group_bdfs={"fast": ["bdf-fast"],
+                                  "slow": ["bdf-slow"]},
+            on_device_health=lambda *a: None, probe=probe))
+        for cycle in range(4):
+            verdicts = hub.probe_cycle()
+            assert verdicts["bdf-fast"] is True, \
+                f"cycle {cycle}: pool exhausted by the hung probe"
+            assert verdicts["bdf-slow"] is False
+        assert calls["slow"] == 1, \
+            f"hung probe resubmitted {calls['slow']} times"
+        assert calls["fast"] == 4
+        assert hub.stats()["probe_timeouts_total"] == 1
+        assert hub.stats()["stuck_probes"] == 1
+        # the read returns -> next cycle probes fresh and recovers
+        release.set()
+        time.sleep(0.1)
+        assert hub.probe_cycle()["bdf-slow"] is True
+        assert calls["slow"] == 2
+        assert hub.stats()["stuck_probes"] == 0
+    finally:
+        release.set()
+        hub.stop()
+
+
+def test_probe_exception_scores_dead_and_counts_not_kills_hub():
+    """Satellite: a raising probe must score its group Unhealthy and bump
+    tdp_probe_errors_total — the health plane keeps running."""
+    hub = _hub(poll_interval_s=3600)
+    hits = []
+    try:
+        hub.subscribe(HubSubscription(
+            name="r", group_bdfs={"g": ["bdf0"]},
+            on_device_health=lambda k, ok, src: hits.append((k, ok, src)),
+            probe=lambda b, n: (_ for _ in ()).throw(RuntimeError("boom"))))
+        verdicts = hub.probe_cycle()
+        assert verdicts == {"bdf0": False}
+        assert ("g", False, "probe") in hits
+        assert hub.stats()["probe_errors_total"] == 1
+        # the hub thread survived and still serves cycles
+        assert hub.probe_cycle() == {"bdf0": False}
+        assert hub._thread.is_alive()
+    finally:
+        hub.stop()
+
+
+def test_native_probe_fault_fires_inside_hub():
+    """docs/fault-injection.md: native.probe's consultation point is the
+    hub's probe runner."""
+    hub = _hub(poll_interval_s=3600)
+    try:
+        hub.subscribe(HubSubscription(
+            name="r", group_bdfs={"g": ["bdf0"]},
+            on_device_health=lambda *a: None,
+            probe=lambda b, n: True))
+        with faults.injected("native.probe", kind="false", count=1):
+            assert hub.probe_cycle() == {"bdf0": False}
+        assert faults.stats().get("native.probe") == 1
+        assert hub.probe_cycle() == {"bdf0": True}  # budget exhausted
+    finally:
+        hub.stop()
+
+
+def test_inotify_unavailable_degrades_to_one_shared_poller(
+        tmp_path, monkeypatch):
+    """Satellite: with inotify unavailable and MANY resources subscribed,
+    the hub degrades to ONE shared existence poller — one hub thread total,
+    zero inotify fds, and every resource still gets its events."""
+    def broken_watcher():
+        raise OSError(24, "inotify_init1 failed (EMFILE)")
+
+    monkeypatch.setattr(healthhub, "InotifyWatcher", broken_watcher)
+    nodes_dir = tmp_path / "nodes"
+    nodes_dir.mkdir()
+    before = {t for t in threading.enumerate()}
+    hub = _hub(poll_interval_s=0.1)
+    hits = []
+    n_resources = 16
+    try:
+        for i in range(n_resources):
+            p = nodes_dir / f"n{i}"
+            p.write_text("")
+            hub.subscribe(HubSubscription(
+                name=f"r{i}", group_paths={f"g{i}": str(p)},
+                on_device_health=(
+                    lambda k, ok, src: hits.append((k, ok, src)))))
+        stats = hub.stats()
+        assert stats["fallback_polling"] is True
+        assert stats["inotify_fds"] == 0
+        assert stats["subscriptions"] == n_resources
+        # exactly ONE poller/loop thread for all 16 resources (probe-pool
+        # workers spawn lazily and none are needed here) — the old shape
+        # was one monitor thread per resource
+        new_threads = [t for t in set(threading.enumerate()) - before
+                       if t.name.startswith("healthhub")]
+        assert len(new_threads) == 1, [t.name for t in new_threads]
+        # existence polling is the event source for EVERY resource
+        (nodes_dir / "n0").unlink()
+        (nodes_dir / "n15").unlink()
+        assert _wait(lambda: ("g0", False, "fs") in hits)
+        assert _wait(lambda: ("g15", False, "fs") in hits)
+        (nodes_dir / "n0").write_text("")
+        assert _wait(lambda: ("g0", True, "fs") in hits)
+    finally:
+        hub.stop()
+
+
+def test_socket_removal_fires_once_and_respects_unsubscribe(tmp_path):
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    sock = sock_dir / "p.sock"
+    sock.write_text("")
+    hub = _hub(poll_interval_s=0.1)
+    removed = []
+    try:
+        sub = hub.subscribe(HubSubscription(
+            name="p", socket_path=str(sock),
+            on_socket_removed=lambda: removed.append(1)))
+        sock.unlink()
+        assert _wait(lambda: removed == [1])
+        time.sleep(0.3)
+        assert removed == [1]  # reported once, not per scan tick
+        # a fresh subscription (plugin restart) re-arms the watch
+        hub.unsubscribe(sub)
+        sock.write_text("")
+        hub.subscribe(HubSubscription(
+            name="p2", socket_path=str(sock),
+            on_socket_removed=lambda: removed.append(2)))
+        sock.unlink()
+        assert _wait(lambda: removed == [1, 2])
+    finally:
+        hub.stop()
+
+
+def test_missing_socket_at_subscribe_time_is_reported(tmp_path):
+    """The bind-to-watch race: a socket wiped before subscribe() must be
+    reported by the initial scan, not lost (no future inotify event)."""
+    sock_dir = tmp_path / "plugins"
+    sock_dir.mkdir()
+    hub = _hub(poll_interval_s=60)
+    removed = []
+    try:
+        hub.subscribe(HubSubscription(
+            name="p", socket_path=str(sock_dir / "gone.sock"),
+            on_socket_removed=lambda: removed.append(1)))
+        assert removed == [1]
+    finally:
+        hub.stop()
+
+
+def test_unsubscribed_subscription_gets_no_callbacks(tmp_path):
+    node = tmp_path / "n"
+    node.write_text("")
+    hub = _hub(poll_interval_s=0.1)
+    hits = []
+    try:
+        sub = hub.subscribe(HubSubscription(
+            name="r", group_paths={"g": str(node)},
+            on_device_health=lambda k, ok, src: hits.append((k, ok))))
+        hub.unsubscribe(sub)
+        node.unlink()
+        time.sleep(0.4)
+        assert hits == []
+    finally:
+        hub.stop()
+
+
+def test_hub_restartable_after_stop(tmp_path):
+    node = tmp_path / "n"
+    node.write_text("")
+    hub = _hub(poll_interval_s=0.1)
+    hits = []
+    hub.subscribe(HubSubscription(
+        name="r", group_paths={"g": str(node)},
+        on_device_health=lambda k, ok, src: hits.append((k, ok))))
+    hub.stop()
+    try:
+        hub.subscribe(HubSubscription(
+            name="r2", group_paths={"g2": str(node)},
+            on_device_health=lambda k, ok, src: hits.append((k, ok))))
+        node.unlink()
+        assert _wait(lambda: ("g2", False) in hits)
+    finally:
+        hub.stop()
+
+
+def test_constructor_validates_knobs():
+    for bad_workers in (0, -1, 1.5):
+        with pytest.raises(ValueError, match="probe_workers"):
+            HealthHub(probe_workers=bad_workers)
+    for bad_deadline in (0, -1.0, float("nan"), float("inf")):
+        with pytest.raises(ValueError, match="probe_deadline_s"):
+            HealthHub(probe_deadline_s=bad_deadline)

@@ -79,6 +79,59 @@ def test_manager_tolerates_partial_start_failure(kubelet, monkeypatch):
         manager.stop()
 
 
+def test_pending_plugins_start_concurrently(kubelet, monkeypatch):
+    """Cold start must overlap plugin start()s: with two resources, both
+    starts must be in flight at once (a barrier only passable concurrently),
+    instead of the old serial for-loop."""
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+
+    from tpu_device_plugin import server as server_mod
+
+    orig_start = server_mod.TpuDevicePlugin.start
+    barrier = threading.Barrier(2)
+
+    def rendezvous_start(self):
+        # a serial loop deadlocks here (BrokenBarrierError after timeout),
+        # leaving both plugins pending — the assert below catches it
+        barrier.wait(timeout=10)
+        orig_start(self)
+
+    monkeypatch.setattr(server_mod.TpuDevicePlugin, "start", rendezvous_start)
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert manager.pending == [], \
+            "plugins did not start concurrently (barrier never filled)"
+        assert kub.wait_for(2)
+    finally:
+        manager.stop()
+
+
+def test_manager_shares_one_health_hub_across_plugins(kubelet):
+    """All plugin servers ride the manager's hub: one inotify fd however
+    many resources, and no plugin spins up a private hub."""
+    host, cfg, kub = kubelet
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0062", iommu_group="11"))
+    host.add_chip(FakeChip("0000:01:00.0", device_id="0063", iommu_group="21"))
+    host.add_mdev("uuid-1", "TPU vhalf", "0000:00:04.0", iommu_group="31")
+    manager = PluginManager(cfg)
+    manager.start()
+    try:
+        assert kub.wait_for(3)
+        assert len(manager.plugins) == 3
+        for p in manager.plugins:
+            assert p._health_hub is manager.health_hub
+            assert p._own_hub is None
+        stats = manager.health_stats()
+        assert stats["inotify_fds"] == 1
+        assert stats["subscriptions"] == 3
+    finally:
+        manager.stop()
+    assert manager.health_stats()["subscriptions"] == 0
+
+
 def test_plugin_started_late_when_kubelet_appears(short_root):
     """Plugin pod up before the kubelet: registration must retry, not die."""
     host = FakeHost(short_root)

@@ -14,6 +14,12 @@ Round 6 adds the incremental-discovery honesty guard: the warm dirty-set
 rescan must do strictly fewer — and at least 5x fewer — SYSFS READS than
 the cold full scan at 64 devices. Counted, not timed, so the guard is
 load-insensitive and CI-safe.
+
+Round 7 adds the shared-health-plane guards (bench.py --health): (a) the
+hub holds ONE inotify fd regardless of resource count — counted, not
+timed; (b) a probe cycle with one hung chip is bounded by the per-cycle
+deadline, never the serial sum — the margins are seconds wide (hang 3 s,
+deadline 0.2 s, ceiling 1.5 s) so CI load cannot flip the verdict.
 """
 
 import pytest
@@ -359,3 +365,78 @@ def test_warm_dirty_rescan_reads_strictly_fewer_than_cold(tmp_path):
     other_bdf_reads = [p for p in warm.paths
                       if "/devices/0000:" in p and "0000:00:04.0" not in p]
     assert other_bdf_reads == [], other_bdf_reads
+
+
+# ------------------------------------------------------ shared health plane
+
+
+def test_health_hub_one_inotify_fd_at_8_and_256_resources(tmp_path):
+    """bench.py --health honesty: the hub's inotify fd count is pinned at
+    ONE whether 8 or 256 resources subscribe (the old per-server monitors
+    held one fd each). Counted, load-insensitive."""
+    from tpu_device_plugin.healthhub import HealthHub, HubSubscription
+
+    nodes = tmp_path / "vfio"
+    nodes.mkdir()
+    for n_resources in (8, 256):
+        hub = HealthHub(poll_interval_s=3600, probe_workers=2)
+        try:
+            for i in range(n_resources):
+                p = nodes / f"n{i}"
+                if not p.exists():
+                    p.write_text("")
+                hub.subscribe(HubSubscription(
+                    name=f"r{i}", group_paths={f"g{i}": str(p)},
+                    group_bdfs={f"g{i}": [f"bdf{i}"]},
+                    on_device_health=lambda *a: None,
+                    probe=lambda b, n: True))
+            stats = hub.stats()
+            assert stats["subscriptions"] == n_resources
+            assert stats["inotify_fds"] == 1, \
+                f"{n_resources} resources must share ONE inotify fd, " \
+                f"got {stats['inotify_fds']}"
+        finally:
+            hub.stop()
+
+
+def test_health_probe_cycle_with_one_slow_chip_is_deadline_bounded():
+    """bench.py --health honesty: one chip hanging its config read for 3 s
+    must cost the cycle ~the 0.2 s deadline, NOT the serial sum (>= 3 s,
+    what the old back-to-back loop paid). The 1.5 s ceiling leaves seconds
+    of CI-load margin on both sides of the serial/parallel divide."""
+    import threading as threading_mod
+    import time
+
+    from tpu_device_plugin.healthhub import HealthHub, HubSubscription
+
+    release = threading_mod.Event()
+
+    def probe(bdf, node):
+        if bdf == "bdf-slow":
+            release.wait(3.0)
+        return True
+
+    hub = HealthHub(poll_interval_s=3600, probe_workers=4,
+                    probe_deadline_s=0.2)
+    hits = []
+    try:
+        hub.subscribe(HubSubscription(
+            name="r",
+            group_bdfs={**{f"g{i}": [f"bdf{i}"] for i in range(16)},
+                        "slow": ["bdf-slow"]},
+            on_device_health=lambda k, ok, src: hits.append((k, ok)),
+            probe=probe))
+        t0 = time.monotonic()
+        verdicts = hub.probe_cycle()
+        wall = time.monotonic() - t0
+        assert wall < 1.5, \
+            f"probe cycle took {wall:.2f}s — the hung chip serialized the " \
+            f"cycle (deadline-bounding is broken)"
+        # every fast chip's verdict landed despite the hung one
+        assert all(verdicts[f"bdf{i}"] for i in range(16))
+        assert verdicts["bdf-slow"] is False
+        assert ("slow", False) in hits
+        assert hub.stats()["probe_timeouts_total"] == 1
+    finally:
+        release.set()
+        hub.stop()

@@ -69,6 +69,16 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              "return CDIDevice names from Allocate")
     parser.add_argument("--health-poll-seconds", type=float,
                         default=cfg.health_poll_s)
+    parser.add_argument("--health-probe-workers", type=int,
+                        default=cfg.health_probe_workers,
+                        help="worker pool size for the shared health hub's "
+                             "deduped per-chip liveness probes")
+    parser.add_argument("--health-probe-deadline-seconds", type=float,
+                        default=cfg.health_probe_deadline_s,
+                        help="wall-clock budget for one probe cycle; a "
+                             "probe that has not answered by then is "
+                             "scored dead (counted on /metrics) instead "
+                             "of delaying every other chip's verdict")
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
@@ -159,6 +169,16 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             or args.lw_debounce_ms < 0:
         parser.error("--lw-debounce-ms must be a finite number >= 0, got "
                      f"{args.lw_debounce_ms!r}")
+    # same fail-loud rule for the health-hub knobs: a 0-worker pool can run
+    # no probe at all and a non-finite deadline silently disables timeouts
+    if args.health_probe_workers < 1:
+        parser.error("--health-probe-workers must be >= 1, got "
+                     f"{args.health_probe_workers}")
+    if math.isnan(args.health_probe_deadline_seconds) \
+            or math.isinf(args.health_probe_deadline_seconds) \
+            or args.health_probe_deadline_seconds <= 0:
+        parser.error("--health-probe-deadline-seconds must be a finite "
+                     f"number > 0, got {args.health_probe_deadline_seconds!r}")
 
     level = logging.DEBUG if args.verbose else logging.INFO
     if args.log_json:
@@ -205,6 +225,8 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         native_lib_path=args.native_lib,
         cdi_spec_dir=args.cdi_spec_dir,
         health_poll_s=args.health_poll_seconds,
+        health_probe_workers=args.health_probe_workers,
+        health_probe_deadline_s=args.health_probe_deadline_seconds,
         rediscovery_interval_s=args.rediscovery_seconds,
         shared_scan_ttl_s=args.shared_scan_ttl,
         lw_debounce_s=args.lw_debounce_ms / 1000.0,
@@ -324,6 +346,11 @@ def main(argv=None) -> int:
             return ok
     manager = PluginManager(cfg, on_inventory=on_inventory,
                             health_listener=health_listener)
+    if dra_driver is not None:
+        # the DRA driver rides the manager's shared health plane for its
+        # registration-socket watch (kubelet-restart recovery) — same hub,
+        # same single inotify fd as the plugin servers
+        dra_driver.attach_health_hub(manager.health_hub)
 
     def handle_drain(signum, frame):
         # flag-set only: drain() takes locks the interrupted main thread

@@ -90,6 +90,13 @@ class Config:
     # --- timing -------------------------------------------------------------
     grpc_timeout_s: float = 5.0      # registration dial bound (reference :53)
     health_poll_s: float = 5.0       # native liveness probe cadence (NVML parity)
+    # Shared health plane (healthhub.HealthHub): bounded worker pool for the
+    # deduped per-BDF liveness probes, and the wall-clock deadline one probe
+    # cycle may spend collecting verdicts — a hung config-space read is
+    # scored dead at the deadline instead of serializing every other chip's
+    # verdict behind it.
+    health_probe_workers: int = 4
+    health_probe_deadline_s: float = 1.0
     rediscovery_interval_s: float = 0.0  # 0 disables periodic re-discovery
     # ListAndWatch coalesce window: health transitions landing within this
     # window are folded into ONE re-send (a vfio flap storm otherwise

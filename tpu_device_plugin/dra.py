@@ -43,6 +43,7 @@ import os
 import re
 import tempfile
 import threading
+import time
 from concurrent import futures
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -143,6 +144,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self.registered = threading.Event()
         self.registration_error: Optional[str] = None
         self._lock = threading.Lock()
+        # serializes server bring-up/teardown against the hub-triggered
+        # re-serve (see attach_health_hub / _restart_serving)
+        self._serve_lock = threading.Lock()
+        self._health_hub = None
+        self._health_sub = None
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
         self._node_uid: Optional[str] = None
@@ -993,10 +999,58 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     def serving(self) -> bool:
         return self._dra_server is not None
 
+    def attach_health_hub(self, hub) -> None:
+        """Subscribe this driver to the shared health plane.
+
+        The hub watches the driver's REGISTRATION socket with a per-resource
+        filter (healthhub.HubSubscription), giving the DRA path the same
+        socket-loss recovery the classic plugins get: a kubelet restart that
+        wipes plugins_registry/ leaves the gRPC server bound to a dangling
+        inode the kubelet can never re-discover — the hub notices the unlink
+        and the driver re-serves both sockets. Call before start()."""
+        self._health_hub = hub
+
+    def _on_registration_socket_removed(self) -> None:
+        with self._lock:
+            if self._stopped or self._dra_server is None:
+                return
+        log.warning("DRA: registration socket %s removed (kubelet "
+                    "restart?); re-serving", self.registration_socket_path)
+        # off the hub thread: re-serving stops/starts gRPC servers and must
+        # not stall every other subscriber's health delivery behind it
+        threading.Thread(target=self._restart_serving, daemon=True,
+                         name="dra-reserve").start()
+
+    def _restart_serving(self) -> None:
+        # backoff-looped like server.py's restart(): a transient failure
+        # while re-binding (kubelet still recreating the registry dir) must
+        # retry, not die on a bare thread — once the hub subscription is
+        # dropped during teardown, no future socket event would re-trigger
+        # recovery for us
+        backoff = BackoffPolicy(base_s=1.0, cap_s=30.0)
+        while True:
+            with self._serve_lock:
+                with self._lock:
+                    if self._stopped:
+                        return
+                try:
+                    self._stop_servers_locked()
+                    self._start_locked()
+                    return
+                except Exception as exc:
+                    delay = backoff.next_delay()
+                    log.error("DRA: re-serve after socket wipe failed (%s); "
+                              "retrying in %.1fs", exc, delay)
+            time.sleep(delay)
+
     def start(self) -> None:
         """Serve the DRAPlugin + Registration sockets (kubelet dials both)."""
-        with self._lock:
-            self._stopped = False
+        with self._serve_lock:
+            with self._lock:
+                self._stopped = False
+            self._start_locked()
+
+    def _start_locked(self) -> None:
         os.makedirs(self.driver_dir, exist_ok=True)
         os.makedirs(self.cfg.dra_registry_path, exist_ok=True)
         for path in (self.dra_socket_path, self.registration_socket_path):
@@ -1018,15 +1072,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._reg_server.add_insecure_port(
             f"unix://{self.registration_socket_path}")
         self._reg_server.start()
+        if self._health_hub is not None:
+            from .healthhub import HubSubscription
+            self._health_sub = self._health_hub.subscribe(HubSubscription(
+                name=f"dra:{self.driver_name}",
+                socket_path=self.registration_socket_path,
+                on_socket_removed=self._on_registration_socket_removed))
         log.info("DRA: serving %s (registration %s)",
                  self.dra_socket_path, self.registration_socket_path)
 
-    def stop(self, withdraw_slice: bool = False) -> None:
-        with self._lock:
-            self._stopped = True
-            timer, self._republish_timer = self._republish_timer, None
-        if timer is not None:
-            timer.cancel()
+    def _stop_servers_locked(self) -> None:
+        # unsubscribe FIRST so our own socket unlinks below never read as a
+        # kubelet restart
+        if self._health_sub is not None and self._health_hub is not None:
+            self._health_hub.unsubscribe(self._health_sub)
+            self._health_sub = None
         for server in (self._reg_server, self._dra_server):
             if server is not None:
                 server.stop(grace=1).wait()
@@ -1036,6 +1096,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 os.unlink(path)
             except FileNotFoundError:
                 pass
+
+    def stop(self, withdraw_slice: bool = False) -> None:
+        with self._lock:
+            self._stopped = True
+            timer, self._republish_timer = self._republish_timer, None
+        if timer is not None:
+            timer.cancel()
+        with self._serve_lock:
+            self._stop_servers_locked()
         if withdraw_slice and self.api is not None:
             # _publish_lock waits out any in-flight publish (a retry timer
             # callback that already passed its _stopped check), so the

@@ -78,7 +78,7 @@ _VALUE_KINDS = ("drop", "false")
 
 # What each instrumented production site can honor. A raising kind armed
 # on a value site would not simulate the documented failure — it would
-# propagate out of a daemon thread (HealthMonitor, watcher loop) and kill
+# propagate out of a daemon thread (health hub, watcher loop) and kill
 # it; a value kind on a raising site is ignored by the call site, so the
 # run reports fires while injecting nothing. arm() enforces the category
 # for known sites (unknown sites stay open for tests to invent).

@@ -15,6 +15,13 @@ Two mechanisms, mirroring the reference's split (SURVEY.md §5):
    config space — a vfio-bound chip has no host driver to ask, but config
    reads still work and a dead/fallen-off chip returns all-FF. See
    `tpu_device_plugin.native`.
+
+Production no longer runs one `HealthMonitor` per plugin server: the
+shared host-level hub (`tpu_device_plugin.healthhub.HealthHub`) owns the
+one inotify fd, the one existence reconciler, and the deduped
+deadline-bounded probe scheduler, and plugin servers subscribe to it.
+`InotifyWatcher` is the hub's watcher; `HealthMonitor` remains as the
+standalone single-consumer form (tests, embedding).
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ import os
 import select
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import faults
@@ -55,6 +63,10 @@ class InotifyWatcher:
         if self._fd < 0:
             raise OSError(ctypes.get_errno(), "inotify_init1 failed")
         self._wd_to_dir: Dict[int, str] = {}
+        # bytes of a partial trailing event carried across reads: a 64 KiB
+        # read boundary can split an event (header or name truncated) and
+        # the parser must not discard the remainder
+        self._pending = b""
 
     def watch_dir(self, path: str) -> None:
         mask = IN_CREATE | IN_DELETE | IN_MOVED_FROM | IN_MOVED_TO
@@ -75,20 +87,27 @@ class InotifyWatcher:
                 os.read(self._fd, 65536)   # consume so the fd doesn't spin
             except BlockingIOError:
                 pass
+            self._pending = b""  # the dropped batch takes its remainder along
             return []
         try:
-            buf = os.read(self._fd, 65536)
+            buf = self._pending + os.read(self._fd, 65536)
         except BlockingIOError:
-            return []
+            buf = self._pending
+        self._pending = b""
         events: List[Tuple[str, str, int]] = []
         off = 0
         while off + _EVENT_HDR.size <= len(buf):
             wd, mask, _cookie, name_len = _EVENT_HDR.unpack_from(buf, off)
+            if off + _EVENT_HDR.size + name_len > len(buf):
+                break  # partial trailing event: name bytes still to come
             off += _EVENT_HDR.size
             name = buf[off:off + name_len].split(b"\0", 1)[0].decode(errors="replace")
             off += name_len
             directory = self._wd_to_dir.get(wd, "")
             events.append((directory, name, mask))
+        # carry any incomplete remainder (truncated header OR name) into the
+        # next read instead of discarding it
+        self._pending = buf[off:]
         return events
 
     def close(self) -> None:
@@ -135,6 +154,12 @@ class HealthMonitor(threading.Thread):
         self.stop_event = stop_event or threading.Event()
         self._probe_state: Dict[str, bool] = {}
         self._watcher: Optional[InotifyWatcher] = None
+        # probe callbacks that raised: a raising probe scores its group
+        # Unhealthy instead of killing the monitor thread (see _run_probes).
+        # NOTE: in production the hub's counter feeds tdp_probe_errors_total
+        # (healthhub.stats probe_errors_total → status.py); this one is for
+        # embedders of the standalone monitor to export themselves.
+        self.probe_errors = 0
 
     def start(self) -> None:
         """Register inotify watches *before* the thread runs, so an event
@@ -200,7 +225,6 @@ class HealthMonitor(threading.Thread):
                 return
         last_probe = 0.0
         last_scan = 0.0
-        import time
         try:
             while not self.stop_event.is_set():
                 if watcher is not None:
@@ -244,7 +268,16 @@ class HealthMonitor(threading.Thread):
     def _run_probes(self) -> None:
         for group, bdfs in self._group_bdfs.items():
             node = self._group_paths.get(group)
-            healthy = all(self._probe(bdf, node) for bdf in bdfs)
+            try:
+                healthy = all(self._probe(bdf, node) for bdf in bdfs)
+            except Exception as exc:
+                # a raising probe used to propagate out of run() and
+                # silently kill the monitor thread — score the group
+                # Unhealthy and keep monitoring
+                self.probe_errors += 1
+                log.error("liveness probe for group %s raised (%s); "
+                          "scoring Unhealthy", group, exc)
+                healthy = False
             if self._probe_state.get(group) != healthy:
                 self._probe_state[group] = healthy
                 if not healthy:

@@ -1,0 +1,544 @@
+"""HealthHub — the host-level shared health plane.
+
+The reference plugin runs one fsnotify watcher and one NVML event loop per
+device type (SURVEY.md §5), and the port inherited that shape: every plugin
+server owned a private `health.HealthMonitor` thread with its own inotify
+fd, its own periodic existence rescan of the same `/dev/vfio` dirs, and a
+strictly serial probe loop — steady-state cost and worst-case health
+latency grew with *resource count*, not with what changed. Health sensing
+is host-global state: the hub senses it once and fans it out.
+
+One `HealthHub` per host process replaces N monitors with:
+
+- **one inotify fd** watching the union of every subscription's socket and
+  device-node directories (`InotifyWatcher`, shared with the legacy
+  monitor). If inotify is unavailable (fd/watch limits exhausted) the hub
+  degrades to ONE shared existence poller — not one per resource;
+- **one periodic existence reconciler**: sysfs (kernfs) emits no inotify
+  events at all, and dirs missing at subscribe time (udev still populating
+  `/dev/vfio`) get no watch — existence scanning stays the ground truth;
+- **a deduped, deadline-bounded probe scheduler**: each physical BDF is
+  probed once per cycle even when exposed through multiple
+  resources/partitions (all partitions of a chip ride the same
+  `/dev/accelN`), probes run on a bounded worker pool, and the cycle
+  collects verdicts under a wall-clock deadline — one hung config-space
+  read (a dead chip returning all-FF slowly, or a stuck vfio region) is
+  scored Unhealthy at the deadline instead of delaying every other chip's
+  verdict by the serial sum.
+
+Fault points (docs/fault-injection.md) fire *inside the hub*:
+`inotify.poll` in the shared watcher's poll, `native.probe` in the hub's
+probe runner — so chaos schedules exercise the one code path production
+actually runs.
+
+Subscribers (`HubSubscription`) are per-resource filters: plugin servers
+subscribe with their watch-key → node-path / member-BDF maps and health
+callbacks; the DRA driver subscribes with just its registration socket.
+Callbacks are delivered from the hub thread; per-device ordering is
+preserved because each subscription's state transitions are computed and
+dispatched sequentially.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import faults
+from .health import InotifyWatcher, _BACK, _GONE
+
+log = logging.getLogger(__name__)
+
+# main-loop tick: inotify poll timeout / fallback sleep (the legacy
+# monitor's cadence, kept so socket-loss detection latency is unchanged)
+_TICK_S = 0.2
+
+
+class HubSubscription:
+    """One subscriber's filter + callbacks. Construct and pass to
+    `HealthHub.subscribe`; keep the returned object to `unsubscribe`.
+
+    All fields are read-only after subscribe (the hub indexes them):
+      name               — display name (logs/stats)
+      socket_path        — plugin socket to watch; removal means the
+                           kubelet restarted and wiped its socket dir
+      on_socket_removed  — called (once per subscription) on removal
+      group_paths        — watch key → device node path
+      group_bdfs         — watch key → member BDFs (probe fan-in: a key is
+                           healthy iff every member BDF probes alive)
+      on_device_health   — (key, healthy, source) with source "fs"/"probe"
+      probe              — (bdf, node_path) → bool; the hub dedups BDFs
+                           across subscriptions and adds the
+                           `native.probe` fault point around it
+    """
+
+    def __init__(
+        self,
+        name: str,
+        socket_path: Optional[str] = None,
+        on_socket_removed: Optional[Callable[[], None]] = None,
+        group_paths: Optional[Dict[str, str]] = None,
+        group_bdfs: Optional[Dict[str, List[str]]] = None,
+        on_device_health: Optional[Callable[[str, bool, str], None]] = None,
+        probe: Optional[Callable[[str, Optional[str]], bool]] = None,
+    ) -> None:
+        self.name = name
+        self.socket_path = socket_path
+        self.on_socket_removed = on_socket_removed
+        self.group_paths = dict(group_paths or {})
+        self.group_bdfs = {k: list(v) for k, v in (group_bdfs or {}).items()}
+        self.on_device_health = on_device_health
+        self.probe = probe
+        # mutable state, owned by the hub. _state_lock serializes every
+        # check-then-set + delivery on this subscription (the subscribe-time
+        # initial scan runs on the caller's thread and must not interleave
+        # with the hub thread's scans/events over the same state — without
+        # it a transition could be delivered twice or land out of order)
+        self._state_lock = threading.Lock()
+        self._active = False
+        self._socket_reported = False
+        self._fs_state: Dict[str, bool] = {}
+        self._probe_state: Dict[str, bool] = {}
+
+
+class HealthHub:
+    """Shared watcher + reconciler + probe scheduler (module docstring)."""
+
+    def __init__(self, poll_interval_s: float = 5.0, probe_workers: int = 4,
+                 probe_deadline_s: float = 1.0) -> None:
+        # fail-loud arm-time validation, matching server.py's debounce rule:
+        # a zero/negative pool serializes nothing and a non-finite deadline
+        # makes every timeout comparison silently false
+        if not isinstance(probe_workers, int) or probe_workers < 1:
+            raise ValueError(
+                f"probe_workers must be an int >= 1, got {probe_workers!r}")
+        if not (isinstance(probe_deadline_s, (int, float))
+                and probe_deadline_s == probe_deadline_s
+                and 0 < probe_deadline_s < float("inf")):
+            raise ValueError(
+                f"probe_deadline_s must be a finite number > 0, got "
+                f"{probe_deadline_s!r}")
+        self.poll_interval_s = poll_interval_s
+        self.probe_workers = probe_workers
+        self.probe_deadline_s = probe_deadline_s
+        self._lock = threading.RLock()
+        self._subs: List[HubSubscription] = []
+        # reverse indexes, rebuilt on (un)subscribe: node events and
+        # existence scans resolve in O(paths touched), not O(subs × keys)
+        self._node_index: Dict[str, List[Tuple[HubSubscription, str]]] = {}
+        self._socket_index: Dict[str, HubSubscription] = {}
+        self._watcher: Optional[InotifyWatcher] = None
+        self._watcher_failed = False
+        self._watched_dirs: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._pool: Optional[futures.ThreadPoolExecutor] = None
+        # one probe cycle at a time (the loop and bench/test callers of
+        # probe_cycle() must not interleave verdict collection)
+        self._cycle_lock = threading.Lock()
+        # BDF -> future still running past its deadline: a genuinely hung
+        # probe (blocked syscall — uncancellable) must NOT be resubmitted
+        # every cycle, or each cycle strands one more pool worker until the
+        # shared pool is exhausted and EVERY chip on the host times out.
+        # While stuck the chip keeps its dead verdict; when the read finally
+        # returns the entry clears and the next cycle probes it fresh.
+        self._stuck: Dict[str, futures.Future] = {}
+        # counters (read under _lock via stats())
+        self._probe_cycles = 0
+        self._probes_last_cycle = 0
+        self._probes_deduped_last_cycle = 0
+        self._probe_timeouts = 0
+        self._probe_errors = 0
+        self._existence_scans = 0
+        self._last_cycle_s = 0.0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def ensure_started(self) -> None:
+        """Idempotent lazy start (also restarts a stopped hub): watcher,
+        probe pool, and the single hub thread come up on first use so a
+        constructed-but-unused hub costs nothing."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            if self._watcher is None and not self._watcher_failed:
+                try:
+                    self._watcher = InotifyWatcher()
+                except OSError as exc:
+                    self._watcher_failed = True
+                    log.error("health hub: inotify unavailable (%s); "
+                              "degrading to ONE shared existence poller",
+                              exc)
+                else:
+                    # re-register dirs across a restart
+                    dirs, self._watched_dirs = self._watched_dirs, set()
+                    for d in dirs:
+                        self._watch_dir(d)
+            if self._pool is None:
+                self._pool = futures.ThreadPoolExecutor(
+                    max_workers=self.probe_workers,
+                    thread_name_prefix="healthhub-probe")
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="healthhub")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread, self._thread = self._thread, None
+            pool, self._pool = self._pool, None
+            watcher, self._watcher = self._watcher, None
+            self._stop.set()
+        if thread is not None:
+            thread.join(timeout=2)
+        if pool is not None:
+            # cancel_futures: a genuinely hung probe should not block
+            # process shutdown behind the executor's atexit join
+            pool.shutdown(wait=False, cancel_futures=True)
+        if watcher is not None:
+            watcher.close()
+
+    # --------------------------------------------------------- subscription
+
+    def subscribe(self, sub: HubSubscription) -> HubSubscription:
+        """Register + watch dirs + initial existence reconcile.
+
+        Watches are added before the initial scan so an event arriving
+        immediately after subscribe (e.g. the kubelet wiping its socket dir
+        during registration) cannot be lost to setup latency — the same
+        ordering the per-plugin monitor guaranteed in start()."""
+        self.ensure_started()
+        with self._lock:
+            sub._active = True
+            sub._fs_state = {k: True for k in sub.group_paths}
+            sub._probe_state = {}
+            self._subs.append(sub)
+            dirs = set()
+            if sub.socket_path:
+                dirs.add(os.path.dirname(sub.socket_path) or ".")
+            for path in sub.group_paths.values():
+                dirs.add(os.path.dirname(path) or ".")
+            for d in dirs:
+                if os.path.isdir(d):
+                    self._watch_dir(d)
+            self._rebuild_indexes_locked()
+        # initial reconcile outside the lock (callbacks may take plugin
+        # locks): inotify only reports *future* events, so a node already
+        # missing at subscribe time must be flagged here
+        self._scan_subscription(sub)
+        return sub
+
+    def unsubscribe(self, sub: HubSubscription) -> None:
+        """Drop a subscription. Watches on its dirs are kept (the dir set
+        is tiny and shared; inotify dedups adds) — events with no matching
+        subscription are simply ignored."""
+        with self._lock:
+            sub._active = False
+            if sub in self._subs:
+                self._subs.remove(sub)
+            self._rebuild_indexes_locked()
+
+    def _rebuild_indexes_locked(self) -> None:
+        node_index: Dict[str, List[Tuple[HubSubscription, str]]] = {}
+        socket_index: Dict[str, HubSubscription] = {}
+        for sub in self._subs:
+            if sub.socket_path:
+                socket_index[sub.socket_path] = sub
+            for key, path in sub.group_paths.items():
+                node_index.setdefault(path, []).append((sub, key))
+        self._node_index = node_index
+        self._socket_index = socket_index
+
+    def _watch_dir(self, path: str) -> None:
+        if self._watcher is None or path in self._watched_dirs:
+            return
+        try:
+            self._watcher.watch_dir(path)
+            self._watched_dirs.add(path)
+        except OSError as exc:
+            # watch-limit exhaustion on one dir degrades that dir to the
+            # existence scan, not the whole hub to polling
+            log.error("health hub: inotify_add_watch(%s) failed (%s); "
+                      "existence scan covers it", path, exc)
+
+    # ------------------------------------------------------------ main loop
+
+    def _run(self) -> None:
+        stop = self._stop
+        # pace from loop start: the subscribe-time initial scan covers the
+        # fs ground truth, so the first periodic scan/probe lands one full
+        # interval later (0.0 here would read as "interval already elapsed"
+        # on any host with uptime and fire a spurious immediate cycle)
+        last_scan = time.monotonic()
+        last_probe = last_scan
+        while not stop.is_set():
+            watcher = self._watcher
+            if watcher is not None:
+                # fault point "inotify.poll" fires inside watcher.poll —
+                # the hub IS the consumer now (docs/fault-injection.md)
+                try:
+                    events = watcher.poll(_TICK_S)
+                except OSError as exc:
+                    if stop.is_set():
+                        break
+                    # a broken fd would return instantly forever (no select
+                    # timeout to pace the loop) — drop the watcher and
+                    # degrade to the shared existence poller instead of
+                    # spinning a core on the dead fd
+                    log.error("health hub: inotify poll failed (%s); "
+                              "degrading to the shared existence poller",
+                              exc)
+                    with self._lock:
+                        if self._watcher is watcher:
+                            self._watcher = None
+                            self._watcher_failed = True
+                    try:
+                        watcher.close()
+                    except OSError:
+                        pass  # the fd may be the broken thing being dropped
+                    continue
+                for directory, name, mask in events:
+                    self._dispatch_event(os.path.join(directory, name), mask)
+            else:
+                stop.wait(_TICK_S)
+            now = time.monotonic()
+            if now - last_scan >= (self.poll_interval_s
+                                   if watcher is not None else _TICK_S):
+                # with inotify this is the periodic reconciler; without it,
+                # it IS the event source — one shared poller either way
+                last_scan = now
+                self._scan_all()
+            if now - last_probe >= self.poll_interval_s:
+                last_probe = now
+                self.probe_cycle()
+
+    def _dispatch_event(self, path: str, mask: int) -> None:
+        with self._lock:
+            sock_sub = self._socket_index.get(path)
+            node_hits = list(self._node_index.get(path, ()))
+        if sock_sub is not None and mask & _GONE:
+            self._report_socket_gone(sock_sub)
+        for sub, key in node_hits:
+            if not sub._active:
+                continue
+            if mask & _GONE:
+                self._fs_transition(sub, key, False,
+                                    "device node %s removed", path)
+            elif mask & _BACK:
+                self._fs_transition(sub, key, True,
+                                    "device node %s (re)created", path)
+
+    def _fs_transition(self, sub: HubSubscription, key: str, exists: bool,
+                       msg: str, path: str) -> None:
+        """Check-then-set + delivery for one fs verdict, serialized per
+        subscription (_state_lock): the subscribe-time initial scan runs on
+        the caller's thread and must not interleave with the hub thread's
+        events/scans — an unsynchronized race could deliver a transition
+        twice or leave the stored state contradicting the last delivery."""
+        with sub._state_lock:
+            if sub._fs_state.get(key) == exists:
+                return
+            sub._fs_state[key] = exists
+            if exists:
+                log.info(msg, path)
+            else:
+                log.warning(msg, path)
+            self._deliver(sub, key, exists, "fs")
+
+    def _report_socket_gone(self, sub: HubSubscription) -> None:
+        if not sub._active or sub.on_socket_removed is None:
+            return
+        with sub._state_lock:
+            if sub._socket_reported:
+                return
+            sub._socket_reported = True
+        log.info("%s: socket %s removed — kubelet restart", sub.name,
+                 sub.socket_path)
+        try:
+            sub.on_socket_removed()
+        except Exception as exc:
+            log.error("%s: on_socket_removed failed: %s", sub.name, exc)
+
+    def _deliver(self, sub: HubSubscription, key: str, healthy: bool,
+                 source: str) -> None:
+        if sub.on_device_health is None:
+            return
+        try:
+            sub.on_device_health(key, healthy, source)
+        except Exception as exc:
+            log.error("%s: health callback (%s, %s) failed: %s",
+                      sub.name, key, source, exc)
+
+    # ----------------------------------------------------- existence scan
+
+    def _scan_all(self) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            self._existence_scans += 1
+        for sub in subs:
+            self._scan_subscription(sub)
+
+    def _scan_subscription(self, sub: HubSubscription) -> None:
+        if not sub._active:
+            return
+        for key, path in list(sub.group_paths.items()):
+            exists = os.path.exists(path)
+            if sub._fs_state.get(key) != exists:
+                self._fs_transition(sub, key, exists,
+                                    "device node %s (re)created" if exists
+                                    else "device node %s missing", path)
+        if sub.socket_path and not os.path.exists(sub.socket_path):
+            # covers both the subscribe-time race (unlink between the grpc
+            # bind and the watch add) and inotify event drops
+            self._report_socket_gone(sub)
+
+    # ------------------------------------------------------- probe cycle
+
+    def probe_cycle(self) -> Dict[str, bool]:
+        """One deduped, deadline-bounded probe pass; returns {bdf: alive}.
+
+        Called by the hub loop every poll_interval_s; also callable
+        directly (bench/tests) — serialized by _cycle_lock either way.
+        Every unique BDF across all subscriptions is probed ONCE on the
+        worker pool; verdicts are collected until `probe_deadline_s` after
+        cycle start, and a probe that has not answered by then is scored
+        dead (and counted) instead of stalling the cycle — the next cycle
+        re-probes it, so a transiently slow chip self-heals.
+        """
+        with self._cycle_lock:
+            t0 = time.monotonic()
+            with self._lock:
+                subs = [s for s in self._subs
+                        if s._active and s.probe is not None and s.group_bdfs]
+                pool = self._pool
+            if pool is None:
+                return {}
+            # dedup: first subscription to mention a BDF supplies its probe
+            # + representative node (all exposures of a chip share the same
+            # physical config space, so any subscriber's probe is valid)
+            requested = 0
+            bdf_map: Dict[str, Tuple[Callable, Optional[str]]] = {}
+            for sub in subs:
+                for key, bdfs in sub.group_bdfs.items():
+                    node = sub.group_paths.get(key)
+                    for bdf in bdfs:
+                        requested += 1
+                        bdf_map.setdefault(bdf, (sub.probe, node))
+            # drop stuck entries whose worker finally returned; a BDF whose
+            # previous probe is STILL running keeps its dead verdict without
+            # a resubmission (see _stuck above — one hung chip must cost one
+            # worker, not one worker per cycle). _stuck is read/written
+            # under _lock: stats() iterates it from HTTP threads
+            with self._lock:
+                self._stuck = {b: f for b, f in self._stuck.items()
+                               if not f.done()}
+                still_stuck = set(self._stuck)
+            verdicts: Dict[str, bool] = {}
+            futs: Dict[str, futures.Future] = {}
+            try:
+                for bdf, (probe, node) in bdf_map.items():
+                    if bdf in still_stuck:
+                        verdicts[bdf] = False
+                        continue
+                    futs[bdf] = pool.submit(self._probe_one, probe, bdf, node)
+            except RuntimeError:
+                return {}  # pool shut down under us (hub.stop mid-cycle)
+            deadline = t0 + self.probe_deadline_s
+            timeouts = 0
+            for bdf, fut in futs.items():
+                try:
+                    verdicts[bdf] = fut.result(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                except futures.CancelledError:
+                    # hub stopped mid-cycle (shutdown cancelled the queue):
+                    # score conservatively, nothing to count
+                    verdicts[bdf] = False
+                except futures.TimeoutError:
+                    # the worker may still be stuck in the read; score the
+                    # chip dead NOW (a dead chip returning all-FF slowly is
+                    # the common cause). cancel() handles the queued-not-
+                    # started case; a running one is remembered in _stuck
+                    if not fut.cancel():
+                        with self._lock:
+                            self._stuck[bdf] = fut
+                    verdicts[bdf] = False
+                    timeouts += 1
+                    log.warning("liveness probe for %s exceeded the %.2fs "
+                                "deadline; scoring dead", bdf,
+                                self.probe_deadline_s)
+            wall = time.monotonic() - t0
+            with self._lock:
+                self._probe_cycles += 1
+                self._probes_last_cycle = len(bdf_map)
+                self._probes_deduped_last_cycle = requested - len(bdf_map)
+                self._probe_timeouts += timeouts
+                self._last_cycle_s = wall
+            # fan verdicts back out through each subscription's filter
+            for sub in subs:
+                if not sub._active:
+                    continue
+                for key, bdfs in sub.group_bdfs.items():
+                    healthy = all(verdicts.get(b, False) for b in bdfs)
+                    with sub._state_lock:
+                        if sub._probe_state.get(key) == healthy:
+                            continue
+                        sub._probe_state[key] = healthy
+                        if not healthy:
+                            log.warning(
+                                "%s: liveness probe failed for %s (%s)",
+                                sub.name, key, ",".join(bdfs))
+                        self._deliver(sub, key, healthy, "probe")
+            return verdicts
+
+    def _probe_one(self, probe: Callable, bdf: str,
+                   node: Optional[str]) -> bool:
+        # fault point "native.probe" (value kind): a fired fault reports
+        # the chip dead, exercising the Unhealthy -> recovery path — fires
+        # in the hub so every subscriber sees the same injected verdict
+        try:
+            if faults.fire("native.probe", bdf=bdf):
+                return False
+            return bool(probe(bdf, node))
+        except Exception as exc:
+            # a raising probe must never kill the worker silently healthy:
+            # score the chip dead and count it (tdp_probe_errors_total)
+            with self._lock:
+                self._probe_errors += 1
+            log.error("liveness probe for %s raised (%s); scoring dead",
+                      bdf, exc)
+            return False
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Counters + gauges for /status, /metrics and the perf guards."""
+        prefixes = ("healthhub", "healthhub-probe")
+        threads = sum(1 for t in threading.enumerate()
+                      if t.name.startswith(prefixes))
+        with self._lock:
+            return {
+                "inotify_fds": 1 if self._watcher is not None else 0,
+                "fallback_polling": self._watcher is None
+                                    and self._watcher_failed,
+                "watched_dirs": len(self._watched_dirs),
+                "subscriptions": len(self._subs),
+                "probe_workers": self.probe_workers,
+                "probe_deadline_s": self.probe_deadline_s,
+                "threads": threads,
+                "probe_cycles_total": self._probe_cycles,
+                "probes_last_cycle": self._probes_last_cycle,
+                "probes_deduped_last_cycle": self._probes_deduped_last_cycle,
+                "probe_timeouts_total": self._probe_timeouts,
+                "probe_errors_total": self._probe_errors,
+                # probes still blocked past their deadline right now: each
+                # pins one pool worker until its read returns (the chip
+                # keeps its dead verdict without resubmission meanwhile)
+                "stuck_probes": sum(1 for f in self._stuck.values()
+                                    if not f.done()),
+                "existence_scans_total": self._existence_scans,
+                "last_cycle_ms": round(self._last_cycle_s * 1e3, 3),
+            }

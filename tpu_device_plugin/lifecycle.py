@@ -2,8 +2,11 @@
 
 Analogue of `InitiateDevicePlugin`/`createDevicePlugins`
 (device_plugin.go:89-176): run discovery once, spin up one TpuDevicePlugin
-per TPU model/generation and one VtpuDevicePlugin per partition type, then
-block until stopped. A plugin that fails to start is logged and skipped, not
+per TPU model/generation and one VtpuDevicePlugin per partition type —
+started and registered CONCURRENTLY (the reference's serial loop made
+cold start O(resources) in registration round-trips) — then
+block until stopped. All plugin servers share the manager's one
+healthhub.HealthHub (one inotify fd + one probe scheduler per host). A plugin that fails to start is logged and skipped, not
 fatal (the reference tolerates per-plugin start errors the same way,
 device_plugin_test.go:102-107). Optional periodic re-discovery (off by
 default, matching the reference's no-hotplug stance) restarts the plugin set
@@ -15,10 +18,12 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent import futures
 from typing import List, Optional
 
 from .config import Config
 from .discovery import HostSnapshot, discover
+from .healthhub import HealthHub
 from .naming import resource_name_for
 from .native import TpuHealth
 from .registry import Registry
@@ -26,6 +31,12 @@ from .resilience import BackoffPolicy
 from .server import (KubeletUnavailable, RegistrationRejected,
                      TpuDevicePlugin)
 from .vtpu import VtpuDevicePlugin
+
+# concurrent plugin startup (see _try_start_pending): bound on how many
+# plugin servers start/register at once — enough to collapse a many-resource
+# cold start into ~one round-trip, small enough not to thundering-herd the
+# kubelet's Registration socket
+START_WORKERS = 8
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +88,16 @@ class PluginManager:
         self._drain_request: Optional[bool] = None
         self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
+        # The host-level shared health plane: ONE inotify fd, ONE existence
+        # reconciler and ONE deduped deadline-bounded probe scheduler for
+        # every plugin server (and the DRA driver's socket watch), however
+        # many resources the host advertises. Started lazily on first
+        # subscription; plugin rebuilds across rediscovery re-subscribe
+        # against the same hub.
+        self.health_hub = HealthHub(
+            poll_interval_s=cfg.health_poll_s,
+            probe_workers=cfg.health_probe_workers,
+            probe_deadline_s=cfg.health_probe_deadline_s)
         # Queried once at startup: whether the host can dlopen libtpu.so.
         # Purely informational on a passthrough host (chips are vfio-bound,
         # the guest owns libtpu), but a useful deployment sanity signal.
@@ -176,6 +197,7 @@ class PluginManager:
                 torus_dims=info.host_topology if info else None,
                 health_shim=self._shim, cdi_enabled=cdi_enabled,
                 health_listener=self.health_listener,
+                health_hub=self.health_hub,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
@@ -212,7 +234,8 @@ class PluginManager:
             plugins.append(VtpuDevicePlugin(
                 self.cfg, type_name, registry, parts, health_shim=self._shim,
                 cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids,
-                health_listener=self.health_listener))
+                health_listener=self.health_listener,
+                health_hub=self.health_hub))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi
@@ -317,38 +340,62 @@ class PluginManager:
         self._try_start_pending()
         self._sigs = new_sigs
 
+    def _start_one(self, plugin) -> None:
+        if self.draining:
+            # BEFORE start(): the kubelet must never see an initial
+            # Healthy snapshot from a plugin born during a drain
+            plugin.set_all_health(False, "drain")
+        plugin.start()
+
     def _try_start_pending(self) -> None:
         """Start plugins that are not serving yet; keep failures for retry.
 
         At node boot the plugin pod regularly comes up before the kubelet's
         socket exists — registration then fails and must be retried, not
-        abandoned (one bad plugin must also not sink the rest)."""
+        abandoned (one bad plugin must also not sink the rest).
+
+        Starts run CONCURRENTLY: each start() pays a self-dial readiness
+        wait plus a kubelet Register round-trip, so the old serial loop made
+        many-resource cold starts O(resources) in those latencies. Plugins
+        are independent servers on independent sockets — overlapping them
+        collapses cold start to ~the slowest single registration.
+        """
+        pending = self.pending
+        if not pending:
+            return
         still_pending: List[TpuDevicePlugin] = []
-        for plugin in self.pending:
-            try:
-                if self.draining:
-                    # BEFORE start(): the kubelet must never see an initial
-                    # Healthy snapshot from a plugin born during a drain
-                    plugin.set_all_health(False, "drain")
-                plugin.start()
-            except KubeletUnavailable as exc:
-                # the expected boot race: the pod came up before the
-                # kubelet's socket — routine, not an error
-                log.info("plugin %s: kubelet not ready (%s); will retry",
-                         plugin.resource_name, exc)
-                still_pending.append(plugin)
-            except RegistrationRejected as exc:
-                # the kubelet answered and said no (version mismatch, bad
-                # resource name): retrying without a fix is futile — make
-                # the log say what actually needs fixing
-                log.error("plugin %s: kubelet REJECTED registration (%s); "
-                          "will retry, but this needs operator attention",
-                          plugin.resource_name, exc)
-                still_pending.append(plugin)
-            except Exception as exc:
-                log.error("plugin %s failed to start (%s); will retry",
-                          plugin.resource_name, exc)
-                still_pending.append(plugin)
+        t0 = time.monotonic()
+        with futures.ThreadPoolExecutor(
+                max_workers=min(START_WORKERS, len(pending)),
+                thread_name_prefix="plugin-start") as pool:
+            outcomes = [(plugin, pool.submit(self._start_one, plugin))
+                        for plugin in pending]
+            for plugin, fut in outcomes:
+                try:
+                    fut.result()
+                except KubeletUnavailable as exc:
+                    # the expected boot race: the pod came up before the
+                    # kubelet's socket — routine, not an error
+                    log.info("plugin %s: kubelet not ready (%s); will retry",
+                             plugin.resource_name, exc)
+                    still_pending.append(plugin)
+                except RegistrationRejected as exc:
+                    # the kubelet answered and said no (version mismatch, bad
+                    # resource name): retrying without a fix is futile — make
+                    # the log say what actually needs fixing
+                    log.error("plugin %s: kubelet REJECTED registration (%s); "
+                              "will retry, but this needs operator attention",
+                              plugin.resource_name, exc)
+                    still_pending.append(plugin)
+                except Exception as exc:
+                    log.error("plugin %s failed to start (%s); will retry",
+                              plugin.resource_name, exc)
+                    still_pending.append(plugin)
+        started = len(pending) - len(still_pending)
+        if started:
+            log.info("started %d plugin(s) concurrently in %.2fs "
+                     "(%d still pending)", started,
+                     time.monotonic() - t0, len(still_pending))
         self.pending = still_pending
 
     def request_drain(self, draining: bool) -> None:
@@ -368,6 +415,10 @@ class PluginManager:
         for plugin in self.plugins:
             plugin.set_all_health(not draining, "drain")
 
+    def health_stats(self) -> dict:
+        """Shared-health-plane counters for /status + /metrics."""
+        return self.health_hub.stats()
+
     def stop(self) -> None:
         for plugin in self.plugins:
             try:
@@ -377,6 +428,7 @@ class PluginManager:
                           plugin.resource_name, exc)
         self.plugins = []
         self.pending = []
+        self.health_hub.stop()
 
     def run(self, stop_event: threading.Event) -> None:
         """Start everything and block until `stop_event` (reference :166-175).

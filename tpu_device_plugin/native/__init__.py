@@ -243,7 +243,9 @@ class TpuHealth:
 
     def chip_alive(self, pci_base_path: str, bdf: str,
                    node_path: Optional[str] = None) -> bool:
-        """Composite liveness for one chip (what HealthMonitor polls).
+        """Composite liveness for one chip (what the health hub's probe
+        scheduler polls — healthhub.HealthHub; also the standalone
+        HealthMonitor's probe).
 
         ANDs two independent native probes: PCI config space (a fallen-off
         chip reads all-FF) and, when the chip has an associated device node

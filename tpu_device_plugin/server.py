@@ -32,7 +32,7 @@ from . import allocate as allocate_mod
 from . import faults
 from . import kubeletapi as api
 from .config import Config
-from .health import HealthMonitor
+from .healthhub import HealthHub, HubSubscription
 from .kubeletapi import pb
 from .native import TpuHealth, link_is_degraded
 from .registry import Registry, TpuDevice
@@ -82,6 +82,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         health_shim: Optional[TpuHealth] = None,
         cdi_enabled: bool = False,
         health_listener=None,
+        health_hub: Optional[HealthHub] = None,
     ) -> None:
         # arm-time validation, matching faults.py's fail-loud convention: a
         # NaN window makes every condvar timeout comparison silently false
@@ -119,7 +120,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._health_sources: Dict[str, Dict[str, bool]] = {}
         self._version = 0
         self._server: Optional[grpc.Server] = None
-        self._monitor: Optional[HealthMonitor] = None
+        # Shared health plane: the PluginManager passes the host-level hub
+        # (one inotify fd + one probe scheduler for every resource); a
+        # standalone plugin (tests, bench) lazily builds a private hub so
+        # the code path is identical either way.
+        self._health_hub = health_hub
+        self._own_hub: Optional[HealthHub] = None
+        self._health_sub: Optional[HubSubscription] = None
         self._stop = threading.Event()
         self._closed = threading.Event()   # terminal stop(); restarts must abort
         self._lifecycle_lock = threading.RLock()  # serializes start/teardown
@@ -325,22 +332,30 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                        for g in self._watched_groups()}
         group_bdfs = {g: [d.bdf for d in self.registry.iommu_map.get(g, ())]
                       for g in self._watched_groups()}
-        self._monitor = HealthMonitor(
+        # the "native.probe" fault point now fires inside the hub's probe
+        # runner (healthhub._probe_one), so the closure here is the plain
+        # native liveness read
+        self._subscribe_health(HubSubscription(
+            name=self.resource_name,
             socket_path=self.socket_path,
+            on_socket_removed=self._restart_async,
             group_paths=group_paths,
             group_bdfs=group_bdfs,
             on_device_health=self.set_group_health,
-            on_socket_removed=self._restart_async,
-            # fault point "native.probe" (value kind): a fired fault reports
-            # the chip dead, exercising the Unhealthy -> recovery path
-            probe=lambda bdf, node: (
-                not faults.fire("native.probe", bdf=bdf)
-                and self.health_shim.chip_alive(
-                    self.cfg.pci_base_path, bdf, node)),
-            poll_interval_s=self.cfg.health_poll_s,
-            stop_event=self._stop,
-        )
-        self._monitor.start()
+            probe=lambda bdf, node: self.health_shim.chip_alive(
+                self.cfg.pci_base_path, bdf, node),
+        ))
+
+    def _subscribe_health(self, sub: HubSubscription) -> None:
+        """Attach this server's health filter to the shared hub, or to a
+        private single-subscriber hub when running standalone."""
+        hub = self._health_hub
+        if hub is None:
+            hub = self._own_hub = HealthHub(
+                poll_interval_s=self.cfg.health_poll_s,
+                probe_workers=self.cfg.health_probe_workers,
+                probe_deadline_s=self.cfg.health_probe_deadline_s)
+        self._health_sub = hub.subscribe(sub)
 
     def _watched_groups(self) -> List[str]:
         return sorted({d.iommu_group for d in self.devices})
@@ -397,13 +412,17 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
+        # unsubscribe BEFORE grpc unlinks the socket so the hub never
+        # mistakes an intentional teardown for a kubelet restart
+        if self._health_sub is not None:
+            (self._health_hub or self._own_hub).unsubscribe(self._health_sub)
+            self._health_sub = None
         if self._server is not None:
             self._server.stop(grace=0.5).wait()
             self._server = None
-        if self._monitor is not None and self._monitor.is_alive() \
-                and threading.current_thread() is not self._monitor:
-            self._monitor.join(timeout=2)
-        self._monitor = None
+        if self._own_hub is not None:
+            self._own_hub.stop()
+            self._own_hub = None
         self._cleanup_socket()
         log.info("%s: stopped", self.resource_name)
 

@@ -108,6 +108,11 @@ class StatusServer:
         discovery_stats = getattr(self.manager, "discovery_stats", None)
         if discovery_stats is not None:
             out["discovery"] = discovery_stats()
+        # shared-health-plane counters (healthhub.HealthHub): hub fd/thread
+        # gauges, probe-cycle latency, per-probe timeout/error counters
+        health_stats = getattr(self.manager, "health_stats", None)
+        if health_stats is not None:
+            out["health"] = health_stats()
         fault_stats = faults.stats()
         armed = faults.armed_sites()
         if fault_stats or armed:
@@ -211,6 +216,43 @@ class StatusServer:
                 "# TYPE tpu_plugin_discovery_last_scan_reads gauge",
                 f'tpu_plugin_discovery_last_scan_reads '
                 f'{disc.get("last_scan_reads", 0)}',
+            ]
+        health = s.get("health")
+        if health:
+            lines += [
+                "# HELP tpu_plugin_health_inotify_fds Inotify fds held by "
+                "the shared health hub (one per HOST, not per resource).",
+                "# TYPE tpu_plugin_health_inotify_fds gauge",
+                f"tpu_plugin_health_inotify_fds {health['inotify_fds']}",
+                "# HELP tpu_plugin_health_threads Hub loop + probe-pool "
+                "threads (the per-resource monitor threads are gone).",
+                "# TYPE tpu_plugin_health_threads gauge",
+                f"tpu_plugin_health_threads {health['threads']}",
+                "# HELP tpu_plugin_health_subscriptions Resources "
+                "subscribed to the shared health hub.",
+                "# TYPE tpu_plugin_health_subscriptions gauge",
+                f"tpu_plugin_health_subscriptions "
+                f"{health['subscriptions']}",
+                "# HELP tpu_plugin_health_probe_cycles_total Deduped "
+                "probe cycles run by the hub.",
+                "# TYPE tpu_plugin_health_probe_cycles_total counter",
+                f"tpu_plugin_health_probe_cycles_total "
+                f"{health['probe_cycles_total']}",
+                "# HELP tpu_plugin_health_last_cycle_ms Wall time of the "
+                "most recent probe cycle (deadline-bounded).",
+                "# TYPE tpu_plugin_health_last_cycle_ms gauge",
+                f"tpu_plugin_health_last_cycle_ms "
+                f"{health['last_cycle_ms']}",
+                "# HELP tpu_plugin_health_probe_timeouts_total Probes "
+                "scored dead at the per-cycle deadline.",
+                "# TYPE tpu_plugin_health_probe_timeouts_total counter",
+                f"tpu_plugin_health_probe_timeouts_total "
+                f"{health['probe_timeouts_total']}",
+                "# HELP tdp_probe_errors_total Probe callbacks that "
+                "raised; each scored its group Unhealthy instead of "
+                "killing the health plane.",
+                "# TYPE tdp_probe_errors_total counter",
+                f"tdp_probe_errors_total {health['probe_errors_total']}",
             ]
         lines += [
             "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",

@@ -28,7 +28,7 @@ from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
 from .discovery import read_link_basename
-from .health import HealthMonitor
+from .healthhub import HubSubscription
 from .kubeletapi import pb
 from .naming import sanitize_name
 from .registry import Registry, TpuPartition
@@ -48,13 +48,15 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         cdi_enabled: bool = False,
         cdi_uuids: frozenset = frozenset(),
         health_listener=None,
+        health_hub=None,
     ) -> None:
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
         self.cdi_uuids = cdi_uuids
         super().__init__(cfg, type_name, registry, devices=[],
                          health_shim=health_shim, cdi_enabled=cdi_enabled,
-                         health_listener=health_listener)
+                         health_listener=health_listener,
+                         health_hub=health_hub)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
@@ -115,20 +117,20 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             # parent BDF and fan out to every partition of that chip
             self.set_devices_health(children.get(key, [key]), ok, src)
 
-        self._monitor = HealthMonitor(
+        self._subscribe_health(HubSubscription(
+            name=self.resource_name,
             socket_path=self.socket_path,
+            on_socket_removed=self._restart_async,
             group_paths=paths,
-            # probe each DISTINCT parent chip once per poll (64 per-core
-            # partitions of 8 chips = 8 probes, not 64), XID-fan-out style
+            # probe each DISTINCT parent chip once per cycle (64 per-core
+            # partitions of 8 chips = 8 probes, not 64), XID-fan-out style;
+            # the hub additionally dedups a parent shared with another
+            # resource's subscription down to ONE physical read
             group_bdfs={parent: [parent] for parent in children},
             on_device_health=on_health,
-            on_socket_removed=self._restart_async,
             probe=lambda bdf, _node: self.health_shim.chip_alive(
                 self.cfg.pci_base_path, bdf, parent_node.get(bdf)),
-            poll_interval_s=self.cfg.health_poll_s,
-            stop_event=self._stop,
-        )
-        self._monitor.start()
+        ))
 
     # ------------------------------------------------------------------- RPCs
 
