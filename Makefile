@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
 
 all: native proto
 
@@ -80,6 +80,13 @@ bench-discovery:
 # resource count (one fd per HOST). Writes docs/bench_health_r07.json.
 bench-health:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --health
+
+# Attach-path burst bench (docs/perf.md "attach path"): K in {1,8,32}
+# concurrent claim prepares at prepare_workers=8 vs the serial single-claim
+# estimate, counted checkpoint writes per burst (group commit), and the
+# precompiled-fragment plan read ratio. Writes docs/bench_attach_r08.json.
+bench-attach:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --attach-burst
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

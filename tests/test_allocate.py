@@ -231,3 +231,126 @@ def test_live_attr_reader_gone_and_empty_are_none(tmp_path):
     assert r.read("k", p) == b"now\n"
     os.unlink(p)
     assert r.read("k", p) is None     # gone again after being cached
+
+
+# ------------------------------------------------- precompiled fragments
+
+
+def iommufd_host8(tmp_path):
+    """8 single-chip groups on an iommufd host (cdev per chip)."""
+    host = FakeHost(tmp_path)
+    for i in range(8):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i),
+                               vfio_dev=f"vfio{i}"))
+    host.enable_iommufd()
+    return host
+
+
+def test_fragment_cache_hits_after_first_plan(tmp_path):
+    host = iommufd_host8(tmp_path)
+    cfg, registry = setup(host)
+    planner = allocate.AllocationPlanner(cfg, registry, "v4")
+    bdfs = [f"0000:00:{4 + i:02x}.0" for i in range(8)]
+    first = planner.plan(bdfs)
+    stats = planner.fragment_stats()
+    assert stats == {"hits": 0, "misses": 8, "size": 8}
+    second = planner.plan(bdfs)
+    assert planner.fragment_stats()["hits"] == 8
+    # identical response either way (specs, order, env, CDI names)
+    assert [s.host_path for s in second.device_specs] == \
+        [s.host_path for s in first.device_specs]
+    assert second.envs == first.envs
+    assert second.cdi_names == first.cdi_names
+    assert second.cdi_names[0].endswith("=0000:00:04.0")
+
+
+def test_fragment_hit_skips_cdev_listdir_but_never_revalidation(tmp_path):
+    """The warm plan must do ZERO vfio-dev listdirs (the fragment carries
+    the cdev specs) while the per-member TOCTOU reads — group link +
+    vendor — appear in BOTH plans in equal number (never cached)."""
+    host = iommufd_host8(tmp_path)
+    cfg, registry = setup(host)
+    planner = allocate.AllocationPlanner(cfg, registry, "v4")
+    bdfs = [f"0000:00:{4 + i:02x}.0" for i in range(8)]
+
+    def split(paths):
+        cdev = [p for p in paths if "vfio-dev" in p]
+        reval = [p for p in paths
+                 if p.endswith("iommu_group") or p.endswith("vendor")]
+        return cdev, reval
+
+    with allocate.count_plan_reads() as cold:
+        planner.plan(bdfs)
+    with allocate.count_plan_reads() as warm:
+        planner.plan(bdfs)
+    cold_cdev, cold_reval = split(cold.paths)
+    warm_cdev, warm_reval = split(warm.paths)
+    assert len(cold_cdev) == 8
+    assert warm_cdev == []
+    assert len(cold_reval) == len(warm_reval) == 16   # 2 live reads/member
+    assert warm.reads < cold.reads
+
+
+def test_fragment_invalidation_recompiles_renamed_cdev(tmp_path):
+    """A health flap drops the group's fragment; the next plan re-lists the
+    cdev and serves the NEW name (the blind spot is only a rename with no
+    flap — docs/perf.md)."""
+    import shutil
+
+    host = iommufd_host8(tmp_path)
+    cfg, registry = setup(host)
+    planner = allocate.AllocationPlanner(cfg, registry, "v4")
+    bdf = "0000:00:04.0"
+    plan = planner.plan([bdf])
+    assert any(s.host_path.endswith("vfio0") for s in plan.device_specs)
+    # the kernel re-enumerates the cdev (unbind/rebind)
+    base = os.path.join(host.pci, bdf, "vfio-dev")
+    shutil.rmtree(base)
+    os.makedirs(os.path.join(base, "vfio9"))
+    with open(os.path.join(host.devfs, "vfio", "devices", "vfio9"), "w"):
+        pass
+    # without invalidation the stale fragment still serves vfio0
+    stale = planner.plan([bdf])
+    assert any(s.host_path.endswith("vfio0") for s in stale.device_specs)
+    planner.invalidate_fragments([bdf])
+    fresh = planner.plan([bdf])
+    assert any(s.host_path.endswith("vfio9") for s in fresh.device_specs)
+    assert not any(s.host_path.endswith("vfio0") for s in fresh.device_specs)
+
+
+def test_fragment_iommufd_flip_misses(tmp_path):
+    """/dev/iommu appearing (or vanishing) must rebuild fragments — the
+    iommufd state is part of the fragment identity, with shared_scan_ttl_s
+    0 keeping the reference's per-RPC /dev/iommu stat."""
+    host = iommufd_host8(tmp_path)
+    cfg, registry = setup(host, shared_scan_ttl_s=0.0)
+    planner = allocate.AllocationPlanner(cfg, registry, "v4")
+    bdf = "0000:00:04.0"
+    plan = planner.plan([bdf])
+    assert any("vfio-dev" not in s.host_path
+               and s.host_path.endswith("iommu")
+               for s in plan.device_specs)
+    os.unlink(os.path.join(host.devfs, "iommu"))
+    downgraded = planner.plan([bdf])
+    paths = [s.host_path for s in downgraded.device_specs]
+    assert not any(p.endswith("/iommu") or "/devices/" in p for p in paths)
+    assert planner.fragment_stats()["misses"] == 2
+
+
+def test_fragment_failure_never_cached(tmp_path):
+    """An iommufd host with a missing cdev fails the plan — and the next
+    plan after the cdev appears succeeds (failures are not cached)."""
+    host = FakeHost(tmp_path)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))  # no vfio_dev
+    host.enable_iommufd()
+    cfg, registry = setup(host)
+    planner = allocate.AllocationPlanner(cfg, registry, "v4")
+    with pytest.raises(allocate.AllocationError, match="no vfio-dev cdev"):
+        planner.plan(["0000:00:04.0"])
+    os.makedirs(os.path.join(host.pci, "0000:00:04.0", "vfio-dev", "vfio7"))
+    os.makedirs(os.path.join(host.devfs, "vfio", "devices"), exist_ok=True)
+    with open(os.path.join(host.devfs, "vfio", "devices", "vfio7"), "w"):
+        pass
+    plan = planner.plan(["0000:00:04.0"])
+    assert any(s.host_path.endswith("vfio7") for s in plan.device_specs)

@@ -405,3 +405,47 @@ def test_soak_mixed_failure_schedule(node):
             assert sim.wait_for_allocatable(r, 2, timeout=30), \
                 f"soak round {round_no} ({action}): {r} degraded"
     sim.stop()
+
+
+def test_checkpoint_write_fault_errors_claims_never_silent_acks(dra_rig):
+    """faults: checkpoint.write armed at the group-commit writer — every
+    claim waiting on the failed commit window must surface a per-claim
+    error and roll back (no silent ACK of an entry that never reached
+    disk); after the fault clears, a kubelet retry prepares exactly once
+    and the on-disk checkpoint recovers every claim."""
+    from tpu_device_plugin.dra import slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+
+    host, cfg, apiserver, driver, breaker = dra_rig
+    # widen the commit window so the whole burst deterministically rides
+    # the ONE faulted write attempt, whatever the CI scheduler does
+    driver.checkpoint_commit_window_s = 0.25
+    names = [slice_device_name(c.bdf) for c in TWO_MODEL_CHIPS[:2]]
+    uids = [f"ckpt-fault-{i}" for i in range(4)]
+    for i, uid in enumerate(uids):
+        apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                            [{"device": names[i % 2]}])
+    claims = [drapb.Claim(namespace="ns", name=uid, uid=uid)
+              for uid in uids]
+
+    faults.arm("checkpoint.write", kind="oserror", count=1)
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=claims), None)
+    errors = {uid: resp.claims[uid].error for uid in uids}
+    assert all(errors.values()), f"silent ACK under write fault: {errors}"
+    assert faults.stats().get("checkpoint.write") == 1
+    # rolled back everywhere: no checkpoint entries, no orphan spec files
+    assert driver.prepared_claim_count() == 0
+    leftovers = [f for f in os.listdir(driver.cdi_dir)
+                 if "claim" in f] if os.path.isdir(driver.cdi_dir) else []
+    assert leftovers == []
+
+    # fault budget exhausted: the kubelet's retry succeeds exactly once
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=claims), None)
+    for uid in uids:
+        assert resp.claims[uid].error == "", resp.claims[uid].error
+    assert driver.prepared_claim_count() == 4
+    import json as json_mod
+    with open(driver.checkpoint_path) as f:
+        assert set(json_mod.load(f)) == set(uids)

@@ -291,3 +291,26 @@ def test_main_plain_run_sigterm(host):
     rc = _run_main(["--root", root, "--rediscovery-seconds", "0"],
                    controller)
     assert rc == 0
+
+
+def test_prepare_workers_flag_env_parity_and_validation(host, monkeypatch):
+    _, root = host
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.prepare_workers == 4                     # default
+    cfg, _ = cli.build_config(["--root", root, "--prepare-workers", "8"])
+    assert cfg.prepare_workers == 8
+    # env parity; explicit flag wins over env
+    monkeypatch.setenv("TDP_PREPARE_WORKERS", "16")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.prepare_workers == 16
+    cfg, _ = cli.build_config(["--root", root, "--prepare-workers", "2"])
+    assert cfg.prepare_workers == 2
+    # fail-loud: a 0-worker pool could prepare nothing at all
+    for bad_argv in (["--prepare-workers", "0"], ["--prepare-workers", "-3"]):
+        with pytest.raises(SystemExit) as e:
+            cli.build_config(["--root", root] + bad_argv)
+        assert e.value.code == 2
+    monkeypatch.setenv("TDP_PREPARE_WORKERS", "not-a-number")
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--root", root])
+    assert e.value.code == 2

@@ -13,6 +13,7 @@ import os
 import shutil
 import tempfile
 import threading
+import time as time_mod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
@@ -35,6 +36,13 @@ class FakeApiServer:
         self.requests = []    # (method, path) log
         self.connections = 0  # distinct TCP connections accepted
         self.versions = list(versions)  # served resource.k8s.io versions
+        # per-request latency injected before answering (bench.py
+        # --attach-burst: a loopback fake has no network, so the RTT a
+        # real in-cluster apiserver costs — the wait the parallel prepare
+        # pool overlaps — is modeled explicitly, like the health bench's
+        # injected slow chip). time.sleep releases the GIL, so concurrent
+        # requests genuinely overlap the way real socket waits do.
+        self.latency_s = 0.0
         self._rv = 0
         outer = self
 
@@ -71,6 +79,8 @@ class FakeApiServer:
 
             def do_GET(self):
                 outer.requests.append(("GET", self.path))
+                if outer.latency_s:
+                    time_mod.sleep(outer.latency_s)
                 if self.path.rstrip("/") == "/apis/resource.k8s.io":
                     return self._send(200, {
                         "kind": "APIGroup", "name": "resource.k8s.io",
@@ -903,7 +913,12 @@ def test_colliding_raw_ids_get_distinct_slice_names(host, apiserver):
 def test_rematerialize_races_concurrent_unprepare(host, apiserver):
     """ADVICE r3 (dra.py:457): a concurrent NodeUnprepareResources during
     the re-materialize API fetch must not leave an orphaned CDI spec file
-    with no checkpoint entry tracking it."""
+    with no checkpoint entry tracking it. Under the per-claim-UID lock the
+    unprepare (on its own thread, like a second kubelet worker) blocks
+    until the prepare finishes, so the two can never interleave — the
+    invariant is that the final state is consistent either way."""
+    import time
+
     _, cfg = host
     driver = make_driver(cfg, apiserver)
     apiserver.add_claim("ns1", "c1", "uid-1", driver.driver_name,
@@ -914,25 +929,36 @@ def test_rematerialize_races_concurrent_unprepare(host, apiserver):
     spec_path = driver._claim_spec_path("uid-1")
     # the spec file is lost (reboot wipes /var/run) ...
     os.unlink(spec_path)
-    # ... and an unprepare completes while the retry fetches the claim
+    # ... and an unprepare races in on another thread while the retry
+    # fetches the claim
     real_fetch = driver._allocation_results
+    racers = []
 
     def racing_fetch(c):
         results = real_fetch(c)
-        unprep = drapb.NodeUnprepareResourcesRequest(claims=[claim])
-        driver.NodeUnprepareResources(unprep, None)
+        t = threading.Thread(
+            target=lambda: driver.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(claims=[claim]), None),
+            daemon=True)
+        t.start()
+        racers.append(t)
+        time.sleep(0.05)   # give the unprepare every chance to interleave
         return results
 
     driver._allocation_results = racing_fetch
     resp = driver.NodePrepareResources(
         drapb.NodePrepareResourcesRequest(claims=[claim]), None)
     driver._allocation_results = real_fetch
-    # the race resolves to a consistent state: either a fresh prepare
-    # (entry + spec both present) — never a spec without an entry
+    for t in racers:
+        t.join(timeout=10)
+        assert not t.is_alive(), "racing unprepare deadlocked"
+    # the race resolves to a consistent state — never a spec without an
+    # entry tracking it (nor the reverse)
     has_entry = driver.prepared_claim_count() == 1
     has_spec = os.path.exists(spec_path)
     assert has_entry == has_spec
     assert resp.claims["uid-1"].error == "" or not has_spec
+    driver.stop()
 
 
 def test_all_unhealthy_keeps_slice_with_bumped_generation(host, apiserver):
@@ -1270,3 +1296,159 @@ def test_version_dropped_by_upgrade_rediscovers(host, apiserver):
     obj = next(iter(apiserver.slices.values()))
     assert obj["apiVersion"] == "resource.k8s.io/v1"
     assert "basic" not in obj["spec"]["devices"][0]
+
+
+# ------------------------------------------------ attach-path concurrency
+
+
+def test_unprepare_serialization_error_is_claim_error(host, apiserver):
+    """A non-OSError checkpoint failure (unserializable entry) used to
+    escape NodeUnprepareResources' `except OSError` and kill the whole
+    multi-claim RPC — it must surface as THAT claim's out.error while
+    other claims in the request still answer."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    claim = drapb.Claim(namespace="ns1", name="c1", uid="uid-1")
+    assert prepare(driver, claim).claims["uid-1"].error == ""
+    # an unserializable entry poisons the NEXT checkpoint write
+    driver._checkpoint["poison"] = {"bad": object()}
+    other = drapb.Claim(namespace="ns1", name="ghost", uid="uid-ghost")
+    resp = driver.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[claim, other]), None)
+    assert resp.claims["uid-1"].error != ""          # reported, not raised
+    assert resp.claims["uid-ghost"].error == ""      # others unaffected
+    # the failed deletion was rolled back: the claim is still recorded, so
+    # a kubelet retry (after the poison clears) drains it
+    assert "uid-1" in driver._checkpoint
+    del driver._checkpoint["poison"]
+    resp = driver.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[claim]), None)
+    assert resp.claims["uid-1"].error == ""
+    assert driver.prepared_claim_count() == 0
+    driver.stop()
+
+
+def test_concurrent_same_uid_prepares_one_spec_write(host, apiserver):
+    """Two kubelet retries of the SAME claim racing: the per-claim-UID
+    lock serializes them into one spec write + one checkpoint entry, and
+    both callers get identical devices."""
+    import time
+
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}, {"device": chip_name(1)}])
+    claim = drapb.Claim(namespace="ns1", name="c1", uid="uid-1")
+    writes = []
+    real_write = driver._write_claim_spec
+
+    def counting_write(uid, specs, envs):
+        writes.append(uid)
+        time.sleep(0.05)   # widen the race window
+        return real_write(uid, specs, envs)
+
+    driver._write_claim_spec = counting_write
+    results = {}
+
+    def worker(name):
+        results[name] = prepare(driver, claim).claims["uid-1"]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive()
+    assert writes == ["uid-1"]                       # ONE spec write
+    assert driver.prepared_claim_count() == 1        # ONE checkpoint entry
+    assert results[0].error == "" and results[1].error == ""
+    assert results[0].devices == results[1].devices
+    driver.stop()
+
+
+def test_prepare_ack_durable_before_crash(host, apiserver):
+    """Group-commit flush barrier: every claim ACKed by a concurrent burst
+    must be recoverable from the on-disk checkpoint by a fresh driver (a
+    simulated crash immediately after the RPC returns)."""
+    from dataclasses import replace as dc_replace
+
+    _, cfg = host
+    cfg = dc_replace(cfg, prepare_workers=4)
+    driver = make_driver(cfg, apiserver)
+    uids = [f"uid-burst-{i}" for i in range(8)]
+    for i, uid in enumerate(uids):
+        apiserver.add_claim("ns1", uid, uid, driver.driver_name,
+                            [{"device": chip_name(i % 4)}])
+    claims = [drapb.Claim(namespace="ns1", name=uid, uid=uid)
+              for uid in uids]
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=claims), None)
+    for uid in uids:
+        assert resp.claims[uid].error == "", resp.claims[uid].error
+    # a burst coalesced into strictly fewer checkpoint writes than claims
+    stats = driver.checkpoint_stats()
+    assert stats["checkpoint_claims_coalesced_total"] == 8
+    assert stats["checkpoint_commits_total"] <= 8
+    # crash: a FRESH driver over the same filesystem recovers every ACK
+    driver2 = make_driver(cfg, apiserver)
+    assert driver2.prepared_claim_count() == 8
+    for uid in uids:
+        again = driver2.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[
+                drapb.Claim(namespace="ns1", name=uid, uid=uid)]), None)
+        assert again.claims[uid].error == ""
+        assert again.claims[uid].devices == resp.claims[uid].devices
+    driver.stop()
+    driver2.stop()
+
+
+def test_status_surfaces_attach_plane(host, apiserver):
+    """/status + /metrics carry the attach-plane gauges and group-commit
+    counters."""
+    from tpu_device_plugin.status import StatusServer
+
+    class FakeManager:
+        plugins = []
+        pending = []
+        native_info = {}
+        draining = False
+
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    apiserver.add_claim("ns1", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    prepare(driver, drapb.Claim(namespace="ns1", name="c1", uid="uid-1"))
+    status = StatusServer(FakeManager(), dra_driver=driver)
+    s = status.status()
+    assert s["dra"]["prepare_inflight"] == 0
+    assert s["dra"]["prepare_workers"] == driver.prepare_workers
+    assert s["dra"]["checkpoint_commits_total"] >= 1
+    assert s["dra"]["checkpoint_claims_coalesced_total"] >= 1
+    metrics = status.metrics()
+    assert "tpu_plugin_dra_prepare_inflight 0" in metrics
+    assert f"tpu_plugin_dra_prepare_workers {driver.prepare_workers}" \
+        in metrics
+    assert "tpu_plugin_dra_checkpoint_commits_total" in metrics
+    assert "tpu_plugin_dra_checkpoint_claims_coalesced_total" in metrics
+    driver.stop()
+
+
+def test_prepare_after_stop_errors_instead_of_resurrecting_writer(host,
+                                                                  apiserver):
+    """A straggler RPC outliving stop() must get a per-claim error from
+    the flush barrier — never hang, never spawn a fresh checkpoint writer
+    that defeats the drain."""
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    driver.stop()
+    apiserver.add_claim("ns1", "late", "uid-late", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="late",
+                                       uid="uid-late"))
+    assert "stopped" in resp.claims["uid-late"].error
+    # rolled back: nothing recorded, no orphan spec, no writer thread
+    assert driver.prepared_claim_count() == 0
+    assert not os.path.exists(driver._claim_spec_path("uid-late"))
+    assert driver._ckpt_thread is None or not driver._ckpt_thread.is_alive()

@@ -21,29 +21,50 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .config import Config
 from .kubeletapi import pb
 from .naming import sanitize_name
+from .readcount import WindowRegistry
 from .registry import Registry, SharedDevice
 
 log = logging.getLogger(__name__)
-
-
 
 
 class AllocationError(Exception):
     """Request references devices this plugin cannot serve (unknown/invalid)."""
 
 
+# --- plan-path sysfs accounting (shared machinery: readcount.py) -------------
+# Same contract as discovery.count_reads: the attach-path perf-honesty guard
+# and `bench.py --attach-burst` assert on sysfs access COUNTS (listdir/
+# readlink/exists/attribute-read on the Allocate plan path), because counts —
+# unlike wall clock on a shared CPU — are load-insensitive. Windowless calls
+# cost one truthiness check.
+
+_plan_registry = WindowRegistry()
+_plan_note = _plan_registry.note
+
+
+def count_plan_reads(confine_thread: bool = False):
+    """Count this module's sysfs accesses inside the with-block (nests;
+    `confine_thread=True` counts only the opening thread — concurrent
+    plan() threads on the gRPC pool would inflate a cross-thread window,
+    the same hazard discovery's stats gauge guards against)."""
+    return _plan_registry.window(confine_thread)
+
+
 class LiveAttrReader:
     """Kept-open-fd live reads of small sysfs attributes.
 
     pread(fd, …, 0) re-runs the attribute's sysfs show() on every call, so
-    the read stays LIVE (TOCTOU-guard grade) at fstat+pread cost instead
-    of open+read+close. Staleness is detected two ways, because the
-    plugin also runs over regular-file roots (tests, --root re-rooting)
-    where an unlinked file's fd would otherwise keep serving old bytes
-    forever: st_nlink == 0 on the cached fd catches unlink/replace on ANY
-    filesystem, and pread errors/empty reads catch sysfs inode
-    invalidation. Either falls back to a fresh open, so a genuinely new
-    device at the same path is still re-validated from scratch.
+    the read stays LIVE (TOCTOU-guard grade) at stat+fstat+pread cost
+    instead of open+read+close. Staleness is detected two ways, because
+    the plugin also runs over regular-file roots (tests, --root
+    re-rooting) where an unlinked file's fd would otherwise keep serving
+    old bytes forever: the PATH's (st_dev, st_ino) identity is compared
+    against the cached fd's — catching unlink/replace on any filesystem,
+    including ones that report st_nlink >= 1 for open unlinked files
+    (9p/overlay, where the previous nlink==0 probe never fired) — and
+    pread errors/empty reads catch sysfs inode invalidation. Either falls
+    back to a fresh open, so a genuinely new device at the same path is
+    still re-validated from scratch.
     get + fstat + pread + stale-path close happen under one lock: a close
     outside it could free the fd NUMBER for reuse by a concurrent open
     while another thread still preads it, silently reading an unrelated
@@ -74,7 +95,10 @@ class LiveAttrReader:
             fd = self._fds.get(key)
             if fd is not None:
                 try:
-                    if os.fstat(fd).st_nlink > 0:
+                    st_path = os.stat(path)
+                    st_fd = os.fstat(fd)
+                    if (st_path.st_dev, st_path.st_ino) \
+                            == (st_fd.st_dev, st_fd.st_ino):
                         raw = os.pread(fd, 256, 0)
                         if raw:
                             return raw
@@ -116,6 +140,7 @@ def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
     validate the same partition differently (reference analogue:
     generic_vgpu_device_plugin.go:216-221)."""
     name_path = os.path.join(cfg.mdev_base_path, uuid, "mdev_type", "name")
+    _plan_note(name_path)
     raw = reader.read(uuid, name_path)
     if raw is None:
         # failure path only: one diagnostic open to recover the errno the
@@ -131,12 +156,15 @@ def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
 
 def supports_iommufd(cfg: Config) -> bool:
     """iommufd-capable host: /dev/iommu exists (reference :692-701)."""
-    return os.path.exists(cfg.dev_path("dev/iommu"))
+    path = cfg.dev_path("dev/iommu")
+    _plan_note(path)
+    return os.path.exists(path)
 
 
 def vfio_device_node(cfg: Config, bdf: str) -> Optional[str]:
     """`vfioN` cdev name from sysfs `<bdf>/vfio-dev/` (reference :702-716)."""
     vfio_dev_dir = os.path.join(cfg.pci_base_path, bdf, "vfio-dev")
+    _plan_note(vfio_dev_dir)
     try:
         entries = sorted(os.listdir(vfio_dev_dir))
     except OSError:
@@ -156,6 +184,7 @@ def discover_shared_devices(cfg: Config) -> List[SharedDevice]:
     """
     out: List[SharedDevice] = []
     for class_dir in cfg.shared_device_classes:
+        _plan_note(class_dir)
         try:
             entries = sorted(os.listdir(class_dir))
         except OSError:
@@ -185,6 +214,42 @@ class AllocationPlan:
     device_specs: List[pb.DeviceSpec]
     envs: Dict[str, str]
     expanded_bdfs: List[str]
+    # fully-qualified CDI names for the expanded devices, precomputed in the
+    # group fragment (None when the planner predates the fragment, e.g. a
+    # hand-built plan in tests); allocate_response falls back to computing
+    # them per call
+    cdi_names: Optional[List[str]] = None
+
+
+class _GroupFragment:
+    """Precompiled Allocate response fragment for ONE IOMMU group.
+
+    Everything deterministic given (registry snapshot, group, iommufd
+    state) is built once and concatenated per request: the member-BDF
+    expansion order, the iommufd cdev DeviceSpecs (the per-member
+    `vfio-dev/` listdirs are the dominant sysfs cost of a cold plan), and
+    the members' CDI names. What is NOT in the fragment, by design: the
+    per-member TOCTOU revalidation (group link + vendor), which stays a
+    live read on every plan.
+
+    Invalidation: health flaps drop the affected group's fragment through
+    `AllocationPlanner.invalidate_fragments` (wired from the same PR-2
+    dirty/delta plumbing that hints incremental rediscovery), and an
+    iommufd-state flip misses naturally (the flag is part of the fragment).
+    Blind spot: a vfio cdev renamed with NO membership change and NO
+    health event serves the stale cdev name until a flap or rebuild —
+    the same contract as incremental discovery (docs/perf.md).
+    """
+
+    __slots__ = ("iommufd", "member_bdfs", "iommufd_specs", "cdi_names")
+
+    def __init__(self, iommufd: bool, member_bdfs: Tuple[str, ...],
+                 iommufd_specs: Tuple[pb.DeviceSpec, ...],
+                 cdi_names: Tuple[str, ...]):
+        self.iommufd = iommufd
+        self.member_bdfs = member_bdfs
+        self.iommufd_specs = iommufd_specs
+        self.cdi_names = cdi_names
 
 
 class AllocationPlanner:
@@ -198,11 +263,14 @@ class AllocationPlanner:
 
     What stays LIVE, by design: the TOCTOU guard still re-reads every
     allocated device's iommu_group link and vendor id from sysfs on every
-    Allocate (reference behavior, generic_device_plugin.go:388-397), the
-    iommufd probe re-stats /dev/iommu (:362,692-701), and vfio cdev names
-    are re-listed. The shared-device (EGM-analogue) scan is cached for
-    cfg.shared_scan_ttl_s (0 = the reference's rescan-every-Allocate
-    behavior, :366,120-157).
+    Allocate (reference behavior, generic_device_plugin.go:388-397) — for
+    a multi-group request those reads are batched through one pass — and
+    the iommufd probe re-stats /dev/iommu (:362,692-701). The vfio cdev
+    names and the rest of the per-group response live in a precompiled
+    _GroupFragment, invalidated on health flaps (the reference re-listed
+    them per Allocate, :702-716). The shared-device (EGM-analogue) scan is
+    cached for cfg.shared_scan_ttl_s (0 = the reference's
+    rescan-every-Allocate behavior, :366,120-157).
 
     `allowed_bdfs` (fixed at construction) scopes every request to the
     owning plugin's devices: the reference resolves any BDF in its global
@@ -261,7 +329,84 @@ class AllocationPlanner:
         self._shared_expires = 0.0
         self._iommufd_cache: Optional[bool] = None
         self._iommufd_expires = 0.0
+        # precompiled per-group response fragments (see _GroupFragment);
+        # guarded by their own lock — plan() runs on concurrent gRPC worker
+        # threads while health listeners invalidate from hub threads
+        self._fragments: Dict[str, _GroupFragment] = {}
+        self._frag_lock = threading.Lock()
+        # bumped by every invalidation; a build that was in flight when an
+        # invalidation landed must not store its (possibly pre-flap)
+        # result — see _fragment
+        self._frag_epoch = 0
+        self.fragment_hits = 0
+        self.fragment_misses = 0
 
+    # ------------------------------------------------------ group fragments
+
+    def invalidate_fragments(self, bdfs: Optional[Sequence[str]] = None) -> None:
+        """Drop the cached fragments of the groups owning `bdfs` (all
+        fragments when None). Wired from the health listeners so a flapped
+        device's group is recompiled — cdev names re-listed — on its next
+        plan, the same dirty plumbing that hints incremental rediscovery."""
+        with self._frag_lock:
+            self._frag_epoch += 1
+            if bdfs is None:
+                self._fragments.clear()
+                return
+            for bdf in bdfs:
+                group = self.registry.bdf_to_group.get(bdf)
+                if group is not None:
+                    self._fragments.pop(group, None)
+
+    def fragment_stats(self) -> Dict[str, int]:
+        with self._frag_lock:
+            return {"hits": self.fragment_hits,
+                    "misses": self.fragment_misses,
+                    "size": len(self._fragments)}
+
+    def _fragment(self, group: str, iommufd: bool) -> _GroupFragment:
+        with self._frag_lock:
+            frag = self._fragments.get(group)
+            if frag is not None and frag.iommufd == iommufd:
+                self.fragment_hits += 1
+                return frag
+            self.fragment_misses += 1
+            epoch = self._frag_epoch
+        frag = self._build_fragment(group, iommufd)
+        with self._frag_lock:
+            # an invalidation that landed mid-build may have been aimed at
+            # what this build just read (a flap racing the listdir): serve
+            # the result but never cache it — the next plan recompiles
+            if self._frag_epoch == epoch:
+                self._fragments[group] = frag
+        return frag
+
+    def _build_fragment(self, group: str, iommufd: bool) -> _GroupFragment:
+        from .cdi import cdi_device_name
+        cfg = self.cfg
+        members = tuple(d.bdf for d in self.registry.iommu_map.get(group, ()))
+        iommufd_specs: List[pb.DeviceSpec] = []
+        if iommufd:
+            for bdf in members:
+                node = vfio_device_node(cfg, bdf)
+                if node is None:
+                    # On an iommufd host every vfio-bound device has a cdev;
+                    # an unreadable vfio-dev entry would boot the VM with an
+                    # incomplete device set — fail fast like the reference
+                    # (generic_device_plugin.go:702-716 errors the Allocate).
+                    # Failures are never cached.
+                    raise AllocationError(
+                        f"device {bdf}: iommufd host but no vfio-dev cdev")
+                iommufd_specs.append(pb.DeviceSpec(
+                    host_path=cfg.dev_path("dev/vfio/devices", node),
+                    container_path=f"/dev/vfio/devices/{node}",
+                    permissions="mrw",
+                ))
+        return _GroupFragment(
+            iommufd=iommufd,
+            member_bdfs=members,
+            iommufd_specs=tuple(iommufd_specs),
+            cdi_names=tuple(cdi_device_name(cfg, bdf) for bdf in members))
 
     def _revalidate_live(self, bdf: str, expected_group: str) -> None:
         """TOCTOU guard (NEVER cached): live sysfs must still agree with the
@@ -272,6 +417,7 @@ class AllocationPlanner:
             paths = (os.path.join(base, "iommu_group"),
                      os.path.join(base, "vendor"))
         glink, vpath = paths
+        _plan_note(glink)
         try:
             target = os.readlink(glink)
         except OSError:
@@ -281,6 +427,7 @@ class AllocationPlanner:
             raise AllocationError(
                 f"device {bdf}: iommu group changed "
                 f"({expected_group!r} -> {live!r})")
+        _plan_note(vpath)
         raw = self._vendor_reader.read(bdf, vpath)
         if raw is not None and raw.strip().lower() in self._vendor_ok_raw:
             return
@@ -323,17 +470,22 @@ class AllocationPlanner:
         DeviceSpec order matches the reference's: the shared /dev/vfio/vfio
         container node first, then one /dev/vfio/<group> per IOMMU group,
         then iommufd cdevs + /dev/iommu, then qualifying shared devices.
+
+        The per-group expansion is fragment concatenation (_GroupFragment
+        cache) plus ONE batched live-revalidation pass over every member of
+        every requested group — the TOCTOU guard is never cached.
         """
-        cfg = self.cfg
         registry = self.registry
         iommufd = self._iommufd()
         if shared_devices is None:
             shared_devices = self.shared_devices()
 
-        specs: List[pb.DeviceSpec] = [self._vfio_spec]
-        expanded: List[str] = []
-        seen_groups: List[str] = []
-        iommufd_specs: List[pb.DeviceSpec] = []
+        # dedup with a set (membership was an O(n^2) list probe across a
+        # request's groups) while keeping the reference's spec ordering
+        seen_groups: set = set()
+        ordered_groups: List[str] = []
+        fragments: List[_GroupFragment] = []
+        revalidate: List[Tuple[str, str]] = []   # (bdf, group), all groups
         for bdf in requested_bdfs:
             group = registry.bdf_to_group.get(bdf)
             if group is None:
@@ -345,29 +497,27 @@ class AllocationPlanner:
                     f"{self.resource_suffix!r}")
             if group in seen_groups:
                 continue
-            seen_groups.append(group)
-            for dev in registry.iommu_map[group]:
-                self._revalidate_live(dev.bdf, group)
-                expanded.append(dev.bdf)
-                if iommufd:
-                    node = vfio_device_node(cfg, dev.bdf)
-                    if node is None:
-                        # On an iommufd host every vfio-bound device has a
-                        # cdev; an unreadable vfio-dev entry would boot the
-                        # VM with an incomplete device set — fail fast like
-                        # the reference (generic_device_plugin.go:702-716
-                        # errors the Allocate).
-                        raise AllocationError(
-                            f"device {dev.bdf}: iommufd host but no "
-                            f"vfio-dev cdev")
-                    iommufd_specs.append(pb.DeviceSpec(
-                        host_path=cfg.dev_path("dev/vfio/devices", node),
-                        container_path=f"/dev/vfio/devices/{node}",
-                        permissions="mrw",
-                    ))
+            seen_groups.add(group)
+            ordered_groups.append(group)
+            frag = self._fragment(group, iommufd)
+            fragments.append(frag)
+            revalidate.extend((m, group) for m in frag.member_bdfs)
+        # one batched pass for the whole request (multi-group requests no
+        # longer interleave revalidation with response assembly)
+        for member, group in revalidate:
+            self._revalidate_live(member, group)
+
+        specs: List[pb.DeviceSpec] = [self._vfio_spec]
+        expanded: List[str] = []
+        cdi_names: List[str] = []
+        iommufd_specs: List[pb.DeviceSpec] = []
+        for group, frag in zip(ordered_groups, fragments):
+            expanded.extend(frag.member_bdfs)
+            cdi_names.extend(frag.cdi_names)
+            iommufd_specs.extend(frag.iommufd_specs)
             specs.append(self._group_specs[group])
         specs.extend(iommufd_specs)
-        if iommufd and seen_groups:
+        if iommufd and ordered_groups:
             specs.append(self._iommu_spec)
 
         # Shared devices ride along iff every member chip is in this
@@ -385,10 +535,10 @@ class AllocationPlanner:
 
         envs = {self.env_key: ",".join(expanded)}
         log.info("allocate %s: groups=%s devices=%s iommufd=%s cdi=%s",
-                 self.resource_suffix, seen_groups, expanded, iommufd,
+                 self.resource_suffix, ordered_groups, expanded, iommufd,
                  self.cdi_enabled)
         return AllocationPlan(device_specs=specs, envs=envs,
-                              expanded_bdfs=expanded)
+                              expanded_bdfs=expanded, cdi_names=cdi_names)
 
     def allocate_response(self, request: pb.AllocateRequest) -> pb.AllocateResponse:
         """Full Allocate handler body: one ContainerAllocateResponse per
@@ -400,10 +550,13 @@ class AllocationPlanner:
             cresp = pb.ContainerAllocateResponse(
                 envs=plan.envs, devices=plan.device_specs)
             if self.cdi_enabled:
-                from .cdi import cdi_device_name
+                names = plan.cdi_names
+                if names is None:
+                    from .cdi import cdi_device_name
+                    names = [cdi_device_name(self.cfg, bdf)
+                             for bdf in plan.expanded_bdfs]
                 cresp.cdi_devices.extend(
-                    pb.CDIDevice(name=cdi_device_name(self.cfg, bdf))
-                    for bdf in plan.expanded_bdfs)
+                    pb.CDIDevice(name=name) for name in names)
             resp.container_responses.append(cresp)
         return resp
 

@@ -79,6 +79,14 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              "probe that has not answered by then is "
                              "scored dead (counted on /metrics) instead "
                              "of delaying every other chip's verdict")
+    # default=None sentinel so the env var ($TDP_PREPARE_WORKERS) can supply
+    # the value when the flag is absent, with the SAME validation either way
+    parser.add_argument("--prepare-workers", type=int, default=None,
+                        help="worker pool size for fanning out a multi-claim "
+                             "DRA NodePrepareResources/NodeUnprepareResources "
+                             "(same-UID kubelet retries still serialize on a "
+                             f"per-claim lock; default {cfg.prepare_workers}; "
+                             "env TDP_PREPARE_WORKERS)")
     parser.add_argument("--rediscovery-seconds", type=float,
                         default=cfg.rediscovery_interval_s,
                         help="0 disables periodic re-discovery")
@@ -169,6 +177,19 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             or args.lw_debounce_ms < 0:
         parser.error("--lw-debounce-ms must be a finite number >= 0, got "
                      f"{args.lw_debounce_ms!r}")
+    if args.prepare_workers is None:
+        env_workers = os.environ.get("TDP_PREPARE_WORKERS")
+        if env_workers is not None:
+            try:
+                args.prepare_workers = int(env_workers)
+            except ValueError:
+                parser.error(f"$TDP_PREPARE_WORKERS={env_workers!r} is not "
+                             "an integer")
+        else:
+            args.prepare_workers = cfg.prepare_workers
+    if args.prepare_workers < 1:
+        parser.error("--prepare-workers must be >= 1, got "
+                     f"{args.prepare_workers}")
     # same fail-loud rule for the health-hub knobs: a 0-worker pool can run
     # no probe at all and a non-finite deadline silently disables timeouts
     if args.health_probe_workers < 1:
@@ -227,6 +248,7 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         health_poll_s=args.health_poll_seconds,
         health_probe_workers=args.health_probe_workers,
         health_probe_deadline_s=args.health_probe_deadline_seconds,
+        prepare_workers=args.prepare_workers,
         rediscovery_interval_s=args.rediscovery_seconds,
         shared_scan_ttl_s=args.shared_scan_ttl,
         lw_debounce_s=args.lw_debounce_ms / 1000.0,

@@ -97,6 +97,11 @@ class Config:
     # verdict behind it.
     health_probe_workers: int = 4
     health_probe_deadline_s: float = 1.0
+    # Attach plane (dra.py): bounded worker pool fanning a multi-claim
+    # NodePrepareResources/NodeUnprepareResources out so concurrent claims
+    # never queue behind each other's API-server fetch or sysfs reads.
+    # Same-UID retries still serialize on a per-claim lock (idempotency).
+    prepare_workers: int = 4
     rediscovery_interval_s: float = 0.0  # 0 disables periodic re-discovery
     # ListAndWatch coalesce window: health transitions landing within this
     # window are folded into ONE re-send (a vfio flap storm otherwise

@@ -15,12 +15,12 @@ import logging
 import os
 import re
 import threading
-from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .config import Config
 from .naming import GenerationInfo, load_generation_map
+from .readcount import ReadWindow, WindowRegistry  # noqa: F401 (ReadWindow re-exported)
 from .registry import Registry, TpuDevice, TpuPartition
 from .topology import assign_coords, load_topology_hints
 
@@ -29,55 +29,23 @@ log = logging.getLogger(__name__)
 _ACCEL_RE = re.compile(r"^accel(\d+)$")
 
 
-# --- sysfs access accounting -------------------------------------------------
+# --- sysfs access accounting (shared machinery: readcount.py) ----------------
+# Every sysfs access (file read, readlink, listdir, stat) made by this
+# module inside an open window bumps its counters; the perf-honesty guard
+# and `bench.py --discovery` assert on these counts because read COUNTS —
+# unlike wall clock on a shared CPU — are load-insensitive.
 
-class ReadWindow:
-    """One open accounting window: every sysfs access (file read, readlink,
-    listdir, stat) made by this module while the window is open bumps
-    `reads` and appends the path to `paths`. The perf-honesty guard and
-    `bench.py --discovery` assert on these counts because read COUNTS —
-    unlike wall clock on a shared CPU — are load-insensitive."""
-
-    def __init__(self, owner: Optional[int] = None) -> None:
-        self.reads = 0
-        self.paths: List[str] = []
-        # thread ident this window is confined to; None = count reads from
-        # every thread (the default — tests observe a manager thread's
-        # rescans from the test thread)
-        self._owner = owner
+_read_registry = WindowRegistry()
+_note = _read_registry.note
 
 
-_windows: List[ReadWindow] = []
-_windows_lock = threading.Lock()
-
-
-def _note(path: str) -> None:
-    ident: Optional[int] = None
-    for w in tuple(_windows):
-        if w._owner is not None:
-            if ident is None:
-                ident = threading.get_ident()
-            if w._owner != ident:
-                continue
-        w.reads += 1
-        w.paths.append(path)
-
-
-@contextmanager
-def count_reads(confine_thread: bool = False) -> Iterator[ReadWindow]:
+def count_reads(confine_thread: bool = False):
     """Count this module's sysfs accesses inside the with-block. Windows
     nest: each one sees every access made while it is open. With
     `confine_thread`, only the opening thread's accesses count — the
     HostSnapshot stats gauge uses this so concurrent readers on other
     threads (DRA prepare, vtpu monitor) cannot inflate it."""
-    w = ReadWindow(threading.get_ident() if confine_thread else None)
-    with _windows_lock:
-        _windows.append(w)
-    try:
-        yield w
-    finally:
-        with _windows_lock:
-            _windows.remove(w)
+    return _read_registry.window(confine_thread)
 
 
 def _listdir(path: str) -> List[str]:

@@ -26,6 +26,10 @@ Instrumented sites and their semantics:
                      (exercises the periodic existence-scan reconciliation)
   dra.publish        value   — the slice publish fails as if the API
                      server had refused it (exercises the republish retry)
+  checkpoint.write   raising — the group-commit checkpoint write fails
+                     before reaching disk (every claim waiting on that
+                     commit window must error, roll back, and never be
+                     silently ACKed)
 
 Arming — programmatic:
 
@@ -88,6 +92,7 @@ _SITE_CATEGORY: Dict[str, str] = {
     "native.probe": "value",
     "inotify.poll": "value",
     "dra.publish": "value",
+    "checkpoint.write": "raising",
 }
 _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 

@@ -1,0 +1,67 @@
+"""Shared sysfs-access accounting windows.
+
+One registry instance per instrumented module (discovery's full-walk
+reads, allocate's plan-path reads). The perf-honesty guards and the
+benches assert on access COUNTS because counts — unlike wall clock on a
+shared CPU — are load-insensitive. Factored here so the window semantics
+(nesting, thread confinement) exist exactly once: discovery grew the
+confine-thread option precisely because concurrent readers on other
+threads inflated its stats gauge, and any registry hands the same
+protection to its callers.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator, List, Optional
+
+
+class ReadWindow:
+    """One open accounting window: every access noted on the owning
+    registry while the window is open bumps `reads` and appends the path
+    to `paths`."""
+
+    def __init__(self, owner: Optional[int] = None) -> None:
+        self.reads = 0
+        self.paths: List[str] = []
+        # thread ident this window is confined to; None = count reads
+        # from every thread (the default — tests observe a worker
+        # thread's reads from the test thread)
+        self._owner = owner
+
+
+class WindowRegistry:
+    """The open windows of one instrumented module. `note()` with no
+    windows open costs one truthiness check (the production state)."""
+
+    def __init__(self) -> None:
+        self._windows: List[ReadWindow] = []
+        self._lock = threading.Lock()
+
+    def note(self, path: str) -> None:
+        if not self._windows:
+            return
+        ident: Optional[int] = None
+        for w in tuple(self._windows):
+            if w._owner is not None:
+                if ident is None:
+                    ident = threading.get_ident()
+                if w._owner != ident:
+                    continue
+            w.reads += 1
+            w.paths.append(path)
+
+    @contextmanager
+    def window(self, confine_thread: bool = False) -> Iterator[ReadWindow]:
+        """Open an accounting window for the with-block. Windows nest:
+        each sees every access made while it is open. With
+        `confine_thread`, only the opening thread's accesses count."""
+        w = ReadWindow(threading.get_ident() if confine_thread else None)
+        with self._lock:
+            self._windows.append(w)
+        try:
+            yield w
+        finally:
+            with self._lock:
+                self._windows.remove(w)

@@ -224,6 +224,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             if changed:
                 self._version += 1
                 self._cond.notify_all()
+        if touched:
+            # flapped devices invalidate their groups' precompiled Allocate
+            # fragments (allocate._GroupFragment): the next plan re-lists
+            # cdev names for exactly those groups — the same dirty plumbing
+            # that hints incremental rediscovery, applied to the attach path
+            self._invalidate_alloc_fragments(touched)
         if touched and self._health_listener is not None:
             # Outside _cond: the listener may do slow work (the DRA driver
             # republishes over HTTP) and must never stall ListAndWatch
@@ -245,6 +251,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     self._health_listener(current)
                 except Exception as exc:
                     log.error("health listener failed: %s", exc)
+
+    def _invalidate_alloc_fragments(self, device_ids: Sequence[str]) -> None:
+        """Hook for fragment invalidation on health transitions; device_ids
+        are this server's device table ids (BDFs here; the vTPU subclass
+        maps partition uuids onto parent BDFs for its parent planner)."""
+        self._planner.invalidate_fragments(device_ids)
 
     def _snapshot(self) -> Tuple[int, List[pb.Device]]:
         with self._cond:
@@ -466,6 +478,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             # stream after coalescing)
             "preferred_cache": pref_cache,
             "lw_resends": self._lw_resends,
+            # precompiled per-IOMMU-group Allocate fragment cache
+            # (allocate._GroupFragment) effectiveness
+            "alloc_fragments": self._planner.fragment_stats(),
             # recovery-activity counters (resilience.BackoffPolicy): how many
             # backoff delays restart() has issued, lifetime and current-run
             "restart_backoff": self._restart_backoff.snapshot(),
@@ -562,15 +577,20 @@ class TpuDevicePlugin(api.DevicePluginServicer):
     def GetPreferredAllocation(self, request, context):
         resp = pb.PreferredAllocationResponse()
         index = self._alloc_index
+        # The ICI sub-box scan is pure in (availability, must-include,
+        # size) over a static torus, and the kubelet re-asks with the
+        # same availability between allocations — memoize on those plus
+        # the device-table version (health flips change nothing the
+        # scan reads, but the version key keeps the cache honest if
+        # that ever changes). Measured: 16 -> ~1 us on the repeat path.
+        # The version is snapshotted ONCE per RPC — a multi-container
+        # request used to take _cond then _pref_lock per container, two
+        # lock rounds per lookup; now a hit costs one (_pref_lock only).
+        # A version bump mid-RPC just misses into a recompute of the same
+        # pure result (health is not an input to the scan).
+        with self._cond:
+            version = self._version
         for creq in request.container_requests:
-            # The ICI sub-box scan is pure in (availability, must-include,
-            # size) over a static torus, and the kubelet re-asks with the
-            # same availability between allocations — memoize on those plus
-            # the device-table version (health flips change nothing the
-            # scan reads, but the version key keeps the cache honest if
-            # that ever changes). Measured: 16 -> ~1 us on the repeat path.
-            with self._cond:
-                version = self._version
             key = (version,
                    tuple(creq.available_deviceIDs),
                    tuple(creq.must_include_deviceIDs),

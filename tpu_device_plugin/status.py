@@ -132,6 +132,9 @@ class StatusServer:
                 # (read-modify-write) slice publishes
                 "publish_stats": dict(d.publish_stats),
             }
+            # attach plane: in-flight claim tasks, prepare pool size, and
+            # group-commit effectiveness (commits vs claims coalesced)
+            out["dra"].update(d.checkpoint_stats())
             if d.api is not None:
                 out["dra"]["api_breaker"] = d.api.breaker.snapshot()
         return out
@@ -201,6 +204,16 @@ class StatusServer:
             lines.append(
                 f'tpu_plugin_lw_resends_total{{resource="{p["resource"]}"}} '
                 f'{p.get("lw_resends", 0)}')
+        lines += ["# HELP tpu_plugin_alloc_fragment_total Precompiled "
+                  "per-IOMMU-group Allocate fragment lookups by outcome.",
+                  "# TYPE tpu_plugin_alloc_fragment_total counter"]
+        for p in s["plugins"]:
+            frags = p.get("alloc_fragments", {})
+            for outcome, key in (("hit", "hits"), ("miss", "misses")):
+                lines.append(
+                    f'tpu_plugin_alloc_fragment_total{{resource='
+                    f'"{p["resource"]}",outcome="{outcome}"}} '
+                    f'{frags.get(key, 0)}')
         disc = s.get("discovery")
         if disc:
             lines += [
@@ -295,6 +308,28 @@ class StatusServer:
                 f"{s['dra']['publish_stats']['delta']}",
                 f'tpu_plugin_dra_slice_publishes_total{{kind="full"}} '
                 f"{s['dra']['publish_stats']['full']}",
+                "# HELP tpu_plugin_dra_prepare_inflight Claim prepare/"
+                "unprepare tasks currently in flight.",
+                "# TYPE tpu_plugin_dra_prepare_inflight gauge",
+                f"tpu_plugin_dra_prepare_inflight "
+                f"{s['dra']['prepare_inflight']}",
+                "# HELP tpu_plugin_dra_prepare_workers Bounded pool size "
+                "fanning out multi-claim prepare RPCs.",
+                "# TYPE tpu_plugin_dra_prepare_workers gauge",
+                f"tpu_plugin_dra_prepare_workers "
+                f"{s['dra']['prepare_workers']}",
+                "# HELP tpu_plugin_dra_checkpoint_commits_total Atomic "
+                "checkpoint file writes (group commits).",
+                "# TYPE tpu_plugin_dra_checkpoint_commits_total counter",
+                f"tpu_plugin_dra_checkpoint_commits_total "
+                f"{s['dra']['checkpoint_commits_total']}",
+                "# HELP tpu_plugin_dra_checkpoint_claims_coalesced_total "
+                "Claim mutations made durable by those commits (claims >> "
+                "commits under a burst is the group-commit win).",
+                "# TYPE tpu_plugin_dra_checkpoint_claims_coalesced_total "
+                "counter",
+                f"tpu_plugin_dra_checkpoint_claims_coalesced_total "
+                f"{s['dra']['checkpoint_claims_coalesced_total']}",
             ]
             breaker = s["dra"].get("api_breaker")
             if breaker is not None:

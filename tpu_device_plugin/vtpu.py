@@ -132,6 +132,17 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                 self.cfg.pci_base_path, bdf, parent_node.get(bdf)),
         ))
 
+    def _invalidate_alloc_fragments(self, device_ids) -> None:
+        """Health transitions arrive keyed by partition uuid; the planner
+        that holds fragments here is the parent-BDF passthrough planner
+        (vfio-backed logical partitions), so map uuids to parents. The
+        inherited self._planner was built from devices=[] and caches
+        nothing worth dropping."""
+        parents = [self._by_uuid[u].parent_bdf for u in device_ids
+                   if u in self._by_uuid]
+        if parents:
+            self._parent_planner.invalidate_fragments(parents)
+
     # ------------------------------------------------------------------- RPCs
 
     def _validate_mdev(self, p: TpuPartition) -> None:
