@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -25,6 +25,34 @@ proto: proto/deviceplugin_v1beta1.proto proto/dra_v1beta1.proto proto/pluginregi
 
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# Static gates (docs/static-analysis.md): ruff (E/F/B/PLE) + gradual
+# strict mypy (allowlist in pyproject.toml) + tsalint, the project
+# concurrency analyzer (lock-order graph, blocking-under-hot-lock,
+# counter ownership, fault-site registry, thread lifecycle) gated on
+# tools/tsalint/baseline.json. ruff/mypy are skipped with a notice where
+# not installed (the hermetic test image ships neither; CI installs both)
+# — tsalint is stdlib-only and always enforced.
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+	    ruff check tpu_device_plugin tools scripts tests bench.py; \
+	else echo "lint: ruff not installed; skipped (CI runs it)"; fi
+	@if command -v mypy >/dev/null 2>&1; then \
+	    mypy --config-file pyproject.toml; \
+	else echo "lint: mypy not installed; skipped (CI runs it)"; fi
+	$(PYTHON) scripts/lint_concurrency.py
+
+# Re-freeze accepted concurrency-lint debt (reviewable in the diff).
+lint-baseline:
+	$(PYTHON) scripts/lint_concurrency.py --update-baseline
+
+# Tier-1 as a race detector: every registered lock records acquisition
+# order + hold times (tpu_device_plugin/lockdep.py); the session FAILS on
+# any observed lock-order inversion, cycle, watched-lock long hold, or
+# leaked daemon thread.
+lockdep-test:
+	TDP_LOCKDEP=1 JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/ -q -m 'not slow'
 
 # Seeded chaos suite (docs/fault-injection.md): randomized kubelet-restart
 # storms, flapping /dev/vfio nodes, apiserver 5xx/timeout bursts — fixed

@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Concurrency lint entrypoint: run tsalint over tpu_device_plugin/.
+
+Usage:
+    python scripts/lint_concurrency.py                 # gate: new findings fail
+    python scripts/lint_concurrency.py --list          # print ALL findings
+    python scripts/lint_concurrency.py --update-baseline
+
+Exit codes: 0 clean (no findings outside the baseline), 1 new findings,
+2 usage/configuration error. Stale baseline entries (debt that no longer
+fires) are reported but never fail the run — delete them via
+--update-baseline when convenient.
+
+See docs/static-analysis.md for the rule set and the baseline workflow;
+the runtime counterpart is tpu_device_plugin/lockdep.py ($TDP_LOCKDEP=1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.tsalint import (analyze_sources, diff_against_baseline,  # noqa: E402
+                           load_baseline, project_config, save_baseline)
+
+PACKAGE = "tpu_device_plugin"
+DEFAULT_BASELINE = os.path.join("tools", "tsalint", "baseline.json")
+
+
+def _package_files(root: str) -> list:
+    paths = []
+    pkg = os.path.join(root, PACKAGE)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "kubeletapi", "data")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and not fn.endswith("_pb2.py"):
+                paths.append(os.path.join(dirpath, fn))
+    return sorted(paths)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repo root (default: this checkout)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline path (default: {DEFAULT_BASELINE})")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings")
+    parser.add_argument("--list", action="store_true",
+                        help="print every finding, baselined or not")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    faults_py = os.path.join(root, PACKAGE, "faults.py")
+    doc_md = os.path.join(root, "docs", "fault-injection.md")
+    try:
+        with open(faults_py, "r", encoding="utf-8") as f:
+            faults_src = f.read()
+        with open(doc_md, "r", encoding="utf-8") as f:
+            doc_text = f.read()
+    except OSError as exc:
+        print(f"tsalint: cannot read rule inputs: {exc}", file=sys.stderr)
+        return 2
+
+    config = project_config(faults_src, doc_text)
+    paths = _package_files(root)
+    rel = [os.path.relpath(p, root).replace(os.sep, "/") for p in paths]
+    sources = []
+    for abs_path, rel_path in zip(paths, rel):
+        with open(abs_path, "r", encoding="utf-8") as f:
+            sources.append((rel_path, f.read()))
+
+    findings = analyze_sources(sources, config)
+
+    if args.update_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"tsalint: baseline updated with {len(findings)} finding(s) "
+              f"-> {baseline_path}")
+        return 0
+
+    try:
+        baseline = load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"tsalint: {exc}", file=sys.stderr)
+        return 2
+    new, stale = diff_against_baseline(findings, baseline)
+
+    if args.list:
+        for f in findings:
+            mark = " (baselined)" if f.key in baseline else ""
+            print(f.render() + mark)
+
+    print(f"tsalint: {len(paths)} files, {len(findings)} finding(s) "
+          f"({len(findings) - len(new)} baselined, {len(new)} new, "
+          f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'})")
+    for key in stale:
+        print(f"tsalint: resolved (delete from baseline): {key}")
+    if new:
+        print("tsalint: NEW findings (fix them or, for accepted debt, "
+              "run --update-baseline):", file=sys.stderr)
+        for f in new:
+            print("  " + f.render(), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,43 @@ if not _want_tpu:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime lockdep (tpu_device_plugin/lockdep.py): with TDP_LOCKDEP=1 the
+# whole suite doubles as a race detector — every registered lock records
+# its acquisition order and hold times, and the session FAILS on any
+# observed lock-order inversion, cycle, or watched-lock long hold, plus on
+# leaked daemon threads. Enabled HERE, before any tpu_device_plugin module
+# is imported, because module-level locks (faults._lock) are instrumented
+# at import time.
+_lockdep_on = os.environ.get("TDP_LOCKDEP") == "1"
+if _lockdep_on:
+    from tpu_device_plugin import lockdep as _lockdep
+
+    _lockdep.enable()
+
+# thread-name prefixes owned by this codebase: anything with one of these
+# names still alive at session end (after a settle window) was leaked by
+# an owner whose stop() path lost it
+_OWNED_THREAD_PREFIXES = (
+    "healthhub", "dra-prepare", "dra-ckpt", "dra-reserve", "restart-",
+    "plugin-start", "status-http", "health-", "dp-",
+)
+
+
+def _leaked_threads(settle_s: float = 5.0):
+    """Our named threads still alive after up to `settle_s` of grace (join
+    timeouts in stop() paths are bounded; give stragglers that long)."""
+    import threading
+    import time
+
+    deadline = time.monotonic() + settle_s
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive()
+                  and t.name.startswith(_OWNED_THREAD_PREFIXES)]
+        if not leaked or time.monotonic() >= deadline:
+            return leaked
+        time.sleep(0.1)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
@@ -43,6 +80,33 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long randomized chaos soak (TDP_CHAOS_SOAK=1; "
                    "run via `make chaos-soak`)")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the run on lockdep violations / thread leaks (TDP_LOCKDEP=1).
+
+    Without TDP_LOCKDEP the leak scan still runs and prints, so a leak
+    regression is visible in any tier-1 log even before the dedicated CI
+    lockdep job catches it."""
+    problems = []
+    leaked = _leaked_threads()
+    if leaked:
+        problems.append(
+            "thread leak: " + ", ".join(sorted(t.name for t in leaked))
+            + " still alive at session end (stop() paths must join)")
+    if _lockdep_on:
+        rep = _lockdep.report()
+        violations = rep.violations()
+        print("\n" + rep.render(stacks=bool(violations)))
+        problems.extend(violations)
+    if problems:
+        print("\nconcurrency gate FAILED:")
+        for p in problems:
+            print("  " + p)
+        if _lockdep_on:
+            session.exitstatus = 1
+        else:
+            print("  (TDP_LOCKDEP not set: reported, not enforced)")
 
 
 class FakeClock:

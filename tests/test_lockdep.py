@@ -1,0 +1,248 @@
+"""Runtime lockdep (tpu_device_plugin/lockdep.py) unit tests.
+
+Everything runs inside lockdep.scoped(), which enables recording with
+ISOLATED state — the intentional inversions staged here must never leak
+into (and fail) a surrounding TDP_LOCKDEP=1 session's final report.
+"""
+
+import threading
+import time
+
+from tpu_device_plugin import lockdep
+
+
+def test_inversion_detected():
+    with lockdep.scoped():
+        a = lockdep.instrument("t.A", threading.Lock())
+        b = lockdep.instrument("t.B", threading.Lock())
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        rep = lockdep.report()
+        assert rep.inversions == [("t.A", "t.B")]
+        assert any("inversion" in v for v in rep.violations())
+        assert "t.A" in rep.render(stacks=True)
+
+
+def test_consistent_order_is_clean():
+    with lockdep.scoped():
+        a = lockdep.instrument("t.A", threading.Lock())
+        b = lockdep.instrument("t.B", threading.Lock())
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        rep = lockdep.report()
+        assert rep.inversions == []
+        assert rep.violations() == []
+        assert ("t.A", "t.B") in rep.edges
+
+
+def test_cross_thread_edges_combine():
+    """One thread only ever takes A->B, another only B->A: neither alone
+    deadlocks, but the union is the classic ABBA — lockdep's whole point."""
+    with lockdep.scoped():
+        a = lockdep.instrument("t.A", threading.Lock())
+        b = lockdep.instrument("t.B", threading.Lock())
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+        assert lockdep.report().inversions == [("t.A", "t.B")]
+
+
+def test_rlock_reentry_is_not_a_self_edge():
+    with lockdep.scoped():
+        r = lockdep.instrument("t.R", threading.RLock())
+        with r:
+            with r:
+                pass
+        rep = lockdep.report()
+        assert ("t.R", "t.R") not in rep.edges
+        assert rep.violations() == []
+
+
+def test_two_instances_same_name_nested_flags_self_inversion():
+    """Nesting two INSTANCES sharing a lockdep name (e.g. two per-claim
+    locks) is an ABBA hazard between peers: reported as a self-edge."""
+    with lockdep.scoped():
+        l1 = lockdep.instrument("t.claim", threading.Lock())
+        l2 = lockdep.instrument("t.claim", threading.Lock())
+        with l1:
+            with l2:
+                pass
+        rep = lockdep.report()
+        assert ("t.claim", "t.claim") in rep.inversions
+        assert any("t.claim" in v for v in rep.violations())
+
+
+def test_three_lock_cycle_detected():
+    with lockdep.scoped():
+        a = lockdep.instrument("t.A", threading.Lock())
+        b = lockdep.instrument("t.B", threading.Lock())
+        c = lockdep.instrument("t.C", threading.Lock())
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        rep = lockdep.report()
+        assert rep.cycles == [["t.A", "t.B", "t.C"]]
+        assert any("cycle" in v for v in rep.violations())
+        assert rep.inversions == []   # no 2-cycle in this graph
+
+
+def test_cycle_reported_in_actual_edge_order():
+    """Edges A->C, C->B, B->A: the cycle must read A -> C -> B -> A (real
+    edges, traceable through exemplar stacks), not the sorted A -> B -> C."""
+    with lockdep.scoped():
+        a = lockdep.instrument("t.A", threading.Lock())
+        b = lockdep.instrument("t.B", threading.Lock())
+        c = lockdep.instrument("t.C", threading.Lock())
+        with a:
+            with c:
+                pass
+        with c:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        rep = lockdep.report()
+        assert rep.cycles == [["t.A", "t.C", "t.B"]]
+        rendered = rep.render(stacks=True)
+        assert "t.A -> t.C -> t.B -> t.A" in rendered
+        # every arc of the cycle has its first-seen stack in the render
+        assert "('t.A', 't.C')" in rendered
+        assert "('t.B', 't.A')" in rendered
+
+
+def test_long_hold_flagged_for_watched_lock_only():
+    with lockdep.scoped(hold_threshold_ms=30, watched={"t.slow"}):
+        slow = lockdep.instrument("t.slow", threading.Lock())
+        fast = lockdep.instrument("t.fast", threading.Lock())
+        with slow:
+            time.sleep(0.06)
+        with fast:             # unwatched: held long but never reported
+            time.sleep(0.06)
+        rep = lockdep.report()
+        assert [h[0] for h in rep.long_holds] == ["t.slow"]
+        assert any("long hold" in v for v in rep.violations())
+
+
+def test_condition_wait_pauses_the_hold_clock():
+    """A waiter is not a holder: a Condition slept on for longer than the
+    threshold must NOT count as a long hold (wait releases the lock), and
+    the post-wait re-acquire restarts the clock."""
+    with lockdep.scoped(hold_threshold_ms=40, watched={"t.cond"}):
+        cond = lockdep.instrument("t.cond", threading.Condition())
+        with cond:
+            cond.wait(timeout=0.1)     # sleeps past the threshold
+        assert lockdep.report().long_holds == []
+
+
+def test_condition_wait_releases_order_stack():
+    """While waiting, the condition must not count as held: a lock taken
+    by the woken path right after wait() is NOT nested under it from the
+    waiting period's perspective... but a lock acquired DURING the wait by
+    the same thread (via the predicate path here, simulated directly)
+    records no edge from the suspended condition."""
+    with lockdep.scoped():
+        cond = lockdep.instrument("t.cond", threading.Condition())
+        other = lockdep.instrument("t.other", threading.Lock())
+
+        acquired_during_wait = []
+
+        class _Probe:
+            calls = 0
+
+            def __call__(self):
+                _Probe.calls += 1
+                if _Probe.calls == 1:
+                    # first predicate check happens with the cond lock
+                    # held — a normal nested acquire, edge expected
+                    return False
+                with other:
+                    acquired_during_wait.append(True)
+                return True
+
+        def waker():
+            time.sleep(0.02)
+            with cond:
+                cond.notify_all()
+
+        t = threading.Thread(target=waker)
+        t.start()
+        with cond:
+            cond.wait_for(_Probe(), timeout=1.0)
+        t.join()
+        rep = lockdep.report()
+        assert acquired_during_wait
+        # the wait_for-internal acquire of t.other happened while the
+        # condition's hold record was SUSPENDED: no cond->other edge
+        assert ("t.cond", "t.other") not in rep.edges
+
+
+def test_disabled_instrument_returns_raw_lock():
+    was = lockdep.enabled()
+    lockdep.disable()
+    try:
+        raw = threading.Lock()
+        assert lockdep.instrument("t.raw", raw) is raw
+    finally:
+        if was:
+            lockdep.enable()
+
+
+def test_acquire_release_api_and_locked():
+    with lockdep.scoped():
+        a = lockdep.instrument("t.api", threading.Lock())
+        assert a.acquire(True, 1.0)
+        assert a.locked()
+        a.release()
+        assert not a.locked()
+        assert "t.api" in repr(a)
+
+
+def test_scoped_restores_outer_state():
+    with lockdep.scoped():
+        outer_a = lockdep.instrument("t.outerA", threading.Lock())
+        outer_b = lockdep.instrument("t.outerB", threading.Lock())
+        with outer_a:
+            with outer_b:
+                pass
+        with lockdep.scoped():
+            # isolated: the outer edge is invisible, inner mess stays here
+            assert lockdep.report().edges == {}
+            x = lockdep.instrument("t.X", threading.Lock())
+            y = lockdep.instrument("t.Y", threading.Lock())
+            with x:
+                with y:
+                    pass
+            with y:
+                with x:
+                    pass
+            assert lockdep.report().inversions == [("t.X", "t.Y")]
+        rep = lockdep.report()
+        assert ("t.outerA", "t.outerB") in rep.edges
+        assert rep.inversions == []

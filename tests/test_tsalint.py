@@ -1,0 +1,535 @@
+"""tsalint (tools/tsalint) unit tests: each rule must fire on a fixture
+snippet that contains exactly the defect the rule exists for, stay quiet
+on the corrected version, and the baseline must round-trip."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.tsalint import (LintConfig, analyze_sources,  # noqa: E402
+                           diff_against_baseline, load_baseline,
+                           save_baseline)
+from tools.tsalint.config import (BLOCKING_CALLS, BLOCKING_METHODS,  # noqa: E402
+                                  documented_fault_sites,
+                                  registered_fault_sites)
+
+
+def run(source, *, hot=(), counters=None, registered=None, documented=None,
+        path="mod.py"):
+    cfg = LintConfig(
+        hot_locks=frozenset(hot),
+        counters=counters or {},
+        blocking_calls=BLOCKING_CALLS,
+        blocking_methods=BLOCKING_METHODS,
+        registered_sites=registered,
+        documented_sites=documented,
+    )
+    return analyze_sources([(path, source)], cfg)
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- lock order
+
+
+LOCK_INVERSION = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def other(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_lock_order_inversion_fires():
+    findings = run(LOCK_INVERSION)
+    assert rules(findings) == ["lock-order-cycle"]
+    assert "mod.C._a" in findings[0].message
+    assert "mod.C._b" in findings[0].message
+
+
+def test_consistent_lock_order_is_clean():
+    clean = LOCK_INVERSION.replace(
+        "with self._b:\n            with self._a:",
+        "with self._a:\n            with self._b:")
+    assert run(clean) == []
+
+
+INTERPROCEDURAL_INVERSION = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def helper(self):
+        with self._b:
+            pass
+
+    def one(self):
+        with self._a:
+            self.helper()        # a -> b, via the call graph
+
+    def other(self):
+        with self._b:
+            with self._a:
+                pass             # b -> a: cycle
+"""
+
+
+def test_lock_order_sees_through_method_calls():
+    findings = run(INTERPROCEDURAL_INVERSION)
+    assert rules(findings) == ["lock-order-cycle"]
+
+
+SELF_DEADLOCK = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+
+    def helper(self):
+        with self._a:
+            pass
+
+    def outer(self):
+        with self._a:
+            self.helper()        # plain Lock re-entered: self-deadlock
+"""
+
+
+def test_plain_lock_self_reentry_fires_and_rlock_does_not():
+    assert rules(run(SELF_DEADLOCK)) == ["lock-order-cycle"]
+    rlock = SELF_DEADLOCK.replace("threading.Lock()", "threading.RLock()")
+    assert run(rlock) == []
+
+
+# -------------------------------------------------------- blocking calls
+
+
+BLOCKING_UNDER_HOT = """
+import os
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        with self._lock:
+            time.sleep(1)
+
+    def also_bad(self):
+        with self._lock:
+            os.listdir("/dev")
+
+    def fine(self):
+        with self._lock:
+            x = 1
+        time.sleep(1)
+        return x
+"""
+
+
+def test_blocking_under_hot_lock_fires():
+    findings = run(BLOCKING_UNDER_HOT, hot={"mod.C._lock"})
+    assert rules(findings) == ["blocking-under-hot-lock"]
+    assert {f.qualname for f in findings} == {"mod.C.bad", "mod.C.also_bad"}
+
+
+def test_blocking_needs_hot_designation():
+    # same code, lock not designated hot: quiet (LiveAttrReader-style
+    # by-design small I/O under a private lock stays legal)
+    assert run(BLOCKING_UNDER_HOT) == []
+
+
+BLOCKING_VIA_HELPER = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _write(self, path, data):
+        with open(path, "w") as f:
+            f.write(data)
+
+    def bad(self):
+        with self._lock:
+            self._write("/tmp/x", "y")
+"""
+
+
+def test_blocking_propagates_through_helpers():
+    findings = run(BLOCKING_VIA_HELPER, hot={"mod.C._lock"})
+    assert any(f.qualname == "mod.C.bad" for f in findings)
+
+
+# -------------------------------------------------------------- counters
+
+
+COUNTER_NO_LOCK = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.stats = {"writes": 0}
+
+    def good(self):
+        with self._lock:
+            self.hits += 1
+            self.stats["writes"] += 1
+
+    def bad(self):
+        self.hits += 1
+
+    def bad_dict(self):
+        self.stats["writes"] = self.stats["writes"] + 1
+"""
+
+
+def test_counter_mutation_requires_owning_lock():
+    counters = {"mod.C": {"hits": "mod.C._lock",
+                          "stats[*]": "mod.C._lock"}}
+    findings = run(COUNTER_NO_LOCK, counters=counters)
+    assert rules(findings) == ["counter-lock"]
+    assert {f.qualname for f in findings} == {"mod.C.bad", "mod.C.bad_dict"}
+
+
+COUNTER_IN_SUBCLASS = """
+import threading
+
+class Base:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+class Sub(Base):
+    def good(self):
+        with self._lock:
+            self.hits += 1
+
+    def bad(self):
+        self.hits += 1
+"""
+
+
+def test_counter_rule_follows_inheritance():
+    """vtpu.VtpuDevicePlugin mutates server.TpuDevicePlugin's counters
+    under the BASE class's locks: both the lock attr and the counter
+    config must resolve through the bases."""
+    counters = {"mod.Base": {"hits": "mod.Base._lock"}}
+    findings = run(COUNTER_IN_SUBCLASS, counters=counters)
+    assert [f.qualname for f in findings] == ["mod.Sub.bad"]
+
+
+COUNTER_VIA_PRIVATE_HELPER = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def _bump(self):
+        self.hits += 1      # only ever called under the lock
+
+    def entry(self):
+        with self._lock:
+            self._bump()
+"""
+
+
+def test_counter_in_helper_called_under_lock_is_clean():
+    counters = {"mod.C": {"hits": "mod.C._lock"}}
+    assert run(COUNTER_VIA_PRIVATE_HELPER, counters=counters) == []
+
+
+# ------------------------------------------------------------ fault sites
+
+
+FIRE_SITES = """
+from . import faults
+
+class C:
+    def good(self):
+        faults.fire("known.site")
+
+    def bad(self):
+        faults.fire("typo.site")
+"""
+
+
+def test_unregistered_fire_site_fires():
+    findings = run(FIRE_SITES, registered={"known.site"},
+                   documented={"known.site"})
+    assert rules(findings) == ["fault-site"]
+    assert any("typo.site" in f.message for f in findings)
+
+
+def test_undocumented_and_dead_sites_fire():
+    findings = run(FIRE_SITES, registered={"known.site", "dead.site"},
+                   documented=set())
+    details = {f.detail for f in findings}
+    assert "undocumented:known.site" in details
+    assert "dead:dead.site" in details
+
+
+# ---------------------------------------------------------------- threads
+
+
+THREAD_BAD = """
+import threading
+
+class C:
+    def spawn(self):
+        threading.Thread(target=self.run).start()
+
+    def run(self):
+        pass
+"""
+
+THREAD_GOOD = """
+import threading
+
+class C:
+    def __init__(self):
+        self._thread = None
+
+    def spawn(self):
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def run(self):
+        pass
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+"""
+
+
+def test_unjoined_undaemonized_thread_fires():
+    findings = run(THREAD_BAD)
+    assert rules(findings) == ["thread-lifecycle"]
+    details = {f.detail for f in findings}
+    assert details == {"not-daemon:Thread", "not-joined:Thread"}
+
+
+def test_tracked_daemon_joined_thread_is_clean():
+    assert run(THREAD_GOOD) == []
+
+
+TWO_THREADS_ONE_JOINED = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = None
+        self._b = None
+
+    def spawn(self):
+        self._a = threading.Thread(target=self.run, daemon=True)
+        self._a.start()
+        self._b = threading.Thread(target=self.run, daemon=True)
+        self._b.start()
+
+    def run(self):
+        pass
+
+    def stop(self):
+        if self._a is not None:
+            self._a.join(timeout=2)
+        if self._b is not None:   # read but NEVER joined
+            pass
+"""
+
+
+def test_join_evidence_is_per_attribute():
+    """A sibling thread's join must not vouch for an unjoined one: the
+    evidence is per attribute, not per class."""
+    findings = run(TWO_THREADS_ONE_JOINED)
+    assert [f.detail for f in findings] == ["not-joined:Thread"]
+    assert all("self._b" not in f.message for f in findings)
+
+
+THREAD_JOINED_VIA_SWAP = """
+import threading
+
+class C:
+    def __init__(self):
+        self._thread = None
+
+    def spawn(self):
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def run(self):
+        pass
+
+    def stop(self):
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2)
+"""
+
+
+def test_join_through_teardown_swap_alias_counts():
+    """healthhub.stop's `thread, self._thread = self._thread, None` form:
+    the local alias's join must credit the attribute."""
+    assert run(THREAD_JOINED_VIA_SWAP) == []
+
+
+NONALPHABETIC_CYCLE = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._c:
+                pass
+
+    def two(self):
+        with self._c:
+            with self._b:
+                pass
+
+    def three(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_cycle_rendered_in_actual_edge_order():
+    """Edges a->c, c->b, b->a: the arc must follow REAL edges (a->c->b->a),
+    not the sorted SCC (a->b->c->a names edges nobody takes), and the
+    finding must anchor to a real source line, not a <graph> fallback."""
+    findings = run(NONALPHABETIC_CYCLE)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.detail == "mod.C._a -> mod.C._c -> mod.C._b -> mod.C._a"
+    assert f.path == "mod.py" and f.line > 0 and f.qualname == "mod.C.one"
+
+
+TIMER_CANCELLED = """
+import threading
+
+class C:
+    def __init__(self):
+        self._timer = None
+
+    def arm(self):
+        t = threading.Timer(5.0, self.firefn)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def firefn(self):
+        pass
+
+    def stop(self):
+        if self._timer is not None:
+            self._timer.cancel()
+"""
+
+
+def test_timer_cancel_counts_as_reaping():
+    assert run(TIMER_CANCELLED) == []
+
+
+# --------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = run(LOCK_INVERSION)
+    assert findings
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, findings)
+    baseline = load_baseline(path)
+    assert len(baseline) == len(findings)
+    new, stale = diff_against_baseline(findings, baseline)
+    assert new == [] and stale == []
+    # the same defect reported from a shifted line is STILL baselined
+    shifted = run("\n\n\n" + LOCK_INVERSION)
+    new, stale = diff_against_baseline(shifted, baseline)
+    assert new == []
+    # a fixed defect shows up as stale debt, a fresh one as new
+    clean = LOCK_INVERSION.replace(
+        "with self._b:\n            with self._a:",
+        "with self._a:\n            with self._b:")
+    new, stale = diff_against_baseline(run(clean), baseline)
+    assert new == [] and len(stale) == 1
+    new, _ = diff_against_baseline(
+        run(BLOCKING_UNDER_HOT, hot={"mod.C._lock"}), baseline)
+    assert new
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "absent.json")) == {}
+
+
+# --------------------------------------------------- project-level inputs
+
+
+def test_registered_sites_parsed_from_faults_py():
+    with open(os.path.join(REPO, "tpu_device_plugin", "faults.py")) as f:
+        sites = registered_fault_sites(f.read())
+    assert "kubelet.register" in sites
+    assert "checkpoint.write" in sites
+
+
+def test_documented_sites_parsed_from_doc():
+    with open(os.path.join(REPO, "docs", "fault-injection.md")) as f:
+        sites = documented_fault_sites(f.read())
+    assert "dra.publish" in sites
+    assert "native.probe" in sites
+
+
+def test_project_tree_is_clean_against_baseline():
+    """The repo's own gate: scripts/lint_concurrency.py must exit 0 — any
+    new concurrency-lint finding in the package fails tier-1 right here,
+    not just in the CI lint job."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "lint_concurrency.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("flag", ["--list"])
+def test_cli_list_mode_runs(flag):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "lint_concurrency.py"), flag],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tsalint:" in proc.stdout

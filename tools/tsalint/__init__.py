@@ -1,0 +1,51 @@
+"""tsalint — project-specific concurrency lint for the threaded daemon.
+
+The daemon grew from a single-threaded loop into a genuinely concurrent
+system (shared HealthHub + bounded probe pool, group-committed checkpoint
+writer, per-claim-UID locks nested inside pool workers, debounce timers,
+~26 lock/thread sites across 8 modules). Generic linters check style;
+nothing checked the invariants that keep that concurrency correct. This
+package does, statically:
+
+  lock-order-cycle        the static lock-acquisition graph (nested
+                          ``with``/".acquire()" sites plus resolvable
+                          intra-class and cross-object calls made while a
+                          lock is held) must be acyclic
+  blocking-under-hot-lock no blocking call (file/socket I/O, sleeps,
+                          kube-apiserver requests) inside the designated
+                          hot locks: the server device-table lock, the DRA
+                          global lock, the checkpoint-writer condition
+  counter-lock            every /status and /metrics counter mutation must
+                          sit under its owning lock (ownership is declared
+                          in config.py)
+  fault-site              every ``faults.fire("site")`` call site must be
+                          registered in faults._SITE_CATEGORY AND
+                          documented in docs/fault-injection.md; registered
+                          sites with no production call site are dead and
+                          fail too
+  thread-lifecycle        every ``threading.Thread(``/``Timer(`` must be
+                          daemonized AND be joinable on a stop() path
+                          (tracked on an attribute that a stop-like method
+                          joins with a timeout, or cancels for a Timer)
+
+Findings are pinned in a checked-in baseline (baseline.json) so
+pre-existing debt is frozen and only NEW violations fail CI. The runtime
+side of the same contract is tpu_device_plugin/lockdep.py
+($TDP_LOCKDEP=1). See docs/static-analysis.md.
+"""
+
+from .analyzer import Analyzer, Finding, analyze_paths, analyze_sources
+from .baseline import diff_against_baseline, load_baseline, save_baseline
+from .config import LintConfig, project_config
+
+__all__ = [
+    "Analyzer",
+    "Finding",
+    "LintConfig",
+    "analyze_paths",
+    "analyze_sources",
+    "diff_against_baseline",
+    "load_baseline",
+    "save_baseline",
+    "project_config",
+]

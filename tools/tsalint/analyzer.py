@@ -1,0 +1,889 @@
+"""The tsalint AST engine.
+
+One pass collects per-module structure (classes, their lock attributes,
+resolvable attribute types, methods); a second pass walks every function
+with a precise lexical held-lock stack, recording acquisition events,
+calls, counter mutations, blocking calls, fault-point consultations and
+thread constructions. Interprocedural facts (locks a callee acquires,
+blocking calls it makes, locks guaranteed held at a callee's entry) come
+from small fixpoints over the resolvable call graph: ``self.m()`` in the
+same class (or a base), ``self.attr.m()`` where ``self.attr = Class(...)``
+was seen, bare module-level functions, and ``Class(...)`` constructions
+(treated as calls to ``__init__``).
+
+The engine is deliberately conservative where Python defeats static
+analysis — callbacks, parameters of unknown type, dynamically-built
+receivers resolve to nothing rather than to guesses. The runtime half of
+the contract (tpu_device_plugin/lockdep.py) covers what this pass cannot
+see; the two report the same lock names.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# the ONE cycle-detection implementation, shared with the runtime half
+# (lockdep is stdlib-only and the package __init__ is import-light, so the
+# lint environment needs no runtime dependencies for this)
+from tpu_device_plugin.lockdep import find_cycles
+
+from .config import LintConfig
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+THREAD_FACTORIES = {"Thread", "Timer"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    qualname: str
+    line: int
+    message: str
+    detail: str   # stable (line-free) discriminator for the baseline key
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.qualname}|{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.qualname}: "
+                f"{self.message}")
+
+
+def _render(node: ast.AST) -> Optional[str]:
+    """Dotted rendering of a name chain; None when not a plain chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _render(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Subscript):
+        base = _render(node.value)
+        return f"{base}[*]" if base else None
+    if isinstance(node, ast.Call):
+        return _render(node.func)
+    return None
+
+
+def _unwrap_instrument(call: ast.Call) -> ast.expr:
+    """lockdep.instrument("name", <lock factory>) -> the factory expr."""
+    name = _render(call.func) or ""
+    if name.endswith("instrument") and len(call.args) >= 2:
+        return call.args[1]
+    return call
+
+
+def _lock_kind(value: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when `value` constructs one (directly or
+    wrapped in lockdep.instrument), else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    inner = _unwrap_instrument(value)
+    if not isinstance(inner, ast.Call):
+        return None
+    name = _render(inner.func) or ""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in LOCK_FACTORIES and (name == leaf
+                                   or name.startswith("threading.")):
+        return leaf
+    return None
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: List[str] = field(default_factory=list)   # rendered base names
+    lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr -> node
+    lock_kinds: Dict[str, str] = field(default_factory=dict)   # node -> kind
+    attr_types: Dict[str, str] = field(default_factory=dict)   # attr -> qual
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.module}.{self.name}"
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    tree: ast.AST
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    module_locks: Dict[str, str] = field(default_factory=dict)  # var -> node
+    lock_kinds: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, ast.AST] = field(default_factory=dict)
+    imported: Dict[str, str] = field(default_factory=dict)  # local -> simple
+
+
+@dataclass
+class _ThreadSite:
+    factory: str                  # "Thread" | "Timer"
+    qualname: str
+    path: str
+    line: int
+    daemon: bool = False
+    self_attr: Optional[str] = None   # "self.X" it ends up stored on
+    anonymous: bool = True
+
+
+@dataclass
+class _FuncFacts:
+    """Per-function events recorded by the lexical walk."""
+    qualname: str
+    path: str
+    # (held-lock tuple, acquired node, line)
+    acquires: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (held-lock tuple, callee id, line); callee id = "module.Class.meth"
+    calls: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (held-lock tuple, rendered blocking call, line)
+    blocking: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (held-lock tuple, counter attr form, line)
+    counters: List[Tuple[Tuple[str, ...], str, int]] = field(
+        default_factory=list)
+    # (site literal or None, line)
+    fire_sites: List[Tuple[Optional[str], int]] = field(default_factory=list)
+    threads: List[_ThreadSite] = field(default_factory=list)
+    # stop-path evidence: join/cancel targets ("self.<attr>" once local
+    # aliases resolve) seen in this function; join carries has-timeout
+    join_calls: List[Tuple[str, bool]] = field(default_factory=list)
+    cancel_calls: List[str] = field(default_factory=list)
+
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Lexical walk of ONE function body with a held-lock stack."""
+
+    def __init__(self, analyzer: "Analyzer", module: ModuleInfo,
+                 cls: Optional[ClassInfo], qualname: str,
+                 func: ast.AST) -> None:
+        self.a = analyzer
+        self.module = module
+        self.cls = cls
+        self.facts = _FuncFacts(qualname=qualname, path=module.path)
+        self.held: List[str] = []
+        self.aliases: Dict[str, str] = {}   # local name -> "self.<attr>"
+        self.self_name: Optional[str] = None
+        args = getattr(func, "args", None)
+        if cls is not None and args is not None and args.args:
+            self.self_name = args.args[0].arg
+        self._func = func
+
+    # ------------------------------------------------------------ resolve
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        """'X' when node is self.X (or an alias of it)."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == self.self_name:
+            return node.attr
+        if isinstance(node, ast.Name):
+            target = self.aliases.get(node.id)
+            if target is not None:
+                return target
+        return None
+
+    def _lock_node(self, node: ast.AST) -> Optional[str]:
+        attr = self._self_attr(node)
+        if attr is not None and self.cls is not None:
+            found = self.a.class_lock(self.cls, attr)
+            if found is not None:
+                return found
+        name = _render(node)
+        if name is not None and name in self.module.module_locks:
+            return self.module.module_locks[name]
+        # fallback: X.attr on a non-self receiver, when the attr name
+        # uniquely identifies one lock across all scanned classes
+        if isinstance(node, ast.Attribute):
+            return self.a.unique_lock_attr(node.attr)
+        return None
+
+    def _callee(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            recv_attr = self._self_attr(func.value)
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == self.self_name and self.cls is not None:
+                target = self.a.resolve_method(self.cls, func.attr)
+                if target is not None:
+                    return target
+            if recv_attr is not None and self.cls is not None:
+                recv_qual = self.a.class_attr_type(self.cls, recv_attr)
+                if recv_qual is not None:
+                    target_cls = self.a.class_by_qual(recv_qual)
+                    if target_cls is not None:
+                        return self.a.resolve_method(target_cls, func.attr)
+        elif isinstance(func, ast.Name):
+            if func.id in self.module.functions:
+                return f"{self.module.name}.{func.id}"
+            simple = self.module.imported.get(func.id, func.id)
+            cls = self.a.class_by_simple(simple)
+            if cls is not None:   # Class(...) construction -> __init__
+                return self.a.resolve_method(cls, "__init__")
+        return None
+
+    # -------------------------------------------------------------- visits
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lock = self._lock_node(item.context_expr)
+            if lock is not None:
+                self.facts.acquires.append(
+                    (tuple(self.held), lock, node.lineno))
+                self.held.append(lock)
+                pushed += 1
+            else:
+                # the context manager expression itself may call things
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # local alias tracking: name = self.attr — including the
+        # teardown-swap form `name, self.attr = self.attr, None`
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Attribute) and \
+                isinstance(node.value.value, ast.Name) and \
+                node.value.value.id == self.self_name:
+            self.aliases[node.targets[0].id] = node.value.attr
+        if len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Tuple) and \
+                isinstance(node.value, ast.Tuple) and \
+                len(node.targets[0].elts) == len(node.value.elts):
+            for tgt, val in zip(node.targets[0].elts, node.value.elts):
+                if isinstance(tgt, ast.Name) \
+                        and isinstance(val, ast.Attribute) \
+                        and isinstance(val.value, ast.Name) \
+                        and val.value.id == self.self_name:
+                    self.aliases[tgt.id] = val.attr
+        for target in node.targets:
+            self._note_counter_write(target, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note_counter_write(node.target, None, node.lineno,
+                                 always=True)
+        self.generic_visit(node)
+
+    def _counter_form(self, target: ast.AST) -> Optional[str]:
+        """'attr' / 'attr[*]' when target mutates a self-owned (or aliased)
+        name; module-level Name targets render as-is."""
+        sub = isinstance(target, ast.Subscript)
+        base = target.value if sub else target
+        attr = self._self_attr(base)
+        if attr is None and isinstance(base, ast.Name) and self.cls is None:
+            attr = base.id
+        if attr is None:
+            return None
+        return f"{attr}[*]" if sub else attr
+
+    def _note_counter_write(self, target: ast.AST, value: Optional[ast.AST],
+                            line: int, always: bool = False) -> None:
+        form = self._counter_form(target)
+        if form is None:
+            return
+        if not always:
+            # plain Assign only counts as a counter mutation when it is a
+            # read-modify-write (the value mentions the same name) — plain
+            # (re)initialization is construction, not counting
+            names = {n for n in (
+                self._counter_form(v) if isinstance(
+                    v, (ast.Attribute, ast.Name, ast.Subscript)) else None
+                for v in ast.walk(value)) if n} if value is not None else set()
+            base = form.split("[", 1)[0]
+            if not any(n.split("[", 1)[0] == base for n in names):
+                return
+        self.facts.counters.append((tuple(self.held), form, line))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        rendered = _render(node.func) or ""
+        leaf = rendered.rsplit(".", 1)[-1]
+
+        # threading.Thread( / threading.Timer(
+        if leaf in THREAD_FACTORIES and (
+                rendered.startswith("threading.") or rendered == leaf):
+            if rendered.startswith("threading.") or \
+                    self.module.imported.get(leaf) == leaf:
+                self._note_thread(node, leaf)
+
+        # faults.fire("site")
+        if leaf == "fire" and (rendered == "faults.fire"
+                               or rendered.endswith(".fire")
+                               and rendered.startswith("faults")):
+            site = None
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                site = node.args[0].value
+            self.facts.fire_sites.append((site, node.lineno))
+
+        # lock.acquire() on a known lock: an acquisition event (we cannot
+        # reliably pair the release, so the held stack is not pushed)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            lock = self._lock_node(node.func.value)
+            if lock is not None:
+                self.facts.acquires.append(
+                    (tuple(self.held), lock, node.lineno))
+
+        # join()/cancel() evidence for the thread-lifecycle rule
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("join", "cancel"):
+            target = _render(node.func.value) or ""
+            attr = self._self_attr(node.func.value)
+            if attr is not None:
+                target = f"self.{attr}"
+            if node.func.attr == "join":
+                has_timeout = bool(node.args) or any(
+                    kw.arg == "timeout" for kw in node.keywords)
+                self.facts.join_calls.append((target, has_timeout))
+            else:
+                self.facts.cancel_calls.append(target)
+
+        # blocking calls
+        if self.a.is_blocking_name(rendered):
+            self.facts.blocking.append(
+                (tuple(self.held), rendered, node.lineno))
+
+        # resolvable callees (propagation)
+        callee = self._callee(node)
+        if callee is not None:
+            self.facts.calls.append((tuple(self.held), callee, node.lineno))
+
+        self.generic_visit(node)
+
+    def _note_thread(self, node: ast.Call, factory: str) -> None:
+        site = _ThreadSite(factory=factory, qualname=self.facts.qualname,
+                           path=self.module.path, line=node.lineno)
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                site.daemon = bool(kw.value.value)
+        self.facts.threads.append(site)
+
+    # nested defs run later on other stacks: analyze separately, not inline
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.a.queue_nested(self.module, self.cls,
+                            f"{self.facts.qualname}.{node.name}", node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass   # opaque: runs later, elsewhere
+
+    def run(self) -> _FuncFacts:
+        for stmt in getattr(self._func, "body", []):
+            self.visit(stmt)
+        self._finish_threads()
+        return self.facts
+
+    def _finish_threads(self) -> None:
+        """Post-pass over the raw statements to resolve what each thread
+        construction was assigned to and whether `.daemon = True` follows."""
+        if not self.facts.threads:
+            return
+        assigns: List[Tuple[str, int]] = []    # (target render, line)
+        daemon_sets: List[str] = []            # target renders
+        for stmt in ast.walk(self._func):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(stmt.value, ast.Call):
+                    name = _render(stmt.value.func) or ""
+                    if name.rsplit(".", 1)[-1] in THREAD_FACTORIES:
+                        rendered = self._assign_target(tgt)
+                        if rendered:
+                            assigns.append((rendered, stmt.lineno))
+                elif isinstance(stmt.value, ast.Name):
+                    # self.X = t  (local handed to an attribute)
+                    rendered = self._assign_target(tgt)
+                    if rendered and rendered.startswith("self."):
+                        src = stmt.value.id
+                        assigns.append((f"{src}->{rendered}", stmt.lineno))
+                if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon" \
+                        and isinstance(stmt.value, ast.Constant) \
+                        and stmt.value.value:
+                    base = _render(tgt.value)
+                    if base:
+                        daemon_sets.append(base)
+        for site in self.facts.threads:
+            direct = [a for a, line in assigns if line == site.line]
+            if direct:
+                target = direct[0]
+                site.anonymous = False
+                if target.startswith("self."):
+                    site.self_attr = target[5:]
+                else:
+                    # a local: daemonized via local.daemon = True?
+                    if target in daemon_sets:
+                        site.daemon = True
+                    # handed on to self.X later?
+                    for a, _line in assigns:
+                        if a.startswith(f"{target}->self."):
+                            site.self_attr = a.split("->self.", 1)[1]
+            elif site.daemon:
+                site.anonymous = True
+
+    def _assign_target(self, tgt: ast.AST) -> Optional[str]:
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and \
+                tgt.value.id == self.self_name:
+            return f"self.{tgt.attr}"
+        return None
+
+
+class Analyzer:
+    """Whole-program pass over a set of modules (see module docstring)."""
+
+    def __init__(self, config: LintConfig) -> None:
+        self.config = config
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.facts: Dict[str, _FuncFacts] = {}      # qualname -> facts
+        self.func_class: Dict[str, Optional[ClassInfo]] = {}
+        self._nested: List[Tuple[ModuleInfo, Optional[ClassInfo],
+                                 str, ast.AST]] = []
+        self._lock_attr_index: Dict[str, Set[str]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+
+    # ----------------------------------------------------------- structure
+
+    def add_source(self, path: str, source: str) -> None:
+        name = path.rsplit("/", 1)[-1].removesuffix(".py")
+        tree = ast.parse(source, filename=path)
+        mod = ModuleInfo(name=name, path=path, tree=tree)
+        for node in tree.body:
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    mod.imported[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imported[alias.asname or alias.name] = alias.name
+            elif isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if kind is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            lock = f"{name}.{tgt.id}"
+                            mod.module_locks[tgt.id] = lock
+                            mod.lock_kinds[lock] = kind
+                            self.lock_kinds[lock] = kind
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                cls = ClassInfo(module=name, name=node.name,
+                                bases=[b for b in map(_render, node.bases)
+                                       if b])
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        cls.methods[item.name] = item
+                        self._collect_attrs(cls, item)
+                mod.classes[node.name] = cls
+        self.modules[name] = mod
+
+    def _collect_attrs(self, cls: ClassInfo, func: ast.AST) -> None:
+        args = getattr(func, "args", None)
+        self_name = args.args[0].arg if args and args.args else "self"
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == self_name):
+                    continue
+                kind = _lock_kind(node.value)
+                if kind is not None:
+                    lock = f"{cls.qual}.{tgt.attr}"
+                    cls.lock_attrs[tgt.attr] = lock
+                    cls.lock_kinds[lock] = kind
+                    self.lock_kinds[lock] = kind
+                    self._lock_attr_index.setdefault(tgt.attr, set()).add(lock)
+                elif isinstance(node.value, ast.Call):
+                    ctor = _render(node.value.func)
+                    if ctor and "." not in ctor:
+                        cls.attr_types.setdefault(tgt.attr, ctor)
+
+    # ------------------------------------------------------------- lookups
+
+    def class_by_simple(self, simple: str) -> Optional[ClassInfo]:
+        for mod in self.modules.values():
+            if simple in mod.classes:
+                return mod.classes[simple]
+        return None
+
+    def class_by_qual(self, qual: str) -> Optional[ClassInfo]:
+        mod_name, _, cls_name = qual.rpartition(".")
+        mod = self.modules.get(mod_name)
+        if mod is not None and cls_name in mod.classes:
+            return mod.classes[cls_name]
+        return self.class_by_simple(cls_name)
+
+    def _mro(self, cls: ClassInfo) -> List[ClassInfo]:
+        out, seen, queue = [], set(), [cls]
+        while queue:
+            c = queue.pop(0)
+            if c.qual in seen:
+                continue
+            seen.add(c.qual)
+            out.append(c)
+            for base in c.bases:
+                resolved = self.class_by_simple(base.rsplit(".", 1)[-1])
+                if resolved is not None:
+                    queue.append(resolved)
+        return out
+
+    def class_lock(self, cls: ClassInfo, attr: str) -> Optional[str]:
+        for c in self._mro(cls):
+            if attr in c.lock_attrs:
+                return c.lock_attrs[attr]
+        return None
+
+    def class_attr_type(self, cls: ClassInfo, attr: str) -> Optional[str]:
+        for c in self._mro(cls):
+            if attr in c.attr_types:
+                target = self.class_by_simple(c.attr_types[attr])
+                if target is not None:
+                    return target.qual
+        return None
+
+    def resolve_method(self, cls: ClassInfo, meth: str) -> Optional[str]:
+        for c in self._mro(cls):
+            if meth in c.methods:
+                return f"{c.qual}.{meth}"
+        return None
+
+    def unique_lock_attr(self, attr: str) -> Optional[str]:
+        locks = self._lock_attr_index.get(attr, set())
+        return next(iter(locks)) if len(locks) == 1 else None
+
+    def counter_owner(self, cls: Optional[ClassInfo], module: ModuleInfo,
+                      form: str) -> Optional[str]:
+        """Owning lock configured for counter `form`, or None."""
+        scopes = ([c.qual for c in self._mro(cls)] if cls is not None
+                  else [module.name])
+        for scope in scopes:
+            table = self.config.counters.get(scope)
+            if table and form in table:
+                return table[form]
+        return None
+
+    def is_blocking_name(self, rendered: str) -> bool:
+        if not rendered:
+            return False
+        if rendered in self.config.blocking_calls:
+            return True
+        leaf = rendered.rsplit(".", 1)[-1]
+        if leaf in self.config.blocking_methods:
+            return True
+        # suffix match: cfg-rooted aliases like "os.path.join" stay distinct
+        return any(rendered.endswith("." + b) if "." in b else False
+                   for b in self.config.blocking_calls)
+
+    # ------------------------------------------------------------- walking
+
+    def queue_nested(self, module: ModuleInfo, cls: Optional[ClassInfo],
+                     qualname: str, func: ast.AST) -> None:
+        self._nested.append((module, cls, qualname, func))
+
+    def _walk_all(self) -> None:
+        for mod in self.modules.values():
+            for fname, func in mod.functions.items():
+                self._walk_one(mod, None, f"{mod.name}.{fname}", func)
+            for cls in mod.classes.values():
+                for mname, meth in cls.methods.items():
+                    self._walk_one(mod, cls, f"{cls.qual}.{mname}", meth)
+        while self._nested:
+            mod, cls, qualname, func = self._nested.pop()
+            self._walk_one(mod, cls, qualname, func)
+
+    def _walk_one(self, module: ModuleInfo, cls: Optional[ClassInfo],
+                  qualname: str, func: ast.AST) -> None:
+        walker = _FunctionWalker(self, module, cls, qualname, func)
+        self.facts[qualname] = walker.run()
+        self.func_class[qualname] = cls
+
+    # ------------------------------------------------------------ fixpoints
+
+    def _method_closure(self) -> Tuple[Dict[str, Set[str]],
+                                       Dict[str, Set[Tuple[str, int]]]]:
+        """(locks each function may acquire, blocking calls it may make),
+        transitively over resolvable callees."""
+        locks: Dict[str, Set[str]] = {}
+        blocking: Dict[str, Set[Tuple[str, int]]] = {}
+        for qual, facts in self.facts.items():
+            locks[qual] = {node for _, node, _line in facts.acquires}
+            blocking[qual] = {(name, line)
+                              for _, name, line in facts.blocking}
+        changed = True
+        while changed:
+            changed = False
+            for qual, facts in self.facts.items():
+                for _, callee, _line in facts.calls:
+                    extra = locks.get(callee)
+                    if extra and not extra <= locks[qual]:
+                        locks[qual] |= extra
+                        changed = True
+                    extra_b = blocking.get(callee)
+                    if extra_b and not extra_b <= blocking[qual]:
+                        blocking[qual] |= extra_b
+                        changed = True
+        return locks, blocking
+
+    def _entry_contexts(self) -> Dict[str, Set[str]]:
+        """Locks guaranteed held whenever a function is entered: the
+        intersection over all resolved call sites (entry points: none)."""
+        TOP = {"<top>"}
+        called: Set[str] = set()
+        for facts in self.facts.values():
+            called |= {c for _, c, _ in facts.calls}
+        ctx: Dict[str, Set[str]] = {
+            q: (set(self.lock_kinds) | TOP if q in called else set())
+            for q in self.facts}
+        changed = True
+        while changed:
+            changed = False
+            for qual, facts in self.facts.items():
+                caller_ctx = ctx.get(qual, set()) - TOP
+                for held, callee, _line in facts.calls:
+                    if callee not in ctx:
+                        continue
+                    incoming = set(held) | caller_ctx
+                    new = ctx[callee] & incoming if TOP not in ctx[callee] \
+                        else incoming
+                    if new != ctx[callee]:
+                        ctx[callee] = new
+                        changed = True
+        return {q: s - TOP for q, s in ctx.items()}
+
+    # --------------------------------------------------------------- rules
+
+    def analyze(self) -> List[Finding]:
+        self._walk_all()
+        findings: List[Finding] = []
+        trans_locks, trans_blocking = self._method_closure()
+        entry_ctx = self._entry_contexts()
+        findings += self._rule_lock_order(trans_locks)
+        findings += self._rule_blocking(trans_blocking, entry_ctx)
+        findings += self._rule_counters(entry_ctx)
+        findings += self._rule_fault_sites()
+        findings += self._rule_threads()
+        order = {r: i for i, r in enumerate((
+            "lock-order-cycle", "blocking-under-hot-lock", "counter-lock",
+            "fault-site", "thread-lifecycle"))}
+        findings.sort(key=lambda f: (order.get(f.rule, 99), f.path, f.line))
+        return findings
+
+    def _rule_lock_order(self, trans_locks: Dict[str, Set[str]]
+                         ) -> List[Finding]:
+        # edge -> exemplar (path, qualname, line)
+        edges: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+
+        def add(a: str, b: str, where: Tuple[str, str, int]) -> None:
+            if a == b:
+                # reentrant re-entry is legal on RLock/Condition-of-RLock;
+                # a plain Lock self-edge is an immediate deadlock
+                if self.lock_kinds.get(a) == "Lock":
+                    edges.setdefault((a, b), where)
+                return
+            edges.setdefault((a, b), where)
+
+        for qual, facts in self.facts.items():
+            for held, node, line in facts.acquires:
+                for h in held:
+                    add(h, node, (facts.path, qual, line))
+            for held, callee, line in facts.calls:
+                if not held:
+                    continue
+                for target in trans_locks.get(callee, ()):
+                    for h in held:
+                        add(h, target, (facts.path, qual, line))
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        findings: List[Finding] = []
+        for cycle in find_cycles(graph):
+            # find_cycles returns nodes in actual edge order, so every
+            # consecutive pair (and the closing arc) has an exemplar site
+            arc = " -> ".join(cycle + [cycle[0]])
+            path, qual, line = edges[(cycle[0], cycle[1 % len(cycle)])]
+            findings.append(Finding(
+                rule="lock-order-cycle", path=path, qualname=qual, line=line,
+                message=f"potential lock-order cycle: {arc}",
+                detail=arc))
+        return findings
+
+    def _rule_blocking(self, trans_blocking: Dict[str, Set[Tuple[str, int]]],
+                       entry_ctx: Dict[str, Set[str]]) -> List[Finding]:
+        findings = []
+        hot = self.config.hot_locks
+        for qual, facts in self.facts.items():
+            ctx = entry_ctx.get(qual, set())
+            for held, name, line in facts.blocking:
+                for lock in (set(held) | ctx) & hot:
+                    findings.append(Finding(
+                        rule="blocking-under-hot-lock", path=facts.path,
+                        qualname=qual, line=line,
+                        message=f"blocking call {name}() inside hot lock "
+                                f"{lock}",
+                        detail=f"{name}@{lock}"))
+            for held, callee, line in facts.calls:
+                hot_held = (set(held) | ctx) & hot
+                if not hot_held:
+                    continue
+                for name, _bline in sorted(trans_blocking.get(callee, ())):
+                    for lock in hot_held:
+                        findings.append(Finding(
+                            rule="blocking-under-hot-lock", path=facts.path,
+                            qualname=qual, line=line,
+                            message=f"call to {callee}() while holding hot "
+                                    f"lock {lock} reaches blocking "
+                                    f"{name}()",
+                            detail=f"{callee}:{name}@{lock}"))
+        return findings
+
+    def _rule_counters(self, entry_ctx: Dict[str, Set[str]]
+                       ) -> List[Finding]:
+        findings = []
+        for qual, facts in self.facts.items():
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf == "__init__" or leaf == "<module>":
+                continue
+            cls = self.func_class.get(qual)
+            mod = self.modules[facts.path.rsplit("/", 1)[-1]
+                               .removesuffix(".py")]
+            ctx = entry_ctx.get(qual, set())
+            for held, form, line in facts.counters:
+                owner = self.counter_owner(cls, mod, form)
+                if owner is None:
+                    continue
+                if owner not in set(held) | ctx:
+                    findings.append(Finding(
+                        rule="counter-lock", path=facts.path, qualname=qual,
+                        line=line,
+                        message=f"counter {form} mutated without its owning "
+                                f"lock {owner}",
+                        detail=f"{form}@{owner}"))
+        return findings
+
+    def _rule_fault_sites(self) -> List[Finding]:
+        if self.config.registered_sites is None:
+            return []
+        registered = self.config.registered_sites
+        documented = self.config.documented_sites or set()
+        findings = []
+        seen: Dict[str, Tuple[str, str, int]] = {}
+        for qual, facts in self.facts.items():
+            if facts.path.rsplit("/", 1)[-1] == "faults.py":
+                continue   # the registry itself
+            for site, line in facts.fire_sites:
+                if site is None:
+                    findings.append(Finding(
+                        rule="fault-site", path=facts.path, qualname=qual,
+                        line=line,
+                        message="faults.fire() with a non-literal site "
+                                "cannot be checked against the registry",
+                        detail="<dynamic>"))
+                    continue
+                seen.setdefault(site, (facts.path, qual, line))
+                if site not in registered:
+                    findings.append(Finding(
+                        rule="fault-site", path=facts.path, qualname=qual,
+                        line=line,
+                        message=f"fault site {site!r} is not registered in "
+                                f"faults._SITE_CATEGORY",
+                        detail=f"unregistered:{site}"))
+                elif site not in documented:
+                    findings.append(Finding(
+                        rule="fault-site", path=facts.path, qualname=qual,
+                        line=line,
+                        message=f"fault site {site!r} is not documented in "
+                                f"docs/fault-injection.md",
+                        detail=f"undocumented:{site}"))
+        for site in sorted(registered - set(seen)):
+            findings.append(Finding(
+                rule="fault-site", path="faults.py", qualname="faults",
+                line=0,
+                message=f"registered fault site {site!r} has no production "
+                        f"fire() call site (dead site)",
+                detail=f"dead:{site}"))
+        return findings
+
+    def _rule_threads(self) -> List[Finding]:
+        findings = []
+        # per-class, PER-ATTRIBUTE stop evidence: which self attrs a
+        # stop-like method joins (with a timeout) or cancels — local
+        # aliases (`thread = self._thread` and the teardown swap
+        # `thread, self._thread = self._thread, None`) resolve through
+        # the walker's alias map, so `thread.join(timeout=2)` counts for
+        # self._thread. Class-wide booleans would let an unjoined thread
+        # ride on a sibling's join.
+        joined_attrs: Dict[str, Set[str]] = {}
+        cancelled_attrs: Dict[str, Set[str]] = {}
+        for qual, facts in self.facts.items():
+            cls = self.func_class.get(qual)
+            if cls is None:
+                continue
+            leaf = qual.rsplit(".", 1)[-1]
+            if leaf not in self.config.stop_methods:
+                continue
+            for target, has_timeout in facts.join_calls:
+                if target.startswith("self.") and has_timeout:
+                    joined_attrs.setdefault(cls.qual, set()).add(target[5:])
+            for target in facts.cancel_calls:
+                if target.startswith("self."):
+                    cancelled_attrs.setdefault(cls.qual, set()).add(
+                        target[5:])
+        for qual, facts in self.facts.items():
+            cls = self.func_class.get(qual)
+            for site in facts.threads:
+                if not site.daemon:
+                    findings.append(Finding(
+                        rule="thread-lifecycle", path=site.path,
+                        qualname=site.qualname, line=site.line,
+                        message=f"threading.{site.factory} is not "
+                                f"daemonized (daemon=True or "
+                                f".daemon = True before start)",
+                        detail=f"not-daemon:{site.factory}"))
+                joined = joined_attrs.get(cls.qual if cls else "", set())
+                cancelled = cancelled_attrs.get(cls.qual if cls else "",
+                                                set())
+                reaped = site.self_attr is not None and (
+                    site.self_attr in joined
+                    or (site.factory == "Timer"
+                        and site.self_attr in cancelled))
+                if not reaped:
+                    what = ("joined (with a timeout)"
+                            if site.factory == "Thread"
+                            else "joined or cancelled")
+                    findings.append(Finding(
+                        rule="thread-lifecycle", path=site.path,
+                        qualname=site.qualname, line=site.line,
+                        message=f"threading.{site.factory} is not tracked "
+                                f"on an attribute that a stop() path "
+                                f"{what}",
+                        detail=f"not-joined:{site.factory}"))
+        return findings
+
+
+def analyze_sources(sources: Sequence[Tuple[str, str]],
+                    config: LintConfig) -> List[Finding]:
+    analyzer = Analyzer(config)
+    for path, text in sources:
+        analyzer.add_source(path, text)
+    return analyzer.analyze()
+
+
+def analyze_paths(paths: Sequence[str], config: LintConfig) -> List[Finding]:
+    sources = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            sources.append((path.replace("\\", "/"), f.read()))
+    return analyze_sources(sources, config)

@@ -1,0 +1,53 @@
+"""Baseline handling: freeze pre-existing debt, fail only NEW findings.
+
+Keys are line-free (`rule|path|qualname|detail`) so unrelated edits that
+shift line numbers never thaw or spuriously match an entry. The baseline
+is checked in; `--update-baseline` is the only way it changes, which makes
+every new entry reviewable in the diff.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from .analyzer import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """{finding key: human message} — empty when the file is absent."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unrecognized baseline format")
+    entries = data.get("findings")
+    if not isinstance(entries, dict):
+        raise ValueError(f"{path}: baseline 'findings' must be an object")
+    return {str(k): str(v) for k, v in entries.items()}
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": {f.key: f.message for f in
+                     sorted(findings, key=lambda f: f.key)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_against_baseline(
+        findings: Sequence[Finding],
+        baseline: Dict[str, str]) -> Tuple[List[Finding], List[str]]:
+    """(new findings not in the baseline, stale baseline keys that no
+    longer fire — resolved debt worth deleting from the file)."""
+    current = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = sorted(k for k in baseline if k not in current)
+    return new, stale

@@ -1,0 +1,172 @@
+"""Project rule configuration for tsalint.
+
+Everything the analyzer needs to know about THIS codebase lives here, so
+the engine (analyzer.py) stays generic and unit-testable with synthetic
+configs (tests/test_tsalint.py builds its own LintConfig for fixtures).
+
+Lock node naming: ``<module>.<Class>.<attr>`` for instance locks,
+``<module>.<name>`` for module-level locks — the same names modules pass
+to ``lockdep.instrument``, so a static finding and a runtime report point
+at the same lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Optional, Set
+
+
+@dataclass
+class LintConfig:
+    # Lock nodes whose critical sections must never contain blocking calls.
+    hot_locks: FrozenSet[str] = frozenset()
+    # class qualname ("module.Class") -> {counter attr: owning lock node}.
+    # A counter attr of the form "name[*]" matches subscript mutations of
+    # self.name (dict-backed counter groups).
+    counters: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # Dotted call names considered blocking (suffix-matched on the rendered
+    # call target, e.g. "os.listdir"), plus bare method names considered
+    # blocking on ANY receiver (apiserver round-trips).
+    blocking_calls: FrozenSet[str] = frozenset()
+    blocking_methods: FrozenSet[str] = frozenset()
+    # fault-site rule inputs; None disables the rule (fixture runs).
+    registered_sites: Optional[Set[str]] = None
+    documented_sites: Optional[Set[str]] = None
+    # stop-like method names a thread/timer must be joined/cancelled from
+    stop_methods: FrozenSet[str] = frozenset(
+        {"stop", "close", "shutdown", "_teardown", "stop_serving"})
+
+
+# Blocking-call vocabulary: calls that can sleep, touch disk, or cross the
+# network. Deliberately NOT including os.path.* stat probes or condition
+# waits (cond.wait releases the lock; stat probes are bounded and some are
+# load-bearing inside small locks by design, e.g. LiveAttrReader).
+BLOCKING_CALLS = frozenset({
+    "open", "io.open",
+    "os.listdir", "os.scandir", "os.walk",
+    "os.open", "os.read", "os.write", "os.pread", "os.pwrite",
+    "os.unlink", "os.remove", "os.replace", "os.rename",
+    "os.makedirs", "os.rmdir", "os.fsync",
+    "time.sleep",
+    "shutil.rmtree", "shutil.copyfile",
+    "subprocess.run", "subprocess.Popen", "subprocess.check_output",
+    "socket.socket", "socket.create_connection",
+    "select.select",
+    "json.dump", "json.load",
+})
+# method names that are blocking whatever the receiver: the stdlib
+# ApiClient verbs (network), urllib, grpc dial helpers, file writers
+BLOCKING_METHODS = frozenset({
+    "get_json", "put_json", "post_json", "request", "urlopen",
+    "channel_ready_future", "_atomic_write_json", "_save_checkpoint",
+})
+
+# The hot set, exactly the three the correctness argument leans on:
+# - the plugin server's device-table condition (every RPC and every health
+#   transition serializes on it; ListAndWatch latency rides it),
+# - the DRA driver's global inventory/checkpoint-map lock (claim prepares,
+#   slice builds and rediscovery swaps all contend on it),
+# - the group-commit checkpoint condition (every claim's ACK latency is a
+#   function of what happens under it).
+HOT_LOCKS = frozenset({
+    "server.TpuDevicePlugin._cond",
+    "dra.DraDriver._lock",
+    "dra.DraDriver._ckpt_cond",
+})
+
+# /status + /metrics counter ownership. Key classes by "module.Class";
+# "name[*]" covers dict-backed counter groups (stats["k"] += 1).
+COUNTERS: Dict[str, Dict[str, str]] = {
+    "server.TpuDevicePlugin": {
+        "_version": "server.TpuDevicePlugin._cond",
+        "_alloc_count": "server.TpuDevicePlugin._cond",
+        "_lw_resends": "server.TpuDevicePlugin._cond",
+        "_pref_hits": "server.TpuDevicePlugin._pref_lock",
+        "_pref_misses": "server.TpuDevicePlugin._pref_lock",
+        "_restart_count": "server.TpuDevicePlugin._lifecycle_lock",
+    },
+    "healthhub.HealthHub": {
+        "_probe_cycles": "healthhub.HealthHub._lock",
+        "_probes_last_cycle": "healthhub.HealthHub._lock",
+        "_probes_deduped_last_cycle": "healthhub.HealthHub._lock",
+        "_probe_timeouts": "healthhub.HealthHub._lock",
+        "_probe_errors": "healthhub.HealthHub._lock",
+        "_existence_scans": "healthhub.HealthHub._lock",
+    },
+    "dra.DraDriver": {
+        "publish_stats[*]": "dra.DraDriver._publish_lock",
+        "checkpoint_stats_counters[*]": "dra.DraDriver._ckpt_cond",
+        "_prepare_inflight": "dra.DraDriver._ckpt_cond",
+        "_attach_active": "dra.DraDriver._ckpt_cond",
+    },
+    "allocate.AllocationPlanner": {
+        "fragment_hits": "allocate.AllocationPlanner._frag_lock",
+        "fragment_misses": "allocate.AllocationPlanner._frag_lock",
+    },
+    "resilience.BackoffPolicy": {
+        "attempts": "resilience.BackoffPolicy._lock",
+        "total_attempts": "resilience.BackoffPolicy._lock",
+    },
+    "resilience.CircuitBreaker": {
+        "trips": "resilience.CircuitBreaker._lock",
+        "rejected": "resilience.CircuitBreaker._lock",
+        "_consecutive_failures": "resilience.CircuitBreaker._lock",
+    },
+    "discovery.HostSnapshot": {
+        "stats[*]": "discovery.HostSnapshot._stats_lock",
+    },
+    "faults": {
+        "_fired[*]": "faults._lock",
+    },
+}
+
+
+def registered_fault_sites(faults_source: str) -> Set[str]:
+    """The site registry, read from faults.py's _SITE_CATEGORY literal —
+    the same dict arm()/configure() enforce at runtime."""
+    tree = ast.parse(faults_source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "_SITE_CATEGORY" and node.value is not None:
+            value = node.value
+        elif isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_SITE_CATEGORY"
+                for t in node.targets):
+            value = node.value
+        else:
+            continue
+        if isinstance(value, ast.Dict):
+            return {k.value for k in value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+    raise ValueError("faults.py: _SITE_CATEGORY dict literal not found")
+
+
+def documented_fault_sites(doc_text: str) -> Set[str]:
+    """Sites documented in docs/fault-injection.md — the first backticked
+    token of each row of the '## Fault points' table."""
+    sites: Set[str] = set()
+    in_section = False
+    for line in doc_text.splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## Fault points"
+            continue
+        if in_section:
+            m = re.match(r"\s*\|\s*`([a-z0-9_.-]+)`\s*\|", line)
+            if m:
+                sites.add(m.group(1))
+    return sites
+
+
+def project_config(faults_source: str, doc_text: str) -> LintConfig:
+    """The LintConfig for THIS repo (scripts/lint_concurrency.py)."""
+    return LintConfig(
+        hot_locks=HOT_LOCKS,
+        counters=COUNTERS,
+        blocking_calls=BLOCKING_CALLS,
+        blocking_methods=BLOCKING_METHODS,
+        registered_sites=registered_fault_sites(faults_source),
+        documented_sites=documented_fault_sites(doc_text),
+    )

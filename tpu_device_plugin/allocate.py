@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import lockdep
 from .config import Config
 from .kubeletapi import pb
 from .naming import sanitize_name
@@ -77,7 +78,8 @@ class LiveAttrReader:
 
     def __init__(self) -> None:
         self._fds: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.instrument(
+            "allocate.LiveAttrReader._lock", threading.Lock())
 
     def __del__(self, _close=os.close):
         # _close bound at def time: os.close may already be torn down when
@@ -333,7 +335,8 @@ class AllocationPlanner:
         # guarded by their own lock — plan() runs on concurrent gRPC worker
         # threads while health listeners invalidate from hub threads
         self._fragments: Dict[str, _GroupFragment] = {}
-        self._frag_lock = threading.Lock()
+        self._frag_lock = lockdep.instrument(
+            "allocate.AllocationPlanner._frag_lock", threading.Lock())
         # bumped by every invalidation; a build that was in flight when an
         # invalidation landed must not store its (possibly pre-flap)
         # result — see _fragment

@@ -18,6 +18,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from . import lockdep
 from .config import Config
 from .naming import GenerationInfo, load_generation_map
 from .readcount import ReadWindow, WindowRegistry  # noqa: F401 (ReadWindow re-exported)
@@ -495,7 +496,12 @@ class HostSnapshot:
         # vtpu health flap carrying "<bdf>-coreN" dirties the parent chip
         self._logical_parent: Dict[str, str] = {}
         # surfaced on /status (status.py) and asserted by the perf-honesty
-        # guard: read counts are the load-insensitive cost metric
+        # guard: read counts are the load-insensitive cost metric. Scans
+        # run on the manager's run loop but /status reads from HTTP
+        # threads, so mutations take the stats lock (values stay ints —
+        # readers see a torn dict never, a stale value at worst)
+        self._stats_lock = lockdep.instrument(
+            "discovery.HostSnapshot._stats_lock", threading.Lock())
         self.stats = {"full_scans": 0, "dirty_rescans": 0,
                       "last_scan_reads": 0}
 
@@ -514,13 +520,15 @@ class HostSnapshot:
                 result = self._full_scan()
             else:
                 result = self._dirty_scan(set(dirty or ()))
-        self.stats["last_scan_reads"] = w.reads
+        with self._stats_lock:
+            self.stats["last_scan_reads"] = w.reads
         return result
 
     # -------------------------------------------------------------- walks
 
     def _full_scan(self) -> Tuple[Registry, Dict[str, GenerationInfo]]:
-        self.stats["full_scans"] += 1
+        with self._stats_lock:
+            self.stats["full_scans"] += 1
         self._signature_version = SNAPSHOT_SIGNATURE_VERSION
         self._genmap_sig = (_stat_sig(self.cfg.generation_map_path)
                             if self.cfg.generation_map_path else None)
@@ -551,7 +559,8 @@ class HostSnapshot:
 
     def _dirty_scan(self, dirty: Set[str],
                     ) -> Tuple[Registry, Dict[str, GenerationInfo]]:
-        self.stats["dirty_rescans"] += 1
+        with self._stats_lock:
+            self.stats["dirty_rescans"] += 1
         changed = False
         dirty |= self._pending_dirty
         # a flapped logical partition names its parent chip's record

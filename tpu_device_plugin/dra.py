@@ -51,6 +51,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 
 from . import faults
+from . import lockdep
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
@@ -95,6 +96,13 @@ CDI_CLAIM_CLASS = "claim"
 # 8 workers lands in <= 4 writes, measured); against a VM-boot-scale
 # attach path the worst-case ACK delay it can add is negligible.
 CHECKPOINT_COMMIT_WINDOW_S = 0.010
+# Idle exit for the group-commit writer thread: with nothing dirty for
+# this long the thread returns instead of parking on the condvar forever.
+# Safe because EVERY producer (_checkpoint_flush / _checkpoint_mark_dirty)
+# calls _ensure_checkpoint_writer_locked first — the next mutation
+# respawns it — and a driver dropped without stop() (tests, embedders)
+# then sheds its writer instead of leaking one per driver lifetime.
+CHECKPOINT_WRITER_IDLE_S = 2.0
 
 
 def slice_device_name(raw: str) -> str:
@@ -154,10 +162,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             cfg.root_path, "var/run/cdi")
         self.registered = threading.Event()
         self.registration_error: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = lockdep.instrument(
+            "dra.DraDriver._lock", threading.Lock())
         # serializes server bring-up/teardown against the hub-triggered
         # re-serve (see attach_health_hub / _restart_serving)
-        self._serve_lock = threading.Lock()
+        self._serve_lock = lockdep.instrument(
+            "dra.DraDriver._serve_lock", threading.Lock())
+        # the hub-triggered re-serve runner; event-paced so stop() can wake
+        # a mid-backoff sleep, tracked so stop() can join it (timeout)
+        self._reserve_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
         self._health_hub = None
         self._health_sub = None
         self._dra_server: Optional[grpc.Server] = None
@@ -189,13 +203,18 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # serializes slice publishes against each other AND against
         # stop(withdraw_slice=True): an in-flight retry publish racing the
         # withdraw could otherwise POST the slice back after the delete
-        self._publish_lock = threading.Lock()
+        self._publish_lock = lockdep.instrument(
+            "dra.DraDriver._publish_lock", threading.Lock())
         # name-stability records (see _assign_slice_names), persisted
         # beside the claim checkpoint so neither an inventory swap nor a
         # driver restart (DaemonSet upgrade) can re-point a published name
         # under a live claim
         self.sticky_names_path = os.path.join(self.driver_dir,
                                               "sticky-names.json")
+        # serializes sticky-name writers (the write itself runs outside
+        # the global lock; see _save_sticky_names)
+        self._sticky_save_lock = lockdep.instrument(
+            "dra.DraDriver._sticky_save_lock", threading.Lock())
         self._sticky_suffixed, self._label_owners = self._load_sticky_names()
         # live mdev_type/name reads for the prepare-path TOCTOU check
         self._mdev_name_reader = LiveAttrReader()
@@ -206,7 +225,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # other's API-server fetch or sysfs reads. Entries are refcounted
         # away so a node-recovery storm cannot grow the map unboundedly.
         self._claim_locks: Dict[str, list] = {}   # uid -> [lock, refcount]
-        self._claim_locks_lock = threading.Lock()
+        self._claim_locks_lock = lockdep.instrument(
+            "dra.DraDriver._claim_locks_lock", threading.Lock())
         # bounded pool fanning a multi-claim NodePrepareResources /
         # NodeUnprepareResources out (threads spawn lazily on first submit)
         self.prepare_workers = max(1, getattr(cfg, "prepare_workers", 4))
@@ -219,7 +239,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # prepare/unprepare blocks on the flush barrier until its entry is
         # durable before ACKing (exactly-once preserved: never ACK before
         # it is on disk). All state below is guarded by _ckpt_cond.
-        self._ckpt_cond = threading.Condition()
+        self._ckpt_cond = lockdep.instrument(
+            "dra.DraDriver._ckpt_cond", threading.Condition())
         self._ckpt_dirty_gen = 0      # bumped per mutation
         self._ckpt_result_gen = 0     # covered by a COMPLETED write attempt
         self._ckpt_durable_gen = 0    # covered by a SUCCESSFUL write
@@ -311,6 +332,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     def set_inventory(self, registry: Registry,
                       generations: Dict[str, GenerationInfo]) -> None:
         """Swap the discovery snapshot (rediscovery path)."""
+        sticky_dirty = False
         with self._lock:
             self.registry = registry
             self.generations = generations
@@ -338,7 +360,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                            for lb, rw in owned.items())):
                 self._sticky_suffixed |= suffixed
                 self._label_owners.update(owned)
-                self._save_sticky_names()
+                sticky_dirty = True
             self._by_name: Dict[str, Tuple[str, str, object]] = {
                 names[raw]: (kind, group, obj)
                 for raw, kind, group, obj in entries}
@@ -347,6 +369,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # vfio-backed logical partitions ride their parent's planner
             self._parent_planner = AllocationPlanner(
                 self.cfg, registry, "vtpu-parent")
+        if sticky_dirty:
+            # file I/O stays OUTSIDE the global lock (a slow disk must not
+            # stall claim prepares / slice builds); _save_sticky_names
+            # re-snapshots the CURRENT sets under the lock per write, so
+            # racing savers converge on the newest state
+            self._save_sticky_names()
 
     def _device_entry(self, name: str, kind: str, group_name: str,
                       obj, version: str = "v1beta1") -> dict:
@@ -783,7 +811,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         with self._claim_locks_lock:
             entry = self._claim_locks.get(uid)
             if entry is None:
-                entry = self._claim_locks[uid] = [threading.Lock(), 0]
+                # one shared lockdep name for the whole per-claim family:
+                # ordering is claim-lock -> global/checkpoint locks, never
+                # claim -> claim, and the shared name makes any nesting of
+                # two claim locks show up as a self-inversion
+                entry = self._claim_locks[uid] = [
+                    lockdep.instrument("dra.DraDriver._claim_lock",
+                                       threading.Lock()), 0]
             entry[1] += 1
         entry[0].acquire()
         try:
@@ -843,9 +877,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         cond = self._ckpt_cond
         while True:
             with cond:
+                idle_deadline = time.monotonic() + CHECKPOINT_WRITER_IDLE_S
                 while self._ckpt_dirty_gen == self._ckpt_result_gen \
                         and not self._ckpt_stopped:
-                    cond.wait()
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        # idle exit (see CHECKPOINT_WRITER_IDLE_S): clear
+                        # the thread slot only if it is still OURS — a
+                        # stop()/start() cycle may already have installed
+                        # a successor
+                        if self._ckpt_thread is threading.current_thread():
+                            self._ckpt_thread = None
+                        return
+                    cond.wait(timeout=remaining)
                 if self._ckpt_stopped \
                         and self._ckpt_dirty_gen == self._ckpt_result_gen:
                     return
@@ -926,14 +970,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return set(), {}
 
     def _save_sticky_names(self) -> None:
-        try:
-            _atomic_write_json(self.sticky_names_path,
-                               {"suffixed": sorted(self._sticky_suffixed),
-                                "label_owners": self._label_owners})
-        except OSError as exc:
-            # a failed persist degrades to process-lifetime stickiness;
-            # names stay correct until the next restart
-            log.warning("DRA: could not persist sticky name set: %s", exc)
+        # called OUTSIDE self._lock (blocking file write; the global lock
+        # is hot). _sticky_save_lock serializes writers, and each writer
+        # snapshots the CURRENT sets under the global lock, so the last
+        # serialized write always carries the newest state — records only
+        # ever grow, so converge-to-latest is lossless.
+        with self._sticky_save_lock:
+            with self._lock:
+                payload = {"suffixed": sorted(self._sticky_suffixed),
+                           "label_owners": dict(self._label_owners)}
+            try:
+                _atomic_write_json(self.sticky_names_path, payload)
+            except OSError as exc:
+                # a failed persist degrades to process-lifetime stickiness;
+                # names stay correct until the next restart
+                log.warning("DRA: could not persist sticky name set: %s", exc)
 
     # ------------------------------------------------------------ prepare
 
@@ -1153,21 +1204,26 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return [drapb.Device(**d) for d in devices]
 
     def _unprepare_claim(self, claim: drapb.Claim, task: dict) -> None:
-        # caller holds the per-claim-UID lock (see _prepare_claim)
+        # Caller holds the per-claim-UID lock (see _prepare_claim), which
+        # makes this read→unlink→drop sequence atomic PER CLAIM — the
+        # global lock only guards the checkpoint-map accesses, so the spec
+        # unlink (file I/O on a path only this claim owns) runs outside it
+        # and a slow filesystem never stalls other claims or slice builds.
         with self._lock:
             entry = self._checkpoint.get(claim.uid)
-            spec_path = (entry or {}).get(
-                "spec_path", self._claim_spec_path(claim.uid))
-            # unlink BEFORE dropping the checkpoint entry: a failed
-            # unlink must leave the claim recorded so the kubelet's
-            # retry reaches the spec again instead of resurrecting
-            # a stale entry on the next driver restart
-            try:
-                os.unlink(spec_path)
-            except FileNotFoundError:
-                pass
-            if entry is not None:
-                del self._checkpoint[claim.uid]
+        spec_path = (entry or {}).get(
+            "spec_path", self._claim_spec_path(claim.uid))
+        # unlink BEFORE dropping the checkpoint entry: a failed
+        # unlink must leave the claim recorded so the kubelet's
+        # retry reaches the spec again instead of resurrecting
+        # a stale entry on the next driver restart
+        try:
+            os.unlink(spec_path)
+        except FileNotFoundError:
+            pass
+        if entry is not None:
+            with self._lock:
+                self._checkpoint.pop(claim.uid, None)
         if entry is not None:
             try:
                 # ACK only once the deletion is durable — otherwise a
@@ -1290,9 +1346,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         log.warning("DRA: registration socket %s removed (kubelet "
                     "restart?); re-serving", self.registration_socket_path)
         # off the hub thread: re-serving stops/starts gRPC servers and must
-        # not stall every other subscriber's health delivery behind it
-        threading.Thread(target=self._restart_serving, daemon=True,
-                         name="dra-reserve").start()
+        # not stall every other subscriber's health delivery behind it.
+        # Tracked so stop() can join it; event-paced so stop() wakes a
+        # mid-backoff sleep instead of abandoning a 30s-deep daemon thread.
+        thread = threading.Thread(target=self._restart_serving, daemon=True,
+                                  name="dra-reserve")
+        self._reserve_thread = thread
+        thread.start()
 
     def _restart_serving(self) -> None:
         # backoff-looped like server.py's restart(): a transient failure
@@ -1314,13 +1374,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                     delay = backoff.next_delay()
                     log.error("DRA: re-serve after socket wipe failed (%s); "
                               "retrying in %.1fs", exc, delay)
-            time.sleep(delay)
+            if self._stopping.wait(timeout=delay):
+                return  # stop() won: exit now, not after the backoff
 
     def start(self) -> None:
         """Serve the DRAPlugin + Registration sockets (kubelet dials both)."""
         with self._serve_lock:
             with self._lock:
                 self._stopped = False
+            self._stopping.clear()
             # a stop() drained the attach plane; a re-start needs a live
             # pool and a writer allowed to spawn again
             with self._ckpt_cond:
@@ -1382,10 +1444,18 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         with self._lock:
             self._stopped = True
             timer, self._republish_timer = self._republish_timer, None
+        self._stopping.set()
         if timer is not None:
             timer.cancel()
         with self._serve_lock:
             self._stop_servers_locked()
+        # reap the hub-triggered re-serve runner: it checks _stopped under
+        # the serve lock and its backoff waits are _stopping-keyed, so it
+        # exits within one loop turn — unless WE are it (stop from a
+        # re-serve failure path), where self-joining would deadlock
+        reserve = self._reserve_thread
+        if reserve is not None and reserve is not threading.current_thread():
+            reserve.join(timeout=2)
         # drain the attach plane: no new claim tasks (pool refuses), then
         # let the checkpoint writer converge any pending mutations and exit
         self._prepare_pool.shutdown(wait=True)

@@ -60,7 +60,9 @@ import os
 import random
 import threading
 from contextlib import contextmanager
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Iterator, Optional
+
+from . import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -110,7 +112,7 @@ class _FaultPoint:
         self.fires = 0
 
 
-_lock = threading.Lock()
+_lock = lockdep.instrument("faults._lock", threading.Lock())
 _points: Dict[str, _FaultPoint] = {}
 _fired: Dict[str, int] = {}     # per-site lifetime fire counts (stats)
 _rng = random.Random()
@@ -172,7 +174,7 @@ def reset() -> None:
         _armed = False
 
 
-def fire(site: str, **ctx) -> bool:
+def fire(site: str, **ctx: object) -> bool:
     """Consult fault point `site`. Disarmed: returns False (one bool read).
 
     Armed with a raising kind: raises the armed exception. Armed with a
@@ -213,7 +215,7 @@ def stats() -> Dict[str, int]:
         return dict(_fired)
 
 
-def armed_sites() -> Dict[str, dict]:
+def armed_sites() -> Dict[str, Dict[str, object]]:
     """Currently armed points, for the /status debugging surface."""
     with _lock:
         return {site: {"kind": p.kind, "remaining": p.remaining,
@@ -224,7 +226,8 @@ def armed_sites() -> Dict[str, dict]:
 @contextmanager
 def injected(site: str, kind: str = "error", count: Optional[int] = 1,
              probability: float = 1.0,
-             exc: Optional[Callable[[], BaseException]] = None):
+             exc: Optional[Callable[[], BaseException]] = None,
+             ) -> Iterator[None]:
     """Scope-bound arming for tests: disarms the site on exit even when
     the fault's budget was not exhausted."""
     arm(site, kind=kind, count=count, probability=probability, exc=exc)

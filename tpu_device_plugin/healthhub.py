@@ -49,6 +49,7 @@ from concurrent import futures
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import faults
+from . import lockdep
 from .health import InotifyWatcher, _BACK, _GONE
 
 log = logging.getLogger(__name__)
@@ -98,7 +99,8 @@ class HubSubscription:
         # initial scan runs on the caller's thread and must not interleave
         # with the hub thread's scans/events over the same state — without
         # it a transition could be delivered twice or land out of order)
-        self._state_lock = threading.Lock()
+        self._state_lock = lockdep.instrument(
+            "healthhub.HubSubscription._state_lock", threading.Lock())
         self._active = False
         self._socket_reported = False
         self._fs_state: Dict[str, bool] = {}
@@ -125,7 +127,8 @@ class HealthHub:
         self.poll_interval_s = poll_interval_s
         self.probe_workers = probe_workers
         self.probe_deadline_s = probe_deadline_s
-        self._lock = threading.RLock()
+        self._lock = lockdep.instrument(
+            "healthhub.HealthHub._lock", threading.RLock())
         self._subs: List[HubSubscription] = []
         # reverse indexes, rebuilt on (un)subscribe: node events and
         # existence scans resolve in O(paths touched), not O(subs × keys)
@@ -139,7 +142,8 @@ class HealthHub:
         self._pool: Optional[futures.ThreadPoolExecutor] = None
         # one probe cycle at a time (the loop and bench/test callers of
         # probe_cycle() must not interleave verdict collection)
-        self._cycle_lock = threading.Lock()
+        self._cycle_lock = lockdep.instrument(
+            "healthhub.HealthHub._cycle_lock", threading.Lock())
         # BDF -> future still running past its deadline: a genuinely hung
         # probe (blocked syscall — uncancellable) must NOT be resubmitted
         # every cycle, or each cycle strands one more pool worker until the

@@ -21,6 +21,7 @@ from typing import Optional
 from urllib.parse import urlsplit
 
 from . import faults
+from . import lockdep
 from .resilience import BackoffPolicy, CircuitBreaker
 
 log = logging.getLogger(__name__)
@@ -96,7 +97,8 @@ class ApiClient:
         self._port = split.port
         self._base_path = split.path.rstrip("/")
         self._idle: list = []
-        self._pool_lock = threading.Lock()
+        self._pool_lock = lockdep.instrument(
+            "kubeapi.ApiClient._pool_lock", threading.Lock())
         # Circuit breaker over the whole client (resilience.py): transport
         # failures and 5xx count as failures, any response < 500 (including
         # 4xx — the server answered) as success. While open, request()

@@ -21,6 +21,7 @@ import time
 from concurrent import futures
 from typing import List, Optional
 
+from . import lockdep
 from .config import Config
 from .discovery import HostSnapshot, discover
 from .healthhub import HealthHub
@@ -64,7 +65,8 @@ class PluginManager:
         # transitions so steady-state polls never dirty anything.
         self.snapshot: Optional[HostSnapshot] = None
         self._dirty: set = set()
-        self._dirty_lock = threading.Lock()
+        self._dirty_lock = lockdep.instrument(
+            "lifecycle.PluginManager._dirty_lock", threading.Lock())
         self._health_baseline: dict = {}
         self._last_inventory = None
         self._inventory_published = True

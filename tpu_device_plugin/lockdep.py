@@ -1,0 +1,486 @@
+"""lockdep — runtime lock-order and lock-hold validation ($TDP_LOCKDEP=1).
+
+The static analyzer (tools/tsalint) proves what it can see; callbacks,
+injected policies and cross-object delivery chains it cannot. This module
+closes that gap the way the kernel's lockdep does: every registered lock
+is wrapped in a recording proxy, each thread keeps its acquisition stack,
+and every FIRST observation of "B acquired while A held" adds the edge
+A -> B to a global order graph with an exemplar stack. At the end of a
+run (tests/conftest.py wires this into the tier-1 suite), the graph is
+checked:
+
+- **inversions**: both A -> B and B -> A observed anywhere in the run —
+  two threads interleaving those paths can deadlock, even if this run got
+  lucky. Includes same-name self-edges (two INSTANCES of the same lock
+  class nested — an ABBA hazard between peers).
+- **cycles**: longer loops (A -> B -> C -> A) via DFS over the edge graph.
+- **long holds**: a watched lock (the hot set: device-table condition,
+  DRA global lock, checkpoint condition, hub lock) held longer than
+  $TDP_LOCKDEP_HOLD_MS (default 500) — the runtime symptom of blocking
+  work under a hot lock. Condition.wait/wait_for pause the hold clock
+  (and the order stack): a waiter is not a holder.
+
+Everything is keyed by the REGISTERED NAME ("module.Class.attr"), shared
+across instances — the same names tsalint reports, so a static finding
+and a runtime report point at the same lock.
+
+Production cost: `instrument()` returns the raw lock unchanged unless
+lockdep was enabled BEFORE the lock was created (module-level locks are
+created at import, so enable() must run first — conftest does). The
+enabled fast path is one thread-local peek plus a set lookup per acquire;
+stacks are captured only the first time an edge is seen.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Set,
+                    Tuple, TypeVar, cast)
+
+__all__ = ["enable", "disable", "enabled", "instrument", "report", "reset",
+           "scoped", "watch", "LockdepReport"]
+
+_LockT = TypeVar("_LockT")
+
+_enabled = False
+_registry_lock = threading.Lock()
+_registered: Set[str] = set()               # names seen by instrument()
+# (holder name, acquired name) -> exemplar stack text
+_edges: Dict[Tuple[str, str], str] = {}
+_long_holds: List[Tuple[str, float, str]] = []   # (name, seconds, stack)
+_watched: Set[str] = set()
+_hold_threshold_s = 0.5
+
+_DEFAULT_WATCHED = (
+    "server.TpuDevicePlugin._cond",
+    "dra.DraDriver._lock",
+    "dra.DraDriver._ckpt_cond",
+    "healthhub.HealthHub._lock",
+)
+
+
+class _HoldRec:
+    __slots__ = ("name", "key", "t0", "count")
+
+    def __init__(self, name: str, key: int, t0: float) -> None:
+        self.name = name
+        self.key = key       # id() of the proxy instance
+        self.t0 = t0         # monotonic acquire time; 0.0 = unwatched
+        self.count = 1       # reentrant depth (RLock)
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.stack: List[_HoldRec] = []
+
+
+_tls = _TLS()
+
+
+def enable(hold_threshold_ms: Optional[float] = None) -> None:
+    """Turn recording on (idempotent). Reads $TDP_LOCKDEP_HOLD_MS unless
+    an explicit threshold is given. Locks created BEFORE enable() stay
+    raw — enable first, import/construct after."""
+    global _enabled, _hold_threshold_s
+    if hold_threshold_ms is None:
+        try:
+            hold_threshold_ms = float(
+                os.environ.get("TDP_LOCKDEP_HOLD_MS", "") or 500.0)
+        except ValueError:
+            hold_threshold_ms = 500.0
+    _hold_threshold_s = max(hold_threshold_ms, 0.0) / 1000.0
+    with _registry_lock:
+        if not _watched:
+            _watched.update(_DEFAULT_WATCHED)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def watch(name: str) -> None:
+    """Add a lock name to the long-hold watch set."""
+    with _registry_lock:
+        _watched.add(name)
+
+
+def reset() -> None:
+    """Clear recorded edges/holds (test isolation); registration stays."""
+    with _registry_lock:
+        _edges.clear()
+        del _long_holds[:]
+
+
+@contextmanager
+def scoped(hold_threshold_ms: Optional[float] = None,
+           watched: Optional[Set[str]] = None) -> Iterator[None]:
+    """Enable lockdep for a with-block with ISOLATED recording state —
+    unit tests exercise intentional inversions/holds without polluting
+    (or failing) a surrounding TDP_LOCKDEP=1 session's final report.
+    Prior edges/holds, threshold, watch set and enablement are restored
+    on exit."""
+    global _enabled, _hold_threshold_s
+    with _registry_lock:
+        saved_edges = dict(_edges)
+        saved_holds = list(_long_holds)
+        saved_watched = set(_watched)
+        _edges.clear()
+        del _long_holds[:]
+        if watched is not None:
+            _watched.clear()
+            _watched.update(watched)
+    saved_enabled = _enabled
+    saved_threshold = _hold_threshold_s
+    enable(hold_threshold_ms)
+    try:
+        yield
+    finally:
+        with _registry_lock:
+            _edges.clear()
+            _edges.update(saved_edges)
+            del _long_holds[:]
+            _long_holds.extend(saved_holds)
+            _watched.clear()
+            _watched.update(saved_watched)
+        _enabled = saved_enabled
+        _hold_threshold_s = saved_threshold
+
+
+def instrument(name: str, lock: _LockT) -> _LockT:
+    """Register `lock` under `name`. Disabled (production): returns the
+    raw lock — zero overhead. Enabled: returns a recording proxy (typed
+    as the wrapped lock: the proxy is API-compatible)."""
+    with _registry_lock:
+        _registered.add(name)
+    if not _enabled:
+        return lock
+    if isinstance(lock, threading.Condition):
+        return cast(_LockT, _ConditionProxy(name, lock))
+    return cast(_LockT, _LockProxy(name, lock))
+
+
+# --------------------------------------------------------------- recording
+
+def _note_acquired(name: str, key: int) -> None:
+    stack = _tls.stack
+    for rec in stack:
+        if rec.key == key:          # reentrant re-acquire (RLock)
+            rec.count += 1
+            return
+    for rec in stack:
+        _note_edge(rec.name, name)
+    t0 = time.monotonic() if name in _watched else 0.0
+    stack.append(_HoldRec(name, key, t0))
+
+
+def _note_edge(holder: str, acquired: str) -> None:
+    pair = (holder, acquired)
+    if pair in _edges:              # racy peek: worst case one extra lock
+        return
+    stack_text = "".join(traceback.format_stack(limit=14)[:-2])
+    with _registry_lock:
+        _edges.setdefault(pair, stack_text)
+
+
+def _note_released(name: str, key: int) -> None:
+    stack = _tls.stack
+    for i in range(len(stack) - 1, -1, -1):
+        rec = stack[i]
+        if rec.key != key:
+            continue
+        rec.count -= 1
+        if rec.count > 0:
+            return
+        del stack[i]
+        if rec.t0:
+            held_s = time.monotonic() - rec.t0
+            if held_s >= _hold_threshold_s:
+                text = "".join(traceback.format_stack(limit=14)[:-2])
+                with _registry_lock:
+                    _long_holds.append((name, held_s, text))
+        return
+
+
+def _suspend(key: int) -> Optional[_HoldRec]:
+    """Pop this lock's hold record for the duration of a Condition wait:
+    a waiter holds nothing."""
+    stack = _tls.stack
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i].key == key:
+            return stack.pop(i)
+    return None
+
+
+def _resume(rec: Optional[_HoldRec]) -> None:
+    if rec is None:
+        return
+    if rec.name in _watched:
+        rec.t0 = time.monotonic()   # the hold clock restarts post-wait
+    _tls.stack.append(rec)
+
+
+class _LockProxy:
+    """Recording wrapper for Lock/RLock."""
+
+    def __init__(self, name: str, lock: Any) -> None:
+        self._name = name
+        self._lock = lock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = bool(self._lock.acquire(blocking, timeout))
+        if ok:
+            _note_acquired(self._name, id(self))
+        return ok
+
+    def release(self) -> None:
+        _note_released(self._name, id(self))
+        self._lock.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return bool(self._lock.locked())
+
+    def __repr__(self) -> str:
+        return f"<lockdep {self._name} wrapping {self._lock!r}>"
+
+
+class _ConditionProxy:
+    """Recording wrapper for Condition: wait/wait_for release the lock, so
+    the hold record (and order stack membership) is suspended around them."""
+
+    def __init__(self, name: str, cond: threading.Condition) -> None:
+        self._name = name
+        self._cond = cond
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        ok = bool(self._cond.acquire(*args, **kwargs))
+        if ok:
+            _note_acquired(self._name, id(self))
+        return ok
+
+    def release(self) -> None:
+        _note_released(self._name, id(self))
+        self._cond.release()
+
+    def __enter__(self) -> bool:
+        self._cond.__enter__()
+        _note_acquired(self._name, id(self))
+        return True
+
+    def __exit__(self, *exc: object) -> None:
+        _note_released(self._name, id(self))
+        self._cond.__exit__(None, None, None)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        rec = _suspend(id(self))
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _resume(rec)
+
+    def wait_for(self, predicate: Callable[[], bool],
+                 timeout: Optional[float] = None) -> bool:
+        rec = _suspend(id(self))
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _resume(rec)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<lockdep {self._name} wrapping {self._cond!r}>"
+
+
+# ----------------------------------------------------------------- report
+
+class LockdepReport:
+    """What the run observed. `violations()` is the CI gate."""
+
+    def __init__(self, registered: Set[str],
+                 edges: Dict[Tuple[str, str], str],
+                 inversions: List[Tuple[str, str]],
+                 cycles: List[List[str]],
+                 long_holds: List[Tuple[str, float, str]]) -> None:
+        self.registered = registered
+        self.edges = edges
+        self.inversions = inversions
+        self.cycles = cycles
+        self.long_holds = long_holds
+
+    def violations(self) -> List[str]:
+        out: List[str] = []
+        for a, b in self.inversions:
+            out.append(f"lock-order inversion: {a} <-> {b}")
+        for cycle in self.cycles:
+            if len(cycle) > 2:   # 2-cycles already reported as inversions
+                out.append("lock-order cycle: " +
+                           " -> ".join(cycle + [cycle[0]]))
+        for name, held_s, _stack in self.long_holds:
+            out.append(f"long hold: {name} held {held_s * 1e3:.0f} ms "
+                       f"(threshold {_hold_threshold_s * 1e3:.0f} ms)")
+        return out
+
+    def render(self, stacks: bool = False) -> str:
+        lines = [f"lockdep: {len(self.registered)} registered lock name(s), "
+                 f"{len(self.edges)} order edge(s), "
+                 f"{len(self.inversions)} inversion(s), "
+                 f"{len(self.long_holds)} long hold(s)"]
+        for a, b in self.inversions:
+            lines.append(f"  INVERSION {a} <-> {b}")
+            if stacks:
+                lines.append("   first saw " + repr((a, b)) + " at:\n" +
+                             _indent(self.edges.get((a, b), "")))
+                lines.append("   first saw " + repr((b, a)) + " at:\n" +
+                             _indent(self.edges.get((b, a), "")))
+        for cycle in self.cycles:
+            if len(cycle) > 2:
+                lines.append("  CYCLE " + " -> ".join(cycle + [cycle[0]]))
+                if stacks:
+                    for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                        lines.append(f"   first saw {(a, b)!r} at:\n" +
+                                     _indent(self.edges.get((a, b), "")))
+        for name, held_s, stack in self.long_holds:
+            lines.append(f"  LONG HOLD {name}: {held_s * 1e3:.0f} ms")
+            if stacks:
+                lines.append(_indent(stack))
+        return "\n".join(lines)
+
+
+def _indent(text: str) -> str:
+    return "\n".join("    " + ln for ln in text.splitlines())
+
+
+def report() -> LockdepReport:
+    with _registry_lock:
+        edges = dict(_edges)
+        registered = set(_registered)
+        long_holds = list(_long_holds)
+    inversions = sorted({(min(a, b), max(a, b))
+                         for (a, b) in edges
+                         if a == b or (b, a) in edges})
+    return LockdepReport(registered, edges, inversions,
+                         _find_cycles(edges), long_holds)
+
+
+def _tarjan_sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan: SCCs of >1 node, plus self-looping singletons."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    order: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+    nodes = sorted(set(graph) | {b for bs in graph.values() for b in bs})
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, List[str]]] = [(root, sorted(graph.get(root,
+                                                                     ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        order.append(root)
+        on_stack.add(root)
+        while work:
+            v, children = work[-1]
+            if children:
+                w = children.pop(0)
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    order.append(w)
+                    on_stack.add(w)
+                    work.append((w, sorted(graph.get(w, ()))))
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            else:
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[v])
+                if low[v] == index[v]:
+                    scc: List[str] = []
+                    while True:
+                        w = order.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == v:
+                            break
+                    if len(scc) > 1 or v in graph.get(v, ()):
+                        sccs.append(sorted(scc))
+    return sccs
+
+
+def _bfs_path(graph: Dict[str, Set[str]], members: Set[str],
+              start: str, goal: str) -> Optional[List[str]]:
+    """Shortest start→goal path inside `members`, or None."""
+    frontier = [start]
+    parents: Dict[str, Optional[str]] = {start: None}
+    while frontier:
+        nxt: List[str] = []
+        for v in frontier:
+            for w in sorted(graph.get(v, ())):
+                if w == goal:
+                    path = [goal, v]
+                    node: Optional[str] = v
+                    while node is not None and parents[node] is not None:
+                        node = parents[node]
+                        if node is not None:
+                            path.append(node)
+                    path.reverse()
+                    return path
+                if w in members and w not in parents:
+                    parents[w] = v
+                    nxt.append(w)
+        frontier = nxt
+    return None
+
+
+def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """One representative cycle per SCC of the directed graph, with nodes
+    in ACTUAL EDGE ORDER: cycle[i] -> cycle[i+1] (and last -> first) are
+    all real edges, so a rendered arc can be traced through the exemplar
+    stacks instead of naming edges nobody ever took. Self-loops come out
+    as single-node cycles. Shared by the static analyzer (tools/tsalint)
+    and the runtime report below — one implementation for both halves."""
+    cycles: List[List[str]] = []
+    for scc in _tarjan_sccs(graph):
+        members = set(scc)
+        start = min(scc)
+        if len(scc) == 1:
+            cycles.append([start])
+            continue
+        best: Optional[List[str]] = None
+        for succ in sorted(set(graph.get(start, ())) & members):
+            path = _bfs_path(graph, members, succ, start)
+            if path is not None and (best is None or len(path) < len(best)):
+                best = path
+        # strongly connected ⇒ best is never None; guard anyway
+        cycles.append([start] + (best[:-1] if best else []))
+    return cycles
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], str]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    return find_cycles(graph)

@@ -16,6 +16,8 @@ import threading
 from contextlib import contextmanager
 from typing import Iterator, List, Optional
 
+from . import lockdep
+
 
 class ReadWindow:
     """One open accounting window: every access noted on the owning
@@ -37,7 +39,8 @@ class WindowRegistry:
 
     def __init__(self) -> None:
         self._windows: List[ReadWindow] = []
-        self._lock = threading.Lock()
+        self._lock = lockdep.instrument(
+            "readcount.WindowRegistry._lock", threading.Lock())
 
     def note(self, path: str) -> None:
         if not self._windows:

@@ -29,9 +29,13 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Dict, Optional, TypeVar, Union
+
+from . import lockdep
 
 __all__ = ["BackoffPolicy", "CircuitBreaker", "CircuitOpen"]
+
+_T = TypeVar("_T")
 
 
 class BackoffPolicy:
@@ -50,7 +54,8 @@ class BackoffPolicy:
         self.base_s = base_s
         self.cap_s = cap_s
         self._rng = rng or random.Random()
-        self._lock = threading.Lock()
+        self._lock = lockdep.instrument(
+            "resilience.BackoffPolicy._lock", threading.Lock())
         self._prev = base_s
         self.attempts = 0
         self.total_attempts = 0
@@ -69,7 +74,7 @@ class BackoffPolicy:
             self._prev = self.base_s
             self.attempts = 0
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {"attempts": self.attempts,
                     "total_attempts": self.total_attempts,
@@ -105,7 +110,8 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.reset_timeout_s = reset_timeout_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.instrument(
+            "resilience.CircuitBreaker._lock", threading.Lock())
         self._state = self.CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -155,7 +161,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 self.trips += 1
 
-    def call(self, fn: Callable, *args, **kwargs):
+    def call(self, fn: Callable[..., _T], *args: Any, **kwargs: Any) -> _T:
         """Run fn through the breaker; raises CircuitOpen when rejected."""
         if not self.allow():
             raise CircuitOpen(
@@ -169,7 +175,7 @@ class CircuitBreaker:
         self.record_success()
         return result
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> Dict[str, Union[str, int]]:
         with self._lock:
             return {"state": self._state,
                     "consecutive_failures": self._consecutive_failures,

@@ -31,6 +31,7 @@ import grpc
 from . import allocate as allocate_mod
 from . import faults
 from . import kubeletapi as api
+from . import lockdep
 from .config import Config
 from .healthhub import HealthHub, HubSubscription
 from .kubeletapi import pb
@@ -103,7 +104,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # second, driftable health watcher.
         self._health_listener = health_listener
         # serializes listener deliveries; see set_devices_health
-        self._listener_lock = threading.Lock()
+        self._listener_lock = lockdep.instrument(
+            "server.TpuDevicePlugin._listener_lock", threading.Lock())
         # CDI names are only valid when this resource's spec file was written
         self.cdi_enabled = cdi_enabled
         self.resource_suffix = resource_suffix
@@ -115,7 +117,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-{resource_suffix}.sock")
 
-        self._cond = threading.Condition()
+        self._cond = lockdep.instrument(
+            "server.TpuDevicePlugin._cond", threading.Condition())
         self._devs: Dict[str, pb.Device] = {}
         self._health_sources: Dict[str, Dict[str, bool]] = {}
         self._version = 0
@@ -129,7 +132,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._health_sub: Optional[HubSubscription] = None
         self._stop = threading.Event()
         self._closed = threading.Event()   # terminal stop(); restarts must abort
-        self._lifecycle_lock = threading.RLock()  # serializes start/teardown
+        self._lifecycle_lock = lockdep.instrument(
+            "server.TpuDevicePlugin._lifecycle_lock",
+            threading.RLock())  # serializes start/teardown
+        # the in-flight socket-loss restart thread (at most one matters: a
+        # newer restart superseding an older one re-points this); joined
+        # with a timeout by stop() so a terminal stop leaves no runner
+        self._restart_thread: Optional[threading.Thread] = None
         self._serving = False
         self._restart_count = 0
         # shared restart backoff (decorrelated jitter): N plugins bounced by
@@ -164,7 +173,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # (availability, must-include, size, version), never health, so a
         # stale hit is impossible while the version is in the key.
         self._pref_cache: "OrderedDict[tuple, list]" = OrderedDict()
-        self._pref_lock = threading.Lock()
+        self._pref_lock = lockdep.instrument(
+            "server.TpuDevicePlugin._pref_lock", threading.Lock())
         self._pref_hits = 0
         self._pref_misses = 0
         # ListAndWatch re-sends since start (initial snapshots excluded):
@@ -378,17 +388,22 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         about to exit. A stop already in progress wins over a restart."""
         if self._closed.is_set() or self._stop.is_set():
             return
-        threading.Thread(target=self.restart, daemon=True,
-                         name=f"restart-{self.resource_suffix}").start()
+        thread = threading.Thread(target=self.restart, daemon=True,
+                                  name=f"restart-{self.resource_suffix}")
+        self._restart_thread = thread
+        thread.start()
 
     def restart(self) -> None:
         """Re-serve + re-register, retrying with backoff until the kubelet is
         back. A terminal stop() (self._closed) aborts the loop at any point;
         the lifecycle lock makes a concurrent stop() either wait for an
         attempt to finish (and then tear it down) or win outright."""
-        self._restart_count += 1
-        log.info("%s: restarting (count=%d)", self.resource_name, self._restart_count)
         with self._lifecycle_lock:
+            # counter mutation under its owning lock: restarts are spawned
+            # from hub callbacks and can overlap a /status snapshot read
+            self._restart_count += 1
+            count = self._restart_count
+            log.info("%s: restarting (count=%d)", self.resource_name, count)
             self._teardown()
         self._restart_backoff.reset()
         while not self._closed.is_set():
@@ -418,6 +433,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._closed.set()
         with self._lifecycle_lock:
             self._teardown()
+        # reap the socket-loss restart runner: it observes _closed at its
+        # next check (every wait is _closed-keyed), so a bounded join
+        # suffices — unless WE are that runner (stop called from a restart
+        # callback), where joining would deadlock on ourselves
+        thread = self._restart_thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2)
 
     def _teardown(self) -> None:
         self._serving = False

@@ -81,6 +81,11 @@ class StatusServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        # shutdown() returns once serve_forever has exited its loop; the
+        # bounded join reaps the server thread itself so a stopped status
+        # endpoint never leaks a thread (the lockdep leak check counts)
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
 
     def alive(self) -> bool:
         return self.manager.running.is_set()
