@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -115,6 +115,15 @@ bench-health:
 # precompiled-fragment plan read ratio. Writes docs/bench_attach_r08.json.
 bench-attach:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --attach-burst
+
+# Epoch read-plane attach bench (docs/perf.md "lock-free read plane"):
+# daemon-side attach wall broken into sysfs-I/O floor (counted syscalls x
+# in-run calibration), daemon overhead, 4-way-contended queue/sync, gRPC
+# transport — plus COUNTED registered-lock acquisitions per attach (0; the
+# pre-epoch tree measured 11). Writes docs/bench_attach_r09.json. The CI
+# bench-smoke job runs this with --quick and the counted honesty guards.
+bench-attach-path:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --attach
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

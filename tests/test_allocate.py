@@ -313,7 +313,7 @@ def test_fragment_invalidation_recompiles_renamed_cdev(tmp_path):
     # without invalidation the stale fragment still serves vfio0
     stale = planner.plan([bdf])
     assert any(s.host_path.endswith("vfio0") for s in stale.device_specs)
-    planner.invalidate_fragments([bdf])
+    planner.invalidate_fragments()
     fresh = planner.plan([bdf])
     assert any(s.host_path.endswith("vfio9") for s in fresh.device_specs)
     assert not any(s.host_path.endswith("vfio0") for s in fresh.device_specs)

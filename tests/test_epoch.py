@@ -1,0 +1,384 @@
+"""Epoch read-plane tests (tpu_device_plugin/epoch.py) + the lockdep
+read-path gate.
+
+The gate is the PR's headline correctness claim: in steady state the four
+hot read paths — Allocate, GetPreferredAllocation, ListAndWatch payload
+assembly, /status — plus DRA prepare planning acquire ZERO registered
+locks. It runs under lockdep.scoped(), so it enforces in every tier-1
+run (not only the TDP_LOCKDEP=1 CI job): objects built inside the scope
+get recording lock proxies, and lockdep.read_path charges every
+acquisition to the bracket it happened in.
+"""
+
+import dataclasses
+import os
+import threading
+import time
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import epoch as epoch_mod
+from tpu_device_plugin import lockdep
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.epoch import (AtomicCounter, Epoch, EpochStore,
+                                     build_server_epoch)
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.server import TpuDevicePlugin
+
+
+# ------------------------------------------------------------ primitives
+
+
+def test_atomic_counter_add_and_value():
+    c = AtomicCounter()
+    assert c.value == 0
+    c.add()
+    c.add()
+    assert c.value == 2
+    c2 = AtomicCounter(start=10)
+    c2.add()
+    assert c2.value == 11
+
+
+def test_atomic_counter_concurrent_adds_are_exact_and_monotonic():
+    c = AtomicCounter()
+    n_threads, per_thread = 8, 2000
+    observed = []
+    stop = threading.Event()
+
+    def worker():
+        for _ in range(per_thread):
+            c.add()
+
+    def observer():
+        # a concurrent /metrics scraper: successive reads must never go
+        # backwards (Prometheus counters treat a decrease as a restart)
+        while not stop.is_set():
+            observed.append(c.value)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    obs = threading.Thread(target=observer)
+    obs.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    obs.join()
+    # EXACT: no add is ever lost
+    assert c.value == n_threads * per_thread
+    c.add()
+    assert c.value == n_threads * per_thread + 1
+    # MONOTONIC: the observer never saw the counter move backwards
+    assert all(a <= b for a, b in zip(observed, observed[1:]))
+
+
+def test_epoch_is_frozen_and_mapping_readonly():
+    ep = build_server_epoch(3, (("a", 0), ("b", 1)), {"b": {"fs": False}})
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        ep.epoch_id = 4
+    with pytest.raises(TypeError):
+        ep.device_health["a"] = "Unhealthy"
+    assert ep.device_health == {"a": "Healthy", "b": "Unhealthy"}
+    # the payload parses back to exactly the table the builder rendered
+    resp = pb.ListAndWatchResponse.FromString(ep.lw_payload)
+    assert {d.ID: d.health for d in resp.devices} == dict(ep.device_health)
+
+
+def test_epoch_builder_health_is_anded_across_sources():
+    sources = {"a": {"fs": True, "probe": False}}
+    ep = build_server_epoch(1, (("a", 0),), sources)
+    assert ep.device_health["a"] == "Unhealthy"
+    sources["a"]["probe"] = True
+    ep2 = build_server_epoch(2, (("a", 0),), sources)
+    assert ep2.device_health["a"] == "Healthy"
+    # the earlier epoch is untouched by the writer's continued mutation
+    assert ep.device_health["a"] == "Unhealthy"
+
+
+def test_store_publish_swaps_atomically_and_counts():
+    store = EpochStore()
+    assert store.current.epoch_id == 0
+    ep1 = Epoch(1)
+    assert store.publish(ep1) is ep1
+    assert store.current is ep1
+    assert store.publishes.value == 1
+
+
+def test_store_wait_for_observes_publish():
+    store = EpochStore()
+    seen = []
+
+    def waiter():
+        store.wait_for(lambda: store.current.epoch_id >= 2, timeout=5)
+        seen.append(store.current.epoch_id)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    store.publish(Epoch(2))
+    t.join(timeout=5)
+    assert seen == [2]
+
+
+def test_store_poke_wakes_without_publishing():
+    store = EpochStore()
+    woke = threading.Event()
+
+    def waiter():
+        store.wait_for(lambda: woke.is_set(), timeout=5)
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    woke.set()
+    store.poke()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert store.publishes.value == 0
+
+
+# ----------------------------------------------------- server integration
+
+
+def _plugin(root, n=4):
+    host = FakeHost(root)
+    for i in range(n):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i), numa_node=i // 2))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, generations = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"],
+                             torus_dims=generations["0062"].host_topology)
+    return host, cfg, plugin
+
+
+def test_effective_flip_publishes_new_epoch(short_root):
+    _, _, plugin = _plugin(short_root)
+    ep0 = plugin._store.current
+    plugin.set_devices_health(["0000:00:04.0"], False, source="t")
+    ep1 = plugin._store.current
+    assert ep1.epoch_id == ep0.epoch_id + 1
+    assert ep1.device_health["0000:00:04.0"] == "Unhealthy"
+    # the OLD epoch still reads its old state (readers mid-flight are safe)
+    assert ep0.device_health["0000:00:04.0"] == "Healthy"
+    # the pre-serialized payload matches the table
+    resp = pb.ListAndWatchResponse.FromString(ep1.lw_payload)
+    assert {d.ID: d.health for d in resp.devices} == dict(ep1.device_health)
+
+
+def test_repeat_verdict_publishes_nothing(short_root):
+    """Probe polls re-deliver every id each cycle; a delivery that flips
+    no EFFECTIVE verdict must not publish (readers pay zero)."""
+    _, _, plugin = _plugin(short_root)
+    plugin.set_devices_health(["0000:00:04.0"], False, source="t")
+    publishes = plugin._store.publishes.value
+    for _ in range(5):
+        plugin.set_devices_health(["0000:00:04.0"], False, source="t")
+        plugin.set_devices_health(["0000:00:05.0"], True, source="t")
+    assert plugin._store.publishes.value == publishes
+
+
+def test_fragment_cache_is_invalidated_by_epoch_key(short_root):
+    """A health flap publishes a new epoch, and THAT (not any listener)
+    makes the next plan recompile its fragments: the renamed-cdev case
+    that PR 4 needed invalidation plumbing for now heals by key."""
+    import shutil
+
+    host = FakeHost(short_root)
+    for i in range(2):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i),
+                               vfio_dev=f"vfio{i}"))
+    host.enable_iommufd()
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"])
+    bdf = "0000:00:04.0"
+    req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=[bdf])])
+    resp = plugin.Allocate(req, None)
+    paths = [d.host_path for d in resp.container_responses[0].devices]
+    assert any(p.endswith("vfio0") for p in paths)
+    # kernel re-enumerates the cdev (unbind/rebind)
+    base = os.path.join(host.pci, bdf, "vfio-dev")
+    shutil.rmtree(base)
+    os.makedirs(os.path.join(base, "vfio9"))
+    with open(os.path.join(host.devfs, "vfio", "devices", "vfio9"), "w"):
+        pass
+    # same epoch: the stale fragment still serves vfio0 (documented
+    # blind spot, same contract as incremental discovery)
+    resp = plugin.Allocate(req, None)
+    paths = [d.host_path for d in resp.container_responses[0].devices]
+    assert any(p.endswith("vfio0") for p in paths)
+    # the flap publishes a new epoch -> fresh fragment cache -> vfio9
+    plugin.set_devices_health([bdf], False, source="t")
+    plugin.set_devices_health([bdf], True, source="t")
+    resp = plugin.Allocate(req, None)
+    paths = [d.host_path for d in resp.container_responses[0].devices]
+    assert any(p.endswith("vfio9") for p in paths)
+    assert not any(p.endswith("vfio0") for p in paths)
+
+
+def test_dra_health_flip_bumps_inventory_epoch(short_root):
+    from tpu_device_plugin.dra import DraDriver
+
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    registry, generations = discover_passthrough(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="n")
+    ep0 = driver._inventory_snapshot()
+    assert driver.apply_health({"0000:00:04.0": False}) is True
+    ep1 = driver._inventory_snapshot()
+    assert ep1.epoch_id == ep0.epoch_id + 1
+    assert "0000:00:04.0" in ep1.unhealthy
+    assert ep0.unhealthy == frozenset()
+    # repeat delivery: no epoch churn
+    assert driver.apply_health({"0000:00:04.0": False}) is False
+    assert driver._inventory_snapshot().epoch_id == ep1.epoch_id
+    # the slice body prunes from the epoch, no lock
+    devices = driver.build_slice()["spec"]["devices"]
+    assert devices == []
+
+
+# ------------------------------------------------- the lockdep read gate
+
+
+def test_read_paths_acquire_zero_registered_locks(short_root):
+    """THE gate: steady-state Allocate / GetPreferredAllocation /
+    ListAndWatch assembly / /status (plugin snapshot + hub stats + DRA
+    read stats) / DRA prepare planning acquire ZERO registered locks.
+    Counted (lockdep proxies + read_path brackets), so CI load cannot
+    flip the verdict. Runs inside lockdep.scoped() — enforced in every
+    tier-1 run, with or without TDP_LOCKDEP=1."""
+    from tpu_device_plugin.dra import DraDriver
+    from tpu_device_plugin.healthhub import HealthHub
+
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        for i in range(4):
+            host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                                   iommu_group=str(11 + i),
+                                   vfio_dev=f"vfio{i}", numa_node=i // 2))
+        host.enable_iommufd()
+        cfg = Config().with_root(host.root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        registry, generations = discover_passthrough(cfg)
+        plugin = TpuDevicePlugin(cfg, "v4", registry,
+                                 registry.devices_by_model["0062"])
+        driver = DraDriver(cfg, registry, generations, node_name="n")
+        hub = HealthHub()   # never started: stats() is the read side
+
+        ids = [d.bdf for d in registry.devices_by_model["0062"]]
+        pref_req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=ids, allocation_size=2)])
+        alloc_req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devices_ids=ids[:2])])
+        slice_names = [n for n in driver._by_name]
+        results = [{"device": n, "pool": "n", "request": "r"}
+                   for n in slice_names[:2]]
+
+        # WARM-UP: first-touch slow paths (fd opens, fragment builds,
+        # memo misses) are allowed to lock — that is the design
+        plugin.GetPreferredAllocation(pref_req, None)
+        plugin.Allocate(alloc_req, None)
+        plugin.status_snapshot()
+        plugin._lw_response(plugin._store.current)
+        driver._plan_devices(results)
+        hub.stats()
+
+        # STEADY STATE: everything below must charge 0 acquisitions
+        lockdep.reset()
+        for _ in range(5):
+            plugin.GetPreferredAllocation(pref_req, None)
+            plugin.Allocate(alloc_req, None)
+            plugin.status_snapshot()
+            plugin._lw_response(plugin._store.current)
+            driver._plan_devices(results)
+            hub.stats()
+            driver.checkpoint_stats()
+            driver.prepared_claim_count()
+            driver.unhealthy_devices()
+
+        stats = lockdep.path_stats()
+        expected = {"server.Allocate", "server.GetPreferredAllocation",
+                    "server.ListAndWatch.assembly",
+                    "server.status_snapshot", "dra.plan"}
+        assert expected <= set(stats), stats
+        for name in expected:
+            assert stats[name]["calls"] >= 5, (name, stats[name])
+            assert stats[name]["lock_acquisitions"] == 0, \
+                f"hot read path {name} acquired " \
+                f"{stats[name]['lock_acquisitions']} registered lock(s) " \
+                f"in steady state — the epoch refactor's zero-lock " \
+                f"contract is broken"
+
+
+def test_status_endpoint_acquires_zero_registered_locks(short_root):
+    """The full /status + /metrics endpoint body (StatusServer.status)
+    over a real manager + DRA driver: zero registered-lock acquisitions
+    once warm — a slow scrape can no longer stall ListAndWatch or claim
+    commits behind a held lock."""
+    from tpu_device_plugin.dra import DraDriver
+    from tpu_device_plugin.lifecycle import PluginManager
+    from tpu_device_plugin.status import StatusServer
+
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+        cfg = Config().with_root(host.root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        manager = PluginManager(cfg)
+        registry, generations = discover_passthrough(cfg)
+        manager.plugins = [TpuDevicePlugin(
+            cfg, "v4", registry, registry.devices_by_model["0062"])]
+        driver = DraDriver(cfg, registry, generations, node_name="n")
+        server = StatusServer(manager, port=0, dra_driver=driver)
+        try:
+            server.status()          # warm-up (native shim first touch)
+            server.metrics()
+            lockdep.reset()
+            for _ in range(3):
+                server.status()
+                server.metrics()
+            stats = lockdep.path_stats()
+            assert stats["status.endpoint"]["calls"] >= 6
+            assert stats["status.endpoint"]["lock_acquisitions"] == 0, stats
+        finally:
+            server._httpd.server_close()
+
+
+def test_read_path_counters_surface_on_status(short_root):
+    """The per-path counters are an observable /status surface under
+    lockdep (satellite: expose a per-path registered-lock-acquisition
+    counter)."""
+    from tpu_device_plugin.lifecycle import PluginManager
+    from tpu_device_plugin.status import StatusServer
+
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+        cfg = Config().with_root(host.root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        manager = PluginManager(cfg)
+        registry, _ = discover_passthrough(cfg)
+        manager.plugins = [TpuDevicePlugin(
+            cfg, "v4", registry, registry.devices_by_model["0062"])]
+        manager.plugins[0].status_snapshot()
+        server = StatusServer(manager, port=0)
+        try:
+            out = server.status()
+            assert "server.status_snapshot" in out["read_paths"]
+            text = server.metrics()
+            assert "tdp_read_path_lock_acquisitions_total" in text
+        finally:
+            server._httpd.server_close()

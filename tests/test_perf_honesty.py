@@ -541,3 +541,93 @@ def test_fragment_hit_plan_is_5x_cheaper_by_counted_reads(tmp_path):
     assert fragment_reads(warm) == 0
     assert reval_reads(cold) == reval_reads(warm) == 16
     assert warm.reads < cold.reads
+
+
+# ------------------------------------------------------- epoch read plane
+
+
+def test_bench_attach_r09_pins_lock_free_attach():
+    """Round-9 honesty pins against the RECORDED docs/bench_attach_r09.json
+    (file content, so CI load cannot flip it). The claims this PR makes:
+
+      - COUNTED: a steady-state attach acquires ZERO registered locks
+        (the pre-epoch tree measured 11/attach) — every hot read path's
+        per-path counter is zero;
+      - COUNTED: the live TOCTOU revalidation's sysfs syscall shape is
+        recorded (4 syscalls per allocated member; caching them away
+        would be the dishonest speedup);
+      - the environment-comparable daemon overhead (wall minus the
+        counted-syscalls x in-run-calibration I/O floor) is under the
+        200 us target — the RAW wall is recorded next to its syscall
+        calibration because the I/O floor is an environment property
+        (sub-us native syscalls where BENCH_r05 was recorded, ~20 us in
+        sandboxed kernels; docs/perf.md "lock-free read plane").
+    """
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_attach_r09.json")
+    with open(path) as f:
+        data = json.load(f)
+
+    # counted: zero registered-lock acquisitions, on every hot path
+    assert data["lock_acquisitions_per_attach"] == 0
+    for name, rec in data["lock_path_stats"].items():
+        assert rec["lock_acquisitions"] == 0, (name, rec)
+        assert rec["calls"] > 0, (name, rec)
+    assert {"server.Allocate", "server.GetPreferredAllocation",
+            "server.ListAndWatch.assembly", "server.status_snapshot"} \
+        <= set(data["lock_path_stats"])
+
+    # counted: the TOCTOU revalidation stays live — one readlink (group
+    # link) and one pread (vendor) per allocated member, with their
+    # staleness guards; zero would mean the guard got cached away
+    sys_counts = data["sysfs_syscalls_per_attach"]
+    assert sys_counts["readlink"] == data["allocation_size"]
+    assert sys_counts["pread"] >= data["allocation_size"]
+    assert data["sysfs_syscalls_per_attach_total"] <= 24
+
+    # the breakdown adds up and the daemon-side overhead meets the
+    # target; the RAW wall must also beat r05's recorded 761.9 us even
+    # though this environment runs syscalls ~30x slower than the one
+    # that recorded r05 (the raw <200 us reading needs native-speed
+    # syscalls — the floor alone exceeds it here; see baseline_source)
+    assert data["value"] < 761.9, data
+    assert data["daemon_overhead_p50_us"] < 200, data
+    assert data["sysfs_io_floor_p50_us"] + data["daemon_overhead_p50_us"] \
+        == pytest.approx(data["value"], abs=0.2)
+    # the r05-comparable transport figure is recorded, unclaimed
+    assert data["transport_wall_p50_us"] > 0
+    assert data["syscall_cost_calibration_us"]["stat"] > 0
+
+
+def test_attach_zero_locks_is_live_not_just_recorded(short_root):
+    """Runtime half of the r09 pin: re-count the zero-lock claim on the
+    CURRENT tree (lockdep.scoped proxies; load-insensitive). The full
+    gate lives in tests/test_epoch.py — this is the minimal version the
+    CI bench-smoke job runs next to the artifact pins."""
+    import os
+
+    from tests.fakehost import FakeChip, FakeHost
+    from tpu_device_plugin import lockdep
+    from tpu_device_plugin.config import Config
+    from tpu_device_plugin.discovery import discover_passthrough
+    from tpu_device_plugin.kubeletapi import pb
+    from tpu_device_plugin.server import TpuDevicePlugin
+
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+        cfg = Config().with_root(host.root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        registry, _ = discover_passthrough(cfg)
+        plugin = TpuDevicePlugin(cfg, "v4", registry,
+                                 registry.devices_by_model["0062"])
+        req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devices_ids=["0000:00:04.0"])])
+        plugin.Allocate(req, None)       # warm-up may lock (slow paths)
+        lockdep.reset()
+        plugin.Allocate(req, None)
+        stats = lockdep.path_stats()
+        assert stats["server.Allocate"]["lock_acquisitions"] == 0, stats

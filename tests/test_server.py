@@ -280,9 +280,12 @@ def test_nan_or_negative_debounce_rejected_at_arm_time(short_root):
                             registry, devs)
 
 
-def test_preferred_cache_is_lru_not_wholesale_clear(rig):
-    """Filling the memo past capacity must evict ONLY the oldest entry: a
-    recently-used key stays a hit (the old clear() dumped all 128)."""
+def test_preferred_cache_hot_key_survives_fill_and_epoch_swap(rig):
+    """The per-epoch memo must (a) keep serving a hot key as a HIT while
+    the cache fills past capacity (no wholesale clear mid-epoch), (b) stay
+    bounded at PREF_CACHE_SIZE, and (c) be invalidated by construction on
+    an epoch publish — a health flip swaps in a fresh dict, so the next
+    ask recomputes instead of serving under a dead epoch's key."""
     from tpu_device_plugin import server as server_mod
     host, cfg, kubelet, plugin = rig
 
@@ -294,21 +297,32 @@ def test_preferred_cache_is_lru_not_wholesale_clear(rig):
 
     hot = ["0000:00:04.0", "0000:00:05.0"]
     ask(hot)                                   # miss 1: the hot key
-    misses0 = plugin._pref_misses
+    misses0 = plugin._pref_misses.value
     # fill the cache past capacity with distinct keys (unknown ids are
     # filtered from the scan but stay in the memo key), touching the hot
-    # key along the way so LRU keeps it
+    # key along the way — it was cached before the fill, so it stays one
     for i in range(server_mod.PREF_CACHE_SIZE + 10):
         ask(["0000:00:04.0", f"filler-{i}"])
-        ask(hot)                               # keep the hot key fresh
+        ask(hot)                               # the hot key keeps hitting
     assert len(plugin._pref_cache) <= server_mod.PREF_CACHE_SIZE
-    before_hits = plugin._pref_hits
+    before_hits = plugin._pref_hits.value
     ask(hot)
-    assert plugin._pref_hits == before_hits + 1   # survived eviction: hit
+    assert plugin._pref_hits.value == before_hits + 1
     snap = plugin.status_snapshot()["preferred_cache"]
-    assert snap["hits"] == plugin._pref_hits
+    assert snap["hits"] == plugin._pref_hits.value
     assert snap["misses"] >= misses0
     assert snap["capacity"] == server_mod.PREF_CACHE_SIZE
+    # an epoch publish (health flip) swaps the memo wholesale: the hot
+    # key misses exactly once under the new epoch id, then hits again
+    epoch0 = plugin._store.current.epoch_id
+    plugin.set_devices_health(["0000:00:06.0"], False, source="test")
+    assert plugin._store.current.epoch_id > epoch0
+    misses_before = plugin._pref_misses.value
+    ask(hot)
+    assert plugin._pref_misses.value == misses_before + 1
+    hits_before = plugin._pref_hits.value
+    ask(hot)
+    assert plugin._pref_hits.value == hits_before + 1
 
 
 def test_allocate_rejects_other_models_bdf(short_root):

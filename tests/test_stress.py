@@ -107,8 +107,8 @@ def test_parallel_rpcs_under_health_churn(rig):
                 f.write("")
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline:
-        with plugin._cond:
-            states = {d.health for d in plugin._devs.values()}
+        # lock-free reader contract: the epoch snapshot needs no lock
+        states = set(plugin._store.current.device_health.values())
         if states == {"Healthy"}:
             break
         time.sleep(0.1)

@@ -533,3 +533,127 @@ def test_cli_list_mode_runs(flag):
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "tsalint:" in proc.stdout
+
+
+# ---------------------------------------------------------- epoch-mutation
+
+
+EPOCH_ATTR_WRITE = """
+def poison(store):
+    ep = store.current
+    ep.epoch_id = 99
+"""
+
+EPOCH_DICT_WRITE = """
+def poison(store):
+    ep = store.current
+    ep.device_health["0000:00:04.0"] = "Unhealthy"
+"""
+
+EPOCH_MUTATOR_CALL = """
+def poison(store):
+    ep = store.current
+    ep.device_health.update({"x": "Unhealthy"})
+"""
+
+EPOCH_PARAM_WRITE = """
+def assemble(ep):
+    ep.lw_payload = b"stale"
+"""
+
+EPOCH_ATTR_CHAIN_WRITE = """
+class C:
+    def flip(self):
+        self._inv_epoch.unhealthy.add("dead")
+"""
+
+EPOCH_CLEAN_READS = """
+def serve(store):
+    ep = store.current
+    health = dict(ep.device_health)
+    n = len(ep.device_health)
+    return ep.epoch_id, health, n
+"""
+
+EPOCH_REBOUND_LOCAL = """
+def fine(store):
+    ep = store.current
+    ep = {}          # rebinding releases the alias...
+    ep["k"] = 1      # ...but the NAME stays epoch-like by convention
+"""
+
+
+def test_epoch_attr_write_fires():
+    findings = run(EPOCH_ATTR_WRITE)
+    assert rules(findings) == ["epoch-mutation"]
+    assert "ep.epoch_id" in findings[0].message
+
+
+def test_epoch_dict_write_fires():
+    findings = run(EPOCH_DICT_WRITE)
+    assert rules(findings) == ["epoch-mutation"]
+
+
+def test_epoch_mutator_call_fires():
+    findings = run(EPOCH_MUTATOR_CALL)
+    assert rules(findings) == ["epoch-mutation"]
+    assert findings[0].detail.endswith("update()")
+
+
+def test_epoch_param_write_fires():
+    findings = run(EPOCH_PARAM_WRITE)
+    assert rules(findings) == ["epoch-mutation"]
+
+
+def test_epoch_attr_chain_write_fires():
+    findings = run(EPOCH_ATTR_CHAIN_WRITE)
+    assert rules(findings) == ["epoch-mutation"]
+
+
+def test_epoch_reads_are_clean():
+    assert run(EPOCH_CLEAN_READS) == []
+
+
+def test_epoch_name_convention_still_guards_rebound_local():
+    # the name-based net is deliberately wider than the alias tracking:
+    # a local NAMED ep stays treated as an epoch even after rebinding
+    findings = run(EPOCH_REBOUND_LOCAL)
+    assert rules(findings) == ["epoch-mutation"]
+
+
+def test_epoch_builder_module_is_exempt():
+    # the same mutation inside epoch.py (the builder) is the one place
+    # allowed to assemble epoch state
+    assert run(EPOCH_DICT_WRITE, path="tpu_device_plugin/epoch.py") == []
+
+
+def test_epoch_unrelated_writes_are_clean():
+    src = """
+class C:
+    def bump(self):
+        self._fds["k"] = 3
+        self.counter = self.counter + 1
+        self._unhealthy.add("x")
+"""
+    assert run(src) == []
+
+
+EPOCH_CURRENT_CHAIN_WRITE = """
+class C:
+    def poison(self):
+        self._store.current.device_health["x"] = "Unhealthy"
+
+def poison2(store):
+    store.current.lw_payload = b"stale"
+
+def poison3(store):
+    store.current.device_health.update({"x": "Unhealthy"})
+"""
+
+
+def test_epoch_current_chain_write_fires_without_alias():
+    # the most direct mutation shape — straight through `.current`, no
+    # intermediate local for the alias tracking to catch
+    findings = run(EPOCH_CURRENT_CHAIN_WRITE)
+    assert rules(findings) == ["epoch-mutation"]
+    assert len(findings) == 3

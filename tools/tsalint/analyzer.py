@@ -33,6 +33,10 @@ from .config import LintConfig
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 THREAD_FACTORIES = {"Thread", "Timer"}
+# container-mutating method names for the epoch-mutation rule: calling
+# one of these on an epoch-rooted receiver mutates published state
+EPOCH_MUTATORS = {"update", "clear", "pop", "popitem", "setdefault",
+                  "append", "extend", "insert", "remove", "add", "discard"}
 
 
 @dataclass(frozen=True)
@@ -66,6 +70,14 @@ def _render(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Call):
         return _render(node.func)
     return None
+
+
+def _epoch_like(name: str) -> bool:
+    """Name-level epoch detection for the epoch-mutation rule: `ep`,
+    `epoch`, or any `*_epoch` local/attribute segment is treated as
+    epoch-rooted (the codebase convention; the `.current` / builder-call
+    alias tracking catches differently-named locals)."""
+    return name in ("ep", "epoch") or name.endswith("_epoch")
 
 
 def _unwrap_instrument(call: ast.Call) -> ast.expr:
@@ -154,6 +166,9 @@ class _FuncFacts:
     # aliases resolve) seen in this function; join carries has-timeout
     join_calls: List[Tuple[str, bool]] = field(default_factory=list)
     cancel_calls: List[str] = field(default_factory=list)
+    # (rendered write target, line) for attribute/dict writes (or
+    # mutating method calls) on epoch-rooted expressions
+    epoch_writes: List[Tuple[str, int]] = field(default_factory=list)
 
 
 class _FunctionWalker(ast.NodeVisitor):
@@ -168,10 +183,17 @@ class _FunctionWalker(ast.NodeVisitor):
         self.facts = _FuncFacts(qualname=qualname, path=module.path)
         self.held: List[str] = []
         self.aliases: Dict[str, str] = {}   # local name -> "self.<attr>"
+        # locals known to hold an epoch (bound from a `.current` read, a
+        # build_*epoch(...) call, or a parameter with an epoch-like name)
+        self.epoch_aliases: set = set()
         self.self_name: Optional[str] = None
         args = getattr(func, "args", None)
         if cls is not None and args is not None and args.args:
             self.self_name = args.args[0].arg
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                if _epoch_like(a.arg) and a.arg != self.self_name:
+                    self.epoch_aliases.add(a.arg)
         self._func = func
 
     # ------------------------------------------------------------ resolve
@@ -202,6 +224,36 @@ class _FunctionWalker(ast.NodeVisitor):
         if isinstance(node, ast.Attribute):
             return self.a.unique_lock_attr(node.attr)
         return None
+
+    def _epoch_rooted(self, node: ast.AST) -> bool:
+        """True when the attribute/subscript chain under `node` is rooted
+        at (or passes through) an epoch: a tracked epoch local, any chain
+        segment with an epoch-like name, or a `.current` store read
+        (`store.current.x = ...` mutates the published epoch directly,
+        with no alias for the alias tracking to catch)."""
+        segs: List[str] = []
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            if isinstance(node, ast.Attribute):
+                # `.current` is only epoch-like as an ATTRIBUTE segment
+                # (a store read); a bare local named `current` is not
+                if node.attr == "current":
+                    return True
+                segs.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            if node.id in self.epoch_aliases:
+                return True
+            segs.append(node.id)
+        return any(_epoch_like(s) for s in segs)
+
+    def _note_epoch_write(self, target: ast.AST, line: int) -> None:
+        """Record an attribute/dict write whose base is epoch-rooted.
+        Rebinding a bare Name is construction, not mutation."""
+        if not isinstance(target, (ast.Attribute, ast.Subscript)):
+            return
+        if self._epoch_rooted(target.value):
+            self.facts.epoch_writes.append(
+                (_render(target) or "<epoch>", line))
 
     def _callee(self, call: ast.Call) -> Optional[str]:
         func = call.func
@@ -254,6 +306,23 @@ class _FunctionWalker(ast.NodeVisitor):
                 isinstance(node.value.value, ast.Name) and \
                 node.value.value.id == self.self_name:
             self.aliases[node.targets[0].id] = node.value.attr
+        # epoch alias tracking: `x = <store>.current` and
+        # `x = build_*epoch(...)` bind an epoch; a later rebinding to
+        # anything else releases the alias
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            value = node.value
+            is_epoch = (isinstance(value, ast.Attribute)
+                        and value.attr == "current")
+            if not is_epoch and isinstance(value, ast.Call):
+                rendered_fn = _render(value.func) or ""
+                is_epoch = "epoch" in rendered_fn.rsplit(".", 1)[-1]
+            if is_epoch:
+                self.epoch_aliases.add(name)
+            else:
+                self.epoch_aliases.discard(name)
+        for target in node.targets:
+            self._note_epoch_write(target, node.lineno)
         if len(node.targets) == 1 and \
                 isinstance(node.targets[0], ast.Tuple) and \
                 isinstance(node.value, ast.Tuple) and \
@@ -271,6 +340,7 @@ class _FunctionWalker(ast.NodeVisitor):
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._note_counter_write(node.target, None, node.lineno,
                                  always=True)
+        self._note_epoch_write(node.target, node.lineno)
         self.generic_visit(node)
 
     def _counter_form(self, target: ast.AST) -> Optional[str]:
@@ -346,6 +416,14 @@ class _FunctionWalker(ast.NodeVisitor):
                 self.facts.join_calls.append((target, has_timeout))
             else:
                 self.facts.cancel_calls.append(target)
+
+        # mutating method call on an epoch-rooted receiver
+        # (epoch-mutation rule): ep.devices.update(...) etc.
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in EPOCH_MUTATORS and \
+                self._epoch_rooted(node.func.value):
+            self.facts.epoch_writes.append(
+                (f"{_render(node.func) or '<epoch>'}()", node.lineno))
 
         # blocking calls
         if self.a.is_blocking_name(rendered):
@@ -675,9 +753,10 @@ class Analyzer:
         findings += self._rule_counters(entry_ctx)
         findings += self._rule_fault_sites()
         findings += self._rule_threads()
+        findings += self._rule_epoch_mutation()
         order = {r: i for i, r in enumerate((
             "lock-order-cycle", "blocking-under-hot-lock", "counter-lock",
-            "fault-site", "thread-lifecycle"))}
+            "fault-site", "thread-lifecycle", "epoch-mutation"))}
         findings.sort(key=lambda f: (order.get(f.rule, 99), f.path, f.line))
         return findings
 
@@ -870,6 +949,30 @@ class Analyzer:
                                 f"on an attribute that a stop() path "
                                 f"{what}",
                         detail=f"not-joined:{site.factory}"))
+        return findings
+
+
+    def _rule_epoch_mutation(self) -> List[Finding]:
+        """No mutation of a published Epoch outside epoch.py's builders:
+        epochs are the lock-free read plane, and readers are correct only
+        because what they point at can never change — any attribute/dict
+        write (or container-mutator call) on an epoch-rooted expression
+        in a non-builder module fails the lint. Builder modules
+        (config.epoch_modules, default {"epoch"}) are exempt wholesale."""
+        findings = []
+        exempt = self.config.epoch_modules
+        for qual, facts in self.facts.items():
+            mod_name = facts.path.rsplit("/", 1)[-1].removesuffix(".py")
+            if mod_name in exempt:
+                continue
+            for target, line in facts.epoch_writes:
+                findings.append(Finding(
+                    rule="epoch-mutation", path=facts.path, qualname=qual,
+                    line=line,
+                    message=f"mutation of published epoch state {target!r} "
+                            f"outside epoch.py's builders (epochs are "
+                            f"immutable: build a successor and publish it)",
+                    detail=target))
         return findings
 
 

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import lockdep
 from .config import Config
+from .epoch import AtomicCounter
 from .kubeletapi import pb
 from .naming import sanitize_name
 from .readcount import WindowRegistry
@@ -55,21 +56,31 @@ class LiveAttrReader:
     """Kept-open-fd live reads of small sysfs attributes.
 
     pread(fd, …, 0) re-runs the attribute's sysfs show() on every call, so
-    the read stays LIVE (TOCTOU-guard grade) at stat+fstat+pread cost
-    instead of open+read+close. Staleness is detected two ways, because
+    the read stays LIVE (TOCTOU-guard grade) at stat+pread cost (plus one
+    fstat per slow-path install) instead of open+read+close per call.
+    Staleness is detected two ways, because
     the plugin also runs over regular-file roots (tests, --root
     re-rooting) where an unlinked file's fd would otherwise keep serving
     old bytes forever: the PATH's (st_dev, st_ino) identity is compared
     against the cached fd's — catching unlink/replace on any filesystem,
     including ones that report st_nlink >= 1 for open unlinked files
-    (9p/overlay, where the previous nlink==0 probe never fired) — and
-    pread errors/empty reads catch sysfs inode invalidation. Either falls
-    back to a fresh open, so a genuinely new device at the same path is
-    still re-validated from scratch.
-    get + fstat + pread + stale-path close happen under one lock: a close
-    outside it could free the fd NUMBER for reuse by a concurrent open
-    while another thread still preads it, silently reading an unrelated
-    file.
+    (9p/overlay) — and pread errors/empty reads catch sysfs inode
+    invalidation. Either falls back to a fresh open, so a genuinely new
+    device at the same path is still re-validated from scratch.
+
+    The STEADY-STATE read is LOCK-FREE (the Allocate path's lockdep gate
+    pins zero acquisitions): the cache maps key -> an immutable
+    (fd, st_dev, st_ino) record, and the fast path is stat(path) ==
+    cached identity -> pread(fd) -> RECORD RECHECK (`_fds.get(key) is
+    rec`). The recheck closes the fd-reuse hole a lock used to close,
+    completely: every replace/evict swaps the dict entry BEFORE closing
+    the old fd, so "rec still cached after the pread" happens-before any
+    close of rec's fd — the bytes are genuine. If the record moved, the
+    pread may have raced a close/reuse (even a double reuse landing back
+    on a matching inode — the ABA a trailing fstat could not rule out),
+    so the bytes are discarded and the slow path re-reads fresh. A
+    closed-unreused fd preads EBADF and falls through identically.
+    Only the slow path (first open, stale replace) takes `_lock`.
 
     read() returns non-empty fresh bytes or None — an empty file is
     reported as None (and never cached), keeping the contract single-faced
@@ -77,62 +88,100 @@ class LiveAttrReader:
     """
 
     def __init__(self) -> None:
-        self._fds: Dict[str, int] = {}
+        # key -> (fd, st_dev, st_ino); records are immutable tuples,
+        # replaced (never mutated) under _lock
+        self._fds: Dict[str, Tuple[int, int, int]] = {}
         self._lock = lockdep.instrument(
             "allocate.LiveAttrReader._lock", threading.Lock())
 
     def __del__(self, _close=os.close):
         # _close bound at def time: os.close may already be torn down when
         # a reader is collected at interpreter shutdown
-        for fd in getattr(self, "_fds", {}).values():
+        for rec in getattr(self, "_fds", {}).values():
             try:
-                _close(fd)
+                _close(rec[0])
             except OSError:
                 pass
 
     def read(self, key: str, path: str) -> Optional[bytes]:
         """Fresh non-empty bytes of `path` (cached fd keyed by `key`);
         None if gone/unreadable/empty."""
-        with self._lock:
-            fd = self._fds.get(key)
-            if fd is not None:
-                try:
-                    st_path = os.stat(path)
-                    st_fd = os.fstat(fd)
-                    if (st_path.st_dev, st_path.st_ino) \
-                            == (st_fd.st_dev, st_fd.st_ino):
-                        raw = os.pread(fd, 256, 0)
-                        if raw:
-                            return raw
-                except OSError:
-                    pass
-                # stale fd (file unlinked/replaced, inode invalidated, or
-                # content gone): drop it and reopen
-                del self._fds[key]
-                try:
-                    os.close(fd)
-                except OSError:
-                    pass
+        rec = self._fds.get(key)          # GIL-atomic; no lock
+        if rec is not None:
+            fd, dev, ino = rec
+            try:
+                st = os.stat(path)
+                if (st.st_dev, st.st_ino) == (dev, ino):
+                    raw = os.pread(fd, 256, 0)
+                    # record recheck (class docstring): replaces swap the
+                    # dict entry before closing the fd, so rec still
+                    # being cached proves no close raced the pread
+                    if raw and self._fds.get(key) is rec:
+                        return raw
+            except OSError:
+                pass
+            # stale record (file unlinked/replaced, inode invalidated,
+            # fd swapped under us, or content gone): slow path
+        return self._read_slow(key, path, rec)
+
+    def _read_slow(self, key: str, path: str,
+                   stale: Optional[Tuple[int, int, int]]) -> Optional[bytes]:
+        """Open fresh, read, and (re)install the record under the lock.
+        `stale` is the record the fast path found wanting — evicted (and
+        its fd closed) only if it is still the cached one."""
         try:
             fd = os.open(path, os.O_RDONLY)
         except OSError:
+            self._evict(key, stale)
             return None
         try:
             raw = os.pread(fd, 256, 0)
+            st = os.fstat(fd)
         except OSError:
             os.close(fd)
+            self._evict(key, stale)
             return None
         if not raw:
             os.close(fd)   # empty attribute: report None, never cache
+            self._evict(key, stale)
             return None
+        rec = (fd, st.st_dev, st.st_ino)
+        close_fd: Optional[int] = None
         with self._lock:
             prev = self._fds.get(key)
-            if prev is None:
-                self._fds[key] = fd
-                fd = None   # ownership transferred to the cache
-        if fd is not None:   # lost the race; another thread cached one
-            os.close(fd)
+            if prev is None or prev is stale:
+                # ORDERING CONTRACT: the dict swap (here, under the lock)
+                # happens-before the close below — the fast path's record
+                # recheck relies on it
+                self._fds[key] = rec
+                if prev is not None:
+                    close_fd = prev[0]   # the replaced stale fd
+            else:
+                close_fd = fd   # lost the race; another thread installed
+        if close_fd is not None:
+            # closing a replaced fd can race a concurrent fast-path pread
+            # on it — that reader's record recheck discards the bytes
+            # (the entry was already swapped), so the close is safe here
+            try:
+                os.close(close_fd)
+            except OSError:
+                pass
         return raw
+
+    def _evict(self, key: str,
+               stale: Optional[Tuple[int, int, int]]) -> None:
+        if stale is None:
+            return
+        with self._lock:
+            if self._fds.get(key) is stale:
+                del self._fds[key]
+            else:
+                stale = None   # someone else already replaced/evicted it
+        if stale is not None:
+            try:
+                os.close(stale[0])
+            except OSError:
+                pass
 
 
 def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
@@ -234,13 +283,14 @@ class _GroupFragment:
     per-member TOCTOU revalidation (group link + vendor), which stays a
     live read on every plan.
 
-    Invalidation: health flaps drop the affected group's fragment through
-    `AllocationPlanner.invalidate_fragments` (wired from the same PR-2
-    dirty/delta plumbing that hints incremental rediscovery), and an
-    iommufd-state flip misses naturally (the flag is part of the fragment).
-    Blind spot: a vfio cdev renamed with NO membership change and NO
-    health event serves the stale cdev name until a flap or rebuild —
-    the same contract as incremental discovery (docs/perf.md).
+    Invalidation is BY CONSTRUCTION: fragments live in a cache keyed by
+    the caller's epoch token (epoch.py), and a health flap publishes a
+    new epoch — the next plan starts a fresh cache and re-lists cdevs.
+    An iommufd-state flip misses naturally inside an epoch (the flag is
+    part of the fragment). Blind spot: a vfio cdev renamed with NO
+    membership change and NO health event serves the stale cdev name
+    until a flap or rebuild — the same contract as incremental
+    discovery (docs/perf.md).
     """
 
     __slots__ = ("iommufd", "member_bdfs", "iommufd_specs", "cdi_names")
@@ -269,8 +319,10 @@ class AllocationPlanner:
     a multi-group request those reads are batched through one pass — and
     the iommufd probe re-stats /dev/iommu (:362,692-701). The vfio cdev
     names and the rest of the per-group response live in a precompiled
-    _GroupFragment, invalidated on health flaps (the reference re-listed
-    them per Allocate, :702-716). The shared-device (EGM-analogue) scan is
+    _GroupFragment cache keyed by the caller's epoch token — a health
+    flap publishes a new epoch, so fragments are invalidated by
+    construction (the reference re-listed cdevs per Allocate, :702-716).
+    The shared-device (EGM-analogue) scan is
     cached for cfg.shared_scan_ttl_s (0 = the reference's
     rescan-every-Allocate behavior, :366,120-157).
 
@@ -331,57 +383,63 @@ class AllocationPlanner:
         self._shared_expires = 0.0
         self._iommufd_cache: Optional[bool] = None
         self._iommufd_expires = 0.0
-        # precompiled per-group response fragments (see _GroupFragment);
-        # guarded by their own lock — plan() runs on concurrent gRPC worker
-        # threads while health listeners invalidate from hub threads
-        self._fragments: Dict[str, _GroupFragment] = {}
-        self._frag_lock = lockdep.instrument(
-            "allocate.AllocationPlanner._frag_lock", threading.Lock())
-        # bumped by every invalidation; a build that was in flight when an
-        # invalidation landed must not store its (possibly pre-flap)
-        # result — see _fragment
-        self._frag_epoch = 0
-        self.fragment_hits = 0
-        self.fragment_misses = 0
+        # Precompiled per-group response fragments (see _GroupFragment),
+        # keyed by EPOCH: the cache is a tuple of at most TWO
+        # (epoch_token, dict) slots, newest first — a plan arriving with
+        # an unseen token swaps in a fresh dict, retiring the oldest
+        # slot. Invalidation by construction, replacing the PR-4
+        # health-listener plumbing AND its lock; the second slot keeps a
+        # long-running prepare pinned to the PREVIOUS inventory epoch
+        # from ping-ponging the cache against new-epoch Allocates.
+        # plan() runs on concurrent gRPC worker threads: lookups/stores
+        # are GIL-atomic dict ops on the dict captured at plan start, so
+        # a build racing an epoch swap lands in the orphaned dict
+        # (served once, never reachable from the new epoch) — the old
+        # _frag_epoch guard, for free.
+        self._frag_cache: Tuple[Tuple[object, Dict[str, _GroupFragment]],
+                                ...] = ()
+        self.fragment_hits = AtomicCounter()
+        self.fragment_misses = AtomicCounter()
 
     # ------------------------------------------------------ group fragments
 
-    def invalidate_fragments(self, bdfs: Optional[Sequence[str]] = None) -> None:
-        """Drop the cached fragments of the groups owning `bdfs` (all
-        fragments when None). Wired from the health listeners so a flapped
-        device's group is recompiled — cdev names re-listed — on its next
-        plan, the same dirty plumbing that hints incremental rediscovery."""
-        with self._frag_lock:
-            self._frag_epoch += 1
-            if bdfs is None:
-                self._fragments.clear()
-                return
-            for bdf in bdfs:
-                group = self.registry.bdf_to_group.get(bdf)
-                if group is not None:
-                    self._fragments.pop(group, None)
+    def invalidate_fragments(self) -> None:
+        """Manual WHOLESALE drop (tests / ad-hoc callers). Production
+        invalidation is by epoch key: the plugin servers and the DRA
+        driver pass their current epoch id to plan(), and a health flip
+        publishes a new epoch. Emptying the slots means the next plan —
+        whatever token it passes, even an unchanged one — starts fresh."""
+        self._frag_cache = ()
 
     def fragment_stats(self) -> Dict[str, int]:
-        with self._frag_lock:
-            return {"hits": self.fragment_hits,
-                    "misses": self.fragment_misses,
-                    "size": len(self._fragments)}
+        slots = self._frag_cache
+        return {"hits": self.fragment_hits.value,
+                "misses": self.fragment_misses.value,
+                "size": len(slots[0][1]) if slots else 0}
 
-    def _fragment(self, group: str, iommufd: bool) -> _GroupFragment:
-        with self._frag_lock:
-            frag = self._fragments.get(group)
-            if frag is not None and frag.iommufd == iommufd:
-                self.fragment_hits += 1
-                return frag
-            self.fragment_misses += 1
-            epoch = self._frag_epoch
+    def _fragments_for(self, epoch: Optional[object]
+                       ) -> Dict[str, _GroupFragment]:
+        """The fragment dict for this epoch token (fresh when the token
+        is unseen; the previous epoch's slot is retained so concurrent
+        plans on adjacent epochs never thrash each other's caches; racy
+        swaps are benign — every racer starts empty)."""
+        slots = self._frag_cache
+        for token, frags in slots:
+            if token == epoch:
+                return frags
+        frags = {}
+        self._frag_cache = ((epoch, frags),) + slots[:1]
+        return frags
+
+    def _fragment(self, group: str, iommufd: bool,
+                  frags: Dict[str, _GroupFragment]) -> _GroupFragment:
+        frag = frags.get(group)
+        if frag is not None and frag.iommufd == iommufd:
+            self.fragment_hits.add()
+            return frag
+        self.fragment_misses.add()
         frag = self._build_fragment(group, iommufd)
-        with self._frag_lock:
-            # an invalidation that landed mid-build may have been aimed at
-            # what this build just read (a flap racing the listdir): serve
-            # the result but never cache it — the next plan recompiles
-            if self._frag_epoch == epoch:
-                self._fragments[group] = frag
+        frags[group] = frag
         return frag
 
     def _build_fragment(self, group: str, iommufd: bool) -> _GroupFragment:
@@ -467,6 +525,7 @@ class AllocationPlanner:
         self,
         requested_bdfs: Sequence[str],
         shared_devices: Optional[Sequence[SharedDevice]] = None,
+        epoch: Optional[object] = None,
     ) -> AllocationPlan:
         """Build the DeviceSpec list + env map for one container request.
 
@@ -475,13 +534,17 @@ class AllocationPlanner:
         then iommufd cdevs + /dev/iommu, then qualifying shared devices.
 
         The per-group expansion is fragment concatenation (_GroupFragment
-        cache) plus ONE batched live-revalidation pass over every member of
-        every requested group — the TOCTOU guard is never cached.
+        cache, keyed by the caller's `epoch` token — health flips publish
+        a new epoch, so fragments are invalidated by construction) plus
+        ONE batched live-revalidation pass over every member of every
+        requested group — the TOCTOU guard is never cached. Steady state
+        acquires ZERO registered locks (the lockdep read-path gate).
         """
         registry = self.registry
         iommufd = self._iommufd()
         if shared_devices is None:
             shared_devices = self.shared_devices()
+        frags = self._fragments_for(epoch)
 
         # dedup with a set (membership was an O(n^2) list probe across a
         # request's groups) while keeping the reference's spec ordering
@@ -502,7 +565,7 @@ class AllocationPlanner:
                 continue
             seen_groups.add(group)
             ordered_groups.append(group)
-            frag = self._fragment(group, iommufd)
+            frag = self._fragment(group, iommufd, frags)
             fragments.append(frag)
             revalidate.extend((m, group) for m in frag.member_bdfs)
         # one batched pass for the whole request (multi-group requests no
@@ -543,13 +606,16 @@ class AllocationPlanner:
         return AllocationPlan(device_specs=specs, envs=envs,
                               expanded_bdfs=expanded, cdi_names=cdi_names)
 
-    def allocate_response(self, request: pb.AllocateRequest) -> pb.AllocateResponse:
+    def allocate_response(self, request: pb.AllocateRequest,
+                          epoch: Optional[object] = None
+                          ) -> pb.AllocateResponse:
         """Full Allocate handler body: one ContainerAllocateResponse per
-        container request in the AllocateRequest."""
+        container request in the AllocateRequest. `epoch` keys the
+        fragment cache (see plan)."""
         shared = self.shared_devices()
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
-            plan = self.plan(list(creq.devices_ids), shared)
+            plan = self.plan(list(creq.devices_ids), shared, epoch=epoch)
             cresp = pb.ContainerAllocateResponse(
                 envs=plan.envs, devices=plan.device_specs)
             if self.cdi_enabled:

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from . import epoch as epoch_mod
 from . import faults
 from . import lockdep
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
@@ -182,8 +183,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # published ResourceSlice so a DRA-only scheduler can never allocate
         # dead hardware (parity with the classic path's one-ListAndWatch-send
         # propagation, server.py set_devices_health). Keyed by raw id so the
-        # set survives set_inventory() swaps.
+        # set survives set_inventory() swaps. WRITER-owned (mutated under
+        # _lock); readers see the frozenset published into the epoch.
         self._unhealthy: set = set()
+        # The read plane (epoch.py): prepare planning, slice builds and
+        # /status read `self._inv_store.current` — an immutable
+        # InventoryEpoch (by_name, planners, parent planner, unhealthy
+        # set) — and never take _lock. set_inventory/apply_health are the
+        # only publishers (under _lock).
+        self._inv_store = epoch_mod.EpochStore(
+            initial=epoch_mod.InventoryEpoch(0))
         self._republish_timer: Optional[threading.Timer] = None
         # jittered delay for the self-armed republish retry; reset by any
         # successful publish. Chaos tests inject a seeded/faster policy.
@@ -331,18 +340,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def set_inventory(self, registry: Registry,
                       generations: Dict[str, GenerationInfo]) -> None:
-        """Swap the discovery snapshot (rediscovery path)."""
+        """Swap the discovery snapshot (rediscovery path): build the new
+        name map + planners into locals, then publish ONE immutable
+        InventoryEpoch — readers switch atomically, mid-flight prepares
+        finish against the epoch they started with."""
         sticky_dirty = False
         with self._lock:
             self.registry = registry
             self.generations = generations
             entries: List[Tuple[str, str, str, object]] = []  # raw,kind,grp,obj
-            self._planners: Dict[str, AllocationPlanner] = {}
+            planners: Dict[str, AllocationPlanner] = {}
             for model, devs in sorted(registry.devices_by_model.items()):
                 info = generations.get(model)
                 gen = info.name if info else f"tpu-{model}"
-                if gen not in self._planners:
-                    self._planners[gen] = AllocationPlanner(
+                if gen not in planners:
+                    planners[gen] = AllocationPlanner(
                         self.cfg, registry, gen)
                 entries.extend((d.bdf, "chip", gen, d) for d in devs)
             for type_name, parts in sorted(registry.partitions_by_type.items()):
@@ -361,14 +373,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 self._sticky_suffixed |= suffixed
                 self._label_owners.update(owned)
                 sticky_dirty = True
-            self._by_name: Dict[str, Tuple[str, str, object]] = {
+            by_name: Dict[str, Tuple[str, str, object]] = {
                 names[raw]: (kind, group, obj)
                 for raw, kind, group, obj in entries}
             # devices that left the inventory take their health state along
             self._unhealthy &= set(names)
-            # vfio-backed logical partitions ride their parent's planner
-            self._parent_planner = AllocationPlanner(
-                self.cfg, registry, "vtpu-parent")
+            self._inv_store.publish(epoch_mod.build_inventory_epoch(
+                self._inv_store.current.epoch_id + 1, by_name, planners,
+                # vfio-backed logical partitions ride their parent's planner
+                AllocationPlanner(self.cfg, registry, "vtpu-parent"),
+                frozenset(self._unhealthy)))
         if sticky_dirty:
             # file I/O stays OUTSIDE the global lock (a slow disk must not
             # stall claim prepares / slice builds); _save_sticky_names
@@ -419,12 +433,14 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         path where an Unhealthy device simply stops being allocatable.
         """
         version = version or self.resource_api_version()
-        with self._lock:
-            devices = [self._device_entry(name, kind, group_name, obj,
-                                          version)
-                       for name, (kind, group_name, obj)
-                       in self._by_name.items()
-                       if self._raw_id(kind, obj) not in self._unhealthy]
+        # read the inventory epoch, no lock: the slice body is a pure
+        # function of one immutable snapshot
+        ep = self._inv_store.current
+        devices = [self._device_entry(name, kind, group_name, obj,
+                                      version)
+                   for name, (kind, group_name, obj)
+                   in ep.by_name.items()
+                   if self._raw_id(kind, obj) not in ep.unhealthy]
         slice_obj = {
             "apiVersion": f"resource.k8s.io/{version}",
             "kind": "ResourceSlice",
@@ -509,8 +525,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         """
         with self._lock:
             before = set(self._unhealthy)
+            ep = self._inv_store.current
             known = {self._raw_id(kind, obj)
-                     for kind, _, obj in self._by_name.values()}
+                     for kind, _, obj in ep.by_name.values()}
             for raw, healthy in transitions.items():
                 if raw not in known:
                     continue
@@ -520,30 +537,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                     self._unhealthy.add(raw)
             # ids whose EFFECTIVE verdict moved — the listener re-delivers
             # unchanged snapshots by design (server.py), and those must
-            # cost nothing here: no fragment invalidation (each bump also
-            # evicts concurrently-built fragments), no inventory walks
-            flipped = before ^ self._unhealthy
-            changed = bool(flipped)
+            # cost nothing here: no epoch publish (each publish also
+            # retires concurrently-built fragment caches), no inventory
+            # walks. A real flip publishes the next epoch, which is ALSO
+            # what invalidates every planner's precompiled fragments —
+            # plan() keys its cache on the epoch id, so the per-planner
+            # invalidate-listener plumbing is gone.
+            changed = bool(before ^ self._unhealthy)
             if changed:
                 dead = sorted(self._unhealthy)
-                planners = (list(self._planners.values())
-                            + [self._parent_planner])
-                # flips are keyed by raw id — partition UUIDs resolve to
-                # their PARENT's BDF (the fragments at stake live in the
-                # parent-group planners; a bare uuid would no-op the
-                # lookup, same mapping vtpu._invalidate_alloc_fragments
-                # does); scoped to the flipped ids, not the inventory
-                parent_of = {obj.uuid: obj.parent_bdf
-                             for kind, _, obj in self._by_name.values()
-                             if kind == "partition" and obj.uuid in flipped}
+                self._inv_store.publish(epoch_mod.build_inventory_epoch(
+                    ep.epoch_id + 1, ep.by_name, ep.planners,
+                    ep.parent_planner, frozenset(self._unhealthy)))
         if not changed:
             return False
-        # flapped chips drop their groups' precompiled Allocate fragments
-        # (allocate._GroupFragment) so the next prepare recompiles them —
-        # the same dirty plumbing that hints incremental rediscovery
-        bdfs = [parent_of.get(raw, raw) for raw in flipped]
-        for planner in planners:
-            planner.invalidate_fragments(bdfs)
         log.warning("DRA: health transition; unhealthy devices now %s",
                     dead or "none")
         if not self.publish_resource_slices():
@@ -580,9 +587,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         if not self.publish_resource_slices():
             self._arm_republish_retry()
 
+    @property
+    def _by_name(self) -> Dict[str, Tuple[str, str, object]]:
+        """The current epoch's published-name map (read-only view);
+        kept as an attribute-shaped surface for tests/debugging."""
+        return self._inv_store.current.by_name
+
     def unhealthy_devices(self) -> List[str]:
-        with self._lock:
-            return sorted(self._unhealthy)
+        # epoch frozenset: no lock, no copy-while-mutating hazard
+        return sorted(self._inv_store.current.unhealthy)
 
     def _node_owner_ref(self) -> Optional[dict]:
         """Owner reference to the Node so slices are garbage-collected when
@@ -946,9 +959,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 self._ckpt_cond.notify_all()
 
     def checkpoint_stats(self) -> dict:
-        with self._ckpt_cond:
-            out = dict(self.checkpoint_stats_counters)
-            out["prepare_inflight"] = self._prepare_inflight
+        # lock-free read side: the counter dict has FIXED keys (values
+        # mutated under _ckpt_cond by the writer), so dict() is one
+        # C-atomic copy and the int reads are GIL-atomic — /status never
+        # queues behind a checkpoint commit window
+        out = dict(self.checkpoint_stats_counters)
+        out["prepare_inflight"] = self._prepare_inflight
         out["prepare_workers"] = self.prepare_workers
         return out
 
@@ -1037,14 +1053,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         results = ((alloc.get("devices") or {}).get("results")) or []
         return [r for r in results if r.get("driver") == self.driver_name]
 
-    def _inventory_snapshot(self) -> tuple:
-        """(by_name, planners, parent_planner) refs under the lock, so
-        device planning — sysfs reads, fragment assembly — runs OUTSIDE it
-        against one consistent snapshot while set_inventory stays free to
-        swap. The maps themselves are replaced wholesale on swap, never
-        mutated in place, so the refs stay internally consistent."""
-        with self._lock:
-            return self._by_name, self._planners, self._parent_planner
+    def _inventory_snapshot(self) -> epoch_mod.InventoryEpoch:
+        """The current inventory epoch — ONE atomic reference read, no
+        lock. Device planning (sysfs reads, fragment assembly) runs
+        against this immutable snapshot while set_inventory/apply_health
+        stay free to publish successors."""
+        return self._inv_store.current
 
     def _plan_devices(self, results: Sequence[dict], snapshot=None):
         """(device_specs, envs) for a claim's allocated devices.
@@ -1052,11 +1066,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         Chips group by generation through the same AllocationPlanner the
         device-plugin Allocate uses (TOCTOU revalidation, group expansion,
         iommufd, shared devices); partitions follow vtpu.py's node rules.
-        Runs lock-free against an _inventory_snapshot: concurrent claims
-        must never queue behind each other's sysfs reads.
+        Runs lock-free against an inventory epoch (the lockdep read-path
+        gate pins zero registered-lock acquisitions): concurrent claims
+        must never queue behind each other's sysfs reads, and the epoch
+        id keys each planner's precompiled fragments.
         """
+        with lockdep.read_path("dra.plan"):
+            return self._plan_devices_impl(
+                results,
+                snapshot if snapshot is not None
+                else self._inventory_snapshot())
+
+    def _plan_devices_impl(self, results: Sequence[dict],
+                           ep: epoch_mod.InventoryEpoch):
         by_name, planners, parent_planner = \
-            snapshot if snapshot is not None else self._inventory_snapshot()
+            ep.by_name, ep.planners, ep.parent_planner
         specs: List = []
         envs: Dict[str, str] = {}
         seen_paths: set = set()
@@ -1083,7 +1107,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
         from .kubeletapi import pb
         for gen, bdfs in sorted(chips_by_gen.items()):
-            plan = planners[gen].plan(bdfs)
+            plan = planners[gen].plan(bdfs, epoch=ep.epoch_id)
             add_specs(plan.device_specs)
             envs.update(plan.envs)
 
@@ -1127,7 +1151,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                     permissions=self.cfg.partition_node_permissions)])
             else:
                 plan = parent_planner.plan([p.parent_bdf],
-                                           shared_devices=[])
+                                           shared_devices=[],
+                                           epoch=ep.epoch_id)
                 add_specs(plan.device_specs)
                 pci_key = (f"{self.cfg.env_prefix}_"
                            f"{sanitize_name(type_name)}")
@@ -1319,8 +1344,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return regpb.RegistrationStatusResponse()
 
     def prepared_claim_count(self) -> int:
-        with self._lock:
-            return len(self._checkpoint)
+        return len(self._checkpoint)   # len() is GIL-atomic; no lock
 
     # ----------------------------------------------------------- serving
 

@@ -1,0 +1,226 @@
+"""Immutable copy-on-write epochs — the daemon's lock-free read plane.
+
+PRs 2-5 made the daemon concurrent but left every hot read path paying
+lock traffic: a warm Allocate took 11 registered-lock acquisitions
+(fragment lock x4, vendor-reader lock x4, device-table condition x2, memo
+lock x1, measured pre-refactor) and /status took the device-table
+condition while assembling its dict. This module inverts the ownership:
+
+- a single WRITER (the discovery/health reconciler) builds a frozen
+  `Epoch` — device table, effective health verdicts, the pre-serialized
+  ListAndWatch payload — and publishes it with ONE atomic reference swap;
+- READERS (`Allocate`, `GetPreferredAllocation`, ListAndWatch payload
+  assembly, `/status`, DRA prepare planning) grab the current epoch
+  pointer and never acquire a registered lock in steady state. Caches
+  that used to need explicit invalidation (the GetPreferredAllocation
+  memo, the per-IOMMU-group Allocate fragments) are keyed by epoch id
+  instead — invalidated by construction, no listener plumbing.
+
+Immutability is enforced three ways: the dataclasses are frozen, their
+mappings are `MappingProxyType` views, and tsalint's `epoch-mutation`
+rule fails the build on any attribute/dict write to an epoch outside
+this module's builders (docs/static-analysis.md).
+
+Atomicity contract (CPython): attribute reads/writes, `dict.get`,
+single-key `dict` stores, `len()`, `dict(d)` / `list(d.values())` copies
+and `deque.append` are single-bytecode / C-level operations under the
+GIL — the reader side leans on exactly these, nothing subtler. The
+free-threaded build would need the stores to become real atomics; the
+seam is `EpochStore.publish`.
+
+What still locks, by design (docs/perf.md "what still locks"):
+- the writer: epoch builds + publishes serialize on the store's internal
+  condition (`epoch.EpochStore._cond` — also what ListAndWatch waiters
+  park on; a parked waiter holds nothing, lockdep suspends it);
+- genuinely mutating paths: claim checkpoint commits (`dra._lock`,
+  `dra._ckpt_cond`), the health-listener delivery chain
+  (`server._listener_lock`), and the LiveAttrReader SLOW path (fd
+  open/replace — its steady-state pread is lock-free, allocate.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from types import MappingProxyType
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+
+from . import lockdep
+
+__all__ = ["AtomicCounter", "Epoch", "EpochStore", "InventoryEpoch",
+           "build_inventory_epoch", "build_server_epoch"]
+
+_EMPTY_MAP: Mapping = MappingProxyType({})
+
+
+class AtomicCounter:
+    """Lock-free EXACT monotonic counter for hot-path stats.
+
+    Sharded per thread: each thread increments its own one-element cell
+    (single-owner, so `cell[0] += 1` is exact), and `value` sums a
+    C-atomic `list()` snapshot of the cells. Cells only grow and are
+    never removed, so two successive `value` reads can never go
+    backwards — a Prometheus scrape sees a true counter (a plain
+    store-last-total design can park a STALE total when the last racing
+    store loses, and a counter decrease reads as a process restart to
+    rate()). Cost: add() is a thread-local hit + int increment; value is
+    O(threads ever seen), read only on /status//metrics. Zero lock
+    acquisitions either way — the lockdep read-path gate pins it
+    (tests/test_epoch.py).
+    """
+
+    __slots__ = ("_cells", "_local", "_start")
+
+    def __init__(self, start: int = 0) -> None:
+        self._start = start
+        self._cells: list = []
+        self._local = threading.local()
+
+    def add(self) -> None:
+        """Count one event. O(1): a thread-local hit + int increment —
+        the cross-cell sum is paid only by `value` readers (/status,
+        /metrics), never by the hot path."""
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = self._local.cell = [0]
+            self._cells.append(cell)   # C-atomic list append
+        cell[0] += 1                   # owner-thread only: exact
+
+    @property
+    def value(self) -> int:
+        return self._start + sum(c[0] for c in list(self._cells))
+
+
+@dataclass(frozen=True)
+class Epoch:
+    """One immutable generation of a plugin server's read-plane state.
+
+    Built ONLY by `build_server_epoch` (tsalint's epoch-mutation rule
+    enforces that nothing outside epoch.py writes to a published epoch).
+
+      epoch_id       — monotonic per-store generation; caches key on it
+      device_health  — device id -> "Healthy"/"Unhealthy" (the ANDed
+                       effective verdict; read-only mapping view)
+      lw_payload     — the fully-serialized ListAndWatchResponse bytes;
+                       stream sends parse this once instead of
+                       deep-copying every pb.Device under a lock
+    """
+
+    epoch_id: int
+    device_health: Mapping[str, str] = _EMPTY_MAP
+    lw_payload: bytes = b""
+
+
+@dataclass(frozen=True)
+class InventoryEpoch:
+    """The DRA driver's read-plane generation (prepare planning + slice
+    builds read this; only `set_inventory`/`apply_health` publish).
+
+      by_name        — published device name -> (kind, group, obj)
+      planners       — generation name -> AllocationPlanner
+      parent_planner — the vfio-backed-partition passthrough planner
+      unhealthy      — raw ids pruned from the published ResourceSlice
+    """
+
+    epoch_id: int
+    by_name: Mapping[str, Tuple[str, str, Any]] = _EMPTY_MAP
+    planners: Mapping[str, Any] = _EMPTY_MAP
+    parent_planner: Any = None
+    unhealthy: frozenset = field(default_factory=frozenset)
+
+
+def build_server_epoch(epoch_id: int,
+                       rows: Sequence[Tuple[str, int]],
+                       health_sources: Mapping[str, Mapping[str, bool]]
+                       ) -> Epoch:
+    """The plugin-server epoch builder (the only place server epochs are
+    born). `rows` is the static (device id, NUMA node) table fixed for
+    the server's lifetime; `health_sources` is the writer-owned per-source
+    verdict map — a device is Healthy iff ALL its sources agree (the
+    fs-watcher/native-probe AND from server.set_devices_health)."""
+    from . import kubeletapi as api
+    from .kubeletapi import pb
+
+    health: Dict[str, str] = {}
+    devices = []
+    for dev_id, numa_node in rows:
+        sources = health_sources.get(dev_id)
+        state = api.HEALTHY if (not sources or all(sources.values())) \
+            else api.UNHEALTHY
+        health[dev_id] = state
+        devices.append(pb.Device(
+            ID=dev_id, health=state,
+            topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=numa_node)])))
+    payload = pb.ListAndWatchResponse(devices=devices).SerializeToString()
+    return Epoch(epoch_id=epoch_id,
+                 device_health=MappingProxyType(health),
+                 lw_payload=payload)
+
+
+def build_inventory_epoch(epoch_id: int,
+                          by_name: Mapping[str, Tuple[str, str, Any]],
+                          planners: Mapping[str, Any],
+                          parent_planner: Any,
+                          unhealthy: frozenset) -> InventoryEpoch:
+    """The DRA inventory-epoch builder. The mappings are snapshotted into
+    read-only views here so a writer that keeps mutating its working dict
+    after publish cannot reach readers."""
+    return InventoryEpoch(
+        epoch_id=epoch_id,
+        by_name=MappingProxyType(dict(by_name)),
+        planners=MappingProxyType(dict(planners)),
+        parent_planner=parent_planner,
+        unhealthy=frozenset(unhealthy))
+
+
+class EpochStore:
+    """Atomic publish/subscribe point for one epoch sequence.
+
+    `current` is a plain attribute read — the whole reader contract.
+    Writers serialize on the internal condition (`with store.lock():`)
+    and publish with `publish_locked`; ListAndWatch waiters park on the
+    same condition via `wait_for` and observe the epoch id change (the
+    notify_all replaces the old per-server device-table condvar fan-out).
+    `publishes` counts successful swaps — the generation counter /status
+    and /metrics surface.
+    """
+
+    def __init__(self, initial: Any = None) -> None:
+        # one shared lockdep name for every store instance (server + DRA):
+        # stores are never nested, so any store->store edge flags as a
+        # self-inversion — the same convention as dra's per-claim locks
+        self._cond = lockdep.instrument(
+            "epoch.EpochStore._cond", threading.Condition())
+        self.current: Any = initial if initial is not None else Epoch(0)
+        self.publishes = AtomicCounter()
+
+    def lock(self) -> threading.Condition:
+        """The writer-side critical section: `with store.lock(): ...`.
+        Epoch builds inside it must stay pure compute — the blocking-call
+        vocabulary under `epoch.EpochStore._cond` is lint-enforced."""
+        return self._cond
+
+    def publish_locked(self, ep: Any) -> Any:
+        """Swap the current epoch and wake every waiter. Caller holds
+        `lock()`; the swap itself is one attribute store, so readers on
+        other threads switch epochs atomically."""
+        self.current = ep
+        self.publishes.add()
+        self._cond.notify_all()
+        return ep
+
+    def publish(self, ep: Any) -> Any:
+        with self._cond:
+            return self.publish_locked(ep)
+
+    def wait_for(self, predicate: Callable[[], bool],
+                 timeout: Optional[float] = None) -> bool:
+        """Park until `predicate()` (checked under the store condition).
+        Waiters hold nothing while parked — lockdep suspends the hold."""
+        with self._cond:
+            return self._cond.wait_for(predicate, timeout)
+
+    def poke(self) -> None:
+        """Wake waiters without publishing (teardown, RPC termination)."""
+        with self._cond:
+            self._cond.notify_all()

@@ -210,17 +210,23 @@ def _refresh_armed_locked() -> None:
 
 
 def stats() -> Dict[str, int]:
-    """Per-site lifetime fire counts (survive disarm; cleared by reset)."""
-    with _lock:
-        return dict(_fired)
+    """Per-site lifetime fire counts (survive disarm; cleared by reset).
+    Lock-free read (the /status lockdep gate): dict(d) is one C-atomic
+    copy, so a racing fire() costs at most a one-fire-stale count."""
+    return dict(_fired)
 
 
 def armed_sites() -> Dict[str, Dict[str, object]]:
-    """Currently armed points, for the /status debugging surface."""
-    with _lock:
-        return {site: {"kind": p.kind, "remaining": p.remaining,
-                       "probability": p.probability, "fires": p.fires}
-                for site, p in _points.items()}
+    """Currently armed points, for the /status debugging surface.
+    Lock-free read: list(d.items()) is a C-atomic copy and each point
+    FIELD is one GIL-atomic read. fire() mutates `remaining`/`fires` in
+    place under _lock, so a mid-fire snapshot can pair a decremented
+    `remaining` with a not-yet-incremented `fires` — fine for a
+    diagnostic listing, but do NOT derive compound facts (e.g. an armed
+    budget) from two fields of one snapshot."""
+    return {site: {"kind": p.kind, "remaining": p.remaining,
+                   "probability": p.probability, "fires": p.fires}
+            for site, p in list(_points.items())}
 
 
 @contextmanager

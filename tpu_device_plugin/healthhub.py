@@ -519,30 +519,36 @@ class HealthHub:
     # -------------------------------------------------------------- stats
 
     def stats(self) -> dict:
-        """Counters + gauges for /status, /metrics and the perf guards."""
+        """Counters + gauges for /status, /metrics and the perf guards.
+
+        LOCK-FREE read side (the /status lockdep gate): every value is a
+        GIL-atomic attribute/int read, `len()` on a live container, or a
+        C-atomic `list(dict.values())` copy — a /status scrape can never
+        queue behind a probe cycle holding the hub lock. Counters are
+        written only under `_lock` (tsalint counter ownership), so reads
+        here see a value at most one mutation stale."""
         prefixes = ("healthhub", "healthhub-probe")
         threads = sum(1 for t in threading.enumerate()
                       if t.name.startswith(prefixes))
-        with self._lock:
-            return {
-                "inotify_fds": 1 if self._watcher is not None else 0,
-                "fallback_polling": self._watcher is None
-                                    and self._watcher_failed,
-                "watched_dirs": len(self._watched_dirs),
-                "subscriptions": len(self._subs),
-                "probe_workers": self.probe_workers,
-                "probe_deadline_s": self.probe_deadline_s,
-                "threads": threads,
-                "probe_cycles_total": self._probe_cycles,
-                "probes_last_cycle": self._probes_last_cycle,
-                "probes_deduped_last_cycle": self._probes_deduped_last_cycle,
-                "probe_timeouts_total": self._probe_timeouts,
-                "probe_errors_total": self._probe_errors,
-                # probes still blocked past their deadline right now: each
-                # pins one pool worker until its read returns (the chip
-                # keeps its dead verdict without resubmission meanwhile)
-                "stuck_probes": sum(1 for f in self._stuck.values()
-                                    if not f.done()),
-                "existence_scans_total": self._existence_scans,
-                "last_cycle_ms": round(self._last_cycle_s * 1e3, 3),
-            }
+        return {
+            "inotify_fds": 1 if self._watcher is not None else 0,
+            "fallback_polling": self._watcher is None
+                                and self._watcher_failed,
+            "watched_dirs": len(self._watched_dirs),
+            "subscriptions": len(self._subs),
+            "probe_workers": self.probe_workers,
+            "probe_deadline_s": self.probe_deadline_s,
+            "threads": threads,
+            "probe_cycles_total": self._probe_cycles,
+            "probes_last_cycle": self._probes_last_cycle,
+            "probes_deduped_last_cycle": self._probes_deduped_last_cycle,
+            "probe_timeouts_total": self._probe_timeouts,
+            "probe_errors_total": self._probe_errors,
+            # probes still blocked past their deadline right now: each
+            # pins one pool worker until its read returns (the chip
+            # keeps its dead verdict without resubmission meanwhile)
+            "stuck_probes": sum(1 for f in list(self._stuck.values())
+                                if not f.done()),
+            "existence_scans_total": self._existence_scans,
+            "last_cycle_ms": round(self._last_cycle_s * 1e3, 3),
+        }

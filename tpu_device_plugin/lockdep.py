@@ -38,11 +38,12 @@ import threading
 import time
 import traceback
 from contextlib import contextmanager
-from typing import (Any, Callable, Dict, Iterator, List, Optional, Set,
-                    Tuple, TypeVar, cast)
+from typing import (Any, Callable, ContextManager, Dict, Iterator, List,
+                    Optional, Set, Tuple, TypeVar, cast)
 
-__all__ = ["enable", "disable", "enabled", "instrument", "report", "reset",
-           "scoped", "watch", "LockdepReport"]
+__all__ = ["enable", "disable", "enabled", "instrument", "path_stats",
+           "read_path", "report", "reset", "scoped", "watch",
+           "LockdepReport"]
 
 _LockT = TypeVar("_LockT")
 
@@ -54,9 +55,14 @@ _edges: Dict[Tuple[str, str], str] = {}
 _long_holds: List[Tuple[str, float, str]] = []   # (name, seconds, stack)
 _watched: Set[str] = set()
 _hold_threshold_s = 0.5
+# hot-read-path accounting: path name -> [entries, lock acquisitions].
+# Production code brackets its lock-free read paths with read_path(name);
+# the per-path acquisition counter is the CI gate proving they acquire
+# ZERO registered locks in steady state (tests/test_epoch.py).
+_paths: Dict[str, List[int]] = {}
 
 _DEFAULT_WATCHED = (
-    "server.TpuDevicePlugin._cond",
+    "epoch.EpochStore._cond",
     "dra.DraDriver._lock",
     "dra.DraDriver._ckpt_cond",
     "healthhub.HealthHub._lock",
@@ -76,6 +82,8 @@ class _HoldRec:
 class _TLS(threading.local):
     def __init__(self) -> None:
         self.stack: List[_HoldRec] = []
+        # the innermost read_path record this thread is inside, or None
+        self.path: Optional[List[int]] = None
 
 
 _tls = _TLS()
@@ -115,10 +123,13 @@ def watch(name: str) -> None:
 
 
 def reset() -> None:
-    """Clear recorded edges/holds (test isolation); registration stays."""
+    """Clear recorded edges/holds/path counters (test isolation);
+    registration stays."""
     with _registry_lock:
         _edges.clear()
         del _long_holds[:]
+        for rec in _paths.values():
+            rec[0] = rec[1] = 0
 
 
 @contextmanager
@@ -134,8 +145,10 @@ def scoped(hold_threshold_ms: Optional[float] = None,
         saved_edges = dict(_edges)
         saved_holds = list(_long_holds)
         saved_watched = set(_watched)
+        saved_paths = {name: list(rec) for name, rec in _paths.items()}
         _edges.clear()
         del _long_holds[:]
+        _paths.clear()
         if watched is not None:
             _watched.clear()
             _watched.update(watched)
@@ -152,6 +165,8 @@ def scoped(hold_threshold_ms: Optional[float] = None,
             _long_holds.extend(saved_holds)
             _watched.clear()
             _watched.update(saved_watched)
+            _paths.clear()
+            _paths.update(saved_paths)
         _enabled = saved_enabled
         _hold_threshold_s = saved_threshold
 
@@ -169,9 +184,74 @@ def instrument(name: str, lock: _LockT) -> _LockT:
     return cast(_LockT, _LockProxy(name, lock))
 
 
+# ---------------------------------------------------------- read paths
+
+class _PathCtx:
+    """Active read_path bracket: counts entries and attributes every
+    registered-lock acquisition made on this thread to the path."""
+
+    __slots__ = ("_rec", "_prev")
+
+    def __init__(self, rec: List[int]) -> None:
+        self._rec = rec
+        self._prev: Optional[List[int]] = None
+
+    def __enter__(self) -> List[int]:
+        self._rec[0] += 1
+        self._prev = _tls.path
+        _tls.path = self._rec
+        return self._rec
+
+    def __exit__(self, *exc: object) -> None:
+        _tls.path = self._prev
+
+
+class _NullCtx:
+    """Reusable no-op bracket: the production cost of read_path when
+    lockdep is disabled is one call + two no-op dunders."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+
+_NULL_CTX = _NullCtx()
+
+
+def read_path(name: str) -> "ContextManager[Optional[List[int]]]":
+    """Bracket one hot read path (`with lockdep.read_path("server.Allocate")`).
+
+    Disabled (production): a cached no-op context. Enabled: every
+    registered-lock acquisition inside the bracket (on this thread) is
+    charged to `name` — `path_stats()` exposes the totals, and the
+    read-path gate asserts they stay 0 (tests/test_epoch.py)."""
+    if not _enabled:
+        return _NULL_CTX
+    rec = _paths.get(name)
+    if rec is None:
+        with _registry_lock:
+            rec = _paths.setdefault(name, [0, 0])
+    return _PathCtx(rec)
+
+
+def path_stats() -> Dict[str, Dict[str, int]]:
+    """{path: {"calls": n, "lock_acquisitions": n}} for every bracket
+    entered since enable()/reset()."""
+    with _registry_lock:
+        return {name: {"calls": rec[0], "lock_acquisitions": rec[1]}
+                for name, rec in _paths.items()}
+
+
 # --------------------------------------------------------------- recording
 
 def _note_acquired(name: str, key: int) -> None:
+    rec = _tls.path
+    if rec is not None:
+        rec[1] += 1
     stack = _tls.stack
     for rec in stack:
         if rec.key == key:          # reentrant re-acquire (RLock)

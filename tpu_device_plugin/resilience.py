@@ -75,10 +75,13 @@ class BackoffPolicy:
             self.attempts = 0
 
     def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return {"attempts": self.attempts,
-                    "total_attempts": self.total_attempts,
-                    "current_delay_s": round(self._prev, 3)}
+        # lock-free read side (the /status lockdep gate): plain int/float
+        # attribute reads are GIL-atomic; mutations stay under _lock
+        # (tsalint counter ownership), so a racing next_delay() costs at
+        # most a one-mutation-stale value, never a torn one
+        return {"attempts": self.attempts,
+                "total_attempts": self.total_attempts,
+                "current_delay_s": round(self._prev, 3)}
 
 
 class CircuitOpen(Exception):
@@ -176,8 +179,10 @@ class CircuitBreaker:
         return result
 
     def snapshot(self) -> Dict[str, Union[str, int]]:
-        with self._lock:
-            return {"state": self._state,
-                    "consecutive_failures": self._consecutive_failures,
-                    "trips": self.trips,
-                    "rejected": self.rejected}
+        # lock-free read side, same contract as BackoffPolicy.snapshot:
+        # each field is one GIL-atomic read; the dict is a diagnostic
+        # snapshot, not a transactional view
+        return {"state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "trips": self.trips,
+                "rejected": self.rejected}

@@ -6,8 +6,10 @@ unix socket under the kubelet's device-plugin dir, registers with the
 kubelet, streams device health over ListAndWatch, and restarts itself when
 the kubelet wipes its socket dir. Differences by design:
 
-- health events flow through a versioned device table + condition variable
-  instead of unbuffered channels (the reference's can deadlock healthCheck
+- health events flow through immutable copy-on-write epochs (epoch.py):
+  the writer publishes a frozen device table + pre-serialized
+  ListAndWatch payload with one atomic reference swap, readers never
+  lock (the reference's unbuffered channels can deadlock healthCheck
   when ListAndWatch is gone, SURVEY.md §7e);
 - `restart()` builds a fresh stop event per Start, so a restart never
   orphans a shared stop channel (ibid.);
@@ -21,7 +23,7 @@ import math
 import os
 import threading
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from concurrent import futures
 from datetime import datetime, timezone
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -29,6 +31,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 
 from . import allocate as allocate_mod
+from . import epoch as epoch_mod
 from . import faults
 from . import kubeletapi as api
 from . import lockdep
@@ -42,9 +45,12 @@ from .topology import AllocatableDevice, AllocationIndex, MustIncludeTooLarge
 
 log = logging.getLogger(__name__)
 
-# GetPreferredAllocation memo capacity (see _pref_cache): a true LRU, so
-# hitting the cap evicts only the single coldest entry instead of the old
-# wholesale clear() whose next 128 calls all recomputed the box scan.
+# GetPreferredAllocation memo capacity (see _pref_cache): the memo is a
+# per-epoch plain dict (swapped wholesale on every epoch publish, so
+# invalidation is by construction and lookups take no lock); at capacity
+# new keys recompute instead of evicting — the scan is a pure ~12 us
+# fallback, and a bounded no-evict dict is the only shape that stays
+# GIL-atomic without a lock.
 PREF_CACHE_SIZE = 128
 # Starvation cap for the ListAndWatch coalesce window: a relentless flap
 # storm may never produce a quiet window, so after this many windows of
@@ -117,11 +123,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-{resource_suffix}.sock")
 
-        self._cond = lockdep.instrument(
-            "server.TpuDevicePlugin._cond", threading.Condition())
-        self._devs: Dict[str, pb.Device] = {}
+        # The read plane (epoch.py): readers — Allocate,
+        # GetPreferredAllocation, ListAndWatch assembly, /status — grab
+        # `self._store.current` and never lock; the store's internal
+        # condition is the WRITER lock (health/table updates) and the
+        # channel ListAndWatch waiters park on. `_health_sources` is
+        # writer-owned state (mutated only under store.lock()).
+        self._store = epoch_mod.EpochStore()
         self._health_sources: Dict[str, Dict[str, bool]] = {}
-        self._version = 0
         self._server: Optional[grpc.Server] = None
         # Shared health plane: the PluginManager passes the host-level hub
         # (one inotify fd + one probe scheduler for every resource); a
@@ -161,41 +170,50 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             cfg, registry, resource_suffix,
             allowed_bdfs=self._allowed_bdfs, cdi_enabled=cdi_enabled)
         # last few successful allocations, surfaced on /status for debugging
-        # VMI attach issues (what was handed out, when)
+        # VMI attach issues (what was handed out, when); deque appends are
+        # C-atomic, so the hot path records without a lock
         self._recent_allocs: deque = deque(maxlen=16)
-        self._alloc_count = 0  # monotonic, for the Prometheus counter
-        # LRU memo for the GetPreferredAllocation box scan (see handler);
-        # guarded by its own lock — handlers run on concurrent gRPC worker
-        # threads. At capacity the single oldest entry is evicted
-        # (move-to-end on hit), never the whole table: the old wholesale
-        # clear() made call 129 a thundering recompute for every cached
-        # availability set. Invariant: the scan result depends on
-        # (availability, must-include, size, version), never health, so a
-        # stale hit is impossible while the version is in the key.
-        self._pref_cache: "OrderedDict[tuple, list]" = OrderedDict()
-        self._pref_lock = lockdep.instrument(
-            "server.TpuDevicePlugin._pref_lock", threading.Lock())
-        self._pref_hits = 0
-        self._pref_misses = 0
+        self._alloc_count = epoch_mod.AtomicCounter()
+        # Memo for the GetPreferredAllocation box scan (see handler): a
+        # plain dict the WRITER swaps wholesale on every epoch publish, so
+        # a lookup is one GIL-atomic dict.get and invalidation is by
+        # construction — the old LRU + lock + version key are gone. Keys
+        # still carry the epoch id (belt and braces for a reader racing
+        # the swap). Invariant: the scan result depends on (availability,
+        # must-include, size) over a static torus, never health, so a
+        # stale hit is impossible even across the swap.
+        self._pref_cache: Dict[tuple, list] = {}
+        self._pref_hits = epoch_mod.AtomicCounter()
+        self._pref_misses = epoch_mod.AtomicCounter()
         # ListAndWatch re-sends since start (initial snapshots excluded):
         # the observable cost of health churn on the kubelet stream
-        self._lw_resends = 0
+        self._lw_resends = epoch_mod.AtomicCounter()
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
 
+    def _device_rows(self) -> Tuple[Tuple[str, int], ...]:
+        """The static (device id, NUMA node) table the epoch builder
+        renders; fixed for this server's lifetime (rediscovery rebuilds
+        the server). The vTPU subclass rows its partitions instead."""
+        return tuple((d.bdf, d.numa_node) for d in self.devices)
+
     def _build_device_table(self) -> None:
-        with self._cond:
-            self._devs = {
-                d.bdf: pb.Device(
-                    ID=d.bdf,
-                    health=api.HEALTHY,
-                    topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=d.numa_node)]),
-                )
-                for d in self.devices
-            }
-            self._version += 1
-            self._cond.notify_all()
+        self._rows = self._device_rows()
+        self._row_ids = frozenset(dev_id for dev_id, _ in self._rows)
+        with self._store.lock():
+            self._publish_epoch_locked()
+
+    def _publish_epoch_locked(self) -> epoch_mod.Epoch:
+        """Build + publish the next epoch from the writer-owned state
+        (caller holds store.lock()). Also swaps in a fresh pref memo —
+        the epoch-id key makes stale hits impossible, the swap just stops
+        dead entries from pinning the cap."""
+        ep = self._store.publish_locked(epoch_mod.build_server_epoch(
+            self._store.current.epoch_id + 1, self._rows,
+            self._health_sources))
+        self._pref_cache = {}
+        return ep
 
     def set_group_health(self, group: str, healthy: bool, source: str = "fs") -> None:
         """Fan a group-level event out to every member device (reference :664-676)."""
@@ -204,9 +222,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def set_all_health(self, healthy: bool, source: str) -> None:
         """One source's verdict for every advertised device (drain path)."""
-        with self._cond:
-            ids = list(self._devs)
-        self.set_devices_health(ids, healthy, source)
+        self.set_devices_health(list(self._row_ids), healthy, source)
 
     def set_devices_health(self, device_ids: Sequence[str], healthy: bool,
                            source: str = "fs") -> None:
@@ -216,62 +232,52 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         native liveness probe — that see different failure modes (a removed
         vfio node is invisible to a config-space read and vice versa), so
         their verdicts are ANDed rather than last-writer-wins.
+
+        This is the WRITER side of the epoch contract: the per-source map
+        mutates under store.lock(), and an EFFECTIVE verdict flip publishes
+        one new epoch (readers switch on the atomic swap; ListAndWatch
+        waiters observe the epoch id change). A delivery that flips no
+        effective verdict — probe polls re-deliver every id each cycle —
+        publishes nothing and costs readers nothing.
         """
         touched = []
-        with self._cond:
+        with self._store.lock():
+            prev = self._store.current.device_health
             changed = False
             for dev_id in device_ids:
-                dev = self._devs.get(dev_id)
-                if dev is None:
+                if dev_id not in self._row_ids:
                     continue
                 touched.append(dev_id)
                 sources = self._health_sources.setdefault(dev_id, {})
                 sources[source] = healthy
-                state = api.HEALTHY if all(sources.values()) else api.UNHEALTHY
-                if dev.health != state:
-                    dev.health = state
+                state = api.HEALTHY if all(sources.values()) \
+                    else api.UNHEALTHY
+                if prev.get(dev_id) != state:
                     changed = True
             if changed:
-                self._version += 1
-                self._cond.notify_all()
-        if touched:
-            # flapped devices invalidate their groups' precompiled Allocate
-            # fragments (allocate._GroupFragment): the next plan re-lists
-            # cdev names for exactly those groups — the same dirty plumbing
-            # that hints incremental rediscovery, applied to the attach path
-            self._invalidate_alloc_fragments(touched)
+                self._publish_epoch_locked()
         if touched and self._health_listener is not None:
-            # Outside _cond: the listener may do slow work (the DRA driver
-            # republishes over HTTP) and must never stall ListAndWatch
-            # wakeups. Deliveries are serialized under _listener_lock and
-            # re-read the CURRENT effective health inside it — sending the
-            # per-call delta instead would let two racing verdicts arrive
-            # out of order and leave the listener's state permanently
-            # inverted vs the device table. Every touched id is delivered
-            # (not just table transitions): a plugin rebuilt on rediscovery
-            # starts all-HEALTHY, so a chip that recovered while pruned
-            # produces NO transition on the first probe poll — only the
-            # unconditional snapshot reconciles the listener. The listener
-            # treats repeats as no-ops.
+            # Outside the store lock: the listener may do slow work (the
+            # DRA driver republishes over HTTP) and must never stall
+            # ListAndWatch wakeups. Deliveries are serialized under
+            # _listener_lock and re-read the CURRENT effective health
+            # inside it — sending the per-call delta instead would let two
+            # racing verdicts arrive out of order and leave the listener's
+            # state permanently inverted vs the device table. Every
+            # touched id is delivered (not just effective transitions): a
+            # plugin rebuilt on rediscovery starts all-HEALTHY, so a chip
+            # that recovered while pruned produces NO transition on the
+            # first probe poll — only the unconditional snapshot
+            # reconciles the listener. The listener treats repeats as
+            # no-ops.
             with self._listener_lock:
-                with self._cond:
-                    current = {i: self._devs[i].health == api.HEALTHY
-                               for i in touched if i in self._devs}
+                health = self._store.current.device_health
+                current = {i: health[i] == api.HEALTHY
+                           for i in touched if i in health}
                 try:
                     self._health_listener(current)
                 except Exception as exc:
                     log.error("health listener failed: %s", exc)
-
-    def _invalidate_alloc_fragments(self, device_ids: Sequence[str]) -> None:
-        """Hook for fragment invalidation on health transitions; device_ids
-        are this server's device table ids (BDFs here; the vTPU subclass
-        maps partition uuids onto parent BDFs for its parent planner)."""
-        self._planner.invalidate_fragments(device_ids)
-
-    def _snapshot(self) -> Tuple[int, List[pb.Device]]:
-        with self._cond:
-            return self._version, [pb.Device.FromString(d.SerializeToString())
-                                   for d in self._devs.values()]
 
     # -------------------------------------------------------------- lifecycle
 
@@ -444,8 +450,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
     def _teardown(self) -> None:
         self._serving = False
         self._stop.set()
-        with self._cond:
-            self._cond.notify_all()
+        self._store.poke()   # wake parked ListAndWatch streams
         # unsubscribe BEFORE grpc unlinks the socket so the hub never
         # mistakes an intentional teardown for a kubelet restart
         if self._health_sub is not None:
@@ -467,65 +472,73 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             pass
 
     def status_snapshot(self) -> dict:
-        """Public state snapshot for the status endpoint (/status)."""
-        with self._cond:
-            devices = {dev_id: d.health for dev_id, d in self._devs.items()}
-        # latched PCI bus-error bits (XID-events analogue) + PCIe link
-        # training state (CurrPcieLinkWidth analogue): diagnostic only, ONE
-        # config read per device, outside the lock — sysfs reads must never
-        # block RPC paths
-        errors = {}
-        degraded_links = {}
-        for d in self.devices:
-            bits, link = self.health_shim.chip_diagnostics(
-                self.cfg.pci_base_path, d.bdf)
-            if bits:
-                errors[d.bdf] = f"0x{bits:04x}"
-            if link_is_degraded(link):
-                degraded_links[d.bdf] = (
-                    f"gen{link['cur_speed']}x{link['cur_width']} of "
-                    f"gen{link['max_speed']}x{link['max_width']}")
-        with self._pref_lock:
-            pref_cache = {"hits": self._pref_hits,
-                          "misses": self._pref_misses,
+        """Public state snapshot for the status endpoint (/status).
+
+        Served from the current epoch + atomic counters — ZERO registered
+        locks (the lockdep read-path gate pins this): a slow /status
+        scrape used to hold the device-table condition and stall
+        ListAndWatch transitions behind itself."""
+        with lockdep.read_path("server.status_snapshot"):
+            ep = self._store.current
+            devices = dict(ep.device_health)
+            # latched PCI bus-error bits (XID-events analogue) + PCIe link
+            # training state (CurrPcieLinkWidth analogue): diagnostic only,
+            # ONE config read per device — sysfs reads must never block RPC
+            # paths, and here nothing they could block on is held
+            errors = {}
+            degraded_links = {}
+            for d in self.devices:
+                bits, link = self.health_shim.chip_diagnostics(
+                    self.cfg.pci_base_path, d.bdf)
+                if bits:
+                    errors[d.bdf] = f"0x{bits:04x}"
+                if link_is_degraded(link):
+                    degraded_links[d.bdf] = (
+                        f"gen{link['cur_speed']}x{link['cur_width']} of "
+                        f"gen{link['max_speed']}x{link['max_width']}")
+            pref_cache = {"hits": self._pref_hits.value,
+                          "misses": self._pref_misses.value,
                           "size": len(self._pref_cache),
                           "capacity": PREF_CACHE_SIZE}
-        return {
-            "resource": self.resource_name,
-            "socket": self.socket_path,
-            "serving": self._serving,
-            "restarts": self._restart_count,
-            # GetPreferredAllocation LRU memo effectiveness + ListAndWatch
-            # re-send count (how much health churn reached the kubelet
-            # stream after coalescing)
-            "preferred_cache": pref_cache,
-            "lw_resends": self._lw_resends,
-            # precompiled per-IOMMU-group Allocate fragment cache
-            # (allocate._GroupFragment) effectiveness
-            "alloc_fragments": self._planner.fragment_stats(),
-            # recovery-activity counters (resilience.BackoffPolicy): how many
-            # backoff delays restart() has issued, lifetime and current-run
-            "restart_backoff": self._restart_backoff.snapshot(),
-            "devices": devices,
-            "pci_errors": errors,
-            "degraded_links": degraded_links,
-            "allocations_total": self._alloc_count,
-            # timestamps are stored as epoch floats (record_allocation is
-            # on the Allocate hot path) and rendered ISO here, off it.
-            # list() first: it snapshots the deque in one atomic C call,
-            # where iterating the live deque would race concurrent
-            # record_allocation appends (RuntimeError: mutated during
-            # iteration)
-            "recent_allocations": [
-                {"time": datetime.fromtimestamp(
-                    e["ts"], timezone.utc).isoformat(timespec="seconds"),
-                 "devices": e["devices"]}
-                for e in list(self._recent_allocs)],
-        }
+            return {
+                "resource": self.resource_name,
+                "socket": self.socket_path,
+                "serving": self._serving,
+                "restarts": self._restart_count,
+                # the read-plane generation (epoch.EpochStore): bumps on
+                # every effective health transition / table rebuild
+                "epoch": ep.epoch_id,
+                # GetPreferredAllocation memo effectiveness + ListAndWatch
+                # re-send count (how much health churn reached the kubelet
+                # stream after coalescing)
+                "preferred_cache": pref_cache,
+                "lw_resends": self._lw_resends.value,
+                # precompiled per-IOMMU-group Allocate fragment cache
+                # (allocate._GroupFragment) effectiveness
+                "alloc_fragments": self._planner.fragment_stats(),
+                # recovery-activity counters (resilience.BackoffPolicy):
+                # how many backoff delays restart() has issued
+                "restart_backoff": self._restart_backoff.snapshot(),
+                "devices": devices,
+                "pci_errors": errors,
+                "degraded_links": degraded_links,
+                "allocations_total": self._alloc_count.value,
+                # timestamps are stored as epoch floats (record_allocation
+                # is on the Allocate hot path) and rendered ISO here, off
+                # it. list() first: it snapshots the deque in one atomic C
+                # call, where iterating the live deque would race
+                # concurrent record_allocation appends
+                "recent_allocations": [
+                    {"time": datetime.fromtimestamp(
+                        e["ts"], timezone.utc).isoformat(timespec="seconds"),
+                     "devices": e["devices"]}
+                    for e in list(self._recent_allocs)],
+            }
 
     def record_allocation(self, per_container_ids) -> None:
-        with self._cond:  # int += is not atomic across the RPC thread pool
-            self._alloc_count += 1
+        # AtomicCounter + C-atomic deque append: the Allocate hot path
+        # records without touching any lock
+        self._alloc_count.add()
         self._recent_allocs.append({
             "ts": time.time(),
             "devices": per_container_ids,
@@ -540,121 +553,126 @@ class TpuDevicePlugin(api.DevicePluginServicer):
     def GetDevicePluginOptions(self, request, context):
         return pb.DevicePluginOptions(get_preferred_allocation_available=True)
 
+    def _lw_response(self, ep: epoch_mod.Epoch) -> pb.ListAndWatchResponse:
+        """Assemble one stream send from the epoch's pre-serialized
+        payload: a single parse, no locks, no per-device deep copies (the
+        old _snapshot serialize/deserialize-per-device under the device-
+        table condition). The lockdep read-path gate pins this at zero
+        registered-lock acquisitions."""
+        with lockdep.read_path("server.ListAndWatch.assembly"):
+            return pb.ListAndWatchResponse.FromString(ep.lw_payload)
+
     def ListAndWatch(self, request, context):
-        """Initial full list, then a re-send on health transitions
-        (reference :312-349). Purely event-driven: the stream thread sleeps
-        on the condvar with NO timeout — wakeups come from health
-        transitions (_cond.notify_all), teardown, and an RPC-termination
-        callback that fires when the kubelet drops the stream (otherwise a
-        dead stream would pin its worker thread on the condvar forever).
+        """Initial full list, then a re-send on epoch transitions
+        (reference :312-349). Purely event-driven: the stream thread parks
+        on the epoch store's condition with NO timeout — wakeups come from
+        epoch publishes (health transitions), teardown, and an
+        RPC-termination callback that fires when the kubelet drops the
+        stream (otherwise a dead stream would pin its worker thread on the
+        condvar forever). Payload ASSEMBLY is lock-free: the writer
+        pre-serialized the response into the epoch.
 
         Re-sends are COALESCED on the trailing edge of a quiet window
         (cfg.lw_debounce_s): a vfio flap storm that flips N times inside the
         window produces one re-send carrying the final state, while a lone
         flip still goes out after a single window. LW_MAX_DEFER_WINDOWS
         bounds deferral so a relentless storm cannot starve the stream; the
-        loop re-compares versions after every send, so the LAST state always
-        reaches the kubelet (the exactly-once/no-lost-final-state chaos
-        guarantees ride on this)."""
-        version, devices = self._snapshot()
+        loop re-compares epoch ids after every send, so the LAST state
+        always reaches the kubelet (the exactly-once/no-lost-final-state
+        chaos guarantees ride on this)."""
+        store = self._store
+        ep = store.current
         log.info("%s: ListAndWatch stream opened (%d devices)",
-                 self.resource_name, len(devices))
-        yield pb.ListAndWatchResponse(devices=devices)
+                 self.resource_name, len(ep.device_health))
+        yield self._lw_response(ep)
 
-        def wake() -> None:
-            with self._cond:
-                self._cond.notify_all()
-
-        if not context.add_callback(wake):
+        if not context.add_callback(store.poke):
             return  # RPC already terminated
+        version = ep.epoch_id
         while True:
-            with self._cond:
-                self._cond.wait_for(
-                    lambda: self._version != version or self._stop.is_set()
-                    or not context.is_active())
-                if self._stop.is_set() or not context.is_active():
-                    return
+            store.wait_for(
+                lambda: store.current.epoch_id != version
+                or self._stop.is_set() or not context.is_active())
+            if self._stop.is_set() or not context.is_active():
+                return
             debounce = self.cfg.lw_debounce_s
             if debounce > 0:
                 deadline = time.monotonic() + debounce * LW_MAX_DEFER_WINDOWS
                 while time.monotonic() < deadline:
-                    with self._cond:
-                        v0 = self._version
-                        moved = self._cond.wait_for(
-                            lambda: self._version != v0
-                            or self._stop.is_set()
-                            or not context.is_active(),
-                            timeout=debounce)
-                        if self._stop.is_set() or not context.is_active():
-                            return
+                    v0 = store.current.epoch_id
+                    moved = store.wait_for(
+                        lambda: store.current.epoch_id != v0
+                        or self._stop.is_set()
+                        or not context.is_active(),
+                        timeout=debounce)
+                    if self._stop.is_set() or not context.is_active():
+                        return
                     if not moved:
                         break  # one full quiet window: trailing edge
-            version, devices = self._snapshot()
-            with self._cond:
-                self._lw_resends += 1
+            ep = store.current
+            version = ep.epoch_id
+            self._lw_resends.add()
             log.info("%s: device state changed; re-sending %d devices",
-                     self.resource_name, len(devices))
-            yield pb.ListAndWatchResponse(devices=devices)
+                     self.resource_name, len(ep.device_health))
+            yield self._lw_response(ep)
 
     def GetPreferredAllocation(self, request, context):
-        resp = pb.PreferredAllocationResponse()
-        index = self._alloc_index
-        # The ICI sub-box scan is pure in (availability, must-include,
-        # size) over a static torus, and the kubelet re-asks with the
-        # same availability between allocations — memoize on those plus
-        # the device-table version (health flips change nothing the
-        # scan reads, but the version key keeps the cache honest if
-        # that ever changes). Measured: 16 -> ~1 us on the repeat path.
-        # The version is snapshotted ONCE per RPC — a multi-container
-        # request used to take _cond then _pref_lock per container, two
-        # lock rounds per lookup; now a hit costs one (_pref_lock only).
-        # A version bump mid-RPC just misses into a recompute of the same
-        # pure result (health is not an input to the scan).
-        with self._cond:
-            version = self._version
-        for creq in request.container_requests:
-            key = (version,
-                   tuple(creq.available_deviceIDs),
-                   tuple(creq.must_include_deviceIDs),
-                   creq.allocation_size)
-            with self._pref_lock:
-                ids = self._pref_cache.get(key)
+        with lockdep.read_path("server.GetPreferredAllocation"):
+            resp = pb.PreferredAllocationResponse()
+            index = self._alloc_index
+            # The ICI sub-box scan is pure in (availability, must-include,
+            # size) over a static torus, and the kubelet re-asks with the
+            # same availability between allocations — memoize on those
+            # plus the epoch id. The memo dict is swapped wholesale on
+            # every epoch publish (invalidated by construction), so a
+            # lookup is ONE GIL-atomic dict.get — the old path took the
+            # device-table condition plus the memo lock per RPC. A racing
+            # publish mid-RPC just misses into a recompute of the same
+            # pure result (health is not an input to the scan).
+            epoch_id = self._store.current.epoch_id
+            memo = self._pref_cache
+            for creq in request.container_requests:
+                key = (epoch_id,
+                       tuple(creq.available_deviceIDs),
+                       tuple(creq.must_include_deviceIDs),
+                       creq.allocation_size)
+                ids = memo.get(key)
                 if ids is not None:
-                    self._pref_cache.move_to_end(key)
-                    self._pref_hits += 1
+                    self._pref_hits.add()
                 else:
-                    self._pref_misses += 1
-            if ids is None:
-                try:
-                    ids = index.preferred(
-                        creq.available_deviceIDs,
-                        creq.must_include_deviceIDs,
-                        creq.allocation_size,
-                    )
-                except MustIncludeTooLarge as exc:
-                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-                with self._pref_lock:
-                    while key not in self._pref_cache \
-                            and len(self._pref_cache) >= PREF_CACHE_SIZE:
-                        self._pref_cache.popitem(last=False)
-                    self._pref_cache[key] = ids
-                    self._pref_cache.move_to_end(key)
-            resp.container_responses.append(
-                pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
-        return resp
+                    self._pref_misses.add()
+                    try:
+                        ids = index.preferred(
+                            creq.available_deviceIDs,
+                            creq.must_include_deviceIDs,
+                            creq.allocation_size,
+                        )
+                    except MustIncludeTooLarge as exc:
+                        context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                      str(exc))
+                    if len(memo) < PREF_CACHE_SIZE:
+                        memo[key] = ids
+                resp.container_responses.append(
+                    pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
+            return resp
 
     def Allocate(self, request, context):
         """Template method: log → subclass impl → record for /status.
         Failed allocations abort inside the impl and are never recorded."""
         ids = [list(c.devices_ids) for c in request.container_requests]
         log.info("%s: Allocate(%s)", self.resource_name, ids)
-        resp = self._allocate_impl(request, context)
-        self.record_allocation(ids)
+        with lockdep.read_path("server.Allocate"):
+            resp = self._allocate_impl(request, context)
+            self.record_allocation(ids)
         return resp
 
     def _allocate_impl(self, request, context):
         try:
-            return self._planner.allocate_response(request)
+            # the epoch id keys the planner's precompiled fragments: a
+            # health flip publishes a new epoch, so the next plan starts a
+            # fresh fragment cache — no invalidation listeners
+            return self._planner.allocate_response(
+                request, epoch=self._store.current.epoch_id)
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))

@@ -95,7 +95,13 @@ class StatusServer:
         return bool(plugins) and any(p.serving for p in plugins)
 
     def status(self) -> dict:
+        from . import lockdep
+        with lockdep.read_path("status.endpoint"):
+            return self._status_impl()
+
+    def _status_impl(self) -> dict:
         from . import faults
+        from . import lockdep
         out = {
             "plugins": [p.status_snapshot() for p in self.manager.plugins],
             "pending": [p.resource_name for p in self.manager.pending],
@@ -122,6 +128,13 @@ class StatusServer:
         armed = faults.armed_sites()
         if fault_stats or armed:
             out["faults"] = {"armed": armed, "fired": fault_stats}
+        # hot-read-path lock accounting (lockdep.read_path): only present
+        # under TDP_LOCKDEP=1 — steady-state acquisitions pinned at 0 by
+        # the read-path gate (tests/test_epoch.py)
+        if lockdep.enabled():
+            paths = lockdep.path_stats()
+            if paths:
+                out["read_paths"] = paths
         d = self.dra_driver
         if d is not None:
             out["dra"] = {
@@ -171,6 +184,13 @@ class StatusServer:
             lines.append(
                 f'tpu_plugin_degraded_links{{resource="{p["resource"]}"}} '
                 f'{len(p.get("degraded_links", {}))}')
+        lines += ["# HELP tpu_plugin_epoch Read-plane epoch generation "
+                  "(epoch.EpochStore): bumps on every effective health "
+                  "transition or device-table rebuild.",
+                  "# TYPE tpu_plugin_epoch gauge"]
+        for p in s["plugins"]:
+            lines.append(f'tpu_plugin_epoch{{resource="{p["resource"]}"}} '
+                         f'{p.get("epoch", 0)}')
         lines += ["# HELP tpu_plugin_restarts_total Socket-loss restarts.",
                   "# TYPE tpu_plugin_restarts_total counter"]
         for p in s["plugins"]:
@@ -272,6 +292,26 @@ class StatusServer:
                 "# TYPE tdp_probe_errors_total counter",
                 f"tdp_probe_errors_total {health['probe_errors_total']}",
             ]
+        read_paths = s.get("read_paths")
+        if read_paths:
+            lines += [
+                "# HELP tdp_read_path_lock_acquisitions_total Registered-"
+                "lock acquisitions charged to each hot read path "
+                "(lockdep.read_path; steady state is pinned at 0).",
+                "# TYPE tdp_read_path_lock_acquisitions_total counter",
+            ]
+            for name, rec in sorted(read_paths.items()):
+                lines.append(
+                    f'tdp_read_path_lock_acquisitions_total'
+                    f'{{path="{name}"}} {rec["lock_acquisitions"]}')
+            lines += [
+                "# HELP tdp_read_path_calls_total Entries into each hot "
+                "read path bracket.",
+                "# TYPE tdp_read_path_calls_total counter",
+            ]
+            for name, rec in sorted(read_paths.items()):
+                lines.append(f'tdp_read_path_calls_total{{path="{name}"}} '
+                             f'{rec["calls"]}')
         lines += [
             "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",
             "# TYPE tpu_plugin_pending_plugins gauge",

@@ -23,7 +23,7 @@ from typing import Dict, List, Sequence
 
 import grpc
 
-from . import kubeletapi as api
+from . import lockdep
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
@@ -74,18 +74,10 @@ class VtpuDevicePlugin(TpuDevicePlugin):
 
     # ------------------------------------------------------------------ state
 
-    def _build_device_table(self) -> None:
-        with self._cond:
-            self._devs = {
-                p.uuid: pb.Device(
-                    ID=p.uuid,
-                    health=api.HEALTHY,
-                    topology=pb.TopologyInfo(nodes=[pb.NUMANode(ID=p.numa_node)]),
-                )
-                for p in self.partitions
-            }
-            self._version += 1
-            self._cond.notify_all()
+    def _device_rows(self):
+        # partitions are this server's advertised devices; the shared
+        # epoch builder (epoch.build_server_epoch) renders them
+        return tuple((p.uuid, p.numa_node) for p in self.partitions)
 
     def _start_monitor(self) -> None:
         paths: Dict[str, str] = {}
@@ -132,17 +124,6 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                 self.cfg.pci_base_path, bdf, parent_node.get(bdf)),
         ))
 
-    def _invalidate_alloc_fragments(self, device_ids) -> None:
-        """Health transitions arrive keyed by partition uuid; the planner
-        that holds fragments here is the parent-BDF passthrough planner
-        (vfio-backed logical partitions), so map uuids to parents. The
-        inherited self._planner was built from devices=[] and caches
-        nothing worth dropping."""
-        parents = [self._by_uuid[u].parent_bdf for u in device_ids
-                   if u in self._by_uuid]
-        if parents:
-            self._parent_planner.invalidate_fragments(parents)
-
     # ------------------------------------------------------------------- RPCs
 
     def _validate_mdev(self, p: TpuPartition) -> None:
@@ -154,6 +135,10 @@ class VtpuDevicePlugin(TpuDevicePlugin):
 
     def _allocate_impl(self, request, context):
         by_uuid = self._by_uuid
+        # one epoch read per RPC: keys the parent planner's precompiled
+        # fragments (a parent-chip health flip publishes a new epoch, so
+        # the next plan recompiles — no uuid->parent invalidation mapping)
+        epoch_id = self._store.current.epoch_id
         resp = pb.AllocateResponse()
         try:
             for creq in request.container_requests:
@@ -207,7 +192,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                                 f"partition {uuid}: parent {p.parent_bdf} has "
                                 "no accel node and is not vfio-bound")
                         plan = self._parent_planner.plan(
-                            [p.parent_bdf], shared_devices=[])
+                            [p.parent_bdf], shared_devices=[],
+                            epoch=epoch_id)
                         for s in plan.device_specs:
                             add(s.host_path, s.container_path, s.permissions)
                         for addr in plan.expanded_bdfs:
@@ -237,7 +223,13 @@ class VtpuDevicePlugin(TpuDevicePlugin):
 
     def GetPreferredAllocation(self, request, context):
         """Pack partitions onto the fewest parent chips (anti-fragmentation),
-        preferring parents on the NUMA node the allocation started on."""
+        preferring parents on the NUMA node the allocation started on.
+        Pure compute over the construction-time partition index — the
+        read-path bracket pins it lock-free like the base class's."""
+        with lockdep.read_path("server.GetPreferredAllocation"):
+            return self._preferred_impl(request, context)
+
+    def _preferred_impl(self, request, context):
         by_uuid = self._by_uuid
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
