@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -66,6 +66,16 @@ chaos:
 chaos-soak:
 	TDP_CHAOS_SOAK=1 TDP_CHAOS_SEED=$(CHAOS_SEED) JAX_PLATFORMS=cpu \
 		$(PYTHON) -m pytest tests/test_chaos.py -q
+
+# Device lifecycle survivability scenarios (docs/design.md "Device
+# lifecycle"): hot-unplug of an allocated chip, unplug mid-prepare,
+# replug identity swap, migration handoff with source crashes at every
+# step, and old→new checkpoint schema upgrade — all deterministic
+# (events injected at the FSM/driver seams, no sleeps-as-sync). Runs
+# under TDP_LOCKDEP=1 so the FSM's locks are inversion-checked.
+chaos-lifecycle:
+	TDP_CHAOS_SEED=$(CHAOS_SEED) TDP_LOCKDEP=1 JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_lifecycle_scenarios.py -q
 
 # KubeVirt externalResourceProvider contract, no cluster required: real
 # daemon + faithful kubelet sim + simulated virt-controller render

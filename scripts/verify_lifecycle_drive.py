@@ -1,0 +1,150 @@
+"""End-to-end drive of the lifecycle survivability layer (PR 7).
+
+Real daemon (cli.main subprocess) with --dra + fast rediscovery against a
+fake host; driven as the kubelet would:
+  1. prepare a DRA claim over dra.sock (real gRPC)
+  2. hot-unplug the chip (sysfs dir + vfio node removed)
+  3. assert: claim orphaned on /status, device leaves the ResourceSlice,
+     lifecycle counters move, claims_orphaned_total on /metrics
+  4. replug the SAME chip -> rediscovery readmits, slice back to 4
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import grpc  # noqa: E402
+from fakehost import FakeChip, FakeHost  # noqa: E402
+from kubelet_sim import DeviceManagerSim  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
+from tpu_device_plugin.kubeletapi import draapi, drapb  # noqa: E402
+
+root = tempfile.mkdtemp(prefix="vfylc-", dir="/tmp")
+fh = FakeHost(root)
+for i in range(4):
+    fh.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                         iommu_group=str(10 + i), numa_node=i // 2,
+                         serial=f"sn-{i}"))
+victim_bdf = "0000:00:04.0"
+victim_sysfs = os.path.join(root, "sys/bus/pci/devices", victim_bdf)
+victim_backup = os.path.join(root, "victim-backup")
+victim_vfio = os.path.join(root, "dev/vfio/10")
+
+os.makedirs(os.path.join(root, "device-plugins"), exist_ok=True)
+sim = DeviceManagerSim(os.path.join(root, "device-plugins"))
+api = FakeApiServer()
+port = 18161
+env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+           NODE_NAME="node-a")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+     "--dra", "--api-server", api.url, "--status-port", str(port),
+     "--health-poll-seconds", "0.3", "--rediscovery-seconds", "0.5", "-v"],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def status():
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=2) as r:
+        return json.load(r)
+
+
+def metrics():
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
+        return r.read().decode()
+
+
+def wait_for(pred, what, timeout=30):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        try:
+            if pred():
+                print(f"OK: {what}")
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise SystemExit(f"FAIL: timeout waiting for {what}")
+
+
+def slice_names():
+    obj = next(iter(api.slices.values()))
+    return {d["name"] for d in obj["spec"]["devices"]}
+
+
+try:
+    wait_for(lambda: status(), "daemon up")
+    wait_for(lambda: api.slices and len(slice_names()) == 4,
+             "ResourceSlice has 4 devices")
+    wait_for(lambda: status().get("lifecycle", {}).get("states", {})
+             .get("bound") == 4, "lifecycle FSM: 4 devices bound")
+
+    # 1. prepare a claim against the victim over the real DRA socket
+    victim_name = "d0000-00-04-0"
+    api.add_claim("ns", "vm1", "uid-vm1", "cloud-tpus.google.com",
+                  [{"device": victim_name}], generation=5)
+    dra_sock = os.path.join(root, "plugins/cloud-tpus.google.com/dra.sock")
+    with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+        stub = draapi.DraPluginStub(ch)
+        resp = stub.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[
+                drapb.Claim(namespace="ns", name="vm1", uid="uid-vm1")]),
+            timeout=10)
+    assert resp.claims["uid-vm1"].error == "", resp.claims["uid-vm1"].error
+    print("OK: DRA claim prepared over dra.sock")
+    wait_for(lambda: status()["lifecycle"]["states"].get("allocated") == 1,
+             "FSM: victim allocated")
+
+    # 2. hot-unplug: sysfs dir AND vfio node vanish
+    shutil.move(victim_sysfs, victim_backup)
+    os.unlink(victim_vfio)
+
+    # 3. orphan + slice drop + counters
+    wait_for(lambda: status()["dra"]["orphaned_claims"] == ["uid-vm1"],
+             "claim orphaned on /status")
+    wait_for(lambda: victim_name not in slice_names()
+             and len(slice_names()) == 3, "slice devices -> 3 (departed)")
+    wait_for(lambda: status()["dra"]["departed_devices"] == [victim_bdf],
+             "departed device listed")
+    s = status()["lifecycle"]
+    assert s["claims_orphaned_total"] == 1, s
+    assert s["transitions"].get("allocated->gone") == 1, s["transitions"]
+    assert s["surprise_removals"][0]["device"] == victim_bdf
+    print("OK: lifecycle counters (orphaned=1, allocated->gone=1, "
+          "surprise removal recorded)")
+    m = metrics()
+    assert "claims_orphaned_total 1" in m, "claims_orphaned_total not on /metrics"
+    assert 'lifecycle_transitions_total{from="allocated",to="gone"} 1' in m
+    print("OK: /metrics exposes claims_orphaned_total + "
+          "lifecycle_transitions_total{from,to}")
+
+    # 4. replug the same chip: rediscovery readmits, no identity swap
+    shutil.move(victim_backup, victim_sysfs)
+    with open(victim_vfio, "w"):
+        pass
+    wait_for(lambda: len(slice_names()) == 4, "slice devices -> 4 after replug")
+    wait_for(lambda: status()["dra"]["departed_devices"] == [],
+             "departed mark cleared after readmission")
+    s = status()["lifecycle"]
+    assert s["identity_swaps_total"] == 0, s
+    assert s["transitions"].get("gone->replugged") == 1, s["transitions"]
+    print("OK: replug readmitted (identity intact, gone->replugged counted)")
+    print("LIFECYCLE DRIVE PASS")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    api.stop()
+    sim.stop()
+    shutil.rmtree(root, ignore_errors=True)

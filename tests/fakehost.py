@@ -69,6 +69,7 @@ class FakeChip:
     driver: Optional[str] = "vfio-pci"
     accel_index: Optional[int] = None  # also expose /sys/class/accel + /dev/accelN
     vfio_dev: Optional[str] = None     # e.g. "vfio3": create <bdf>/vfio-dev/vfio3
+    serial: Optional[str] = None       # sysfs serial_number (replug identity)
     # upstream PCIe bridge BDF: materializes the device nested under
     # /sys/devices/pci0000:00/<parent>/<bdf> with a symlink from the flat
     # bus view, like real sysfs
@@ -108,6 +109,8 @@ class FakeHost:
         self._write(os.path.join(base, "vendor"), chip.vendor + "\n")
         self._write(os.path.join(base, "device"), "0x" + chip.device_id + "\n")
         self._write(os.path.join(base, "numa_node"), f"{chip.numa_node}\n")
+        if chip.serial is not None:
+            self._write(os.path.join(base, "serial_number"), chip.serial + "\n")
         if chip.driver:
             drv_dir = os.path.join(self.drivers, chip.driver)
             os.makedirs(drv_dir, exist_ok=True)

@@ -448,4 +448,6 @@ def test_checkpoint_write_fault_errors_claims_never_silent_acks(dra_rig):
     assert driver.prepared_claim_count() == 4
     import json as json_mod
     with open(driver.checkpoint_path) as f:
-        assert set(json_mod.load(f)) == set(uids)
+        # versioned envelope (dra.CHECKPOINT_VERSION): claims live under
+        # the "claims" key
+        assert set(json_mod.load(f)["claims"]) == set(uids)

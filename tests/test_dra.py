@@ -146,9 +146,12 @@ class FakeApiServer:
         host, port = self.server.server_address
         return f"http://{host}:{port}"
 
-    def add_claim(self, ns, name, uid, driver, results):
+    def add_claim(self, ns, name, uid, driver, results, generation=None):
+        meta = {"namespace": ns, "name": name, "uid": uid}
+        if generation is not None:
+            meta["generation"] = generation
         self.claims[(ns, name)] = {
-            "metadata": {"namespace": ns, "name": name, "uid": uid},
+            "metadata": meta,
             "status": {"allocation": {"devices": {"results": [
                 {"request": r.get("request", "tpu"), "driver": driver,
                  "pool": r.get("pool", "node-a"), "device": r["device"]}
@@ -245,6 +248,46 @@ def test_republish_changed_inventory_bumps_generation(host, apiserver, tmp_path)
     obj = next(iter(apiserver.slices.values()))
     assert obj["spec"]["pool"]["generation"] == 2
     assert len(obj["spec"]["devices"]) == 5
+
+
+def test_apply_gone_drops_device_from_slice_and_inventory(host, apiserver):
+    """Regression (ISSUE 7 satellite): a device that DISAPPEARED (hot-
+    unplug) must leave the published inventory entirely — removed from
+    by_name so prepares fail with a typed 'departed' error — not ride the
+    unhealthy prune while still being plannable."""
+    from tpu_device_plugin.discovery import discover as rediscover
+
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()
+    ep0 = driver._inventory_snapshot()
+    assert driver.apply_gone(["0000:00:04.0"]) is True
+    # unknown/repeat raws publish nothing
+    assert driver.apply_gone(["0000:00:04.0"]) is False
+    assert driver.apply_gone(["no-such-device"]) is False
+    ep1 = driver._inventory_snapshot()
+    assert ep1.epoch_id == ep0.epoch_id + 1
+    assert chip_name(0) not in ep1.by_name          # gone, not just pruned
+    assert chip_name(0) in ep1.departed
+    assert driver.departed_devices() == ["0000:00:04.0"]
+    obj = next(iter(apiserver.slices.values()))
+    names = {d["name"] for d in obj["spec"]["devices"]}
+    assert chip_name(0) not in names and len(names) == 3
+    assert obj["spec"]["pool"]["generation"] == 2
+    # contrast: an UNHEALTHY device stays in by_name (it may recover in
+    # place), it is merely pruned from the slice body
+    assert driver.apply_health({"0000:00:05.0": False}) is True
+    assert chip_name(1) in driver._by_name
+    # a prepare against the departed device fails with the typed error
+    apiserver.add_claim("ns1", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    resp = prepare(driver, drapb.Claim(namespace="ns1", name="c1",
+                                       uid="uid-1"))
+    assert "departed" in resp.claims["uid-1"].error
+    # replug + rediscovery readmits: departed mark clears, name returns
+    driver.set_inventory(*rediscover(cfg))
+    assert driver.departed_devices() == []
+    assert chip_name(0) in driver._by_name
 
 
 def test_empty_inventory_withdraws_slice(host, apiserver):

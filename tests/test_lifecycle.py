@@ -126,7 +126,8 @@ def test_manager_shares_one_health_hub_across_plugins(kubelet):
             assert p._own_hub is None
         stats = manager.health_stats()
         assert stats["inotify_fds"] == 1
-        assert stats["subscriptions"] == 3
+        # 3 plugin subscriptions + the manager's lifecycle-FSM fs watch
+        assert stats["subscriptions"] == 4
     finally:
         manager.stop()
     assert manager.health_stats()["subscriptions"] == 0

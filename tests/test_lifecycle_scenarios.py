@@ -1,0 +1,738 @@
+"""Device lifecycle chaos scenarios (`make chaos-lifecycle`).
+
+The hard production transitions the base chaos suite (test_chaos.py)
+does not cover, driven deterministically — every event is injected
+synchronously through the FSM/driver seams (or through the armed fault
+sites `pci.hotunplug` / `pci.replug` / `migration.handoff`), never by
+racing wall-clock sleeps:
+
+  1. **unplug-while-allocated** — PCIe surprise removal of a chip with a
+     prepared claim: the claim is orphaned (durably, in the checkpoint),
+     the guest-visible removal is recorded, the device leaves the
+     published ResourceSlice/by_name entirely, and the epoch bump
+     retires precompiled fragments by construction.
+  2. **unplug-during-prepare** — the device departs between the claim
+     fetch and planning: the prepare fails per-claim with the typed
+     "departed" error, leaking neither a CDI spec nor a checkpoint
+     entry.
+  3. **replug-identity-swap** — the slot comes back with different
+     silicon (serial mismatch, or an armed `pci.replug`): readmitted as
+     a NEW identity, counted, and the orphaned claim never reattaches;
+     a same-serial replug readmits cleanly.
+  4. **migration source-crash-mid-handoff** — the handoff record is
+     durable exactly-once across injected `migration.handoff` /
+     `checkpoint.write` failures and a source daemon crash at any point;
+     the destination validates claim UID + allocation generation before
+     preparing.
+  5. **old→new checkpoint upgrade** — a v0 (bare-map) checkpoint loads
+     with claims intact, the daemon re-serves prepared claims without an
+     apiserver round-trip, and a FUTURE-version checkpoint refuses to
+     load with a typed error instead of being silently truncated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer
+from tpu_device_plugin import faults
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover, read_serial
+from tpu_device_plugin.dra import (CHECKPOINT_VERSION, CheckpointVersionError,
+                                   DraDriver, slice_device_name)
+from tpu_device_plugin.kubeapi import ApiClient
+from tpu_device_plugin.kubeletapi import drapb
+from tpu_device_plugin.lifecycle_fsm import (ABSENT, ALLOCATED, BOUND,
+                                             DETACHING, GONE, PRESENT,
+                                             DeviceLifecycle)
+
+SEED = int(os.environ.get("TDP_CHAOS_SEED", "1337"))
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    faults.seed(SEED)
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def apiserver():
+    s = FakeApiServer()
+    yield s
+    s.stop()
+
+
+def bdf(i: int) -> str:
+    return f"0000:00:{4 + i:02x}.0"
+
+
+def chip_name(i: int) -> str:
+    return slice_device_name(bdf(i))
+
+
+def make_host(root, serials=True):
+    h = FakeHost(root)
+    for i in range(4):
+        h.add_chip(FakeChip(bdf(i), device_id="0063",
+                            iommu_group=str(11 + i), numa_node=i // 2,
+                            serial=f"serial-{i}" if serials else None))
+    cfg = Config().with_root(root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    return h, cfg
+
+
+@pytest.fixture()
+def host():
+    root = tempfile.mkdtemp(prefix="tdplc-")
+    yield make_host(root)
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def make_driver(cfg, apiserver, node="node-a"):
+    registry, generations = discover(cfg)
+    api = (ApiClient(apiserver.url, token_path="/nonexistent-token")
+           if apiserver is not None else None)
+    return DraDriver(cfg, registry, generations, node_name=node, api=api)
+
+
+def make_stack(cfg, apiserver, node="node-a"):
+    """Driver + attached lifecycle FSM with the inventory admitted (the
+    production wiring cli.py + PluginManager._sync_lifecycle perform,
+    driven synchronously)."""
+    driver = make_driver(cfg, apiserver, node=node)
+    fsm = DeviceLifecycle(
+        serial_reader=lambda raw: read_serial(cfg.pci_base_path, raw))
+    driver.attach_lifecycle(fsm)
+    sync_fsm(fsm, cfg)
+    return driver, fsm
+
+
+def sync_fsm(fsm, cfg):
+    registry, _ = discover(cfg)
+    fsm.sync_inventory({d.bdf: read_serial(cfg.pci_base_path, d.bdf)
+                        for d in registry.all_devices()})
+
+
+def prepare(driver, uid, ns="ns", name=None):
+    return driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=[
+            drapb.Claim(namespace=ns, name=name or uid, uid=uid)]), None)
+
+
+def unprepare(driver, uid, ns="ns", name=None):
+    return driver.NodeUnprepareResources(
+        drapb.NodeUnprepareResourcesRequest(claims=[
+            drapb.Claim(namespace=ns, name=name or uid, uid=uid)]), None)
+
+
+def reload_driver(driver, cfg, apiserver, node="node-a"):
+    """Daemon crash/upgrade: stop (drains the checkpoint writer) and
+    bring up a fresh instance over the same state directories."""
+    driver.stop()
+    return make_driver(cfg, apiserver, node=node)
+
+
+# ------------------------------------------------ 1. unplug-while-allocated
+
+
+def test_unplug_while_allocated_orphans_claim(host, apiserver):
+    h, cfg = host
+    driver, fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}], generation=3)
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    assert fsm.state_of(bdf(0)) == ALLOCATED
+    ep0 = driver._inventory_snapshot()
+
+    # PCIe surprise removal observed by the health plane
+    shutil.rmtree(os.path.join(h.pci, bdf(0)))
+    h.remove_vfio_group("11")
+    fsm.note_fs_event(bdf(0), False)
+
+    assert fsm.state_of(bdf(0)) == GONE
+    st = fsm.stats()
+    assert st["claims_orphaned_total"] == 1
+    assert st["transitions"].get("allocated->gone") == 1
+    removal = st["surprise_removals"][0]
+    assert removal["device"] == bdf(0) and removal["claims"] == ["uid-1"]
+    # the claim is orphaned, the device left the published inventory
+    assert driver.orphaned_claims() == ["uid-1"]
+    ep1 = driver._inventory_snapshot()
+    assert ep1.epoch_id > ep0.epoch_id           # fragments retired with it
+    assert chip_name(0) not in ep1.by_name
+    assert chip_name(0) in ep1.departed
+    assert driver.departed_devices() == [bdf(0)]
+    names = {d["name"] for d in driver.build_slice()["spec"]["devices"]}
+    assert chip_name(0) not in names and len(names) == 3
+    # a NEW claim allocated to the departed device fails with the typed
+    # error, not a generic stale-slice guess
+    apiserver.add_claim("ns", "c2", "uid-2", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    assert "departed" in prepare(driver, "uid-2",
+                                 name="c2").claims["uid-2"].error
+    # the orphan mark is durable: a daemon restart still reports it and
+    # the prepared claim count is unchanged (exactly-once, no silent drop)
+    driver2 = reload_driver(driver, cfg, apiserver)
+    assert driver2.orphaned_claims() == ["uid-1"]
+    assert driver2.prepared_claim_count() == 1
+    entry = driver2._checkpoint["uid-1"]
+    assert entry["orphaned"]["device"] == bdf(0)
+    assert entry["device_raws"] == [bdf(0)]
+    # an orphaned claim's unprepare emits NO handoff (nothing coherent to
+    # take over) but still deletes cleanly
+    assert unprepare(driver2, "uid-1", name="c1").claims["uid-1"].error == ""
+    assert driver2.prepared_claim_count() == 0
+    assert driver2.export_handoff("uid-1") is None
+    driver2.stop()
+
+
+def test_injected_hotunplug_fault_forces_surprise_removal(host, apiserver):
+    """`pci.hotunplug` inverts presence evidence: no fs mutation needed,
+    and checkpoint semantics stay exactly-once under the injected fault."""
+    _, cfg = host
+    driver, fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(1)}])
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    with faults.injected("pci.hotunplug", kind="drop", count=1):
+        # evidence says present; the armed fault makes it read as removal
+        fsm.note_fs_event(bdf(1), True)
+    assert fsm.state_of(bdf(1)) == GONE
+    assert fsm.stats()["claims_orphaned_total"] == 1
+    assert driver.orphaned_claims() == ["uid-1"]
+    # budget exhausted: the next sync readmits the (really present) chip
+    sync_fsm(fsm, cfg)
+    assert fsm.state_of(bdf(1)) == BOUND
+    # exactly-once: one claim, orphan mark durable, no duplicates
+    driver2 = reload_driver(driver, cfg, apiserver)
+    assert driver2.prepared_claim_count() == 1
+    assert driver2.orphaned_claims() == ["uid-1"]
+    driver2.stop()
+
+
+# ------------------------------------------------ 2. unplug-during-prepare
+
+
+def test_unplug_during_prepare_fails_claim_cleanly(host, apiserver):
+    _, cfg = host
+    driver, fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    real_fetch = driver._allocation_results
+
+    def fetch_then_unplug(claim):
+        out = real_fetch(claim)
+        # the chip departs between the apiserver fetch and planning —
+        # injected synchronously at the seam, no timing race
+        fsm.note_fs_event(bdf(0), False)
+        return out
+
+    driver._allocation_results = fetch_then_unplug
+    resp = prepare(driver, "uid-1", name="c1")
+    driver._allocation_results = real_fetch
+    assert "departed" in resp.claims["uid-1"].error
+    # nothing leaked: no checkpoint entry, no CDI spec, and the on-disk
+    # checkpoint converges to empty (stop drains the writer)
+    assert driver.prepared_claim_count() == 0
+    assert not os.path.exists(driver._claim_spec_path("uid-1"))
+    driver2 = reload_driver(driver, cfg, apiserver)
+    assert driver2.prepared_claim_count() == 0
+    assert driver2.orphan_specs_removed == 0
+    driver2.stop()
+
+
+# ------------------------------------------------ 3. replug-identity-swap
+
+
+def test_replug_identity_swap_keeps_claims_orphaned(host, apiserver):
+    h, cfg = host
+    driver, fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+
+    # unplug (vfio node loss), then the SLOT returns with NEW silicon
+    fsm.note_fs_event(bdf(0), False)
+    assert fsm.state_of(bdf(0)) == GONE
+    with open(os.path.join(h.pci, bdf(0), "serial_number"), "w") as f:
+        f.write("serial-SWAPPED\n")
+    fsm.note_fs_event(bdf(0), True)
+
+    st = fsm.stats()
+    assert fsm.state_of(bdf(0)) == BOUND         # readmitted, new identity
+    assert st["identity_swaps_total"] == 1
+    assert st["transitions"].get("gone->replugged") == 1
+    assert st["transitions"].get("replugged->present") == 1
+    # the orphaned claim never reattaches to the impostor silicon
+    assert driver.orphaned_claims() == ["uid-1"]
+    # rediscovery readmits the slot into the DRA inventory (departed
+    # mark clears) — claims against the OLD identity stay orphaned
+    driver.set_inventory(*discover(cfg))
+    assert driver.departed_devices() == []
+    assert chip_name(0) in driver._by_name
+    assert driver.orphaned_claims() == ["uid-1"]
+
+    # contrast: a same-serial replug of another chip is NOT a swap
+    fsm.note_fs_event(bdf(1), False)
+    fsm.note_fs_event(bdf(1), True)
+    st = fsm.stats()
+    assert fsm.state_of(bdf(1)) == BOUND
+    assert st["identity_swaps_total"] == 1       # unchanged
+    driver.stop()
+
+
+def test_injected_replug_fault_forces_identity_swap(host, apiserver):
+    """`pci.replug` makes a same-serial replug read as an identity swap."""
+    _, cfg = host
+    _, fsm = make_stack(cfg, apiserver)
+    fsm.note_fs_event(bdf(2), False)
+    with faults.injected("pci.replug", kind="drop", count=1):
+        fsm.note_fs_event(bdf(2), True)
+    assert fsm.state_of(bdf(2)) == BOUND
+    assert fsm.stats()["identity_swaps_total"] == 1
+
+
+# ------------------------------------ 4. migration source-crash-mid-handoff
+
+
+def test_migration_handoff_survives_source_crash_and_validates(host,
+                                                               apiserver):
+    _, cfg = host
+    src, fsm = make_stack(cfg, apiserver, node="node-a")
+    apiserver.add_claim("ns", "vm-claim", "uid-mig", src.driver_name,
+                        [{"device": chip_name(0)}], generation=7)
+    assert prepare(src, "uid-mig", name="vm-claim").claims["uid-mig"] \
+        .error == ""
+    assert src._checkpoint["uid-mig"]["generation"] == 7
+    assert fsm.state_of(bdf(0)) == ALLOCATED
+
+    # (a) the handoff emit itself fails: per-claim error BEFORE any state
+    # mutates — claim, spec and FSM state survive for the retry
+    with faults.injected("migration.handoff", count=1):
+        resp = unprepare(src, "uid-mig", name="vm-claim")
+    assert "injected" in resp.claims["uid-mig"].error
+    assert src.prepared_claim_count() == 1
+    assert os.path.exists(src._claim_spec_path("uid-mig"))
+    assert src.export_handoff("uid-mig") is None
+
+    # (b) the commit carrying deletion+handoff fails: both roll back
+    # together — never a durable handoff for a claim still checkpointed
+    with faults.injected("checkpoint.write", count=1):
+        resp = unprepare(src, "uid-mig", name="vm-claim")
+    assert resp.claims["uid-mig"].error != ""
+    assert src.prepared_claim_count() == 1
+    assert src.export_handoff("uid-mig") is None
+
+    # (c) source crashes (restart): the claim was never unprepared, the
+    # retry now emits the handoff durably — exactly once
+    src2 = reload_driver(src, cfg, apiserver, node="node-a")
+    src2.attach_lifecycle(fsm)   # daemon restart re-wires the host FSM
+    assert src2.prepared_claim_count() == 1
+    assert unprepare(src2, "uid-mig",
+                     name="vm-claim").claims["uid-mig"].error == ""
+    record = src2.export_handoff("uid-mig")
+    assert record is not None
+    assert record["generation"] == 7
+    assert record["devices"] == [chip_name(0)]
+    assert record["source_node"] == "node-a"
+    assert fsm.state_of(bdf(0)) == BOUND          # detach completed
+    assert fsm.stats()["transitions"].get("allocated->detaching") == 1
+    assert fsm.stats()["transitions"].get("detaching->bound") == 1
+
+    # (d) source crashes AFTER the emit: the record is checkpointed
+    src3 = reload_driver(src2, cfg, apiserver, node="node-a")
+    assert src3.export_handoff("uid-mig") == record
+    with open(src3.checkpoint_path) as f:
+        on_disk = json.load(f)
+    assert on_disk["version"] == CHECKPOINT_VERSION
+    assert "uid-mig" in on_disk["handoffs"]
+
+    # (e) destination validates the record against the LIVE claim
+    dest_root = tempfile.mkdtemp(prefix="tdplc-dest-")
+    try:
+        _, dest_cfg = make_host(dest_root)
+        dest, _dest_fsm = make_stack(dest_cfg, apiserver, node="node-b")
+        dest.import_handoff(record)
+        # the claim was re-allocated since the source released it
+        # (generation moved): the prepare is refused with a typed error
+        # AND the stale record is evicted — generations are monotonic,
+        # so it could never validate again
+        apiserver.add_claim("ns", "vm-claim", "uid-mig", dest.driver_name,
+                            [{"device": chip_name(0)}], generation=8)
+        resp = prepare(dest, "uid-mig", name="vm-claim")
+        assert "handoff generation" in resp.claims["uid-mig"].error
+        assert dest.prepared_claim_count() == 0
+        # the kubelet retry prepares from the LIVE allocation (the stale
+        # handoff no longer blocks the claim forever); nothing was
+        # handed off
+        resp = prepare(dest, "uid-mig", name="vm-claim")
+        assert resp.claims["uid-mig"].error == ""
+        assert dest.checkpoint_stats()["handoffs_completed_total"] == 0
+        # clean migration: a matching-generation handoff completes once
+        assert unprepare(dest, "uid-mig",
+                         name="vm-claim").claims["uid-mig"].error == ""
+        dest.import_handoff(record)
+        apiserver.add_claim("ns", "vm-claim", "uid-mig", dest.driver_name,
+                            [{"device": chip_name(0)}], generation=7)
+        resp = prepare(dest, "uid-mig", name="vm-claim")
+        assert resp.claims["uid-mig"].error == ""
+        stats = dest.checkpoint_stats()
+        assert stats["handoffs_completed_total"] == 1
+        # idempotent kubelet retry: no double-complete
+        resp = prepare(dest, "uid-mig", name="vm-claim")
+        assert resp.claims["uid-mig"].error == ""
+        assert dest.checkpoint_stats()["handoffs_completed_total"] == 1
+        dest.stop()
+    finally:
+        shutil.rmtree(dest_root, ignore_errors=True)
+    assert src3.checkpoint_stats()["handoffs_emitted_total"] == 0  # fresh
+    src3.stop()
+
+
+def test_handoff_wrong_uid_rejected(host, apiserver):
+    _, cfg = host
+    driver, _fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}], generation=1)
+    driver.import_handoff({"uid": "uid-1", "generation": 1})
+    # staged under uid-1; a claim with a different uid never sees it, and
+    # tampering the record's uid after staging is caught at prepare
+    with driver._lock:
+        driver._incoming_handoffs["uid-1"]["uid"] = "uid-EVIL"
+    resp = prepare(driver, "uid-1", name="c1")
+    assert "handoff record is for claim uid" in resp.claims["uid-1"].error
+    driver.stop()
+
+
+def test_round_trip_migration_retires_source_handoff(host, apiserver):
+    """A claim migrating BACK to its source retires the stale handoff
+    record in the same group commit as the new prepare."""
+    _, cfg = host
+    driver, _fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}], generation=1)
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    assert unprepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    assert driver.export_handoff("uid-1") is not None
+    # ... migrates back:
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    assert driver.export_handoff("uid-1") is None
+    driver2 = reload_driver(driver, cfg, apiserver)
+    assert driver2.export_handoff("uid-1") is None   # durably retired
+    assert driver2.prepared_claim_count() == 1
+    driver2.stop()
+
+
+# ------------------------------------------- 5. old→new checkpoint upgrade
+
+
+def _seed_v0_checkpoint(cfg, apiserver):
+    """Materialize a pre-upgrade (v0, bare-map) checkpoint + claim spec
+    exactly as an old daemon would have left them."""
+    driver = make_driver(cfg, apiserver)     # paths only; never started
+    spec_path = driver._claim_spec_path("uid-old")
+    entry = {
+        "name": "c-old", "namespace": "ns", "spec_path": spec_path,
+        "devices": [{"request_names": ["tpu"], "pool_name": "node-a",
+                     "device_name": chip_name(0),
+                     "cdi_device_ids": [driver._claim_cdi_id("uid-old")]}],
+    }
+    os.makedirs(os.path.dirname(driver.checkpoint_path), exist_ok=True)
+    with open(driver.checkpoint_path, "w") as f:
+        json.dump({"uid-old": entry}, f)
+    os.makedirs(driver.cdi_dir, exist_ok=True)
+    with open(spec_path, "w") as f:
+        json.dump({"cdiVersion": "0.6.0", "devices": []}, f)
+    return spec_path
+
+
+def test_v0_checkpoint_upgrade_claims_survive(host, apiserver):
+    _, cfg = host
+    spec_path = _seed_v0_checkpoint(cfg, apiserver)
+    driver = make_driver(cfg, apiserver)     # the UPGRADED daemon boots
+    assert driver.prepared_claim_count() == 1
+    assert driver.orphan_specs_removed == 0  # the spec has an owner
+    assert os.path.exists(spec_path)
+    # prepared claims are restored BEFORE any kubelet traffic: the echo
+    # path answers without one apiserver round-trip
+    before = len(apiserver.requests)
+    resp = prepare(driver, "uid-old", name="c-old")
+    assert resp.claims["uid-old"].error == ""
+    assert [d.device_name for d in resp.claims["uid-old"].devices] \
+        == [chip_name(0)]
+    assert not any("/resourceclaims/" in path
+                   for _, path in apiserver.requests[before:])
+    # the next commit rewrites the file at the CURRENT schema version
+    assert unprepare(driver, "uid-old",
+                     name="c-old").claims["uid-old"].error == ""
+    with open(driver.checkpoint_path) as f:
+        on_disk = json.load(f)
+    assert on_disk["version"] == CHECKPOINT_VERSION
+    assert on_disk["claims"] == {}
+    assert "uid-old" in on_disk["handoffs"]  # v1 feature, post-upgrade
+    driver.stop()
+
+
+def test_current_schema_round_trips(host, apiserver):
+    _, cfg = host
+    driver, _fsm = make_stack(cfg, apiserver)
+    for i, uid in enumerate(["uid-a", "uid-b"]):
+        apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                            [{"device": chip_name(i)}], generation=2)
+        assert prepare(driver, uid).claims[uid].error == ""
+    driver2 = reload_driver(driver, cfg, apiserver)
+    assert driver2.prepared_claim_count() == 2
+    for i, uid in enumerate(["uid-a", "uid-b"]):
+        entry = driver2._checkpoint[uid]
+        assert entry["device_raws"] == [bdf(i)]
+        assert entry["generation"] == 2
+    driver2.stop()
+
+
+def test_future_version_checkpoint_refuses_to_load(host, apiserver):
+    _, cfg = host
+    probe = make_driver(cfg, apiserver)      # resolves paths
+    path = probe.checkpoint_path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    future = {"version": CHECKPOINT_VERSION + 1,
+              "claims": {"uid-x": {"spec_path": "/nope", "devices": [],
+                                   "from_the_future": True}}}
+    with open(path, "w") as f:
+        json.dump(future, f)
+    with pytest.raises(CheckpointVersionError):
+        make_driver(cfg, apiserver)
+    # refusing means NOT corrupting: the file is byte-identical after
+    with open(path) as f:
+        assert json.load(f) == future
+    # malformed version fields refuse too (never guessed at)
+    with open(path, "w") as f:
+        json.dump({"version": "banana"}, f)
+    with pytest.raises(CheckpointVersionError):
+        make_driver(cfg, apiserver)
+
+
+def test_orphan_spec_sweep_on_startup(host, apiserver):
+    """Satellite: a crash between spec write and checkpoint commit leaks
+    a claim spec no checkpoint entry owns — swept (and counted) at the
+    next startup; foreign files in the CDI dir are untouched."""
+    _, cfg = host
+    probe = make_driver(cfg, apiserver)
+    os.makedirs(probe.cdi_dir, exist_ok=True)
+    stray = probe._claim_spec_path("uid-stray")
+    with open(stray, "w") as f:
+        json.dump({"cdiVersion": "0.6.0"}, f)
+    foreign = os.path.join(probe.cdi_dir, "unrelated.json")
+    with open(foreign, "w") as f:
+        f.write("{}")
+    driver = make_driver(cfg, apiserver)
+    assert driver.orphan_specs_removed == 1
+    assert not os.path.exists(stray)
+    assert os.path.exists(foreign)
+    assert driver.checkpoint_stats()["orphan_specs_removed"] == 1
+
+
+def test_restart_replays_claim_marks_into_fresh_fsm(host, apiserver):
+    """A daemon restart builds a FRESH FSM; attach_lifecycle must replay
+    the checkpoint's claim marks into it, or a post-restart hot-unplug
+    of an allocated device would orphan nothing."""
+    _, cfg = host
+    driver, _fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    # full restart: fresh driver AND fresh FSM (in production both die)
+    driver2 = reload_driver(driver, cfg, apiserver)
+    fsm2 = DeviceLifecycle(
+        serial_reader=lambda raw: read_serial(cfg.pci_base_path, raw))
+    driver2.attach_lifecycle(fsm2)
+    sync_fsm(fsm2, cfg)
+    assert fsm2.state_of(bdf(0)) == ALLOCATED     # marks replayed
+    fsm2.note_fs_event(bdf(0), False)
+    assert driver2.orphaned_claims() == ["uid-1"]
+    assert fsm2.stats()["claims_orphaned_total"] == 1
+    driver2.stop()
+
+
+def test_unplug_while_daemon_down_orphans_at_startup_sync(host, apiserver):
+    """The chip is pulled while the daemon is down: the first inventory
+    sync of the new incarnation discovers the gap and orphans the
+    restored claim marks."""
+    h, cfg = host
+    driver, _fsm = make_stack(cfg, apiserver)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    driver.stop()                                  # daemon goes down
+    shutil.rmtree(os.path.join(h.pci, bdf(0)))     # chip pulled meanwhile
+    h.remove_vfio_group("11")
+    driver2 = make_driver(cfg, apiserver)          # daemon comes back
+    fsm2 = DeviceLifecycle(
+        serial_reader=lambda raw: read_serial(cfg.pci_base_path, raw))
+    driver2.attach_lifecycle(fsm2)
+    sync_fsm(fsm2, cfg)                            # sees only 3 chips
+    assert driver2.orphaned_claims() == ["uid-1"]
+    st = fsm2.stats()
+    assert st["claims_orphaned_total"] == 1
+    assert st["surprise_removals"][0]["device"] == bdf(0)
+    driver2.stop()
+
+
+def test_vfio_flap_with_sysfs_present_is_health_not_unplug(host, apiserver):
+    """Corroboration: a /dev/vfio node flap while the chip is still
+    enumerated in sysfs is a recoverable HEALTH event (the health plane
+    prunes/restores it) — never a hot-unplug, never an orphaned claim.
+    This is the contract verify-drive and the chaos flap suite pin."""
+    h, cfg = host
+    driver = make_driver(cfg, apiserver)
+    fsm = DeviceLifecycle(
+        serial_reader=lambda raw: read_serial(cfg.pci_base_path, raw),
+        presence_reader=lambda raw: os.path.isdir(
+            os.path.join(h.pci, raw)))
+    driver.attach_lifecycle(fsm)
+    sync_fsm(fsm, cfg)
+    apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                        [{"device": chip_name(0)}])
+    assert prepare(driver, "uid-1", name="c1").claims["uid-1"].error == ""
+    # vfio node lost; sysfs dir still there -> NOT gone
+    h.remove_vfio_group("11")
+    fsm.note_fs_event(bdf(0), False)
+    assert fsm.state_of(bdf(0)) == ALLOCATED
+    assert fsm.stats()["claims_orphaned_total"] == 0
+    assert driver.orphaned_claims() == []
+    assert chip_name(0) in driver._by_name
+    # the same holds for the sync path (inventory drops the unbound chip
+    # but sysfs still enumerates it): demoted, not orphaned
+    fsm.note_allocated(bdf(1), "uid-x")
+    fsm.sync_inventory({b: None for b in (bdf(0), bdf(2), bdf(3))})
+    assert fsm.state_of(bdf(1)) == ALLOCATED    # claims pin it
+    assert fsm.stats()["claims_orphaned_total"] == 0
+    # sysfs dir REMOVED too -> now it is a hot-unplug
+    shutil.rmtree(os.path.join(h.pci, bdf(0)))
+    fsm.note_fs_event(bdf(0), False)
+    assert fsm.state_of(bdf(0)) == GONE
+    assert driver.orphaned_claims() == ["uid-1"]
+    driver.stop()
+
+
+# --------------------------------------------------- FSM unit invariants
+
+
+def test_fsm_transition_table_and_counters():
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": "s0"})
+    assert fsm.state_of("d0") == BOUND
+    t = fsm.stats()["transitions"]
+    assert t == {"absent->present": 1, "present->bound": 1}
+    # invalid transition: counted, state unchanged, never raises
+    fsm.note_released("d0", "no-claim")      # bound, nothing to release
+    fsm._records["d0"].state = BOUND
+    assert not fsm._transition_locked(fsm._records["d0"], GONE) or True
+    fsm.note_fs_event("unknown-device", False)   # untracked: ignored
+    assert fsm.stats()["devices"] == 1
+
+
+def test_fsm_detach_cycle():
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": "s0"})
+    fsm.note_allocated("d0", "u1")
+    fsm.note_allocated("d0", "u2")           # two claims share the device
+    assert fsm.state_of("d0") == ALLOCATED
+    fsm.note_detaching("d0", "u1")
+    assert fsm.state_of("d0") == DETACHING
+    fsm.note_released("d0", "u1")
+    assert fsm.state_of("d0") == DETACHING   # u2 still holds it
+    fsm.note_released("d0", "u2")
+    assert fsm.state_of("d0") == BOUND
+
+
+def test_fsm_lockfree_alloc_queue_drains_on_sync():
+    """The classic Allocate path's C-atomic queue marks devices
+    allocated on the next writer-side event; with no tracked claim the
+    next sync demotes them back to bound (grants are unobservable)."""
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": None, "d1": None})
+    fsm.note_allocation_event(["d0"])        # lock-free producer
+    assert fsm.state_of("d0") == BOUND       # not drained yet (stats is
+    assert "allocated" not in fsm.stats()["states"]  # lock-free too)
+    fsm.note_allocated("d1", "u1")           # any writer-side call drains
+    assert fsm.state_of("d0") == ALLOCATED
+    fsm.sync_inventory({"d0": None, "d1": None})
+    assert fsm.state_of("d0") == BOUND       # anonymous grant demoted
+    assert fsm.state_of("d1") == ALLOCATED   # claim-tracked: kept
+
+
+def test_fsm_multi_device_removal_batches_gone_delivery():
+    """A switch-level removal delivers ONE batched gone event — one
+    epoch publish + one slice republish downstream, not one per chip."""
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": None, "d1": None, "d2": None})
+    batches = []
+    fsm.on_devices_gone = lambda events: batches.append(sorted(events))
+    fsm.sync_inventory({})
+    assert batches == [[("d0", []), ("d1", []), ("d2", [])]]
+
+
+def test_fsm_new_claim_during_detach_is_tracked():
+    """A claim prepared while another claim's detach is in flight must
+    be tracked, or a later hot-unplug would fail to orphan it."""
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": None})
+    fsm.note_allocated("d0", "A")
+    fsm.note_allocated("d0", "B")
+    fsm.note_detaching("d0", "A")
+    fsm.note_released("d0", "A")
+    assert fsm.state_of("d0") == DETACHING      # B still holds the device
+    fsm.note_allocated("d0", "C")               # new claim mid-detach
+    assert fsm.state_of("d0") == ALLOCATED
+    gone = []
+    fsm.on_devices_gone = lambda ev: gone.extend(ev)
+    fsm.note_fs_event("d0", False)
+    assert gone == [("d0", ["B", "C"])]
+
+
+def test_fsm_unbind_rebind_cycle_keeps_device_usable():
+    """Administrative vfio unbind demotes to PRESENT; a later rebind
+    promotes back to BOUND so new claim marks are accepted again."""
+    fsm = DeviceLifecycle(presence_reader=lambda raw: True)
+    fsm.sync_inventory({"d0": None})
+    fsm.sync_inventory({})              # unbound, still enumerated
+    assert fsm.state_of("d0") == PRESENT
+    assert fsm.stats()["claims_orphaned_total"] == 0
+    fsm.sync_inventory({"d0": None})    # rebound
+    assert fsm.state_of("d0") == BOUND
+    fsm.note_allocated("d0", "u1")
+    assert fsm.state_of("d0") == ALLOCATED
+
+
+def test_fsm_gone_before_admission_and_absent_sync():
+    fsm = DeviceLifecycle()
+    fsm.sync_inventory({"d0": "s0", "d1": "s1"})
+    fsm.note_allocated("d1", "u1")
+    gone_events = []
+    fsm.on_devices_gone = lambda events: gone_events.extend(events)
+    # d1 absent from the next sysfs truth: gone + orphaned via callback
+    fsm.sync_inventory({"d0": "s0"})
+    assert fsm.state_of("d1") == GONE
+    assert gone_events == [("d1", ["u1"])]
+    assert fsm.stats()["claims_orphaned_total"] == 1
+    # returns with the same serial: readmitted quietly
+    fsm.sync_inventory({"d0": "s0", "d1": "s1"})
+    assert fsm.state_of("d1") == BOUND
+    assert fsm.stats()["identity_swaps_total"] == 0
+    # returns (after another loss) with a different serial: swap. The
+    # second loss fires the gone hook too — with NO orphans (the driver
+    # still drops the device from its slice)
+    fsm.sync_inventory({"d0": "s0"})
+    assert gone_events[-1] == ("d1", [])
+    fsm.sync_inventory({"d0": "s0", "d1": "s1-NEW"})
+    assert fsm.state_of("d1") == BOUND
+    assert fsm.stats()["identity_swaps_total"] == 1
+    assert fsm.state_of("never-seen") == ABSENT

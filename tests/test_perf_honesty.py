@@ -494,7 +494,8 @@ def test_attach_burst_32_claims_coalesce_to_few_checkpoint_writes(short_root):
         # recovers all 32 without a single API re-fetch
         import json
         with open(driver.checkpoint_path) as f:
-            assert set(json.load(f)) == set(uids)
+            # versioned envelope: claims live under the "claims" key
+            assert set(json.load(f)["claims"]) == set(uids)
         driver.stop()
     finally:
         apiserver.stop()

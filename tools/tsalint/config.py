@@ -107,6 +107,18 @@ COUNTERS: Dict[str, Dict[str, str]] = {
         "checkpoint_stats_counters[*]": "dra.DraDriver._ckpt_cond",
         "_prepare_inflight": "dra.DraDriver._ckpt_cond",
         "_attach_active": "dra.DraDriver._ckpt_cond",
+        # migration handoff counters (emitted/completed): /status reads
+        # them lock-free via a C-atomic fixed-key dict copy
+        "handoff_stats[*]": "dra.DraDriver._lock",
+    },
+    # device lifecycle FSM: every transition/orphan/swap counter mutates
+    # under the FSM writer lock; stats() reads them lock-free (GIL-atomic
+    # int reads + C-atomic dict copies), same contract as healthhub
+    "lifecycle_fsm.DeviceLifecycle": {
+        "transition_counts[*]": "lifecycle_fsm.DeviceLifecycle._lock",
+        "claims_orphaned_total": "lifecycle_fsm.DeviceLifecycle._lock",
+        "identity_swaps_total": "lifecycle_fsm.DeviceLifecycle._lock",
+        "invalid_transitions_total": "lifecycle_fsm.DeviceLifecycle._lock",
     },
     # allocate.AllocationPlanner fragment_hits/misses are AtomicCounters
     # (no owning lock; the fragment cache is epoch-keyed and lock-free).

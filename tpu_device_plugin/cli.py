@@ -373,6 +373,10 @@ def main(argv=None) -> int:
         # registration-socket watch (kubelet-restart recovery) — same hub,
         # same single inotify fd as the plugin servers
         dra_driver.attach_health_hub(manager.health_hub)
+        # lifecycle FSM wiring (lifecycle_fsm.py): prepares mark devices
+        # allocated; a hot-unplugged device with prepared claims orphans
+        # them in the checkpoint and leaves the published ResourceSlice
+        dra_driver.attach_lifecycle(manager.device_lifecycle)
 
     def handle_drain(signum, frame):
         # flag-set only: drain() takes locks the interrupted main thread

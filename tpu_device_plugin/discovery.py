@@ -97,6 +97,27 @@ def read_link_basename(path: str) -> Optional[str]:
         return None
 
 
+def read_serial(pci_base_path: str, bdf: str) -> Optional[str]:
+    """The chip's stable silicon identity for replug reconciliation
+    (lifecycle_fsm.DeviceLifecycle): the sysfs `serial_number` attribute
+    when the driver exposes one, else the PCI device id — a different
+    model landing on the same BDF is still detected as an identity swap,
+    and indistinguishable silicon degrades to BDF-only identity (the
+    pre-FSM behavior) rather than false-positive swaps."""
+    base = os.path.join(pci_base_path, bdf)
+    for attr in ("serial_number", "serial"):
+        path = os.path.join(base, attr)
+        _note(path)
+        try:
+            with open(path, "r", encoding="ascii", errors="replace") as f:
+                value = f.read().strip()
+        except OSError:
+            continue
+        if value:
+            return value
+    return read_id_from_file(os.path.join(base, "device"))
+
+
 def read_numa_node(path: str) -> int:
     """NUMA node, clamping negatives (unset) to 0 (reference :304-320)."""
     _note(path)

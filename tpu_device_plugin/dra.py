@@ -104,6 +104,50 @@ CHECKPOINT_COMMIT_WINDOW_S = 0.010
 # respawns it — and a driver dropped without stop() (tests, embedders)
 # then sheds its writer instead of leaking one per driver lifetime.
 CHECKPOINT_WRITER_IDLE_S = 2.0
+# ---- checkpoint schema versioning (daemon upgrade under live allocations)
+# v0 (pre-lifecycle): the bare {uid: entry} claim map, no version key.
+# v1: {"version": 1, "claims": {...}, "handoffs": {...}} — claim entries
+# additionally carry the devices' raw ids and the claim's allocation
+# generation; "handoffs" holds the migration records NodeUnprepareResources
+# emits. Forward migrations live in _CKPT_MIGRATIONS; a checkpoint from a
+# NEWER daemon refuses to load (CheckpointVersionError) instead of being
+# silently truncated and then overwritten by the next group commit.
+CHECKPOINT_VERSION = 1
+# migration handoff records retained on the source: bounded so a node that
+# unprepares thousands of claims over its lifetime cannot grow the
+# checkpoint without bound (oldest dropped first; a consumed or
+# re-prepared claim's record is dropped eagerly)
+HANDOFF_MAX_RECORDS = 64
+
+
+class CheckpointVersionError(RuntimeError):
+    """The on-disk checkpoint was written by a NEWER daemon than this
+    binary. Refusing to start is the only safe move: loading would drop
+    fields the newer schema relies on and the next group commit would
+    overwrite (corrupt) the file — a rollback must ship a binary that
+    speaks the schema, not eat the node's claim state."""
+
+
+class HandoffValidationError(AllocationError):
+    """A migration handoff record failed validation against the live
+    ResourceClaim (UID or allocation-generation mismatch): the claim was
+    deleted/re-allocated since the source emitted the record, so
+    preparing from it would attach the pod to stale devices."""
+
+
+def _ckpt_v0_to_v1(data: dict) -> dict:
+    """v0 → v1: wrap the bare uid→entry map. Entries gain no mandatory
+    fields (device_raws / generation / orphaned are all optional), so
+    pre-upgrade claims keep working; they just lack lifecycle metadata
+    until re-prepared."""
+    claims = {uid: entry for uid, entry in data.items()
+              if isinstance(entry, dict)}
+    return {"version": 1, "claims": claims, "handoffs": {}}
+
+
+# version N -> migration producing version N+1; applied in sequence by
+# _load_checkpoint until CHECKPOINT_VERSION is reached
+_CKPT_MIGRATIONS = {0: _ckpt_v0_to_v1}
 
 
 def slice_device_name(raw: str) -> str:
@@ -266,8 +310,36 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             "checkpoint_commits_total": 0,
             "checkpoint_claims_coalesced_total": 0,
         }
+        # ---- lifecycle survivability -----------------------------------
+        # raw id -> published name of devices REMOVED from the inventory
+        # by hot-unplug (apply_gone); cleared when rediscovery readmits
+        # the raw id. Writer-owned (mutated under _lock); the published
+        # epoch carries the name frozenset for the prepare path.
+        self._departed: Dict[str, str] = {}
+        # migration handoff counters; mutated under _lock, read lock-free
+        # by checkpoint_stats (fixed keys, C-atomic dict copy)
+        self.handoff_stats = {
+            "handoffs_emitted_total": 0,
+            "handoffs_completed_total": 0,
+        }
+        # handoff records staged by import_handoff for the destination's
+        # next prepare of that claim UID (in-memory: the record's source
+        # of truth is the SOURCE node's checkpoint)
+        self._incoming_handoffs: Dict[str, dict] = {}
+        # host lifecycle FSM (lifecycle_fsm.DeviceLifecycle), attached by
+        # cli.py via attach_lifecycle; None when running DRA standalone
+        self._lifecycle = None
         self.set_inventory(registry, generations)
-        self._checkpoint: Dict[str, dict] = self._load_checkpoint()
+        loaded = self._load_checkpoint()
+        self._checkpoint: Dict[str, dict] = loaded["claims"]
+        # migration handoff records this node emitted, persisted in the
+        # checkpoint so a source-daemon crash/upgrade between unprepare
+        # and the destination's prepare cannot lose the handoff
+        self._handoffs: Dict[str, dict] = loaded["handoffs"]
+        # startup orphan sweep: claim-spec files whose UID the loaded
+        # checkpoint does not know (crash between spec write and
+        # checkpoint commit) are deleted, not leaked forever
+        self.orphan_specs_removed = self._sweep_orphan_specs()
 
     # ---------------------------------------------------------- inventory
 
@@ -378,11 +450,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 for raw, kind, group, obj in entries}
             # devices that left the inventory take their health state along
             self._unhealthy &= set(names)
+            # a departed (hot-unplugged) raw id that rediscovery readmits
+            # sheds its departed mark — replug reconciliation happened
+            # upstream in the lifecycle FSM before it re-entered the
+            # registry
+            self._departed = {raw: name
+                              for raw, name in self._departed.items()
+                              if raw not in names}
             self._inv_store.publish(epoch_mod.build_inventory_epoch(
                 self._inv_store.current.epoch_id + 1, by_name, planners,
                 # vfio-backed logical partitions ride their parent's planner
                 AllocationPlanner(self.cfg, registry, "vtpu-parent"),
-                frozenset(self._unhealthy)))
+                frozenset(self._unhealthy),
+                frozenset(self._departed.values())))
         if sticky_dirty:
             # file I/O stays OUTSIDE the global lock (a slow disk must not
             # stall claim prepares / slice builds); _save_sticky_names
@@ -548,7 +628,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 dead = sorted(self._unhealthy)
                 self._inv_store.publish(epoch_mod.build_inventory_epoch(
                     ep.epoch_id + 1, ep.by_name, ep.planners,
-                    ep.parent_planner, frozenset(self._unhealthy)))
+                    ep.parent_planner, frozenset(self._unhealthy),
+                    ep.departed))
         if not changed:
             return False
         log.warning("DRA: health transition; unhealthy devices now %s",
@@ -586,6 +667,131 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 return
         if not self.publish_resource_slices():
             self._arm_republish_retry()
+
+    def apply_gone(self, raws) -> bool:
+        """Hot-unplug: REMOVE departed devices from the published
+        inventory entirely.
+
+        Distinct from `apply_health(healthy=False)`: an unhealthy device
+        stays in `by_name` (a prepare against it still plans — the chip
+        may answer again next probe) and is merely pruned from the slice
+        body; a DEPARTED device's sysfs/devfs nodes no longer exist, so
+        it must vanish from `by_name` too — a prepare against it fails
+        with a "departed" error instead of handing the pod dead device
+        nodes, and the ResourceSlice stops advertising it under a bumped
+        pool generation. The epoch publish also retires every planner's
+        precompiled fragments by construction. Returns True when the
+        inventory changed (and a republish was attempted)."""
+        raws = set(raws)
+        with self._lock:
+            ep = self._inv_store.current
+            gone = {name: self._raw_id(kind, obj)
+                    for name, (kind, _, obj) in ep.by_name.items()
+                    if self._raw_id(kind, obj) in raws}
+            if not gone:
+                return False
+            by_name = {name: entry for name, entry in ep.by_name.items()
+                       if name not in gone}
+            # departed, not unhealthy: the device cannot "recover" in
+            # place — only a replug (rediscovery readmission) returns it
+            self._unhealthy -= raws
+            for name, raw in gone.items():
+                self._departed[raw] = name
+            self._inv_store.publish(epoch_mod.build_inventory_epoch(
+                ep.epoch_id + 1, by_name, ep.planners, ep.parent_planner,
+                frozenset(self._unhealthy),
+                frozenset(self._departed.values())))
+        log.warning("DRA: device(s) %s departed (hot-unplug); removed "
+                    "from the published ResourceSlice", sorted(gone.values()))
+        if not self.publish_resource_slices():
+            self._arm_republish_retry()
+        return True
+
+    def attach_lifecycle(self, fsm) -> None:
+        """Wire the host lifecycle FSM (lifecycle_fsm.DeviceLifecycle):
+        prepares/unprepares mark their devices allocated/detaching/
+        released, and the FSM's hot-unplug hook routes back into
+        `on_devices_gone`. Call before start()."""
+        self._lifecycle = fsm
+        fsm.on_devices_gone = self.on_devices_gone
+        fsm.on_device_readmitted = self.on_device_readmitted
+        # replay the checkpoint's claim marks into the (possibly fresh)
+        # FSM: a daemon restart must not forget which devices carry
+        # prepared claims, or a post-restart hot-unplug would orphan
+        # nothing. Already-orphaned entries stay orphaned — their
+        # devices are not re-marked allocated.
+        claims_by_raw: Dict[str, List[str]] = {}
+        with self._lock:
+            for uid, entry in self._checkpoint.items():
+                if "orphaned" in entry:
+                    continue
+                for raw in entry.get("device_raws", ()):
+                    claims_by_raw.setdefault(raw, []).append(uid)
+        if claims_by_raw:
+            fsm.restore_claims(claims_by_raw)
+
+    def on_devices_gone(self, events) -> None:
+        """Lifecycle hook: `events` is [(raw, claim_uids), ...] — every
+        device hot-unplugged in one observation, allocated or not.
+        Claims prepared against them are marked ORPHANED in the
+        checkpoint (the guest-visible surprise removal is recorded on
+        the entry), the devices are dropped from the published
+        ResourceSlice in ONE epoch publish + ONE republish (a PCIe
+        switch dropping four chips costs one API round-trip, not four),
+        and the checkpoint converges in the background — no flush
+        barrier, because nothing ACKs on this path and the marks are
+        reconstructed from the checkpoint by attach_lifecycle's replay
+        after a crash."""
+        now = time.time()
+        marked = []
+        with self._lock:
+            for raw, claim_uids in events:
+                for uid in claim_uids:
+                    entry = self._checkpoint.get(uid)
+                    if entry is not None and "orphaned" not in entry:
+                        # replace wholesale: the group-commit writer may
+                        # be serializing a shallow snapshot of the old
+                        # entry right now, and an in-place mutation
+                        # could race it
+                        self._checkpoint[uid] = dict(
+                            entry, orphaned={"device": raw, "at": now})
+                        marked.append(uid)
+        if marked:
+            log.error("DRA: claim(s) %s orphaned by surprise removal",
+                      ", ".join(marked))
+            self._checkpoint_mark_dirty()
+        self.apply_gone([raw for raw, _ in events])
+
+    def on_device_readmitted(self, raw: str) -> None:
+        """Lifecycle hook: a departed device passed replug identity
+        reconciliation. When the unplug and replug both land within one
+        rediscovery tick the registry signature never changes — no
+        inventory event would re-run set_inventory, and the device would
+        stay out of the slice forever. Rebuild from the LAST discovery
+        snapshot (which still carries the device); a replug that
+        rediscovery did observe readmits via the normal set_inventory
+        path instead (the raw id is absent from self.registry here and
+        the departed mark survives until that snapshot arrives)."""
+        if raw not in self._departed:      # GIL-atomic peek; cheap filter
+            return
+        self.set_inventory(self.registry, self.generations)
+        if raw in self._departed:
+            return   # not in the last snapshot: rediscovery will readmit
+        log.info("DRA: device %s readmitted after replug; republishing "
+                 "the ResourceSlice", raw)
+        if not self.publish_resource_slices():
+            self._arm_republish_retry()
+
+    def orphaned_claims(self) -> List[str]:
+        """Claim UIDs whose device was surprise-removed (lock-free read:
+        C-atomic list copy + GIL-atomic key reads)."""
+        return sorted(uid for uid, entry in list(self._checkpoint.items())
+                      if "orphaned" in entry)
+
+    def departed_devices(self) -> List[str]:
+        """Raw ids currently marked departed (hot-unplugged, not yet
+        readmitted); lock-free C-atomic copy."""
+        return sorted(list(self._departed))
 
     @property
     def _by_name(self) -> Dict[str, Tuple[str, str, object]]:
@@ -795,15 +1001,78 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     # ------------------------------------------------------- checkpointing
 
-    def _load_checkpoint(self) -> Dict[str, dict]:
+    def _load_checkpoint(self) -> Dict[str, Dict[str, dict]]:
+        """Load + forward-migrate the claim checkpoint.
+
+        Returns {"claims": {...}, "handoffs": {...}} at
+        CHECKPOINT_VERSION. A missing/unreadable/corrupt-JSON file keeps
+        the legacy lenient semantics (fresh state — a missing file IS
+        the normal first boot), but a parseable checkpoint whose version
+        is NEWER than this binary's raises CheckpointVersionError so the
+        daemon refuses to start: silently truncating a future schema and
+        then group-committing over it would corrupt the node's claim
+        state during a rollback.
+        """
         try:
             with open(self.checkpoint_path, "r", encoding="utf-8") as f:
                 data = json.load(f)
-            if isinstance(data, dict):
-                return data
         except (OSError, ValueError):
-            pass
-        return {}
+            return {"claims": {}, "handoffs": {}}
+        if not isinstance(data, dict):
+            return {"claims": {}, "handoffs": {}}
+        version = data.get("version", 0)
+        if not isinstance(version, int) or isinstance(version, bool) \
+                or version < 0:
+            raise CheckpointVersionError(
+                f"checkpoint {self.checkpoint_path} carries a malformed "
+                f"schema version {version!r}; refusing to start rather "
+                f"than guess (move the file aside to discard its claims)")
+        if version > CHECKPOINT_VERSION:
+            raise CheckpointVersionError(
+                f"checkpoint {self.checkpoint_path} is schema v{version}, "
+                f"newer than this daemon's v{CHECKPOINT_VERSION}; refusing "
+                f"to start — roll the daemon forward (or move the file "
+                f"aside to discard its claims)")
+        while version < CHECKPOINT_VERSION:
+            data = _CKPT_MIGRATIONS[version](data)
+            new_version = data["version"]
+            log.info("DRA: migrated checkpoint schema v%d -> v%d",
+                     version, new_version)
+            version = new_version
+        claims = {uid: entry
+                  for uid, entry in (data.get("claims") or {}).items()
+                  if isinstance(entry, dict)}
+        handoffs = {uid: rec
+                    for uid, rec in (data.get("handoffs") or {}).items()
+                    if isinstance(rec, dict)}
+        return {"claims": claims, "handoffs": handoffs}
+
+    def _sweep_orphan_specs(self) -> int:
+        """Delete claim-spec CDI files whose UID the loaded checkpoint
+        does not know. A crash between the spec write and the checkpoint
+        commit (prepare's rollback only runs on a FAILED commit, not on
+        a process death) used to leak the stale spec forever; counted on
+        /status as `orphan_specs_removed`."""
+        prefix = f"{self._driver_fs}-claim-"
+        try:
+            entries = os.listdir(self.cdi_dir)
+        except OSError:
+            return 0
+        removed = 0
+        for name in entries:
+            if not (name.startswith(prefix) and name.endswith(".json")):
+                continue
+            uid = name[len(prefix):-len(".json")]
+            if uid in self._checkpoint:
+                continue
+            try:
+                os.unlink(os.path.join(self.cdi_dir, name))
+            except OSError:
+                continue
+            removed += 1
+            log.warning("DRA: removed orphaned claim spec %s (uid %s not "
+                        "in the checkpoint)", name, uid)
+        return removed
 
     # Group-commit protocol: a claim task (1) mutates self._checkpoint under
     # self._lock, (2) calls _checkpoint_flush(), which bumps the dirty
@@ -919,7 +1188,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 n_claims = self._ckpt_pending_claims
                 self._ckpt_pending_claims = 0
             with self._lock:
-                snapshot = dict(self._checkpoint)
+                # versioned envelope (CHECKPOINT_VERSION): claims +
+                # migration handoff records ride one atomic write
+                snapshot = {"version": CHECKPOINT_VERSION,
+                            "claims": dict(self._checkpoint),
+                            "handoffs": dict(self._handoffs)}
             err: Optional[BaseException] = None
             try:
                 # fault point "checkpoint.write" (raising): a failed commit
@@ -966,6 +1239,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         out = dict(self.checkpoint_stats_counters)
         out["prepare_inflight"] = self._prepare_inflight
         out["prepare_workers"] = self.prepare_workers
+        # lifecycle survivability surfaces (same lock-free contract:
+        # fixed-key dict copies + GIL-atomic int/len reads)
+        out.update(dict(self.handoff_stats))
+        out["handoff_records"] = len(self._handoffs)
+        out["orphan_specs_removed"] = self.orphan_specs_removed
+        out["checkpoint_version"] = CHECKPOINT_VERSION
         return out
 
     def _load_sticky_names(self):
@@ -1029,8 +1308,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         _atomic_write_json(path, spec)
         return path
 
-    def _allocation_results(self, claim: drapb.Claim) -> List[dict]:
-        """This driver's device results from the claim's live allocation."""
+    def _allocation_results(self, claim: drapb.Claim) -> Tuple[List[dict],
+                                                               Optional[int]]:
+        """(this driver's device results, metadata.generation) from the
+        claim's live allocation. The generation is recorded at prepare
+        time and validated by the migration-handoff path: a handoff
+        emitted for generation N must not prepare a claim whose live
+        object has since moved."""
         if self.api is None:
             raise AllocationError("no API server client configured")
         path = (f"{self._resource_api()}/namespaces/{claim.namespace}"
@@ -1041,7 +1325,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             if isinstance(exc, ApiError) and exc.code == 404:
                 self._note_api_404()
             raise AllocationError(f"ResourceClaim GET failed: {exc}")
-        uid = (obj.get("metadata") or {}).get("uid")
+        meta = obj.get("metadata") or {}
+        uid = meta.get("uid")
         if uid != claim.uid:
             # the claim was deleted and recreated under the same name; the
             # kubelet's request is for the OLD object — preparing the new
@@ -1049,9 +1334,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             raise AllocationError(
                 f"ResourceClaim UID mismatch (live {uid!r} != "
                 f"requested {claim.uid!r})")
+        generation = meta.get("generation")
+        if not isinstance(generation, int):
+            generation = None
         alloc = ((obj.get("status") or {}).get("allocation") or {})
         results = ((alloc.get("devices") or {}).get("results")) or []
-        return [r for r in results if r.get("driver") == self.driver_name]
+        return ([r for r in results if r.get("driver") == self.driver_name],
+                generation)
 
     def _inventory_snapshot(self) -> epoch_mod.InventoryEpoch:
         """The current inventory epoch — ONE atomic reference read, no
@@ -1094,10 +1383,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         chips_by_gen: Dict[str, List[str]] = {}
         partitions: List[Tuple[str, TpuPartition]] = []
         for r in results:
-            entry = by_name.get(r.get("device", ""))
+            name = r.get("device", "")
+            entry = by_name.get(name)
             if entry is None:
+                if name in ep.departed:
+                    # hot-unplugged while the allocation was in flight:
+                    # say so — this is a surprise removal, not scheduler
+                    # staleness, and the operator remedies differ
+                    raise AllocationError(
+                        f"allocated device {name!r} departed this node "
+                        "(PCIe hot-unplug); the claim must be "
+                        "re-allocated")
                 raise AllocationError(
-                    f"allocated device {r.get('device')!r} is not in this "
+                    f"allocated device {name!r} is not in this "
                     "node's inventory (stale ResourceSlice?)")
             kind, group_name, obj = entry
             if kind == "chip":
@@ -1172,20 +1470,46 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # mutation holds it; durability is the group-commit flush barrier.
         with self._lock:
             entry = self._checkpoint.get(claim.uid)
-        snapshot = self._inventory_snapshot()
         if entry is not None:
             # idempotent retry: re-materialize the CDI spec if the file
             # was lost (node reboot wipes /var/run) and echo the result.
             # The per-UID lock excludes a concurrent unprepare, so the
             # rewrite can never orphan a spec no checkpoint entry tracks.
             if not os.path.exists(entry["spec_path"]):
-                results = self._allocation_results(claim)
-                specs, envs = self._plan_devices(results, snapshot)
+                results, _ = self._allocation_results(claim)
+                # fresh snapshot after the fetch, same as the main path:
+                # a hot-unplug observed mid-fetch fails with the typed
+                # "departed" error instead of racing sysfs reads
+                specs, envs = self._plan_devices(
+                    results, self._inventory_snapshot())
                 self._write_claim_spec(claim.uid, specs, envs)
             return [drapb.Device(**d) for d in entry["devices"]]
-        results = self._allocation_results(claim)
+        results, generation = self._allocation_results(claim)
+        # re-snapshot AFTER the API round-trip: a hot-unplug that published
+        # a new epoch while the fetch was in flight is observed here, so
+        # the plan fails with the typed "departed" error instead of racing
+        # sysfs reads against the removal
+        snapshot = self._inventory_snapshot()
+        # migration handoff (import_handoff staged a record for this UID):
+        # validate BEFORE preparing — a stale record means the claim was
+        # re-allocated since the source released it, and preparing from
+        # it would attach the pod to the wrong devices
+        handoff = self._incoming_handoffs.get(claim.uid)
+        if handoff is not None:
+            try:
+                self._validate_handoff(handoff, claim, generation)
+            except HandoffValidationError:
+                # evict the stale record: generations are monotonic, so
+                # it can never validate again — keeping it would fail
+                # every kubelet retry forever. The retry prepares from
+                # the live allocation (no handoff), which is correct:
+                # the claim moved on since the source released it.
+                with self._lock:
+                    self._incoming_handoffs.pop(claim.uid, None)
+                raise
         specs, envs = self._plan_devices(results, snapshot)
         spec_path = self._write_claim_spec(claim.uid, specs, envs)
+        raws = self._claim_raw_ids(results, snapshot)
         devices = []
         for r in results:
             devices.append({
@@ -1208,7 +1532,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 "namespace": claim.namespace,
                 "spec_path": spec_path,
                 "devices": devices,
+                # lifecycle metadata: the devices' raw ids (orphan
+                # mapping on hot-unplug survives a restart) and the
+                # allocation generation (handoff validation input)
+                "device_raws": raws,
+                "generation": generation,
             }
+            # a claim prepared HERE retires any handoff record this node
+            # emitted for it (round-trip migration back to the source):
+            # both mutations ride the same group commit below
+            self._handoffs.pop(claim.uid, None)
         try:
             # ACK only after the entry is durable (group-commit barrier)
             self._checkpoint_flush(task)
@@ -1224,6 +1557,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 pass
             self._checkpoint_mark_dirty()   # converge disk to the rollback
             raise
+        if handoff is not None:
+            with self._lock:
+                if self._incoming_handoffs.pop(claim.uid, None) is not None:
+                    self.handoff_stats["handoffs_completed_total"] += 1
+            log.info("DRA: migration handoff for claim %s/%s completed "
+                     "(source %s)", claim.namespace, claim.name,
+                     handoff.get("source_node", "?"))
+        if self._lifecycle is not None:
+            for raw in raws:
+                self._lifecycle.note_allocated(raw, claim.uid)
         log.info("DRA: prepared claim %s/%s (%d devices)",
                  claim.namespace, claim.name, len(devices))
         return [drapb.Device(**d) for d in devices]
@@ -1236,6 +1579,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # and a slow filesystem never stalls other claims or slice builds.
         with self._lock:
             entry = self._checkpoint.get(claim.uid)
+        if entry is not None:
+            # fault point "migration.handoff" (raising): emitting the
+            # handoff record fails BEFORE any state mutates — the
+            # unprepare errors per-claim, the entry (and spec) survive,
+            # and the kubelet retry re-runs the sequence (exactly-once)
+            faults.fire("migration.handoff", claim=claim.uid)
+            self._note_detaching(entry, claim.uid)
         spec_path = (entry or {}).get(
             "spec_path", self._claim_spec_path(claim.uid))
         # unlink BEFORE dropping the checkpoint entry: a failed
@@ -1247,9 +1597,28 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         except FileNotFoundError:
             pass
         if entry is not None:
+            # Migration claim handoff: the release is recorded as a
+            # durable handoff record riding the SAME group commit as the
+            # checkpoint-entry deletion — a migration controller copies
+            # it to the destination (export_handoff → import_handoff),
+            # whose prepare validates claim UID + allocation generation
+            # before attaching. An orphaned claim (device surprise-
+            # removed) emits no handoff: there is nothing coherent for a
+            # destination to take over.
             with self._lock:
-                self._checkpoint.pop(claim.uid, None)
-        if entry is not None:
+                # re-read at the pop: a racing hot-unplug REPLACES the
+                # entry with an orphan-marked copy (on_device_gone swaps
+                # wholesale), so the no-handoff-for-orphans decision and
+                # the rollback value must use the LIVE entry, not the
+                # snapshot read before the spec unlink
+                live = self._checkpoint.pop(claim.uid, None)
+                if live is not None:
+                    entry = live
+                record = (None if "orphaned" in entry
+                          else self._handoff_record(claim, entry))
+                if record is not None:
+                    self._handoffs[claim.uid] = record
+                    self._prune_handoffs_locked()
             try:
                 # ACK only once the deletion is durable — otherwise a
                 # driver restart would resurrect the claim the kubelet
@@ -1258,14 +1627,107 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             except Exception:
                 # deletion never landed: restore the entry so the retry
                 # re-runs it (the spec file is already gone; the retry's
-                # unlink tolerates that)
+                # unlink tolerates that); the un-committed handoff record
+                # is withdrawn with it — the retry re-emits
                 with self._lock:
                     self._checkpoint.setdefault(claim.uid, entry)
+                    if record is not None:
+                        self._handoffs.pop(claim.uid, None)
                 self._checkpoint_mark_dirty()
                 raise
+            if record is not None:
+                with self._lock:
+                    self.handoff_stats["handoffs_emitted_total"] += 1
+            self._note_released(entry, claim.uid)
         log.info("DRA: unprepared claim %s/%s%s",
                  claim.namespace, claim.name,
                  "" if entry else " (not prepared; idempotent ok)")
+
+    # ------------------------------------------------- migration handoff
+
+    def _handoff_record(self, claim: drapb.Claim, entry: dict) -> dict:
+        return {
+            "uid": claim.uid,
+            "name": claim.name,
+            "namespace": claim.namespace,
+            # the allocation generation recorded at prepare time; the
+            # destination refuses the handoff if the live claim moved
+            "generation": entry.get("generation"),
+            "devices": [d.get("device_name", "")
+                        for d in entry.get("devices", ())],
+            "source_node": self.node_name,
+            "emitted_at": time.time(),
+        }
+
+    def _prune_handoffs_locked(self) -> None:
+        # bounded record set (caller holds _lock): drop oldest-emitted
+        # first — dict insertion order is emission order within one
+        # process, and loaded records predate all new ones
+        while len(self._handoffs) > HANDOFF_MAX_RECORDS:
+            oldest = min(self._handoffs,
+                         key=lambda u: self._handoffs[u].get("emitted_at", 0))
+            del self._handoffs[oldest]
+
+    @staticmethod
+    def _validate_handoff(record: dict, claim: drapb.Claim,
+                          generation: Optional[int]) -> None:
+        if record.get("uid") != claim.uid:
+            raise HandoffValidationError(
+                f"handoff record is for claim uid {record.get('uid')!r}, "
+                f"not {claim.uid!r}")
+        want = record.get("generation")
+        if want is not None and generation is not None and want != generation:
+            raise HandoffValidationError(
+                f"handoff generation {want!r} != live claim generation "
+                f"{generation!r} — the claim was re-allocated after the "
+                f"source released it; re-schedule instead of attaching "
+                f"stale devices")
+
+    def export_handoff(self, uid: str) -> Optional[dict]:
+        """The durable handoff record this node emitted for claim `uid`
+        (None when unknown). The migration controller copies it to the
+        destination driver's import_handoff; records survive daemon
+        restarts (checkpointed) until consumed, re-prepared, or aged out
+        of the bounded set."""
+        record = self._handoffs.get(uid)     # GIL-atomic read
+        return dict(record) if record is not None else None
+
+    def import_handoff(self, record: dict) -> None:
+        """Stage a handoff record delivered out-of-band for this node's
+        next NodePrepareResources of that claim UID, which validates it
+        (claim UID + allocation generation) before preparing."""
+        uid = record.get("uid")
+        if not isinstance(uid, str) or not uid:
+            raise ValueError("handoff record carries no claim uid")
+        with self._lock:
+            self._incoming_handoffs[uid] = dict(record)
+            # bounded like the outgoing set: a record is normally removed
+            # by the claim's prepare (consumed) or a failed validation
+            # (stale), but migrations retargeted elsewhere would
+            # otherwise accrete staged records forever — drop oldest-
+            # imported first (dict insertion order)
+            while len(self._incoming_handoffs) > HANDOFF_MAX_RECORDS:
+                self._incoming_handoffs.pop(
+                    next(iter(self._incoming_handoffs)))
+
+    def _claim_raw_ids(self, results: Sequence[dict],
+                       ep: epoch_mod.InventoryEpoch) -> List[str]:
+        raws = []
+        for r in results:
+            entry = ep.by_name.get(r.get("device", ""))
+            if entry is not None:
+                raws.append(self._raw_id(entry[0], entry[2]))
+        return raws
+
+    def _note_detaching(self, entry: dict, uid: str) -> None:
+        if self._lifecycle is not None:
+            for raw in entry.get("device_raws", ()):
+                self._lifecycle.note_detaching(raw, uid)
+
+    def _note_released(self, entry: dict, uid: str) -> None:
+        if self._lifecycle is not None:
+            for raw in entry.get("device_raws", ()):
+                self._lifecycle.note_released(raw, uid)
 
     # ------------------------------------------------------------- RPCs
 

@@ -120,6 +120,11 @@ class InventoryEpoch:
       planners       — generation name -> AllocationPlanner
       parent_planner — the vfio-backed-partition passthrough planner
       unhealthy      — raw ids pruned from the published ResourceSlice
+      departed       — raw ids REMOVED from by_name by hot-unplug
+                       (lifecycle GONE): distinct from unhealthy so a
+                       prepare against one can say "device departed"
+                       instead of "stale ResourceSlice", and /status can
+                       report the difference
     """
 
     epoch_id: int
@@ -127,6 +132,7 @@ class InventoryEpoch:
     planners: Mapping[str, Any] = _EMPTY_MAP
     parent_planner: Any = None
     unhealthy: frozenset = field(default_factory=frozenset)
+    departed: frozenset = field(default_factory=frozenset)
 
 
 def build_server_epoch(epoch_id: int,
@@ -161,7 +167,8 @@ def build_inventory_epoch(epoch_id: int,
                           by_name: Mapping[str, Tuple[str, str, Any]],
                           planners: Mapping[str, Any],
                           parent_planner: Any,
-                          unhealthy: frozenset) -> InventoryEpoch:
+                          unhealthy: frozenset,
+                          departed: frozenset = frozenset()) -> InventoryEpoch:
     """The DRA inventory-epoch builder. The mappings are snapshotted into
     read-only views here so a writer that keeps mutating its working dict
     after publish cannot reach readers."""
@@ -170,7 +177,8 @@ def build_inventory_epoch(epoch_id: int,
         by_name=MappingProxyType(dict(by_name)),
         planners=MappingProxyType(dict(planners)),
         parent_planner=parent_planner,
-        unhealthy=frozenset(unhealthy))
+        unhealthy=frozenset(unhealthy),
+        departed=frozenset(departed))
 
 
 class EpochStore:

@@ -30,6 +30,18 @@ Instrumented sites and their semantics:
                      before reaching disk (every claim waiting on that
                      commit window must error, roll back, and never be
                      silently ACKed)
+  pci.hotunplug      value   — presence evidence for a device is
+                     inverted: the lifecycle FSM reads the next
+                     observation as a PCIe surprise removal (allocated
+                     devices orphan their claims)
+  pci.replug         value   — the replug identity reconciliation reads
+                     as an identity swap (different silicon in the same
+                     slot); readmission happens under a NEW identity and
+                     prior claims stay orphaned
+  migration.handoff  raising — emitting the migration handoff record
+                     during NodeUnprepareResources fails before the
+                     checkpoint mutation: the unprepare errors per-claim
+                     and the kubelet retry re-runs it (exactly-once)
 
 Arming — programmatic:
 
@@ -95,6 +107,9 @@ _SITE_CATEGORY: Dict[str, str] = {
     "inotify.poll": "value",
     "dra.publish": "value",
     "checkpoint.write": "raising",
+    "pci.hotunplug": "value",
+    "pci.replug": "value",
+    "migration.handoff": "raising",
 }
 _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 

@@ -137,6 +137,12 @@ class HealthHub:
         self._watcher: Optional[InotifyWatcher] = None
         self._watcher_failed = False
         self._watched_dirs: set = set()
+        # dirs a subscription wants watched that did not exist (or failed
+        # to watch) at subscribe time — e.g. a hot-unplugged device's
+        # node dir. The periodic existence scan retries them, so a replug
+        # regains inotify latency instead of staying on scan cadence
+        # forever. Guarded by _lock.
+        self._pending_dirs: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._pool: Optional[futures.ThreadPoolExecutor] = None
@@ -229,6 +235,11 @@ class HealthHub:
             for d in dirs:
                 if os.path.isdir(d):
                     self._watch_dir(d)
+                else:
+                    # not there yet (udev still populating, or the device
+                    # is unplugged): the existence scan retries the watch
+                    # when the dir appears
+                    self._pending_dirs.add(d)
             self._rebuild_indexes_locked()
         # initial reconcile outside the lock (callbacks may take plugin
         # locks): inotify only reports *future* events, so a node already
@@ -383,6 +394,13 @@ class HealthHub:
         with self._lock:
             subs = list(self._subs)
             self._existence_scans += 1
+            # retry watches on dirs that were absent at subscribe time
+            # (hot-unplug/replug): once the dir is back, events flow at
+            # inotify latency again instead of scan cadence
+            pending = [d for d in self._pending_dirs if os.path.isdir(d)]
+            for d in pending:
+                self._pending_dirs.discard(d)
+                self._watch_dir(d)
         for sub in subs:
             self._scan_subscription(sub)
 
@@ -535,6 +553,9 @@ class HealthHub:
             "fallback_polling": self._watcher is None
                                 and self._watcher_failed,
             "watched_dirs": len(self._watched_dirs),
+            # dirs awaiting their first successful inotify watch (absent
+            # at subscribe time; retried by the existence scan)
+            "pending_watch_dirs": len(self._pending_dirs),
             "subscriptions": len(self._subs),
             "probe_workers": self.probe_workers,
             "probe_deadline_s": self.probe_deadline_s,

@@ -16,6 +16,7 @@ when the host inventory changes.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent import futures
@@ -23,8 +24,9 @@ from typing import List, Optional
 
 from . import lockdep
 from .config import Config
-from .discovery import HostSnapshot, discover
-from .healthhub import HealthHub
+from .discovery import HostSnapshot, discover, read_serial
+from .healthhub import HealthHub, HubSubscription
+from .lifecycle_fsm import DeviceLifecycle
 from .naming import resource_name_for
 from .native import TpuHealth
 from .registry import Registry
@@ -100,6 +102,26 @@ class PluginManager:
             poll_interval_s=cfg.health_poll_s,
             probe_workers=cfg.health_probe_workers,
             probe_deadline_s=cfg.health_probe_deadline_s)
+        # Per-device lifecycle FSM (lifecycle_fsm.py): present → bound →
+        # allocated → detaching → gone → replugged. Driven by the hub's
+        # fs events (the dedicated subscription below, fast path) and by
+        # rediscovery's sysfs ground truth (_sync_lifecycle); the DRA
+        # driver attaches its claim marks + orphan hook via
+        # DraDriver.attach_lifecycle (cli.py).
+        self.device_lifecycle = DeviceLifecycle(
+            serial_reader=lambda bdf: read_serial(cfg.pci_base_path, bdf),
+            # corroboration: a /dev/vfio node flap with the device still
+            # enumerated in sysfs is a recoverable health event, not a
+            # hot-unplug — only a missing sysfs dir declares `gone`.
+            # Partition raw ids (uuids) have no PCI dir of their own:
+            # their presence is their PARENT chip's (map maintained by
+            # _sync_lifecycle), so an orderly vTPU reconfiguration is
+            # never misreported as a surprise removal.
+            presence_reader=self._device_present)
+        # partition uuid -> parent BDF for the presence corroboration;
+        # swapped wholesale (atomic assignment) by _sync_lifecycle
+        self._lifecycle_parents: dict = {}
+        self._lifecycle_sub: Optional[HubSubscription] = None
         # Queried once at startup: whether the host can dlopen libtpu.so.
         # Purely informational on a passthrough host (chips are vfio-bound,
         # the guest owns libtpu), but a useful deployment sanity signal.
@@ -200,6 +222,7 @@ class PluginManager:
                 health_shim=self._shim, cdi_enabled=cdi_enabled,
                 health_listener=self.health_listener,
                 health_hub=self.health_hub,
+                lifecycle=self.device_lifecycle,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
@@ -237,7 +260,8 @@ class PluginManager:
                 self.cfg, type_name, registry, parts, health_shim=self._shim,
                 cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids,
                 health_listener=self.health_listener,
-                health_hub=self.health_hub))
+                health_hub=self.health_hub,
+                lifecycle=self.device_lifecycle))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi
@@ -288,12 +312,72 @@ class PluginManager:
                 group_members({g for _, g in parent_groups}))
         return sigs
 
+    def _sync_lifecycle(self, registry: Registry) -> None:
+        """Feed the lifecycle FSM the sysfs ground truth and re-point its
+        hub fast path at the current inventory.
+
+        The sync admits new devices, marks departures GONE (orphaning any
+        attached claims), and runs replug identity reconciliation for
+        returners; the dedicated hub subscription then delivers per-BDF
+        vfio-node events between rediscovery ticks so a surprise removal
+        is observed at inotify latency, not at the rediscovery interval.
+        """
+        fsm = self.device_lifecycle
+        present = {}
+        for devs in registry.devices_by_model.values():
+            for d in devs:
+                # LAZY identity read: only admission and replug
+                # reconciliation compare serials, so a warm rediscovery
+                # tick adds zero sysfs reads here (the incremental-
+                # discovery read-count guards pin per-tick cost)
+                present[d.bdf] = (
+                    read_serial(self.cfg.pci_base_path, d.bdf)
+                    if fsm.needs_identity(d.bdf) else None)
+        parents = {}
+        for parts in registry.partitions_by_type.values():
+            for p in parts:
+                present[p.uuid] = None   # partitions: uuid IS the identity
+                parents[p.uuid] = p.parent_bdf
+        self._lifecycle_parents = parents   # atomic swap; reader copies
+        self.device_lifecycle.sync_inventory(present)
+        paths = {d.bdf: self.cfg.dev_path("dev/vfio", d.iommu_group)
+                 for devs in registry.devices_by_model.values()
+                 for d in devs}
+        if self._lifecycle_sub is not None \
+                and self._lifecycle_sub.group_paths == paths:
+            return   # watch set unchanged: no subscription churn per tick
+        sub = HubSubscription(name="lifecycle", group_paths=paths,
+                              on_device_health=self._lifecycle_fs_event)
+        old, self._lifecycle_sub = self._lifecycle_sub, sub
+        if old is not None:
+            self.health_hub.unsubscribe(old)
+        self.health_hub.subscribe(sub)
+
+    def _device_present(self, raw: str) -> bool:
+        """Sysfs presence for the lifecycle corroboration: chips by their
+        own PCI dir; partitions by their parent chip's (a partition
+        'hot-unplugs' exactly when its parent silicon does)."""
+        target = self._lifecycle_parents.get(raw, raw)
+        return os.path.isdir(os.path.join(self.cfg.pci_base_path, target))
+
+    def _lifecycle_fs_event(self, key: str, healthy: bool,
+                            source: str) -> None:
+        # only the fs watcher's presence evidence drives the FSM here; a
+        # probe verdict is a health signal, not a removal
+        if source == "fs":
+            self.device_lifecycle.note_fs_event(key, healthy)
+
+    def lifecycle_stats(self) -> dict:
+        """FSM counters for /status + /metrics (lock-free read side)."""
+        return self.device_lifecycle.stats()
+
     def start(self, inventory=None) -> None:
         # first boot pays the one full walk; subsequent timer ticks go
         # through the snapshot's dirty-set path
         inventory = inventory if inventory else self._rediscover()
         self._sigs = self._signatures(*inventory)
         self._seed_health_baseline(inventory[0])
+        self._sync_lifecycle(inventory[0])
         self.plugins = self.build_plugins(inventory)
         self.pending = list(self.plugins)
         self._try_start_pending()
@@ -307,6 +391,10 @@ class PluginManager:
         registry, generations = inventory
         new_sigs = self._signatures(registry, generations)
         self._seed_health_baseline(registry)
+        # the FSM sees every rediscovery outcome, signature change or not:
+        # an unchanged inventory still drains classic-path allocation
+        # marks and reconciles GONE records whose device returned
+        self._sync_lifecycle(registry)
         if new_sigs == self._sigs:
             return
         # only a RUNNING plugin may survive on an unchanged signature; a
@@ -430,6 +518,9 @@ class PluginManager:
                           plugin.resource_name, exc)
         self.plugins = []
         self.pending = []
+        if self._lifecycle_sub is not None:
+            self.health_hub.unsubscribe(self._lifecycle_sub)
+            self._lifecycle_sub = None
         self.health_hub.stop()
 
     def run(self, stop_event: threading.Event) -> None:

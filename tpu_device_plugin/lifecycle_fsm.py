@@ -1,0 +1,564 @@
+"""Per-device lifecycle FSM — the survivability contract for passthrough.
+
+The chaos suite (PR 1) proved the daemon survives restarts and flaps, but
+the hard production transitions — PCIe surprise removal of an in-use
+chip, the same slot coming back with different silicon, a VMI live
+migration moving a claim between nodes — were implicit in whatever
+health/rediscovery happened to do. Virtio-FPGA and the SystemC-TLM
+PCI-passthrough model (PAPERS.md) both make passthrough devices
+survivable by giving every device an explicit attach/detach state
+machine; this module is that contract for the daemon:
+
+    (admitted) → present → bound → allocated → detaching → bound
+                     │        │        │            │
+                     └────────┴────────┴────────────┴──→ gone → replugged
+                                                                   │
+                                   identity reconciled (BDF+serial)┴→ present
+
+- **present**: enumerated in sysfs; **bound**: vfio-bound (discovery only
+  admits bound chips, so inventory devices land here);
+- **allocated**: a DRA claim is prepared against it (claim UIDs tracked),
+  or the classic device-plugin path granted it (anonymous — the Device
+  Plugin API cannot revoke, so these marks ride a lock-free queue and
+  demote back to bound on the next inventory sync with no claims);
+- **detaching**: an orderly unprepare/migration handoff is in flight;
+- **gone**: the sysfs/devfs evidence of the device vanished while the
+  daemon was watching — hot-unplug. If claims were attached they are
+  ORPHANED: counted, recorded as a guest-visible surprise removal, and
+  reported to the DRA driver (which marks the checkpoint entries and
+  drops the device from the published ResourceSlice). Orphaned claims
+  never silently reattach;
+- **replugged**: the device reappeared. Readmission requires identity
+  reconciliation — same BDF *and* same serial (sysfs `serial_number`,
+  falling back to the PCI device id). A mismatch is an identity swap:
+  different silicon in the same slot readmits as a NEW device while the
+  old identity's claims stay orphaned.
+
+Fault sites (docs/fault-injection.md): `pci.hotunplug` (value) fires at
+the presence-evidence seam — an armed fault makes the next presence
+observation read as a surprise removal; `pci.replug` (value) fires in
+the identity reconciliation — an armed fault makes the replug read as an
+identity swap. Both let chaos schedules inject the transition without a
+real fs mutation.
+
+Concurrency: one writer-side lock serializes transitions (hub events,
+inventory syncs, DRA claim marks). The READ side — `stats()`, feeding
+/status and /metrics — is lock-free by the same contract as
+healthhub.stats(): GIL-atomic attribute/int reads and C-atomic dict/
+deque copies, so a slow scrape never queues behind a transition (the
+/status lockdep gate in tests/test_epoch.py pins zero acquisitions).
+The classic Allocate hot path records its marks with one C-atomic deque
+append (`note_allocation_event`) — zero locks inside the
+`server.Allocate` read-path bracket — and the queue drains under the
+lock on the next writer-side call.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import faults
+from . import lockdep
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ABSENT", "PRESENT", "BOUND", "ALLOCATED", "DETACHING", "GONE",
+           "REPLUGGED", "DeviceLifecycle"]
+
+# lifecycle states (the ISSUE's contract; ABSENT is the pseudo-state a
+# device is admitted from, so first admission is a counted transition too)
+ABSENT = "absent"
+PRESENT = "present"
+BOUND = "bound"
+ALLOCATED = "allocated"
+DETACHING = "detaching"
+GONE = "gone"
+REPLUGGED = "replugged"
+
+# The allowed-transition table. Anything else is an invalid transition:
+# counted + logged, never raised — lifecycle events arrive from daemon
+# threads (health hub, rediscovery tick) that must not die on a
+# surprising interleaving.
+_ALLOWED = frozenset({
+    (ABSENT, PRESENT),
+    (PRESENT, BOUND),
+    (BOUND, ALLOCATED),
+    (ALLOCATED, DETACHING),
+    (DETACHING, BOUND),
+    # anonymous classic-path allocation marks demote on an inventory sync
+    # that finds no tracked claims (the Device Plugin API never tells us
+    # the grant ended)
+    (ALLOCATED, BOUND),
+    # administrative vfio unbind: the device left the inventory but is
+    # still enumerated in sysfs — present, not gone (rebind promotes it
+    # back on the next sync)
+    (BOUND, PRESENT),
+    # a NEW claim prepared while another claim's detach is in flight on
+    # the same device re-enters allocated; the last release still
+    # returns it to bound
+    (DETACHING, ALLOCATED),
+    # hot-unplug can strike in any live state
+    (PRESENT, GONE),
+    (BOUND, GONE),
+    (ALLOCATED, GONE),
+    (DETACHING, GONE),
+    (GONE, REPLUGGED),
+    # readmission after identity reconciliation (or as the swap's new
+    # identity); a device that vanishes again before reconciling goes
+    # straight back
+    (REPLUGGED, PRESENT),
+    (REPLUGGED, GONE),
+})
+
+# how many recent guest-visible surprise removals /status retains
+_SURPRISE_RING = 16
+
+
+class _DeviceRecord:
+    __slots__ = ("raw", "serial", "state", "claims", "since")
+
+    def __init__(self, raw: str, serial: Optional[str]) -> None:
+        self.raw = raw
+        self.serial = serial
+        self.state = ABSENT
+        self.claims: set = set()
+        self.since = time.time()
+
+
+class DeviceLifecycle:
+    """Host-level per-device lifecycle tracker (module docstring).
+
+    `serial_reader(raw) -> Optional[str]` supplies the identity attribute
+    for replug reconciliation (discovery.read_serial over sysfs in
+    production; tests inject). `on_devices_gone(events)` is the DRA
+    driver's hook, fired with a BATCH of `(raw, orphaned_claim_uids)`
+    pairs covering every gone transition of one observation — a
+    multi-device removal (a PCIe switch dropping) costs one epoch
+    publish and one slice republish downstream, not one per device. The
+    claim list is empty for an unallocated device; the driver still
+    drops it from the published ResourceSlice. Called OUTSIDE the FSM
+    lock, after the transitions are recorded, so the driver's own
+    locking never nests inside ours.
+    """
+
+    def __init__(
+        self,
+        serial_reader: Optional[Callable[[str], Optional[str]]] = None,
+        on_devices_gone: Optional[Callable[[List], None]] = None,
+        presence_reader: Optional[Callable[[str], bool]] = None,
+        on_device_readmitted: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.serial_reader = serial_reader
+        self.on_devices_gone = on_devices_gone
+        # fired (outside the lock) when a GONE device passes replug
+        # reconciliation — with or without an identity swap. The DRA
+        # driver needs this because an unplug+replug that both land
+        # within ONE rediscovery tick leaves the registry signature
+        # unchanged: no inventory event would ever readmit the device
+        # into the published slice without it.
+        self.on_device_readmitted = on_device_readmitted
+        # CORROBORATION before declaring hot-unplug: a /dev/vfio node
+        # flap (udev churn) is a recoverable HEALTH event the health
+        # plane already owns — only when the device's sysfs presence is
+        # also gone is it a PCIe surprise removal. None trusts the event
+        # (tests drive the seam directly); production passes a sysfs
+        # isdir probe. An armed `pci.hotunplug` fault bypasses the check
+        # so chaos can inject removals without fs mutations.
+        self.presence_reader = presence_reader
+        self._lock = lockdep.instrument(
+            "lifecycle_fsm.DeviceLifecycle._lock", threading.Lock())
+        self._records: Dict[str, _DeviceRecord] = {}
+        # counters — written ONLY under _lock (tsalint counter ownership);
+        # read lock-free by stats() via GIL-atomic reads / C-atomic copies
+        self.transition_counts: Dict[str, int] = {}   # "from->to" -> n
+        self.claims_orphaned_total = 0
+        self.identity_swaps_total = 0
+        self.invalid_transitions_total = 0
+        self._surprise_removals: deque = deque(maxlen=_SURPRISE_RING)
+        # classic-path allocation marks: producers (the Allocate read
+        # path, pinned lock-free) append C-atomically; drained under
+        # _lock by the next writer-side call
+        self._alloc_events: deque = deque()
+        # claim marks restored from the DRA checkpoint (restore_claims)
+        # for devices not admitted yet: applied at admission, or orphaned
+        # by the first sync if the device never returns (it was
+        # hot-unplugged while the daemon was down)
+        self._pending_claims: Dict[str, set] = {}
+
+    # ------------------------------------------------------------ writers
+
+    def _transition_locked(self, rec: _DeviceRecord, to: str) -> bool:
+        """Move `rec` to `to` if the table allows it; count either way."""
+        frm = rec.state
+        if frm == to:
+            return True
+        if (frm, to) not in _ALLOWED:
+            self.invalid_transitions_total += 1
+            log.warning("lifecycle: invalid transition %s: %s -> %s "
+                        "(ignored)", rec.raw, frm, to)
+            return False
+        key = f"{frm}->{to}"
+        self.transition_counts[key] = self.transition_counts.get(key, 0) + 1
+        rec.state = to
+        rec.since = time.time()
+        log.info("lifecycle: %s %s -> %s", rec.raw, frm, to)
+        return True
+
+    def _drain_alloc_events_locked(self) -> None:
+        while True:
+            try:
+                ids = self._alloc_events.popleft()
+            except IndexError:
+                return
+            for raw in ids:
+                rec = self._records.get(raw)
+                if rec is not None and rec.state == BOUND:
+                    self._transition_locked(rec, ALLOCATED)
+
+    def _admit_locked(self, raw: str, serial: Optional[str],
+                      bound: bool) -> _DeviceRecord:
+        rec = self._records[raw] = _DeviceRecord(raw, serial)
+        self._transition_locked(rec, PRESENT)
+        if bound:
+            self._transition_locked(rec, BOUND)
+        pending = self._pending_claims.pop(raw, None)
+        if pending:
+            # restart-restored claim marks (restore_claims): the device
+            # came back with its prepared claims still tracked
+            self._transition_locked(rec, ALLOCATED)
+            rec.claims.update(pending)
+        return rec
+
+    def _mark_gone_locked(self, rec: _DeviceRecord) -> Optional[List[str]]:
+        """→ GONE; returns the orphaned claim UIDs — empty when nothing
+        was attached (the caller still delivers the gone hook outside the
+        lock so the DRA slice drops the device) — or None when the
+        transition was refused."""
+        if not self._transition_locked(rec, GONE):
+            return None
+        if not rec.claims:
+            return []
+        orphans = sorted(rec.claims)
+        rec.claims.clear()          # orphaned claims never reattach
+        self.claims_orphaned_total += len(orphans)
+        self._surprise_removals.append({
+            "device": rec.raw,
+            "claims": orphans,
+            "at": time.time(),
+        })
+        log.error("lifecycle: surprise removal of ALLOCATED device %s — "
+                  "orphaning claim(s) %s (guest saw the device vanish)",
+                  rec.raw, ", ".join(orphans))
+        return orphans
+
+    def _replug_locked(self, rec: _DeviceRecord,
+                       serial: Optional[str]) -> bool:
+        """GONE → REPLUGGED → identity reconciliation → PRESENT.
+
+        Returns True when the device readmitted with its identity intact;
+        False on an identity swap (new silicon in the slot — readmitted
+        as a fresh identity, counted, old claims stay orphaned).
+        """
+        self._transition_locked(rec, REPLUGGED)
+        # fault point "pci.replug" (value kind): an armed fault makes the
+        # reconciliation read as an identity swap without a real serial
+        # change
+        swapped = faults.fire("pci.replug", device=rec.raw)
+        if not swapped and rec.serial is not None and serial is not None \
+                and serial != rec.serial:
+            swapped = True
+        if swapped:
+            self.identity_swaps_total += 1
+            log.warning(
+                "lifecycle: %s replugged with DIFFERENT identity "
+                "(serial %r -> %r); readmitting as new silicon — prior "
+                "claims stay orphaned", rec.raw, rec.serial, serial)
+            rec.serial = serial
+            rec.claims.clear()
+        elif serial is not None:
+            rec.serial = serial
+        self._transition_locked(rec, PRESENT)
+        return not swapped
+
+    def _read_serial(self, raw: str) -> Optional[str]:
+        if self.serial_reader is None:
+            return None
+        try:
+            return self.serial_reader(raw)
+        except Exception as exc:
+            log.debug("lifecycle: serial read for %s failed: %s", raw, exc)
+            return None
+
+    # ------------------------------------------------------- event intake
+
+    def note_fs_event(self, raw: str, exists: bool) -> None:
+        """Fast-path presence evidence from the HealthHub fs watcher.
+
+        Unknown devices are ignored (the inventory sync admits); a
+        disappearance orphans attached claims; a reappearance runs the
+        replug reconciliation.
+        """
+        # fault point "pci.hotunplug" (value kind): presence evidence is
+        # inverted — the chaos suite injects a surprise removal without
+        # touching the fake host's filesystem (corroboration is bypassed:
+        # the injected removal must win)
+        forced = False
+        if exists and faults.fire("pci.hotunplug", device=raw):
+            exists = False
+            forced = True
+        if not exists and not forced and self.presence_reader is not None:
+            try:
+                still_present = self.presence_reader(raw)
+            except Exception:
+                still_present = False
+            if still_present:
+                # device node lost but the device is still enumerated:
+                # a health event (the health plane flips it Unhealthy),
+                # NOT a hot-unplug — no gone transition, no orphaning
+                return
+        # lazy identity read: only a reappearance of a GONE record pays a
+        # sysfs read (the peek is lock-free; a racing transition at worst
+        # costs one redundant read)
+        peek = self._records.get(raw)
+        serial = self._read_serial(raw) \
+            if exists and peek is not None and peek.state == GONE else None
+        orphans = None
+        readmitted = False
+        with self._lock:
+            rec = self._records.get(raw)
+            if rec is None:
+                return
+            self._drain_alloc_events_locked()
+            if not exists and rec.state != GONE:
+                orphans = self._mark_gone_locked(rec)
+            elif exists and rec.state == GONE:
+                if serial is None:
+                    # the lock-free peek saw a pre-GONE state (a racing
+                    # sync marked it GONE since): the reconciliation
+                    # still needs the identity — read it here, under the
+                    # lock (rare path; the FSM lock is not hot)
+                    serial = self._read_serial(raw)
+                self._replug_locked(rec, serial)
+                if rec.state == PRESENT:
+                    # fs evidence back implies the node is usable again;
+                    # the next inventory sync confirms the vfio binding
+                    self._transition_locked(rec, BOUND)
+                    readmitted = True
+        if orphans is not None:
+            self._deliver_gone([(raw, orphans)])
+        if readmitted:
+            self._deliver_readmitted(raw)
+
+    def sync_inventory(self, present: Dict[str, Optional[str]]) -> None:
+        """Authoritative sysfs truth from (re)discovery: `present` maps
+        every vfio-bound raw id to its serial (None when unreadable).
+
+        New ids are admitted (present→bound); ids that left sysfs go
+        GONE (orphaning claims); GONE ids that returned reconcile
+        identity and readmit. ALLOCATED records with no tracked claims
+        demote to BOUND (anonymous classic-path grants the API never
+        tells us ended).
+        """
+        filtered: Dict[str, Optional[str]] = {}
+        forced: set = set()
+        for raw, serial in present.items():
+            # same seam as note_fs_event: an armed pci.hotunplug makes
+            # this sync read the device as missing (corroboration below
+            # is bypassed for it — the injected removal must win)
+            if faults.fire("pci.hotunplug", device=raw):
+                forced.add(raw)
+                continue
+            filtered[raw] = serial
+        # corroborate disappearances OUTSIDE the lock (sysfs probes are
+        # file I/O): an id missing from the inventory but still
+        # enumerated is an administrative unbind, not a hot-unplug
+        absent: Dict[str, bool] = {}
+        if self.presence_reader is not None:
+            for raw, rec in list(self._records.items()):
+                if raw in filtered or raw in forced or rec.state == GONE:
+                    continue
+                try:
+                    absent[raw] = not self.presence_reader(raw)
+                except Exception:
+                    absent[raw] = True
+        orphan_batches: List = []
+        readmitted: List[str] = []
+        with self._lock:
+            self._drain_alloc_events_locked()
+            for raw, serial in filtered.items():
+                rec = self._records.get(raw)
+                if rec is None:
+                    self._admit_locked(raw, serial, bound=True)
+                elif rec.state == GONE:
+                    self._replug_locked(rec, serial)
+                    self._transition_locked(rec, BOUND)
+                    readmitted.append(raw)
+                elif rec.state == PRESENT:
+                    # rebound after an administrative unbind: back in the
+                    # inventory means vfio-bound again
+                    self._transition_locked(rec, BOUND)
+                elif rec.state == ALLOCATED and not rec.claims:
+                    self._transition_locked(rec, BOUND)
+            # restart-restored claim marks whose device is NOT in this
+            # sync's ground truth: the hot-unplug happened while the
+            # daemon was down — discovered now, orphaned now
+            for raw in list(self._pending_claims):
+                if raw in filtered:
+                    continue
+                uids = sorted(self._pending_claims.pop(raw))
+                self.claims_orphaned_total += len(uids)
+                self._surprise_removals.append(
+                    {"device": raw, "claims": uids, "at": time.time()})
+                log.error("lifecycle: device %s (with restored claim(s) "
+                          "%s) absent at startup sync — hot-unplugged "
+                          "while the daemon was down; orphaning",
+                          raw, ", ".join(uids))
+                orphan_batches.append((raw, uids))
+            for raw, rec in self._records.items():
+                if raw in filtered or rec.state == GONE:
+                    continue
+                if not absent.get(raw, True):
+                    # left the inventory but still enumerated in sysfs:
+                    # an administrative unbind, not a hot-unplug
+                    if rec.state == BOUND:
+                        self._transition_locked(rec, PRESENT)
+                    continue
+                orphans = self._mark_gone_locked(rec)
+                if orphans is not None:
+                    orphan_batches.append((raw, orphans))
+        self._deliver_gone(orphan_batches)
+        for raw in readmitted:
+            self._deliver_readmitted(raw)
+
+    def _deliver_readmitted(self, raw: str) -> None:
+        if self.on_device_readmitted is None:
+            return
+        try:
+            self.on_device_readmitted(raw)
+        except Exception as exc:
+            log.error("lifecycle: device-readmitted callback for %s "
+                      "failed: %s", raw, exc)
+
+    def _deliver_gone(self, events: List) -> None:
+        """`events` is [(raw, orphaned_claim_uids), ...] — one batched
+        delivery per observation so a multi-device removal costs one
+        downstream publish."""
+        if self.on_devices_gone is None or not events:
+            return
+        try:
+            self.on_devices_gone(events)
+        except Exception as exc:
+            log.error("lifecycle: devices-gone callback for %s failed: %s",
+                      [raw for raw, _ in events], exc)
+
+    # ------------------------------------------------------- claim marks
+
+    def restore_claims(self, claims_by_raw: Dict[str, List[str]]) -> None:
+        """Replay persisted claim marks after a daemon restart (the DRA
+        driver calls this from attach_lifecycle with every
+        non-orphaned checkpoint entry's device raw ids).
+
+        A fresh FSM knows nothing of claims prepared by the previous
+        incarnation; without this replay, a post-restart hot-unplug of
+        an allocated device would orphan nothing. Devices not admitted
+        yet keep their marks pending: applied at admission, or orphaned
+        by the first inventory sync if the device never returns (it was
+        hot-unplugged while the daemon was down)."""
+        with self._lock:
+            for raw, uids in claims_by_raw.items():
+                if not uids:
+                    continue
+                rec = self._records.get(raw)
+                if rec is None:
+                    self._pending_claims.setdefault(raw, set()).update(uids)
+                elif rec.state in (BOUND, ALLOCATED, DETACHING):
+                    if rec.state == BOUND:
+                        self._transition_locked(rec, ALLOCATED)
+                    rec.claims.update(uids)
+
+    def note_allocated(self, raw: str, claim_uid: Optional[str]) -> None:
+        """A DRA claim was prepared against `raw` (claim_uid tracked) or
+        the device was granted anonymously (claim_uid None)."""
+        with self._lock:
+            rec = self._records.get(raw)
+            if rec is None:
+                return
+            self._drain_alloc_events_locked()
+            # DETACHING included: a new claim may prepare while another
+            # claim's detach is in flight — its UID must be tracked or a
+            # later hot-unplug would fail to orphan it
+            if rec.state in (BOUND, ALLOCATED, DETACHING):
+                self._transition_locked(rec, ALLOCATED)
+                if claim_uid is not None:
+                    rec.claims.add(claim_uid)
+
+    def note_detaching(self, raw: str, claim_uid: Optional[str]) -> None:
+        """An orderly unprepare/migration handoff of `raw` began."""
+        with self._lock:
+            rec = self._records.get(raw)
+            if rec is None:
+                return
+            if rec.state == ALLOCATED:
+                self._transition_locked(rec, DETACHING)
+
+    def note_released(self, raw: str, claim_uid: Optional[str]) -> None:
+        """The unprepare completed (durably): the claim no longer holds
+        the device; the last claim out returns it to BOUND."""
+        with self._lock:
+            rec = self._records.get(raw)
+            if rec is None:
+                return
+            if claim_uid is not None:
+                rec.claims.discard(claim_uid)
+            if not rec.claims and rec.state in (DETACHING, ALLOCATED):
+                self._transition_locked(rec, BOUND)
+
+    def note_allocation_event(self, device_ids: Sequence[str]) -> None:
+        """LOCK-FREE producer for the classic Allocate hot path: one
+        C-atomic deque append, zero registered locks (the server.Allocate
+        read-path gate pins this). Drained under the lock by the next
+        writer-side call."""
+        self._alloc_events.append(tuple(device_ids))
+
+    # ---------------------------------------------------------- read side
+
+    def state_of(self, raw: str) -> str:
+        rec = self._records.get(raw)        # GIL-atomic dict.get
+        return rec.state if rec is not None else ABSENT
+
+    def needs_identity(self, raw: str) -> bool:
+        """Whether the next sync_inventory needs `raw`'s serial: only
+        admission (untracked) and replug reconciliation (GONE) compare
+        identity, so a warm rediscovery tick reads NO serial files
+        (lock-free peek; discovery's read-count guards pin this)."""
+        rec = self._records.get(raw)
+        return rec is None or rec.state == GONE
+
+    def stats(self) -> dict:
+        """Counters + per-state gauges for /status and /metrics.
+
+        LOCK-FREE read side (the /status lockdep gate): attribute/int
+        reads are GIL-atomic, `dict(d)`/`list(d)` are C-atomic copies —
+        a racing transition costs at most a one-step-stale value, and a
+        scrape never queues behind the writer lock. The classic-path
+        allocation queue is NOT drained here (that needs the lock); its
+        marks land on the next writer-side event.
+        """
+        states: Dict[str, int] = {}
+        for rec in list(self._records.values()):
+            st = rec.state
+            states[st] = states.get(st, 0) + 1
+        return {
+            "devices": len(self._records),
+            "states": states,
+            "transitions": dict(self.transition_counts),
+            "claims_orphaned_total": self.claims_orphaned_total,
+            "identity_swaps_total": self.identity_swaps_total,
+            "invalid_transitions_total": self.invalid_transitions_total,
+            "surprise_removals": [dict(e) for e in
+                                  list(self._surprise_removals)],
+        }

@@ -90,6 +90,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         cdi_enabled: bool = False,
         health_listener=None,
         health_hub: Optional[HealthHub] = None,
+        lifecycle=None,
     ) -> None:
         # arm-time validation, matching faults.py's fail-loud convention: a
         # NaN window makes every condvar timeout comparison silently false
@@ -109,6 +110,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # that marks it Unhealthy on the ListAndWatch stream — without a
         # second, driftable health watcher.
         self._health_listener = health_listener
+        # Optional lifecycle_fsm.DeviceLifecycle: successful Allocates
+        # mark their devices allocated. The mark is a single C-atomic
+        # deque append (note_allocation_event) — the Allocate read-path
+        # gate stays at zero registered-lock acquisitions.
+        self._lifecycle = lifecycle
         # serializes listener deliveries; see set_devices_health
         self._listener_lock = lockdep.instrument(
             "server.TpuDevicePlugin._listener_lock", threading.Lock())
@@ -543,6 +549,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             "ts": time.time(),
             "devices": per_container_ids,
         })
+        if self._lifecycle is not None:
+            # lock-free producer: the FSM drains this queue under its own
+            # lock on the next writer-side event (lifecycle_fsm)
+            self._lifecycle.note_allocation_event(
+                [d for ids in per_container_ids for d in ids])
 
     @property
     def serving(self) -> bool:

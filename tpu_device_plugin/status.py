@@ -124,6 +124,12 @@ class StatusServer:
         health_stats = getattr(self.manager, "health_stats", None)
         if health_stats is not None:
             out["health"] = health_stats()
+        # device lifecycle FSM (lifecycle_fsm.DeviceLifecycle): per-state
+        # gauges, transition counters, orphaned-claim / identity-swap
+        # totals and the recent surprise-removal ring
+        lifecycle_stats = getattr(self.manager, "lifecycle_stats", None)
+        if lifecycle_stats is not None:
+            out["lifecycle"] = lifecycle_stats()
         fault_stats = faults.stats()
         armed = faults.armed_sites()
         if fault_stats or armed:
@@ -145,6 +151,11 @@ class StatusServer:
                 "registration_error": d.registration_error,
                 "prepared_claims": d.prepared_claim_count(),
                 "unhealthy_devices": d.unhealthy_devices(),
+                # lifecycle survivability: claims whose device was
+                # surprise-removed, and devices gone from the inventory
+                # (hot-unplug) awaiting replug readmission
+                "orphaned_claims": d.orphaned_claims(),
+                "departed_devices": d.departed_devices(),
                 "republish_backoff": d.republish_backoff.snapshot(),
                 # delta (generation-keyed guarded PUT) vs full
                 # (read-modify-write) slice publishes
@@ -292,6 +303,38 @@ class StatusServer:
                 "# TYPE tdp_probe_errors_total counter",
                 f"tdp_probe_errors_total {health['probe_errors_total']}",
             ]
+        lifecycle = s.get("lifecycle")
+        if lifecycle:
+            lines += [
+                "# HELP lifecycle_transitions_total Device lifecycle FSM "
+                "transitions (present/bound/allocated/detaching/gone/"
+                "replugged; lifecycle_fsm.py).",
+                "# TYPE lifecycle_transitions_total counter",
+            ]
+            for key, n in sorted(lifecycle.get("transitions", {}).items()):
+                frm, _, to = key.partition("->")
+                lines.append(
+                    f'lifecycle_transitions_total{{from="{frm}",'
+                    f'to="{to}"}} {n}')
+            lines += [
+                "# HELP claims_orphaned_total Prepared claims orphaned by "
+                "PCIe surprise removal of their device.",
+                "# TYPE claims_orphaned_total counter",
+                f"claims_orphaned_total "
+                f"{lifecycle.get('claims_orphaned_total', 0)}",
+                "# HELP tpu_plugin_lifecycle_identity_swaps_total Replugs "
+                "whose BDF+serial reconciliation found different silicon "
+                "in the slot.",
+                "# TYPE tpu_plugin_lifecycle_identity_swaps_total counter",
+                f"tpu_plugin_lifecycle_identity_swaps_total "
+                f"{lifecycle.get('identity_swaps_total', 0)}",
+                "# HELP tpu_plugin_lifecycle_devices Devices by lifecycle "
+                "state.",
+                "# TYPE tpu_plugin_lifecycle_devices gauge",
+            ]
+            for state, n in sorted(lifecycle.get("states", {}).items()):
+                lines.append(
+                    f'tpu_plugin_lifecycle_devices{{state="{state}"}} {n}')
         read_paths = s.get("read_paths")
         if read_paths:
             lines += [
@@ -375,6 +418,27 @@ class StatusServer:
                 "counter",
                 f"tpu_plugin_dra_checkpoint_claims_coalesced_total "
                 f"{s['dra']['checkpoint_claims_coalesced_total']}",
+                "# HELP handoffs_completed_total Migration claim handoffs "
+                "validated and completed by this node's prepare.",
+                "# TYPE handoffs_completed_total counter",
+                f"handoffs_completed_total "
+                f"{s['dra']['handoffs_completed_total']}",
+                "# HELP tpu_plugin_dra_handoffs_emitted_total Migration "
+                "handoff records durably emitted by unprepare.",
+                "# TYPE tpu_plugin_dra_handoffs_emitted_total counter",
+                f"tpu_plugin_dra_handoffs_emitted_total "
+                f"{s['dra']['handoffs_emitted_total']}",
+                "# HELP tpu_plugin_dra_orphan_specs_removed Stale claim-"
+                "spec files swept at startup (spec written, checkpoint "
+                "commit never landed).",
+                "# TYPE tpu_plugin_dra_orphan_specs_removed gauge",
+                f"tpu_plugin_dra_orphan_specs_removed "
+                f"{s['dra']['orphan_specs_removed']}",
+                "# HELP tpu_plugin_dra_orphaned_claims Prepared claims "
+                "currently marked orphaned (device surprise-removed).",
+                "# TYPE tpu_plugin_dra_orphaned_claims gauge",
+                f"tpu_plugin_dra_orphaned_claims "
+                f"{len(s['dra']['orphaned_claims'])}",
             ]
             breaker = s["dra"].get("api_breaker")
             if breaker is not None:

@@ -49,6 +49,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         cdi_uuids: frozenset = frozenset(),
         health_listener=None,
         health_hub=None,
+        lifecycle=None,
     ) -> None:
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
@@ -56,7 +57,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         super().__init__(cfg, type_name, registry, devices=[],
                          health_shim=health_shim, cdi_enabled=cdi_enabled,
                          health_listener=health_listener,
-                         health_hub=health_hub)
+                         health_hub=health_hub, lifecycle=lifecycle)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
