@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -130,10 +130,18 @@ bench-attach:
 # daemon-side attach wall broken into sysfs-I/O floor (counted syscalls x
 # in-run calibration), daemon overhead, 4-way-contended queue/sync, gRPC
 # transport — plus COUNTED registered-lock acquisitions per attach (0; the
-# pre-epoch tree measured 11). Writes docs/bench_attach_r09.json. The CI
-# bench-smoke job runs this with --quick and the counted honesty guards.
+# pre-epoch tree measured 11). Writes docs/bench_attach_r09.json, then the
+# flight-recorder overhead bench (r10, below). The CI bench-smoke job runs
+# this with --quick and the counted honesty guards.
 bench-attach-path:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --attach
+
+# Flight-recorder overhead bench (docs/observability.md): per-attach wall
+# with tracing enabled vs disabled (interleaved A/B) + COUNTED trace
+# records per attach (2 spans, 0 events). Writes docs/bench_attach_r10.json;
+# the honesty guard pins the recorded overhead within the documented bound.
+bench-trace:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trace-overhead
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

@@ -1133,6 +1133,123 @@ def run_attach(quick=False):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_trace_overhead(quick=False):
+    """`bench.py --trace-overhead` (r10): the flight recorder's cost on
+    the attach path — the honesty guard for "always-on, low-overhead"
+    (docs/observability.md).
+
+    Two kinds of numbers, matching the r09 discipline of pinning what is
+    COUNTED and recording what is timed:
+
+      - COUNTED (load-insensitive): trace records produced by one
+        steady-state attach — exactly 2 spans (GetPreferredAllocation +
+        Allocate), 0 events (fragment rebuilds are cold-path only).
+        tests/test_perf_honesty.py re-counts this live.
+      - TIMED (recorded in the artifact, pinned against the committed
+        file): per-attach wall with tracing ENABLED vs DISABLED,
+        interleaved A/B per iteration so co-tenant load drift hits both
+        arms equally. overhead = traced_p50 - untraced_p50.
+
+    Writes docs/bench_attach_r10.json ($BENCH_TRACE_OUT overrides).
+    """
+    from tpu_device_plugin import trace
+
+    iters = 400 if quick else 2000
+    warm = 40 if quick else 100
+    root = tempfile.mkdtemp(prefix="tdptrace-")
+    try:
+        _build_host(root, 8)
+        cfg = Config().with_root(root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        registry, generations = discover_passthrough(cfg)
+        devices = registry.devices_by_model["0063"]
+        plugin = TpuDevicePlugin(cfg, "v5e", registry, devices,
+                                 torus_dims=generations["0063"].host_topology)
+        all_ids = [d.bdf for d in devices]
+        pref_req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=all_ids, allocation_size=4)])
+
+        def attach_once():
+            # same composition as run_attach's estimator: cold pref memo
+            # + Allocate, direct servicer calls
+            plugin._pref_cache.clear()
+            t0 = time.perf_counter()
+            pref = plugin.GetPreferredAllocation(pref_req, None)
+            alloc_req = pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(
+                    devices_ids=list(pref.container_responses[0].deviceIDs))])
+            plugin.Allocate(alloc_req, None)
+            return time.perf_counter() - t0
+
+        # counted: records per steady-state attach
+        for _ in range(3):
+            attach_once()                     # warm slow paths (fragments)
+        trace.reset()
+        before = trace.stats()
+        attach_once()
+        after = trace.stats()
+        spans_per_attach = (after["spans_recorded_total"]
+                            - before["spans_recorded_total"])
+        events_per_attach = (after["events_recorded_total"]
+                             - before["events_recorded_total"])
+
+        # timed: interleaved A/B
+        traced_us, untraced_us = [], []
+        for i in range(iters + warm):
+            trace.configure(enabled=True)
+            t_on = attach_once() * 1e6
+            trace.configure(enabled=False)
+            t_off = attach_once() * 1e6
+            if i >= warm:
+                traced_us.append(t_on)
+                untraced_us.append(t_off)
+        trace.configure(enabled=True)
+
+        traced_p50 = statistics.median(traced_us)
+        untraced_p50 = statistics.median(untraced_us)
+        overhead = traced_p50 - untraced_p50
+        out = {
+            "metric": "trace_overhead_per_attach_us",
+            "value": round(overhead, 2),
+            "unit": "us",
+            "baseline_source": (
+                "untraced same-run interleaved A/B median; spans counted "
+                "per attach are the load-insensitive pin (2: "
+                "GetPreferredAllocation + Allocate; 0 events warm). The "
+                "documented bound the honesty guard enforces: recorded "
+                "overhead <= 35 us AND <= 10% of the untraced wall "
+                "(observed ~21 us / ~4% in this sandboxed kernel, where "
+                "a monotonic read costs what a native syscall does)"),
+            "trace_spans_per_attach": spans_per_attach,
+            "trace_events_per_attach": events_per_attach,
+            "traced_wall_p50_us": round(traced_p50, 1),
+            "untraced_wall_p50_us": round(untraced_p50, 1),
+            "overhead_pct": round(100.0 * overhead / untraced_p50, 2),
+            "ring_size": trace.stats()["ring_size"],
+            "devices_advertised": len(devices),
+            "allocation_size": 4,
+            "iterations": iters,
+            "quick": quick,
+        }
+        out_path = os.environ.get("BENCH_TRACE_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "docs", "bench_attach_r10.json")
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+        out["matrix_file"] = os.path.relpath(
+            out_path, os.path.dirname(os.path.abspath(__file__)))
+        print(f"  trace overhead/attach {out['value']:+6.2f} us "
+              f"({out['overhead_pct']:+.2f}%): traced p50 "
+              f"{traced_p50:7.1f} us vs untraced {untraced_p50:7.1f} us | "
+              f"records/attach {spans_per_attach} spans + "
+              f"{events_per_attach} events", file=sys.stderr)
+        return out
+    finally:
+        trace.reset()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 # RTT injected into the fake apiserver's claim GETs for the attach bench.
 # A loopback fake shares this process's GIL and has no network, so the wait
 # a REAL in-cluster apiserver round-trip costs — the thing the parallel
@@ -1319,8 +1436,16 @@ def main() -> int:
     if "--attach-burst" in sys.argv:
         print(json.dumps(run_attach_burst()))
         return 0
+    if "--trace-overhead" in sys.argv:
+        print(json.dumps(run_trace_overhead(quick="--quick" in sys.argv)))
+        return 0
     if "--attach" in sys.argv:
-        print(json.dumps(run_attach(quick="--quick" in sys.argv)))
+        result = run_attach(quick="--quick" in sys.argv)
+        # the r10 tracing-overhead artifact rides the same invocation so
+        # the CI bench-smoke job exercises both (docs/bench_attach_r10.json)
+        trace_result = run_trace_overhead(quick="--quick" in sys.argv)
+        result["trace_overhead_file"] = trace_result["matrix_file"]
+        print(json.dumps(result))
         return 0
     root = tempfile.mkdtemp(prefix="tdpbench-")
     try:

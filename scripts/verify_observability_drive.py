@@ -1,0 +1,160 @@
+"""End-to-end drive of the observability plane (PR 8).
+
+Real daemon (cli.main subprocess) with --dra + status server against a
+fake host; driven as the kubelet would, then inspected the way a fleet
+operator would during an incident:
+  1. prepare a DRA claim over dra.sock, hot-unplug the chip
+  2. GET /debug/flight?claim=<uid> -> the claim's full story (prepare
+     span + checkpoint flush + apiserver RTT + orphan event), time-ordered
+  3. GET /debug/flight?bdf=<bdf> -> the device's lifecycle transitions
+  4. /metrics carries the trace histogram families (strict families)
+  5. SIGHUP -> flight-recorder dump file written
+  6. stderr is structured key=value and carries span context (claim_uid)
+Prints OBSERVABILITY DRIVE PASS on success.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import grpc  # noqa: E402
+from fakehost import FakeChip, FakeHost  # noqa: E402
+from kubelet_sim import DeviceManagerSim  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
+from tpu_device_plugin.kubeletapi import draapi, drapb  # noqa: E402
+
+root = tempfile.mkdtemp(prefix="vfyobs-", dir="/tmp")
+fh = FakeHost(root)
+for i in range(4):
+    fh.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                         iommu_group=str(10 + i), numa_node=i // 2,
+                         serial=f"sn-{i}"))
+victim_bdf = "0000:00:04.0"
+victim_sysfs = os.path.join(root, "sys/bus/pci/devices", victim_bdf)
+victim_vfio = os.path.join(root, "dev/vfio/10")
+dump_path = os.path.join(root, "flight-dump.json")
+stderr_path = os.path.join(root, "daemon.stderr")
+
+os.makedirs(os.path.join(root, "device-plugins"), exist_ok=True)
+sim = DeviceManagerSim(os.path.join(root, "device-plugins"))
+api = FakeApiServer()
+port = 18171
+env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+           NODE_NAME="node-a", TDP_TRACE_DUMP_PATH=dump_path)
+stderr_f = open(stderr_path, "w")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+     "--dra", "--api-server", api.url, "--status-port", str(port),
+     "--health-poll-seconds", "0.3", "--rediscovery-seconds", "0.5"],
+    env=env, stdout=subprocess.DEVNULL, stderr=stderr_f)
+
+
+def get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=2) as r:
+        body = r.read()
+    return json.loads(body) if path != "/metrics" else body.decode()
+
+
+def wait_for(pred, what, timeout=30):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        try:
+            if pred():
+                print(f"OK: {what}")
+                return
+        except Exception:
+            pass
+        time.sleep(0.25)
+    raise SystemExit(f"FAIL: timeout waiting for {what}")
+
+
+try:
+    wait_for(lambda: get("/status"), "daemon up")
+    wait_for(lambda: api.slices, "ResourceSlice published")
+
+    # 1. prepare a claim, then hot-unplug the chip
+    api.add_claim("ns", "vm1", "uid-vm1", "cloud-tpus.google.com",
+                  [{"device": "d0000-00-04-0"}], generation=5)
+    dra_sock = os.path.join(root, "plugins/cloud-tpus.google.com/dra.sock")
+    with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+        resp = draapi.DraPluginStub(ch).NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[
+                drapb.Claim(namespace="ns", name="vm1", uid="uid-vm1")]),
+            timeout=10)
+    assert resp.claims["uid-vm1"].error == "", resp.claims["uid-vm1"].error
+    print("OK: DRA claim prepared over dra.sock")
+    shutil.move(victim_sysfs, os.path.join(root, "victim-backup"))
+    os.unlink(victim_vfio)
+    wait_for(lambda: get("/status")["dra"]["orphaned_claims"] == ["uid-vm1"],
+             "claim orphaned on /status")
+
+    # 2. the claim's story from /debug/flight?claim=
+    flight = get("/debug/flight?claim=uid-vm1")
+    ops = [r["op"] for r in flight["spans"]]
+    for needed in ("dra.prepare.claim", "dra.checkpoint.flush",
+                   "kubeapi.request", "lifecycle.claim.orphaned"):
+        assert needed in ops, (needed, ops)
+    ts = [r["ts"] for r in flight["spans"]]
+    assert ts == sorted(ts), "flight output not time-ordered"
+    assert ops.index("dra.prepare.claim") < ops.index(
+        "lifecycle.claim.orphaned")
+    assert all(r["attrs"].get("claim_uid") == "uid-vm1"
+               for r in flight["spans"])
+    print("OK: /debug/flight?claim= replays prepare -> orphan story "
+          f"({len(ops)} records)")
+
+    # 3. the device's story from /debug/flight?bdf=
+    dev = get(f"/debug/flight?bdf={victim_bdf}")
+    transitions = [(r["attrs"].get("from"), r["attrs"].get("to"))
+                   for r in dev["spans"] if r["op"] == "lifecycle.transition"]
+    assert ("bound", "allocated") in transitions, transitions
+    assert ("allocated", "gone") in transitions, transitions
+    print("OK: /debug/flight?bdf= shows the lifecycle transitions "
+          f"({transitions})")
+
+    # 4. trace histograms on /metrics
+    m = get("/metrics")
+    for fam in ("tdp_prepare_wall_ms", "tdp_kubeapi_rtt_ms",
+                "tdp_checkpoint_commit_ms", "tdp_probe_cycle_ms"):
+        assert f"# TYPE {fam} histogram" in m, fam
+        assert f'{fam}_bucket{{le="+Inf"}}' in m, fam
+    assert "tdp_trace_spans_total" in m
+    print("OK: /metrics carries the trace histogram families")
+
+    # 5. SIGHUP dumps the ring (dedicated dump signal; SIGUSR2 stays undrain)
+    proc.send_signal(signal.SIGHUP)
+    wait_for(lambda: os.path.exists(dump_path), "SIGHUP flight dump")
+    with open(dump_path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "SIGHUP"
+    assert any(r["op"] == "dra.prepare.claim" for r in dump["spans"])
+    print(f"OK: dump carries {len(dump['spans'])} spans")
+
+    # 6. structured key=value logs with span context
+    stderr_f.flush()
+    with open(stderr_path) as f:
+        logs = f.read()
+    assert "claim_uid=uid-vm1" in logs, "span context missing from logs"
+    print("OK: stderr logs are key=value and carry claim_uid from the "
+          "active span")
+    print("OBSERVABILITY DRIVE PASS")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    stderr_f.close()
+    api.stop()
+    sim.stop()
+    shutil.rmtree(root, ignore_errors=True)

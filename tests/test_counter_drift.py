@@ -1,0 +1,169 @@
+"""Counter-drift audit (ISSUE 8 satellite).
+
+Counter names have drifted across PRs 2-7: counters documented in
+docs/perf.md / docs/fault-injection.md, or registered for lock-ownership
+in tsalint's COUNTERS config, did not always surface on /status and
+/metrics under the documented names. This test pins them:
+
+1. every Prometheus series name mentioned in the docs appears in a
+   fully-populated /metrics scrape;
+2. every counter registered in tools/tsalint/config.py COUNTERS maps to
+   a /status JSON path (asserted to resolve) and a /metrics family
+   (asserted to exist) via the explicit table below — adding a counter
+   to COUNTERS without extending the table (i.e. without surfacing it)
+   fails this test.
+"""
+
+import os
+import re
+
+from tests.test_metrics_format import full_scrape, parse_scrape  # noqa: F401
+from tools.tsalint.config import COUNTERS
+
+_DOCS = ("docs/perf.md", "docs/fault-injection.md", "docs/observability.md")
+# backticked tokens that look like Prometheus series names
+_METRIC_TOKEN = re.compile(
+    r"`((?:tpu_plugin_|tdp_|lifecycle_transitions_total|"
+    r"claims_orphaned_total|handoffs_completed_total)[a-z0-9_]*)"
+    r"(?:\{[^}]*\})?`")
+
+# COUNTERS ("module.Class" -> {attr: lock}) -> where each counter
+# surfaces. status: dotted path into the /status JSON ("plugins[*]." =
+# per-plugin snapshot, "dra.", "health.", ...). metrics: the family name
+# in the scrape. The two-sided pin the satellite asks for.
+SURFACES = {
+    ("server.TpuDevicePlugin", "_restart_count"): {
+        "status": "plugins[*].restarts",
+        "metrics": "tpu_plugin_restarts_total"},
+    ("healthhub.HealthHub", "_probe_cycles"): {
+        "status": "health.probe_cycles_total",
+        "metrics": "tpu_plugin_health_probe_cycles_total"},
+    ("healthhub.HealthHub", "_probes_last_cycle"): {
+        "status": "health.probes_last_cycle",
+        "metrics": "tpu_plugin_health_probes_last_cycle"},
+    ("healthhub.HealthHub", "_probes_deduped_last_cycle"): {
+        "status": "health.probes_deduped_last_cycle",
+        "metrics": "tpu_plugin_health_probes_deduped_last_cycle"},
+    ("healthhub.HealthHub", "_probe_timeouts"): {
+        "status": "health.probe_timeouts_total",
+        "metrics": "tpu_plugin_health_probe_timeouts_total"},
+    ("healthhub.HealthHub", "_probe_errors"): {
+        "status": "health.probe_errors_total",
+        "metrics": "tdp_probe_errors_total"},
+    ("healthhub.HealthHub", "_existence_scans"): {
+        "status": "health.existence_scans_total",
+        "metrics": "tpu_plugin_health_existence_scans_total"},
+    ("dra.DraDriver", "publish_stats[*]"): {
+        "status": "dra.publish_stats.delta",
+        "metrics": "tpu_plugin_dra_slice_publishes_total"},
+    ("dra.DraDriver", "checkpoint_stats_counters[*]"): {
+        "status": "dra.checkpoint_commits_total",
+        "metrics": "tpu_plugin_dra_checkpoint_commits_total"},
+    ("dra.DraDriver", "_prepare_inflight"): {
+        "status": "dra.prepare_inflight",
+        "metrics": "tpu_plugin_dra_prepare_inflight"},
+    ("dra.DraDriver", "_attach_active"): {
+        "status": "dra.attach_active",
+        "metrics": "tpu_plugin_dra_attach_active"},
+    ("dra.DraDriver", "handoff_stats[*]"): {
+        "status": "dra.handoffs_emitted_total",
+        "metrics": "tpu_plugin_dra_handoffs_emitted_total"},
+    ("lifecycle_fsm.DeviceLifecycle", "transition_counts[*]"): {
+        "status": "lifecycle.transitions",
+        "metrics": "lifecycle_transitions_total"},
+    ("lifecycle_fsm.DeviceLifecycle", "claims_orphaned_total"): {
+        "status": "lifecycle.claims_orphaned_total",
+        "metrics": "claims_orphaned_total"},
+    ("lifecycle_fsm.DeviceLifecycle", "identity_swaps_total"): {
+        "status": "lifecycle.identity_swaps_total",
+        "metrics": "tpu_plugin_lifecycle_identity_swaps_total"},
+    ("lifecycle_fsm.DeviceLifecycle", "invalid_transitions_total"): {
+        "status": "lifecycle.invalid_transitions_total",
+        "metrics": "tpu_plugin_lifecycle_invalid_transitions_total"},
+    ("resilience.BackoffPolicy", "attempts"): {
+        # current streak, reset on success — transient state surfaced
+        # per-owner on /status; the cumulative twin below is the counter
+        "status": "plugins[*].restart_backoff.attempts",
+        "metrics": None},
+    ("resilience.BackoffPolicy", "total_attempts"): {
+        "status": "plugins[*].restart_backoff.total_attempts",
+        "metrics": "tpu_plugin_restart_retries_total"},
+    ("resilience.CircuitBreaker", "trips"): {
+        "status": "dra.api_breaker.trips",
+        "metrics": "tpu_plugin_kubeapi_breaker_trips_total"},
+    ("resilience.CircuitBreaker", "rejected"): {
+        "status": "dra.api_breaker.rejected",
+        "metrics": "tpu_plugin_kubeapi_breaker_rejected_total"},
+    ("resilience.CircuitBreaker", "_consecutive_failures"): {
+        # transient breaker state (resets on success): /status only
+        "status": "dra.api_breaker.consecutive_failures",
+        "metrics": None},
+    ("discovery.HostSnapshot", "stats[*]"): {
+        "status": "discovery.full_scans",
+        "metrics": "tpu_plugin_discovery_scans_total"},
+    ("faults", "_fired[*]"): {
+        "status": "faults.fired",
+        "metrics": "tdp_fault_fires_total"},
+}
+
+
+def _resolve(status: dict, path: str):
+    node = status
+    for part in path.split("."):
+        if part == "plugins[*]":
+            assert status["plugins"], "rig has no plugins"
+            node = node["plugins"][0]
+            continue
+        assert isinstance(node, dict) and part in node, \
+            f"/status path {path!r} broke at {part!r} (have: " \
+            f"{sorted(node) if isinstance(node, dict) else type(node)})"
+        node = node[part]
+    return node
+
+
+def _doc_metric_names():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    names = set()
+    for rel in _DOCS:
+        with open(os.path.join(root, rel)) as f:
+            text = f.read()
+        for m in _METRIC_TOKEN.finditer(text):
+            name = m.group(1)
+            if "*" in name or name.endswith("_"):
+                continue   # wildcard shorthand like tpu_plugin_dra_checkpoint_*
+            names.add(name)
+    return names
+
+
+def test_documented_metric_names_appear_on_metrics(full_scrape):  # noqa: F811
+    text, _server = full_scrape
+    types, _helps, _samples = parse_scrape(text)
+    documented = _doc_metric_names()
+    assert len(documented) > 15, documented   # the extraction works
+    missing = {n for n in documented if n not in types}
+    assert not missing, \
+        f"counters documented in {_DOCS} missing from /metrics: " \
+        f"{sorted(missing)}"
+
+
+def test_tsalint_registered_counters_surface_on_status_and_metrics(
+        full_scrape):  # noqa: F811
+    text, server = full_scrape
+    types, _helps, _samples = parse_scrape(text)
+    status = server.status()
+
+    registered = {(scope, attr)
+                  for scope, table in COUNTERS.items() for attr in table}
+    unmapped = registered - set(SURFACES)
+    assert not unmapped, \
+        f"counters registered in tsalint COUNTERS but not pinned to a " \
+        f"/status + /metrics surface here: {sorted(unmapped)} — extend " \
+        f"SURFACES (and the endpoints) when adding counters"
+    stale = set(SURFACES) - registered
+    assert not stale, f"SURFACES entries no longer in COUNTERS: {stale}"
+
+    for key, surface in sorted(SURFACES.items()):
+        _resolve(status, surface["status"])
+        if surface["metrics"] is not None:
+            assert surface["metrics"] in types, \
+                f"{key}: family {surface['metrics']} missing from /metrics"

@@ -1,0 +1,200 @@
+"""Prometheus text-format conformance for the /metrics scrape.
+
+The exposition had never been validated against a parser (ISSUE 8
+satellite): every series must carry # HELP and # TYPE lines, label
+values must be escaped per the spec, families must be contiguous, no
+series may repeat, and histogram families must be internally consistent
+(_bucket cumulative, +Inf == _count, _sum present). The parser here is a
+strict line grammar — any line that is not a well-formed HELP, TYPE or
+sample line fails the test.
+"""
+
+import os
+import re
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer, make_driver
+from tpu_device_plugin import faults, lockdep, trace
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.lifecycle import PluginManager
+from tpu_device_plugin.server import TpuDevicePlugin
+from tpu_device_plugin.status import StatusServer, _esc
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.+)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{({_LABEL}(?:,{_LABEL})*)?\}})?"
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)|\+Inf|-Inf|NaN)$")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_scrape(text):
+    """Strict parse → (types, helps, samples). Raises AssertionError on
+    any malformed line. samples = [(family, name, labels-dict, value)]."""
+    assert text.endswith("\n"), "scrape must end with a newline"
+    types, helps, samples = {}, {}, []
+    for line in text[:-1].split("\n"):
+        m = _HELP_RE.match(line)
+        if m:
+            assert m.group(1) not in helps, f"duplicate HELP: {line}"
+            helps[m.group(1)] = m.group(2)
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            assert m.group(1) not in types, f"duplicate TYPE: {line}"
+            types[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        name, label_blob, value = m.group(1), m.group(2), m.group(3)
+        family = name
+        for suffix in _HIST_SUFFIXES:
+            base = name.removesuffix(suffix)
+            if name.endswith(suffix) and types.get(base) == "histogram":
+                family = base
+        labels = dict(_LABEL_RE.findall(label_blob or ""))
+        samples.append((family, name, labels, value))
+    return types, helps, samples
+
+
+@pytest.fixture()
+def full_scrape(short_root):
+    """A fully-populated daemon: plugin + DRA (with apiserver) + health
+    hub + lifecycle FSM + discovery snapshot + a fired fault + trace
+    histograms + lockdep read-path counters — every /metrics family the
+    daemon can emit is present in one scrape."""
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        host.add_chip(FakeChip("0000:00:04.0", device_id="0063",
+                               iommu_group="11"))
+        cfg = Config().with_root(host.root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        apiserver = FakeApiServer()
+        manager = PluginManager(cfg)
+        registry, _ = discover_passthrough(cfg)
+        manager.plugins = [TpuDevicePlugin(
+            cfg, "v5e", registry, registry.devices_by_model["0063"])]
+        manager._rediscover()                    # discovery stats exist
+        manager.device_lifecycle.sync_inventory({"0000:00:04.0": None})
+        driver = make_driver(cfg, apiserver)
+        driver.publish_resource_slices()
+        faults.arm("dra.publish", kind="drop", count=1)
+        faults.fire("dra.publish")               # fault stats exist
+        trace.observe("tdp_attach_wall_ms", 1.25)
+        trace.observe("tdp_kubeapi_rtt_ms", 42.0)
+        server = StatusServer(manager, port=0, dra_driver=driver)
+        try:
+            server.status()                      # warm read_path counters
+            yield server.metrics(), server
+        finally:
+            server._httpd.server_close()
+            apiserver.stop()
+            faults.reset()
+            trace.reset()
+
+
+def test_every_series_has_help_and_type_and_parses(full_scrape):
+    text, _ = full_scrape
+    types, helps, samples = parse_scrape(text)
+    assert samples, "empty scrape"
+    for family, name, labels, _value in samples:
+        assert family in types, f"sample {name} has no # TYPE"
+        assert family in helps, f"sample {name} has no # HELP"
+    # the rig exercises every subsystem: spot-check the families that
+    # have drifted or were added by this PR
+    for family in ("tpu_plugin_devices", "tpu_plugin_epoch",
+                   "lifecycle_transitions_total", "claims_orphaned_total",
+                   "tpu_plugin_dra_attach_active",
+                   "tpu_plugin_health_existence_scans_total",
+                   "tpu_plugin_lifecycle_invalid_transitions_total",
+                   "tdp_fault_fires_total", "tdp_trace_spans_total",
+                   "tdp_read_path_lock_acquisitions_total",
+                   "tdp_attach_wall_ms"):
+        assert family in types, f"family {family} missing from scrape"
+
+
+def test_families_are_contiguous_and_series_unique(full_scrape):
+    text, _ = full_scrape
+    _types, _helps, samples = parse_scrape(text)
+    seen_series = set()
+    family_order, closed = [], set()
+    for family, name, labels, _value in samples:
+        series = (name, tuple(sorted(labels.items())))
+        assert series not in seen_series, f"duplicate series {series}"
+        seen_series.add(series)
+        if not family_order or family_order[-1] != family:
+            assert family not in closed, \
+                f"family {family} reappears after other samples"
+            if family_order:
+                closed.add(family_order[-1])
+            family_order.append(family)
+
+
+def test_histogram_families_are_internally_consistent(full_scrape):
+    text, _ = full_scrape
+    types, _helps, samples = parse_scrape(text)
+    hist_families = [f for f, t in types.items() if t == "histogram"]
+    assert "tdp_attach_wall_ms" in hist_families
+    for family in hist_families:
+        buckets = [(labels["le"], float(value))
+                   for f, name, labels, value in samples
+                   if f == family and name == f"{family}_bucket"]
+        counts = {name: float(value) for f, name, _l, value in samples
+                  if f == family and name in (f"{family}_count",
+                                              f"{family}_sum")}
+        assert buckets and buckets[-1][0] == "+Inf", family
+        cum = [n for _le, n in buckets]
+        assert cum == sorted(cum), f"{family} buckets not cumulative"
+        les = [float(le) for le, _n in buckets[:-1]]
+        assert les == sorted(les), f"{family} le bounds unsorted"
+        assert counts[f"{family}_count"] == cum[-1], family
+        assert f"{family}_sum" in counts, family
+
+
+def test_counter_and_gauge_types_are_declared_correctly(full_scrape):
+    text, _ = full_scrape
+    types, _helps, _samples = parse_scrape(text)
+    # *_total families follow the counter convention
+    for family, kind in types.items():
+        if kind == "histogram":
+            continue
+        if family.endswith("_total"):
+            assert kind == "counter", (family, kind)
+
+
+def test_label_values_are_escaped_per_spec():
+    assert _esc('plain') == "plain"
+    assert _esc('say "hi"') == 'say \\"hi\\"'
+    assert _esc("back\\slash") == "back\\\\slash"
+    assert _esc("multi\nline") == "multi\\nline"
+
+    # a hostile resource name renders to a parseable sample line
+    class Hostile(StatusServer):
+        def __init__(self):   # no HTTP server needed
+            pass
+
+        def status(self):
+            return {"plugins": [{
+                "resource": 'tpu"v4\\weird\nname',
+                "devices": {"a": "Healthy"}, "serving": True,
+                "restarts": 0, "allocations_total": 0, "epoch": 1,
+                "degraded_links": {}, "preferred_cache": {},
+                "alloc_fragments": {}, "restart_backoff": {},
+                "lw_resends": 0,
+            }], "pending": [], "native": {}, "draining": False}
+
+    text = Hostile().metrics()
+    types, helps, samples = parse_scrape(text)
+    resources = {labels.get("resource") for _f, name, labels, _v in samples
+                 if name == "tpu_plugin_serving"}
+    # the parser returns the ESCAPED form; unescaping restores the name
+    assert resources == {'tpu\\"v4\\\\weird\\nname'}

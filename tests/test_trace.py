@@ -1,0 +1,501 @@
+"""Flight-recorder tests (tpu_device_plugin/trace.py).
+
+Covers the lock-free span/ring/histogram primitives, the concurrency
+contract (writers appending while a reader drains must never produce a
+torn or duplicated span), the /debug/flight HTTP surface, the crash-dump
+hook, the structured-logging correlation, and the two scenario claims
+from the ISSUE:
+
+- a full claim story (prepare -> allocate -> hot-unplug orphan) is
+  reconstructable purely from /debug/flight filtered by claim UID;
+- an armed checkpoint.write fault shows up on the failing claim's trace
+  (the flush span errors with the injected fault) and as a fault event
+  in the ring.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer, make_driver, prepare
+from tpu_device_plugin import faults, trace
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.kubeletapi import drapb
+from tpu_device_plugin.lifecycle_fsm import DeviceLifecycle
+from tpu_device_plugin.log import JsonFormatter, KeyValueFormatter
+
+
+@pytest.fixture(autouse=True)
+def clean_trace():
+    trace.reset()
+    yield
+    trace.reset()
+    trace.configure(enabled=True, ring_size=256, slow_ms=250.0)
+
+
+# ------------------------------------------------------------- primitives
+
+
+def test_span_records_fields_and_duration():
+    with trace.span("t.op", resource="r0", epoch_id=3):
+        time.sleep(0.002)
+    recs = trace.snapshot(op="t.op")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "span"
+    assert rec["op"] == "t.op"
+    assert rec["outcome"] == "ok"
+    assert rec["dur_ms"] >= 1.0
+    assert rec["attrs"] == {"resource": "r0", "epoch_id": 3}
+    assert rec["thread"] == threading.current_thread().name
+    assert rec["parent"] is None
+
+
+def test_child_span_and_event_inherit_parent_attrs():
+    with trace.span("t.parent", claim_uid="u1", bdf="0000:00:04.0"):
+        with trace.span("t.child", step="flush"):
+            pass
+        trace.event("t.evt", what="fired")
+    child = trace.snapshot(op="t.child")[0]
+    assert child["attrs"]["claim_uid"] == "u1"
+    assert child["attrs"]["bdf"] == "0000:00:04.0"
+    assert child["attrs"]["step"] == "flush"
+    assert child["parent"] is not None
+    evt = trace.snapshot(op="t.evt")[0]
+    assert evt["kind"] == "event"
+    assert evt["attrs"]["claim_uid"] == "u1"
+    # inheritance makes the claim filter catch both
+    assert {r["op"] for r in trace.snapshot(claim="u1")} == \
+        {"t.parent", "t.child", "t.evt"}
+
+
+def test_span_error_outcome_carries_exception_text():
+    with pytest.raises(RuntimeError):
+        with trace.span("t.fail", claim_uid="u9"):
+            raise RuntimeError("boom in prepare")
+    rec = trace.snapshot(op="t.fail")[0]
+    assert rec["outcome"] == "error"
+    assert "RuntimeError: boom in prepare" == rec["error"]
+
+
+def test_ring_overwrites_oldest_and_counts():
+    trace.configure(ring_size=8)
+    trace.reset()
+    for i in range(20):
+        with trace.span("t.ring", i=i):
+            pass
+    recs = trace.snapshot(op="t.ring")
+    assert len(recs) == 8                     # fixed size, oldest gone
+    assert [r["attrs"]["i"] for r in recs] == list(range(12, 20))
+    assert trace.stats()["spans_overwritten_total"] == 12
+    assert trace.stats()["spans_recorded_total"] == 20
+
+
+def test_disabled_trace_records_nothing():
+    trace.configure(enabled=False)
+    try:
+        with trace.span("t.off") as sp:
+            sp.set(x=1)                       # the null span accepts set()
+        trace.event("t.off.evt")
+        assert trace.snapshot(op="t.off") == []
+        assert trace.stats()["spans_recorded_total"] == 0
+    finally:
+        trace.configure(enabled=True)
+
+
+def test_snapshot_filters_claim_bdf_op_and_limit():
+    with trace.span("a.one", claim_uid="u1", bdf="b1"):
+        pass
+    with trace.span("a.two", claim_uid="u2", bdf="b2"):
+        pass
+    trace.event("b.three", device="b1")
+    assert {r["op"] for r in trace.snapshot(claim="u1")} == {"a.one"}
+    # bdf filter matches attrs.bdf AND attrs.device
+    assert {r["op"] for r in trace.snapshot(bdf="b1")} == \
+        {"a.one", "b.three"}
+    assert {r["op"] for r in trace.snapshot(op="a.")} == {"a.one", "a.two"}
+    assert len(trace.snapshot(limit=2)) == 2
+    # limit keeps the NEWEST records
+    assert trace.snapshot(limit=1)[0]["op"] == "b.three"
+
+
+# ------------------------------------------------------------ concurrency
+
+
+def test_concurrent_writers_and_reader_never_tear_or_duplicate():
+    """The /debug/flight concurrency contract: writer threads appending
+    while a reader drains must never produce torn or duplicated spans.
+    Torn = a record missing required keys / partially built; duplicated =
+    the same (thread, seq) twice in one snapshot."""
+    n_threads, per_thread = 4, 400
+    required = {"kind", "op", "thread", "seq", "ts", "outcome", "attrs"}
+    stop = threading.Event()
+    problems = []
+
+    def writer(tid):
+        for i in range(per_thread):
+            with trace.span("t.conc", writer=tid, i=i):
+                pass
+
+    def reader():
+        while not stop.is_set():
+            snap = trace.snapshot(op="t.conc")
+            seen = set()
+            for rec in snap:
+                if not required <= set(rec):
+                    problems.append(("torn", rec))
+                key = (rec["thread"], rec["seq"])
+                if key in seen:
+                    problems.append(("dup", key))
+                seen.add(key)
+                if rec["kind"] == "span" and "dur_ms" not in rec:
+                    problems.append(("no-dur", rec))
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    rd = threading.Thread(target=reader)
+    rd.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    rd.join()
+    assert problems == []
+    # the final snapshot holds the last ring_size spans per writer thread
+    final = trace.snapshot(op="t.conc")
+    per_writer = {}
+    for rec in final:
+        per_writer.setdefault(rec["attrs"]["writer"], []).append(
+            rec["attrs"]["i"])
+    ring = trace.stats()["ring_size"]
+    for tid, seen_is in per_writer.items():
+        expect = list(range(per_thread - min(ring, per_thread), per_thread))
+        assert sorted(seen_is) == expect, tid
+
+
+def test_dead_thread_rings_are_bounded_and_charged_to_overwritten():
+    """Thread churn (the idle-exiting checkpoint writer respawns per
+    burst) must not accrete one ring per dead thread forever: only the
+    newest _DEAD_RING_KEEP dead rings stay readable, and retired rings'
+    records are charged to the overwritten counter (monotonic)."""
+    for i in range(60):
+        t = threading.Thread(target=lambda i=i: trace.event("t.short", i=i))
+        t.start()
+        t.join()
+    stats = trace.stats()
+    assert stats["rings"] <= trace._DEAD_RING_KEEP + 2, stats
+    assert stats["events_recorded_total"] == 60
+    # the NEWEST dead threads' records are still readable post-mortem
+    recs = trace.snapshot(op="t.short")
+    assert recs and recs[-1]["attrs"]["i"] == 59
+    assert stats["spans_overwritten_total"] >= 60 - (
+        trace._DEAD_RING_KEEP + 2)
+
+
+def test_histogram_cells_are_adopted_across_thread_churn():
+    """Same churn property for histogram shards: a new thread's first
+    observe adopts a dead owner's cell (lossless — shards are sums), so
+    the cell count is bounded by peak LIVE threads, not thread count."""
+    hist = trace.Histogram("t_adopt_ms", "test", bounds=(1.0, 10.0))
+    for _ in range(30):
+        t = threading.Thread(target=lambda: hist.observe(0.5))
+        t.start()
+        t.join()
+    snap = hist.snapshot()
+    assert snap["count"] == 30          # adoption loses nothing
+    assert snap["buckets"] == [(1.0, 30), (10.0, 30)]
+    assert len(hist._cells) <= 3        # not one cell per dead thread
+
+
+# ------------------------------------------------------------- histograms
+
+
+def test_histogram_buckets_are_cumulative_and_exact_across_threads():
+    hist = trace.Histogram("t_hist_ms", "test", bounds=(1.0, 10.0, 100.0))
+    values = [0.5, 5.0, 50.0, 500.0]
+
+    def worker():
+        for v in values:
+            hist.observe(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = hist.snapshot()
+    assert snap["count"] == 8 * len(values)
+    assert snap["sum"] == pytest.approx(8 * sum(values))
+    assert snap["buckets"] == [(1.0, 8), (10.0, 16), (100.0, 24)]
+
+
+def test_span_histogram_option_observes_duration():
+    before = trace.histogram("tdp_attach_wall_ms").snapshot()["count"]
+    with trace.span("t.timed", histogram="tdp_attach_wall_ms"):
+        pass
+    after = trace.histogram("tdp_attach_wall_ms").snapshot()
+    assert after["count"] == before + 1
+    assert after["sum"] > 0
+
+
+def test_render_prometheus_histogram_families_are_well_formed():
+    trace.observe("tdp_kubeapi_rtt_ms", 3.0)
+    trace.observe("tdp_kubeapi_rtt_ms", 30000.0)   # beyond the last bound
+    lines = trace.render_prometheus()
+    text = "\n".join(lines)
+    assert "# TYPE tdp_kubeapi_rtt_ms histogram" in text
+    assert "# HELP tdp_kubeapi_rtt_ms" in text
+    bucket_lines = [ln for ln in lines
+                    if ln.startswith("tdp_kubeapi_rtt_ms_bucket")]
+    # +Inf terminal bucket equals _count; cumulative monotone
+    assert bucket_lines[-1] == 'tdp_kubeapi_rtt_ms_bucket{le="+Inf"} 2'
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts)
+    assert "tdp_kubeapi_rtt_ms_count 2" in text
+    assert any(ln.startswith("tdp_kubeapi_rtt_ms_sum ") for ln in lines)
+    assert "tdp_trace_spans_total" in text
+
+
+# ------------------------------------------------------ slow spans + logs
+
+
+def test_slow_span_lands_in_slow_log_and_structured_logger(caplog):
+    trace.configure(slow_ms=1.0)
+    with caplog.at_level(logging.WARNING, logger="tpu_device_plugin.trace"):
+        with trace.span("t.slow", claim_uid="u-slow"):
+            time.sleep(0.005)
+    slow = trace.slow_spans()
+    assert [r["op"] for r in slow] == ["t.slow"]
+    assert trace.stats()["slow_spans_total"] == 1
+    assert any("slow span" in r.message and "t.slow" in r.message
+               for r in caplog.records)
+
+
+def test_per_op_threshold_overrides_global():
+    trace.configure(slow_ms=0.0)               # everything is "slow"...
+    old = trace.SLOW_THRESHOLDS_MS.get("t.fastpath")
+    trace.SLOW_THRESHOLDS_MS["t.fastpath"] = 10_000.0
+    try:
+        with trace.span("t.fastpath"):
+            pass
+        assert trace.slow_spans() == []        # ...except the override
+    finally:
+        if old is None:
+            trace.SLOW_THRESHOLDS_MS.pop("t.fastpath", None)
+        else:
+            trace.SLOW_THRESHOLDS_MS["t.fastpath"] = old
+
+
+def test_log_formatters_carry_active_span_context():
+    rec = logging.LogRecord("dra", logging.INFO, __file__, 1,
+                            "prepared claim", (), None)
+    with trace.span("t.ctx", claim_uid="u7", resource="tpu-v4"):
+        kv = KeyValueFormatter().format(rec)
+        js = json.loads(JsonFormatter().format(rec))
+    assert "claim_uid=u7" in kv and "resource=tpu-v4" in kv
+    assert js["ctx"] == {"claim_uid": "u7", "resource": "tpu-v4"}
+    # outside a span: no context tail
+    assert "claim_uid" not in KeyValueFormatter().format(rec)
+    assert "ctx" not in json.loads(JsonFormatter().format(rec))
+
+
+# ------------------------------------------------------- dump + crash hook
+
+
+def test_dump_writes_ring_and_slow_log(tmp_path):
+    with trace.span("t.dumped", claim_uid="u3"):
+        pass
+    path = str(tmp_path / "flight.json")
+    assert trace.dump("unit-test", path=path) == path
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "unit-test"
+    assert any(r["op"] == "t.dumped" for r in payload["spans"])
+    assert "stats" in payload and "slow" in payload
+
+
+def test_crash_hook_dumps_and_chains(tmp_path, monkeypatch):
+    # a cli test earlier in the session may have left the hook installed
+    # (cli.main installs it; install is idempotent) — clear it so THIS
+    # test's monkeypatched hook is the one being chained to
+    trace.uninstall_crash_hook()
+    path = str(tmp_path / "crash.json")
+    monkeypatch.setenv("TDP_TRACE_DUMP_PATH", path)
+    chained = []
+    monkeypatch.setattr(sys, "excepthook", lambda *a: chained.append(a))
+    trace.install_crash_hook()
+    try:
+        with trace.span("t.pre-crash"):
+            pass
+        try:
+            raise ValueError("kaboom")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        assert os.path.exists(path)
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["reason"] == "unhandled-exception:ValueError"
+        assert any(r["op"] == "t.pre-crash" for r in payload["spans"])
+        assert len(chained) == 1               # previous hook still ran
+    finally:
+        trace.uninstall_crash_hook()
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+class _StubManager:
+    def __init__(self):
+        self.running = threading.Event()
+        self.plugins = []
+        self.pending = []
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def test_debug_flight_endpoint_serves_filtered_ring():
+    from tpu_device_plugin.status import StatusServer
+    server = StatusServer(_StubManager(), port=0)
+    server.start()
+    try:
+        with trace.span("http.one", claim_uid="u-a", bdf="0000:00:04.0"):
+            pass
+        with trace.span("http.two", claim_uid="u-b"):
+            pass
+        body = _get_json(server.port, "/debug/flight")
+        assert {"spans", "slow", "stats", "filters"} <= set(body)
+        ops = [r["op"] for r in body["spans"]]
+        assert "http.one" in ops and "http.two" in ops
+        by_claim = _get_json(server.port, "/debug/flight?claim=u-a")
+        assert [r["op"] for r in by_claim["spans"]] == ["http.one"]
+        assert by_claim["filters"]["claim"] == "u-a"
+        by_bdf = _get_json(server.port, "/debug/flight?bdf=0000:00:04.0")
+        assert [r["op"] for r in by_bdf["spans"]] == ["http.one"]
+        by_op = _get_json(server.port, "/debug/flight?op=http.&limit=1")
+        assert [r["op"] for r in by_op["spans"]] == ["http.two"]
+        # bad limit is a 400, not a stack trace
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.port, "/debug/flight?limit=bogus")
+        assert err.value.code == 400
+        # a BLANK filter value (typo'd $UID in an incident script) is a
+        # 400 too — not a silent fall-through to the whole ring
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.port, "/debug/flight?claim=")
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_status_carries_trace_stats():
+    from tpu_device_plugin.status import StatusServer
+    server = StatusServer(_StubManager(), port=0)
+    try:
+        with trace.span("s.one"):
+            pass
+        out = server.status()
+        assert out["trace"]["spans_recorded_total"] >= 1
+        assert out["trace"]["enabled"] is True
+        text = server.metrics()
+        assert "tdp_trace_spans_total" in text
+        assert "tdp_attach_wall_ms_bucket" in text
+    finally:
+        server._httpd.server_close()
+
+
+# -------------------------------------------------------- claim scenarios
+
+
+@pytest.fixture()
+def dra_rig(short_root):
+    host = FakeHost(short_root)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 2))
+    cfg = Config().with_root(short_root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    apiserver = FakeApiServer()
+    driver = make_driver(cfg, apiserver)
+    fsm = DeviceLifecycle()
+    driver.attach_lifecycle(fsm)
+    fsm.sync_inventory({f"0000:00:{4 + i:02x}.0": None for i in range(4)})
+    yield host, cfg, apiserver, driver, fsm
+    apiserver.stop()
+
+
+def _claim_ops(uid):
+    return [r["op"] for r in trace.snapshot(claim=uid)]
+
+
+def test_claim_story_reconstructs_from_flight_filtered_by_uid(dra_rig):
+    """ACCEPTANCE: prepare -> allocate -> hot-unplug orphan, reconstructed
+    purely from the /debug/flight output filtered by claim UID."""
+    from tpu_device_plugin.dra import slice_device_name
+    _, _, apiserver, driver, fsm = dra_rig
+    bdf = "0000:00:04.0"
+    apiserver.add_claim("ns1", "c1", "uid-story", driver.driver_name,
+                        [{"device": slice_device_name(bdf)}])
+    claim = drapb.Claim(namespace="ns1", name="c1", uid="uid-story")
+    resp = prepare(driver, claim)
+    assert resp.claims["uid-story"].error == ""
+    # hot-unplug the allocated chip (the FSM seam the lifecycle scenarios
+    # drive; presence_reader is None so the event is trusted)
+    fsm.note_fs_event(bdf, False)
+    assert driver.orphaned_claims() == ["uid-story"]
+
+    story = trace.snapshot(claim="uid-story")
+    ops = [r["op"] for r in story]
+    # the three acts, each present and in causal order:
+    prepare_i = ops.index("dra.prepare.claim")
+    alloc_i = ops.index("lifecycle.transition")     # bound -> allocated
+    orphan_i = ops.index("lifecycle.claim.orphaned")
+    assert story[alloc_i]["attrs"]["to"] == "allocated"
+    assert story[alloc_i]["attrs"]["device"] == bdf
+    assert prepare_i < orphan_i and alloc_i < orphan_i
+    # the prepare decomposes: apiserver fetch + durability wait, each
+    # carrying the claim uid by inheritance
+    assert "kubeapi.request" in ops
+    assert "dra.checkpoint.flush" in ops
+    assert "dra.claim.orphaned" in ops
+    # every record in the filtered story belongs to this claim
+    for rec in story:
+        assert rec["attrs"].get("claim_uid") == "uid-story"
+    # and the whole story survives a JSON round-trip (the /debug/flight
+    # transport) without loss
+    assert json.loads(json.dumps(story)) == story
+
+
+def test_armed_checkpoint_fault_shows_on_the_failing_claims_trace(dra_rig):
+    """Chaos-run assertion: an armed checkpoint.write fault is visible as
+    a fault event in the ring AND on the failing claim's filtered trace
+    (the flush span errors with the injected fault text)."""
+    from tpu_device_plugin.dra import slice_device_name
+    _, _, apiserver, driver, fsm = dra_rig
+    apiserver.add_claim("ns1", "c2", "uid-chaos", driver.driver_name,
+                        [{"device": slice_device_name("0000:00:05.0")}])
+    claim = drapb.Claim(namespace="ns1", name="c2", uid="uid-chaos")
+    with faults.injected("checkpoint.write", count=1):
+        resp = prepare(driver, claim)
+    assert "injected fault at checkpoint.write" in \
+        resp.claims["uid-chaos"].error
+    # the fault event rides the commit span in the writer thread
+    events = trace.snapshot(op="fault.checkpoint.write")
+    assert events and events[0]["kind"] == "event"
+    # the failing claim's trace carries the injected failure explicitly
+    story = trace.snapshot(claim="uid-chaos")
+    flush = [r for r in story if r["op"] == "dra.checkpoint.flush"]
+    assert flush and flush[-1]["outcome"] == "error"
+    assert "checkpoint.write" in flush[-1]["error"]
+    claim_span = [r for r in story if r["op"] == "dra.prepare.claim"]
+    assert claim_span and claim_span[-1]["outcome"] == "error"
+    faults.reset()

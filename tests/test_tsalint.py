@@ -657,3 +657,60 @@ def test_epoch_current_chain_write_fires_without_alias():
     findings = run(EPOCH_CURRENT_CHAIN_WRITE)
     assert rules(findings) == ["epoch-mutation"]
     assert len(findings) == 3
+
+
+# ---------------------------------------------------------- trace plane
+
+
+TRACE_SPAN_ON_EPOCH_READ_PATH = """
+import threading
+from tpu_device_plugin import lockdep, trace
+
+class Server:
+    def __init__(self, store):
+        self._store = store
+        self._cond = lockdep.instrument(
+            "mod.Server._cond", threading.Condition())
+
+    def allocate(self, request):
+        ep = self._store.current
+        with lockdep.read_path("server.Allocate"), trace.span(
+                "server.Allocate", histogram="tdp_attach_wall_ms",
+                epoch_id=ep.epoch_id, devices=len(ep.device_health)):
+            trace.event("allocate.fragment.rebuild", group="g0")
+            return list(ep.device_health)
+
+    def commit(self):
+        # spans may wrap work under a HOT lock too: trace takes no
+        # registered lock and makes no blocking call
+        with self._cond:
+            with trace.span("dra.checkpoint.commit", claims=1):
+                pass
+"""
+
+
+def test_span_on_epoch_read_path_trips_no_rule():
+    """ISSUE 8 fixture: instrumenting an epoch read path (span attrs
+    READ the epoch; the span itself takes no registered lock and makes
+    no blocking call) must not fire epoch-mutation, blocking-under-hot-
+    lock, lock-order, or counter findings — the tracing plane is lint-
+    invisible by design (docs/observability.md)."""
+    findings = run(TRACE_SPAN_ON_EPOCH_READ_PATH,
+                   hot={"mod.Server._cond"})
+    assert findings == []
+
+
+TRACE_EPOCH_MUTATION_STILL_FIRES = """
+from tpu_device_plugin import trace
+
+def bad(store):
+    ep = store.current
+    with trace.span("server.Allocate"):
+        ep.device_health["x"] = "Unhealthy"
+"""
+
+
+def test_epoch_mutation_inside_span_still_fires():
+    # the span context must not LAUNDER a real epoch mutation
+    findings = run(TRACE_EPOCH_MUTATION_STILL_FIRES)
+    assert rules(findings) == ["epoch-mutation"]

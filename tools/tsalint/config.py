@@ -122,6 +122,14 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     },
     # allocate.AllocationPlanner fragment_hits/misses are AtomicCounters
     # (no owning lock; the fragment cache is epoch-keyed and lock-free).
+    # trace.py (flight recorder) counters are LOCK-FREE-OWNED by design:
+    # span/event totals are epoch.AtomicCounter, ring cursors and
+    # histogram cells are single-owner-thread sharded cells — there is no
+    # owning lock to configure, and tests/test_tsalint.py carries a
+    # fixture proving a span() on an epoch read path trips no rule.
+    # tests/test_counter_drift.py pins every entry BELOW to its /status
+    # and /metrics surface names — extend its SURFACES table when adding
+    # counters here.
     "resilience.BackoffPolicy": {
         "attempts": "resilience.BackoffPolicy._lock",
         "total_attempts": "resilience.BackoffPolicy._lock",

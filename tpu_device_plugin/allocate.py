@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import lockdep
+from . import trace
 from .config import Config
 from .epoch import AtomicCounter
 from .kubeletapi import pb
@@ -438,6 +439,10 @@ class AllocationPlanner:
             self.fragment_hits.add()
             return frag
         self.fragment_misses.add()
+        # cold path only (a warm attach never reaches here): the rebuild
+        # marker makes post-flap fragment churn visible on /debug/flight
+        trace.event("allocate.fragment.rebuild", group=group,
+                    iommufd=iommufd)
         frag = self._build_fragment(group, iommufd)
         frags[group] = frag
         return frag

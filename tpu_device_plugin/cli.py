@@ -143,7 +143,7 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              "JSON, and exit (ops/debug; no kubelet contact)")
     parser.add_argument("--log-json", action="store_true",
                         help="emit one JSON object per log line (fleet log "
-                             "pipelines)")
+                             "pipelines; env TDP_LOG_JSON=1)")
     parser.add_argument("-v", "--verbose", action="count", default=0)
     args = parser.parse_args(argv)
     if args.max_partitions_per_chip < 0:
@@ -202,29 +202,14 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                      f"number > 0, got {args.health_probe_deadline_seconds!r}")
 
     level = logging.DEBUG if args.verbose else logging.INFO
-    if args.log_json:
-        import json as json_mod
-
-        class _JsonFormatter(logging.Formatter):
-            def format(self, record):
-                entry = {
-                    "ts": self.formatTime(record),
-                    "level": record.levelname,
-                    "logger": record.name,
-                    "msg": record.getMessage(),
-                }
-                if record.exc_info:
-                    entry["exc"] = self.formatException(record.exc_info)
-                return json_mod.dumps(entry)
-
-        handler = logging.StreamHandler()
-        handler.setFormatter(_JsonFormatter())
-        logging.basicConfig(level=level, handlers=[handler])
-    else:
-        logging.basicConfig(
-            level=level,
-            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-        )
+    # Structured logging (log.py): key=value records by default, JSON
+    # under --log-json / $TDP_LOG_JSON=1 — either way each line carries
+    # the active trace span's context (claim_uid/bdf/resource), so log
+    # lines and /debug/flight traces correlate by construction.
+    json_mode = args.log_json or os.environ.get(
+        "TDP_LOG_JSON", "").strip().lower() in ("1", "true", "yes", "on")
+    from .log import configure as configure_logging
+    configure_logging(level=level, json_mode=json_mode)
     dpp = (args.device_plugin_path if args.device_plugin_path is not None
            else cfg.device_plugin_path)
     cfg = replace(
@@ -312,6 +297,11 @@ def main(argv=None) -> int:
         logging.getLogger(__name__).warning(
             "FAULT INJECTION ARMED from $TDP_FAULTS: %s",
             sorted(faults.armed_sites()))
+    # Flight recorder (trace.py): always-on span rings; an unhandled
+    # exception in any thread dumps them to a JSON file for post-incident
+    # analysis ($TDP_TRACE_DUMP_PATH overrides the location)
+    from . import trace
+    trace.install_crash_hook()
     if args.discover_only:
         print(dump_inventory(cfg))
         return 0
@@ -383,10 +373,21 @@ def main(argv=None) -> int:
         # may hold; the manager run loop applies the request next tick
         manager.request_drain(signum == signal.SIGUSR1)
 
+    def handle_dump(signum, frame):
+        # flag-set only, like drain: trace.dump() logs + writes a file (a
+        # reentrant-stream hazard if the signal lands mid-write); the run
+        # loop dumps within ~1s. A DEDICATED signal — overloading the
+        # undrain signal would silently undrain a maintenance-drained
+        # node exactly when an operator asks for a post-incident dump.
+        manager.request_flight_dump()
+
     # SIGUSR1 = drain (all devices administratively Unhealthy; kubelet stops
-    # placing new VMIs), SIGUSR2 = undrain
+    # placing new VMIs), SIGUSR2 = undrain, SIGHUP = flight-recorder dump
+    # (the on-demand post-incident artifact; harmless if delivered
+    # spuriously by a closing terminal)
     signal.signal(signal.SIGUSR1, handle_drain)
     signal.signal(signal.SIGUSR2, handle_drain)
+    signal.signal(signal.SIGHUP, handle_dump)
     status = None
     if args.status_port:
         from .status import StatusServer

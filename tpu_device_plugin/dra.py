@@ -38,7 +38,6 @@ from __future__ import annotations
 
 import hashlib
 import json
-import logging
 import os
 import re
 import tempfile
@@ -53,6 +52,8 @@ import grpc
 from . import epoch as epoch_mod
 from . import faults
 from . import lockdep
+from . import trace
+from .log import get_logger
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
@@ -63,7 +64,7 @@ from .kubeletapi import draapi, drapb, regpb
 from .naming import GenerationInfo, sanitize_name
 from .registry import Registry, TpuDevice, TpuPartition
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 RESOURCE_API = "/apis/resource.k8s.io/v1beta1"   # fallback when undiscoverable
 # REST versions this driver can speak, newest first. v1 flattens the
@@ -756,6 +757,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                         self._checkpoint[uid] = dict(
                             entry, orphaned={"device": raw, "at": now})
                         marked.append(uid)
+                        # flight-recorder marker: the claim's trace ends
+                        # with its orphaning (event() is lock-free, so
+                        # emitting under _lock costs no reader anything)
+                        trace.event("dra.claim.orphaned", claim_uid=uid,
+                                    device=raw)
         if marked:
             log.error("DRA: claim(s) %s orphaned by surprise removal",
                       ", ".join(marked))
@@ -1136,7 +1142,14 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     def _checkpoint_flush(self, task: dict) -> None:
         """Flush barrier: returns once this task's checkpoint mutation is
         on disk; raises the write error otherwise (the caller rolls back
-        and reports it as the claim's error)."""
+        and reports it as the claim's error). The span makes the group-
+        commit WAIT an explicit child of the claim span (inheriting its
+        claim_uid), so "why was this attach slow" decomposes into plan
+        time vs durability-wait time on /debug/flight."""
+        with trace.span("dra.checkpoint.flush"):
+            self._checkpoint_flush_impl(task)
+
+    def _checkpoint_flush_impl(self, task: dict) -> None:
         with self._ckpt_cond:
             self._ckpt_dirty_gen += 1
             self._ckpt_pending_claims += 1
@@ -1195,10 +1208,18 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                             "handoffs": dict(self._handoffs)}
             err: Optional[BaseException] = None
             try:
-                # fault point "checkpoint.write" (raising): a failed commit
-                # must surface as per-claim errors, never silent ACKs
-                faults.fire("checkpoint.write")
-                _atomic_write_json(self.checkpoint_path, snapshot)
+                # span inside the try: an injected checkpoint.write fault
+                # (the event faults.fire emits lands under this span) or a
+                # real write failure closes the commit span with
+                # outcome=error before the handler swallows it
+                with trace.span("dra.checkpoint.commit",
+                                histogram="tdp_checkpoint_commit_ms",
+                                claims=n_claims):
+                    # fault point "checkpoint.write" (raising): a failed
+                    # commit must surface as per-claim errors, never
+                    # silent ACKs
+                    faults.fire("checkpoint.write")
+                    _atomic_write_json(self.checkpoint_path, snapshot)
             except Exception as exc:   # incl. non-OSError serialization
                 err = exc
                 log.error("DRA: checkpoint commit failed (%d claims "
@@ -1238,6 +1259,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # queues behind a checkpoint commit window
         out = dict(self.checkpoint_stats_counters)
         out["prepare_inflight"] = self._prepare_inflight
+        # claim tasks still before their durability barrier (the commit
+        # window's input); surfaced so the counter-drift audit can pin
+        # every tsalint-registered counter to a public name
+        out["attach_active"] = self._attach_active
         out["prepare_workers"] = self.prepare_workers
         # lifecycle survivability surfaces (same lock-free contract:
         # fixed-key dict copies + GIL-atomic int/len reads)
@@ -1731,16 +1756,28 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     # ------------------------------------------------------------- RPCs
 
-    def _run_claim_tasks(self, claims, fn) -> List[Optional[str]]:
+    def _run_claim_tasks(self, claims, fn, op: str,
+                         hist: Optional[str] = None) -> List[Optional[str]]:
         """Run `fn(claim, task)` for every claim — on the bounded prepare
         pool when the request carries several — returning the per-claim
         error string (None = success). ANY exception becomes that claim's
         error, never the RPC's: a non-OSError checkpoint/serialization
         failure used to escape NodeUnprepareResources' `except OSError`
-        and kill the whole multi-claim RPC."""
+        and kill the whole multi-claim RPC. `op`/`hist` name the
+        per-claim trace span and its latency histogram — explicit at the
+        two call sites, so a callback rename can never silently detach
+        tdp_prepare_wall_ms from the prepare path."""
+
         def run_one(claim) -> Optional[str]:
+            # Per-claim child span of the burst fan-out: runs on a pool
+            # worker, so the claim context rides the span's own attrs
+            # (child spans started inside it — the checkpoint flush, the
+            # kubeapi fetch — inherit claim_uid for /debug/flight?claim=)
             try:
-                with self._claim_task() as tsk, self._claim_lock(claim.uid):
+                with trace.span(op, histogram=hist, claim_uid=claim.uid,
+                                namespace=claim.namespace, name=claim.name), \
+                        self._claim_task() as tsk, \
+                        self._claim_lock(claim.uid):
                     fn(claim, tsk)
                 return None
             except Exception as exc:
@@ -1766,7 +1803,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         def prepare_one(claim, task):
             prepared[claim.uid] = self._prepare_claim(claim, task)
 
-        errors = self._run_claim_tasks(claims, prepare_one)
+        with trace.span("dra.NodePrepareResources", claims=len(claims)):
+            errors = self._run_claim_tasks(
+                claims, prepare_one, op="dra.prepare.claim",
+                hist="tdp_prepare_wall_ms")
         for claim, error in zip(claims, errors):
             out = resp.claims[claim.uid]
             if error is not None:
@@ -1778,7 +1818,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     def NodeUnprepareResources(self, request, context):
         resp = drapb.NodeUnprepareResourcesResponse()
         claims = list(request.claims)
-        errors = self._run_claim_tasks(claims, self._unprepare_claim)
+        with trace.span("dra.NodeUnprepareResources", claims=len(claims)):
+            errors = self._run_claim_tasks(
+                claims, self._unprepare_claim, op="dra.unprepare.claim")
         for claim, error in zip(claims, errors):
             out = resp.claims[claim.uid]
             if error is not None:

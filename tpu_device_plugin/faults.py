@@ -75,6 +75,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, Iterator, Optional
 
 from . import lockdep
+from . import trace
 
 log = logging.getLogger(__name__)
 
@@ -214,6 +215,13 @@ def fire(site: str, **ctx: object) -> bool:
         factory = point.exc_factory
     log.warning("fault point FIRED: %s%s", site,
                 f" ({ctx})" if ctx else "")
+    # flight-recorder marker: an injected fault becomes a span event —
+    # fired inside an instrumented span (probe, checkpoint commit, claim
+    # prepare) it inherits that span's attrs, so chaos runs read as
+    # traces, not just counters. Outside the armed path this line is
+    # never reached (fire() returns above on the one-bool fast path).
+    trace.event(f"fault.{site}",
+                **{k: str(v) for k, v in ctx.items()})
     if factory is not None:
         raise factory()
     return True

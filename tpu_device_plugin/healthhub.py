@@ -50,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import faults
 from . import lockdep
+from . import trace
 from .health import InotifyWatcher, _BACK, _GONE
 
 log = logging.getLogger(__name__)
@@ -362,6 +363,8 @@ class HealthHub:
                 log.info(msg, path)
             else:
                 log.warning(msg, path)
+            trace.event("health.fs_transition", device=key,
+                        subscriber=sub.name, healthy=exists)
             self._deliver(sub, key, exists, "fs")
 
     def _report_socket_gone(self, sub: HubSubscription) -> None:
@@ -431,7 +434,9 @@ class HealthHub:
         dead (and counted) instead of stalling the cycle — the next cycle
         re-probes it, so a transiently slow chip self-heals.
         """
-        with self._cycle_lock:
+        with self._cycle_lock, \
+                trace.span("health.probe_cycle",
+                           histogram="tdp_probe_cycle_ms") as cycle_span:
             t0 = time.monotonic()
             with self._lock:
                 subs = [s for s in self._subs
@@ -493,6 +498,9 @@ class HealthHub:
                                 "deadline; scoring dead", bdf,
                                 self.probe_deadline_s)
             wall = time.monotonic() - t0
+            cycle_span.set(probes=len(bdf_map),
+                           deduped=requested - len(bdf_map),
+                           timeouts=timeouts)
             with self._lock:
                 self._probe_cycles += 1
                 self._probes_last_cycle = len(bdf_map)
@@ -520,19 +528,27 @@ class HealthHub:
                    node: Optional[str]) -> bool:
         # fault point "native.probe" (value kind): a fired fault reports
         # the chip dead, exercising the Unhealthy -> recovery path — fires
-        # in the hub so every subscriber sees the same injected verdict
-        try:
-            if faults.fire("native.probe", bdf=bdf):
+        # in the hub so every subscriber sees the same injected verdict.
+        # The per-BDF verdict span carries the bdf, so the fault event
+        # faults.fire emits inherits it on the flight recorder.
+        with trace.span("health.probe", bdf=bdf) as sp:
+            try:
+                if faults.fire("native.probe", bdf=bdf):
+                    sp.set(alive=False, injected=True)
+                    return False
+                alive = bool(probe(bdf, node))
+                sp.set(alive=alive)
+                return alive
+            except Exception as exc:
+                # a raising probe must never kill the worker silently
+                # healthy: score the chip dead and count it
+                # (tdp_probe_errors_total)
+                with self._lock:
+                    self._probe_errors += 1
+                log.error("liveness probe for %s raised (%s); scoring dead",
+                          bdf, exc)
+                sp.set(alive=False, probe_error=str(exc))
                 return False
-            return bool(probe(bdf, node))
-        except Exception as exc:
-            # a raising probe must never kill the worker silently healthy:
-            # score the chip dead and count it (tdp_probe_errors_total)
-            with self._lock:
-                self._probe_errors += 1
-            log.error("liveness probe for %s raised (%s); scoring dead",
-                      bdf, exc)
-            return False
 
     # -------------------------------------------------------------- stats
 

@@ -22,6 +22,7 @@ from urllib.parse import urlsplit
 
 from . import faults
 from . import lockdep
+from . import trace
 from .resilience import BackoffPolicy, CircuitBreaker
 
 log = logging.getLogger(__name__)
@@ -151,31 +152,45 @@ class ApiClient:
 
         Fails fast (without touching the network) while the circuit
         breaker is open; every attempt's outcome feeds the breaker.
+
+        The span (op "kubeapi.request", tdp_kubeapi_rtt_ms) is the
+        daemon's apiserver-RTT observability: started inside a claim
+        span it inherits the claim_uid, so a prepare stalled on a slow
+        ResourceClaim GET is attributable from /debug/flight alone.
         """
         url = self.server + path
+        # breaker fast-fail OUTSIDE the span: an open breaker rejects in
+        # microseconds, and recording those as RTT samples would collapse
+        # tdp_kubeapi_rtt_ms percentiles to ~0 exactly when the apiserver
+        # is down — the opposite of what the histogram exists to show
         if not self.breaker.allow():
             raise ApiError(f"{method} {url}: circuit breaker open "
                            f"(apiserver failing; next probe within "
-                           f"{self.breaker.reset_timeout_s:.0f}s)", code=0)
-        try:
-            # fault point "kubeapi.request" (raising): an armed fault fails
-            # the request before the wire, as a transport error would
-            faults.fire("kubeapi.request", method=method, path=path)
-            data = self._request_once(path, method, body, content_type, url)
-        except ApiError as exc:
-            if exc.code == 0 or exc.code >= 500:
+                           f"{self.breaker.reset_timeout_s:.0f}s)",
+                           code=0)
+        with trace.span("kubeapi.request", histogram="tdp_kubeapi_rtt_ms",
+                        method=method, path=path):
+            try:
+                # fault point "kubeapi.request" (raising): an armed fault
+                # fails the request before the wire, as a transport error
+                # would
+                faults.fire("kubeapi.request", method=method, path=path)
+                data = self._request_once(path, method, body, content_type,
+                                          url)
+            except ApiError as exc:
+                if exc.code == 0 or exc.code >= 500:
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()  # 3xx/4xx: alive
+                raise
+            except Exception as exc:
+                # injected fault of a non-ApiError kind: surface it under
+                # the client's one exception contract
                 self.breaker.record_failure()
-            else:
-                self.breaker.record_success()  # 3xx/4xx: server is alive
-            raise
-        except Exception as exc:
-            # injected fault of a non-ApiError kind: surface it under the
-            # client's one exception contract
-            self.breaker.record_failure()
-            raise ApiError(f"{method} {url}: {exc}") from exc
-        self.breaker.record_success()
-        self._stale_backoff.reset()
-        return data
+                raise ApiError(f"{method} {url}: {exc}") from exc
+            self.breaker.record_success()
+            self._stale_backoff.reset()
+            return data
 
     def _request_once(self, path: str, method: str, body: Optional[bytes],
                       content_type: Optional[str], url: str) -> bytes:

@@ -15,7 +15,6 @@ when the host inventory changes.
 
 from __future__ import annotations
 
-import logging
 import os
 import threading
 import time
@@ -23,7 +22,9 @@ from concurrent import futures
 from typing import List, Optional
 
 from . import lockdep
+from . import trace
 from .config import Config
+from .log import get_logger
 from .discovery import HostSnapshot, discover, read_serial
 from .healthhub import HealthHub, HubSubscription
 from .lifecycle_fsm import DeviceLifecycle
@@ -41,7 +42,7 @@ from .vtpu import VtpuDevicePlugin
 # kubelet's Registration socket
 START_WORKERS = 8
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 class PluginManager:
@@ -90,6 +91,11 @@ class PluginManager:
         # takes locks the interrupted main thread may hold); the run loop
         # applies it on the next tick
         self._drain_request: Optional[bool] = None
+        # flight-recorder dump request (SIGHUP): flag-set only, like the
+        # drain request — trace.dump() logs and writes a file, and doing
+        # either from a signal handler can hit a reentrant-stream
+        # RuntimeError if the interrupt lands mid-write on this thread
+        self._dump_request = False
         self.running = threading.Event()  # run() loop is alive (liveness)
         self._shim = TpuHealth(cfg.native_lib_path)
         # The host-level shared health plane: ONE inotify fd, ONE existence
@@ -397,6 +403,8 @@ class PluginManager:
         self._sync_lifecycle(registry)
         if new_sigs == self._sigs:
             return
+        trace.event("lifecycle.inventory_changed",
+                    resources=len(new_sigs))
         # only a RUNNING plugin may survive on an unchanged signature; a
         # pending one is torn down and rebuilt fresh so it is never lost
         running_keys = {self._plugin_key(p) for p in self.plugins
@@ -493,6 +501,12 @@ class PluginManager:
         loop performs the actual (lock-taking) drain on its next tick."""
         self._drain_request = draining
 
+    def request_flight_dump(self) -> None:
+        """Async-signal-safe flight-recorder dump request (SIGHUP); the
+        run loop performs the actual dump (logging + file I/O) on its
+        next tick, within ~1s."""
+        self._dump_request = True
+
     def drain(self, draining: bool) -> None:
         """Administratively mark every device (un)healthy for maintenance.
 
@@ -554,6 +568,8 @@ class PluginManager:
                     if self._drain_request is not None \
                             and self._drain_request != self.draining:
                         break
+                    if self._dump_request:
+                        break   # dump within ~1s, not a rediscovery tick
                 if stopped:
                     break
                 if self.pending:
@@ -561,6 +577,9 @@ class PluginManager:
                 if self._drain_request is not None \
                         and self._drain_request != self.draining:
                     self.drain(self._drain_request)
+                if self._dump_request:
+                    self._dump_request = False
+                    trace.dump("SIGHUP")
                 if self.on_inventory is not None \
                         and not self._inventory_published \
                         and self._last_inventory is not None \

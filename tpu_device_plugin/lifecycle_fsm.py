@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from . import faults
 from . import lockdep
+from . import trace
 
 log = logging.getLogger(__name__)
 
@@ -205,6 +206,11 @@ class DeviceLifecycle:
         self.transition_counts[key] = self.transition_counts.get(key, 0) + 1
         rec.state = to
         rec.since = time.time()
+        # flight-recorder marker (lock-free event; emitting under the FSM
+        # lock costs readers nothing): every device's state history is
+        # reconstructable from /debug/flight?bdf=<raw>
+        trace.event("lifecycle.transition", device=rec.raw,
+                    **{"from": frm, "to": to})
         log.info("lifecycle: %s %s -> %s", rec.raw, frm, to)
         return True
 
@@ -245,6 +251,11 @@ class DeviceLifecycle:
         orphans = sorted(rec.claims)
         rec.claims.clear()          # orphaned claims never reattach
         self.claims_orphaned_total += len(orphans)
+        for uid in orphans:
+            # one event PER CLAIM so /debug/flight?claim= ends the
+            # claim's story with its surprise removal
+            trace.event("lifecycle.claim.orphaned", claim_uid=uid,
+                        device=rec.raw)
         self._surprise_removals.append({
             "device": rec.raw,
             "claims": orphans,
@@ -271,6 +282,8 @@ class DeviceLifecycle:
         if not swapped and rec.serial is not None and serial is not None \
                 and serial != rec.serial:
             swapped = True
+        trace.event("lifecycle.replug", device=rec.raw,
+                    identity_swap=swapped)
         if swapped:
             self.identity_swaps_total += 1
             log.warning(
@@ -411,6 +424,9 @@ class DeviceLifecycle:
                     continue
                 uids = sorted(self._pending_claims.pop(raw))
                 self.claims_orphaned_total += len(uids)
+                for uid in uids:
+                    trace.event("lifecycle.claim.orphaned", claim_uid=uid,
+                                device=raw)
                 self._surprise_removals.append(
                     {"device": raw, "claims": uids, "at": time.time()})
                 log.error("lifecycle: device %s (with restored claim(s) "

@@ -1,0 +1,112 @@
+"""log — structured logging that correlates with the trace plane.
+
+One module owns the daemon's log output shape so every line can be
+machine-joined with the flight recorder (trace.py): the formatters ask
+the trace plane for the ACTIVE SPAN's attributes on the emitting thread
+and append them to every record — a log line emitted inside
+``trace.span("dra.prepare.claim", claim_uid=uid)`` carries
+``claim_uid=...`` without the call site threading context through its
+arguments. Two formats, selected once at startup (cli.build_config):
+
+- default: ``<ts> <LEVEL> <logger>: <message> key=value ...`` —
+  the key=value tail is the span context (claim_uid, bdf, resource,
+  epoch_id, ...), values quoted only when they contain spaces;
+- ``$TDP_LOG_JSON=1`` (or ``--log-json``): one JSON object per line
+  with the span context under ``"ctx"`` — fleet log pipelines join
+  ``ctx.claim_uid`` against ``/debug/flight?claim=`` directly.
+
+Modules obtain loggers via ``get_logger(__name__)`` (a plain stdlib
+logger — the structure lives in the formatter, so third-party/library
+records get the same treatment) and tests that capture with caplog see
+unformatted records exactly as before.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict
+
+__all__ = ["configure", "get_logger", "KeyValueFormatter", "JsonFormatter"]
+
+
+def get_logger(name: str) -> logging.Logger:
+    """The project's logger accessor: a stdlib logger today, but the one
+    seam a future adapter (rate limiting, per-module levels) plugs into
+    without touching every module again."""
+    return logging.getLogger(name)
+
+
+def _span_context() -> Dict[str, Any]:
+    """The active span's attributes on THIS thread (empty when no span is
+    open or tracing is disabled). Imported lazily so the logging module
+    never participates in an import cycle with trace/epoch."""
+    from . import trace
+    stack = trace._tls.stack
+    if not stack:
+        return {}
+    return stack[-1].attrs
+
+
+def _kv(value: Any) -> str:
+    text = str(value)
+    if not text or any(c in text for c in ' "=\n'):
+        return json.dumps(text)
+    return text
+
+
+class KeyValueFormatter(logging.Formatter):
+    """``<ts> <LEVEL> <logger>: <msg> key=value ...`` with the active
+    span's context appended."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = (f"{self.formatTime(record)} {record.levelname} "
+                f"{record.name}: {record.getMessage()}")
+        ctx = _span_context()
+        if ctx:
+            base += " " + " ".join(
+                f"{k}={_kv(v)}" for k, v in sorted(ctx.items()))
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; span context under "ctx"."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry: Dict[str, Any] = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        ctx = _span_context()
+        if ctx:
+            entry["ctx"] = {k: str(v) for k, v in ctx.items()}
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        return json.dumps(entry, sort_keys=True)
+
+
+_installed_handler: "logging.Handler | None" = None
+
+
+def configure(level: int = logging.INFO, json_mode: bool = False) -> None:
+    """Install the structured handler on the root logger (cli.main).
+
+    basicConfig semantics, deliberately: if the root logger already has
+    FOREIGN handlers (pytest's caplog capture, an embedding app), they
+    are left untouched — ripping them out would silently break the
+    host's capture. Our own handler (tracked) is installed once and
+    reconfigured on repeat calls; the level is always applied."""
+    global _installed_handler
+    root = logging.getLogger()
+    formatter = JsonFormatter() if json_mode else KeyValueFormatter()
+    if _installed_handler is not None and _installed_handler in root.handlers:
+        _installed_handler.setFormatter(formatter)
+    elif not root.handlers:
+        _installed_handler = logging.StreamHandler()
+        _installed_handler.setFormatter(formatter)
+        root.addHandler(_installed_handler)
+    root.setLevel(level)

@@ -18,7 +18,6 @@ the kubelet wipes its socket dir. Differences by design:
 
 from __future__ import annotations
 
-import logging
 import math
 import os
 import threading
@@ -35,7 +34,9 @@ from . import epoch as epoch_mod
 from . import faults
 from . import kubeletapi as api
 from . import lockdep
+from . import trace
 from .config import Config
+from .log import get_logger
 from .healthhub import HealthHub, HubSubscription
 from .kubeletapi import pb
 from .native import TpuHealth, link_is_degraded
@@ -43,7 +44,7 @@ from .registry import Registry, TpuDevice
 from .resilience import BackoffPolicy
 from .topology import AllocatableDevice, AllocationIndex, MustIncludeTooLarge
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 # GetPreferredAllocation memo capacity (see _pref_cache): the memo is a
 # per-epoch plain dict (swapped wholesale on every epoch publish, so
@@ -570,7 +571,11 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         old _snapshot serialize/deserialize-per-device under the device-
         table condition). The lockdep read-path gate pins this at zero
         registered-lock acquisitions."""
-        with lockdep.read_path("server.ListAndWatch.assembly"):
+        with lockdep.read_path("server.ListAndWatch.assembly"), \
+                trace.span("server.ListAndWatch.send",
+                           resource=self.resource_name,
+                           epoch_id=ep.epoch_id,
+                           devices=len(ep.device_health)):
             return pb.ListAndWatchResponse.FromString(ep.lw_payload)
 
     def ListAndWatch(self, request, context):
@@ -628,7 +633,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             yield self._lw_response(ep)
 
     def GetPreferredAllocation(self, request, context):
-        with lockdep.read_path("server.GetPreferredAllocation"):
+        # span INSIDE the read-path bracket: the zero-lock gate
+        # (tests/test_epoch.py) counts the tracing plane's acquisitions
+        # too, so instrumentation can never silently re-lock the path
+        with lockdep.read_path("server.GetPreferredAllocation"), \
+                trace.span("server.GetPreferredAllocation",
+                           resource=self.resource_name,
+                           epoch_id=self._store.current.epoch_id):
             resp = pb.PreferredAllocationResponse()
             index = self._alloc_index
             # The ICI sub-box scan is pure in (availability, must-include,
@@ -672,7 +683,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         Failed allocations abort inside the impl and are never recorded."""
         ids = [list(c.devices_ids) for c in request.container_requests]
         log.info("%s: Allocate(%s)", self.resource_name, ids)
-        with lockdep.read_path("server.Allocate"):
+        with lockdep.read_path("server.Allocate"), \
+                trace.span("server.Allocate",
+                           histogram="tdp_attach_wall_ms",
+                           resource=self.resource_name,
+                           epoch_id=self._store.current.epoch_id,
+                           devices=sum(len(i) for i in ids)):
             resp = self._allocate_impl(request, context)
             self.record_allocation(ids)
         return resp

@@ -14,9 +14,20 @@ dependency-free endpoint for liveness probes and debugging:
                    allocations, pending (not-yet-registered) plugins,
                    native-shim facts, draining flag
   GET /metrics  -> Prometheus text format: device health gauges, serving
-                   flags, restart counters, pending count, native-shim facts
+                   flags, restart counters, pending count, native-shim
+                   facts, flight-recorder latency histograms (trace.py)
+  GET /debug/flight -> the flight recorder (trace.py): the merged span
+                   ring as time-ordered JSON, filterable by
+                   ?claim=<uid> / ?bdf=<raw id> / ?op=<prefix> /
+                   ?limit=<n>, plus the slow-span log — the "what
+                   happened to claim X" surface (docs/observability.md)
 
 Disabled by default (--status-port 0).
+
+The /metrics exposition follows the Prometheus text format strictly:
+every series carries # HELP and # TYPE lines and label values are
+escaped per the spec (tests/test_metrics_format.py parses the full
+scrape with a line grammar).
 """
 
 from __future__ import annotations
@@ -25,8 +36,16 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 log = logging.getLogger(__name__)
+
+
+def _esc(value) -> str:
+    """Escape a Prometheus label VALUE per the text-format spec
+    (backslash, double-quote, newline)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class StatusServer:
@@ -49,22 +68,50 @@ class StatusServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                parts = urlsplit(self.path)
+                route = parts.path
+                if route == "/healthz":
                     if outer.alive():
                         self._send(200, b"ok", "text/plain")
                     else:
                         self._send(503, b"manager loop not running", "text/plain")
-                elif self.path == "/readyz":
+                elif route == "/readyz":
                     if outer.ready():
                         self._send(200, b"ok", "text/plain")
                     else:
                         self._send(503, b"no plugins serving", "text/plain")
-                elif self.path == "/status":
+                elif route == "/status":
                     self._send(200, json.dumps(outer.status(),
                                                sort_keys=True).encode())
-                elif self.path == "/metrics":
+                elif route == "/metrics":
                     self._send(200, outer.metrics().encode(),
                                "text/plain; version=0.0.4")
+                elif route == "/debug/flight":
+                    # keep_blank_values: "?claim=" with an empty value
+                    # (a typo'd $UID in an incident script) must NOT
+                    # silently degrade to the whole unfiltered ring —
+                    # no claim/bdf/op is the empty string, so reject it
+                    query = parse_qs(parts.query, keep_blank_values=True)
+
+                    def first(key):
+                        values = query.get(key)
+                        return values[0] if values else None
+
+                    for key in ("claim", "bdf", "op"):
+                        if first(key) == "":
+                            return self._send(
+                                400, f"empty {key} filter".encode(),
+                                "text/plain")
+                    limit = first("limit")
+                    try:
+                        limit = int(limit) if limit is not None else None
+                    except ValueError:
+                        return self._send(400, b"limit must be an integer",
+                                          "text/plain")
+                    self._send(200, json.dumps(outer.flight(
+                        claim=first("claim"), bdf=first("bdf"),
+                        op=first("op"), limit=limit),
+                        sort_keys=True).encode())
                 else:
                     self._send(404, b"not found", "text/plain")
 
@@ -98,6 +145,22 @@ class StatusServer:
         from . import lockdep
         with lockdep.read_path("status.endpoint"):
             return self._status_impl()
+
+    def flight(self, claim=None, bdf=None, op=None, limit=None) -> dict:
+        """The /debug/flight body: merged span ring (time-ordered,
+        filtered), the slow-span log, and the recorder's own stats.
+        Entirely lock-free (trace.snapshot merges C-atomic ring copies) —
+        draining the flight recorder during an incident can never stall
+        the paths being debugged."""
+        from . import trace
+        return {
+            "filters": {"claim": claim, "bdf": bdf, "op": op,
+                        "limit": limit},
+            "spans": trace.snapshot(claim=claim, bdf=bdf, op=op,
+                                    limit=limit),
+            "slow": trace.slow_spans(),
+            "stats": trace.stats(),
+        }
 
     def _status_impl(self) -> dict:
         from . import faults
@@ -134,6 +197,10 @@ class StatusServer:
         armed = faults.armed_sites()
         if fault_stats or armed:
             out["faults"] = {"armed": armed, "fired": fault_stats}
+        # flight-recorder gauges (trace.py): ring occupancy/overwrites,
+        # slow-span pressure — lock-free reads like everything else here
+        from . import trace
+        out["trace"] = trace.stats()
         # hot-read-path lock accounting (lockdep.read_path): only present
         # under TDP_LOCKDEP=1 — steady-state acquisitions pinned at 0 by
         # the read-path gate (tests/test_epoch.py)
@@ -181,32 +248,32 @@ class StatusServer:
                 counts[health] = counts.get(health, 0) + 1
             for health, n in sorted(counts.items()):
                 lines.append(
-                    f'tpu_plugin_devices{{resource="{p["resource"]}",'
+                    f'tpu_plugin_devices{{resource="{_esc(p["resource"])}",'
                     f'health="{health}"}} {n}')
         lines += ["# HELP tpu_plugin_serving Plugin serving state (1=serving).",
                   "# TYPE tpu_plugin_serving gauge"]
         for p in s["plugins"]:
-            lines.append(f'tpu_plugin_serving{{resource="{p["resource"]}"}} '
+            lines.append(f'tpu_plugin_serving{{resource="{_esc(p["resource"])}"}} '
                          f'{int(p["serving"])}')
         lines += ["# HELP tpu_plugin_degraded_links Chips whose PCIe link "
                   "trained below its maximum (diagnostic).",
                   "# TYPE tpu_plugin_degraded_links gauge"]
         for p in s["plugins"]:
             lines.append(
-                f'tpu_plugin_degraded_links{{resource="{p["resource"]}"}} '
+                f'tpu_plugin_degraded_links{{resource="{_esc(p["resource"])}"}} '
                 f'{len(p.get("degraded_links", {}))}')
         lines += ["# HELP tpu_plugin_epoch Read-plane epoch generation "
                   "(epoch.EpochStore): bumps on every effective health "
                   "transition or device-table rebuild.",
                   "# TYPE tpu_plugin_epoch gauge"]
         for p in s["plugins"]:
-            lines.append(f'tpu_plugin_epoch{{resource="{p["resource"]}"}} '
+            lines.append(f'tpu_plugin_epoch{{resource="{_esc(p["resource"])}"}} '
                          f'{p.get("epoch", 0)}')
         lines += ["# HELP tpu_plugin_restarts_total Socket-loss restarts.",
                   "# TYPE tpu_plugin_restarts_total counter"]
         for p in s["plugins"]:
             lines.append(
-                f'tpu_plugin_restarts_total{{resource="{p["resource"]}"}} '
+                f'tpu_plugin_restarts_total{{resource="{_esc(p["resource"])}"}} '
                 f'{p["restarts"]}')
         lines += ["# HELP tpu_plugin_restart_retries_total Backoff delays "
                   "issued while re-registering after socket loss.",
@@ -215,13 +282,13 @@ class StatusServer:
             retries = p.get("restart_backoff", {}).get("total_attempts", 0)
             lines.append(
                 f'tpu_plugin_restart_retries_total'
-                f'{{resource="{p["resource"]}"}} {retries}')
+                f'{{resource="{_esc(p["resource"])}"}} {retries}')
         lines += ["# HELP tpu_plugin_allocations_total Successful Allocate "
                   "RPCs since plugin start.",
                   "# TYPE tpu_plugin_allocations_total counter"]
         for p in s["plugins"]:
             lines.append(
-                f'tpu_plugin_allocations_total{{resource="{p["resource"]}"}} '
+                f'tpu_plugin_allocations_total{{resource="{_esc(p["resource"])}"}} '
                 f'{p["allocations_total"]}')
         lines += ["# HELP tpu_plugin_pref_cache_total GetPreferredAllocation "
                   "LRU memo lookups by outcome.",
@@ -231,14 +298,14 @@ class StatusServer:
             for outcome, key in (("hit", "hits"), ("miss", "misses")):
                 lines.append(
                     f'tpu_plugin_pref_cache_total{{resource='
-                    f'"{p["resource"]}",outcome="{outcome}"}} '
+                    f'"{_esc(p["resource"])}",outcome="{outcome}"}} '
                     f'{cache.get(key, 0)}')
         lines += ["# HELP tpu_plugin_lw_resends_total ListAndWatch re-sends "
                   "after debounce coalescing (initial snapshots excluded).",
                   "# TYPE tpu_plugin_lw_resends_total counter"]
         for p in s["plugins"]:
             lines.append(
-                f'tpu_plugin_lw_resends_total{{resource="{p["resource"]}"}} '
+                f'tpu_plugin_lw_resends_total{{resource="{_esc(p["resource"])}"}} '
                 f'{p.get("lw_resends", 0)}')
         lines += ["# HELP tpu_plugin_alloc_fragment_total Precompiled "
                   "per-IOMMU-group Allocate fragment lookups by outcome.",
@@ -248,7 +315,7 @@ class StatusServer:
             for outcome, key in (("hit", "hits"), ("miss", "misses")):
                 lines.append(
                     f'tpu_plugin_alloc_fragment_total{{resource='
-                    f'"{p["resource"]}",outcome="{outcome}"}} '
+                    f'"{_esc(p["resource"])}",outcome="{outcome}"}} '
                     f'{frags.get(key, 0)}')
         disc = s.get("discovery")
         if disc:
@@ -302,6 +369,22 @@ class StatusServer:
                 "killing the health plane.",
                 "# TYPE tdp_probe_errors_total counter",
                 f"tdp_probe_errors_total {health['probe_errors_total']}",
+                "# HELP tpu_plugin_health_probes_last_cycle Unique BDFs "
+                "probed by the most recent cycle (after dedup).",
+                "# TYPE tpu_plugin_health_probes_last_cycle gauge",
+                f"tpu_plugin_health_probes_last_cycle "
+                f"{health['probes_last_cycle']}",
+                "# HELP tpu_plugin_health_probes_deduped_last_cycle "
+                "Probe requests collapsed by the per-BDF dedup in the "
+                "most recent cycle.",
+                "# TYPE tpu_plugin_health_probes_deduped_last_cycle gauge",
+                f"tpu_plugin_health_probes_deduped_last_cycle "
+                f"{health['probes_deduped_last_cycle']}",
+                "# HELP tpu_plugin_health_existence_scans_total Periodic "
+                "existence-reconciler passes run by the hub.",
+                "# TYPE tpu_plugin_health_existence_scans_total counter",
+                f"tpu_plugin_health_existence_scans_total "
+                f"{health['existence_scans_total']}",
             ]
         lifecycle = s.get("lifecycle")
         if lifecycle:
@@ -314,8 +397,8 @@ class StatusServer:
             for key, n in sorted(lifecycle.get("transitions", {}).items()):
                 frm, _, to = key.partition("->")
                 lines.append(
-                    f'lifecycle_transitions_total{{from="{frm}",'
-                    f'to="{to}"}} {n}')
+                    f'lifecycle_transitions_total{{from="{_esc(frm)}",'
+                    f'to="{_esc(to)}"}} {n}')
             lines += [
                 "# HELP claims_orphaned_total Prepared claims orphaned by "
                 "PCIe surprise removal of their device.",
@@ -328,13 +411,20 @@ class StatusServer:
                 "# TYPE tpu_plugin_lifecycle_identity_swaps_total counter",
                 f"tpu_plugin_lifecycle_identity_swaps_total "
                 f"{lifecycle.get('identity_swaps_total', 0)}",
+                "# HELP tpu_plugin_lifecycle_invalid_transitions_total "
+                "Lifecycle FSM transitions refused by the allowed-"
+                "transition table (counted, never raised).",
+                "# TYPE tpu_plugin_lifecycle_invalid_transitions_total "
+                "counter",
+                f"tpu_plugin_lifecycle_invalid_transitions_total "
+                f"{lifecycle.get('invalid_transitions_total', 0)}",
                 "# HELP tpu_plugin_lifecycle_devices Devices by lifecycle "
                 "state.",
                 "# TYPE tpu_plugin_lifecycle_devices gauge",
             ]
             for state, n in sorted(lifecycle.get("states", {}).items()):
                 lines.append(
-                    f'tpu_plugin_lifecycle_devices{{state="{state}"}} {n}')
+                    f'tpu_plugin_lifecycle_devices{{state="{_esc(state)}"}} {n}')
         read_paths = s.get("read_paths")
         if read_paths:
             lines += [
@@ -346,14 +436,14 @@ class StatusServer:
             for name, rec in sorted(read_paths.items()):
                 lines.append(
                     f'tdp_read_path_lock_acquisitions_total'
-                    f'{{path="{name}"}} {rec["lock_acquisitions"]}')
+                    f'{{path="{_esc(name)}"}} {rec["lock_acquisitions"]}')
             lines += [
                 "# HELP tdp_read_path_calls_total Entries into each hot "
                 "read path bracket.",
                 "# TYPE tdp_read_path_calls_total counter",
             ]
             for name, rec in sorted(read_paths.items()):
-                lines.append(f'tdp_read_path_calls_total{{path="{name}"}} '
+                lines.append(f'tdp_read_path_calls_total{{path="{_esc(name)}"}} '
                              f'{rec["calls"]}')
         lines += [
             "# HELP tpu_plugin_pending_plugins Plugins awaiting registration.",
@@ -401,6 +491,12 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_prepare_inflight gauge",
                 f"tpu_plugin_dra_prepare_inflight "
                 f"{s['dra']['prepare_inflight']}",
+                "# HELP tpu_plugin_dra_attach_active Claim tasks still "
+                "before their checkpoint durability barrier (the group-"
+                "commit window's input).",
+                "# TYPE tpu_plugin_dra_attach_active gauge",
+                f"tpu_plugin_dra_attach_active "
+                f"{s['dra']['attach_active']}",
                 "# HELP tpu_plugin_dra_prepare_workers Bounded pool size "
                 "fanning out multi-claim prepare RPCs.",
                 "# TYPE tpu_plugin_dra_prepare_workers gauge",
@@ -453,5 +549,26 @@ class StatusServer:
                     "# TYPE tpu_plugin_kubeapi_breaker_trips_total counter",
                     f"tpu_plugin_kubeapi_breaker_trips_total "
                     f"{breaker['trips']}",
+                    "# HELP tpu_plugin_kubeapi_breaker_rejected_total "
+                    "Requests failed fast while the breaker was open.",
+                    "# TYPE tpu_plugin_kubeapi_breaker_rejected_total "
+                    "counter",
+                    f"tpu_plugin_kubeapi_breaker_rejected_total "
+                    f"{breaker['rejected']}",
                 ]
+        fired = (s.get("faults") or {}).get("fired") or {}
+        if fired:
+            lines += [
+                "# HELP tdp_fault_fires_total Injected-fault fires by "
+                "site (faults.py; chaos runs only — absent when no "
+                "fault ever fired).",
+                "# TYPE tdp_fault_fires_total counter",
+            ]
+            for site, n in sorted(fired.items()):
+                lines.append(f'tdp_fault_fires_total{{site="{_esc(site)}"}} '
+                             f'{n}')
+        # flight-recorder exposition (trace.py): latency histograms
+        # (_bucket/_sum/_count families) + the trace-plane counters
+        from . import trace
+        lines += trace.render_prometheus()
         return "\n".join(lines) + "\n"

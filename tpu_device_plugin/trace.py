@@ -1,0 +1,669 @@
+"""trace — the lock-free flight recorder: spans, histograms, slow-span log.
+
+The daemon's counters (/status, /metrics) answer "how many" and "how
+fast on average"; they cannot answer the two questions a fleet operator
+actually asks during a boot storm: *why was THIS attach slow?* and *what
+happened to claim X in the 30 s before it was orphaned?* This module is
+the always-on introspection plane for those questions, built under the
+same constraint PR 6 put on every other read path: ZERO registered
+locks. `tests/test_epoch.py` pins Allocate/GetPreferredAllocation/
+ListAndWatch//status at 0 registered-lock acquisitions, and the spans
+now bracketed INSIDE those paths are counted by the same gate — the
+tracing plane cannot regress the zero-lock contract without failing CI.
+
+Three surfaces, one sharded-cells design (epoch.AtomicCounter's trick):
+
+- **Spans** — ``with trace.span("dra.prepare.claim", claim_uid=uid):``
+  records monotonic start/end, outcome (ok/error + the error text), and
+  attributes (claim_uid, bdf, resource, epoch_id, ...) into a PER-THREAD
+  ring buffer. Child spans inherit the parent's attributes, so a
+  checkpoint-flush span started inside a claim span carries the claim
+  UID without replumbing. The writer side is the owning thread only:
+  the completed record is built as one immutable dict and stored with a
+  single C-atomic list-slot assignment, so a concurrent snapshot reader
+  can never observe a torn span. ``event()`` records a point-in-time
+  record the same way (fault injections, lifecycle transitions).
+- **Histograms** — fixed exponential-bucket latency histograms
+  (attach wall, claim prepare wall, checkpoint commit, probe cycle,
+  kubeapi RTT) with per-thread cells summed at read; exposed in
+  Prometheus text format (``_bucket``/``_sum``/``_count``) on /metrics.
+- **Flight recorder** — ``snapshot()`` merges every thread's ring into
+  one time-ordered list (optionally filtered by claim/bdf/op); the
+  status server serves it as ``/debug/flight``. Spans exceeding a
+  per-op threshold (``$TDP_TRACE_SLOW_MS`` overrides the default) are
+  additionally kept in a bounded slow-span log and emitted through the
+  structured logger with their full attribute context. ``dump()``
+  writes the whole ring to a JSON file; ``install_crash_hook()`` wires
+  that into sys/threading excepthooks, and cli.py binds an on-demand
+  dump to SIGHUP — the post-incident artifact for orphaned claims and
+  identity swaps.
+
+Concurrency contract (CPython, same vocabulary as epoch.py): ring slots
+are written ONLY by their owning thread; ``list(buf)`` and
+``_rings.append`` are C-level atomic; records are immutable once
+stored. Readers therefore see each record exactly once per snapshot,
+fully formed, at worst missing the very newest writes. Zero registered
+locks on every write AND read path — tsalint has a fixture proving a
+span inside an epoch read path trips no rule, and the trace counters
+are epoch.AtomicCounter (lock-free by design, no owning lock to
+configure in tools/tsalint/config.py COUNTERS).
+
+Overhead: a span is two monotonic reads, two dict builds and one list
+store (~2-4 us in this sandbox); ``bench.py --trace-overhead`` measures
+it on the live attach path and docs/bench_attach_r10.json pins the
+bound (guarded by tests/test_perf_honesty.py). ``$TDP_TRACE=0``
+disables recording entirely (spans become a cached no-op context).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from bisect import bisect_right
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .epoch import AtomicCounter
+
+log = logging.getLogger(__name__)
+
+__all__ = ["span", "event", "snapshot", "slow_spans", "stats", "dump",
+           "install_crash_hook", "uninstall_crash_hook", "configure",
+           "reset", "histogram", "observe", "render_prometheus",
+           "Histogram", "enabled"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# per-thread ring capacity: 256 spans x ~a dozen threads keeps the last
+# ~30 s of a busy daemon's story in a few hundred KB
+_ring_size = _env_int("TDP_TRACE_RING", 256)
+_enabled = os.environ.get("TDP_TRACE", "1").strip().lower() not in (
+    "0", "false", "no", "off")
+# global slow-span threshold; per-op overrides below win
+_slow_default_ms = _env_float("TDP_TRACE_SLOW_MS", 250.0)
+# Per-op slow thresholds (ms): the ops where "slow" means something much
+# tighter than the global default. Overridable at runtime via configure().
+SLOW_THRESHOLDS_MS: Dict[str, float] = {
+    # the attach hot path: double-digit ms here is an incident
+    "server.Allocate": 50.0,
+    "server.GetPreferredAllocation": 50.0,
+    "server.ListAndWatch.send": 50.0,
+}
+# how many slow spans the bounded log retains for /debug/flight
+_SLOW_RING = 64
+
+
+class _Ring:
+    """One thread's span ring. `buf` slots are written only by the owner
+    thread (single C-atomic store of an immutable record); `idx` is the
+    owner's monotonically growing write cursor, so `max(0, idx - size)`
+    is the exact overwrite count. `owner` is the owning Thread object —
+    `_retire_dead_rings` uses it to bound how many dead threads' rings
+    are retained."""
+
+    __slots__ = ("buf", "idx", "thread", "owner")
+
+    def __init__(self, size: int, thread: str) -> None:
+        self.buf: List[Optional[dict]] = [None] * size
+        self.idx = 0
+        self.thread = thread
+        self.owner = threading.current_thread()
+
+    def store(self, rec: dict) -> None:
+        self.buf[self.idx % len(self.buf)] = rec   # C-atomic slot store
+        self.idx += 1                              # owner thread only
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.ring: Optional[_Ring] = None
+        self.gen = -1
+        self.stack: List["_Span"] = []
+        self.seq = 0
+
+
+_tls = _TLS()
+# every live ring, appended C-atomically on a thread's first record; the
+# generation counter lets reset() retire all rings without a lock (a
+# thread whose cached ring predates the bump re-registers a fresh one)
+_rings: List[_Ring] = []
+_gen = 0
+# DEAD-thread rings retained for post-mortem reading: short-lived threads
+# (the idle-exiting checkpoint writer, restart runners, start-pool
+# workers) would otherwise accrete one ring per incarnation forever.
+# The newest _DEAD_RING_KEEP dead rings stay readable (a crashed thread's
+# last spans are exactly what the flight recorder is for); older ones are
+# dropped at ring-registration time — a cold path, guarded by a plain
+# (UNregistered — invisible to the zero-lock gates, never taken on a
+# record/snapshot path) maintenance lock so two registering threads
+# cannot double-retire.
+_DEAD_RING_KEEP = 16
+_maintenance_lock = threading.Lock()
+# records made unreadable by ring retirement (mutated only under the
+# maintenance lock; read GIL-atomically by stats) — keeps the exposed
+# spans_overwritten_total monotonic across retirements
+_retired_lost = 0
+_slow: deque = deque(maxlen=_SLOW_RING)
+_spans_total = AtomicCounter()
+_events_total = AtomicCounter()
+_slow_total = AtomicCounter()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(enabled: Optional[bool] = None,
+              ring_size: Optional[int] = None,
+              slow_ms: Optional[float] = None) -> None:
+    """Runtime knobs (tests, bench): toggle recording, resize FUTURE
+    rings (existing rings keep their size), or move the global slow
+    threshold."""
+    global _enabled, _ring_size, _slow_default_ms
+    if enabled is not None:
+        _enabled = bool(enabled)
+    if ring_size is not None:
+        if ring_size < 1:
+            raise ValueError(f"ring_size must be >= 1, got {ring_size!r}")
+        _ring_size = int(ring_size)
+    if slow_ms is not None:
+        _slow_default_ms = float(slow_ms)
+
+
+def reset() -> None:
+    """Retire every ring, the slow log and the counters (test isolation).
+    The generation bump makes every thread's cached ring stale, so the
+    next record lands in a fresh ring registered under the new
+    generation."""
+    global _rings, _gen, _spans_total, _events_total, _slow_total, \
+        _retired_lost
+    _gen += 1
+    _rings = []
+    _slow.clear()
+    _spans_total = AtomicCounter()
+    _events_total = AtomicCounter()
+    _slow_total = AtomicCounter()
+    with _maintenance_lock:
+        _retired_lost = 0
+    for hist in _histograms.values():
+        hist._reset()
+
+
+def _retire_dead_rings() -> None:
+    """Drop all but the newest _DEAD_RING_KEEP dead-owner rings (called
+    on the rare ring-registration path; readers snapshot `list(_rings)`
+    so concurrent removal is safe for them). The retired rings' records
+    are charged to the overwritten counter — they became unreadable
+    before any reader drained them."""
+    global _retired_lost
+    with _maintenance_lock:
+        dead = [r for r in list(_rings) if not r.owner.is_alive()]
+        for ring in dead[:max(0, len(dead) - _DEAD_RING_KEEP)]:
+            try:
+                _rings.remove(ring)
+            except ValueError:
+                continue
+            _retired_lost += ring.idx
+
+
+def _ring() -> _Ring:
+    tls = _tls
+    if tls.ring is None or tls.gen != _gen:
+        tls.ring = _Ring(_ring_size, threading.current_thread().name)
+        tls.gen = _gen
+        _rings.append(tls.ring)     # C-atomic list append
+        _retire_dead_rings()
+    return tls.ring
+
+
+def _next_seq() -> int:
+    _tls.seq += 1
+    return _tls.seq
+
+
+class _NullSpan:
+    """Cached no-op context for $TDP_TRACE=0: one call + two no-op
+    dunders, mirroring lockdep's disabled read_path cost."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+    def set(self, **attrs: Any) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One active span on its owning thread. The record is built and
+    stored at __exit__ — in-flight spans are not visible to snapshots
+    (the flight recorder records completed work)."""
+
+    __slots__ = ("op", "attrs", "histogram", "t0", "ts", "seq", "parent")
+
+    def __init__(self, op: str, histogram: Optional[str],
+                 attrs: Dict[str, Any]) -> None:
+        self.op = op
+        self.histogram = histogram
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.ts = 0.0
+        self.seq = 0
+        self.parent: Optional[int] = None
+
+    def set(self, **attrs: Any) -> None:
+        """Attach attributes discovered mid-span (e.g. a probe verdict)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        stack = _tls.stack
+        if stack:
+            parent = stack[-1]
+            self.parent = parent.seq
+            # inheritance: a child born inside a claim/bdf-scoped span
+            # carries that context without replumbing call signatures
+            merged = dict(parent.attrs)
+            merged.update(self.attrs)
+            self.attrs = merged
+        self.seq = _next_seq()
+        stack.append(self)
+        self.ts = time.time()
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur_ms = (time.monotonic() - self.t0) * 1e3
+        stack = _tls.stack
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:             # defensive: mis-nested exits
+            stack.remove(self)
+        rec = {
+            "kind": "span",
+            "op": self.op,
+            "thread": threading.current_thread().name,
+            "seq": self.seq,
+            "parent": self.parent,
+            "ts": self.ts,
+            "dur_ms": round(dur_ms, 3),
+            "outcome": "ok" if exc is None else "error",
+            "attrs": self.attrs,
+        }
+        if exc is not None:
+            rec["error"] = f"{type(exc).__name__}: {exc}"
+        _ring().store(rec)
+        _spans_total.add()
+        if self.histogram is not None:
+            hist = _histograms.get(self.histogram)
+            if hist is not None:
+                hist.observe(dur_ms)
+        threshold = SLOW_THRESHOLDS_MS.get(self.op, _slow_default_ms)
+        if dur_ms >= threshold:
+            _slow_total.add()
+            _slow.append(rec)           # C-atomic bounded append
+            log.warning(
+                "slow span: op=%s dur_ms=%.1f threshold_ms=%g outcome=%s "
+                "attrs=%s", self.op, dur_ms, threshold, rec["outcome"],
+                self.attrs)
+
+
+def span(op: str, histogram: Optional[str] = None, **attrs: Any):
+    """Open a span: ``with trace.span("server.Allocate", resource=r): ...``
+
+    Disabled ($TDP_TRACE=0): a cached no-op. Enabled: records into this
+    thread's ring at exit; `histogram` names a registered Histogram that
+    observes the span's duration (ms). Zero registered locks either way —
+    safe inside every lockdep.read_path bracket.
+    """
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(op, histogram, attrs)
+
+
+def event(op: str, **attrs: Any) -> None:
+    """Record a point-in-time event (fault fired, lifecycle transition).
+    Inherits the active span's attributes on this thread, so an injected
+    fault inside a probe span carries the probe's bdf."""
+    if not _enabled:
+        return
+    stack = _tls.stack
+    if stack:
+        merged = dict(stack[-1].attrs)
+        merged.update(attrs)
+        attrs = merged
+        parent: Optional[int] = stack[-1].seq
+    else:
+        parent = None
+    _ring().store({
+        "kind": "event",
+        "op": op,
+        "thread": threading.current_thread().name,
+        "seq": _next_seq(),
+        "parent": parent,
+        "ts": time.time(),
+        "outcome": "ok",
+        "attrs": attrs,
+    })
+    _events_total.add()
+
+
+# ------------------------------------------------------------- read side
+
+def _matches(rec: dict, claim: Optional[str], bdf: Optional[str],
+             op: Optional[str]) -> bool:
+    if op is not None and not rec["op"].startswith(op):
+        return False
+    attrs = rec.get("attrs") or {}
+    if claim is not None and attrs.get("claim_uid") != claim:
+        return False
+    if bdf is not None and attrs.get("bdf") != bdf \
+            and attrs.get("device") != bdf:
+        return False
+    return True
+
+
+def snapshot(claim: Optional[str] = None, bdf: Optional[str] = None,
+             op: Optional[str] = None,
+             limit: Optional[int] = None) -> List[dict]:
+    """Merge every thread's ring into one time-ordered record list.
+
+    Lock-free and tear-free: `list(ring.buf)` snapshots each ring's slots
+    in one C-atomic copy, each slot is either None or a COMPLETE immutable
+    record (writers store fully-built dicts), and (thread, seq) is unique,
+    so a snapshot can never contain a torn or duplicated span — at worst
+    it misses records stored after its ring copy. Filters: claim matches
+    attrs.claim_uid; bdf matches attrs.bdf/attrs.device; op is a prefix.
+    `limit` keeps the newest N after filtering.
+    """
+    records: List[dict] = []
+    for ring in list(_rings):
+        for rec in list(ring.buf):
+            if rec is not None and _matches(rec, claim, bdf, op):
+                records.append(rec)
+    records.sort(key=lambda r: (r["ts"], r["seq"]))
+    if limit is not None and limit >= 0:
+        records = records[len(records) - min(limit, len(records)):]
+    return records
+
+
+def slow_spans() -> List[dict]:
+    """The bounded slow-span log, oldest first (C-atomic deque copy)."""
+    return list(_slow)
+
+
+def stats() -> dict:
+    """Gauges + counters for /status (lock-free: atomic counter sums,
+    C-atomic list copies, GIL-atomic int reads)."""
+    # the overwritten total must be MONOTONE (it is exposed as a
+    # Prometheus counter): a scrape landing between a retire's
+    # _rings.remove and its _retired_lost charge would otherwise dip —
+    # so the two are read under the same (unregistered, cold, tiny)
+    # maintenance lock the retire path mutates them under. Everything
+    # else stays lock-free.
+    with _maintenance_lock:
+        rings = list(_rings)
+        overwritten = _retired_lost + sum(
+            max(0, r.idx - len(r.buf)) for r in rings)
+    return {
+        "enabled": _enabled,
+        "ring_size": _ring_size,
+        "rings": len(rings),
+        "spans_recorded_total": _spans_total.value,
+        "events_recorded_total": _events_total.value,
+        "spans_overwritten_total": overwritten,
+        "slow_spans_total": _slow_total.value,
+        "slow_threshold_ms": _slow_default_ms,
+    }
+
+
+# ------------------------------------------------------------ histograms
+
+# exponential bounds (ms): 100 us .. 10 s covers a sub-ms Allocate and a
+# wedged multi-second apiserver round-trip in one bucket family
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """Lock-free fixed-bucket histogram, sharded per thread like
+    epoch.AtomicCounter: each thread owns one cell (a plain list —
+    per-bucket counts plus a value sum), mutated only by its owner;
+    `snapshot()` sums C-atomic slice copies of the cells. Consistency by
+    construction: `_count` (and the `+Inf` bucket) are DERIVED from the
+    copied bucket counts, so a scrape racing an observe can never show a
+    finite-`le` bucket exceeding `+Inf` — the strict conformance test
+    (tests/test_metrics_format.py) holds on a busy daemon, not just an
+    idle one. Cells only accrete, so successive scrapes are monotonic.
+
+    Short-lived threads do not leak cells: a new thread's first observe
+    ADOPTS a dead owner's cell (ownership handoff under the cold-path
+    maintenance lock; shard totals are sums, so reuse is lossless) —
+    the cell count is bounded by the peak number of LIVE threads, not
+    by thread churn (the idle-exiting checkpoint writer respawns per
+    burst)."""
+
+    __slots__ = ("name", "help", "bounds", "_cells", "_local")
+
+    def __init__(self, name: str, help_text: str,
+                 bounds: Tuple[float, ...] = DEFAULT_BUCKETS_MS) -> None:
+        self.name = name
+        self.help = help_text
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        # entries are [owner_thread, cell]; cell = bucket counts
+        # (len(bounds)+1) + [value sum]
+        self._cells: List[list] = []
+        self._local = threading.local()
+
+    def _reset(self) -> None:
+        # retire the cells wholesale (reset()); threads re-register on
+        # their next observe because the thread-local cell is checked
+        # against membership via the home-list identity below
+        self._cells = []
+        self._local = threading.local()
+
+    def _claim_cell(self) -> list:
+        me = threading.current_thread()
+        with _maintenance_lock:
+            for entry in self._cells:
+                if not entry[0].is_alive():
+                    entry[0] = me          # adopt a dead owner's shard
+                    return entry[1]
+            cell = [0] * (len(self.bounds) + 1) + [0.0]
+            self._cells.append([me, cell])
+            return cell
+
+    def observe(self, value_ms: float) -> None:
+        cell = getattr(self._local, "cell", None)
+        cells = self._cells
+        if cell is None or getattr(self._local, "home", None) is not cells:
+            cell = self._claim_cell()
+            self._local.cell = cell
+            self._local.home = cells
+        i = bisect_right(self.bounds, value_ms)
+        cell[i] += 1                    # owner thread only: exact
+        cell[-1] += value_ms            # sum (float; owner-only)
+
+    def snapshot(self) -> dict:
+        """{"buckets": [(le, cumulative_count), ...], "count": n,
+        "sum": total_ms} — buckets cumulative, Prometheus-style; count
+        derived from the same copied bucket values (see class doc)."""
+        n_buckets = len(self.bounds) + 1
+        per_bucket = [0] * n_buckets
+        total = 0.0
+        for entry in list(self._cells):
+            copied = entry[1][:]        # one C-atomic slice copy
+            for i in range(n_buckets):
+                per_bucket[i] += copied[i]
+            total += copied[-1]
+        buckets: List[Tuple[float, int]] = []
+        running = 0
+        for bound, n in zip(self.bounds, per_bucket):
+            running += n
+            buckets.append((bound, running))
+        return {"buckets": buckets, "count": sum(per_bucket),
+                "sum": round(total, 6)}
+
+
+# The registered histogram families (ms). The HELP text doubles as the
+# Prometheus exposition's # HELP line.
+_histograms: Dict[str, Histogram] = {}
+
+
+def _register(name: str, help_text: str) -> Histogram:
+    hist = Histogram(name, help_text)
+    _histograms[name] = hist
+    return hist
+
+
+_register("tdp_attach_wall_ms",
+          "Allocate RPC wall time (server.Allocate span).")
+_register("tdp_prepare_wall_ms",
+          "Per-claim DRA prepare wall time (dra.prepare.claim span).")
+_register("tdp_checkpoint_commit_ms",
+          "Group-committed checkpoint write wall time "
+          "(dra.checkpoint.commit span).")
+_register("tdp_probe_cycle_ms",
+          "Health hub probe-cycle wall time (health.probe_cycle span).")
+_register("tdp_kubeapi_rtt_ms",
+          "Kubernetes API request round-trip time (kubeapi.request span).")
+
+
+def histogram(name: str) -> Histogram:
+    return _histograms[name]
+
+
+def observe(name: str, value_ms: float) -> None:
+    hist = _histograms.get(name)
+    if hist is not None and _enabled:
+        hist.observe(value_ms)
+
+
+def _fmt_bound(bound: float) -> str:
+    return format(bound, "g")
+
+
+def render_prometheus() -> List[str]:
+    """Prometheus text-format lines for every registered histogram plus
+    the trace-plane counters (appended to status.metrics()). Lock-free —
+    the /status zero-lock gate covers the scrape path."""
+    lines: List[str] = []
+    for name in sorted(_histograms):
+        hist = _histograms[name]
+        snap = hist.snapshot()
+        lines.append(f"# HELP {name} {hist.help}")
+        lines.append(f"# TYPE {name} histogram")
+        for bound, cumulative in snap["buckets"]:
+            lines.append(
+                f'{name}_bucket{{le="{_fmt_bound(bound)}"}} {cumulative}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {snap["count"]}')
+        lines.append(f'{name}_sum {snap["sum"]}')
+        lines.append(f'{name}_count {snap["count"]}')
+    s = stats()
+    lines += [
+        "# HELP tdp_trace_spans_total Spans recorded by the flight "
+        "recorder since start.",
+        "# TYPE tdp_trace_spans_total counter",
+        f"tdp_trace_spans_total {s['spans_recorded_total']}",
+        "# HELP tdp_trace_events_total Point events recorded by the "
+        "flight recorder since start.",
+        "# TYPE tdp_trace_events_total counter",
+        f"tdp_trace_events_total {s['events_recorded_total']}",
+        "# HELP tdp_trace_slow_spans_total Spans that exceeded their "
+        "per-op slow threshold ($TDP_TRACE_SLOW_MS).",
+        "# TYPE tdp_trace_slow_spans_total counter",
+        f"tdp_trace_slow_spans_total {s['slow_spans_total']}",
+        "# HELP tdp_trace_spans_overwritten_total Ring-buffer slots "
+        "overwritten before any reader drained them.",
+        "# TYPE tdp_trace_spans_overwritten_total counter",
+        f"tdp_trace_spans_overwritten_total {s['spans_overwritten_total']}",
+    ]
+    return lines
+
+
+# --------------------------------------------------------- crash artifact
+
+def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
+    """Write the merged ring + slow log + stats to a JSON file; returns
+    the path (None when the write failed — dumping must never add a
+    second crash to the one being reported). Default path:
+    $TDP_TRACE_DUMP_PATH, else tdp-flight-<pid>.json under $TMPDIR."""
+    path = path or os.environ.get("TDP_TRACE_DUMP_PATH") or os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"tdp-flight-{os.getpid()}.json")
+    payload = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "dumped_at": time.time(),
+        "stats": stats(),
+        "slow": slow_spans(),
+        "spans": snapshot(),
+    }
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+    except OSError as exc:
+        log.error("flight-recorder dump to %s failed: %s", path, exc)
+        return None
+    log.warning("flight recorder dumped to %s (%s; %d spans)", path,
+                reason, len(payload["spans"]))
+    return path
+
+
+_prev_excepthook = None
+_prev_threading_excepthook = None
+
+
+def install_crash_hook() -> None:
+    """Dump the flight recorder on any unhandled exception (main thread
+    via sys.excepthook, daemon threads via threading.excepthook), then
+    chain to the previous hook. Idempotent."""
+    global _prev_excepthook, _prev_threading_excepthook
+    if _prev_excepthook is not None:
+        return
+
+    def _sys_hook(exc_type, exc, tb):
+        dump(f"unhandled-exception:{exc_type.__name__}")
+        (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    def _thread_hook(args):
+        dump(f"unhandled-thread-exception:{args.exc_type.__name__}")
+        (_prev_threading_excepthook or threading.__excepthook__)(args)
+
+    _prev_excepthook = sys.excepthook
+    _prev_threading_excepthook = threading.excepthook
+    sys.excepthook = _sys_hook
+    threading.excepthook = _thread_hook
+
+
+def uninstall_crash_hook() -> None:
+    """Restore the pre-install hooks (test teardown)."""
+    global _prev_excepthook, _prev_threading_excepthook
+    if _prev_excepthook is None:
+        return
+    sys.excepthook = _prev_excepthook
+    threading.excepthook = _prev_threading_excepthook
+    _prev_excepthook = None
+    _prev_threading_excepthook = None
