@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -142,6 +142,32 @@ bench-attach-path:
 # the honesty guard pins the recorded overhead within the documented bound.
 bench-trace:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trace-overhead
+
+# Fleet-scale simulation bench (docs/perf.md "fleet scale"): paced vs
+# unpaced boot storms at N={16,64,256} in-process nodes against the
+# congestion-modeling fabric (peak in-flight, write p99, exactly-once
+# publish audit), plus the 64-node attach storm / flip wave / rolling
+# drain-upgrade. Writes docs/bench_fleet_r11.json. CI bench-smoke runs
+# the --quick (N=4) variant.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet
+
+# Single-daemon scale ceiling bench (docs/perf.md "fleet scale"): 4096
+# devices / 1024 partitions — warm-discovery read floor, one-flip epoch
+# isolation (counted builds + payload identity), /status //metrics
+# scrape assembly accounting, 1024-claim checkpoint burst at the
+# group-commit bound with compact-serialization sizing. Writes
+# docs/bench_scale_r11.json.
+bench-scale:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale
+
+# Fleet chaos soak (nightly-shape, gated): 64-node boot storm + flip
+# wave + 1024-claim attach + rolling upgrade with chaos faults armed
+# (dra.publish refusals, kubeapi transport errors), under runtime
+# lockdep. Deterministic seeds; every fleet contract asserted.
+fleet-soak:
+	TDP_CHAOS_SOAK=1 TDP_LOCKDEP=1 JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_fleetsim.py -q -k soak
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

@@ -382,3 +382,98 @@ def test_read_path_counters_surface_on_status(short_root):
             assert "tdp_read_path_lock_acquisitions_total" in text
         finally:
             server._httpd.server_close()
+
+
+# ------------------------------------------------ mass-churn waiter wakeup
+
+
+def test_mass_churn_one_flip_wakes_only_that_resources_waiters(short_root):
+    """ISSUE 9 satellite: 256 concurrent ListAndWatch subscribers across
+    16 resources, ONE health flip. Exactly the flipped resource's waiters
+    assemble a send; every untouched resource keeps its epoch — and its
+    pre-serialized payload — by OBJECT IDENTITY (`is`), pays zero epoch
+    builds (counted), and none of its 240 parked streams produce a send.
+    At 4096 devices a spurious rebuild is a multi-ms serialize per flip;
+    identity is the proof it cannot happen."""
+    n_resources, n_streams = 16, 16
+    host = FakeHost(short_root)
+    for i in range(n_resources * 4):
+        host.add_chip(FakeChip(f"0000:{i // 32:02x}:{4 + i % 32:02x}.0",
+                               iommu_group=str(11 + i), numa_node=0))
+    cfg = dataclasses.replace(Config().with_root(host.root),
+                              lw_debounce_s=0.0)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, _ = discover_passthrough(cfg)
+    devices = registry.devices_by_model["0062"]
+    plugins = [TpuDevicePlugin(cfg, f"v4-r{i:02d}", registry,
+                               devices[i * 4:(i + 1) * 4])
+               for i in range(n_resources)]
+
+    class Ctx:
+        def is_active(self):
+            return True
+
+        def add_callback(self, cb):
+            return True
+
+    responses = [[[] for _ in range(n_streams)]
+                 for _ in range(n_resources)]
+    threads = []
+    for pi, plugin in enumerate(plugins):
+        for si in range(n_streams):
+            def consume(pi=pi, si=si, plugin=plugin):
+                for resp in plugin.ListAndWatch(None, Ctx()):
+                    responses[pi][si].append(
+                        {d.ID: d.health for d in resp.devices})
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            threads.append(t)
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(p._store.waiters >= n_streams for p in plugins):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError(
+                f"streams never parked: waiters="
+                f"{[p._store.waiters for p in plugins]}")
+
+        before = [p._store.current for p in plugins]
+        builds_before = [p._epoch_builds.value for p in plugins]
+        flip_dev = devices[0].bdf
+        plugins[0].set_devices_health([flip_dev], healthy=False,
+                                      source="churn")
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(len(responses[0][si]) == 2 for si in range(n_streams)):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError(
+                f"flipped resource's waiters did not all send: "
+                f"{[len(r) for r in responses[0]]}")
+        time.sleep(0.1)   # grace: any spurious wakeup would send now
+
+        # exactly the flipped resource's waiters assembled a send
+        assert plugins[0]._lw_resends.value == n_streams
+        for si in range(n_streams):
+            assert responses[0][si][-1][flip_dev] == "Unhealthy"
+        for pi in range(1, n_resources):
+            # epoch AND payload identity-reused — not equal, THE SAME
+            assert plugins[pi]._store.current is before[pi]
+            assert plugins[pi]._store.current.lw_payload \
+                is before[pi].lw_payload
+            assert plugins[pi]._epoch_builds.value == builds_before[pi]
+            assert plugins[pi]._lw_resends.value == 0
+            for si in range(n_streams):
+                assert len(responses[pi][si]) == 1, (pi, si)
+    finally:
+        for p in plugins:
+            p._stop.set()
+            p._store.poke()
+        for t in threads:
+            t.join(timeout=5)
+    assert not any(t.is_alive() for t in threads)

@@ -1,0 +1,360 @@
+"""Fleet-scale simulation harness tests (ISSUE 9).
+
+Small-N deterministic versions of the storms bench.py --fleet runs at
+{16,64,256}: coordinated boot, mass attach, health-flip coalescing,
+rolling drain/upgrade — each asserting the counted fleet contracts
+(exactly-once slice generations, zero lost claims, convergence) rather
+than wall-clock. The 64-node chaos soak is @pytest.mark.slow and gated
+on TDP_CHAOS_SOAK=1 (`make fleet-soak`, lockdep-enabled).
+"""
+
+import os
+import time
+import threading
+
+import pytest
+
+from tpu_device_plugin import faults
+from tpu_device_plugin.fleetsim import FleetApiServer, FleetSim
+from tpu_device_plugin.kubeapi import ApiClient, ApiError, PublishPacer
+
+
+@pytest.fixture()
+def fleet():
+    sims = []
+
+    def build(**kw):
+        kw.setdefault("n_nodes", 4)
+        kw.setdefault("devices_per_node", 4)
+        kw.setdefault("latency_s", 0.002)
+        kw.setdefault("seed", 3)
+        sim = FleetSim(**kw)
+        sims.append(sim)
+        return sim
+
+    yield build
+    for sim in sims:
+        sim.stop()
+
+
+# ------------------------------------------------------------ fabric
+
+
+def test_fabric_serves_the_dra_surface_and_audits_writes():
+    srv = FleetApiServer()
+    try:
+        client = ApiClient(srv.url, token_path="/nonexistent")
+        group = client.get_json("/apis/resource.k8s.io")
+        assert group["versions"][0]["version"] == "v1beta1"
+        node = client.get_json("/api/v1/nodes/n1")
+        assert node["metadata"]["uid"] == "uid-n1"
+        obj = {"metadata": {"name": "s1"},
+               "spec": {"pool": {"generation": 1}, "devices": []}}
+        created = client.post_json(
+            "/apis/resource.k8s.io/v1beta1/resourceslices", obj)
+        # duplicate create = 409, like a real apiserver (exactly-once)
+        with pytest.raises(ApiError) as exc:
+            client.post_json(
+                "/apis/resource.k8s.io/v1beta1/resourceslices", obj)
+        assert exc.value.code == 409
+        # guarded PUT honors resourceVersion
+        created["spec"]["pool"]["generation"] = 2
+        client.put_json(
+            "/apis/resource.k8s.io/v1beta1/resourceslices/s1", created)
+        stale = dict(created, metadata={"name": "s1",
+                                        "resourceVersion": "0"})
+        with pytest.raises(ApiError) as exc:
+            client.put_json(
+                "/apis/resource.k8s.io/v1beta1/resourceslices/s1", stale)
+        assert exc.value.code == 409
+        audit = srv.exactly_once_audit()
+        assert audit["exactly_once"], audit
+        assert audit["slices_audited"] == 1
+    finally:
+        srv.stop()
+
+
+def test_fabric_throttles_beyond_capacity_and_client_retries_gets():
+    srv = FleetApiServer(latency_s=0.4, max_inflight=1)
+    try:
+        client = ApiClient(srv.url, token_path="/nonexistent")
+        blocker = threading.Thread(
+            target=lambda: client.get_json("/api/v1/nodes/slow"),
+            daemon=True)
+        blocker.start()
+        # wait until the blocker actually OCCUPIES the single admission
+        # slot, so the probe below deterministically draws a 429 first
+        deadline = time.monotonic() + 5
+        while srv._admitted < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv._admitted >= 1
+        # the blocked slot forces 429s; the client's bounded in-call GET
+        # retry (jittered, client-wide backoff) absorbs most of the
+        # window, and the outer loop models the caller retrying a GET
+        # whose in-call budget expired while the slot was still held —
+        # the budget is deliberately bounded, so exhausting it under a
+        # 400 ms hold is legitimate behavior, not a failure
+        out = ApiClient(srv.url, token_path="/nonexistent")
+        node = None
+        for _ in range(5):
+            try:
+                node = out.get_json("/api/v1/nodes/n2")
+                break
+            except ApiError as exc:
+                assert exc.code == 429, exc
+        assert node is not None and node["metadata"]["name"] == "n2"
+        assert out.throttled_total.value >= 1
+        assert out.thread_throttled_count() >= 1
+        blocker.join(timeout=5)
+        assert srv.snapshot()["throttled_total"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_fabric_load_dependent_latency_degrades_with_inflight():
+    """congestion_k: service time scales 1 + inflight/k — concurrent
+    requests are measurably slower than a lone one (the herd makes
+    itself slow; what the pacing bench's peak-in-flight cells model)."""
+    srv = FleetApiServer(latency_s=0.05, congestion_k=1)
+    try:
+        lone = ApiClient(srv.url, token_path="/nonexistent")
+        t0 = time.monotonic()
+        lone.get_json("/api/v1/nodes/a")
+        lone_wall = time.monotonic() - t0
+
+        clients = [ApiClient(srv.url, token_path="/nonexistent")
+                   for _ in range(4)]
+        walls = []
+
+        def hit(c):
+            t0 = time.monotonic()
+            c.get_json("/api/v1/nodes/b")
+            walls.append(time.monotonic() - t0)
+
+        threads = [threading.Thread(target=hit, args=(c,), daemon=True)
+                   for c in clients]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # the slowest concurrent request saw >= 2 in flight: its service
+        # time is at least ~2x the lone request's base
+        assert max(walls) > lone_wall * 1.5, (lone_wall, walls)
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------- pacing unit
+
+
+def test_pacer_coalesces_concurrent_publishers():
+    """Publishers arriving during a wave's admission wait ride that wave:
+    5 concurrent requests -> 1 publish_fn call, every caller sees the
+    wave's result."""
+    calls = []
+    release = threading.Event()
+
+    def publish():
+        calls.append(threading.get_ident())
+        return True
+
+    pacer = PublishPacer(base_window_s=0.3)
+    results = []
+
+    def caller():
+        release.wait(5)
+        results.append(pacer.run(publish))
+
+    threads = [threading.Thread(target=caller, daemon=True)
+               for _ in range(5)]
+    for t in threads:
+        t.start()
+    release.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1, calls
+    assert results == [True] * 5
+    snap = pacer.snapshot()
+    assert snap["publish_waves_total"] == 1
+    assert snap["publishes_coalesced_total"] == 4
+
+
+def test_pacer_zero_window_adds_no_delay_and_adapts_on_throttle():
+    class FakeApi:
+        def __init__(self):
+            self.last_code = None
+            self.last_rtt_s = 0.001
+
+        def reset_thread_error(self):
+            self.last_code = None
+
+        def thread_last_error_code(self):
+            return self.last_code
+
+    api = FakeApi()
+    pacer = PublishPacer(api=api, base_window_s=0.0, max_window_s=2.0)
+    assert pacer.run(lambda: True) is True
+    assert pacer.snapshot()["window_ms"] == 0      # uncongested: no pacing
+    assert pacer.snapshot()["pacing_delays_total"] == 0
+
+    # a throttled failure (the wave's final request answered 429) grows
+    # the window and re-admits; success through the grown window decays
+    outcomes = [False, True]
+
+    def publish():
+        ok = outcomes.pop(0)
+        api.last_code = None if ok else 429
+        return ok
+
+    assert pacer.run(publish) is True
+    snap = pacer.snapshot()
+    assert snap["publish_throttled_total"] == 1
+    assert snap["pacing_delays_total"] >= 1        # the re-admission wait
+    assert outcomes == []
+
+
+def test_pacer_non_throttle_failure_with_earlier_throttled_get():
+    """A wave whose internal GET drew a (retried-away) 429 but whose
+    final request failed 5xx is NOT throttled: it returns to the
+    caller's republish machinery immediately instead of re-admitting."""
+    class FakeApi:
+        def __init__(self):
+            self.last_code = None
+            self.last_rtt_s = 0.001
+
+        def reset_thread_error(self):
+            self.last_code = None
+
+        def thread_last_error_code(self):
+            return self.last_code
+
+    api = FakeApi()
+    pacer = PublishPacer(api=api, base_window_s=0.0, max_window_s=2.0)
+    calls = []
+
+    def publish():
+        calls.append(1)
+        api.last_code = 500     # the request that made the wave give up
+        return False
+
+    assert pacer.run(publish) is False
+    assert len(calls) == 1
+    assert pacer.snapshot()["publish_throttled_total"] == 0
+
+
+def test_pacer_non_throttle_failure_returns_immediately():
+    pacer = PublishPacer(base_window_s=0.0)
+    calls = []
+
+    def publish():
+        calls.append(1)
+        return False
+
+    assert pacer.run(publish) is False
+    assert len(calls) == 1     # no blind retry: the caller's machinery owns it
+
+
+# ------------------------------------------------------------- storms
+
+
+def test_boot_storm_publishes_every_node_exactly_once(fleet):
+    sim = fleet(n_nodes=4)
+    boot = sim.boot_storm()
+    assert boot["published_ok"] == 4
+    assert boot["exactly_once"], boot["audit"]
+    assert boot["apiserver"]["slices"] == 4
+    # one accepted write per node at boot: no duplicated POSTs
+    assert boot["apiserver"]["accepted_writes"] == 4
+    assert sim.assert_converged()
+
+
+def test_boot_storm_converges_through_a_throttling_fabric(fleet):
+    """A capped fabric 429s the herd; the adaptive windows + in-pacer
+    re-admission land every node's slice exactly once. A node may
+    legitimately exhaust its in-call retry budget under extreme
+    throttling (production hands off to the republish timer); settle()
+    compresses that timer, after which convergence and the exactly-once
+    write audit must hold unconditionally."""
+    sim = fleet(n_nodes=6, latency_s=0.05, max_inflight=2, pace=True)
+    boot = sim.boot_storm()
+    assert boot["published_ok"] >= 4     # the storm mostly lands in-call
+    sim.settle()
+    assert sim.assert_converged()
+    audit = sim.apiserver.exactly_once_audit()
+    assert audit["exactly_once"], audit
+    assert audit["slices_audited"] == 6
+
+
+def test_attach_storm_prepares_every_claim(fleet):
+    sim = fleet(n_nodes=4)
+    sim.boot_storm()
+    attach = sim.attach_storm(4)
+    assert attach["errors"] == []
+    assert attach["prepared_total"] == 16
+    # group commit held fleet-wide: commits well under one per claim
+    assert attach["checkpoint_commits"] < 16
+
+
+def test_flip_wave_coalesces_and_lands_final_state(fleet):
+    sim = fleet(n_nodes=4, latency_s=0.02, max_inflight=2)
+    sim.boot_storm()
+    flip = sim.flip_wave(6)
+    assert flip["converged"]
+    assert flip["exactly_once"]
+    # the fabric never saw one write per flip: pacing + effective-flip
+    # publishing bound the wave count below the raw flip count
+    assert flip["accepted_writes"] < 4 * 7
+
+
+def test_drain_upgrade_wave_preserves_claims(fleet):
+    sim = fleet(n_nodes=4)
+    sim.boot_storm()
+    sim.attach_storm(2)
+    wave = sim.drain_upgrade_wave(2)
+    assert wave["waves"] == 2
+    assert wave["converged"]
+    assert wave["exactly_once"]
+    assert wave["prepared_total"] == 8     # every claim survived upgrade
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("TDP_CHAOS_SOAK") != "1",
+                    reason="soak: set TDP_CHAOS_SOAK=1 (make fleet-soak)")
+def test_fleet_soak_64_node_boot_storm_with_chaos():
+    """`make fleet-soak`: a 64-node boot storm + flip wave + attach storm
+    + rolling upgrade with the chaos registry armed (publish refusals and
+    apiserver transport faults firing mid-storm), under TDP_LOCKDEP=1
+    (the make target bakes it in). Every fleet contract must hold
+    through the faults."""
+    faults.reset()
+    faults.arm("dra.publish", kind="drop", count=8)
+    faults.arm("kubeapi.request", kind="error", count=8)
+    try:
+        sim = FleetSim(n_nodes=64, devices_per_node=4, latency_s=0.02,
+                       max_inflight=8, pace=True, seed=1337)
+        try:
+            boot = sim.boot_storm()
+            # armed dra.publish faults fail some first publishes; the
+            # nodes' own retry (pacer returns False -> storm result
+            # False) is out of scope here — republish and convergence
+            # are: re-drive the failed nodes once, then audit
+            for node in sim.nodes:
+                name = node.driver.slice_name()
+                with sim.apiserver._lock:
+                    missing = name not in sim.apiserver.slices
+                if missing:
+                    assert node.driver.publish_resource_slices()
+            assert sim.assert_converged()
+            flip = sim.flip_wave(4)
+            assert flip["converged"] and flip["exactly_once"]
+            attach = sim.attach_storm(4)
+            assert attach["errors"] == []
+            assert attach["prepared_total"] == 256
+            wave = sim.drain_upgrade_wave(16)
+            assert wave["converged"] and wave["exactly_once"]
+            assert wave["prepared_total"] == 256
+            assert boot["exactly_once"]
+        finally:
+            sim.stop()
+    finally:
+        faults.reset()

@@ -65,7 +65,8 @@ BLOCKING_CALLS = frozenset({
 # ApiClient verbs (network), urllib, grpc dial helpers, file writers
 BLOCKING_METHODS = frozenset({
     "get_json", "put_json", "post_json", "request", "urlopen",
-    "channel_ready_future", "_atomic_write_json", "_save_checkpoint",
+    "channel_ready_future", "_atomic_write_json", "_atomic_write_text",
+    "_save_checkpoint",
 })
 
 # The hot set, exactly the three the correctness argument leans on:
@@ -107,6 +108,7 @@ COUNTERS: Dict[str, Dict[str, str]] = {
         "checkpoint_stats_counters[*]": "dra.DraDriver._ckpt_cond",
         "_prepare_inflight": "dra.DraDriver._ckpt_cond",
         "_attach_active": "dra.DraDriver._ckpt_cond",
+        "_checkpoint_bytes": "dra.DraDriver._ckpt_cond",
         # migration handoff counters (emitted/completed): /status reads
         # them lock-free via a C-atomic fixed-key dict copy
         "handoff_stats[*]": "dra.DraDriver._lock",
@@ -130,6 +132,14 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     # tests/test_counter_drift.py pins every entry BELOW to its /status
     # and /metrics surface names — extend its SURFACES table when adding
     # counters here.
+    # publish pacing (ISSUE 9): wave/coalesce/throttle/delay counters all
+    # mutate inside `with self._cond` blocks of PublishPacer.run;
+    # snapshot() reads them lock-free (fixed-key C-atomic dict copy).
+    # ApiClient.throttled_total is an epoch.AtomicCounter (lock-free
+    # owned, like the trace-plane counters — no entry here by design).
+    "kubeapi.PublishPacer": {
+        "stats[*]": "kubeapi.PublishPacer._cond",
+    },
     "resilience.BackoffPolicy": {
         "attempts": "resilience.BackoffPolicy._lock",
         "total_attempts": "resilience.BackoffPolicy._lock",

@@ -106,6 +106,24 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="cache the shared-device (EGM-analogue) sysfs "
                              "scan for this many seconds inside Allocate "
                              "(0 = rescan every RPC, reference behavior)")
+    parser.add_argument("--publish-pace-max", type=float,
+                        default=cfg.publish_pace_max_s,
+                        help="ceiling (seconds) for the adaptive "
+                             "ResourceSlice publish admission window "
+                             "(kubeapi.PublishPacer): 429/slow-RTT feedback "
+                             "grows the jittered window up to this; 0 "
+                             "disables pacing entirely")
+    parser.add_argument("--publish-pace-base", type=float,
+                        default=cfg.publish_pace_base_s,
+                        help="resting admission window (seconds) for "
+                             "ResourceSlice publishes; the default 0 adds "
+                             "no latency until the apiserver pushes back")
+    parser.add_argument("--diagnostics-ttl", type=float,
+                        default=cfg.diagnostics_ttl_s,
+                        help="cache the per-device PCI diagnostics reads "
+                             "on /status for this many seconds (0 = read "
+                             "live every scrape; at 4096 devices a scrape "
+                             "costs 2 sysfs reads per device uncached)")
     parser.add_argument("--label-node", action="store_true",
                         help="publish per-node TPU facts (generation, chip "
                              "count, torus dims) as node labels via the API "
@@ -200,6 +218,22 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             or args.health_probe_deadline_seconds <= 0:
         parser.error("--health-probe-deadline-seconds must be a finite "
                      f"number > 0, got {args.health_probe_deadline_seconds!r}")
+    # fail-loud pacing/diagnostics knobs: a NaN window defeats every
+    # monotonic-deadline comparison silently, a negative one is nonsense
+    for name, value in (("--publish-pace-base", args.publish_pace_base),
+                        ("--publish-pace-max", args.publish_pace_max),
+                        ("--diagnostics-ttl", args.diagnostics_ttl)):
+        if math.isnan(value) or math.isinf(value) or value < 0:
+            parser.error(f"{name} must be a finite number >= 0, "
+                         f"got {value!r}")
+    if args.publish_pace_base > args.publish_pace_max:
+        # base > max is silently inconsistent: decay clamps the window
+        # to base while adaptation clamps to max — reject it loudly
+        # (this also keeps "--publish-pace-max 0 disables pacing" true:
+        # it forces base 0 too)
+        parser.error(f"--publish-pace-base ({args.publish_pace_base}) "
+                     f"must be <= --publish-pace-max "
+                     f"({args.publish_pace_max})")
 
     level = logging.DEBUG if args.verbose else logging.INFO
     # Structured logging (log.py): key=value records by default, JSON
@@ -238,6 +272,9 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         shared_scan_ttl_s=args.shared_scan_ttl,
         lw_debounce_s=args.lw_debounce_ms / 1000.0,
         incremental_rediscovery=not args.full_rescan,
+        publish_pace_base_s=args.publish_pace_base,
+        publish_pace_max_s=args.publish_pace_max,
+        diagnostics_ttl_s=args.diagnostics_ttl,
     )
     if args.root:
         cfg = cfg.with_root(args.root)

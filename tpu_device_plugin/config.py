@@ -118,6 +118,19 @@ class Config:
     # generic_device_plugin.go:366); a small TTL keeps hotplug visible within
     # seconds while taking the sysfs walk off the per-RPC critical path.
     shared_scan_ttl_s: float = 1.0
+    # ResourceSlice publish pacing (kubeapi.PublishPacer): the admission
+    # window starts at base and ADAPTS — 429/slow-RTT feedback doubles it
+    # (bounded by max), fast successes decay it back. base 0 means an
+    # uncongested node publishes with zero added latency; the window only
+    # opens when the apiserver pushes back (fleet boot storms).
+    publish_pace_base_s: float = 0.0
+    publish_pace_max_s: float = 2.0
+    # /status diagnostics cache TTL: the per-device latched-PCI-error +
+    # link-training reads cost 2 sysfs reads per device per scrape — at
+    # 4096 devices that is 8192 reads per /status. A small TTL serves
+    # repeat scrapes from the last read set. 0 = always live (default;
+    # single-rack inventories are cheap to read fresh).
+    diagnostics_ttl_s: float = 0.0
 
     # --- native shim --------------------------------------------------------
     native_lib_path: Optional[str] = None  # override libtpuhealth.so location

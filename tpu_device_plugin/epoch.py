@@ -201,6 +201,11 @@ class EpochStore:
             "epoch.EpochStore._cond", threading.Condition())
         self.current: Any = initial if initial is not None else Epoch(0)
         self.publishes = AtomicCounter()
+        # parked wait_for callers (ListAndWatch streams, fleet-sim
+        # subscribers): mutated under _cond, read lock-free (GIL-atomic
+        # int) — the mass-churn wakeup tests and the fleet bench use it
+        # to know every subscriber is parked before firing a flip
+        self.waiters = 0
 
     def lock(self) -> threading.Condition:
         """The writer-side critical section: `with store.lock(): ...`.
@@ -226,7 +231,11 @@ class EpochStore:
         """Park until `predicate()` (checked under the store condition).
         Waiters hold nothing while parked — lockdep suspends the hold."""
         with self._cond:
-            return self._cond.wait_for(predicate, timeout)
+            self.waiters += 1
+            try:
+                return self._cond.wait_for(predicate, timeout)
+            finally:
+                self.waiters -= 1
 
     def poke(self) -> None:
         """Wake waiters without publishing (teardown, RPC termination)."""

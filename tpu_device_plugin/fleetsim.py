@@ -1,0 +1,722 @@
+"""Fleet-scale simulation harness (ROADMAP item 1 / ISSUE 9).
+
+Runs N in-process daemon "nodes" — each with an isolated sysfs/devfs
+root, its own plugin server (direct servicer surface), and its own DRA
+driver + publish pacer — against ONE shared fake apiserver fabric, and
+drives the fleet storms production TPU clusters actually see:
+
+  - BOOT STORM: every node discovers, builds its daemon, and publishes
+    its guarded ResourceSlice at the same instant — the thundering-herd
+    shape the kubeapi.PublishPacer admission window exists for;
+  - MASS VMI ATTACH: K claims per node prepared in one concurrent
+    burst per node (a popular rollout = thousands of VMs attaching
+    simultaneously), riding the PR 4 group-committed checkpoint;
+  - HEALTH-FLIP WAVES: per-node flip storms whose guarded PUTs must
+    coalesce into bounded publish waves with the FINAL state durable
+    (exactly-once, never a lost last transition);
+  - ROLLING DRAIN / UPGRADE WAVES: wave-sized groups drain, restart
+    their DRA driver against the same checkpoint (daemon upgrade), and
+    restore — prepared claims must survive every wave.
+
+The fabric (`FleetApiServer`) models the congestion the RPCAcc paper
+(PAPERS.md) targets: per-request latency, a bounded admission capacity
+answered with 429 beyond it, and arrival-concurrency tracking (peak
+in-flight) so pacing wins are measured, not asserted. Determinism: all
+jitter flows from per-node seeded RNGs, and every acceptance fact is
+counted (publish logs, generations, claim counts) rather than timed.
+
+Storm fan-out uses ThreadPoolExecutor workers synchronized on a
+Barrier — the simulator spawns no raw threads beyond the fabric's one
+tracked serve thread (joined by stop()).
+
+Used by `bench.py --fleet` (docs/bench_fleet_r11.json), the fleet test
+suite (tests/test_fleetsim.py), and `make fleet-soak`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import tempfile
+import threading
+import time
+from concurrent import futures
+from dataclasses import replace
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .config import Config
+from .discovery import discover_passthrough
+from .dra import DraDriver, slice_device_name
+from .kubeapi import ApiClient, PublishPacer
+from .kubeletapi import drapb
+from .server import TpuDevicePlugin
+
+# fabric defaults: a conservative in-cluster apiserver RTT (the same 5 ms
+# rationale as bench.py's ATTACH_APISERVER_RTT_S) and an admission
+# capacity small enough that a 64-node herd actually collides
+DEFAULT_LATENCY_S = 0.005
+DEFAULT_MAX_INFLIGHT = 8
+
+
+def _fakehost():
+    """The sysfs fixture builder lives in tests/ (it is a simulation
+    artifact, not daemon code); the simulator is only runnable from a
+    source checkout, like bench.py."""
+    try:
+        from tests.fakehost import FakeChip, FakeHost
+    except ImportError as exc:   # pragma: no cover - checkout-only tool
+        raise RuntimeError(
+            "fleetsim needs the tests/ tree (tests.fakehost) on "
+            "sys.path — run it from a source checkout") from exc
+    return FakeChip, FakeHost
+
+
+class _FleetHTTPServer(ThreadingHTTPServer):
+    # listen backlog: the default 5 makes a 64-node barrier-released
+    # connect storm hit kernel SYN retransmission timers (seconds of
+    # artificial serialization that would masquerade as pacing wins);
+    # a real apiserver's accept queue is never the modeled bottleneck
+    request_queue_size = 512
+    daemon_threads = True
+
+
+class FleetApiServer:
+    """The shared kube-apiserver fabric with congestion modeling.
+
+    Speaks just enough of the resource.k8s.io + core API for N DRA
+    drivers: group discovery, node GETs (owner refs), ResourceSlice
+    CRUD with resourceVersion guards (guarded PUTs stay exactly-once),
+    and ResourceClaim GETs. Congestion knobs:
+
+      latency_s     — base service time per admitted request, slept with
+                      the GIL released (concurrent requests genuinely
+                      overlap);
+      congestion_k  — when > 0, service time DEGRADES with load:
+                      latency_s * (1 + inflight/congestion_k) — the
+                      convoy shape an overloaded apiserver (etcd fsync
+                      queue, priority-and-fairness queuing) actually
+                      shows, and what makes "peak in-flight" and write
+                      p99 meaningful herd measurements;
+      max_inflight  — admission capacity; arrivals beyond it are
+                      answered 429 immediately (kube priority-and-
+                      fairness shedding), the signal PublishPacer feeds
+                      its window from. 0 = unlimited.
+
+    Counted facts (under one lock): peak arrival concurrency
+    (`peak_inflight`), peak admitted concurrency, totals by outcome,
+    per-write service walls (p50/p99 surface), and the per-slice log of
+    ACCEPTED writes [(monotonic, method, generation)] — the
+    exactly-once audit surface.
+
+    Deliberately NOT a subclass of tests/test_dra.py's FakeApiServer:
+    that fake is a test fixture this package must not import at module
+    scope, and the fleet fabric's contracts diverge on purpose — every
+    store access is locked (N nodes hammer one instance), POST of an
+    existing slice is 409 AlreadyExists (the exactly-once audit depends
+    on it; the test fake last-writer-wins), and admission/congestion/
+    write-log accounting wraps every request. Shared behavior is the
+    thin REST surface, re-stated here in ~100 lines; keep the two in
+    sync when the DRA driver grows a new endpoint.
+    """
+
+    def __init__(self, latency_s: float = 0.0, max_inflight: int = 0,
+                 congestion_k: int = 0, versions=("v1beta1",)):
+        self.latency_s = latency_s
+        self.max_inflight = max_inflight
+        self.congestion_k = congestion_k
+        self.versions = list(versions)
+        self.slices: Dict[str, dict] = {}
+        self.claims: Dict[tuple, dict] = {}
+        self._rv = 0
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._admitted = 0
+        self.stats = {
+            "requests_total": 0,
+            "throttled_total": 0,       # 429s sent
+            "peak_inflight": 0,         # arrival concurrency
+            "peak_admitted": 0,         # concurrency past the 429 gate
+        }
+        # slice name -> [(t_monotonic, method, pool generation), ...]
+        self.write_log: Dict[str, List[tuple]] = {}
+        # service wall (seconds) of every ACCEPTED slice write — the
+        # apiserver-side publish-latency surface (p50/p99 in snapshot())
+        self.write_walls: List[float] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            wbufsize = 65536
+            disable_nagle_algorithm = True
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj=None):
+                body = json.dumps(obj or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _enter(self) -> bool:
+                """Arrival accounting + 429 admission gate."""
+                with outer._lock:
+                    outer.stats["requests_total"] += 1
+                    outer._inflight += 1
+                    if outer._inflight > outer.stats["peak_inflight"]:
+                        outer.stats["peak_inflight"] = outer._inflight
+                    if outer.max_inflight and \
+                            outer._admitted >= outer.max_inflight:
+                        outer.stats["throttled_total"] += 1
+                        return False
+                    outer._admitted += 1
+                    if outer._admitted > outer.stats["peak_admitted"]:
+                        outer.stats["peak_admitted"] = outer._admitted
+                return True
+
+            def _exit(self, admitted: bool) -> None:
+                with outer._lock:
+                    outer._inflight -= 1
+                    if admitted:
+                        outer._admitted -= 1
+
+            def _handle(self, method):
+                admitted = self._enter()
+                # service-wall start for _log_write_locked: only writes
+                # the store ACCEPTS are recorded (409 guard conflicts /
+                # 404s never reach the log), so write_wall percentiles
+                # measure successful publish service time, not refusals
+                self._req_t0 = time.monotonic()
+                try:
+                    if not admitted:
+                        return self._send(429, {"reason": "TooManyRequests"})
+                    if outer.latency_s:
+                        delay = outer.latency_s
+                        if outer.congestion_k:
+                            # load-dependent degradation: the more
+                            # concurrent requests, the slower each one —
+                            # the herd makes ITSELF slow, which is the
+                            # whole case for client-side pacing
+                            with outer._lock:
+                                n = outer._inflight
+                            delay *= 1 + n / outer.congestion_k
+                        time.sleep(delay)   # GIL released: overlaps
+                    return getattr(self, f"_do_{method}")()
+                finally:
+                    self._exit(admitted)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def _do_GET(self):
+                path = self.path
+                if path.rstrip("/") == "/apis/resource.k8s.io":
+                    return self._send(200, {
+                        "kind": "APIGroup", "name": "resource.k8s.io",
+                        "versions": [
+                            {"groupVersion": f"resource.k8s.io/{v}",
+                             "version": v} for v in outer.versions]})
+                if path.startswith("/api/v1/nodes/"):
+                    name = path.rsplit("/", 1)[-1]
+                    return self._send(200, {"metadata": {
+                        "name": name, "uid": f"uid-{name}"}})
+                if "/resourceslices/" in path:
+                    name = path.rsplit("/", 1)[-1]
+                    with outer._lock:
+                        obj = outer.slices.get(name)
+                    if obj is not None:
+                        return self._send(200, obj)
+                    return self._send(404, {"reason": "NotFound"})
+                if "/resourceclaims/" in path:
+                    parts = path.split("/")
+                    ns, name = parts[-3], parts[-1]
+                    obj = outer.claims.get((ns, name))
+                    if obj is not None:
+                        return self._send(200, obj)
+                    return self._send(404, {"reason": "NotFound"})
+                return self._send(404, {})
+
+            def _do_POST(self):
+                obj = self._body()
+                name = obj["metadata"]["name"]
+                with outer._lock:
+                    if name in outer.slices:
+                        # a real apiserver 409s a duplicate create — the
+                        # exactly-once audit depends on this
+                        return self._send(409, {"reason": "AlreadyExists"})
+                    outer._rv += 1
+                    obj["metadata"]["resourceVersion"] = str(outer._rv)
+                    outer.slices[name] = obj
+                    outer._log_write_locked(name, "POST", obj,
+                                            self._req_t0)
+                return self._send(201, obj)
+
+            def _do_PUT(self):
+                name = self.path.rsplit("/", 1)[-1]
+                obj = self._body()
+                with outer._lock:
+                    live = outer.slices.get(name)
+                    if live is None:
+                        return self._send(404, {})
+                    if (obj["metadata"].get("resourceVersion")
+                            != live["metadata"]["resourceVersion"]):
+                        return self._send(409, {"reason": "Conflict"})
+                    outer._rv += 1
+                    obj["metadata"]["resourceVersion"] = str(outer._rv)
+                    outer.slices[name] = obj
+                    outer._log_write_locked(name, "PUT", obj,
+                                            self._req_t0)
+                return self._send(200, obj)
+
+            def _do_DELETE(self):
+                name = self.path.rsplit("/", 1)[-1]
+                with outer._lock:
+                    if outer.slices.pop(name, None) is None:
+                        return self._send(404, {})
+                return self._send(200, {})
+
+        self.server = _FleetHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True,
+            name="fleet-apiserver")
+        self.thread.start()
+
+    def _log_write_locked(self, name: str, method: str, obj: dict,
+                          t0: float) -> None:
+        now = time.monotonic()
+        gen = (((obj.get("spec") or {}).get("pool") or {})
+               .get("generation")) or 1
+        self.write_log.setdefault(name, []).append((now, method, gen))
+        self.write_walls.append(now - t0)
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def add_claim(self, ns, name, uid, driver, results) -> None:
+        self.claims[(ns, name)] = {
+            "metadata": {"namespace": ns, "name": name, "uid": uid},
+            "status": {"allocation": {"devices": {"results": [
+                {"request": r.get("request", "tpu"), "driver": driver,
+                 "pool": r.get("pool", "fleet"), "device": r["device"]}
+                for r in results
+            ]}}},
+        }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["slices"] = len(self.slices)
+            out["accepted_writes"] = sum(
+                len(v) for v in self.write_log.values())
+            walls = sorted(self.write_walls)
+        if walls:
+            out["write_wall_p50_ms"] = round(
+                1e3 * walls[len(walls) // 2], 1)
+            out["write_wall_p99_ms"] = round(
+                1e3 * walls[min(len(walls) - 1,
+                               int(len(walls) * 0.99))], 1)
+            out["write_wall_max_ms"] = round(1e3 * walls[-1], 1)
+        return out
+
+    def exactly_once_audit(self) -> dict:
+        """Counted exactly-once facts over the accepted-write log: every
+        slice's generation sequence must be strictly increasing with no
+        duplicates (a duplicated generation = a replayed publish; a gap
+        is fine — unchanged projections skip publishes, never the other
+        way around)."""
+        with self._lock:
+            logs = {k: list(v) for k, v in self.write_log.items()}
+        duplicated = []
+        regressed = []
+        for name, entries in logs.items():
+            gens = [g for _, _, g in entries]
+            if len(gens) != len(set(gens)):
+                duplicated.append(name)
+            if any(b <= a for a, b in zip(gens, gens[1:])):
+                regressed.append(name)
+        return {"slices_audited": len(logs),
+                "duplicated_generations": sorted(duplicated),
+                "regressed_generations": sorted(regressed),
+                "exactly_once": not duplicated and not regressed}
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self.thread.is_alive():
+            self.thread.join(timeout=2)
+
+
+class FleetNode:
+    """One simulated node: isolated sysfs root, plugin server (direct
+    servicer surface — no gRPC socket; the kubelet side of a fleet storm
+    is exercised through the same handlers the socket would call), and a
+    DRA driver whose pacer jitter is seeded per node."""
+
+    def __init__(self, root: str, index: int, apiserver: FleetApiServer,
+                 n_devices: int = 4, pace_max_s: float = 2.0,
+                 pace_base_s: float = 0.0, pace: bool = True,
+                 seed: int = 0):
+        FakeChip, FakeHost = _fakehost()
+        self._pace = pace
+        self.index = index
+        self.name = f"node-{index:03d}"
+        self.root = os.path.join(root, self.name)
+        self.apiserver = apiserver
+        host = FakeHost(self.root)
+        for i in range(n_devices):
+            host.add_chip(FakeChip(
+                f"0000:{i // 32:02x}:{4 + i % 32:02x}.0",
+                device_id="0063", iommu_group=str(11 + i),
+                numa_node=i // max(1, n_devices // 2)))
+        self.cfg = replace(Config().with_root(self.root),
+                           publish_pace_base_s=pace_base_s,
+                           publish_pace_max_s=pace_max_s,
+                           lw_debounce_s=0.0)
+        os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
+        self.registry, self.generations = discover_passthrough(self.cfg)
+        self.devices = self.registry.devices_by_model["0063"]
+        self.bdfs = [d.bdf for d in self.devices]
+        self._seed = seed
+        self.driver = self._build_driver()
+        # the plugin's ANDed health verdicts feed the driver exactly like
+        # cli.py wires the production daemon: one health observer, no
+        # second driftable watcher
+        self.plugin = TpuDevicePlugin(
+            self.cfg, "v5e", self.registry, self.devices,
+            health_listener=self._health_listener)
+
+    def _build_driver(self) -> DraDriver:
+        driver = DraDriver(
+            self.cfg, self.registry, self.generations,
+            node_name=self.name,
+            api=ApiClient(self.apiserver.url, token_path="/nonexistent"))
+        # deterministic jitter: the fleet's pacing behavior replays
+        # exactly under a fixed fleet seed. The unpaced control keeps
+        # the same plumbing with a zero window and a deep retry budget —
+        # the naive keep-hammering client the pacer replaces.
+        driver.pacer = PublishPacer(
+            api=driver.api,
+            base_window_s=self.cfg.publish_pace_base_s if self._pace
+            else 0.0,
+            max_window_s=self.cfg.publish_pace_max_s if self._pace
+            else 0.0,
+            max_attempts=16 if self._pace else 50,
+            rng=random.Random((self._seed << 16) ^ self.index))
+        return driver
+
+    def _health_listener(self, current: Dict[str, bool]) -> None:
+        self.driver.apply_health(current)
+
+    # ------------------------------------------------------------ storms
+
+    def boot(self) -> bool:
+        """One node's boot-storm contribution: publish the guarded
+        ResourceSlice and assemble the initial ListAndWatch send from
+        the current epoch (the kubelet-visible boot payload)."""
+        ok = self.driver.publish_resource_slices()
+        self.plugin._lw_response(self.plugin._store.current)
+        return ok
+
+    def register_claims(self, k: int, wave: int = 0) -> List[str]:
+        uids = [f"{self.name}-w{wave}-c{i}" for i in range(k)]
+        for i, uid in enumerate(uids):
+            self.apiserver.add_claim(
+                "fleet", uid, uid, self.driver.driver_name,
+                [{"device": slice_device_name(
+                    self.bdfs[i % len(self.bdfs)])}])
+        return uids
+
+    def attach(self, uids: List[str]):
+        claims = [drapb.Claim(namespace="fleet", name=uid, uid=uid)
+                  for uid in uids]
+        return self.driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=claims), None)
+
+    def flip_storm(self, flips: int) -> None:
+        """Alternate one device unhealthy/healthy `flips` times: each
+        EFFECTIVE transition publishes (paced, coalescible); the final
+        state must still land exactly (asserted fleet-wide)."""
+        for i in range(flips):
+            self.plugin.set_devices_health(
+                [self.bdfs[0]], healthy=(i % 2 == 1), source="storm")
+        # end healthy: an even flip count leaves the last verdict
+        # unhealthy, so normalize for the convergence audit
+        self.plugin.set_devices_health([self.bdfs[0]], healthy=True,
+                                       source="storm")
+
+    def drain(self) -> None:
+        self.plugin.set_all_health(False, source="drain")
+
+    def restore(self) -> None:
+        self.plugin.set_all_health(True, source="drain")
+
+    def upgrade(self) -> bool:
+        """Daemon upgrade: stop the driver, rebuild it against the SAME
+        checkpoint (claims must survive), republish."""
+        before = self.driver.prepared_claim_count()
+        self.driver.stop()
+        self.driver = self._build_driver()
+        if self.driver.prepared_claim_count() != before:
+            raise AssertionError(
+                f"{self.name}: upgrade lost claims "
+                f"({before} -> {self.driver.prepared_claim_count()})")
+        return self.driver.publish_resource_slices()
+
+    def pacer_stats(self) -> dict:
+        return self.driver.pacer.snapshot()
+
+    def stop(self) -> None:
+        self.driver.stop()
+
+
+class FleetSim:
+    """N FleetNodes against one FleetApiServer, plus the storm drivers.
+
+    `pace=False` builds the control fleet: the same pacer plumbing with
+    a zero-ceiling window — throttled publishes retry IMMEDIATELY (the
+    naive thundering-herd client) so paced-vs-unpaced comparisons
+    differ only in the admission window adaptation.
+    """
+
+    def __init__(self, n_nodes: int, devices_per_node: int = 4,
+                 latency_s: float = DEFAULT_LATENCY_S,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 congestion_k: int = 0,
+                 pace: bool = True, pace_max_s: float = 2.0,
+                 pace_base_s: float = 0.0,
+                 seed: int = 0, root: Optional[str] = None,
+                 build_workers: int = 16):
+        self.n_nodes = n_nodes
+        self._own_root = root is None
+        self.root = root or tempfile.mkdtemp(prefix="tdpfleet-")
+        self.apiserver = FleetApiServer(latency_s=latency_s,
+                                        max_inflight=max_inflight,
+                                        congestion_k=congestion_k)
+        with futures.ThreadPoolExecutor(
+                max_workers=min(build_workers, max(1, n_nodes))) as pool:
+            self.nodes: List[FleetNode] = list(pool.map(
+                lambda i: FleetNode(self.root, i, self.apiserver,
+                                    n_devices=devices_per_node,
+                                    pace_max_s=pace_max_s,
+                                    pace_base_s=pace_base_s,
+                                    pace=pace, seed=seed),
+                range(n_nodes)))
+
+    def _storm(self, fn) -> List:
+        """Run fn(node) on every node concurrently, all released from
+        one barrier (the coordinated-storm shape). Exceptions propagate
+        — a storm that errored must fail the run, not vanish into a
+        worker thread."""
+        barrier = threading.Barrier(self.n_nodes)
+
+        def run_one(node):
+            barrier.wait(timeout=60)
+            return fn(node)
+
+        with futures.ThreadPoolExecutor(max_workers=self.n_nodes) as pool:
+            return list(pool.map(run_one, self.nodes))
+
+    # --------------------------------------------------------- scenarios
+
+    def boot_storm(self) -> dict:
+        t0 = time.monotonic()
+        results = self._storm(lambda n: n.boot())
+        wall_s = time.monotonic() - t0
+        audit = self.apiserver.exactly_once_audit()
+        return {
+            "nodes": self.n_nodes,
+            "published_ok": sum(bool(r) for r in results),
+            "wall_s": round(wall_s, 3),
+            "apiserver": self.apiserver.snapshot(),
+            "pacing": self.pacer_totals(),
+            "exactly_once": audit["exactly_once"],
+            "audit": audit,
+        }
+
+    def attach_storm(self, claims_per_node: int, wave: int = 0) -> dict:
+        uids_by_node = {n.index: n.register_claims(claims_per_node, wave)
+                        for n in self.nodes}
+        commits_before = sum(
+            n.driver.checkpoint_stats()["checkpoint_commits_total"]
+            for n in self.nodes)
+        t0 = time.monotonic()
+
+        def attach(node):
+            """One node's storm contribution, with the kubelet's retry
+            behavior: NodePrepareResources claims that error (e.g. a
+            throttled claim GET that exhausted the client's bounded 429
+            retries) are re-prepared — prepare is idempotent — until all
+            land or the retry budget is spent. Returns (errors, retries)."""
+            pending = list(uids_by_node[node.index])
+            retries = 0
+            failures: List[str] = []
+            for round_no in range(6):
+                resp = node.attach(pending)
+                failures = [uid for uid in pending
+                            if resp.claims[uid].error]
+                if not failures:
+                    return [], retries
+                retries += len(failures)
+                pending = failures
+                time.sleep(0.05 * (round_no + 1))
+            return [f"{uid}: {resp.claims[uid].error}"
+                    for uid in failures], retries
+
+        results = self._storm(attach)
+        errors = [e for errs, _ in results for e in errs]
+        retried = sum(r for _, r in results)
+        wall_s = time.monotonic() - t0
+        commits = sum(
+            n.driver.checkpoint_stats()["checkpoint_commits_total"]
+            for n in self.nodes) - commits_before
+        total = claims_per_node * self.n_nodes
+        return {
+            "nodes": self.n_nodes,
+            "claims_per_node": claims_per_node,
+            "claims_total": total,
+            "errors": errors,
+            "claim_retries": retried,
+            "wall_s": round(wall_s, 3),
+            "claims_per_s": round(total / max(1e-9, wall_s), 1),
+            "checkpoint_commits": commits,
+            "prepared_total": sum(n.driver.prepared_claim_count()
+                                  for n in self.nodes),
+        }
+
+    def flip_wave(self, flips_per_node: int) -> dict:
+        writes_before = self.apiserver.snapshot()["accepted_writes"]
+        t0 = time.monotonic()
+        self._storm(lambda n: n.flip_storm(flips_per_node))
+        self.settle()
+        wall_s = time.monotonic() - t0
+        converged = self.assert_converged()
+        return {
+            "nodes": self.n_nodes,
+            "flips_per_node": flips_per_node,
+            "wall_s": round(wall_s, 3),
+            "accepted_writes": (self.apiserver.snapshot()["accepted_writes"]
+                                - writes_before),
+            "pacing": self.pacer_totals(),
+            "converged": converged,
+            "exactly_once":
+                self.apiserver.exactly_once_audit()["exactly_once"],
+        }
+
+    def drain_upgrade_wave(self, wave_size: int) -> dict:
+        """Rolling drain → upgrade → restore in wave_size-node groups
+        (the fleet rollout shape); claims survive every upgrade by
+        assertion inside FleetNode.upgrade."""
+        t0 = time.monotonic()
+        waves = 0
+        for start in range(0, self.n_nodes, wave_size):
+            group = self.nodes[start:start + wave_size]
+            waves += 1
+            barrier = threading.Barrier(len(group))
+
+            def roll(node, barrier=barrier):
+                barrier.wait(timeout=60)
+                node.drain()
+                ok = node.upgrade()
+                node.restore()
+                return ok
+
+            with futures.ThreadPoolExecutor(
+                    max_workers=len(group)) as pool:
+                list(pool.map(roll, group))
+        self.settle()
+        wall_s = time.monotonic() - t0
+        return {
+            "nodes": self.n_nodes,
+            "wave_size": wave_size,
+            "waves": waves,
+            "wall_s": round(wall_s, 3),
+            "converged": self.assert_converged(),
+            "exactly_once":
+                self.apiserver.exactly_once_audit()["exactly_once"],
+            "prepared_total": sum(n.driver.prepared_claim_count()
+                                  for n in self.nodes),
+        }
+
+    # ------------------------------------------------------------- audit
+
+    def _expected_devices(self, node: FleetNode) -> set:
+        return {slice_device_name(b) for b in node.bdfs} \
+            - {slice_device_name(b)
+               for b in node.driver.unhealthy_devices()}
+
+    def _node_matches(self, node: FleetNode) -> bool:
+        with self.apiserver._lock:
+            obj = self.apiserver.slices.get(node.driver.slice_name())
+        if obj is None:
+            return False
+        return {d["name"] for d in obj["spec"]["devices"]} \
+            == self._expected_devices(node)
+
+    def settle(self, rounds: int = 5) -> None:
+        """Compress the production republish-retry timer: a publish that
+        exhausted its throttle budget under a storm returns False and
+        arms a jittered 5-30 s retry (dra._arm_republish_retry) — far
+        too slow for a deterministic storm assertion. Re-drive exactly
+        the nodes whose slice does not yet match; an already-matching
+        node's republish is a no-op GET (unchanged projection), so
+        settling never disturbs the exactly-once write audit."""
+        for _ in range(rounds):
+            pending = [n for n in self.nodes
+                       if not self._node_matches(n)]
+            if not pending:
+                return
+            for node in pending:
+                node.driver.publish_resource_slices()
+
+    def assert_converged(self) -> bool:
+        """Every node's published slice must advertise exactly its
+        healthy device set (counted, not timed)."""
+        for node in self.nodes:
+            name = node.driver.slice_name()
+            with self.apiserver._lock:
+                obj = self.apiserver.slices.get(name)
+            if obj is None:
+                raise AssertionError(f"{node.name}: slice missing")
+            published = {d["name"] for d in obj["spec"]["devices"]}
+            expected = self._expected_devices(node)
+            if published != expected:
+                raise AssertionError(
+                    f"{node.name}: slice devices {sorted(published)} != "
+                    f"expected {sorted(expected)}")
+        return True
+
+    def pacer_totals(self) -> dict:
+        totals = {"publish_waves_total": 0, "publishes_coalesced_total": 0,
+                  "publish_throttled_total": 0, "pacing_delays_total": 0}
+        for node in self.nodes:
+            snap = node.pacer_stats()
+            for key in totals:
+                totals[key] += snap[key]
+        return totals
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            node.stop()
+        self.apiserver.stop()
+        if self._own_root:
+            shutil.rmtree(self.root, ignore_errors=True)

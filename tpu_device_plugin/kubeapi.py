@@ -14,12 +14,14 @@ import http.client
 import json
 import logging
 import os
+import random
 import ssl
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 from urllib.parse import urlsplit
 
+from . import epoch as epoch_mod
 from . import faults
 from . import lockdep
 from . import trace
@@ -43,6 +45,12 @@ _RETRYABLE_STALE = (http.client.BadStatusLine,
                     ConnectionResetError, ConnectionAbortedError)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# bounded in-call retries for a 429-throttled GET (reads are idempotent;
+# writes go through PublishPacer's re-admission instead). 4 retries at
+# the jittered 50-500 ms client-wide backoff rides out a boot-storm
+# congestion spike without turning one kubelet RPC into an unbounded wait.
+THROTTLED_GET_RETRIES = 4
 
 
 def in_cluster_server() -> Optional[str]:
@@ -113,6 +121,21 @@ class ApiClient:
         # (below): lets a restarting apiserver finish its listen() instead
         # of immediately eating the one retry the contract allows
         self._stale_backoff = BackoffPolicy(base_s=0.02, cap_s=0.2)
+        # jittered client-wide backoff for 429-throttled GETs (below):
+        # shared across this client's threads on purpose — when the
+        # apiserver sheds load, EVERY reader of this client slows down
+        # together instead of each thread independently hammering
+        self._throttle_backoff = BackoffPolicy(base_s=0.05, cap_s=0.5)
+        # Congestion signals consumed by PublishPacer: 429s (apiserver
+        # priority-and-fairness shedding load), the calling thread's
+        # last observed RTT (last_rtt_s property), and the thread's last
+        # error code. throttled_total is an AtomicCounter (lock-free,
+        # exact, client-wide — the /status-style aggregate); everything
+        # the pacer classifies from is PER-THREAD (_throttle_tls), so
+        # concurrent prepare workers' traffic on the same client can
+        # never be misattributed to a publish.
+        self.throttled_total = epoch_mod.AtomicCounter()
+        self._throttle_tls = threading.local()
 
     def _new_conn(self) -> http.client.HTTPConnection:
         if self._https:
@@ -168,29 +191,101 @@ class ApiClient:
                            f"(apiserver failing; next probe within "
                            f"{self.breaker.reset_timeout_s:.0f}s)",
                            code=0)
+        # The 429-GET retry loop sits OUTSIDE the per-attempt span below:
+        # the backoff sleeps are client-side waiting, not server RTT, and
+        # folding them into tdp_kubeapi_rtt_ms would read seconds for
+        # requests the server answered in ~1 ms exactly when the
+        # apiserver throttles — the same honesty rule that keeps the
+        # breaker fast-fail out of the span. A throttled GET — whose
+        # replay cannot duplicate a write — retries behind a client-wide
+        # jittered backoff (every reader of this client slows down
+        # together); throttled WRITES never retry at this layer — the
+        # publish pacer owns their re-admission.
+        for attempt in range(THROTTLED_GET_RETRIES + 1):
+            try:
+                return self._traced_attempt(path, method, body,
+                                            content_type, url)
+            except ApiError as exc:
+                if exc.code == 429 and method == "GET" \
+                        and attempt < THROTTLED_GET_RETRIES:
+                    time.sleep(self._throttle_backoff.next_delay())
+                    continue
+                raise
+        raise ApiError(f"{method} {url}: throttle retry fell "
+                       f"through")  # unreachable
+
+    def _traced_attempt(self, path: str, method: str,
+                        body: Optional[bytes],
+                        content_type: Optional[str], url: str) -> bytes:
+        """One traced wire attempt: its span IS one server round trip
+        (tdp_kubeapi_rtt_ms stays an RTT histogram even under throttle
+        storms), with breaker + congestion-signal accounting."""
         with trace.span("kubeapi.request", histogram="tdp_kubeapi_rtt_ms",
                         method=method, path=path):
+            tls = self._throttle_tls
+            t0 = time.monotonic()
             try:
-                # fault point "kubeapi.request" (raising): an armed fault
-                # fails the request before the wire, as a transport error
-                # would
+                # fault point "kubeapi.request" (raising): an armed
+                # fault fails the request before the wire, as a
+                # transport error would
                 faults.fire("kubeapi.request", method=method, path=path)
-                data = self._request_once(path, method, body, content_type,
-                                          url)
+                data = self._request_once(path, method, body,
+                                          content_type, url)
             except ApiError as exc:
-                if exc.code == 0 or exc.code >= 500:
+                tls.rtt = time.monotonic() - t0
+                tls.last_code = exc.code
+                if exc.code == 429:
+                    # apiserver shedding load (priority-and-fairness):
+                    # the pacing layer widens its admission window on
+                    # this signal; the server ANSWERED, so the breaker
+                    # records success like any other 4xx
+                    self.throttled_total.add()
+                    tls.count = getattr(tls, "count", 0) + 1
+                    self.breaker.record_success()
+                elif exc.code == 0 or exc.code >= 500:
                     self.breaker.record_failure()
                 else:
                     self.breaker.record_success()  # 3xx/4xx: alive
                 raise
             except Exception as exc:
-                # injected fault of a non-ApiError kind: surface it under
-                # the client's one exception contract
+                # injected fault of a non-ApiError kind: surface it
+                # under the client's one exception contract
                 self.breaker.record_failure()
+                tls.last_code = 0
                 raise ApiError(f"{method} {url}: {exc}") from exc
+            tls.rtt = time.monotonic() - t0
             self.breaker.record_success()
             self._stale_backoff.reset()
+            self._throttle_backoff.reset()
             return data
+
+    # -- per-thread congestion signals (PublishPacer's classification) ----
+
+    @property
+    def last_rtt_s(self) -> float:
+        """The CALLING thread's most recent server round-trip time —
+        the pacer's slow-RTT signal (per-thread so another worker's
+        request can never overwrite the publish's own reading)."""
+        return getattr(self._throttle_tls, "rtt", 0.0)
+
+    def thread_throttled_count(self) -> int:
+        """429s observed by the CALLING thread's requests."""
+        return getattr(self._throttle_tls, "count", 0)
+
+    def reset_thread_error(self) -> None:
+        """Clear the calling thread's last-error record (the pacer calls
+        this at wave start so a stale code from earlier traffic cannot
+        classify this wave)."""
+        self._throttle_tls.last_code = None
+
+    def thread_last_error_code(self) -> Optional[int]:
+        """HTTP code of the CALLING thread's most recent FAILED request
+        (None if none since reset). The pacer classifies a failed wave
+        as throttled only when the request that made it give up was a
+        429 — a publish whose internal GET drew a retried-away 429 but
+        whose PUT then failed 5xx must return to the caller's republish
+        machinery, not re-admit."""
+        return getattr(self._throttle_tls, "last_code", None)
 
     def _request_once(self, path: str, method: str, body: Optional[bytes],
                       content_type: Optional[str], url: str) -> bytes:
@@ -279,3 +374,214 @@ class ApiClient:
         return self.request(
             path, method="PATCH", body=json.dumps(obj).encode(),
             content_type="application/strategic-merge-patch+json")
+
+
+# ---------------------------------------------------------------- pacing
+
+# Admission-window bounds for PublishPacer. The window starts at the
+# configured base (default 0: an unloaded node publishes with zero added
+# latency) and adapts: multiplicative increase on a 429 or a slow RTT,
+# halving decay on fast successes — AIMD, the same shape TCP and RPCAcc-
+# style PCIe RPC pacing use, because the fleet problem is the same: N
+# independent senders discovering one server's capacity without a
+# coordinator.
+PACE_GROW_FLOOR_S = 0.05     # first growth step when the window was ~0
+PACE_MAX_WINDOW_S = 2.0      # adaptation ceiling
+PACE_SLOW_RTT_S = 0.25       # RTT above this reads as server congestion
+PACE_MAX_ATTEMPTS = 8        # throttled-publish retries within one run()
+
+
+class PublishPacer:
+    """Per-client adaptive pacing + coalescing for guarded publishes.
+
+    The fleet congestion shape (ROADMAP item 1 / RPCAcc in PAPERS.md):
+    N nodes boot at once and every daemon's guarded ResourceSlice PUT
+    lands on the apiserver in the same instant — a thundering herd the
+    server answers with 429s, which naive clients retry immediately,
+    keeping peak in-flight at N forever. This class bounds that:
+
+    - ADMISSION WINDOW: a publish first waits a jittered delay drawn
+      from the current window. The window starts at `base_window_s`
+      (default 0 — steady-state single-node publishes pay nothing) and
+      adapts on feedback from the ApiClient's congestion signals: a 429
+      or a slow RTT doubles it (from PACE_GROW_FLOOR_S when it was ~0),
+      a fast success halves it back toward base. Across a fleet the
+      jittered, independently-grown windows turn N simultaneous PUTs
+      into bounded-rate waves.
+    - COALESCING: publishers arriving while a wave is still in its
+      admission wait JOIN that wave instead of queueing their own —
+      the leader builds the slice body AFTER admission, so the joined
+      caller's state rides the same PUT (`publishes_coalesced_total`).
+      A health-flip storm inside one daemon becomes one PUT, not one
+      per flip.
+    - THROTTLE RETRY: a publish the server answered with 429 is retried
+      through a re-grown window (bounded by PACE_MAX_ATTEMPTS), so a
+      boot storm converges without waiting for the caller's slow
+      republish timer. Non-throttle failures return False immediately —
+      the existing retry machinery (republish backoff, chaos contracts)
+      owns those.
+
+    Exactly-once is untouched: the pacer never replays a publish the
+    server may have applied — it only delays, coalesces, and retries
+    attempts the server REFUSED (429 = not executed, by definition).
+
+    Counters (`stats`) mutate under `_cond` (tsalint COUNTERS entry);
+    admission delays are recorded into the `tdp_pacing_delay_ms`
+    histogram (trace.py). `rng` is injectable so fleet simulations are
+    deterministic.
+    """
+
+    def __init__(self, api: Optional[ApiClient] = None,
+                 base_window_s: float = 0.0,
+                 max_window_s: float = PACE_MAX_WINDOW_S,
+                 slow_rtt_s: float = PACE_SLOW_RTT_S,
+                 max_attempts: int = PACE_MAX_ATTEMPTS,
+                 rng: Optional[random.Random] = None) -> None:
+        self.api = api
+        self.base_window_s = max(0.0, base_window_s)
+        self.max_window_s = max_window_s
+        self.slow_rtt_s = slow_rtt_s
+        self.max_attempts = max(1, max_attempts)
+        self._rng = rng or random.Random()
+        self._cond = lockdep.instrument(
+            "kubeapi.PublishPacer._cond", threading.Condition())
+        # state machine: idle -> waiting (admission; joinable) ->
+        # publishing -> idle. All state below is guarded by _cond.
+        self._state = "idle"
+        self._window_s = self.base_window_s
+        self._wave_seq = 0       # waves opened (leader entered waiting)
+        self._done_seq = 0       # waves completed
+        self._last_result = False
+        self.stats = {
+            # publish waves actually sent to the server (leader attempts)
+            "publish_waves_total": 0,
+            # callers whose state rode another caller's wave
+            "publishes_coalesced_total": 0,
+            # waves the server answered 429 and the pacer re-admitted
+            "publish_throttled_total": 0,
+            # admission waits with a non-zero delay
+            "pacing_delays_total": 0,
+        }
+
+    def snapshot(self) -> dict:
+        """Lock-free stats read (fixed-key dict: C-atomic copy + GIL-
+        atomic int reads), plus the current admission window — the
+        /status surface."""
+        out = dict(self.stats)
+        out["window_ms"] = round(self._window_s * 1e3, 3)
+        return out
+
+    def _wave_start(self) -> None:
+        if self.api is not None:
+            self.api.reset_thread_error()
+
+    def _wave_throttled(self, ok: bool) -> bool:
+        """A FAILED wave is throttled iff the request that made it give
+        up answered 429. publish_fn runs synchronously on this thread,
+        and the client's last-error record is per-thread and reset at
+        wave start — so neither concurrent workers' traffic nor a
+        retried-away internal 429 followed by a 5xx PUT can re-admit a
+        wave that must return to the caller's republish machinery."""
+        if ok or self.api is None:
+            return False
+        return self.api.thread_last_error_code() == 429
+
+    def _wave_rtt_s(self, wall_s: float) -> float:
+        """The slow-RTT adaptation signal: the publish's own last server
+        round trip when a client is wired (per-thread last_rtt_s), the
+        whole-wave wall otherwise (tests / detached pacers)."""
+        if self.api is not None:
+            rtt = self.api.last_rtt_s
+            if rtt > 0:
+                return rtt
+        return wall_s
+
+    def _adapt_locked(self, ok: bool, rtt_s: float, throttled: bool) -> None:
+        if throttled:
+            self._window_s = min(self.max_window_s,
+                                 max(PACE_GROW_FLOOR_S, self._window_s * 2))
+        elif rtt_s > self.slow_rtt_s:
+            self._window_s = min(self.max_window_s,
+                                 max(PACE_GROW_FLOOR_S / 2,
+                                     self._window_s * 1.5))
+        elif ok:
+            decayed = self._window_s / 2
+            self._window_s = self.base_window_s \
+                if decayed < max(self.base_window_s, 1e-3) else decayed
+
+    def run(self, publish_fn: Callable[[], bool]) -> bool:
+        """Publish through the pacer; returns publish_fn's result (or a
+        completed wave's result when this caller coalesced onto it).
+
+        publish_fn must build the published body from CURRENT state when
+        invoked (the DRA driver's `_publish_locked` does): that is what
+        makes joining a wave that has not yet built its body correct.
+        """
+        cond = self._cond
+        with cond:
+            while True:
+                if self._state == "waiting":
+                    # a wave is still in its admission wait: our state
+                    # will be in the body it builds after admission
+                    joined = self._wave_seq
+                    self.stats["publishes_coalesced_total"] += 1
+                    cond.wait_for(lambda: self._done_seq >= joined)
+                    return self._last_result
+                if self._state == "publishing":
+                    # too late to join (the body may already be built):
+                    # wait for the wave to finish, then lead our own
+                    cond.wait_for(lambda: self._state != "publishing")
+                    continue
+                self._state = "waiting"
+                self._wave_seq += 1
+                break
+        ok = False
+        try:
+            attempt = 0
+            while True:
+                with cond:
+                    window = self._window_s
+                    # uniform over the FULL window: a fleet of pacers
+                    # with the same window then spreads a simultaneous
+                    # storm evenly across it (a [w/2, w] draw would
+                    # re-clump every node into the window's second half)
+                    delay = self._rng.uniform(0.0, window) \
+                        if window > 0 else 0.0
+                    if delay > 0:
+                        self.stats["pacing_delays_total"] += 1
+                        deadline = time.monotonic() + delay
+                        while True:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            cond.wait(timeout=remaining)
+                    self._state = "publishing"
+                    self.stats["publish_waves_total"] += 1
+                if delay > 0:
+                    # 0-delay waves (the unloaded steady state) are not
+                    # recorded: they would collapse the histogram's
+                    # percentiles to 0 exactly when pacing is idle
+                    trace.observe("tdp_pacing_delay_ms", delay * 1e3)
+                self._wave_start()
+                t0 = time.monotonic()
+                ok = publish_fn()
+                wall = time.monotonic() - t0
+                throttled = self._wave_throttled(ok)
+                with cond:
+                    self._adapt_locked(ok, self._wave_rtt_s(wall),
+                                       throttled)
+                    if ok or not throttled \
+                            or attempt >= self.max_attempts - 1:
+                        return ok
+                    # 429: the server refused (never executed) the PUT —
+                    # re-admit through the grown window; new arrivals
+                    # coalesce onto the retry
+                    attempt += 1
+                    self.stats["publish_throttled_total"] += 1
+                    self._state = "waiting"
+        finally:
+            with cond:
+                self._state = "idle"
+                self._done_seq = self._wave_seq
+                self._last_result = ok
+                cond.notify_all()

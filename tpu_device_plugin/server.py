@@ -195,6 +195,18 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # ListAndWatch re-sends since start (initial snapshots excluded):
         # the observable cost of health churn on the kubelet stream
         self._lw_resends = epoch_mod.AtomicCounter()
+        # Epoch (and pre-serialized ListAndWatch payload) builds since
+        # start: the scale-honesty counter. A health flip of SOME OTHER
+        # resource must never bump this — untouched resources keep their
+        # epoch (and its payload bytes) by identity; at 4096 devices a
+        # spurious rebuild is a multi-ms serialize the flip did not need
+        # (pinned by tests/test_epoch.py + bench.py --scale).
+        self._epoch_builds = epoch_mod.AtomicCounter()
+        # /status diagnostics cache: (monotonic ts, errors, degraded) —
+        # one attribute store, read lock-free (cfg.diagnostics_ttl_s > 0
+        # serves repeat scrapes without re-reading 2 sysfs files per
+        # device; 0 = always live)
+        self._diag_cache: Optional[Tuple[float, dict, dict]] = None
         self._build_device_table()
 
     # ------------------------------------------------------------------ state
@@ -208,6 +220,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
     def _build_device_table(self) -> None:
         self._rows = self._device_rows()
         self._row_ids = frozenset(dev_id for dev_id, _ in self._rows)
+        # a rebuilt table retires the diagnostics cache: a departed
+        # device's latched error bits must not be served (nor a
+        # readmitted device's fresh ones hidden) for up to a TTL
+        self._diag_cache = None
         with self._store.lock():
             self._publish_epoch_locked()
 
@@ -216,6 +232,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         (caller holds store.lock()). Also swaps in a fresh pref memo —
         the epoch-id key makes stale hits impossible, the swap just stops
         dead entries from pinning the cap."""
+        self._epoch_builds.add()
         ep = self._store.publish_locked(epoch_mod.build_server_epoch(
             self._store.current.epoch_id + 1, self._rows,
             self._health_sources))
@@ -491,18 +508,28 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             # latched PCI bus-error bits (XID-events analogue) + PCIe link
             # training state (CurrPcieLinkWidth analogue): diagnostic only,
             # ONE config read per device — sysfs reads must never block RPC
-            # paths, and here nothing they could block on is held
-            errors = {}
-            degraded_links = {}
-            for d in self.devices:
-                bits, link = self.health_shim.chip_diagnostics(
-                    self.cfg.pci_base_path, d.bdf)
-                if bits:
-                    errors[d.bdf] = f"0x{bits:04x}"
-                if link_is_degraded(link):
-                    degraded_links[d.bdf] = (
-                        f"gen{link['cur_speed']}x{link['cur_width']} of "
-                        f"gen{link['max_speed']}x{link['max_width']}")
+            # paths, and here nothing they could block on is held. At
+            # fleet scale (4096 devices = 8192 reads/scrape) a small
+            # cfg.diagnostics_ttl_s serves repeat scrapes from the last
+            # read set; the cache is a single attribute store, lock-free.
+            ttl = getattr(self.cfg, "diagnostics_ttl_s", 0.0)
+            cached = self._diag_cache
+            now = time.monotonic()
+            if ttl > 0 and cached is not None and now - cached[0] < ttl:
+                errors, degraded_links = cached[1], cached[2]
+            else:
+                errors = {}
+                degraded_links = {}
+                for d in self.devices:
+                    bits, link = self.health_shim.chip_diagnostics(
+                        self.cfg.pci_base_path, d.bdf)
+                    if bits:
+                        errors[d.bdf] = f"0x{bits:04x}"
+                    if link_is_degraded(link):
+                        degraded_links[d.bdf] = (
+                            f"gen{link['cur_speed']}x{link['cur_width']} of "
+                            f"gen{link['max_speed']}x{link['max_width']}")
+                self._diag_cache = (now, errors, degraded_links)
             pref_cache = {"hits": self._pref_hits.value,
                           "misses": self._pref_misses.value,
                           "size": len(self._pref_cache),
@@ -515,6 +542,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 # the read-plane generation (epoch.EpochStore): bumps on
                 # every effective health transition / table rebuild
                 "epoch": ep.epoch_id,
+                # epoch builds this server actually paid (scale honesty:
+                # flips of OTHER resources must not bump this — at 4096
+                # devices each build re-serializes the full LW payload)
+                "epoch_builds": self._epoch_builds.value,
                 # GetPreferredAllocation memo effectiveness + ListAndWatch
                 # re-send count (how much health churn reached the kubelet
                 # stream after coalescing)

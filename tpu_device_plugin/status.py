@@ -53,6 +53,10 @@ class StatusServer:
                  dra_driver=None):
         self.manager = manager
         self.dra_driver = dra_driver
+        # assembly accounting of the most recent /metrics render (series,
+        # parts, bytes_joined == bytes_rendered): the O(series) scrape
+        # guard reads this (test_perf_honesty.py, bench.py --scale)
+        self.scrape_stats: dict = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -227,6 +231,10 @@ class StatusServer:
                 # delta (generation-keyed guarded PUT) vs full
                 # (read-modify-write) slice publishes
                 "publish_stats": dict(d.publish_stats),
+                # publish pacing + coalescing (kubeapi.PublishPacer):
+                # wave/coalesce/throttle counters and the live adaptive
+                # admission window — lock-free snapshot
+                "pacing": d.pacer.snapshot(),
             }
             # attach plane: in-flight claim tasks, prepare pool size, and
             # group-commit effectiveness (commits vs claims coalesced)
@@ -535,6 +543,35 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_orphaned_claims gauge",
                 f"tpu_plugin_dra_orphaned_claims "
                 f"{len(s['dra']['orphaned_claims'])}",
+                "# HELP tpu_plugin_dra_checkpoint_bytes Size of the last "
+                "committed checkpoint write (compact serialization) — "
+                "the checkpoint-growth observability gauge.",
+                "# TYPE tpu_plugin_dra_checkpoint_bytes gauge",
+                f"tpu_plugin_dra_checkpoint_bytes "
+                f"{s['dra']['checkpoint_bytes']}",
+                "# HELP tpu_plugin_dra_publish_waves_total ResourceSlice "
+                "publish waves sent through the pacing layer "
+                "(kubeapi.PublishPacer).",
+                "# TYPE tpu_plugin_dra_publish_waves_total counter",
+                f"tpu_plugin_dra_publish_waves_total "
+                f"{s['dra']['pacing']['publish_waves_total']}",
+                "# HELP tpu_plugin_dra_publishes_coalesced_total Publish "
+                "requests whose state rode another request's wave instead "
+                "of issuing their own PUT.",
+                "# TYPE tpu_plugin_dra_publishes_coalesced_total counter",
+                f"tpu_plugin_dra_publishes_coalesced_total "
+                f"{s['dra']['pacing']['publishes_coalesced_total']}",
+                "# HELP tpu_plugin_dra_publish_throttled_total Publish "
+                "waves the apiserver answered 429 (re-admitted through a "
+                "grown window).",
+                "# TYPE tpu_plugin_dra_publish_throttled_total counter",
+                f"tpu_plugin_dra_publish_throttled_total "
+                f"{s['dra']['pacing']['publish_throttled_total']}",
+                "# HELP tpu_plugin_dra_pacing_window_ms Current adaptive "
+                "admission window of the publish pacer (0 = uncongested).",
+                "# TYPE tpu_plugin_dra_pacing_window_ms gauge",
+                f"tpu_plugin_dra_pacing_window_ms "
+                f"{s['dra']['pacing']['window_ms']}",
             ]
             breaker = s["dra"].get("api_breaker")
             if breaker is not None:
@@ -571,4 +608,21 @@ class StatusServer:
         # (_bucket/_sum/_count families) + the trace-plane counters
         from . import trace
         lines += trace.render_prometheus()
-        return "\n".join(lines) + "\n"
+        # ONE join materializes the scrape: every byte of the response is
+        # produced exactly once (list-append assembly — incremental `+=`
+        # string building re-copies the accumulated prefix per line,
+        # O(series²) bytes at 4096 devices). The accounting below is a
+        # consistency gauge (bytes_joined == rendered length, parts
+        # O(series)) recorded by bench.py --scale; the regression
+        # TRIPWIRE is test_perf_honesty.py's AST scan refusing any
+        # non-`lines` augmented assignment on this render path.
+        text = "\n".join(lines) + "\n"
+        n_series = sum(1 for ln in lines if ln and not ln.startswith("#"))
+        # joined bytes = part bytes + (parts-1) separators + trailing \n
+        self.scrape_stats = {
+            "series": n_series,
+            "parts": len(lines),
+            "bytes_joined": sum(len(ln) for ln in lines) + len(lines),
+            "bytes_rendered": len(text),
+        }
+        return text

@@ -550,6 +550,9 @@ _register("tdp_probe_cycle_ms",
           "Health hub probe-cycle wall time (health.probe_cycle span).")
 _register("tdp_kubeapi_rtt_ms",
           "Kubernetes API request round-trip time (kubeapi.request span).")
+_register("tdp_pacing_delay_ms",
+          "Publish-pacer admission delay before a ResourceSlice publish "
+          "wave (kubeapi.PublishPacer; 0-delay waves are not recorded).")
 
 
 def histogram(name: str) -> Histogram:
