@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -160,6 +160,16 @@ bench-fleet:
 # docs/bench_scale_r11.json.
 bench-scale:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --scale
+
+# Slice placement bench (docs/perf.md "slice placement"): engine vs
+# naive placement quality (4-chip requests on one ICI ring) under
+# seeded claim churn at N={4,16} fleetsim nodes, plus the defrag
+# advisory applied via migration handoff (unplaceable 2x2 -> placeable)
+# — all counted facts, exactly-once audited. Writes
+# docs/bench_placement_r12.json. CI bench-smoke runs the --quick (N=4)
+# variant.
+bench-placement:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --placement
 
 # Fleet chaos soak (nightly-shape, gated): 64-node boot storm + flip
 # wave + 1024-claim attach + rolling upgrade with chaos faults armed

@@ -68,6 +68,13 @@ SURFACES = {
     ("dra.DraDriver", "handoff_stats[*]"): {
         "status": "dra.handoffs_emitted_total",
         "metrics": "tpu_plugin_dra_handoffs_emitted_total"},
+    # slice placement (ISSUE 10): the recompute counter anchors the dict
+    # group; the defrag twins surface under the same dra.placement.*
+    # status object and their own metric families (pinned by the docs
+    # half of this audit via perf.md)
+    ("dra.DraDriver", "placement_stats[*]"): {
+        "status": "dra.placement.frag_recomputes_total",
+        "metrics": "tpu_plugin_dra_frag_recomputes_total"},
     ("dra.DraDriver", "_checkpoint_bytes"): {
         "status": "dra.checkpoint_bytes",
         "metrics": "tpu_plugin_dra_checkpoint_bytes"},

@@ -358,3 +358,200 @@ def test_fleet_soak_64_node_boot_storm_with_chaos():
             sim.stop()
     finally:
         faults.reset()
+
+
+# ------------------------------------- multi-host slice placement (ISSUE 10)
+
+
+@pytest.fixture()
+def placement_fleet():
+    """Lean placement fleet: full 2x4 v5e hosts, zero fabric latency —
+    placement facts are counted, never timed."""
+    sims = []
+
+    def build(n_nodes=3):
+        sim = FleetSim(n_nodes=n_nodes, devices_per_node=8,
+                       latency_s=0.0, max_inflight=0, seed=7)
+        sims.append(sim)
+        return sim
+
+    yield build
+    for sim in sims:
+        sim.stop()
+
+
+def _raw_at(node):
+    return {c: r for r, c in node.host_view().coords.items()}
+
+
+def test_four_chip_request_lands_on_one_ring_on_fragmented_host(
+        placement_fleet):
+    """THE single-host acceptance: a fragmented host still holding one
+    free 2x2 ICI ring gets the 4-chip slice ON that ring — scored 1.0
+    and asserted by coordinates, not luck. The fuller-but-ringless node
+    never wins."""
+    sim = placement_fleet(n_nodes=2)
+    a, b = sim.nodes
+    # node-000: claims leave EXACTLY one 2x2 ring free at columns 2-3
+    ra = _raw_at(a)
+    a.claim_devices("a-1", [ra[(0, 0)]])
+    a.claim_devices("a-2", [ra[(1, 1)]])
+    a.claim_devices("a-3", [ra[(0, 1)]])
+    a.claim_devices("a-4", [ra[(1, 0)]])
+    # node-001: MORE free chips (5) but checkerboarded — no box of 4
+    rb = _raw_at(b)
+    b.claim_devices("b-1", [rb[(0, 1)]])
+    b.claim_devices("b-2", [rb[(1, 2)]])
+    b.claim_devices("b-3", [rb[(0, 3)]])
+    res = sim.prepare_slice("2x2", "ring-claim")
+    assert res["placed"] and res["score"] == 1.0 and res["hosts"] == 1
+    (node_name, raws), = res["shards"]
+    assert node_name == a.name
+    coords = sorted(a.host_view().coords[r] for r in raws)
+    assert coords == [(0, 2), (0, 3), (1, 2), (1, 3)]
+    audit = sim.apiserver.multiclaim_audit()
+    assert audit["exactly_once"] and audit["claims_audited"] == 1
+    # the prepared shard is real claim state, not advisory: it occupies
+    frag = a.driver.fragmentation_stats()["v5e"]
+    assert frag["free"] == 0
+
+
+def test_multi_host_slice_tiles_full_tori(placement_fleet):
+    """4x4 over 2x4 hosts = two whole tori; a host with any claim is
+    ineligible, and the committed claim is audited exactly-once."""
+    sim = placement_fleet(n_nodes=3)
+    dirty = sim.nodes[2]
+    dirty.claim_devices("pin", [sorted(dirty.host_view().free)[0]])
+    res = sim.prepare_slice("4x4", "mesh-16")
+    assert res["placed"] and res["hosts"] == 2 and res["score"] == 1.0
+    assert {s[0] for s in res["shards"]} == {sim.nodes[0].name,
+                                             sim.nodes[1].name}
+    assert all(len(raws) == 8 for _n, raws in res["shards"])
+    assert sim.apiserver.multiclaim_audit()["exactly_once"]
+    # both member drivers now report zero free capacity
+    for node in sim.nodes[:2]:
+        assert node.driver.fragmentation_stats()["v5e"]["free"] == 0
+
+
+def test_multi_host_failure_rolls_back_whole_claim(placement_fleet):
+    """ISSUE 10 satellite: one node's prepare fails mid-slice (after the
+    first shard already landed) -> the WHOLE claim rolls back, no
+    orphaned per-node specs or checkpoint entries anywhere, and both
+    fabric audits stay exactly-once under an armed dra.publish fault."""
+    faults.reset()
+    sim = placement_fleet(n_nodes=2)
+    try:
+        free_before = [len(n.host_view().free) for n in sim.nodes]
+        plan_nodes = [n.name for n in sim.nodes]
+        # publishes during the storm get dropped by the armed fault; the
+        # claim path must stay exactly-once regardless
+        faults.arm("dra.publish", kind="drop", count=2)
+        res = sim.prepare_slice("4x4", "doomed", fail_node=plan_nodes[1])
+        assert not res["placed"] and res["rolled_back"]
+        assert plan_nodes[1] in res["error"]
+        assert res["residue"] == []          # no orphaned per-node specs
+        assert sim.slice_residue("doomed") == []
+        # every chip is free again on every node
+        assert [len(n.host_view().free) for n in sim.nodes] == free_before
+        for node in sim.nodes:
+            assert node.driver.prepared_claim_count() == 0
+        audit = sim.apiserver.multiclaim_audit()
+        assert audit["exactly_once"]
+        assert audit["pending"] == []        # the abort is recorded
+        sim.settle()
+        assert sim.apiserver.exactly_once_audit()["exactly_once"]
+    finally:
+        faults.reset()
+
+
+def test_defrag_proposal_application_makes_shape_placeable(
+        placement_fleet):
+    """THE defrag acceptance: an unplaceable-but-satisfiable 2x2 yields
+    an advisory whose application — riding the PR 7 migration-handoff
+    machinery claim by claim — makes the shape placeable, with the
+    handoff completions counted and every fabric audit green."""
+    from tpu_device_plugin import placement as pl
+    sim = placement_fleet(n_nodes=2)
+    a, b = sim.nodes
+    ra, rb = _raw_at(a), _raw_at(b)
+    # checkerboard node-000 (free 4, no box); nearly fill node-001
+    for i, c in enumerate([(0, 1), (1, 0), (0, 3), (1, 2)]):
+        a.claim_devices(f"a-{i}", [ra[c]])
+    for i, c in enumerate([(0, 0), (0, 1), (0, 2), (0, 3), (1, 0),
+                           (1, 1)]):
+        b.claim_devices(f"b-{i}", [rb[c]])
+    assert pl.plan_slice((2, 2), sim.host_views()) is None
+    prop = sim.propose_defrag("2x2")
+    assert not prop["placeable"] and prop["satisfiable"]
+    assert 1 <= prop["moves"] <= 2
+    completed_before = sum(
+        n.driver.handoff_stats["handoffs_completed_total"]
+        for n in sim.nodes)
+    moves = sim.apply_defrag(prop)
+    assert moves == prop["moves"]
+    plan = pl.plan_slice((2, 2), sim.host_views())
+    assert plan is not None and plan.score == 1.0
+    # and the slice actually prepares end to end now
+    res = sim.prepare_slice("2x2", "post-defrag")
+    assert res["placed"] and res["score"] == 1.0
+    assert sum(n.driver.handoff_stats["handoffs_completed_total"]
+               for n in sim.nodes) == completed_before + moves
+    assert sim.apiserver.multiclaim_audit()["exactly_once"]
+    sim.settle()
+    assert sim.apiserver.exactly_once_audit()["exactly_once"]
+
+
+# --------------------------- managed node: PR 7 lifecycle through fleetsim
+
+
+def test_hot_unplug_of_allocated_chip_through_managed_fleet_node(
+        short_root):
+    """ISSUE 10 satellite (ROADMAP item 1 follow-on): the PR 7
+    hot-unplug-of-an-allocated-chip scenario driven through a fleetsim
+    node with the FULL PluginManager + HealthHub wiring cli.main builds
+    — and the orphan + slice republish observed in the shared fabric's
+    accepted-write generation log (exactly-once)."""
+    from tpu_device_plugin.fleetsim import ManagedFleetNode
+
+    api = FleetApiServer(latency_s=0.0, max_inflight=0)
+    node = None
+    try:
+        node = ManagedFleetNode(short_root, api, n_devices=4)
+        # full wiring is live: plugins registered with the kubelet sim,
+        # FSM tracking every chip as bound
+        assert list(node.kubelet.endpoints)
+        assert node.manager.lifecycle_stats()["states"] == {"bound": 4}
+        assert len(node.published_devices()) == 4
+        views = node.driver.host_views()["v5e"]
+        raw_at = {c: r for r, c in views.coords.items()}
+        victim = raw_at[(0, 1)]
+        node.claim_devices("vm1", [victim])
+        assert node.manager.device_lifecycle.state_of(victim) == "allocated"
+        gens_before = [g for _t, _m, g in node.slice_log()]
+
+        node.hot_unplug(victim)
+        node.tick()                          # one run-loop rediscovery
+
+        # orphan observed end to end
+        assert node.driver.orphaned_claims() == ["vm1"]
+        assert node.driver.departed_devices() == [victim]
+        ls = node.manager.lifecycle_stats()
+        assert ls["claims_orphaned_total"] == 1
+        assert ls["transitions"].get("allocated->gone") == 1
+        # ... and the republish landed in the fabric's generation log:
+        # strictly increasing generations, exactly one new accepted
+        # write, with the departed chip gone from the published slice
+        log = node.slice_log()
+        gens = [g for _t, _m, g in log]
+        assert len(gens) > len(gens_before)
+        assert gens == sorted(set(gens)), gens
+        assert len(node.published_devices()) == 3
+        audit = api.exactly_once_audit()
+        assert audit["exactly_once"], audit
+        # the departed slot keeps counting toward fragmentation
+        frag = node.driver.fragmentation_stats()["v5e"]
+        assert frag["departed"] == 1 and frag["free"] == 3
+    finally:
+        if node is not None:
+            node.stop()
+        api.stop()

@@ -926,3 +926,82 @@ def test_checkpoint_compact_write_and_bytes_gauge_at_1024_claims(short_root):
         assert size <= 1024 * 420, size
     finally:
         driver.stop()
+
+
+def test_bench_placement_r12_pins_placement_quality():
+    """Round-12 placement pins against the RECORDED
+    docs/bench_placement_r12.json (counted facts, CI-safe): in every
+    cell the engine lands at least as many 4-chip requests on one ICI
+    ring as the naive first-free baseline (strictly more at N=16), the
+    defrag advisory was applied (via migration handoff) and flipped an
+    unplaceable 2x2 placeable, and both fabric logs audited
+    exactly-once."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_placement_r12.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    assert {c["nodes"] for c in d["cells"]} >= {4, 16}
+    for cell in d["cells"]:
+        eng, nai = cell["engine"], cell["naive"]
+        assert eng["contiguous"] >= nai["contiguous"], cell
+        assert eng["mean_score"] >= nai["mean_score"], cell
+        assert cell["exactly_once"], cell
+        assert cell["multiclaim_exactly_once"], cell
+        assert cell["defrag"]["attempted"], cell
+        assert cell["defrag"]["placeable_after"], cell
+        assert cell["defrag"]["moves"] >= 1, cell
+    big = next(c for c in d["cells"] if c["nodes"] == 16)
+    assert big["engine"]["contiguous"] > big["naive"]["contiguous"], big
+    assert big["engine"]["placed"] == big["requests"], big
+
+
+def test_placement_scoring_zero_locks_is_live_not_just_recorded(
+        short_root):
+    """LIVE half of the r12 placement pin (the ISSUE 10 CI guard,
+    extending the epoch gate): the ICI placement scoring every
+    GetPreferredAllocation answer pays runs inside the
+    `placement.score` read-path bracket and acquires ZERO registered
+    locks in steady state — counted by lockdep proxies, so CI load
+    cannot flip the verdict."""
+    import os as _os
+
+    from tests.fakehost import FakeChip, FakeHost
+    from tpu_device_plugin import lockdep
+    from tpu_device_plugin.config import Config
+    from tpu_device_plugin.discovery import discover_passthrough
+    from tpu_device_plugin.kubeletapi import pb
+    from tpu_device_plugin.server import TpuDevicePlugin
+
+    with lockdep.scoped():
+        host = FakeHost(short_root)
+        for i in range(8):
+            host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                                   device_id="0063",
+                                   iommu_group=str(11 + i),
+                                   numa_node=i // 4))
+        cfg = Config().with_root(host.root)
+        _os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        registry, _ = discover_passthrough(cfg)
+        plugin = TpuDevicePlugin(cfg, "v5e", registry,
+                                 registry.devices_by_model["0063"],
+                                 torus_dims=(2, 4))
+        ids = [d.bdf for d in registry.devices_by_model["0063"]]
+        req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=ids, allocation_size=4)])
+        plugin.GetPreferredAllocation(req, None)     # warm-up
+        lockdep.reset()
+        for _ in range(5):
+            plugin.GetPreferredAllocation(req, None)
+        stats = lockdep.path_stats()
+        rec = stats["placement.score"]
+        assert rec["calls"] >= 5, stats
+        assert rec["lock_acquisitions"] == 0, \
+            f"placement scoring acquired {rec['lock_acquisitions']} " \
+            f"registered lock(s) on the preferred-allocation path"
+        # the scoring is live, not vestigial: a full free host scores 1.0
+        assert plugin.status_snapshot()["placement"]["last_score"] == 1.0

@@ -1,0 +1,393 @@
+"""Slice placement engine tests (ISSUE 10).
+
+Unit coverage of placement.py (shape algebra, scoring, fragmentation,
+single/multi-host planning, defrag advisories) plus the daemon
+integration: DRA fragmentation gauges recomputed per epoch publish,
+/debug/defrag over real HTTP, the placement counters on /status +
+/metrics, and the preferred-allocation scoring surface. The fleetsim
+end-to-end scenarios (multi-host claims, rollback, defrag application
+via migration handoff) live in tests/test_fleetsim.py.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tests.test_dra import FakeApiServer
+from tpu_device_plugin import placement
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.dra import DraDriver
+from tpu_device_plugin.kubeapi import ApiClient
+from tpu_device_plugin.kubeletapi import pb
+from tpu_device_plugin.placement import HostView
+from tpu_device_plugin.server import TpuDevicePlugin
+
+
+def view(node="n0", dims=(2, 4), occupied=(), departed=(), claims=None,
+         missing=()):
+    """A hand-built HostView: chips at every torus coordinate except
+    `missing`; `occupied` coords are claim-held (one claim per coord
+    unless `claims` maps uid -> [coords]), `departed` coords are holes."""
+    import itertools
+    coords = {}
+    names = {}
+    for c in itertools.product(*[range(d) for d in dims]):
+        if c in set(missing):
+            continue
+        raw = "c" + "-".join(str(x) for x in c)
+        coords[raw] = c
+        names[raw] = raw
+    raw_at = {c: r for r, c in coords.items()}
+    claim_map = {}
+    if claims:
+        claim_map = {uid: tuple(raw_at[c] for c in cs)
+                     for uid, cs in claims.items()}
+    else:
+        for i, c in enumerate(occupied):
+            claim_map[f"claim-{i}"] = (raw_at[c],)
+    held = {r for raws in claim_map.values() for r in raws}
+    dep = frozenset(raw_at[c] for c in departed)
+    free = frozenset(r for r in coords
+                     if r not in held and r not in dep)
+    return HostView(node=node, dims=dims, coords=coords, names=names,
+                    free=free, departed=dep, claims=claim_map)
+
+
+# ------------------------------------------------------------ shape algebra
+
+
+def test_parse_shape_forms():
+    assert placement.parse_shape("2x2x1") == (2, 2, 1)
+    assert placement.parse_shape("4") == (4,)
+    assert placement.parse_shape([2, 2]) == (2, 2)
+
+
+@pytest.mark.parametrize("bad", ["", "0x2", "-1", "2xa", [0]])
+def test_parse_shape_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        placement.parse_shape(bad)
+
+
+def test_orientations_pad_and_permute():
+    assert placement.orientations((4,), 2) == ((1, 4), (4, 1))
+    # trailing 1-axes collapse: 2x2x1 on a 2D torus is just 2x2
+    assert placement.orientations((2, 2, 1), 2) == ((2, 2),)
+    # more >1 axes than the torus has: impossible
+    assert placement.orientations((2, 2, 2), 2) == ()
+
+
+def test_selection_score_box_vs_stragglers():
+    assert placement.selection_score((2, 4), [(0, 0), (0, 1)]) == 1.0
+    assert placement.selection_score(
+        (2, 4), [(0, 0), (0, 1), (1, 0), (1, 1)]) == 1.0
+    # opposite corners: covering box is the whole 2x4 -> 2/8
+    assert placement.selection_score((2, 4), [(0, 0), (1, 3)]) == 0.25
+    assert placement.selection_score(None, [(0, 0)]) == 0.0
+    assert placement.selection_score((2, 4), [(0, 0), None]) == 0.0
+
+
+# ------------------------------------------------------------ fragmentation
+
+
+def test_fragmentation_whole_host_free_is_zero():
+    rec = placement.fragmentation(view())
+    assert rec == {"chips": 8, "free": 8, "departed": 0,
+                   "largest_free_box": 8, "fragmentation": 0.0}
+
+
+def test_fragmentation_scattered_free_scores_high():
+    # free: (0,0),(1,1),(0,2),(1,3) — checkerboard, no two adjacent
+    v = view(occupied=[(0, 1), (1, 0), (0, 3), (1, 2)])
+    rec = placement.fragmentation(v)
+    assert rec["free"] == 4
+    assert rec["largest_free_box"] == 1
+    assert rec["fragmentation"] == 0.75
+
+
+def test_departed_hole_counts_toward_fragmentation():
+    """ISSUE 10 satellite: a gone chip's slot splits boxes (raising the
+    score) without adding free capacity."""
+    baseline = placement.fragmentation(view(occupied=[(0, 1)]))
+    departed = placement.fragmentation(view(departed=[(0, 1)]))
+    # same geometry, same free count either way; the hole fragments
+    assert departed["free"] == baseline["free"] == 7
+    assert departed["departed"] == 1
+    assert departed["largest_free_box"] == baseline["largest_free_box"] == 4
+    assert departed["fragmentation"] == baseline["fragmentation"] > 0
+
+
+def test_fragmentation_full_host_is_zero_not_divzero():
+    v = view(occupied=[(x, y) for x in range(2) for y in range(4)])
+    rec = placement.fragmentation(v)
+    assert rec["free"] == 0 and rec["fragmentation"] == 0.0
+
+
+# ------------------------------------------------------------- plan_slice
+
+
+def test_single_host_box_any_orientation():
+    plan = placement.plan_slice((4,), [view()])
+    assert plan is not None and plan.score == 1.0 and plan.hosts == 1
+    (_node, raws), = plan.shards
+    coords = [view().coords[r] for r in raws]
+    assert placement.selection_score((2, 4), coords) == 1.0
+
+
+def test_plan_prefers_best_fit_host():
+    """Two hosts can fit a 2x2; the one whose remaining free space stays
+    LEAST fragmented wins (best-fit, not first-fit)."""
+    tight = view(node="tight", occupied=[(0, 2), (0, 3), (1, 2), (1, 3)])
+    empty = view(node="empty")
+    plan = placement.plan_slice((2, 2), [empty, tight])
+    assert plan.shards[0][0] == "tight"   # placing there leaves 0 free
+    plan2 = placement.plan_slice((2, 2), [empty])
+    assert plan2.shards[0][0] == "empty"
+
+
+def test_plan_multi_host_requires_full_tori():
+    """4x4 over 2x4 hosts = two FULLY-free tori; a host with one claim
+    cannot join the tiling (cross-host ICI joins whole blocks)."""
+    a, b, c = view(node="a"), view(node="b"), view(node="c",
+                                                   occupied=[(0, 0)])
+    plan = placement.plan_slice((4, 4), [a, b, c])
+    assert plan is not None and plan.hosts == 2 and plan.score == 1.0
+    assert {s[0] for s in plan.shards} == {"a", "b"}
+    assert placement.plan_slice((4, 4), [a, c]) is None
+    # shape that does not factor over the host torus: no tiling
+    assert placement.plan_slice((3, 4), [a, b, c]) is None
+
+
+def test_plan_best_effort_scatters_with_honest_score():
+    v = view(occupied=[(0, 1), (1, 0), (0, 3), (1, 2)])  # checkerboard
+    assert placement.plan_slice((2, 2), [v]) is None
+    plan = placement.plan_slice((2, 2), [v], best_effort=True)
+    assert plan is not None and 0 < plan.score < 1.0
+
+
+def test_plan_unplaceable_returns_none():
+    v = view(occupied=[(x, y) for x in range(2) for y in range(4)])
+    assert placement.plan_slice((2, 2), [v], best_effort=True) is None
+
+
+# ---------------------------------------------------------------- defrag
+
+
+def test_defrag_picks_minimal_blocker_box():
+    """Box (0,0)-(1,1) is blocked by ONE claim; (0,2)-(1,3) by two.
+    The advisory must evict exactly the one."""
+    v = view(claims={"one": [(0, 0)],
+                     "two-a": [(0, 2)], "two-b": [(1, 3)]})
+    prop = placement.propose_defrag((2, 2), [v])
+    assert not prop["placeable"] and prop["satisfiable"]
+    assert prop["moves"] == 1
+    assert prop["migrations"][0]["claim"] == "one"
+    # destination stays outside the target box
+    target = set(prop["target"]["devices"])
+    assert not target & set(prop["migrations"][0]["target_devices"])
+
+
+def test_defrag_excludes_departed_boxes_and_destinations():
+    """ISSUE 10 satellite: a departed hole disqualifies every box that
+    contains it (no silicon to migrate onto) and is never a destination."""
+    # hole at (0,0); claims block the right half lightly
+    v = view(departed=[(0, 0)], claims={"c": [(0, 2)]})
+    prop = placement.propose_defrag((2, 2), [v])
+    assert not prop["placeable"] and prop["satisfiable"]
+    hole_name = "c0-0"
+    assert hole_name not in prop["target"]["devices"]
+    for mig in prop["migrations"]:
+        assert hole_name not in (mig["target_devices"] or ())
+
+
+def test_defrag_migrates_multi_chip_claim_to_scattered_slots():
+    """Regression: a multi-chip blocking claim whose destination has no
+    contiguous box of its size must still get a (scattered) target —
+    this used to crash with UnboundLocalError in _destination."""
+    v = view(claims={"pair": [(0, 0), (0, 1)], "s1": [(1, 2)],
+                     "s2": [(0, 3)]})
+    prop = placement.propose_defrag((2, 2), [v])
+    assert not prop["placeable"] and prop["satisfiable"]
+    assert prop["moves"] == 1
+    mig = prop["migrations"][0]
+    assert mig["claim"] == "pair" and len(mig["target_devices"]) == 2
+    assert not set(mig["target_devices"]) & set(prop["target"]["devices"])
+
+
+def test_defrag_unsatisfiable_when_capacity_short():
+    v = view(claims={"big": [(0, 0), (0, 1), (0, 2), (0, 3), (1, 0)]},
+             departed=[(1, 1)])
+    # free = 2 < 4 wanted
+    prop = placement.propose_defrag((2, 2), [v])
+    assert not prop["satisfiable"]
+
+
+def test_defrag_placeable_short_circuits():
+    prop = placement.propose_defrag((2, 2), [view()])
+    assert prop["placeable"] and prop["moves"] == 0
+
+
+def test_defrag_cross_host_destination():
+    """Blockers move to ANOTHER host when the local one has no room."""
+    full = view(node="a", occupied=[(0, 0), (0, 2), (0, 3), (1, 0),
+                                    (1, 2), (1, 3)])
+    spare = view(node="b", occupied=[(0, 1), (1, 0), (0, 3), (1, 2)])
+    prop = placement.propose_defrag((2, 2), [full, spare])
+    assert not prop["placeable"] and prop["satisfiable"]
+    assert any(m["target_node"] == "b" for m in prop["migrations"])
+
+
+# ------------------------------------------------- daemon integration
+
+
+@pytest.fixture()
+def rig(short_root):
+    """8-chip v5e host + DRA driver against a fake apiserver."""
+    host = FakeHost(short_root)
+    for i in range(8):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 4))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    apiserver = FakeApiServer()
+    registry, generations = discover_passthrough(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="n",
+                       api=ApiClient(apiserver.url,
+                                     token_path="/nonexistent"))
+    yield cfg, registry, generations, driver, apiserver
+    driver.stop()
+    apiserver.stop()
+
+
+def _prepare(driver, apiserver, uid, names):
+    from tpu_device_plugin.kubeletapi import drapb
+    apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                        [{"device": nm} for nm in names])
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=[
+            drapb.Claim(namespace="ns", name=uid, uid=uid)]), None)
+    assert resp.claims[uid].error == "", resp.claims[uid].error
+
+
+def test_driver_fragmentation_recomputes_on_claims_and_health(rig):
+    _cfg, _registry, _generations, driver, apiserver = rig
+    frag0 = driver.fragmentation_stats()["v5e"]
+    assert frag0["free"] == 8 and frag0["fragmentation"] == 0.0
+    recomputes0 = driver.placement_stats["frag_recomputes_total"]
+    # claim one chip -> free drops, recompute counted
+    v = driver.host_views()["v5e"]
+    raw_at = {c: r for r, c in v.coords.items()}
+    _prepare(driver, apiserver, "u1", [v.names[raw_at[(0, 1)]]])
+    frag1 = driver.fragmentation_stats()["v5e"]
+    assert frag1["free"] == 7 and frag1["fragmentation"] > 0
+    assert driver.placement_stats["frag_recomputes_total"] > recomputes0
+    # health flip publishes an epoch AND refreshes fragmentation
+    driver.apply_health({raw_at[(1, 2)]: False})
+    frag2 = driver.fragmentation_stats()["v5e"]
+    assert frag2["free"] == 6
+
+
+def test_driver_host_view_claims_and_propose(rig):
+    _cfg, _registry, _generations, driver, apiserver = rig
+    v = driver.host_views()["v5e"]
+    raw_at = {c: r for r, c in v.coords.items()}
+    # checkerboard the host so no 2x2 box survives
+    for i, c in enumerate([(0, 1), (1, 0), (0, 3), (1, 2)]):
+        _prepare(driver, apiserver, f"u{i}", [v.names[raw_at[c]]])
+    v2 = driver.host_views()["v5e"]
+    assert len(v2.claims) == 4 and len(v2.free) == 4
+    prop = driver.propose_defrag("2x2")
+    assert not prop["placeable"] and prop["satisfiable"]
+    assert prop["generation"] == "v5e"
+    assert prop["moves"] >= 1
+    assert driver.placement_stats["defrag_proposals_total"] == 1
+    with pytest.raises(ValueError):
+        driver.propose_defrag("2x2", generation="nope")
+
+
+def test_status_and_metrics_surface_fragmentation(rig, short_root):
+    from tpu_device_plugin.lifecycle import PluginManager
+    from tpu_device_plugin.status import StatusServer
+    cfg, registry, _generations, driver, _apiserver = rig
+    manager = PluginManager(cfg)
+    manager.plugins = [TpuDevicePlugin(
+        cfg, "v5e", registry, registry.devices_by_model["0063"],
+        torus_dims=(2, 4))]
+    server = StatusServer(manager, port=0, dra_driver=driver)
+    try:
+        s = server.status()
+        assert s["dra"]["fragmentation"]["v5e"]["free"] == 8
+        assert "frag_recomputes_total" in s["dra"]["placement"]
+        text = server.metrics()
+        assert 'tpu_plugin_dra_fragmentation{generation="v5e"} 0.0' in text
+        assert 'tpu_plugin_dra_largest_free_box{generation="v5e"} 8' in text
+        assert 'tpu_plugin_dra_free_chips{generation="v5e"} 8' in text
+        assert "tpu_plugin_dra_frag_recomputes_total" in text
+        assert "tpu_plugin_dra_defrag_proposals_total 0" in text
+        assert "tpu_plugin_pref_placement_score" in text
+    finally:
+        server._httpd.server_close()
+
+
+def test_debug_defrag_endpoint_over_http(rig):
+    from tpu_device_plugin.lifecycle import PluginManager
+    from tpu_device_plugin.status import StatusServer
+    cfg, _registry, _generations, driver, apiserver = rig
+    v = driver.host_views()["v5e"]
+    raw_at = {c: r for r, c in v.coords.items()}
+    for i, c in enumerate([(0, 1), (1, 0), (0, 3), (1, 2)]):
+        _prepare(driver, apiserver, f"u{i}", [v.names[raw_at[c]]])
+    manager = PluginManager(cfg)
+    server = StatusServer(manager, port=0, dra_driver=driver)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/debug/defrag?shape=2x2",
+                                    timeout=5) as r:
+            prop = json.load(r)
+        assert not prop["placeable"] and prop["satisfiable"]
+        assert prop["moves"] >= 1 and prop["target"]["node"] == "n"
+        # malformed requests answer 400, not a stack trace
+        for bad in ("/debug/defrag", "/debug/defrag?shape=0x2",
+                    "/debug/defrag?shape=2x2&generation=nope"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + bad, timeout=5)
+            assert exc.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_debug_defrag_404_without_dra():
+    from tpu_device_plugin.lifecycle import PluginManager
+    from tpu_device_plugin.status import StatusServer
+    server = StatusServer(PluginManager(Config()), port=0)
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/debug/defrag?shape=2x2",
+                timeout=5)
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_preferred_allocation_reports_placement_score(rig):
+    cfg, registry, _generations, _driver, _apiserver = rig
+    plugin = TpuDevicePlugin(cfg, "v5e", registry,
+                             registry.devices_by_model["0063"],
+                             torus_dims=(2, 4))
+    ids = [d.bdf for d in registry.devices_by_model["0063"]]
+    req = pb.PreferredAllocationRequest(container_requests=[
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=ids, allocation_size=4)])
+    resp = plugin.GetPreferredAllocation(req, None)
+    chosen = list(resp.container_responses[0].deviceIDs)
+    assert len(chosen) == 4
+    snap = plugin.status_snapshot()["placement"]
+    assert snap["scored_total"] == 1
+    # a full host of free chips always yields one sub-box
+    assert snap["last_score"] == 1.0

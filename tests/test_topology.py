@@ -253,3 +253,26 @@ def test_boxes_memoized_across_index_rebuilds():
     after = _boxes.cache_info()
     assert after.misses == 1, "sub-box enumeration re-paid on rebuild"
     assert after.hits >= after_first.hits + 3
+
+
+def test_duplicate_coordinate_hints_rejected(caplog):
+    """ISSUE 10 satellite: two hints landing on ONE torus slot used to be
+    silently accepted — both chips at the same coordinate poisons every
+    sub-box score. Colliding hints are now dropped (with a warning) like
+    the arity/range check drops malformed ones; the chips fall back to
+    layout order and every chip still gets a UNIQUE slot."""
+    import logging
+
+    bdfs = ["0000:00:04.0", "0000:00:05.0", "0000:00:06.0", "0000:00:07.0"]
+    info = GenerationInfo("v4", 4, (2, 2, 1))
+    hints = {"0000:00:04.0": (0, 0, 0), "0000:00:05.0": (0, 0, 0),
+             "0000:00:06.0": (1, 1, 0)}
+    with caplog.at_level(logging.WARNING, "tpu_device_plugin.topology"):
+        coords = assign_coords(bdfs, info, hints=hints)
+    assert sum("duplicates another hint" in r.message
+               for r in caplog.records) == 2
+    # the non-colliding hint still wins; the colliders were re-laid
+    assert coords["0000:00:06.0"] == (1, 1, 0)
+    placed = [c for c in coords.values() if c is not None]
+    assert len(placed) == len(set(placed)) == 4, coords
+    assert coords["0000:00:04.0"] != coords["0000:00:05.0"]

@@ -112,6 +112,11 @@ COUNTERS: Dict[str, Dict[str, str]] = {
         # migration handoff counters (emitted/completed): /status reads
         # them lock-free via a C-atomic fixed-key dict copy
         "handoff_stats[*]": "dra.DraDriver._lock",
+        # slice placement (ISSUE 10): fragmentation-recompute + defrag-
+        # advisor counters mutate under the global lock (the recompute is
+        # writer-side, the advisor bumps after building its proposal);
+        # /status reads them lock-free via a fixed-key C-atomic dict copy
+        "placement_stats[*]": "dra.DraDriver._lock",
     },
     # device lifecycle FSM: every transition/orphan/swap counter mutates
     # under the FSM writer lock; stats() reads them lock-free (GIL-atomic

@@ -47,6 +47,7 @@ from dataclasses import replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from . import placement
 from .config import Config
 from .discovery import discover_passthrough
 from .dra import DraDriver, slice_device_name
@@ -142,6 +143,16 @@ class FleetApiServer:
         }
         # slice name -> [(t_monotonic, method, pool generation), ...]
         self.write_log: Dict[str, List[tuple]] = {}
+        # ---- multi-host DRA claim state (ISSUE 10) -----------------------
+        # The fabric carries the cross-node claim record a real scheduler/
+        # controller would keep in etcd: uid -> {shape, shards, phase}.
+        # Every phase change is appended to the commit log, the exactly-
+        # once audit surface for multi-node claims: a uid must see begin →
+        # (commit | abort) with AT MOST ONE commit ever — a replayed
+        # commit is a double-attach, a commit without a begin is a writer
+        # bypassing the fabric.
+        self.multiclaims: Dict[str, dict] = {}
+        self.multiclaim_log: List[tuple] = []   # (t, uid, phase, detail)
         # service wall (seconds) of every ACCEPTED slice write — the
         # apiserver-side publish-latency surface (p50/p99 in snapshot())
         self.write_walls: List[float] = []
@@ -312,6 +323,61 @@ class FleetApiServer:
         host, port = self.server.server_address
         return f"http://{host}:{port}"
 
+    # ------------------------------------------- multi-host claim records
+
+    def multiclaim_begin(self, uid: str, shape, shards) -> None:
+        with self._lock:
+            self.multiclaims[uid] = {
+                "shape": list(shape),
+                "shards": [(node, list(raws)) for node, raws in shards],
+                "phase": "pending",
+            }
+            self.multiclaim_log.append(
+                (time.monotonic(), uid, "begin", len(shards)))
+
+    def multiclaim_commit(self, uid: str) -> None:
+        with self._lock:
+            rec = self.multiclaims.get(uid)
+            if rec is not None:
+                rec["phase"] = "committed"
+            # the log records the attempt even when the record is absent/
+            # already committed — that is exactly what the audit flags
+            self.multiclaim_log.append(
+                (time.monotonic(), uid, "commit", None))
+
+    def multiclaim_abort(self, uid: str, reason: str) -> None:
+        with self._lock:
+            rec = self.multiclaims.get(uid)
+            if rec is not None:
+                rec["phase"] = "aborted"
+            self.multiclaim_log.append(
+                (time.monotonic(), uid, "abort", reason))
+
+    def multiclaim_audit(self) -> dict:
+        """Counted exactly-once facts over the multi-node claim commit
+        log (the multi-host analogue of exactly_once_audit)."""
+        with self._lock:
+            log_copy = list(self.multiclaim_log)
+        phases: Dict[str, List[str]] = {}
+        for _t, uid, phase, _detail in log_copy:
+            phases.setdefault(uid, []).append(phase)
+        duplicated = sorted(u for u, ps in phases.items()
+                            if ps.count("commit") > 1)
+        unbegun = sorted(u for u, ps in phases.items()
+                         if ("commit" in ps or "abort" in ps)
+                         and ps[0] != "begin")
+        dangling = sorted(u for u, ps in phases.items()
+                          if "commit" not in ps and "abort" not in ps)
+        return {"claims_audited": len(phases),
+                "duplicated_commits": duplicated,
+                "unbegun_commits": unbegun,
+                "pending": dangling,
+                "exactly_once": not duplicated and not unbegun}
+
+    def remove_claim(self, ns, name) -> None:
+        with self._lock:
+            self.claims.pop((ns, name), None)
+
     def add_claim(self, ns, name, uid, driver, results) -> None:
         self.claims[(ns, name)] = {
             "metadata": {"namespace": ns, "name": name, "uid": uid},
@@ -375,7 +441,7 @@ class FleetNode:
     def __init__(self, root: str, index: int, apiserver: FleetApiServer,
                  n_devices: int = 4, pace_max_s: float = 2.0,
                  pace_base_s: float = 0.0, pace: bool = True,
-                 seed: int = 0):
+                 seed: int = 0, device_id: str = "0063"):
         FakeChip, FakeHost = _fakehost()
         self._pace = pace
         self.index = index
@@ -386,7 +452,7 @@ class FleetNode:
         for i in range(n_devices):
             host.add_chip(FakeChip(
                 f"0000:{i // 32:02x}:{4 + i % 32:02x}.0",
-                device_id="0063", iommu_group=str(11 + i),
+                device_id=device_id, iommu_group=str(11 + i),
                 numa_node=i // max(1, n_devices // 2)))
         self.cfg = replace(Config().with_root(self.root),
                            publish_pace_base_s=pace_base_s,
@@ -394,15 +460,19 @@ class FleetNode:
                            lw_debounce_s=0.0)
         os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
         self.registry, self.generations = discover_passthrough(self.cfg)
-        self.devices = self.registry.devices_by_model["0063"]
+        self.device_id = device_id
+        self.devices = self.registry.devices_by_model[device_id]
         self.bdfs = [d.bdf for d in self.devices]
         self._seed = seed
         self.driver = self._build_driver()
+        info = self.generations.get(device_id)
+        suffix = info.name if info is not None else f"tpu-{device_id}"
         # the plugin's ANDed health verdicts feed the driver exactly like
         # cli.py wires the production daemon: one health observer, no
         # second driftable watcher
         self.plugin = TpuDevicePlugin(
-            self.cfg, "v5e", self.registry, self.devices,
+            self.cfg, suffix, self.registry, self.devices,
+            torus_dims=info.host_topology if info is not None else None,
             health_listener=self._health_listener)
 
     def _build_driver(self) -> DraDriver:
@@ -452,6 +522,32 @@ class FleetNode:
         return self.driver.NodePrepareResources(
             drapb.NodePrepareResourcesRequest(claims=claims), None)
 
+    def detach(self, uids: List[str]):
+        claims = [drapb.Claim(namespace="fleet", name=uid, uid=uid)
+                  for uid in uids]
+        return self.driver.NodeUnprepareResources(
+            drapb.NodeUnprepareResourcesRequest(claims=claims), None)
+
+    # ------------------------------------------------------- placement
+
+    def host_view(self) -> "placement.HostView":
+        """This node's placement snapshot for its (single) generation."""
+        views = self.driver.host_views()
+        return views[next(iter(sorted(views)))]
+
+    def claim_devices(self, uid: str, raws: List[str]) -> None:
+        """Pin a claim onto SPECIFIC chips (fragmentation scripting for
+        placement tests/benches): register + prepare, raising on error."""
+        names = self.host_view().names
+        self.apiserver.add_claim(
+            "fleet", uid, uid, self.driver.driver_name,
+            [{"device": names[r]} for r in raws])
+        resp = self.attach([uid])
+        if resp.claims[uid].error:
+            raise AssertionError(
+                f"{self.name}: claim {uid} on {raws} failed: "
+                f"{resp.claims[uid].error}")
+
     def flip_storm(self, flips: int) -> None:
         """Alternate one device unhealthy/healthy `flips` times: each
         EFFECTIVE transition publishes (paced, coalescible); the final
@@ -489,6 +585,119 @@ class FleetNode:
         self.driver.stop()
 
 
+class ManagedFleetNode:
+    """One fleetsim node with the FULL production wiring cli.main builds
+    (ROADMAP item 1 follow-on): a real PluginManager — shared HealthHub,
+    per-device lifecycle FSM, incremental rediscovery, plugin servers
+    registering against an in-process kubelet devicemanager simulator —
+    with the DRA driver attached through the same three seams the daemon
+    uses (on_inventory sink, plugin health listener, attach_lifecycle),
+    publishing to the shared fleet fabric.
+
+    Unlike FleetNode (a lean plugin+driver pair for storm fan-out), this
+    node exists to drive the PR 7 lifecycle scenarios through the REAL
+    wiring: hot_unplug() removes a chip's sysfs dir + vfio node, tick()
+    runs one rediscovery pass exactly like the manager's run loop would,
+    and the resulting orphan + slice republish land in the fabric's
+    accepted-write generation log where the exactly-once audit sees
+    them. Claims prepare through the driver's direct servicer surface,
+    like FleetNode."""
+
+    def __init__(self, root: str, apiserver: FleetApiServer,
+                 name: str = "mnode-000", n_devices: int = 4,
+                 device_id: str = "0063"):
+        FakeChip, FakeHost = _fakehost()
+        from .lifecycle import PluginManager
+        from .registry import Registry
+        try:
+            from tests.kubelet_sim import DeviceManagerSim
+        except ImportError as exc:   # pragma: no cover - checkout-only
+            raise RuntimeError(
+                "ManagedFleetNode needs the tests/ tree "
+                "(tests.kubelet_sim) on sys.path") from exc
+        self.name = name
+        self.root = os.path.join(root, name)
+        self.apiserver = apiserver
+        self.host = FakeHost(self.root)
+        self.bdfs = []
+        self.groups = {}
+        for i in range(n_devices):
+            bdf = f"0000:00:{4 + i:02x}.0"
+            self.host.add_chip(FakeChip(
+                bdf, device_id=device_id, iommu_group=str(11 + i),
+                numa_node=i // max(1, n_devices // 2),
+                serial=f"sn-{name}-{i}"))
+            self.bdfs.append(bdf)
+            self.groups[bdf] = str(11 + i)
+        self.cfg = replace(Config().with_root(self.root),
+                           publish_pace_base_s=0.0, lw_debounce_s=0.0)
+        os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
+        self.kubelet = DeviceManagerSim(self.cfg.device_plugin_path)
+        self.driver = DraDriver(
+            self.cfg, Registry(), {}, node_name=name,
+            api=ApiClient(apiserver.url, token_path="/nonexistent"))
+
+        def dra_sink(reg, gens, _d=self.driver):
+            _d.set_inventory(reg, gens)
+            return _d.publish_resource_slices()
+
+        self.manager = PluginManager(
+            self.cfg, on_inventory=dra_sink,
+            health_listener=self.driver.apply_health)
+        self.driver.attach_lifecycle(self.manager.device_lifecycle)
+        self.manager.start()
+        self.manager.running.set()
+
+    def attach(self, uids: List[str]):
+        claims = [drapb.Claim(namespace="fleet", name=uid, uid=uid)
+                  for uid in uids]
+        return self.driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=claims), None)
+
+    def claim_devices(self, uid: str, raws: List[str]) -> None:
+        names: Dict[str, str] = {}
+        for v in self.driver.host_views().values():
+            names.update(v.names)
+        self.apiserver.add_claim(
+            "fleet", uid, uid, self.driver.driver_name,
+            [{"device": names[r]} for r in raws])
+        resp = self.attach([uid])
+        if resp.claims[uid].error:
+            raise AssertionError(
+                f"{self.name}: claim {uid} failed: {resp.claims[uid].error}")
+
+    def hot_unplug(self, bdf: str) -> None:
+        """PCIe surprise removal: the chip's sysfs dir AND vfio node
+        vanish (the corroborated shape — a vfio flap alone stays a
+        health event, PR 7)."""
+        shutil.rmtree(os.path.join(self.root, "sys/bus/pci/devices", bdf),
+                      ignore_errors=True)
+        try:
+            os.unlink(os.path.join(self.root, "dev/vfio", self.groups[bdf]))
+        except FileNotFoundError:
+            pass
+
+    def tick(self) -> None:
+        """One rediscovery pass, exactly the run loop's tick body."""
+        self.manager._apply_inventory(self.manager._rediscover())
+
+    def slice_log(self) -> List[tuple]:
+        with self.apiserver._lock:
+            return list(self.apiserver.write_log.get(
+                self.driver.slice_name(), ()))
+
+    def published_devices(self) -> set:
+        with self.apiserver._lock:
+            obj = self.apiserver.slices.get(self.driver.slice_name())
+        return {d["name"] for d in obj["spec"]["devices"]} if obj else set()
+
+    def stop(self) -> None:
+        self.manager.running.clear()
+        self.manager.stop()
+        self.driver.stop()
+        self.kubelet.stop()
+
+
 class FleetSim:
     """N FleetNodes against one FleetApiServer, plus the storm drivers.
 
@@ -505,7 +714,7 @@ class FleetSim:
                  pace: bool = True, pace_max_s: float = 2.0,
                  pace_base_s: float = 0.0,
                  seed: int = 0, root: Optional[str] = None,
-                 build_workers: int = 16):
+                 build_workers: int = 16, device_id: str = "0063"):
         self.n_nodes = n_nodes
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="tdpfleet-")
@@ -519,7 +728,8 @@ class FleetSim:
                                     n_devices=devices_per_node,
                                     pace_max_s=pace_max_s,
                                     pace_base_s=pace_base_s,
-                                    pace=pace, seed=seed),
+                                    pace=pace, seed=seed,
+                                    device_id=device_id),
                 range(n_nodes)))
 
     def _storm(self, fn) -> List:
@@ -656,6 +866,146 @@ class FleetSim:
             "prepared_total": sum(n.driver.prepared_claim_count()
                                   for n in self.nodes),
         }
+
+    # ---------------------------------------- multi-host slice placement
+
+    def host_views(self) -> List["placement.HostView"]:
+        return [n.host_view() for n in self.nodes]
+
+    def _node_by_name(self) -> Dict[str, FleetNode]:
+        return {n.name: n for n in self.nodes}
+
+    def prepare_slice(self, shape, uid: str, best_effort: bool = False,
+                      fail_node: Optional[str] = None) -> dict:
+        """Plan + prepare one multi-host slice claim end to end.
+
+        The fabric carries the cross-node claim record (multiclaim_begin/
+        commit/abort — the exactly-once audit surface); each involved
+        node's DRA driver prepares its LOCAL shard as a per-node
+        sub-claim `<uid>-<node>` (the shape a real controller slices a
+        multi-node allocation into, since a node driver can only prepare
+        devices it owns). ALL-OR-NOTHING: any shard failure unprepares
+        every already-prepared shard, deletes the sub-claims, and aborts
+        the fabric record — no orphaned per-node specs survive
+        (slice_residue() is the counted check).
+
+        `fail_node` is the failure-injection knob: that node's sub-claim
+        is registered against a device name the node does not publish,
+        so its prepare fails deterministically mid-slice.
+        """
+        shape = placement.parse_shape(shape)
+        plan = placement.plan_slice(shape, self.host_views(),
+                                    best_effort=best_effort)
+        if plan is None:
+            return {"uid": uid, "placed": False, "reason": "unplaceable"}
+        by_node = self._node_by_name()
+        self.apiserver.multiclaim_begin(uid, shape, plan.shards)
+        prepared: List[tuple] = []
+        error = None
+        for node_name, raws in plan.shards:
+            node = by_node[node_name]
+            sub_uid = f"{uid}-{node_name}"
+            names = node.host_view().names
+            devices = ["fleetsim-injected-missing-device"] \
+                if node_name == fail_node else [names[r] for r in raws]
+            self.apiserver.add_claim(
+                "fleet", sub_uid, sub_uid, node.driver.driver_name,
+                [{"device": nm} for nm in devices])
+            resp = node.attach([sub_uid])
+            err = resp.claims[sub_uid].error
+            if err:
+                error = f"{node_name}: {err}"
+                break
+            prepared.append((node, sub_uid))
+        if error is not None:
+            # whole-claim rollback: unprepare is idempotent and durable
+            # (the deletion rides the group commit before ACK), so after
+            # this loop NO node's checkpoint or CDI dir knows the claim
+            for node, sub_uid in prepared:
+                resp = node.detach([sub_uid])
+                if resp.claims[sub_uid].error:
+                    raise AssertionError(
+                        f"rollback unprepare of {sub_uid} failed: "
+                        f"{resp.claims[sub_uid].error}")
+            # ... and neither does the fabric: every registered sub-claim
+            # (prepared or not, including the failed node's) is deleted,
+            # like the controller garbage-collecting its slice of an
+            # aborted allocation
+            for node_name, _raws in plan.shards:
+                self.apiserver.remove_claim("fleet", f"{uid}-{node_name}")
+            self.apiserver.multiclaim_abort(uid, error)
+            return {"uid": uid, "placed": False, "rolled_back": True,
+                    "error": error,
+                    "residue": self.slice_residue(uid)}
+        self.apiserver.multiclaim_commit(uid)
+        return {"uid": uid, "placed": True, "score": plan.score,
+                "hosts": plan.hosts,
+                "shards": [(node, list(raws))
+                           for node, raws in plan.shards],
+                "sub_claims": [sub for _n, sub in prepared]}
+
+    def slice_residue(self, uid: str) -> List[str]:
+        """State left behind by multi-host claim `uid`: per-node sub-claim
+        checkpoint entries, CDI spec files, or fabric claim records.
+        Empty after a clean commit-less rollback — THE no-orphaned-specs
+        assertion."""
+        residue = []
+        for node in self.nodes:
+            sub_uid = f"{uid}-{node.name}"
+            if sub_uid in node.driver._checkpoint:
+                residue.append(f"{node.name}:checkpoint:{sub_uid}")
+            if os.path.exists(node.driver._claim_spec_path(sub_uid)):
+                residue.append(f"{node.name}:spec:{sub_uid}")
+            with self.apiserver._lock:
+                stale = ("fleet", sub_uid) in self.apiserver.claims
+            if stale:
+                residue.append(f"fabric:claim:{sub_uid}")
+        return residue
+
+    def propose_defrag(self, shape) -> dict:
+        """Cluster-wide defrag advisory over every node's view (the
+        per-node /debug/defrag serves the same proposal with only its
+        own view; here migration targets resolve across the fleet)."""
+        return placement.propose_defrag(placement.parse_shape(shape),
+                                        self.host_views())
+
+    def apply_defrag(self, proposal: dict) -> int:
+        """Apply a defrag advisory by riding the PR 7 migration-handoff
+        machinery claim by claim: unprepare at the source (emits the
+        durable handoff record), re-point the fabric claim at the target
+        devices, import the record at the destination, and prepare there
+        (which VALIDATES the handoff — uid + allocation generation —
+        before attaching, and counts handoffs_completed_total). Returns
+        the number of migrations applied."""
+        by_node = self._node_by_name()
+        moves = 0
+        for mig in proposal.get("migrations", ()):
+            uid = mig["claim"]
+            if mig.get("target_node") is None:
+                raise AssertionError(
+                    f"migration of {uid} has no target (free capacity "
+                    f"exhausted); cannot apply")
+            src = by_node[mig["source_node"]]
+            dst = by_node[mig["target_node"]]
+            resp = src.detach([uid])
+            if resp.claims[uid].error:
+                raise AssertionError(
+                    f"defrag unprepare of {uid} on {src.name} failed: "
+                    f"{resp.claims[uid].error}")
+            record = src.driver.export_handoff(uid)
+            names = dst.host_view().names
+            self.apiserver.add_claim(
+                "fleet", uid, uid, dst.driver.driver_name,
+                [{"device": names[r]} for r in mig["target_devices"]])
+            if record is not None:
+                dst.driver.import_handoff(record)
+            resp = dst.attach([uid])
+            if resp.claims[uid].error:
+                raise AssertionError(
+                    f"defrag prepare of {uid} on {dst.name} failed: "
+                    f"{resp.claims[uid].error}")
+            moves += 1
+        return moves
 
     # ------------------------------------------------------------- audit
 

@@ -1,0 +1,475 @@
+"""Slice placement engine — multi-host ICI slices, fragmentation, defrag.
+
+ROADMAP item 3, the cluster half of topology.py's single-host sub-box
+problem. A guest's `jax.Mesh`/`PartitionSpec` sharding (SNIPPETS.md
+[1]-[3]) needs the hardware slice to MATCH the mesh shape: four chips on
+one ICI ring run XLA collectives over ICI, four stragglers fall back to
+PCIe/DCN. This module models slice shapes as tilings of host-local tori
+and answers three questions a fleet scheduler (or its simulator,
+fleetsim.py) keeps asking:
+
+1. **Where does shape S go?** `plan_slice` places an axis-aligned mesh:
+   on ONE host as a free sub-box of the host torus (any axis
+   orientation), or across SEVERAL hosts as a grid of fully-free host
+   tori — the physical TPU model, where multi-host ICI only exists
+   between whole host blocks (a v4 pod is a stack of 2x2x1 host cubes;
+   a v5e pod a grid of 2x4 trays). Placements carry a contiguity
+   score (1.0 = one perfect box/tiling); `best_effort=True` degrades
+   to scattered free chips so callers can measure HOW bad a naive
+   placement is instead of just failing.
+
+2. **How fragmented is this host?** `fragmentation` scores a host view:
+   `1 - largest_placeable_subbox / free_chips`. 0.0 means every free
+   chip is reachable through one box (nothing to defrag); 0.75 on an
+   8-chip host means four free chips of which no two are adjacent. A
+   DEPARTED chip (hot-unplugged, lifecycle GONE) counts TOWARD
+   fragmentation — its hole splits boxes — but is never free capacity
+   and never a migration target (ROADMAP item 4 follow-on).
+
+3. **What would make S placeable?** `propose_defrag`: when S is
+   unplaceable but free capacity suffices, pick the candidate box
+   blocked by the FEWEST claims (departed/unhealthy holes disqualify a
+   box — no migration can empty them) and propose moving exactly those
+   claims to free slots outside the box. The proposal rides the PR 7
+   migration-handoff machinery: each migration is an unprepare (handoff
+   record emitted) + re-prepare at the destination, applied by
+   fleetsim.FleetSim.apply_defrag and advertised per-node via
+   /debug/defrag (docs/design.md "Slice placement" documents the
+   proposal format).
+
+Everything here is PURE COMPUTE over immutable inputs: `HostView` is a
+frozen snapshot built from an inventory epoch + a checkpoint copy, so
+placement scoring can run inside the zero-lock read-path gate
+(tests/test_epoch.py pins `placement.score` at 0 registered-lock
+acquisitions) and fragmentation can be recomputed at epoch-publish time
+with readers never locking (dra.fragmentation_stats).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .topology import Coords, _boxes
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HostView", "SlicePlan", "parse_shape", "orientations",
+           "selection_score", "largest_fit", "scatter_score",
+           "fragmentation", "plan_slice", "propose_defrag"]
+
+
+def parse_shape(text) -> Coords:
+    """"2x2x1" / "4" / [2, 2] → validated dims tuple (every axis >= 1)."""
+    if isinstance(text, (tuple, list)):
+        dims = tuple(int(d) for d in text)
+    else:
+        dims = tuple(int(p) for p in str(text).lower().split("x") if p != "")
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"invalid slice shape {text!r}: want NxN[xN] with "
+                         f"every axis >= 1")
+    return dims
+
+
+def volume(dims: Coords) -> int:
+    v = 1
+    for d in dims:
+        v *= d
+    return v
+
+
+def orientations(shape: Coords, ndims: int) -> Tuple[Coords, ...]:
+    """Distinct axis-assignments of `shape` onto an `ndims`-d torus.
+
+    A mesh is orientation-free on the hardware (XLA renumbers axes), so a
+    1x4 request may land as 4x1; shapes with fewer axes than the torus
+    pad with 1s. A shape with MORE axes than the torus only fits if the
+    extra axes are 1 (a 2x2x1 request on a 2D v5e tray is just 2x2)."""
+    shape = tuple(d for d in shape if d > 1) or (1,)
+    if len(shape) > ndims:
+        return ()
+    padded = shape + (1,) * (ndims - len(shape))
+    return tuple(sorted(set(itertools.permutations(padded))))
+
+
+def selection_score(dims: Optional[Coords],
+                    coords: Sequence[Optional[Coords]]) -> float:
+    """ICI contiguity of a chosen chip set: size / minimal-covering-box
+    volume. 1.0 = the selection IS an axis-aligned box (one ICI ring /
+    torus tile); lower = stragglers whose collectives leave the ICI
+    mesh. 0.0 when the torus is unmodeled or any chip has no coords."""
+    if not dims or not coords or any(c is None for c in coords):
+        return 0.0
+    pts = [c for c in coords if c is not None]
+    if any(len(c) != len(dims) for c in pts):
+        return 0.0
+    cover = 1
+    for axis in range(len(dims)):
+        lo = min(c[axis] for c in pts)
+        hi = max(c[axis] for c in pts)
+        cover *= hi - lo + 1
+    return round(len(set(pts)) / cover, 4) if cover else 0.0
+
+
+def largest_fit(dims: Coords, avail: frozenset) -> int:
+    """Volume of the largest axis-aligned sub-box of `dims` whose every
+    coordinate is in `avail` — the core of the fragmentation score and
+    the best-fit tie-break."""
+    largest = 0
+    for vol, _box, boxset in _boxes(dims):
+        if vol > len(avail):
+            break          # volume-sorted: nothing larger can fit
+        if vol > largest and boxset <= avail:
+            largest = vol
+    return largest
+
+
+def scatter_score(shards: Sequence[Tuple[Coords, Sequence[Coords]]],
+                  need: int, max_host_volume: int) -> float:
+    """Contiguity of a scattered multi-shard pick: per-shard
+    selection_score weighted by size, penalized by the host count in
+    excess of a perfect tiling's. Shared by plan_slice's best-effort
+    fallback and the bench's naive baseline so the engine-vs-naive
+    comparison can never drift onto two scoring formulas."""
+    weighted = sum(selection_score(dims, list(coords)) * len(coords)
+                   for dims, coords in shards)
+    min_hosts = max(1, -(-need // max_host_volume))
+    return round((weighted / need) * (min_hosts / len(shards)), 4)
+
+
+@dataclass(frozen=True)
+class HostView:
+    """Immutable placement snapshot of one host's torus for one
+    generation. Built by the DRA driver (DraDriver.host_views) from the
+    current inventory epoch + a C-atomic checkpoint copy; fleetsim
+    assembles one per node.
+
+      coords    raw id -> host-local torus coords (placed chips only)
+      names     raw id -> published ResourceSlice device name
+      free      raws allocatable right now (healthy, unclaimed, present)
+      departed  raws hot-unplugged (lifecycle GONE): a hole that counts
+                toward fragmentation but can never be freed or targeted
+      claims    claim uid -> raws it occupies (migratable blockers)
+    """
+
+    node: str
+    dims: Coords
+    coords: Mapping[str, Coords]
+    names: Mapping[str, str]
+    free: frozenset
+    departed: frozenset
+    claims: Mapping[str, Tuple[str, ...]]
+
+    def free_coords(self) -> frozenset:
+        return frozenset(self.coords[r] for r in self.free
+                         if r in self.coords)
+
+    def claim_of(self) -> Dict[str, str]:
+        """raw -> occupying claim uid (inverse of `claims`)."""
+        return {raw: uid for uid, raws in self.claims.items()
+                for raw in raws}
+
+    def raw_at(self) -> Dict[Coords, str]:
+        return {c: raw for raw, c in self.coords.items()}
+
+
+def fragmentation(view: HostView) -> dict:
+    """The per-host fragmentation record /status + /metrics publish.
+
+    score = 1 - largest_placeable_subbox / free. 0.0 when free capacity
+    is one contiguous box (or the host is full — nothing to place,
+    nothing fragmented). Departed holes lower `largest_free_box` without
+    adding free capacity, so a hot-unplug RAISES the score (its slot is
+    unusable until replug) — the defrag advisor reads the same record.
+    """
+    free_coords = view.free_coords()
+    free = len(free_coords)
+    largest = largest_fit(view.dims, free_coords) if free else 0
+    score = 0.0 if free == 0 else round(1.0 - largest / free, 4)
+    return {
+        "chips": len(view.coords),
+        "free": free,
+        "departed": len(view.departed),
+        "largest_free_box": largest,
+        "fragmentation": score,
+    }
+
+
+@dataclass(frozen=True)
+class SlicePlan:
+    """One placement decision: per-host shards + how contiguous it is."""
+
+    shape: Coords
+    shards: Tuple[Tuple[str, Tuple[str, ...]], ...]   # (node, raws)
+    score: float
+    hosts: int
+
+    def devices(self) -> List[Tuple[str, str]]:
+        return [(node, raw) for node, raws in self.shards for raw in raws]
+
+
+def _host_boxes(view: HostView, shape: Coords):
+    """Candidate placements of `shape` on one host: (raws, boxset) for
+    every free axis-aligned box matching any orientation of the shape,
+    in deterministic (orientation, position) order."""
+    wanted = set(orientations(shape, len(view.dims)))
+    if not wanted:
+        return
+    free_coords = view.free_coords()
+    raw_at = view.raw_at()
+    for vol, box, boxset in _boxes(view.dims):
+        if vol != volume(shape):
+            continue
+        lengths = tuple(length for _start, length in box)
+        if lengths not in wanted:
+            continue
+        if boxset <= free_coords:
+            yield tuple(raw_at[c] for c in sorted(boxset)), boxset
+
+
+def _single_host_plan(shape: Coords, views: Sequence[HostView]
+                      ) -> Optional[SlicePlan]:
+    """Best free sub-box across hosts: best-fit by post-placement
+    fragmentation (leave the tightest host tightest), node name as the
+    deterministic tie-break."""
+    best: Optional[Tuple[tuple, SlicePlan]] = None
+    for view in views:
+        for raws, boxset in _host_boxes(view, shape):
+            remaining = view.free_coords() - boxset
+            frag_after = 0.0 if not remaining \
+                else 1.0 - largest_fit(view.dims, remaining) / len(remaining)
+            key = (round(frag_after, 6), len(view.free), view.node,
+                   sorted(boxset))
+            if best is None or key < best[0]:
+                best = (key, SlicePlan(shape=shape,
+                                       shards=((view.node, raws),),
+                                       score=1.0, hosts=1))
+    return best[1] if best else None
+
+
+def _multi_host_plan(shape: Coords, views: Sequence[HostView]
+                     ) -> Optional[SlicePlan]:
+    """Tile `shape` as a grid of FULLY-FREE host tori — the physical TPU
+    model: cross-host ICI links join whole host blocks, so a multi-host
+    slice is only a mesh when every member host contributes its complete
+    torus (v4: 2x2x1 cubes; v5e: 2x4 trays)."""
+    by_dims: Dict[Coords, List[HostView]] = {}
+    for view in views:
+        full = view.free_coords()
+        if len(full) == volume(view.dims) and not view.departed:
+            by_dims.setdefault(view.dims, []).append(view)
+    for dims, candidates in sorted(by_dims.items()):
+        for oriented in orientations(shape, len(dims)):
+            if any(s % d for s, d in zip(oriented, dims)):
+                continue
+            n_hosts = volume(tuple(s // d
+                                   for s, d in zip(oriented, dims)))
+            if n_hosts < 2 or n_hosts > len(candidates):
+                continue
+            chosen = sorted(candidates, key=lambda v: v.node)[:n_hosts]
+            shards = tuple(
+                (v.node, tuple(raw for _c, raw in sorted(
+                    (c, raw) for raw, c in v.coords.items())))
+                for v in chosen)
+            return SlicePlan(shape=shape, shards=shards, score=1.0,
+                             hosts=n_hosts)
+    return None
+
+
+def _scatter_plan(shape: Coords, views: Sequence[HostView]
+                  ) -> Optional[SlicePlan]:
+    """Best-effort fallback: fill from the freest hosts in coordinate
+    order — the 'four stragglers' a topology-blind allocator produces.
+    Scored honestly so benches can compare against the planner."""
+    need = volume(shape)
+    ordered = sorted(views, key=lambda v: (-len(v.free), v.node))
+    shards: List[Tuple[str, Tuple[str, ...]]] = []
+    scored: List[Tuple[Coords, List[Coords]]] = []
+    taken = 0
+    for view in ordered:
+        if taken >= need:
+            break
+        free_sorted = sorted(
+            (view.coords[r], r) for r in view.free if r in view.coords)
+        raws = tuple(r for _c, r in free_sorted[:need - taken])
+        if not raws:
+            continue
+        shards.append((view.node, raws))
+        scored.append((view.dims, [view.coords[r] for r in raws]))
+        taken += len(raws)
+    if taken < need:
+        return None
+    # a scatter that crossed more hosts than a perfect tiling would is
+    # penalized by the host ratio: cross-host traffic leaves ICI entirely
+    score = scatter_score(scored, need,
+                          max(volume(v.dims) for v in views))
+    return SlicePlan(shape=shape, shards=tuple(shards), score=score,
+                     hosts=len(shards))
+
+
+def plan_slice(shape: Coords, views: Sequence[HostView],
+               best_effort: bool = False) -> Optional[SlicePlan]:
+    """Place `shape` across `views`.
+
+    Contiguous placements only (score 1.0): one host sub-box, else a
+    whole-torus multi-host tiling. `best_effort=True` adds the scatter
+    fallback (score < 1.0) so callers can place-and-measure instead of
+    failing — the bench's naive baseline and the fleetsim storms use it.
+    Returns None when nothing fits.
+    """
+    if not views:
+        return None
+    plan = _single_host_plan(shape, views)
+    if plan is None:
+        plan = _multi_host_plan(shape, views)
+    if plan is None and best_effort:
+        plan = _scatter_plan(shape, views)
+    return plan
+
+
+# ------------------------------------------------------------------ defrag
+
+
+def _box_candidates(shape: Coords, view: HostView):
+    """Defrag target candidates on one host: boxes of the shape whose
+    every slot is free or claim-held. A box containing a DEPARTED hole
+    (no silicon to migrate onto) or an unhealthy/untracked occupant (no
+    claim to move) can never be emptied — skip it."""
+    wanted = set(orientations(shape, len(view.dims)))
+    if not wanted:
+        return
+    free_coords = view.free_coords()
+    raw_at = view.raw_at()
+    claim_of = view.claim_of()
+    departed_coords = {view.coords[r] for r in view.departed
+                       if r in view.coords}
+    for vol, box, boxset in _boxes(view.dims):
+        if vol != volume(shape):
+            continue
+        if tuple(length for _s, length in box) not in wanted:
+            continue
+        if boxset & departed_coords:
+            continue
+        blockers: set = set()
+        feasible = True
+        for c in boxset:
+            if c in free_coords:
+                continue
+            uid = claim_of.get(raw_at.get(c, ""))
+            if uid is None:
+                feasible = False    # unhealthy / untracked occupant
+                break
+            blockers.add(uid)
+        if feasible:
+            yield boxset, frozenset(blockers)
+
+
+def _destination(view: HostView, n: int, exclude: frozenset,
+                 reserved: set) -> Optional[Tuple[str, ...]]:
+    """`n` free slots on `view` outside `exclude` coords and not already
+    `reserved` by an earlier migration of the same proposal — preferring
+    a contiguous box of the migrated claim's size so defrag does not
+    trade one ragged tenant for another."""
+    avail = {c for c in view.free_coords() - exclude
+             if (view.node, c) not in reserved}
+    if len(avail) < n:
+        return None
+    raw_at = view.raw_at()
+    chosen = None
+    for vol, _box, boxset in _boxes(view.dims):
+        if vol > n:
+            break
+        if vol == n and boxset <= avail:
+            chosen = sorted(boxset)
+            break
+    if chosen is None:         # no exact-size contiguous box: scatter
+        chosen = sorted(avail)[:n]
+    for c in chosen:
+        reserved.add((view.node, c))
+    return tuple(raw_at[c] for c in chosen)
+
+
+def propose_defrag(shape: Coords, views: Sequence[HostView]) -> dict:
+    """The defrag advisory (docs/design.md "Slice placement" documents
+    this format):
+
+      {"shape": [...], "placeable": bool, "satisfiable": bool,
+       "free_total": n, "target": {"node", "devices": [raw...]} | None,
+       "migrations": [{"claim", "source_node", "devices": [raw...],
+                       "target_node" | None, "target_devices" | None}],
+       "moves": n}
+
+    placeable: a contiguous plan already exists (nothing to do).
+    satisfiable: total free capacity across views covers the shape —
+    when False the advisory still names the minimal evictions (with
+    target_node None = "off these hosts"), because an operator with
+    capacity elsewhere can act on it.
+    """
+    shape = parse_shape(shape)
+    need = volume(shape)
+    free_total = sum(len(v.free) for v in views)
+    out = {
+        "shape": list(shape),
+        "placeable": False,
+        "satisfiable": free_total >= need,
+        "free_total": free_total,
+        "target": None,
+        "migrations": [],
+        "moves": 0,
+    }
+    if plan_slice(shape, views) is not None:
+        out["placeable"] = True
+        return out
+    # Candidates ordered by minimal moves (fewest blocking claims, then
+    # fewest chips, then node/box for determinism). Each is then checked
+    # for DESTINATION feasibility — a smaller eviction set whose claims
+    # have nowhere to land loses to a slightly larger one that fully
+    # resolves; when nothing fully resolves, the minimal candidate is
+    # still advised with target_node None ("off these hosts").
+    candidates = sorted(
+        ((len(blockers),
+          sum(len(view.claims[uid]) for uid in blockers),
+          view.node, sorted(boxset), view, boxset, blockers)
+         for view in views
+         for boxset, blockers in _box_candidates(shape, view)),
+        key=lambda c: c[:4])
+    if not candidates:
+        return out
+    by_free = sorted(views, key=lambda v: (-len(v.free), v.node))
+    best_partial = None
+    for _n, _chips, _node, _box, view, boxset, blockers in candidates:
+        reserved: set = set()
+        migrations = []
+        resolved = True
+        for uid in sorted(blockers):
+            raws = view.claims[uid]
+            migration = {
+                "claim": uid,
+                "source_node": view.node,
+                "devices": sorted(raws),
+                "target_node": None,
+                "target_devices": None,
+            }
+            for cand in by_free:
+                exclude = boxset if cand.node == view.node else frozenset()
+                dest = _destination(cand, len(raws), exclude, reserved)
+                if dest is not None:
+                    migration["target_node"] = cand.node
+                    migration["target_devices"] = list(dest)
+                    break
+            else:
+                resolved = False
+            migrations.append(migration)
+        result = dict(out)
+        result["target"] = {
+            "node": view.node,
+            "devices": sorted(view.raw_at()[c] for c in boxset)}
+        result["migrations"] = migrations
+        result["moves"] = len(migrations)
+        if resolved:
+            return result
+        if best_partial is None:
+            best_partial = result
+    return best_partial if best_partial is not None else out

@@ -34,6 +34,7 @@ from . import epoch as epoch_mod
 from . import faults
 from . import kubeletapi as api
 from . import lockdep
+from . import placement
 from . import trace
 from .config import Config
 from .log import get_logger
@@ -195,6 +196,13 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # ListAndWatch re-sends since start (initial snapshots excluded):
         # the observable cost of health churn on the kubelet stream
         self._lw_resends = epoch_mod.AtomicCounter()
+        # ICI placement scoring of every GetPreferredAllocation answer
+        # (placement.selection_score): counter + last-score attr are
+        # lock-free owned (AtomicCounter / single attribute store), and
+        # the scoring itself runs inside the `placement.score` read-path
+        # bracket the zero-lock gate pins (tests/test_epoch.py)
+        self._placement_scored = epoch_mod.AtomicCounter()
+        self._last_placement_score = 0.0
         # Epoch (and pre-serialized ListAndWatch payload) builds since
         # start: the scale-honesty counter. A health flip of SOME OTHER
         # resource must never bump this — untouched resources keep their
@@ -550,6 +558,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 # re-send count (how much health churn reached the kubelet
                 # stream after coalescing)
                 "preferred_cache": pref_cache,
+                # ICI placement scoring of preferred-allocation answers
+                # (placement.selection_score; 1.0 = one sub-box)
+                "placement": {
+                    "scored_total": self._placement_scored.value,
+                    "last_score": self._last_placement_score,
+                },
                 "lw_resends": self._lw_resends.value,
                 # precompiled per-IOMMU-group Allocate fragment cache
                 # (allocate._GroupFragment) effectiveness
@@ -705,6 +719,18 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                                       str(exc))
                     if len(memo) < PREF_CACHE_SIZE:
                         memo[key] = ids
+                # Score the answer's ICI contiguity (placement.py): 1.0 =
+                # the chosen chips ARE one axis-aligned sub-box (one ICI
+                # ring/tile), lower = stragglers. Scored on every call
+                # (hits too — the score is the placement-quality signal
+                # /status surfaces, ~1 us over immutable prebuilt maps)
+                # inside its own read-path bracket so the epoch gate pins
+                # the scoring itself at zero registered locks.
+                with lockdep.read_path("placement.score"):
+                    coords_of = index.coords_of
+                    self._last_placement_score = placement.selection_score(
+                        self.torus_dims, [coords_of.get(i) for i in ids])
+                    self._placement_scored.add()
                 resp.container_responses.append(
                     pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
             return resp

@@ -21,6 +21,13 @@ dependency-free endpoint for liveness probes and debugging:
                    ?claim=<uid> / ?bdf=<raw id> / ?op=<prefix> /
                    ?limit=<n>, plus the slow-span log — the "what
                    happened to claim X" surface (docs/observability.md)
+  GET /debug/defrag -> the defrag advisor (placement.py): given
+                   ?shape=2x2[&generation=v5e], the minimal claim
+                   migrations that would free a contiguous ICI box for
+                   that shape on this node (docs/design.md "Slice
+                   placement" documents the proposal format). Requires
+                   the DRA driver; advisory only — applying it rides
+                   the migration-handoff machinery.
 
 Disabled by default (--status-port 0).
 
@@ -116,6 +123,24 @@ class StatusServer:
                         claim=first("claim"), bdf=first("bdf"),
                         op=first("op"), limit=limit),
                         sort_keys=True).encode())
+                elif route == "/debug/defrag":
+                    if outer.dra_driver is None:
+                        return self._send(
+                            404, b"no DRA driver attached", "text/plain")
+                    query = parse_qs(parts.query, keep_blank_values=True)
+                    shape = (query.get("shape") or [None])[0]
+                    generation = (query.get("generation") or [None])[0]
+                    if not shape:
+                        return self._send(
+                            400, b"shape=NxN[xN] query parameter required",
+                            "text/plain")
+                    try:
+                        proposal = outer.defrag(shape, generation)
+                    except ValueError as exc:
+                        return self._send(400, str(exc).encode(),
+                                          "text/plain")
+                    self._send(200, json.dumps(proposal,
+                                               sort_keys=True).encode())
                 else:
                     self._send(404, b"not found", "text/plain")
 
@@ -149,6 +174,13 @@ class StatusServer:
         from . import lockdep
         with lockdep.read_path("status.endpoint"):
             return self._status_impl()
+
+    def defrag(self, shape: str, generation=None) -> dict:
+        """The /debug/defrag body: this node's defrag advisory for the
+        requested slice shape (DraDriver.propose_defrag over lock-free
+        host views; raises ValueError on a malformed shape or unknown
+        generation — the handler answers 400)."""
+        return self.dra_driver.propose_defrag(shape, generation)
 
     def flight(self, claim=None, bdf=None, op=None, limit=None) -> dict:
         """The /debug/flight body: merged span ring (time-ordered,
@@ -227,6 +259,12 @@ class StatusServer:
                 # (hot-unplug) awaiting replug readmission
                 "orphaned_claims": d.orphaned_claims(),
                 "departed_devices": d.departed_devices(),
+                # slice placement (placement.py): per-generation
+                # fragmentation records (largest placeable sub-box vs
+                # free capacity, recomputed per epoch publish) and the
+                # advisor counters — all lock-free attribute reads
+                "fragmentation": d.fragmentation_stats(),
+                "placement": dict(d.placement_stats),
                 "republish_backoff": d.republish_backoff.snapshot(),
                 # delta (generation-keyed guarded PUT) vs full
                 # (read-modify-write) slice publishes
@@ -308,6 +346,24 @@ class StatusServer:
                     f'tpu_plugin_pref_cache_total{{resource='
                     f'"{_esc(p["resource"])}",outcome="{outcome}"}} '
                     f'{cache.get(key, 0)}')
+        lines += ["# HELP tpu_plugin_pref_placement_scored_total "
+                  "GetPreferredAllocation answers scored for ICI "
+                  "contiguity (placement.selection_score).",
+                  "# TYPE tpu_plugin_pref_placement_scored_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_pref_placement_scored_total'
+                f'{{resource="{_esc(p["resource"])}"}} '
+                f'{p.get("placement", {}).get("scored_total", 0)}')
+        lines += ["# HELP tpu_plugin_pref_placement_score ICI contiguity "
+                  "of the most recent preferred-allocation answer "
+                  "(1 = one axis-aligned sub-box, lower = stragglers).",
+                  "# TYPE tpu_plugin_pref_placement_score gauge"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_pref_placement_score'
+                f'{{resource="{_esc(p["resource"])}"}} '
+                f'{p.get("placement", {}).get("last_score", 0.0)}')
         lines += ["# HELP tpu_plugin_lw_resends_total ListAndWatch re-sends "
                   "after debounce coalescing (initial snapshots excluded).",
                   "# TYPE tpu_plugin_lw_resends_total counter"]
@@ -572,7 +628,57 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_pacing_window_ms gauge",
                 f"tpu_plugin_dra_pacing_window_ms "
                 f"{s['dra']['pacing']['window_ms']}",
+                # slice placement / fragmentation (placement.py)
+                "# HELP tpu_plugin_dra_frag_recomputes_total Fragmentation "
+                "snapshot rebuilds (one per inventory-epoch publish or "
+                "checkpoint group commit).",
+                "# TYPE tpu_plugin_dra_frag_recomputes_total counter",
+                f"tpu_plugin_dra_frag_recomputes_total "
+                f"{s['dra']['placement']['frag_recomputes_total']}",
+                "# HELP tpu_plugin_dra_defrag_proposals_total Defrag "
+                "advisories computed (/debug/defrag + fleetsim).",
+                "# TYPE tpu_plugin_dra_defrag_proposals_total counter",
+                f"tpu_plugin_dra_defrag_proposals_total "
+                f"{s['dra']['placement']['defrag_proposals_total']}",
+                "# HELP tpu_plugin_dra_defrag_unsatisfiable_total Defrag "
+                "advisories whose shape exceeded total free capacity "
+                "(no migration set can help; add hosts instead).",
+                "# TYPE tpu_plugin_dra_defrag_unsatisfiable_total counter",
+                f"tpu_plugin_dra_defrag_unsatisfiable_total "
+                f"{s['dra']['placement']['defrag_unsatisfiable_total']}",
             ]
+            frag = s["dra"].get("fragmentation") or {}
+            if frag:
+                lines += [
+                    "# HELP tpu_plugin_dra_fragmentation Per-generation "
+                    "fragmentation score: 1 - largest placeable sub-box "
+                    "/ free chips (0 = one contiguous box).",
+                    "# TYPE tpu_plugin_dra_fragmentation gauge",
+                ]
+                for gen, rec in sorted(frag.items()):
+                    lines.append(
+                        f'tpu_plugin_dra_fragmentation'
+                        f'{{generation="{_esc(gen)}"}} '
+                        f'{rec["fragmentation"]}')
+                lines += [
+                    "# HELP tpu_plugin_dra_largest_free_box Chips in the "
+                    "largest axis-aligned free sub-box of the host torus.",
+                    "# TYPE tpu_plugin_dra_largest_free_box gauge",
+                ]
+                for gen, rec in sorted(frag.items()):
+                    lines.append(
+                        f'tpu_plugin_dra_largest_free_box'
+                        f'{{generation="{_esc(gen)}"}} '
+                        f'{rec["largest_free_box"]}')
+                lines += [
+                    "# HELP tpu_plugin_dra_free_chips Chips free for "
+                    "placement (healthy, unclaimed, present).",
+                    "# TYPE tpu_plugin_dra_free_chips gauge",
+                ]
+                for gen, rec in sorted(frag.items()):
+                    lines.append(
+                        f'tpu_plugin_dra_free_chips'
+                        f'{{generation="{_esc(gen)}"}} {rec["free"]}')
             breaker = s["dra"].get("api_breaker")
             if breaker is not None:
                 lines += [

@@ -78,6 +78,21 @@ def assign_coords(
     for b, c in bad.items():
         log.warning("topology hint %s=%s invalid for torus %s; ignoring", b, c, dims)
     hints = {b: c for b, c in hints.items() if b not in bad}
+    # Duplicate coordinates across hint entries: two chips on ONE torus
+    # slot would poison every sub-box score downstream (a "2-chip box"
+    # that is physically one chip). Reject the WHOLE colliding group —
+    # picking a winner would silently mislabel the loser's physical slot
+    # — and let the path/BDF layout place them like any unhinted chip.
+    by_coord: Dict[Coords, List[str]] = {}
+    for b, c in hints.items():
+        by_coord.setdefault(c, []).append(b)
+    colliding = {b for group in by_coord.values() if len(group) > 1
+                 for b in group}
+    for b in sorted(colliding):
+        log.warning("topology hint %s=%s duplicates another hint's "
+                    "coordinates on torus %s; ignoring the colliding "
+                    "hints", b, hints[b], dims)
+    hints = {b: c for b, c in hints.items() if b not in colliding}
     grid = list(itertools.product(*[range(d) for d in dims]))
     unhinted = [b for b in sorted(bdfs,
                                   key=lambda b: (pcie_paths.get(b, b), b))
