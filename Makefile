@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker test-broker-spawn fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -170,6 +170,21 @@ bench-scale:
 # variant.
 bench-placement:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --placement
+
+# Privilege-separation bench (docs/design.md "Privilege separation"):
+# the attach path in BOTH broker modes — counted crossings per attach
+# (the <=2 budget tests/test_perf_honesty.py pins) and the spawned
+# broker's IPC crossing overhead. Writes docs/bench_broker_r13.json.
+# CI bench-smoke runs the --quick variant.
+bench-broker:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --broker
+
+# Broker + policy suites over the REAL two-process path: every
+# seam-facing assertion re-executed with a spawned broker process per
+# fixture root (the CI broker-spawn job's body).
+test-broker-spawn:
+	TDP_BROKER=spawn JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_broker.py tests/test_policy.py -q
 
 # Fleet chaos soak (nightly-shape, gated): 64-node boot storm + flip
 # wave + 1024-claim attach + rolling upgrade with chaos faults armed

@@ -1,0 +1,26 @@
+# Example operator policy module (docs/policy.md).
+#
+# Load with:  tpu-device-plugin --policy-dir examples/
+#
+# Runs under the sandboxed evaluator (tpu_device_plugin/policy.py): no
+# imports, no filesystem — pure functions over the decision ctx.
+
+
+def score_allocation(ctx):
+    """Keep the ICI placement engine's answer when it found a single
+    contiguous sub-box; otherwise prefer the highest-numbered chips
+    (e.g. the freshest silicon bank on this fleet's boards)."""
+    if ctx["builtin_score"] >= 1.0:
+        return None
+    ranked = sorted(ctx["available"], reverse=True)
+    must = list(ctx["must_include"])
+    take = [d for d in ranked if d not in must]
+    return (must + take)[:ctx["size"]]
+
+
+def admit(ctx):
+    """Freeze DRA prepares for a namespace under maintenance; admit
+    everything else (None = builtin behavior)."""
+    if ctx["op"] == "prepare" and ctx.get("namespace") == "frozen":
+        return "namespace frozen for maintenance"
+    return None

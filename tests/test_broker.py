@@ -1,0 +1,735 @@
+"""Privilege-separated broker tests (ISSUE 11 tentpole).
+
+Covers the brokeripc wire contract (framing round-trip, fd passing over
+real socketpairs, version-mismatch handshake refusal, oversized and
+malformed frame rejection), the BrokerServer's path policy + audit
+plane (every crossing carries the caller's flight-recorder span), the
+held-fd registry surviving serving-daemon disconnects, the typed
+BrokerUnavailable degradation on broker death with respawn + handshake
+recovery, and the seam semantics both client shapes share.
+
+The suite runs its seam-facing tests against BOTH client shapes: the
+default in-process broker, and — under ``TDP_BROKER=spawn`` (the CI
+matrix leg) — a real spawned broker process per fixture root, so the
+two-process path is exercised by the same assertions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from tpu_device_plugin import broker, brokeripc, faults, trace
+from tpu_device_plugin.broker import (BrokerError, BrokerServer,
+                                      BrokerUnavailable, InProcessBroker,
+                                      PathPolicy, SocketBrokerClient)
+
+SPAWN_MODE = os.environ.get("TDP_BROKER") == "spawn"
+
+
+@pytest.fixture(autouse=True)
+def clean_seam():
+    """Every test starts from the lazy in-process default and leaves no
+    installed client behind."""
+    broker.reset_client()
+    faults.reset()
+    yield
+    faults.reset()
+    broker.reset_client()
+
+
+def _wait(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def served(short_root):
+    """An in-process BrokerServer on a real unix socket + a connected
+    SocketBrokerClient — the two-process wire without the process
+    spawn cost (the real-subprocess path has its own tests below)."""
+    sock_path = os.path.join(short_root, "broker.sock")
+    server = BrokerServer(sock_path, root=short_root)
+    server.start()
+    client = SocketBrokerClient(sock_path)
+    yield short_root, server, client
+    client.close()
+    server.stop()
+
+
+@pytest.fixture
+def bare_server(short_root):
+    """A BrokerServer with NO connected client: the broker accepts ONE
+    daemon connection at a time by design, so tests that drive raw
+    sockets must not share the socket with a fixture client."""
+    sock_path = os.path.join(short_root, "broker.sock")
+    server = BrokerServer(sock_path, root=short_root)
+    server.start()
+    yield short_root, server
+    server.stop()
+
+
+@pytest.fixture
+def seam(short_root):
+    """The seam under test: in-process by default; under TDP_BROKER=spawn
+    a REAL broker subprocess rooted at the fixture tree, installed as
+    the process-global client — the CI matrix leg's two-process path."""
+    if SPAWN_MODE:
+        sock_path = os.path.join(short_root, "broker.sock")
+        proc = broker.spawn_broker(sock_path, root=short_root)
+        client = SocketBrokerClient(sock_path)
+        prev = broker.set_client(client)
+        yield short_root, client
+        broker.set_client(prev)
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+    else:
+        client = InProcessBroker()
+        prev = broker.set_client(client)
+        yield short_root, client
+        broker.set_client(prev)
+
+
+# ------------------------------------------------------------- framing
+
+
+def test_frame_round_trip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        obj = {"op": "hello", "seq": 7, "nested": {"x": [1, 2, 3]}}
+        brokeripc.send_frame(a, obj)
+        got, fds = brokeripc.recv_frame(b)
+        assert got == obj
+        assert fds == []
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fd_passing_over_real_socketpair(tmp_path):
+    """SCM_RIGHTS: the receiver's fd is a live duplicate — reading it
+    yields the sender's file content."""
+    payload_file = tmp_path / "node"
+    payload_file.write_bytes(b"device-bytes")
+    a, b = socket.socketpair()
+    fd = os.open(payload_file, os.O_RDONLY)
+    try:
+        brokeripc.send_frame(a, {"ok": True, "seq": 1}, fds=(fd,))
+        got, fds = brokeripc.recv_frame(b, want_fds=1)
+        assert got["ok"] is True
+        assert len(fds) == 1
+        # the received fd is a kernel dup: reading it proves liveness
+        assert os.pread(fds[0], 64, 0) == b"device-bytes"
+        os.close(fds[0])
+    finally:
+        os.close(fd)
+        a.close()
+        b.close()
+
+
+def test_oversized_frame_rejected_without_allocation():
+    """A corrupt length prefix must raise, not allocate gigabytes."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(brokeripc.MAGIC + struct.pack(">I", brokeripc.MAX_FRAME + 1))
+        with pytest.raises(brokeripc.BrokerProtocolError,
+                           match="exceeds MAX_FRAME"):
+            brokeripc.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bad_magic_and_malformed_payload_rejected():
+    for wire, match in (
+            (b"XXXX" + struct.pack(">I", 2) + b"{}", "bad frame magic"),
+            (brokeripc.MAGIC + struct.pack(">I", 9) + b"not-json!",
+             "malformed"),
+            (brokeripc.MAGIC + struct.pack(">I", 2) + b"[]",
+             "not an object")):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(wire)
+            with pytest.raises(brokeripc.BrokerProtocolError, match=match):
+                brokeripc.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_peer_death_mid_frame_is_connection_lost():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(brokeripc.MAGIC + struct.pack(">I", 100) + b"short")
+        a.close()
+        with pytest.raises(brokeripc.BrokerConnectionLost):
+            brokeripc.recv_frame(b)
+    finally:
+        b.close()
+
+
+# ----------------------------------------------------------- handshake
+
+
+def test_version_mismatch_handshake_refused(bare_server):
+    """A client speaking a future protocol version is refused BEFORE any
+    operation is served."""
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        brokeripc.send_frame(raw, {
+            "op": "hello", "seq": 0,
+            "version": brokeripc.PROTOCOL_VERSION + 1})
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is False
+        assert "version" in reply["error"]
+        with pytest.raises(brokeripc.BrokerProtocolError,
+                           match="refused handshake"):
+            brokeripc.check_hello_reply(reply)
+    finally:
+        raw.close()
+
+
+def test_malformed_frame_closes_connection_with_protocol_error(bare_server):
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        raw.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 8)
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is False
+        assert reply["kind"] == "protocol"
+        # the broker closed the connection after the framing error
+        # (clean EOF or RST depending on unread bytes — both are "gone")
+        try:
+            assert raw.recv(1) == b""
+        except ConnectionResetError:
+            pass
+    finally:
+        raw.close()
+
+
+# ----------------------------------------------------- path policy
+
+
+def test_path_policy_refuses_outside_roots(short_root):
+    policy = PathPolicy(short_root)
+    with pytest.raises(BrokerError, match="path policy"):
+        policy.check_read("/etc/shadow")
+    with pytest.raises(BrokerError, match="path policy"):
+        policy.check_node(os.path.join(short_root, "etc/passwd"))
+    with pytest.raises(BrokerError, match="path policy"):
+        policy.check_write(os.path.join(short_root, "sys/devices/x/remove"))
+    # component safety: <root>/system must not pass as <root>/sys
+    with pytest.raises(BrokerError, match="path policy"):
+        policy.check_read(os.path.join(short_root, "system/x"))
+    # the allowed shapes
+    policy.check_read(os.path.join(short_root, "sys/bus/pci/devices"))
+    policy.check_node(os.path.join(short_root, "dev/vfio/11"))
+    policy.check_node(os.path.join(short_root, "dev/accel0"))
+    policy.check_write(os.path.join(
+        short_root, "sys/bus/pci/drivers/vfio-pci/bind"))
+
+
+def test_server_refuses_bad_paths_with_typed_errors(served):
+    root, server, client = served
+    with pytest.raises(BrokerError, match="refused"):
+        client.read_attr("k", "/etc/hostname")
+    with pytest.raises(BrokerError, match="refused"):
+        client.open_node(os.path.join(root, "sys/whatever"))
+    with pytest.raises(BrokerError, match="refused"):
+        client.write_sysfs(os.path.join(root, "sys/x/remove"), "1")
+    # the connection survives refusals: a good request still answers
+    os.makedirs(os.path.join(root, "sys/bus"), exist_ok=True)
+    assert client.node_exists(os.path.join(root, "sys/bus")) is True
+
+
+# ------------------------------------------------- operations + audit
+
+
+def test_open_node_passes_fd_and_broker_holds_its_own(served):
+    root, server, client = served
+    node = os.path.join(root, "dev/vfio/11")
+    os.makedirs(os.path.dirname(node), exist_ok=True)
+    with open(node, "w") as f:
+        f.write("vfio-group-11")
+    fd = client.open_node(node)
+    try:
+        assert os.pread(fd, 64, 0) == b"vfio-group-11"
+    finally:
+        os.close(fd)
+    stats = client.stats()
+    assert stats["broker"]["held_fds"] == 1
+    assert node in stats["broker"]["held_paths"]
+
+
+def test_broker_keeps_fds_across_client_disconnect(served):
+    """kill -9 of the serving daemon: the broker sees EOF, keeps its
+    held fds, and serves the reconnected daemon with audit intact."""
+    root, server, client = served
+    node = os.path.join(root, "dev/vfio/12")
+    os.makedirs(os.path.dirname(node), exist_ok=True)
+    with open(node, "w") as f:
+        f.write("x")
+    os.close(client.open_node(node))
+    ops_before = client.stats()["broker"]["ops"]["open_node"]
+    # abrupt disconnect — no shutdown op, exactly what SIGKILL produces
+    client.close()
+    client2 = SocketBrokerClient(server.socket_path)
+    try:
+        stats = client2.stats()["broker"]
+        assert stats["held_fds"] == 1, "broker dropped fds on daemon death"
+        assert stats["ops"]["open_node"] == ops_before
+    finally:
+        client2.close()
+
+
+def test_every_crossing_is_audited_with_span_context(served):
+    """Each request carries the caller's active flight-recorder span;
+    the broker's audit ring links the crossing back to it, and the
+    client records a broker.ipc span per crossing."""
+    root, server, client = served
+    trace.reset()
+    with trace.span("dra.prepare.claim", claim_uid="claim-42"):
+        client.node_exists(os.path.join(root, "dev"))
+    spans = trace.snapshot(op="broker.ipc")
+    assert spans, "crossing recorded no broker.ipc span"
+    crossing_span = spans[-1]
+    # attribute inheritance: the crossing span carries the claim context
+    assert crossing_span["attrs"]["claim_uid"] == "claim-42"
+    audit = client.stats()["broker"]["audit"]
+    crossing = [a for a in audit if a["op"] == "node_exists"][-1]
+    # the broker's audit entry links back to the daemon-side crossing
+    # span (op + seq), so /debug/flight and /debug/broker correlate
+    assert crossing["span"] is not None
+    assert crossing["span"]["op"] == "broker.ipc"
+    assert crossing["span"]["seq"] == crossing_span["seq"]
+    trace.reset()
+
+
+def test_write_sysfs_performs_rebind_write(served):
+    root, server, client = served
+    bind = os.path.join(root, "sys/bus/pci/drivers/vfio-pci/bind")
+    os.makedirs(os.path.dirname(bind), exist_ok=True)
+    with open(bind, "w") as f:
+        f.write("")
+    client.write_sysfs(bind, "0000:00:04.0")
+    with open(bind) as f:
+        assert f.read() == "0000:00:04.0"
+
+
+def test_read_attr_and_read_link(served):
+    root, server, client = served
+    dev_dir = os.path.join(root, "sys/bus/pci/devices/0000:00:04.0")
+    os.makedirs(dev_dir, exist_ok=True)
+    with open(os.path.join(dev_dir, "vendor"), "w") as f:
+        f.write("0x1ae0\n")
+    os.makedirs(os.path.join(root, "sys/kernel/iommu_groups/7"),
+                exist_ok=True)
+    os.symlink(os.path.join(root, "sys/kernel/iommu_groups/7"),
+               os.path.join(dev_dir, "iommu_group"))
+    assert client.read_attr("v", os.path.join(dev_dir, "vendor")) \
+        .strip() == b"0x1ae0"
+    assert client.read_link(os.path.join(dev_dir, "iommu_group")) == "7"
+    assert client.read_attr("gone", os.path.join(dev_dir, "absent")) is None
+
+
+# -------------------------------------------- death + typed degradation
+
+
+def test_broker_death_yields_typed_unavailable_then_reconnect(served):
+    root, server, client = served
+    assert client.node_exists(os.path.join(root, "dev")) is False
+    server.stop()
+    with pytest.raises(BrokerUnavailable, match="broker unavailable"):
+        client.node_exists(os.path.join(root, "dev"))
+    # every later call fails fast with the SAME typed error
+    with pytest.raises(BrokerUnavailable):
+        client.read_link(os.path.join(root, "dev"))
+    # respawn (new server, same socket) + handshake recovers
+    server2 = BrokerServer(server.socket_path, root=root)
+    server2.start()
+    try:
+        client.reconnect()
+        assert client.node_exists(os.path.join(root, "dev")) is False
+        assert client.reconnects.value == 1
+    finally:
+        server2.stop()
+
+
+def test_injected_broker_fault_is_typed_unavailable():
+    client = InProcessBroker()
+    with faults.injected("broker.ipc", kind="drop", count=1):
+        with pytest.raises(BrokerUnavailable, match="broker unavailable"):
+            client.node_exists("/dev")
+    # disarmed: back to answering
+    assert isinstance(client.node_exists("/dev"), bool)
+    assert client.errors.value == 1
+
+
+# -------------------------------------------------- real subprocess path
+
+
+def test_spawned_broker_kill9_respawn_recovers(short_root):
+    """The acceptance shape against a REAL broker process: kill -9 →
+    typed unavailable; respawn + handshake → recovery; the respawned
+    broker is a different pid."""
+    sock_path = os.path.join(short_root, "broker.sock")
+    proc = broker.spawn_broker(sock_path, root=short_root)
+    client = SocketBrokerClient(sock_path)
+    try:
+        pid1 = client.stats()["broker"]["pid"]
+        assert pid1 == proc.pid
+        proc.kill()
+        proc.wait(timeout=5)
+        with pytest.raises(BrokerUnavailable):
+            client.node_exists(os.path.join(short_root, "dev"))
+        proc = broker.spawn_broker(sock_path, root=short_root)
+        client.reconnect()
+        pid2 = client.stats()["broker"]["pid"]
+        assert pid2 == proc.pid and pid2 != pid1
+    finally:
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_spawned_broker_survives_client_death(short_root):
+    sock_path = os.path.join(short_root, "broker.sock")
+    proc = broker.spawn_broker(sock_path, root=short_root)
+    try:
+        client = SocketBrokerClient(sock_path)
+        client.close()          # daemon "dies"
+        client2 = SocketBrokerClient(sock_path)   # daemon "restarts"
+        assert client2.stats()["broker"]["pid"] == proc.pid
+        client2.close()
+        assert proc.poll() is None, "broker died with its client"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+# --------------------------------------------------- seam-facing tests
+
+
+def test_seam_allocate_crossing_budget_and_audit(seam):
+    """A steady-state Allocate plan crosses the privilege boundary at
+    most twice (one batched revalidation + at most one TTL-expired
+    iommufd probe) in EITHER mode, every crossing visible as a
+    broker.ipc span."""
+    from dataclasses import replace as dc_replace
+
+    from tests.fakehost import FakeChip, FakeHost
+    from tpu_device_plugin.allocate import AllocationPlanner
+    from tpu_device_plugin.config import Config
+    from tpu_device_plugin.discovery import discover_passthrough
+
+    root, client = seam
+    host = FakeHost(root)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i)))
+    cfg = dc_replace(Config().with_root(root), shared_scan_ttl_s=60.0)
+    registry, _ = discover_passthrough(cfg)
+    planner = AllocationPlanner(cfg, registry, "v4")
+    bdfs = sorted(registry.bdf_to_group)
+    trace.reset()
+    planner.plan(bdfs)                      # cold: fragments + iommufd
+    before = client.crossings.value
+    planner.plan(bdfs)                      # steady state
+    per_attach = client.crossings.value - before
+    assert 1 <= per_attach <= 2, per_attach
+    spans = trace.snapshot(op="broker.ipc")
+    assert any(s["attrs"]["broker_op"] == "revalidate" for s in spans)
+    trace.reset()
+
+
+def test_seam_supports_iommufd_routes_through_broker(seam):
+    from tpu_device_plugin.allocate import supports_iommufd
+    from tpu_device_plugin.config import Config
+
+    root, client = seam
+    cfg = Config().with_root(root)
+    before = client.crossings.value
+    assert supports_iommufd(cfg) is False
+    os.makedirs(os.path.join(root, "dev"), exist_ok=True)
+    with open(os.path.join(root, "dev/iommu"), "w") as f:
+        f.write("")
+    assert supports_iommufd(cfg) is True
+    assert client.crossings.value == before + 2
+
+
+def test_seam_read_link_routes_mdev_prepare(seam):
+    root, client = seam
+    target_dir = os.path.join(root, "sys/kernel/iommu_groups/42")
+    os.makedirs(target_dir, exist_ok=True)
+    link = os.path.join(root, "sys/bus/mdev/devices")
+    os.makedirs(link, exist_ok=True)
+    link_path = os.path.join(link, "iommu_group")
+    os.symlink(target_dir, link_path)
+    assert broker.seam_read_link(link_path) == "42"
+    assert broker.seam_read_link(os.path.join(link, "absent")) is None
+
+
+def test_brokered_health_shim_matches_native_verdicts(seam):
+    """BrokeredHealth forwards every probe through the seam client (IPC
+    in spawn mode, direct in-process) and its verdicts agree with the
+    plain native shim's; broker.health_shim picks the right shape for
+    the installed client."""
+    from tpu_device_plugin.native import MISSING, OK, TpuHealth
+
+    root, client = seam
+    picked = broker.health_shim()
+    if SPAWN_MODE:
+        assert isinstance(picked, broker.BrokeredHealth)
+    else:
+        assert isinstance(picked, TpuHealth)
+    # the brokered shape must answer identically over EITHER client
+    shim = broker.BrokeredHealth(client)
+    dev_dir = os.path.join(root, "sys/bus/pci/devices/0000:00:04.0")
+    os.makedirs(dev_dir, exist_ok=True)
+    with open(os.path.join(dev_dir, "config"), "wb") as f:
+        f.write(b"\xe0\x1a\x00\x00\x00\x00\x00\x00")
+    native = TpuHealth()
+    cfg_path = os.path.join(dev_dir, "config")
+    assert shim.probe_config(cfg_path) == native.probe_config(cfg_path) == OK
+    assert shim.probe_config(cfg_path + ".gone") == MISSING
+    assert shim.chip_alive(os.path.join(root, "sys/bus/pci/devices"),
+                           "0000:00:04.0") is True
+    bits, _link = shim.chip_diagnostics(
+        os.path.join(root, "sys/bus/pci/devices"), "0000:00:04.0")
+    assert bits == 0
+
+
+def test_in_process_node_policy_matches_spawned_policy():
+    client = InProcessBroker()
+    with pytest.raises(BrokerError, match="not a device node"):
+        client.open_node("/etc/passwd")
+    with pytest.raises(BrokerError, match="write_sysfs refused"):
+        client.write_sysfs("/sys/bus/pci/devices/x/remove", "1")
+
+
+def test_seam_default_and_set_reset():
+    default = broker.get_client()
+    assert isinstance(default, InProcessBroker)
+    assert broker.get_client() is default       # stable
+    other = InProcessBroker()
+    prev = broker.set_client(other)
+    assert prev is default
+    assert broker.get_client() is other
+    broker.reset_client()
+    assert broker.get_client() is not other
+
+
+def test_broker_main_entrypoint_serves_and_exits(short_root):
+    """python -m tpu_device_plugin.broker round-trip: the module main
+    binds, answers a handshake + an op, and exits on shutdown."""
+    sock_path = os.path.join(short_root, "broker.sock")
+    proc = broker.spawn_broker(sock_path, root=short_root)
+    client = SocketBrokerClient(sock_path)
+    try:
+        assert client.node_exists(os.path.join(short_root, "dev")) is False
+        client.shutdown_broker()
+        assert _wait(lambda: proc.poll() is not None, timeout=5)
+        assert proc.returncode == 0
+    finally:
+        client.close()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=5)
+
+
+def test_stats_surface_is_json_serializable(seam):
+    root, client = seam
+    client.node_exists(os.path.join(root, "dev"))
+    json.dumps(client.stats(), default=str)
+    json.dumps(client.client_stats())
+
+
+# ----------------------------------------- hardening (review findings)
+
+
+def test_malformed_request_fields_do_not_kill_the_broker(served):
+    """A compromised/version-skewed daemon sending structurally-valid
+    frames with missing or wrong-shaped FIELDS gets typed refusals —
+    never a dead accept thread with dropped fds (the threat-model DoS)."""
+    root, server, client = served
+    node = os.path.join(root, "dev/vfio/13")
+    os.makedirs(os.path.dirname(node), exist_ok=True)
+    with open(node, "w") as f:
+        f.write("x")
+    os.close(client.open_node(node))
+    for req in ({"op": "node_exists"},                  # missing path
+                {"op": "chip_alive", "pci_base": 7},    # wrong shape
+                {"op": "revalidate",
+                 "pci_base": os.path.join(root, "sys"),
+                 "pairs": [["only-one-element"]]},      # not a 2-list
+                {"op": "open_node"}):
+        with pytest.raises(BrokerError, match="refused"):
+            client._request(**{k: v for k, v in req.items() if k != "op"},
+                            op=req["op"])
+    # the broker survived every one of them: fds held, still serving
+    stats = client.stats()["broker"]
+    assert stats["held_fds"] == 1
+    assert client.node_exists(node) is True
+
+
+def test_traversal_bdf_and_arbitrary_node_are_refused(served):
+    """PathPolicy holds for the joined/indirect fields too: a traversal
+    bdf must not escape the readable roots, and the chip_alive node path
+    must not be usable as an arbitrary-file existence oracle."""
+    root, server, client = served
+    base = os.path.join(root, "sys/bus/pci/devices")
+    os.makedirs(base, exist_ok=True)
+    with pytest.raises(BrokerError, match="path component"):
+        client.chip_alive(base, "../../../etc")
+    with pytest.raises(BrokerError, match="path component"):
+        client.chip_diagnostics(base, "..")
+    with pytest.raises(BrokerError, match="path policy"):
+        client._request("chip_alive", pci_base=base,
+                        bdf="0000:00:04.0", node="/etc/hostname")
+
+    class _Planner:
+        class cfg:
+            pci_base_path = base
+        _vendor_ok = frozenset({"1ae0"})
+
+    from tpu_device_plugin.allocate import AllocationError as _AE
+    with pytest.raises((BrokerError, _AE), match="path component"):
+        client.revalidate_batch(_Planner(), [("../escape", "11")])
+
+
+def test_ops_refused_before_handshake(bare_server):
+    """A client that SKIPS hello gets nothing: the version contract
+    ('refused before serving anything else') must not depend on client
+    cooperation."""
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        brokeripc.send_frame(raw, {"op": "node_exists", "seq": 1,
+                                   "path": os.path.join(root, "dev")})
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is False
+        assert reply["kind"] == "version"
+        # hello unlocks the connection
+        brokeripc.send_frame(raw, brokeripc.hello_request(seq=2))
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is True
+        brokeripc.send_frame(raw, {"op": "node_exists", "seq": 3,
+                                   "path": os.path.join(root, "dev")})
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is True
+    finally:
+        raw.close()
+
+
+def test_wedged_broker_times_out_typed_unavailable(short_root):
+    """A broker that is alive but STUCK (accepts + handshakes, then
+    never answers) must degrade to typed unavailable within the op
+    timeout — not pin the channel lock forever."""
+    import threading
+
+    sock_path = os.path.join(short_root, "wedged.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(1)
+
+    def wedge():
+        conn, _ = listener.accept()
+        req, _ = brokeripc.recv_frame(conn)          # the hello
+        brokeripc.send_frame(conn, {
+            "ok": True, "seq": req["seq"],
+            "version": brokeripc.PROTOCOL_VERSION})
+        brokeripc.recv_frame(conn)                   # the op — swallowed
+        time.sleep(5)                                # ...and never answered
+        conn.close()
+
+    t = threading.Thread(target=wedge, daemon=True)
+    t.start()
+    client = SocketBrokerClient(sock_path, op_timeout_s=0.3)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(BrokerUnavailable):
+            client.node_exists("/dev")
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        client.close()
+        listener.close()
+        t.join(timeout=6)
+
+
+def test_spawn_mode_accepts_0x_prefixed_vendor_ids(served):
+    """cfg.vendor_ids spelled with the 0x prefix must revalidate
+    identically over the broker (the in-process reader accepts both
+    spellings; a mode-dependent divergence would be a spawn-only
+    outage)."""
+    root, server, client = served
+    base = os.path.join(root, "sys/bus/pci/devices")
+    dev = os.path.join(base, "0000:00:04.0")
+    os.makedirs(dev, exist_ok=True)
+    with open(os.path.join(dev, "vendor"), "w") as f:
+        f.write("0x1ae0\n")
+    os.makedirs(os.path.join(root, "sys/kernel/iommu_groups/11"),
+                exist_ok=True)
+    os.symlink(os.path.join(root, "sys/kernel/iommu_groups/11"),
+               os.path.join(dev, "iommu_group"))
+
+    class _Planner:
+        class cfg:
+            pci_base_path = base
+
+    for spelling in ("1ae0", "0x1ae0"):
+        _Planner._vendor_ok = frozenset({spelling})
+        client.revalidate_batch(_Planner(), [("0000:00:04.0", "11")])
+
+
+def test_shutdown_requires_handshake(bare_server):
+    """An un-handshaked local process must NOT be able to kill the
+    privileged broker through the socket: a refused shutdown leaves the
+    broker serving."""
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        brokeripc.send_frame(raw, {"op": "shutdown", "seq": 1})
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is False and reply["kind"] == "version"
+        assert not server._stop.is_set(), \
+            "refused shutdown still stopped the broker"
+    finally:
+        raw.close()
+    # the broker still serves a proper (handshaked) client
+    client = SocketBrokerClient(server.socket_path)
+    try:
+        assert client.node_exists(os.path.join(root, "dev")) is False
+    finally:
+        client.close()
+
+
+def test_socket_live_distinguishes_wedged_from_dead(short_root):
+    path = os.path.join(short_root, "probe.sock")
+    assert broker.socket_live(path) is False          # nothing there
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(1)
+    try:
+        assert broker.socket_live(path) is True       # listening (wedged)
+    finally:
+        listener.close()
+    assert broker.socket_live(path) is False          # stale socket file

@@ -451,3 +451,155 @@ def test_checkpoint_write_fault_errors_claims_never_silent_acks(dra_rig):
         # versioned envelope (dra.CHECKPOINT_VERSION): claims live under
         # the "claims" key
         assert set(json_mod.load(f)["claims"]) == set(uids)
+
+
+# --------------------------------------------------- broker chaos (ISSUE 11)
+
+
+def test_broker_fault_mid_allocate_degrades_typed_unavailable(dra_rig):
+    """faults: broker.ipc armed on the privilege seam — every claim whose
+    prepare crosses the boundary while armed errors with the typed
+    'broker unavailable' prefix and rolls back; when the fault clears,
+    the kubelet retry prepares exactly once (checkpoint audit clean)."""
+    from tpu_device_plugin.dra import slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+
+    host, cfg, apiserver, driver, breaker = dra_rig
+    names = [slice_device_name(c.bdf) for c in TWO_MODEL_CHIPS[:2]]
+    uids = [f"broker-fault-{i}" for i in range(3)]
+    for i, uid in enumerate(uids):
+        apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                            [{"device": names[i % 2]}])
+    claims = [drapb.Claim(namespace="ns", name=uid, uid=uid)
+              for uid in uids]
+
+    faults.arm("broker.ipc", kind="drop", count=None)
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=claims), None)
+    for uid in uids:
+        assert "broker unavailable" in resp.claims[uid].error, \
+            resp.claims[uid].error
+    assert driver.prepared_claim_count() == 0
+    faults.disarm("broker.ipc")
+
+    # the retry after "respawn" (fault cleared) prepares exactly once
+    resp = driver.NodePrepareResources(
+        drapb.NodePrepareResourcesRequest(claims=claims), None)
+    for uid in uids:
+        assert resp.claims[uid].error == "", resp.claims[uid].error
+    assert driver.prepared_claim_count() == 3
+
+
+@pytest.fixture
+def broker_rig(short_root):
+    """dra_rig running against a REAL spawned broker process: every
+    privileged read of the prepare path crosses the versioned IPC."""
+    from tests.test_dra import FakeApiServer
+    from tpu_device_plugin import broker as broker_mod
+    from tpu_device_plugin.discovery import discover
+    from tpu_device_plugin.dra import DraDriver
+    from tpu_device_plugin.kubeapi import ApiClient
+
+    host, cfg = _make_node(short_root, TWO_MODEL_CHIPS[:2])
+    proc = broker_mod.spawn_broker(cfg.broker_socket_path, root=short_root)
+    client = broker_mod.SocketBrokerClient(cfg.broker_socket_path)
+    prev = broker_mod.set_client(client)
+    apiserver = FakeApiServer()
+    api = ApiClient(apiserver.url, token_path="/nonexistent-token")
+    registry, generations = discover(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="broker-node",
+                       api=api)
+    yield host, cfg, apiserver, driver, proc, client
+    driver.stop()
+    apiserver.stop()
+    broker_mod.set_client(prev)
+    client.close()
+    if proc.poll() is None:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_broker_kill9_mid_claim_storm_respawn_claims_survive(broker_rig):
+    """The acceptance scenario against a real broker process:
+
+    1. a claim storm prepares through the spawned broker;
+    2. kill -9 of the broker mid-storm → the remaining claims degrade to
+       typed 'broker unavailable' errors, nothing half-prepares;
+    3. respawn + handshake recovers: the kubelet retry prepares the rest
+       exactly once (every claim exactly one checkpoint entry);
+    4. a serving-daemon restart (rebuild from the schema-versioned
+       checkpoint) loses zero claims while the broker keeps running —
+       same pid, audit intact."""
+    from tpu_device_plugin import broker as broker_mod
+    from tpu_device_plugin.dra import DraDriver, slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+
+    host, cfg, apiserver, driver, proc, client = broker_rig
+    names = [slice_device_name(c.bdf) for c in TWO_MODEL_CHIPS[:2]]
+    uids = [f"storm-{i}" for i in range(6)]
+    for i, uid in enumerate(uids):
+        apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                            [{"device": names[i % 2]}])
+
+    def prepare(batch):
+        return driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[
+                drapb.Claim(namespace="ns", name=u, uid=u)
+                for u in batch]), None)
+
+    # phase 1: half the storm lands through the live broker
+    resp = prepare(uids[:3])
+    for uid in uids[:3]:
+        assert resp.claims[uid].error == "", resp.claims[uid].error
+    broker_pid = client.stats()["broker"]["pid"]
+    assert broker_pid == proc.pid
+
+    # phase 2: kill -9 mid-storm → typed unavailable, no half-prepares
+    proc.kill()
+    proc.wait(timeout=5)
+    resp = prepare(uids[3:])
+    for uid in uids[3:]:
+        assert "broker unavailable" in resp.claims[uid].error
+    assert driver.prepared_claim_count() == 3
+
+    # phase 3: respawn + handshake → the retry prepares exactly once
+    proc2 = broker_mod.spawn_broker(cfg.broker_socket_path,
+                                    root=short_root_of(host))
+    try:
+        client.reconnect()
+        resp = prepare(uids[3:])
+        for uid in uids[3:]:
+            assert resp.claims[uid].error == "", resp.claims[uid].error
+        assert driver.prepared_claim_count() == 6
+        import json as json_mod
+        with open(driver.checkpoint_path) as f:
+            ckpt = json_mod.load(f)["claims"]
+        assert set(ckpt) == set(uids)   # exactly one entry per claim
+
+        # phase 4: serving-daemon restart — rebuild from the checkpoint
+        # while the broker keeps running (same pid, ops preserved)
+        ops_before = client.stats()["broker"]["ops"].get("revalidate", 0)
+        driver.stop()
+        driver2 = DraDriver(cfg, *discover_inventory(cfg),
+                            node_name="broker-node", api=driver.api)
+        try:
+            assert driver2.prepared_claim_count() == 6, \
+                "serving-daemon restart lost claims"
+            stats = client.stats()["broker"]
+            assert stats["pid"] == proc2.pid
+            assert stats["ops"].get("revalidate", 0) >= ops_before
+        finally:
+            driver2.stop()
+    finally:
+        if proc2.poll() is None:
+            proc2.terminate()
+            proc2.wait(timeout=5)
+
+
+def short_root_of(host):
+    return host.root
+
+
+def discover_inventory(cfg):
+    from tpu_device_plugin.discovery import discover
+    return discover(cfg)

@@ -314,3 +314,106 @@ def test_prepare_workers_flag_env_parity_and_validation(host, monkeypatch):
     with pytest.raises(SystemExit) as e:
         cli.build_config(["--root", root])
     assert e.value.code == 2
+
+
+def test_broker_flag_env_parity_and_validation(host, monkeypatch):
+    _, root = host
+    # default: in-process seam
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.broker_mode == "inproc"
+    assert cfg.broker_socket_path.startswith(root)
+    # flag
+    cfg, _ = cli.build_config(["--root", root, "--broker", "spawn"])
+    assert cfg.broker_mode == "spawn"
+    # env supplies the mode when the flag is absent
+    monkeypatch.setenv("TDP_BROKER", "spawn")
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.broker_mode == "spawn"
+    # the flag wins over the env
+    cfg, _ = cli.build_config(["--root", root, "--broker", "inproc"])
+    assert cfg.broker_mode == "inproc"
+    # a typo'd env mode fails loudly, never silently keeps privileges
+    monkeypatch.setenv("TDP_BROKER", "spwan")
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--root", root])
+    assert e.value.code == 2
+    monkeypatch.delenv("TDP_BROKER")
+    # explicit socket wins over --root re-rooting (same rule as DRA paths)
+    cfg, _ = cli.build_config(["--root", root,
+                               "--broker-socket", "/explicit/broker.sock"])
+    assert cfg.broker_socket_path == "/explicit/broker.sock"
+
+
+def test_policy_flags_validation(host, tmp_path):
+    _, root = host
+    cfg, _ = cli.build_config(["--root", root])
+    assert cfg.policy_dir is None
+    cfg, _ = cli.build_config(
+        ["--root", root, "--policy-dir", str(tmp_path),
+         "--policy-hook-deadline-ms", "50"])
+    assert cfg.policy_dir == str(tmp_path)
+    assert cfg.policy_hook_deadline_ms == 50.0
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--root", root, "--policy-hook-deadline-ms", "0"])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        cli.build_config(["--root", root, "--policy-hook-deadline-ms",
+                          "nan"])
+    assert e.value.code == 2
+
+
+def test_main_spawn_broker_and_policy_dir(host):
+    """Full daemon pass in spawn mode with a policy dir: cli spawns the
+    privileged broker, installs the SocketBrokerClient seam, loads the
+    policy engine, and reaps the broker on clean SIGTERM shutdown."""
+    from tpu_device_plugin import broker as broker_mod
+
+    _, root = host
+    port = free_port()
+    policy_dir = os.path.join(root, "policies")
+    os.makedirs(policy_dir)
+    with open(os.path.join(policy_dir, "quota.py"), "w") as f:
+        f.write("def admit(ctx):\n    return None\n")
+
+    def controller():
+        _wait(lambda: _get_status(port).get("broker", {}).get("mode")
+              == "spawn", "spawn-mode seam installed")
+        s = _get_status(port)
+        assert s["policy"]["modules"] == ["quota"]
+        # the broker process answers over the IPC
+        dbg = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/broker"))
+        assert dbg["mode"] == "spawn"
+        assert dbg["broker"]["pid"] > 0
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    prev = broker_mod.get_client()
+    try:
+        rc = _run_main(
+            ["--root", root, "--broker", "spawn",
+             "--policy-dir", policy_dir,
+             "--status-port", str(port), "--status-host", "127.0.0.1",
+             "--rediscovery-seconds", "0"],
+            controller)
+    finally:
+        # restore the default seam for the rest of the session
+        client = broker_mod.set_client(
+            prev if isinstance(prev, broker_mod.InProcessBroker) else None)
+        if client is not None and client is not prev:
+            client.close()
+    assert rc == 0
+    # the spawned broker was reaped: its socket is gone and no child
+    # process is left behind serving it
+    assert not os.path.exists(os.path.join(root, "run/broker.sock")) \
+        or not _can_connect(os.path.join(root, "run/broker.sock"))
+
+
+def _can_connect(path):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()

@@ -555,3 +555,70 @@ def test_hot_unplug_of_allocated_chip_through_managed_fleet_node(
         if node is not None:
             node.stop()
         api.stop()
+
+
+def test_broker_backed_managed_node_boot_and_claim_storm(short_root):
+    """ISSUE 11: a ManagedFleetNode with the REAL privilege-separated
+    wiring — a spawned broker process owns every privileged read while
+    the full PluginManager + DRA stack drives a boot + claim storm
+    through the versioned IPC, exactly-once audited in the fabric; a
+    broker kill -9 degrades attaches to typed unavailable errors and a
+    respawn + handshake recovers without restarting the serving side."""
+    from tpu_device_plugin.fleetsim import ManagedFleetNode
+
+    api = FleetApiServer(latency_s=0.0, max_inflight=0)
+    node = None
+    try:
+        node = ManagedFleetNode(short_root, api, n_devices=4,
+                                spawn_broker=True)
+        assert node.broker_proc.poll() is None
+        # boot storm landed through the broker: plugins registered,
+        # slice published, crossings counted
+        assert list(node.kubelet.endpoints)
+        assert len(node.published_devices()) == 4
+        from tpu_device_plugin import broker as broker_mod
+        client = broker_mod.get_client()
+        assert client.mode == "spawn"
+        # the health plane is brokered: probe closures cross the IPC
+        assert isinstance(node.manager._shim, broker_mod.BrokeredHealth)
+        node.manager._shim.chip_alive(
+            node.cfg.pci_base_path, node.bdfs[0])
+        boot_crossings = client.crossings.value
+        assert boot_crossings > 0
+
+        # claim storm: every prepare's TOCTOU revalidation crosses
+        names = {}
+        for v in node.driver.host_views().values():
+            names.update(v.names)
+        uids = [f"vm-{i}" for i in range(4)]
+        for i, uid in enumerate(uids):
+            node.apiserver.add_claim(
+                "fleet", uid, uid, node.driver.driver_name,
+                [{"device": names[node.bdfs[i]]}])
+        resp = node.attach(uids)
+        for uid in uids:
+            assert resp.claims[uid].error == "", resp.claims[uid].error
+        assert client.crossings.value > boot_crossings
+        assert client.stats()["broker"]["ops"].get("revalidate", 0) >= 4
+
+        # broker kill -9 mid-fleet: typed unavailable, claims intact
+        node.kill_broker()
+        node.apiserver.add_claim(
+            "fleet", "vm-degraded", "vm-degraded",
+            node.driver.driver_name, [{"device": names[node.bdfs[0]]}])
+        resp = node.attach(["vm-degraded"])
+        assert "broker unavailable" in resp.claims["vm-degraded"].error
+        assert node.driver.prepared_claim_count() == 4
+
+        # respawn + handshake: the retry lands, fabric audit still clean
+        node.respawn_broker()
+        resp = node.attach(["vm-degraded"])
+        assert resp.claims["vm-degraded"].error == "", \
+            resp.claims["vm-degraded"].error
+        assert node.driver.prepared_claim_count() == 5
+        audit = api.exactly_once_audit()
+        assert audit["exactly_once"], audit
+    finally:
+        if node is not None:
+            node.stop()
+        api.stop()

@@ -20,7 +20,7 @@ from tools.tsalint.config import (BLOCKING_CALLS, BLOCKING_METHODS,  # noqa: E40
 
 
 def run(source, *, hot=(), counters=None, registered=None, documented=None,
-        path="mod.py"):
+        path="mod.py", privileged=None):
     cfg = LintConfig(
         hot_locks=frozenset(hot),
         counters=counters or {},
@@ -28,6 +28,7 @@ def run(source, *, hot=(), counters=None, registered=None, documented=None,
         blocking_methods=BLOCKING_METHODS,
         registered_sites=registered,
         documented_sites=documented,
+        privileged_modules=privileged,
     )
     return analyze_sources([(path, source)], cfg)
 
@@ -714,3 +715,99 @@ def test_epoch_mutation_inside_span_still_fires():
     # the span context must not LAUNDER a real epoch mutation
     findings = run(TRACE_EPOCH_MUTATION_STILL_FIRES)
     assert rules(findings) == ["epoch-mutation"]
+
+
+# --------------------------------------------------------- broker-boundary
+
+
+PRIV_DEV_OPEN = """
+import os
+
+def grab_group(group):
+    return os.open("/dev/vfio/" + group, os.O_RDWR)
+"""
+
+PRIV_DEV_OPEN_VIA_DEV_PATH = """
+def grab(cfg, group):
+    return open(cfg.dev_path("dev/vfio", group))
+"""
+
+PRIV_REBIND_WRITE = """
+def rebind(bdf):
+    with open("/sys/bus/pci/drivers/vfio-pci/unbind", "w") as f:
+        f.write(bdf)
+"""
+
+PRIV_CONFIG_READ = """
+def probe(config_path):
+    with open(config_path, "rb") as f:
+        return f.read(2)
+"""
+
+PRIV_CONFIG_LITERAL = """
+def probe(base):
+    with open(base + "/config", "rb") as f:
+        return f.read(2)
+"""
+
+INNOCUOUS_OPENS = """
+import os
+
+def fine(checkpoint_path, reconfigure_path):
+    with open(checkpoint_path, "w") as f:
+        f.write("{}")
+    with open(reconfigure_path) as f:
+        data = f.read()
+    # read-mode open of a bind-named path is not a rebind write
+    with open("/sys/bus/pci/drivers/vfio-pci/bind") as f:
+        return f.read(), data
+"""
+
+WHITELIST = frozenset({"broker.py", "discovery.py"})
+
+
+def test_broker_boundary_device_node_open_fires():
+    for fixture in (PRIV_DEV_OPEN, PRIV_DEV_OPEN_VIA_DEV_PATH):
+        findings = run(fixture, privileged=WHITELIST)
+        assert rules(findings) == ["broker-boundary"], fixture
+        assert "device-node-open" in findings[0].detail
+
+
+def test_broker_boundary_rebind_write_fires():
+    findings = run(PRIV_REBIND_WRITE, privileged=WHITELIST)
+    assert rules(findings) == ["broker-boundary"]
+    assert findings[0].detail == "sysfs-rebind-write:unbind"
+
+
+def test_broker_boundary_config_space_read_fires():
+    for fixture in (PRIV_CONFIG_READ, PRIV_CONFIG_LITERAL):
+        findings = run(fixture, privileged=WHITELIST)
+        assert rules(findings) == ["broker-boundary"], fixture
+        assert findings[0].detail == "config-space-read:config"
+
+
+def test_broker_boundary_whitelisted_seam_is_clean():
+    """The SAME privileged calls inside a whitelisted seam file pass —
+    the clean variant of every fire fixture."""
+    for fixture in (PRIV_DEV_OPEN, PRIV_REBIND_WRITE, PRIV_CONFIG_READ):
+        assert run(fixture, path="pkg/broker.py",
+                   privileged=WHITELIST) == []
+    assert run(PRIV_CONFIG_READ, path="pkg/discovery.py",
+               privileged=WHITELIST) == []
+
+
+def test_broker_boundary_innocuous_opens_are_clean():
+    assert run(INNOCUOUS_OPENS, privileged=WHITELIST) == []
+
+
+def test_broker_boundary_disabled_without_whitelist():
+    assert run(PRIV_DEV_OPEN, privileged=None) == []
+
+
+def test_broker_boundary_project_whitelist_names_the_seams():
+    from tools.tsalint.config import PRIVILEGED_SEAMS
+    assert PRIVILEGED_SEAMS == {
+        "tpu_device_plugin/broker.py",
+        "tpu_device_plugin/discovery.py",
+        "tpu_device_plugin/native/__init__.py",
+    }

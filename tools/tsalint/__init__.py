@@ -27,6 +27,13 @@ package does, statically:
                           daemonized AND be joinable on a stop() path
                           (tracked on an attribute that a stop-like method
                           joins with a timeout, or cancels for a Timer)
+  broker-boundary         privileged calls — device-node opens
+                          (/dev/vfio, /dev/iommu), sysfs bind/unbind/
+                          driver_override writes, config-space reads —
+                          only in the whitelisted privilege seams
+                          (broker.py, discovery.py, the native shim);
+                          everything else must route through
+                          broker.get_client()
 
 Findings are pinned in a checked-in baseline (baseline.json) so
 pre-existing debt is frozen and only NEW violations fail CI. The runtime

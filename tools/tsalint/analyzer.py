@@ -21,6 +21,7 @@ see; the two report the same lock names.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -33,6 +34,11 @@ from .config import LintConfig
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 THREAD_FACTORIES = {"Thread", "Timer"}
+# broker-boundary rule (rule 7): call names that OPEN files — the only
+# primitives a privileged access can enter the process through
+PRIV_OPEN_FUNCS = {"open", "io.open", "os.open"}
+# sysfs leaves whose write is a driver-rebind (privileged) operation
+PRIV_WRITE_LEAVES = {"bind", "unbind", "driver_override"}
 # container-mutating method names for the epoch-mutation rule: calling
 # one of these on an epoch-rooted receiver mutates published state
 EPOCH_MUTATORS = {"update", "clear", "pop", "popitem", "setdefault",
@@ -70,6 +76,15 @@ def _render(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Call):
         return _render(node.func)
     return None
+
+
+_WORD_RE = re.compile(r"[a-z0-9]+")
+
+
+def re_split_nonword(text: str) -> List[str]:
+    """Lower-cased word tokens of a path/name blob (broker-boundary
+    evidence matching: `reconfigure_path` must not read as `config`)."""
+    return _WORD_RE.findall(text.lower())
 
 
 def _epoch_like(name: str) -> bool:
@@ -169,6 +184,10 @@ class _FuncFacts:
     # (rendered write target, line) for attribute/dict writes (or
     # mutating method calls) on epoch-rooted expressions
     epoch_writes: List[Tuple[str, int]] = field(default_factory=list)
+    # (kind, evidence token, line) for privileged calls — device-node
+    # opens, sysfs bind/unbind/driver_override writes, config-space
+    # reads (broker-boundary rule)
+    priv_calls: List[Tuple[str, str, int]] = field(default_factory=list)
 
 
 class _FunctionWalker(ast.NodeVisitor):
@@ -425,6 +444,15 @@ class _FunctionWalker(ast.NodeVisitor):
             self.facts.epoch_writes.append(
                 (f"{_render(node.func) or '<epoch>'}()", node.lineno))
 
+        # privileged call detection (broker-boundary rule): open-family
+        # calls whose path expression evidences a device node, a driver
+        # rebind write, or a config-space read
+        if rendered in PRIV_OPEN_FUNCS:
+            priv = self._priv_open_detail(node)
+            if priv is not None:
+                self.facts.priv_calls.append(
+                    (priv[0], priv[1], node.lineno))
+
         # blocking calls
         if self.a.is_blocking_name(rendered):
             self.facts.blocking.append(
@@ -436,6 +464,52 @@ class _FunctionWalker(ast.NodeVisitor):
             self.facts.calls.append((tuple(self.held), callee, node.lineno))
 
         self.generic_visit(node)
+
+    def _priv_open_detail(self, node: ast.Call):
+        """(kind, evidence) when this open-family call touches privileged
+        state, else None. Evidence is gathered from the PATH expression —
+        every string constant in it plus the rendered name chain — so
+        both literal paths ("/dev/vfio/11") and conventionally-named
+        variables (config_path) are caught; rendered names keep the
+        codebase's naming convention load-bearing, which is exactly what
+        a lint rule should pin."""
+        if not node.args:
+            return None
+        path_arg = node.args[0]
+        texts: List[str] = [c.value for c in ast.walk(path_arg)
+                            if isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)]
+        rendered_path = _render(path_arg)
+        if rendered_path:
+            texts.append(rendered_path)
+        blob = " ".join(texts)
+        if "dev/vfio" in blob or "dev/iommu" in blob:
+            return ("device-node-open", "dev/vfio|dev/iommu")
+        # tokenized word match so `reconfigure` never reads as `config`
+        tokens = {t for text in texts
+                  for t in re_split_nonword(text) if t}
+        if tokens & PRIV_WRITE_LEAVES and self._open_writes(node):
+            leaf = sorted(tokens & PRIV_WRITE_LEAVES)[0]
+            return ("sysfs-rebind-write", leaf)
+        if "config" in tokens:
+            return ("config-space-read", "config")
+        return None
+
+    @staticmethod
+    def _open_writes(node: ast.Call) -> bool:
+        """True when the open call's mode/flags evidence a write."""
+        mode = None
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        if mode is not None:
+            return any(ch in mode for ch in "wa+x")
+        flags = " ".join(filter(None, (_render(a) for a in node.args[1:])))
+        return "O_WRONLY" in flags or "O_RDWR" in flags or "O_APPEND" in flags
 
     def _note_thread(self, node: ast.Call, factory: str) -> None:
         site = _ThreadSite(factory=factory, qualname=self.facts.qualname,
@@ -754,9 +828,11 @@ class Analyzer:
         findings += self._rule_fault_sites()
         findings += self._rule_threads()
         findings += self._rule_epoch_mutation()
+        findings += self._rule_broker_boundary()
         order = {r: i for i, r in enumerate((
             "lock-order-cycle", "blocking-under-hot-lock", "counter-lock",
-            "fault-site", "thread-lifecycle", "epoch-mutation"))}
+            "fault-site", "thread-lifecycle", "epoch-mutation",
+            "broker-boundary"))}
         findings.sort(key=lambda f: (order.get(f.rule, 99), f.path, f.line))
         return findings
 
@@ -973,6 +1049,33 @@ class Analyzer:
                             f"outside epoch.py's builders (epochs are "
                             f"immutable: build a successor and publish it)",
                     detail=target))
+        return findings
+
+    def _rule_broker_boundary(self) -> List[Finding]:
+        """Rule 7: privileged calls — device-node opens (/dev/vfio,
+        /dev/iommu), sysfs bind/unbind/driver_override writes, and
+        config-space reads — may only appear in the whitelisted seam
+        files (config.privileged_modules, matched by path suffix:
+        broker.py, discovery.py, the native shim). Everything else must
+        route through broker.get_client(), so the privilege boundary
+        holds statically, not just by convention. None disables the rule
+        (fixture runs without the project whitelist)."""
+        allowed = self.config.privileged_modules
+        if allowed is None:
+            return []
+        findings = []
+        for qual, facts in self.facts.items():
+            if any(facts.path.endswith(suffix) for suffix in allowed):
+                continue
+            for kind, token, line in facts.priv_calls:
+                findings.append(Finding(
+                    rule="broker-boundary", path=facts.path,
+                    qualname=qual, line=line,
+                    message=f"privileged {kind} (evidence: {token}) "
+                            f"outside the broker seam — route it through "
+                            f"broker.get_client() (docs/design.md "
+                            f"'Privilege separation')",
+                    detail=f"{kind}:{token}"))
         return findings
 
 

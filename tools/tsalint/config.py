@@ -42,6 +42,12 @@ class LintConfig:
     # epoch-rooted expression OUTSIDE these modules. Matched by module
     # name (file stem), so fixture runs can exempt their own "epoch.py".
     epoch_modules: FrozenSet[str] = frozenset({"epoch"})
+    # broker-boundary rule (rule 7) whitelist: path SUFFIXES of the files
+    # allowed to contain privileged calls (device-node opens, sysfs
+    # bind/unbind/driver_override writes, config-space reads). None
+    # disables the rule (fixture runs); the project config whitelists the
+    # broker, discovery, and the native shim (PRIVILEGED_SEAMS below).
+    privileged_modules: Optional[FrozenSet[str]] = None
 
 
 # Blocking-call vocabulary: calls that can sleep, touch disk, or cross the
@@ -83,6 +89,22 @@ HOT_LOCKS = frozenset({
     "epoch.EpochStore._cond",
     "dra.DraDriver._lock",
     "dra.DraDriver._ckpt_cond",
+})
+
+# The broker-boundary whitelist (rule 7, ISSUE 11): the ONLY files that
+# may contain privileged calls. Path-suffix matched, because the two
+# __init__.py files would collide as module stems:
+# - broker.py — the privilege seam itself (both sides of it);
+# - discovery.py — the read-only sysfs walk that BUILDS the inventory
+#   (it predates the broker and runs before any serving surface is up;
+#   the spawned broker process reuses it unchanged);
+# - native/__init__.py — the probe implementation (config-space reads)
+#   that the broker executes on the privileged side; daemon-side callers
+#   reach it only through the broker.health_shim seam.
+PRIVILEGED_SEAMS = frozenset({
+    "tpu_device_plugin/broker.py",
+    "tpu_device_plugin/discovery.py",
+    "tpu_device_plugin/native/__init__.py",
 })
 
 # /status + /metrics counter ownership. Key classes by "module.Class";
@@ -209,4 +231,5 @@ def project_config(faults_source: str, doc_text: str) -> LintConfig:
         blocking_methods=BLOCKING_METHODS,
         registered_sites=registered_fault_sites(faults_source),
         documented_sites=documented_fault_sites(doc_text),
+        privileged_modules=PRIVILEGED_SEAMS,
     )

@@ -18,6 +18,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import broker
 from . import lockdep
 from . import trace
 from .config import Config
@@ -190,27 +191,53 @@ def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
     validation; raises AllocationError when the mdev is gone. Shared by the
     classic vTPU server and the DRA prepare path so the two APIs can never
     validate the same partition differently (reference analogue:
-    generic_vgpu_device_plugin.go:216-221)."""
+    generic_vgpu_device_plugin.go:216-221).
+
+    The read rides the broker seam in spawn mode (broker.py: the
+    privileged process does the sysfs read), so a read-only serving
+    daemon prepares mdev partitions without touching the host tree; the
+    in-process mode keeps the caller's kept-fd reader — same bytes,
+    same lock-free fast path, same read counts."""
     name_path = os.path.join(cfg.mdev_base_path, uuid, "mdev_type", "name")
     _plan_note(name_path)
-    raw = reader.read(uuid, name_path)
+    client = broker.get_client()
+    spawn = client.mode == "spawn"
+    if spawn:
+        raw: Optional[bytes] = client.read_attr(uuid, name_path)
+    else:
+        raw = reader.read(uuid, name_path)
     if raw is None:
-        # failure path only: one diagnostic open to recover the errno the
-        # operator needs (EACCES mount misconfig vs ENOENT gone)
-        try:
-            with open(name_path, "rb"):
-                detail = "empty or unreadable"
-        except OSError as exc:
-            detail = str(exc)
+        if spawn:
+            # the broker did the (failed) read host-side; a local
+            # diagnostic open would report THIS daemon's lack of host
+            # access, not the real errno — exists() through the same
+            # seam distinguishes the two triage cases instead
+            detail = ("present but empty or unreadable host-side"
+                      if client.node_exists(name_path)
+                      else "gone host-side")
+        else:
+            # failure path only: one diagnostic open to recover the errno
+            # the operator needs (EACCES mount misconfig vs ENOENT gone)
+            try:
+                with open(name_path, "rb"):
+                    detail = "empty or unreadable"
+            except OSError as exc:
+                detail = str(exc)
         raise AllocationError(f"partition {uuid}: mdev vanished ({detail})")
     return raw.decode("ascii", "replace").strip().replace(" ", "_")
 
 
 def supports_iommufd(cfg: Config) -> bool:
-    """iommufd-capable host: /dev/iommu exists (reference :692-701)."""
+    """iommufd-capable host: /dev/iommu exists (reference :692-701).
+
+    Probed through the broker seam (broker.py): a /dev access is a
+    privileged fact, and routing it here means a read-only serving
+    daemon (CI, tests, spawn mode) never stats the real /dev tree
+    itself. One counted crossing; the planner's TTL cache keeps it off
+    the steady-state attach path."""
     path = cfg.dev_path("dev/iommu")
     _plan_note(path)
-    return os.path.exists(path)
+    return broker.get_client().node_exists(path)
 
 
 def vfio_device_node(cfg: Config, bdf: str) -> Optional[str]:
@@ -341,9 +368,16 @@ class AllocationPlanner:
         resource_suffix: str,
         allowed_bdfs: Optional[frozenset] = None,
         cdi_enabled: Optional[bool] = None,
+        broker_client=None,
     ) -> None:
         self.cfg = cfg
         self.registry = registry
+        # the privilege seam (broker.py): the per-plan TOCTOU
+        # revalidation batch crosses it exactly once — in-process the
+        # crossing runs this planner's own live readers (zero registered
+        # locks, the epoch gate's contract); in spawn mode the broker
+        # process does the reads
+        self._broker = broker_client or broker.get_client()
         self.resource_suffix = resource_suffix
         self.allowed_bdfs = allowed_bdfs
         self.cdi_enabled = (bool(cfg.cdi_spec_dir) if cdi_enabled is None
@@ -574,9 +608,10 @@ class AllocationPlanner:
             fragments.append(frag)
             revalidate.extend((m, group) for m in frag.member_bdfs)
         # one batched pass for the whole request (multi-group requests no
-        # longer interleave revalidation with response assembly)
-        for member, group in revalidate:
-            self._revalidate_live(member, group)
+        # longer interleave revalidation with response assembly), crossing
+        # the privilege seam ONCE per plan — the per-attach crossing
+        # budget the bench pins (docs/bench_broker_r13.json)
+        self._broker.revalidate_batch(self, revalidate)
 
         specs: List[pb.DeviceSpec] = [self._vfio_spec]
         expanded: List[str] = []

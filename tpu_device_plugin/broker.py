@@ -1,0 +1,1047 @@
+"""broker — the privilege-separated VFIO/sysfs/iommufd broker.
+
+ROADMAP item 1, Glider-style (PAPERS.md): the daemon used to hold
+root-equivalent powers (device-node opens, sysfs driver bind/unbind
+writes, config-space probes) in the same process that serves the
+kubelet-facing gRPC surface. This module splits them: a tiny privileged
+BROKER process owns every such operation behind the narrow, versioned,
+audited IPC of brokeripc.py, and the unprivileged SERVING daemon reaches
+it through a BrokerClient. The serving daemon can then crash and upgrade
+freely (the PR 7 schema-versioned checkpoint + re-serve machinery makes
+it restartable) while the broker keeps its device fds; a dead broker
+degrades the daemon to TYPED unavailable errors instead of undefined
+behavior, and a respawn + handshake recovers.
+
+Three client shapes, one seam:
+
+- ``InProcessBroker`` — the in-process fallback (tests, read-only
+  daemons, the default production mode until operators opt into spawn):
+  the same narrow operation surface executed by direct calls, still
+  audited (every call is a ``broker.ipc`` flight-recorder span and a
+  counted crossing) so the privilege boundary is observable and
+  benchable in BOTH modes. Hot-path operations stay lock-free — the
+  zero-lock read-path gates (tests/test_epoch.py) run against this
+  client.
+- ``SocketBrokerClient`` — the real two-process path: one unix-socket
+  connection, requests serialized under a plain (unregistered) channel
+  lock, fds received via SCM_RIGHTS. Connection loss surfaces as
+  ``BrokerUnavailable`` — the typed signal dra.py/server.py turn into
+  per-claim / per-RPC unavailable errors.
+- ``BrokerServer`` — the privileged side: path-policy-validated
+  dispatch, an audit ring linking every crossing to the caller's span,
+  and a held-fd registry (device nodes stay open in the broker across
+  serving-daemon restarts). Runs standalone via
+  ``python -m tpu_device_plugin.broker --socket PATH --root ROOT``.
+
+The process-global seam (``get_client``/``set_client``) is what
+allocate.py, vtpu.py, dra.py and lifecycle.py route privileged accesses
+through — tsalint's broker-boundary rule (tools/tsalint, rule 7) fails
+any privileged call outside this module's whitelisted seams, so the
+boundary is enforced statically, not just by convention.
+
+Fault site ``broker.ipc`` (value kind) fires on the client's crossing
+path: an armed drop turns the next crossing into BrokerUnavailable —
+test_chaos.py scripts broker crashes mid-Allocate with it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import brokeripc
+from . import faults
+from . import trace
+from .epoch import AtomicCounter
+from .native import TpuHealth
+
+log = logging.getLogger(__name__)
+
+# sysfs attribute leaves the broker will write — the driver rebind
+# surface, nothing else (not `remove`, not `rescan`: a compromised
+# serving daemon must not be able to eject devices through the broker)
+SYSFS_WRITE_LEAVES = frozenset({"bind", "unbind", "driver_override"})
+# device-node path segments the broker will open
+DEV_NODE_SEGMENTS = ("dev/vfio", "dev/iommu", "dev/accel")
+AUDIT_RING = 256
+
+
+class BrokerError(Exception):
+    """The broker answered and refused the request (policy violation,
+    bad path, failed syscall) — retrying without a fix is futile."""
+
+
+class BrokerUnavailable(BrokerError):
+    """The broker did not answer (process dead, connection lost, injected
+    drop): the serving daemon degrades to typed unavailable errors until
+    a respawn + handshake recovers. The message always carries the
+    'broker unavailable' prefix tests and operators match on."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__(f"broker unavailable: {detail}")
+
+
+def _is_dev_node(path: str) -> bool:
+    norm = os.path.normpath(path)
+    return any(f"/{seg}" in norm or norm.startswith(seg)
+               for seg in DEV_NODE_SEGMENTS)
+
+
+# --------------------------------------------------------------- clients
+
+class _BaseClient:
+    """Shared crossing accounting: every operation is one counted
+    crossing, one ``broker.ipc`` span (histogram tdp_broker_crossing_ms),
+    and one ``broker.ipc`` fault-point consultation. Subclasses implement
+    the operations themselves."""
+
+    mode = "none"
+
+    def __init__(self) -> None:
+        self.crossings = AtomicCounter()
+        self.errors = AtomicCounter()
+
+    def _cross(self, op: str, **attrs: object):
+        """Open the audited crossing span (call under ``with``). Counts
+        the crossing FIRST so even an injected drop is a visible
+        crossing, then consults the fault point: an armed drop turns
+        this crossing into BrokerUnavailable — the same typed error a
+        real broker death produces."""
+        self.crossings.add()
+        if faults.fire("broker.ipc", broker_op=op):
+            self.errors.add()
+            raise BrokerUnavailable(f"injected fault at op {op!r}")
+        return trace.span("broker.ipc", histogram="tdp_broker_crossing_ms",
+                          broker_op=op, broker_mode=self.mode, **attrs)
+
+    # ------------------------------------------------------------- stats
+
+    def client_stats(self) -> Dict[str, object]:
+        return {"mode": self.mode,
+                "crossings_total": self.crossings.value,
+                "errors_total": self.errors.value}
+
+    def stats(self) -> Dict[str, object]:
+        return self.client_stats()
+
+    def close(self) -> None:
+        return None
+
+
+class InProcessBroker(_BaseClient):
+    """The in-process fallback: the broker's operation surface executed
+    by direct calls in THIS process. Used by tests, read-only daemons
+    (CI never needs real /dev access — every /dev probe funnels through
+    here and answers honestly about the fixture tree), and production
+    daemons that have not opted into spawn mode. Per-operation cost is
+    one AtomicCounter add + one trace span — the zero-lock gates pin the
+    brokered Allocate path at 0 registered-lock acquisitions against
+    this client."""
+
+    mode = "inproc"
+
+    def __init__(self, native_lib_path: Optional[str] = None) -> None:
+        super().__init__()
+        # lazy import breaks the module cycle (allocate imports broker
+        # for the seam; both are loaded by the time a client is built)
+        from .allocate import LiveAttrReader
+        self._native_lib_path = native_lib_path
+        self._health_obj: Optional[TpuHealth] = None
+        self._reader = LiveAttrReader()
+
+    @property
+    def _health(self) -> TpuHealth:
+        # built on first PROBE use, not at seam construction: the lazy
+        # default client must not dlopen a (possibly wrong) native lib
+        # that nothing in-process routes probes through — cli installs a
+        # client carrying cfg.native_lib_path when it matters
+        health = self._health_obj
+        if health is None:
+            health = self._health_obj = TpuHealth(self._native_lib_path)
+        return health
+
+    # --------------------------------------------------------- node ops
+
+    def node_exists(self, path: str) -> bool:
+        with self._cross("node_exists", path=path):
+            return os.path.exists(path)
+
+    def open_node(self, path: str) -> int:
+        """Open a device node; caller owns the returned fd. Only vfio/
+        iommu/accel nodes qualify — the same policy the spawned broker
+        enforces, so a path that works in tests works in production."""
+        with self._cross("open_node", path=path):
+            if not _is_dev_node(path):
+                raise BrokerError(
+                    f"open_node refused: {path!r} is not a device node "
+                    f"under {'/'.join(DEV_NODE_SEGMENTS)}")
+            try:
+                return os.open(path, os.O_RDWR)
+            except OSError as exc:
+                raise BrokerError(f"open_node {path!r}: {exc}") from exc
+
+    # -------------------------------------------------------- sysfs ops
+
+    def read_attr(self, key: str, path: str) -> Optional[bytes]:
+        """Fresh non-empty bytes of a small sysfs attribute (kept-fd
+        cached by `key`, LiveAttrReader semantics); None if gone."""
+        with self._cross("read_attr", path=path):
+            return self._reader.read(key, path)
+
+    def read_link(self, path: str) -> Optional[str]:
+        with self._cross("read_link", path=path):
+            try:
+                return os.path.basename(os.readlink(path))
+            except OSError:
+                return None
+
+    def write_sysfs(self, path: str, data: str) -> None:
+        """Driver bind/unbind/driver_override write — the rebind surface
+        and nothing else (SYSFS_WRITE_LEAVES)."""
+        with self._cross("write_sysfs", path=path):
+            if os.path.basename(path) not in SYSFS_WRITE_LEAVES:
+                raise BrokerError(
+                    f"write_sysfs refused: {os.path.basename(path)!r} not "
+                    f"in {sorted(SYSFS_WRITE_LEAVES)}")
+            try:
+                with open(path, "w", encoding="ascii") as f:
+                    f.write(data)
+            except OSError as exc:
+                raise BrokerError(f"write_sysfs {path!r}: {exc}") from exc
+
+    # ------------------------------------------------------- health ops
+
+    def probe_config(self, config_path: str) -> int:
+        with self._cross("probe_config", path=config_path):
+            return self._health.probe_config(config_path)
+
+    def probe_node(self, dev_path: str) -> int:
+        with self._cross("probe_node", path=dev_path):
+            return self._health.probe_node(dev_path)
+
+    def chip_alive(self, pci_base_path: str, bdf: str,
+                   node_path: Optional[str] = None) -> bool:
+        with self._cross("chip_alive", bdf=bdf):
+            return self._health.chip_alive(pci_base_path, bdf, node_path)
+
+    def chip_diagnostics(self, pci_base_path: str, bdf: str):
+        with self._cross("chip_diagnostics", bdf=bdf):
+            return self._health.chip_diagnostics(pci_base_path, bdf)
+
+    # ---------------------------------------------------- batched plan op
+
+    def revalidate_batch(self, planner, pairs: Sequence[Tuple[str, str]],
+                         ) -> None:
+        """ONE crossing for a whole Allocate plan's TOCTOU revalidation.
+        In-process the reads are the planner's own live readers (kept-fd
+        vendor pread + group readlink — the exact pre-broker behavior the
+        r09 syscall pins count); the spawned broker runs the equivalent
+        reads privileged-side. Raises allocate.AllocationError on the
+        first stale member."""
+        if not pairs:
+            return
+        with self._cross("revalidate", members=len(pairs)):
+            for member, group in pairs:
+                planner._revalidate_live(member, group)
+
+
+class SocketBrokerClient(_BaseClient):
+    """The unprivileged side of the two-process path: one unix-socket
+    connection to the broker, one request/reply pair per operation,
+    serialized under a plain channel lock (spawn mode is explicitly not
+    the zero-lock path — the gates run against InProcessBroker). Any
+    connection loss raises BrokerUnavailable; ``reconnect()`` re-dials
+    and re-handshakes after a broker respawn."""
+
+    mode = "spawn"
+
+    def __init__(self, socket_path: str, connect_timeout_s: float = 5.0,
+                 op_timeout_s: float = 30.0) -> None:
+        super().__init__()
+        self.socket_path = socket_path
+        self._timeout = connect_timeout_s
+        # every crossing is bounded: a broker that is alive but WEDGED
+        # (stuck in an uninterruptible sysfs read on dying hardware)
+        # must degrade to typed-unavailable like a dead one — an
+        # unbounded recv here would pin the channel lock and stall the
+        # whole privileged plane behind one stuck operation
+        self._op_timeout = op_timeout_s
+        # plain lock by design: serializes request/reply pairing on the
+        # single channel; unregistered so it stays invisible to the
+        # zero-lock gates (which pin the in-process mode, not this one)
+        self._channel_lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self.reconnects = AtomicCounter()
+        self._dial()
+
+    # ------------------------------------------------------ connection
+
+    def _dial(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # the timeout covers the WHOLE handshake, not just connect(): the
+        # broker accepts one connection at a time, so a connect can land
+        # in the listen backlog (stale previous connection, wedged
+        # broker) and the hello reply never come — an unbounded recv
+        # here would hang startup despite --broker-handshake-timeout
+        sock.settimeout(self._timeout)
+        try:
+            sock.connect(self.socket_path)
+            brokeripc.send_frame(sock, brokeripc.hello_request())
+            reply, _fds = brokeripc.recv_frame(sock)
+            brokeripc.check_hello_reply(reply)
+            sock.settimeout(self._op_timeout)
+        except (OSError, brokeripc.BrokerConnectionLost) as exc:
+            sock.close()
+            raise BrokerUnavailable(f"dial {self.socket_path}: {exc}") \
+                from exc
+        except brokeripc.BrokerProtocolError:
+            sock.close()
+            raise
+        self._sock = sock
+
+    def reconnect(self) -> None:
+        """Re-dial + re-handshake (broker respawn recovery). Raises
+        BrokerUnavailable if the broker is still gone."""
+        with self._channel_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            self._dial()
+            self.reconnects.add()
+
+    def close(self) -> None:
+        with self._channel_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _request(self, op: str, want_fds: int = 0,
+                 **fields: object) -> Tuple[dict, List[int]]:
+        with self._channel_lock:
+            if self._sock is None:
+                raise BrokerUnavailable("not connected (close/crash); "
+                                        "reconnect() after respawn")
+            self._seq += 1
+            req = {"op": op, "seq": self._seq,
+                   "span": brokeripc.span_context()}
+            req.update(fields)
+            try:
+                brokeripc.send_frame(self._sock, req)
+                reply, fds = brokeripc.recv_frame(self._sock,
+                                                  want_fds=want_fds)
+            except brokeripc.BrokerConnectionLost as exc:
+                # the kill -9 path: drop the dead socket so every later
+                # call fails fast with the same typed error until
+                # reconnect()
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                self.errors.add()
+                raise BrokerUnavailable(str(exc)) from exc
+            if reply.get("seq") != self._seq:
+                # a desynced stream can never re-pair (brokeripc contract):
+                # drop the socket so every later call fails fast typed
+                # until reconnect(), instead of reading stale replies
+                brokeripc.close_fds(fds)
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                self.errors.add()
+                raise BrokerUnavailable(
+                    f"protocol desync: reply seq {reply.get('seq')!r} != "
+                    f"request {self._seq}; reconnect() required")
+        if not reply.get("ok"):
+            brokeripc.close_fds(fds)
+            self.errors.add()
+            raise BrokerError(
+                f"broker refused {op}: {reply.get('error', 'unknown')}")
+        return reply, fds
+
+    # ------------------------------------------------------- operations
+
+    def node_exists(self, path: str) -> bool:
+        with self._cross("node_exists", path=path):
+            reply, _ = self._request("node_exists", path=path)
+            return bool(reply["exists"])
+
+    def open_node(self, path: str) -> int:
+        with self._cross("open_node", path=path):
+            reply, fds = self._request("open_node", want_fds=1, path=path)
+            if not fds:
+                raise BrokerError(
+                    f"broker acked open_node {path!r} but passed no fd")
+            if len(fds) > 1:
+                brokeripc.close_fds(fds[1:])
+            return fds[0]
+
+    def read_attr(self, key: str, path: str) -> Optional[bytes]:
+        with self._cross("read_attr", path=path):
+            reply, _ = self._request("read_attr", path=path)
+            data = reply.get("data")
+            return data.encode("latin-1") if data is not None else None
+
+    def read_link(self, path: str) -> Optional[str]:
+        with self._cross("read_link", path=path):
+            reply, _ = self._request("read_link", path=path)
+            return reply.get("target")
+
+    def write_sysfs(self, path: str, data: str) -> None:
+        with self._cross("write_sysfs", path=path):
+            self._request("write_sysfs", path=path, data=data)
+
+    def probe_config(self, config_path: str) -> int:
+        with self._cross("probe_config", path=config_path):
+            reply, _ = self._request("probe_config", path=config_path)
+            return int(reply["verdict"])
+
+    def probe_node(self, dev_path: str) -> int:
+        with self._cross("probe_node", path=dev_path):
+            reply, _ = self._request("probe_node", path=dev_path)
+            return int(reply["verdict"])
+
+    def chip_alive(self, pci_base_path: str, bdf: str,
+                   node_path: Optional[str] = None) -> bool:
+        with self._cross("chip_alive", bdf=bdf):
+            reply, _ = self._request("chip_alive", pci_base=pci_base_path,
+                                     bdf=bdf, node=node_path)
+            return bool(reply["alive"])
+
+    def chip_diagnostics(self, pci_base_path: str, bdf: str):
+        with self._cross("chip_diagnostics", bdf=bdf):
+            reply, _ = self._request("chip_diagnostics",
+                                     pci_base=pci_base_path, bdf=bdf)
+            return int(reply["bits"]), reply.get("link")
+
+    def revalidate_batch(self, planner, pairs: Sequence[Tuple[str, str]],
+                         ) -> None:
+        if not pairs:
+            return
+        from .allocate import AllocationError
+        with self._cross("revalidate", members=len(pairs)):
+            reply, _ = self._request(
+                "revalidate", pci_base=planner.cfg.pci_base_path,
+                vendors=sorted(planner._vendor_ok),
+                pairs=[[m, g] for m, g in pairs])
+            for err in reply.get("errors", ()):
+                if err is not None:
+                    raise AllocationError(err)
+
+    def stats(self) -> Dict[str, object]:
+        out = self.client_stats()
+        out["reconnects_total"] = self.reconnects.value
+        try:
+            with self._cross("stats"):
+                reply, _ = self._request("stats")
+            out["broker"] = reply.get("broker", {})
+        except (BrokerError, brokeripc.BrokerProtocolError):
+            out["broker"] = None
+        return out
+
+    def shutdown_broker(self) -> None:
+        """Ask the broker process to exit cleanly (test teardown)."""
+        with self._cross("shutdown"):
+            try:
+                self._request("shutdown")
+            except BrokerUnavailable:
+                pass   # already gone — the goal state
+
+
+# ------------------------------------------------------ privileged side
+
+class PathPolicy:
+    """What the broker will touch, derived from one root prefix: device
+    nodes only under <root>/dev/{vfio,iommu,accel*}, reads only under
+    <root>/sys or <root>/dev, writes only to SYSFS_WRITE_LEAVES under
+    <root>/sys. Everything else is refused with a typed error — the
+    serving daemon compromising itself must not turn the broker into an
+    arbitrary-file oracle."""
+
+    def __init__(self, root: str = "/") -> None:
+        self.root = os.path.abspath(root)
+        self._dev = [os.path.join(self.root, seg)
+                     for seg in DEV_NODE_SEGMENTS]
+        self._read_roots = [os.path.join(self.root, "sys"),
+                            os.path.join(self.root, "dev")]
+        self._sys_root = os.path.join(self.root, "sys")
+
+    @staticmethod
+    def _under(path: str, prefix: str, loose: bool = False) -> bool:
+        """Component-safe prefix check (`/sys` must not admit
+        `/system`); `loose` also accepts name-extension matches
+        (`dev/accel` admits `dev/accel0` — the accel nodes are files
+        named by index, not a directory)."""
+        norm = os.path.normpath(path)
+        if norm == prefix or norm.startswith(prefix.rstrip("/") + "/"):
+            return True
+        return loose and norm.startswith(prefix)
+
+    def check_node(self, path: str) -> None:
+        if not any(self._under(path, p, loose=True) for p in self._dev):
+            raise BrokerError(
+                f"path policy: {path!r} is not a device node under "
+                f"{self._dev}")
+
+    def check_read(self, path: str) -> None:
+        if not any(self._under(path, p) for p in self._read_roots):
+            raise BrokerError(
+                f"path policy: {path!r} is outside the readable roots "
+                f"{self._read_roots}")
+
+    def check_write(self, path: str) -> None:
+        if not self._under(path, self._sys_root):
+            raise BrokerError(
+                f"path policy: sysfs write target {path!r} is outside "
+                f"{self._sys_root}")
+        if os.path.basename(path) not in SYSFS_WRITE_LEAVES:
+            raise BrokerError(
+                f"path policy: write leaf {os.path.basename(path)!r} not "
+                f"in {sorted(SYSFS_WRITE_LEAVES)}")
+
+    @staticmethod
+    def check_component(name: str, what: str = "bdf") -> None:
+        """A device identifier joined under a validated base must be a
+        single path-free component — a traversal bdf ('../../etc') would
+        otherwise escape the readable roots through the join."""
+        if (not name or "/" in name or "\x00" in name
+                or name in (".", "..")):
+            raise BrokerError(
+                f"path policy: {what} {name!r} is not a single path "
+                f"component")
+
+
+class BrokerServer:
+    """The privileged broker process body: accept one connection at a
+    time on a unix socket (the serving daemon holds exactly one), speak
+    brokeripc frames, dispatch through the path policy, and audit every
+    crossing. Device nodes opened through the broker are HELD open here
+    (``held_fds``) in addition to the duplicate passed to the client —
+    the broker keeping its fds across serving-daemon restarts is the
+    privilege-separation payoff the acceptance test pins."""
+
+    def __init__(self, socket_path: str, root: str = "/",
+                 native_lib_path: Optional[str] = None) -> None:
+        self.socket_path = socket_path
+        self.policy = PathPolicy(root)
+        self._health = TpuHealth(native_lib_path)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # the one live daemon connection (sequential accept: the serving
+        # daemon holds exactly one); stop() closes it so a handler
+        # blocked in recv wakes instead of pinning the accept thread
+        self._active_conn: Optional[socket.socket] = None
+        self._held: Dict[str, int] = {}      # node path -> broker-held fd
+        self._counters: Dict[str, int] = {}  # per-op crossing counts
+        self._refused = 0
+        self._audit: deque = deque(maxlen=AUDIT_RING)
+        os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
+        try:
+            os.unlink(socket_path)
+        except OSError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(socket_path)
+        self._listener.listen(4)
+        # accept() must wake for stop(): a short timeout loop, not a
+        # blocking accept, so the in-process test server tears down
+        self._listener.settimeout(0.2)
+        log.info("broker: listening on %s (root %s, pid %d)",
+                 socket_path, self.policy.root, os.getpid())
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Serve on a background thread (tests / embedded use; the
+        standalone process calls serve_forever on its main thread)."""
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True, name="broker-accept")
+        self._thread.start()
+
+    def initiate_shutdown(self) -> None:
+        """Signal-safe shutdown trigger (the standalone process's SIGTERM
+        handler): closing the live sockets is what actually wakes a
+        handler blocked in recv — PEP 475 would otherwise retry the read
+        forever and the stop flag would never be observed."""
+        self._stop.set()
+        conn = self._active_conn
+        if conn is not None:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self.initiate_shutdown()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2)
+            self._thread = None
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        for fd in self._held.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._held.clear()
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._active_conn = conn
+            try:
+                with conn:
+                    self._serve_connection(conn)
+            finally:
+                self._active_conn = None
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        log.info("broker: serving daemon connected")
+        # per-connection handshake gate: the documented contract is that
+        # a version mismatch is refused BEFORE anything else is served —
+        # which only holds if a client that SKIPS hello gets nothing
+        helloed = False
+        while not self._stop.is_set():
+            try:
+                req, extra_fds = brokeripc.recv_frame(conn)
+            except brokeripc.BrokerConnectionLost:
+                # the serving daemon died or restarted: keep running,
+                # keep the held fds, go back to accept()
+                log.info("broker: serving daemon disconnected; "
+                         "holding %d fds", len(self._held))
+                return
+            except brokeripc.BrokerProtocolError as exc:
+                log.warning("broker: protocol error: %s", exc)
+                try:
+                    brokeripc.send_frame(conn, {
+                        "ok": False, "seq": -1, "kind": "protocol",
+                        "error": str(exc)})
+                except brokeripc.BrokerConnectionLost:
+                    pass
+                return   # connection unusable after a framing error
+            brokeripc.close_fds(extra_fds)   # clients never send fds
+            if not helloed and req.get("op") != "hello":
+                reply, fds = {
+                    "ok": False, "seq": req.get("seq", -1),
+                    "kind": "version",
+                    "error": "handshake required before any operation"}, []
+                self._audit_note(req, False, reply["error"])
+            else:
+                reply, fds = self._dispatch(req)
+                if req.get("op") == "hello" and reply.get("ok"):
+                    helloed = True
+            try:
+                brokeripc.send_frame(conn, reply, fds=tuple(fds))
+            except brokeripc.BrokerConnectionLost:
+                return
+            finally:
+                brokeripc.close_fds(fds)   # ours were dups; client has its own
+            if req.get("op") == "shutdown" and reply.get("ok"):
+                # only an ACCEPTED shutdown stops the broker: a refused
+                # one (no handshake) must not let an arbitrary local
+                # process kill the privileged side through the socket
+                self._stop.set()
+                return
+
+    # --------------------------------------------------------- dispatch
+
+    def _audit_note(self, req: dict, ok: bool, error: str = "") -> None:
+        op = str(req.get("op"))
+        self._counters[op] = self._counters.get(op, 0) + 1
+        if not ok:
+            self._refused += 1
+        self._audit.append({
+            "op": op, "path": req.get("path") or req.get("bdf"),
+            "ok": ok, "error": error or None,
+            "span": req.get("span"), "ts": time.time()})
+
+    def _dispatch(self, req: dict) -> Tuple[dict, List[int]]:
+        op = req.get("op")
+        seq = req.get("seq", -1)
+        fds: List[int] = []
+        reply: dict = {"ok": True, "seq": seq}
+        try:
+            if op == "hello":
+                if req.get("version") != brokeripc.PROTOCOL_VERSION:
+                    raise BrokerError(
+                        f"protocol version {req.get('version')!r} "
+                        f"unsupported (broker speaks "
+                        f"{brokeripc.PROTOCOL_VERSION})")
+                reply["version"] = brokeripc.PROTOCOL_VERSION
+                reply["pid"] = os.getpid()
+            elif op == "node_exists":
+                path = str(req["path"])
+                self.policy.check_read(path)
+                reply["exists"] = os.path.exists(path)
+            elif op == "open_node":
+                path = str(req["path"])
+                self.policy.check_node(path)
+                try:
+                    fd = os.open(path, os.O_RDWR)
+                except OSError as exc:
+                    raise BrokerError(f"open_node {path!r}: {exc}") from exc
+                # the broker HOLDS its own copy: a serving-daemon crash
+                # never drops the device state the broker owns
+                prev = self._held.get(path)
+                self._held[path] = os.dup(fd)
+                if prev is not None:
+                    try:
+                        os.close(prev)
+                    except OSError:
+                        pass
+                fds.append(fd)
+            elif op == "read_attr":
+                path = str(req["path"])
+                self.policy.check_read(path)
+                data: Optional[bytes] = None
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read(256)
+                except OSError:
+                    data = None
+                reply["data"] = (data.decode("latin-1")
+                                 if data else None)
+            elif op == "read_link":
+                path = str(req["path"])
+                self.policy.check_read(path)
+                try:
+                    reply["target"] = os.path.basename(os.readlink(path))
+                except OSError:
+                    reply["target"] = None
+            elif op == "write_sysfs":
+                path = str(req["path"])
+                self.policy.check_write(path)
+                try:
+                    with open(path, "w", encoding="ascii") as f:
+                        f.write(str(req.get("data", "")))
+                except OSError as exc:
+                    raise BrokerError(
+                        f"write_sysfs {path!r}: {exc}") from exc
+            elif op == "probe_config":
+                path = str(req["path"])
+                self.policy.check_read(path)
+                reply["verdict"] = self._health.probe_config(path)
+            elif op == "probe_node":
+                path = str(req["path"])
+                self.policy.check_read(path)
+                reply["verdict"] = self._health.probe_node(path)
+            elif op == "chip_alive":
+                base = str(req["pci_base"])
+                bdf = str(req["bdf"])
+                self.policy.check_read(base)
+                self.policy.check_component(bdf)
+                node = req.get("node")
+                if node is not None:
+                    # the node path is probed privileged-side: confine it
+                    # like every other read, or the daemon could use the
+                    # probe as an arbitrary-file existence oracle
+                    self.policy.check_read(str(node))
+                reply["alive"] = self._health.chip_alive(
+                    base, bdf, node)
+            elif op == "chip_diagnostics":
+                base = str(req["pci_base"])
+                bdf = str(req["bdf"])
+                self.policy.check_read(base)
+                self.policy.check_component(bdf)
+                bits, link = self._health.chip_diagnostics(base, bdf)
+                reply["bits"] = bits
+                reply["link"] = link
+            elif op == "revalidate":
+                base = str(req["pci_base"])
+                self.policy.check_read(base)
+                # normalize configured spellings like the in-process
+                # reader does (allocate._vendor_ok_raw accepts both
+                # "1ae0" and "0x1ae0"): the sysfs value is stripped of
+                # its 0x below, so the configured set must be too — or a
+                # cosmetic cfg spelling would fail every spawn-mode
+                # Allocate while inproc mode works
+                vendors = {
+                    v[2:] if v.startswith("0x") else v
+                    for v in (str(x).lower()
+                              for x in req.get("vendors", ()))}
+                pairs = [(str(m), str(g))
+                         for m, g in req.get("pairs", ())]
+                for member, _group in pairs:
+                    self.policy.check_component(member)
+                reply["errors"] = [
+                    self._revalidate_one(base, m, g, vendors)
+                    for m, g in pairs]
+            elif op == "stats":
+                reply["broker"] = {
+                    "pid": os.getpid(),
+                    "held_fds": len(self._held),
+                    "held_paths": sorted(self._held),
+                    "ops": dict(self._counters),
+                    "refused_total": self._refused,
+                    "audit": list(self._audit)[-32:],
+                }
+            elif op == "shutdown":
+                log.info("broker: shutdown requested")
+            else:
+                raise BrokerError(f"unknown op {op!r}")
+        except BrokerError as exc:
+            reply = {"ok": False, "seq": seq, "kind": "refused",
+                     "error": str(exc)}
+            brokeripc.close_fds(fds)
+            fds = []
+        except Exception as exc:
+            # a malformed request field (missing key, wrong shape) from a
+            # compromised or version-skewed daemon must degrade to a
+            # typed refusal — an uncaught exception here would kill the
+            # accept thread, drop every held fd, and wedge all future
+            # daemon connects in the dead listener's backlog (the exact
+            # DoS the threat model forbids)
+            log.warning("broker: bad request %r: %s: %s",
+                        op, type(exc).__name__, exc)
+            reply = {"ok": False, "seq": seq, "kind": "bad-request",
+                     "error": f"{type(exc).__name__}: {exc}"}
+            brokeripc.close_fds(fds)
+            fds = []
+        self._audit_note(req, reply["ok"], reply.get("error", ""))
+        return reply, fds
+
+    def _revalidate_one(self, pci_base: str, bdf: str, group: str,
+                        vendors: set) -> Optional[str]:
+        """One member's TOCTOU revalidation, privileged-side: the same
+        facts AllocationPlanner._revalidate_live checks in-process."""
+        base = os.path.join(pci_base, bdf)
+        try:
+            target = os.readlink(os.path.join(base, "iommu_group"))
+        except OSError:
+            target = ""
+        live = target.rsplit("/", 1)[-1] or None
+        if live != group:
+            return (f"device {bdf}: iommu group changed "
+                    f"({group!r} -> {live!r})")
+        try:
+            with open(os.path.join(base, "vendor"), "rb") as f:
+                raw = f.read(64).strip().lower()
+        except OSError:
+            raw = b""
+        vendor = raw.decode("ascii", "replace")
+        if vendor.startswith("0x"):
+            vendor = vendor[2:]
+        if not vendor or vendor not in vendors:
+            return f"device {bdf}: vendor {vendor or None!r} is not a TPU"
+        return None
+
+
+# ------------------------------------------------------- health adapter
+
+class BrokeredHealth:
+    """TpuHealth-compatible probe surface that forwards the privileged
+    reads (config-space probes, node probes, diagnostics) through the
+    broker client. lifecycle.PluginManager swaps this in for the plain
+    native shim when the daemon runs in spawn mode, so the health hub's
+    probe closures cross the privilege boundary without knowing it."""
+
+    def __init__(self, client: _BaseClient,
+                 native_lib_path: Optional[str] = None) -> None:
+        self._client = client
+        # parsing-only helpers (link predicates, libtpu availability)
+        # stay local — they touch no privileged state
+        self._local = TpuHealth(native_lib_path)
+
+    @property
+    def is_native(self) -> bool:
+        return self._local.is_native
+
+    def libtpu_available(self) -> bool:
+        return self._local.libtpu_available()
+
+    def probe_config(self, config_path: str) -> int:
+        return self._client.probe_config(config_path)
+
+    def probe_node(self, dev_path: str) -> int:
+        return self._client.probe_node(dev_path)
+
+    def chip_alive(self, pci_base_path: str, bdf: str,
+                   node_path: Optional[str] = None) -> bool:
+        return self._client.chip_alive(pci_base_path, bdf, node_path)
+
+    def chip_diagnostics(self, pci_base_path: str, bdf: str):
+        bits, link = self._client.chip_diagnostics(pci_base_path, bdf)
+        return bits, link
+
+    def chip_link_degraded(self, pci_base_path: str, bdf: str) -> bool:
+        from .native import link_is_degraded
+        return link_is_degraded(
+            self.chip_diagnostics(pci_base_path, bdf)[1])
+
+    def chip_error_bits(self, pci_base_path: str, bdf: str) -> int:
+        return self.chip_diagnostics(pci_base_path, bdf)[0]
+
+
+# ------------------------------------------------------------- the seam
+
+_client: Optional[_BaseClient] = None
+
+
+def seam_read_link(path: str) -> Optional[str]:
+    """Basename of a sysfs symlink target, through the privilege seam:
+    the spawned broker does the readlink in spawn mode (a read-only
+    serving daemon never touches the host tree during prepare — the
+    vtpu/dra mdev paths used to read it directly and silently assumed
+    access); in-process it is discovery's plain reader, so the existing
+    read accounting is unchanged."""
+    client = get_client()
+    if client.mode == "spawn":
+        return client.read_link(path)
+    from .discovery import read_link_basename
+    return read_link_basename(path)
+
+
+def get_client() -> _BaseClient:
+    """The process-global broker seam every privileged access routes
+    through. Defaults to an InProcessBroker (lazily built; a benign
+    construction race leaves one winner). cli.main replaces it with a
+    SocketBrokerClient in spawn mode BEFORE any server starts."""
+    global _client
+    client = _client
+    if client is None:
+        client = _client = InProcessBroker()
+    return client
+
+
+def set_client(client: Optional[_BaseClient]) -> Optional[_BaseClient]:
+    """Install a client (spawn mode, tests); returns the previous one so
+    tests can restore it."""
+    global _client
+    prev, _client = _client, client
+    return prev
+
+
+def reset_client() -> None:
+    """Back to the lazy in-process default (test teardown)."""
+    global _client
+    client, _client = _client, None
+    if client is not None:
+        client.close()
+
+
+def health_shim(native_lib_path: Optional[str] = None):
+    """The health probe implementation for this process: the plain
+    native shim when privileged reads run in-process, a BrokeredHealth
+    forwarding through the broker in spawn mode."""
+    client = get_client()
+    if isinstance(client, SocketBrokerClient):
+        return BrokeredHealth(client, native_lib_path)
+    return TpuHealth(native_lib_path)
+
+
+# ---------------------------------------------------------- spawn logic
+
+def socket_live(socket_path: str, timeout_s: float = 1.0) -> bool:
+    """True when SOMETHING accepts connections on the socket — used by
+    the restart path to tell a wedged-but-alive broker (do NOT spawn a
+    duplicate over it) from a dead one (safe to respawn)."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout_s)
+    try:
+        sock.connect(socket_path)
+        return True
+    except OSError:
+        return False
+    finally:
+        sock.close()
+
+
+def spawn_broker(socket_path: str, root: str = "/",
+                 native_lib_path: Optional[str] = None,
+                 timeout_s: float = 10.0) -> subprocess.Popen:
+    """Start the privileged broker as a child process and wait for its
+    socket. The caller connects with SocketBrokerClient and installs it
+    via set_client. The broker outlives serving-daemon crashes by
+    design; it exits on SIGTERM or a shutdown op."""
+    argv = [sys.executable, "-m", "tpu_device_plugin.broker",
+            "--socket", socket_path, "--root", root]
+    if native_lib_path:
+        argv += ["--native-lib", native_lib_path]
+    # a kill -9'd broker leaves its socket FILE behind; remove it so the
+    # bind-wait below observes the NEW broker's socket, not the corpse's
+    try:
+        os.unlink(socket_path)
+    except OSError:
+        pass
+    proc = subprocess.Popen(argv)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(socket_path):
+            return proc
+        if proc.poll() is not None:
+            raise BrokerUnavailable(
+                f"broker process exited rc={proc.returncode} before "
+                f"binding {socket_path}")
+        time.sleep(0.02)
+    proc.terminate()
+    raise BrokerUnavailable(
+        f"broker did not bind {socket_path} within {timeout_s}s")
+
+
+def main(argv=None) -> int:
+    """``python -m tpu_device_plugin.broker``: the standalone privileged
+    process. Deliberately tiny — argparse, one BrokerServer, SIGTERM."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="tpu-device-plugin-broker",
+        description="Privileged vfio/sysfs/iommufd broker for the "
+                    "unprivileged TPU device-plugin daemon.")
+    parser.add_argument("--socket", required=True,
+                        help="unix socket to serve the broker IPC on")
+    parser.add_argument("--root", default="/",
+                        help="filesystem root the path policy allows "
+                             "(fixture trees in tests)")
+    parser.add_argument("--native-lib", default=None,
+                        help="path to libtpuhealth.so")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="broker %(levelname)s %(message)s")
+    server = BrokerServer(args.socket, root=args.root,
+                          native_lib_path=args.native_lib)
+
+    def handle(signum, frame):
+        server.initiate_shutdown()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    server.serve_forever()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,196 @@
+"""brokeripc — the wire protocol between the serving daemon and the broker.
+
+The privilege-separated broker (broker.py) owns every vfio/sysfs/iommufd
+operation; the unprivileged serving daemon reaches them over a unix
+socket. This module is the NARROW, VERSIONED framing both sides speak —
+deliberately small enough to audit by reading:
+
+  frame   = MAGIC (4 bytes b"TDPB") + length (4-byte big-endian)
+            + payload (UTF-8 JSON object, <= MAX_FRAME bytes)
+  fds     = passed as SCM_RIGHTS ancillary data ON the frame's first
+            send/recv (socket.send_fds / socket.recv_fds; at most
+            MAX_FDS per frame)
+
+Every request object carries:
+  op      — the operation name (broker.py's dispatch key)
+  seq     — a client-assigned sequence number echoed in the reply, so a
+            desynced connection is detected instead of mis-pairing
+  span    — the caller's active flight-recorder span context (op + seq +
+            thread), so every privilege crossing in the broker's audit
+            ring links back to the daemon-side trace (/debug/flight)
+
+and every reply carries `ok` (bool), `seq` (echoed), and either result
+fields or `error` + `kind`. The handshake is its own op ("hello"): the
+client sends PROTOCOL_VERSION, the broker refuses a mismatch with
+kind="version" BEFORE serving anything else — an old daemon can never
+drive a new broker into undefined requests, and vice versa.
+
+Robustness rules, enforced on BOTH sides:
+  - a frame without the magic, or longer than MAX_FRAME, is a protocol
+    error: the receiver raises (server side: replies kind="protocol"
+    then closes) — a corrupt length prefix must never turn into a
+    multi-GB allocation;
+  - short reads (peer died mid-frame) raise BrokerConnectionLost, the
+    typed signal broker.BrokerClient turns into "typed unavailable"
+    claim errors;
+  - received fds the caller did not expect are closed immediately, never
+    leaked.
+
+No threading in this module: callers serialize access to a connection
+(broker.SocketBrokerClient holds one plain lock around each
+request/reply pair; the broker serves each connection on its own
+thread).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+MAGIC = b"TDPB"
+PROTOCOL_VERSION = 1
+# one frame must fit a batched revalidation for a large claim plus audit
+# context, and nothing else — 1 MiB is orders of magnitude above both
+MAX_FRAME = 1 << 20
+MAX_FDS = 8
+
+_LEN = struct.Struct(">I")
+_HEADER_SIZE = len(MAGIC) + _LEN.size
+
+
+class BrokerProtocolError(Exception):
+    """The peer spoke something that is not this protocol (bad magic,
+    oversized/underflowing frame, non-JSON payload, non-object payload,
+    mismatched seq). The connection is unusable afterwards."""
+
+
+class BrokerConnectionLost(Exception):
+    """The peer vanished mid-conversation (EOF, ECONNRESET, EPIPE) — the
+    kill -9 signal the serving daemon maps to typed-unavailable errors."""
+
+
+def _encode(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":"),
+                         sort_keys=True).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise BrokerProtocolError(
+            f"frame payload {len(payload)} bytes exceeds MAX_FRAME "
+            f"{MAX_FRAME}")
+    return MAGIC + _LEN.pack(len(payload)) + payload
+
+
+def send_frame(sock: socket.socket, obj: dict,
+               fds: Tuple[int, ...] = ()) -> None:
+    """Send one frame; `fds` ride as SCM_RIGHTS on the first byte."""
+    data = _encode(obj)
+    try:
+        if fds:
+            if len(fds) > MAX_FDS:
+                raise BrokerProtocolError(
+                    f"{len(fds)} fds exceed MAX_FDS {MAX_FDS}")
+            socket.send_fds(sock, [data], list(fds))
+        else:
+            sock.sendall(data)
+    except (BrokenPipeError, ConnectionResetError, OSError) as exc:
+        raise BrokerConnectionLost(f"peer gone during send: {exc}") from exc
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                first: bytes = b"") -> bytes:
+    buf = first
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (ConnectionResetError, OSError) as exc:
+            raise BrokerConnectionLost(
+                f"peer gone during recv: {exc}") from exc
+        if not chunk:
+            raise BrokerConnectionLost("peer closed mid-frame"
+                                       if buf else "peer closed")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket, want_fds: int = 0,
+               ) -> Tuple[dict, List[int]]:
+    """Receive one frame → (object, fds). `want_fds` is the MAXIMUM fd
+    count the caller will accept; extras are closed, never leaked."""
+    fds: List[int] = []
+    if want_fds > 0:
+        # the ancillary data arrives with the first data bytes; ask for
+        # the whole header in one recv_fds call, then drain the rest
+        try:
+            head, received, _flags, _addr = socket.recv_fds(
+                sock, _HEADER_SIZE, min(want_fds, MAX_FDS))
+        except (ConnectionResetError, OSError) as exc:
+            raise BrokerConnectionLost(
+                f"peer gone during recv: {exc}") from exc
+        if not head:
+            raise BrokerConnectionLost("peer closed")
+        fds = list(received)
+        header = _recv_exact(sock, _HEADER_SIZE, first=head)
+    else:
+        header = _recv_exact(sock, _HEADER_SIZE)
+    try:
+        if header[:len(MAGIC)] != MAGIC:
+            raise BrokerProtocolError(
+                f"bad frame magic {header[:len(MAGIC)]!r}")
+        (length,) = _LEN.unpack(header[len(MAGIC):])
+        if length > MAX_FRAME:
+            raise BrokerProtocolError(
+                f"frame length {length} exceeds MAX_FRAME {MAX_FRAME}")
+        payload = _recv_exact(sock, length)
+        try:
+            obj = json.loads(payload.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as exc:
+            raise BrokerProtocolError(f"malformed frame payload: {exc}") \
+                from exc
+        if not isinstance(obj, dict):
+            raise BrokerProtocolError(
+                f"frame payload is {type(obj).__name__}, not an object")
+    except Exception:
+        close_fds(fds)
+        raise
+    return obj, fds
+
+
+def close_fds(fds) -> None:
+    """Best-effort close of received fds (error paths, unwanted extras)."""
+    import os
+    for fd in fds:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+
+
+def hello_request(seq: int = 0) -> dict:
+    return {"op": "hello", "seq": seq, "version": PROTOCOL_VERSION}
+
+
+def check_hello_reply(reply: dict) -> None:
+    """Raise BrokerProtocolError unless the broker accepted our version."""
+    if not reply.get("ok"):
+        raise BrokerProtocolError(
+            f"broker refused handshake: {reply.get('error', 'unknown')} "
+            f"(kind={reply.get('kind')!r}, broker version "
+            f"{reply.get('version')!r}, ours {PROTOCOL_VERSION})")
+    if reply.get("version") != PROTOCOL_VERSION:
+        raise BrokerProtocolError(
+            f"broker answered version {reply.get('version')!r}, "
+            f"ours {PROTOCOL_VERSION}")
+
+
+def span_context() -> Optional[dict]:
+    """The caller's active flight-recorder span as a small JSON-able
+    context (None outside any span, or with tracing disabled). Carried on
+    every request so the broker's audit ring links each privilege
+    crossing back to the daemon-side trace."""
+    from . import trace
+    stack = getattr(trace._tls, "stack", None)
+    if not stack:
+        return None
+    span = stack[-1]
+    return {"op": span.op, "seq": span.seq}

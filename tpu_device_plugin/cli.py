@@ -64,6 +64,39 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              "accel-backed logical partitions")
     parser.add_argument("--native-lib", default=None,
                         help="path to libtpuhealth.so")
+    # default=None sentinel so the env var ($TDP_BROKER) can supply the
+    # mode when the flag is absent, with the SAME validation either way
+    parser.add_argument("--broker", choices=("inproc", "spawn"),
+                        default=None,
+                        help="privilege separation mode (broker.py): "
+                             "'inproc' runs privileged operations in this "
+                             "process through the audited seam; 'spawn' "
+                             "starts a separate privileged broker process "
+                             "and crosses a versioned IPC per operation "
+                             f"(default {cfg.broker_mode}; env TDP_BROKER)")
+    parser.add_argument("--broker-socket", default=None,
+                        help="unix socket for the broker IPC (default: "
+                             f"{cfg.broker_socket_path}; re-rooted under "
+                             "--root). With --broker spawn and an EXISTING "
+                             "broker on this socket, the daemon connects "
+                             "and handshakes instead of spawning — the "
+                             "serving-daemon-restart path")
+    parser.add_argument("--broker-handshake-timeout", type=float,
+                        default=10.0,
+                        help="seconds to wait for the spawned broker to "
+                             "bind its socket and answer the version "
+                             "handshake before aborting startup")
+    parser.add_argument("--policy-dir", default=None,
+                        help="directory of sandboxed operator policy "
+                             "modules (*.py; policy.py hooks: "
+                             "score_allocation, health_verdict, admit). "
+                             "A module that fails to load aborts startup")
+    parser.add_argument("--policy-hook-deadline-ms", type=float,
+                        default=cfg.policy_hook_deadline_ms,
+                        help="wall-clock budget per policy hook call; "
+                             "later results are discarded (builtin "
+                             "behavior) and charged to the hook's "
+                             "circuit breaker")
     parser.add_argument("--cdi-spec-dir", default=None,
                         help="write CDI specs here (e.g. /var/run/cdi) and "
                              "return CDIDevice names from Allocate")
@@ -226,6 +259,28 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         if math.isnan(value) or math.isinf(value) or value < 0:
             parser.error(f"{name} must be a finite number >= 0, "
                          f"got {value!r}")
+    if args.broker is None:
+        env_broker = os.environ.get("TDP_BROKER")
+        if env_broker is not None and env_broker.strip():
+            mode = env_broker.strip().lower()
+            if mode not in ("inproc", "spawn"):
+                # fail loudly like the other env knobs: a typo'd mode
+                # silently keeping in-process privileges is the worst case
+                parser.error(f"$TDP_BROKER={env_broker!r} is not a broker "
+                             "mode (use inproc or spawn)")
+            args.broker = mode
+        else:
+            args.broker = cfg.broker_mode
+    if math.isnan(args.policy_hook_deadline_ms) \
+            or math.isinf(args.policy_hook_deadline_ms) \
+            or args.policy_hook_deadline_ms <= 0:
+        parser.error("--policy-hook-deadline-ms must be a finite number "
+                     f"> 0, got {args.policy_hook_deadline_ms!r}")
+    if args.broker_handshake_timeout <= 0 \
+            or math.isnan(args.broker_handshake_timeout) \
+            or math.isinf(args.broker_handshake_timeout):
+        parser.error("--broker-handshake-timeout must be a finite number "
+                     f"> 0, got {args.broker_handshake_timeout!r}")
     if args.publish_pace_base > args.publish_pace_max:
         # base > max is silently inconsistent: decay clamps the window
         # to base while adaptation clamps to max — reject it loudly
@@ -275,6 +330,9 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         publish_pace_base_s=args.publish_pace_base,
         publish_pace_max_s=args.publish_pace_max,
         diagnostics_ttl_s=args.diagnostics_ttl,
+        broker_mode=args.broker,
+        policy_dir=args.policy_dir,
+        policy_hook_deadline_ms=args.policy_hook_deadline_ms,
     )
     if args.root:
         cfg = cfg.with_root(args.root)
@@ -293,6 +351,9 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
         cfg = replace(cfg, dra_plugins_path=args.dra_plugins_path)
     if args.dra_registry_path is not None:
         cfg = replace(cfg, dra_registry_path=args.dra_registry_path)
+    # explicit broker socket wins over --root's re-rooting, same rule
+    if args.broker_socket is not None:
+        cfg = replace(cfg, broker_socket_path=args.broker_socket)
     return cfg, args
 
 
@@ -342,6 +403,68 @@ def main(argv=None) -> int:
     if args.discover_only:
         print(dump_inventory(cfg))
         return 0
+    # Privilege separation (broker.py): in spawn mode the global broker
+    # seam is pointed at a separate privileged process BEFORE anything
+    # builds planners or health shims. An existing broker on the socket
+    # (serving-daemon restart — the broker survived us) is connected to
+    # and version-handshaked; otherwise one is spawned. In-process mode
+    # leaves the lazy audited in-process seam in place.
+    from . import broker as broker_mod
+    broker_proc = None
+    try:
+        if cfg.broker_mode == "spawn":
+            logger = logging.getLogger(__name__)
+            try:
+                client = broker_mod.SocketBrokerClient(
+                    cfg.broker_socket_path,
+                    connect_timeout_s=args.broker_handshake_timeout)
+                logger.info("connected to existing broker on %s (daemon "
+                            "restart path)", cfg.broker_socket_path)
+            except broker_mod.BrokerUnavailable:
+                if broker_mod.socket_live(cfg.broker_socket_path):
+                    # something IS listening but would not complete the
+                    # handshake (a wedged broker): spawning a duplicate
+                    # would unlink the live broker's socket and orphan
+                    # its held device fds — refuse startup loudly and
+                    # let the operator deal with the stuck process
+                    raise
+                broker_proc = broker_mod.spawn_broker(
+                    cfg.broker_socket_path, root=cfg.root_path,
+                    native_lib_path=cfg.native_lib_path,
+                    timeout_s=args.broker_handshake_timeout)
+                client = broker_mod.SocketBrokerClient(
+                    cfg.broker_socket_path,
+                    connect_timeout_s=args.broker_handshake_timeout)
+                logger.info("spawned privileged broker pid=%d on %s",
+                            broker_proc.pid, cfg.broker_socket_path)
+            broker_mod.set_client(client)
+        else:
+            # in-process mode: install the seam EXPLICITLY so the
+            # configured native lib reaches any probe routed through it
+            # (the lazy default client has no cfg to read)
+            broker_mod.set_client(
+                broker_mod.InProcessBroker(cfg.native_lib_path))
+        # Operator policy hooks (policy.py): fail-loud loading — a broken
+        # policy module must refuse startup, not silently run without it
+        policy_engine = None
+        if cfg.policy_dir:
+            from .policy import PolicyEngine
+            policy_engine = PolicyEngine(
+                hook_deadline_ms=cfg.policy_hook_deadline_ms)
+            n_modules = policy_engine.load_dir(cfg.policy_dir)
+            logging.getLogger(__name__).info(
+                "policy engine: %d module(s) loaded from %s",
+                n_modules, cfg.policy_dir)
+    except Exception:
+        # a startup failure AFTER the broker spawned (handshake timeout,
+        # broken policy module) must not orphan a root-privileged child
+        if broker_proc is not None:
+            broker_proc.terminate()
+            try:
+                broker_proc.wait(timeout=5)
+            except Exception:
+                broker_proc.kill()
+        raise
     stop = threading.Event()
 
     def handle(signum, frame):
@@ -369,7 +492,7 @@ def main(argv=None) -> int:
         server_url = args.api_server or in_cluster_server()
         api = ApiClient(server_url) if server_url else None
         dra_driver = DraDriver(cfg, Registry(), {}, node_name=args.node_name,
-                               api=api)
+                               api=api, policy=policy_engine)
 
         def dra_sink(reg, gens, _d=dra_driver):
             _d.set_inventory(reg, gens)
@@ -394,7 +517,8 @@ def main(argv=None) -> int:
                 ok = sink(reg, gens) and ok
             return ok
     manager = PluginManager(cfg, on_inventory=on_inventory,
-                            health_listener=health_listener)
+                            health_listener=health_listener,
+                            policy_engine=policy_engine)
     if dra_driver is not None:
         # the DRA driver rides the manager's shared health plane for its
         # registration-socket watch (kubelet-restart recovery) — same hub,
@@ -438,6 +562,15 @@ def main(argv=None) -> int:
             dra_driver.stop()
         if status is not None:
             status.stop()
+        if broker_proc is not None:
+            # WE spawned this broker: reap it on a clean daemon shutdown
+            # (a broker we merely connected to belongs to whoever started
+            # it and outlives us — the privilege-separation design)
+            broker_proc.terminate()
+            try:
+                broker_proc.wait(timeout=5)
+            except Exception:
+                broker_proc.kill()
     return 0
 
 

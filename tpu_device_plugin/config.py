@@ -132,6 +132,27 @@ class Config:
     # single-rack inventories are cheap to read fresh).
     diagnostics_ttl_s: float = 0.0
 
+    # --- privilege separation (broker.py) -----------------------------------
+    # "inproc" (default): privileged operations run in this process
+    # through the audited in-process seam. "spawn": cli.main starts the
+    # privileged broker as a separate process and every privileged
+    # operation crosses the versioned IPC — the serving daemon can then
+    # run unprivileged and crash/upgrade freely while the broker keeps
+    # its device fds. Env override: $TDP_BROKER.
+    broker_mode: str = "inproc"
+    # unix socket the broker serves its IPC on (the serving daemon
+    # reconnects here after either side restarts)
+    broker_socket_path: str = "/var/run/tpu-device-plugin/broker.sock"
+
+    # --- operator policy hooks (policy.py) ----------------------------------
+    # Directory of sandboxed policy modules (*.py) hooking allocation
+    # scoring, health verdicts, and admission; None disables the engine.
+    policy_dir: Optional[str] = None
+    # wall-clock budget per hook call: a result arriving later is
+    # discarded (builtin behavior), counted, and charged to the hook's
+    # circuit breaker
+    policy_hook_deadline_ms: float = 25.0
+
     # --- native shim --------------------------------------------------------
     native_lib_path: Optional[str] = None  # override libtpuhealth.so location
 
@@ -157,4 +178,5 @@ class Config:
             dra_plugins_path=os.path.join(root, "plugins/"),
             dra_registry_path=os.path.join(root, "plugins_registry/"),
             shared_device_classes=(os.path.join(root, "sys/class/egm"),),
+            broker_socket_path=os.path.join(root, "run/broker.sock"),
         )

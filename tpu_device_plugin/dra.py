@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from . import broker as broker_mod
 from . import epoch as epoch_mod
 from . import faults
 from . import lockdep
@@ -59,7 +60,6 @@ from .log import get_logger
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
-from .discovery import read_link_basename
 from .kubeapi import ApiClient, ApiError, PublishPacer
 from .resilience import BackoffPolicy
 from .kubeletapi import draapi, drapb, regpb
@@ -207,10 +207,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         node_name: Optional[str] = None,
         api: Optional[ApiClient] = None,
         driver_name: Optional[str] = None,
+        policy=None,
     ) -> None:
         self.cfg = cfg
         self.node_name = node_name or os.environ.get("NODE_NAME") or "node"
         self.api = api
+        # Optional policy.PolicyEngine: the prepare plane consults its
+        # admit hook per claim (a rejection is that claim's typed error,
+        # never the RPC's); None costs one attribute check
+        self._policy = policy
         self.driver_name = driver_name or cfg.resource_namespace
         self._driver_fs = sanitize_name(self.driver_name).lower().replace(
             "_", "-")
@@ -1678,7 +1683,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 mdev_specs = [pb.DeviceSpec(
                     host_path=self.cfg.dev_path("dev/vfio/vfio"),
                     container_path="/dev/vfio/vfio", permissions="mrw")]
-                group = read_link_basename(os.path.join(
+                # via the privilege seam (broker.seam_read_link): a
+                # read-only daemon prepares mdev partitions without
+                # touching the host tree itself (spawn mode brokers it)
+                group = broker_mod.seam_read_link(os.path.join(
                     self.cfg.mdev_base_path, p.uuid, "iommu_group"))
                 if group is not None:
                     mdev_specs.append(pb.DeviceSpec(
@@ -1709,6 +1717,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
     def _prepare_claim(self, claim: drapb.Claim,
                        task: dict) -> List[drapb.Device]:
+        # Policy admission throttle (policy.py): BEFORE any state is
+        # touched, so a rejected claim leaves nothing to roll back. The
+        # rejection is this claim's error string; the kubelet retries and
+        # a later policy decision (or an unloaded policy) admits it.
+        engine = self._policy
+        if engine is not None and engine.has_hook("admit"):
+            reason = engine.admit({
+                "op": "prepare", "claim_uid": claim.uid,
+                "namespace": claim.namespace, "name": claim.name})
+            if reason is not None:
+                raise AllocationError(
+                    f"policy rejected claim {claim.namespace}/{claim.name}:"
+                    f" {reason}")
         # Caller holds the per-claim-UID lock, so a concurrent retry of the
         # SAME claim waits here while distinct claims run fully parallel.
         # The API-server round-trip and device planning (sysfs reads,

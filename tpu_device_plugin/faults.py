@@ -42,6 +42,17 @@ Instrumented sites and their semantics:
                      during NodeUnprepareResources fails before the
                      checkpoint mutation: the unprepare errors per-claim
                      and the kubelet retry re-runs it (exactly-once)
+  broker.ipc         value   — the next broker crossing (broker.py
+                     client) fails as if the privileged broker process
+                     had died: the caller gets the typed
+                     BrokerUnavailable, the serving daemon degrades to
+                     per-claim/per-RPC unavailable errors, recovery is
+                     respawn + handshake
+  policy.hook        raising — the operator policy hook raises (or, with
+                     kind=timeout, is "slow") inside the engine's
+                     guarded invocation: the engine keeps builtin
+                     behavior, counts the failure, and trips the hook's
+                     circuit breaker after repetition
 
 Arming — programmatic:
 
@@ -111,6 +122,8 @@ _SITE_CATEGORY: Dict[str, str] = {
     "pci.hotunplug": "value",
     "pci.replug": "value",
     "migration.handoff": "raising",
+    "broker.ipc": "value",
+    "policy.hook": "raising",
 }
 _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 

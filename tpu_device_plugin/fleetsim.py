@@ -605,7 +605,7 @@ class ManagedFleetNode:
 
     def __init__(self, root: str, apiserver: FleetApiServer,
                  name: str = "mnode-000", n_devices: int = 4,
-                 device_id: str = "0063"):
+                 device_id: str = "0063", spawn_broker: bool = False):
         FakeChip, FakeHost = _fakehost()
         from .lifecycle import PluginManager
         from .registry import Registry
@@ -630,7 +630,25 @@ class ManagedFleetNode:
             self.bdfs.append(bdf)
             self.groups[bdf] = str(11 + i)
         self.cfg = replace(Config().with_root(self.root),
-                           publish_pace_base_s=0.0, lw_debounce_s=0.0)
+                           publish_pace_base_s=0.0, lw_debounce_s=0.0,
+                           broker_mode="spawn" if spawn_broker
+                           else "inproc")
+        # Privilege separation (broker.py): a broker-backed node runs a
+        # REAL privileged broker process rooted at this node's fixture
+        # tree and points the process-global seam at it BEFORE any
+        # planner or health shim is built — the whole boot/claim-storm
+        # path then crosses the versioned IPC exactly as the production
+        # spawn mode does. One spawn-mode node per process at a time
+        # (the seam is process-global); stop() restores the previous
+        # client.
+        self.broker_proc = None
+        self._prev_broker_client = None
+        if spawn_broker:
+            from . import broker as broker_mod
+            self.broker_proc = broker_mod.spawn_broker(
+                self.cfg.broker_socket_path, root=self.root)
+            self._prev_broker_client = broker_mod.set_client(
+                broker_mod.SocketBrokerClient(self.cfg.broker_socket_path))
         os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
         self.kubelet = DeviceManagerSim(self.cfg.device_plugin_path)
         self.driver = DraDriver(
@@ -691,11 +709,39 @@ class ManagedFleetNode:
             obj = self.apiserver.slices.get(self.driver.slice_name())
         return {d["name"] for d in obj["spec"]["devices"]} if obj else set()
 
+    def kill_broker(self) -> None:
+        """kill -9 the privileged broker (chaos): subsequent privileged
+        operations degrade to typed BrokerUnavailable errors."""
+        if self.broker_proc is None:
+            raise RuntimeError(f"{self.name} is not broker-backed")
+        self.broker_proc.kill()
+        self.broker_proc.wait(timeout=5)
+
+    def respawn_broker(self) -> None:
+        """Respawn the broker and re-handshake the live client — the
+        recovery path the acceptance criteria pin."""
+        from . import broker as broker_mod
+        self.broker_proc = broker_mod.spawn_broker(
+            self.cfg.broker_socket_path, root=self.root)
+        client = broker_mod.get_client()
+        client.reconnect()
+
     def stop(self) -> None:
         self.manager.running.clear()
         self.manager.stop()
         self.driver.stop()
         self.kubelet.stop()
+        if self.broker_proc is not None:
+            from . import broker as broker_mod
+            client = broker_mod.set_client(self._prev_broker_client)
+            if client is not None:
+                client.close()
+            if self.broker_proc.poll() is None:
+                self.broker_proc.terminate()
+                try:
+                    self.broker_proc.wait(timeout=5)
+                except Exception:
+                    self.broker_proc.kill()
 
 
 class FleetSim:

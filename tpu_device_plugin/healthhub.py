@@ -51,6 +51,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from . import faults
 from . import lockdep
 from . import trace
+from .broker import BrokerUnavailable
 from .health import InotifyWatcher, _BACK, _GONE
 
 log = logging.getLogger(__name__)
@@ -164,6 +165,11 @@ class HealthHub:
         self._probes_deduped_last_cycle = 0
         self._probe_timeouts = 0
         self._probe_errors = 0
+        # probes that failed because the privileged broker was gone
+        # (broker.BrokerUnavailable): counted apart from generic probe
+        # errors so a broker outage reads as ITSELF on /status — the
+        # chip's dead verdict is a degradation artifact, not silicon
+        self._probe_broker_unavailable = 0
         self._existence_scans = 0
         self._last_cycle_s = 0.0
 
@@ -539,6 +545,17 @@ class HealthHub:
                 alive = bool(probe(bdf, node))
                 sp.set(alive=alive)
                 return alive
+            except BrokerUnavailable as exc:
+                # spawn mode, broker gone: the probe cannot answer, so
+                # the chip scores dead (safe direction) — but the counter
+                # and span attribute say WHY, and a broker respawn
+                # recovers the verdict on the next cycle
+                with self._lock:
+                    self._probe_broker_unavailable += 1
+                log.error("liveness probe for %s degraded (%s); scoring "
+                          "dead until the broker returns", bdf, exc)
+                sp.set(alive=False, broker_unavailable=True)
+                return False
             except Exception as exc:
                 # a raising probe must never kill the worker silently
                 # healthy: score the chip dead and count it
@@ -581,6 +598,8 @@ class HealthHub:
             "probes_deduped_last_cycle": self._probes_deduped_last_cycle,
             "probe_timeouts_total": self._probe_timeouts,
             "probe_errors_total": self._probe_errors,
+            "probe_broker_unavailable_total":
+                self._probe_broker_unavailable,
             # probes still blocked past their deadline right now: each
             # pins one pool worker until its read returns (the chip
             # keeps its dead verdict without resubmission meanwhile)

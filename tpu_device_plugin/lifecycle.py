@@ -21,6 +21,7 @@ import time
 from concurrent import futures
 from typing import List, Optional
 
+from . import broker as broker_mod
 from . import lockdep
 from . import trace
 from .config import Config
@@ -29,7 +30,6 @@ from .discovery import HostSnapshot, discover, read_serial
 from .healthhub import HealthHub, HubSubscription
 from .lifecycle_fsm import DeviceLifecycle
 from .naming import resource_name_for
-from .native import TpuHealth
 from .registry import Registry
 from .resilience import BackoffPolicy
 from .server import (KubeletUnavailable, RegistrationRejected,
@@ -47,8 +47,12 @@ log = get_logger(__name__)
 
 class PluginManager:
     def __init__(self, cfg: Config, on_inventory=None,
-                 health_listener=None) -> None:
+                 health_listener=None, policy_engine=None) -> None:
         self.cfg = cfg
+        # Optional policy.PolicyEngine, threaded into every plugin server
+        # (scoring/health/admission hooks) and surfaced on /status +
+        # /debug/policy by status.py. None = builtin behavior everywhere.
+        self.policy_engine = policy_engine
         # called with (registry, generations) after every (re)discovery —
         # the node labeler publishes per-node facts through this seam; a
         # False return (e.g. API server unreachable at node boot) is retried
@@ -97,7 +101,12 @@ class PluginManager:
         # RuntimeError if the interrupt lands mid-write on this thread
         self._dump_request = False
         self.running = threading.Event()  # run() loop is alive (liveness)
-        self._shim = TpuHealth(cfg.native_lib_path)
+        # the probe implementation for this process, via the privilege
+        # seam (broker.health_shim): the plain native shim in-process, a
+        # BrokeredHealth forwarding config-space/node probes through the
+        # broker IPC in spawn mode — the hub's probe closures cross the
+        # boundary without knowing it
+        self._shim = broker_mod.health_shim(cfg.native_lib_path)
         # The host-level shared health plane: ONE inotify fd, ONE existence
         # reconciler and ONE deduped deadline-bounded probe scheduler for
         # every plugin server (and the DRA driver's socket watch), however
@@ -229,6 +238,7 @@ class PluginManager:
                 health_listener=self.health_listener,
                 health_hub=self.health_hub,
                 lifecycle=self.device_lifecycle,
+                policy=self.policy_engine,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
@@ -267,7 +277,8 @@ class PluginManager:
                 cdi_enabled=cdi_enabled, cdi_uuids=cdi_uuids,
                 health_listener=self.health_listener,
                 health_hub=self.health_hub,
-                lifecycle=self.device_lifecycle))
+                lifecycle=self.device_lifecycle,
+                policy=self.policy_engine))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi

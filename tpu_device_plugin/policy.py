@@ -1,0 +1,380 @@
+"""policy — the gpu_ext-inspired sandboxed policy hook layer.
+
+Fleet operators tune placement and health behavior today by forking the
+daemon. This module makes those decisions operator-loadable instead
+(ROADMAP item 1): small Python policy modules, loaded from
+``--policy-dir``, run under a restricted-builtins evaluator and hook
+three decision points:
+
+  score_allocation(ctx) -> list[str] | None
+      Override the GetPreferredAllocation winner. ``ctx`` carries the
+      kubelet's available/must-include sets, the requested size, and the
+      builtin engine's choice + ICI contiguity score
+      (placement.selection_score — the PR 10 engine stays the baseline
+      the policy COMPOSES with). Return None to keep the builtin choice;
+      a returned list must be a valid allocation (every must-include id,
+      exactly `size` ids, all drawn from available+must) or it is
+      counted invalid and discarded.
+
+  health_verdict(ctx) -> bool | None
+      Override one health source's verdict before it enters the ANDed
+      device table (``ctx``: device, healthy, source). None keeps the
+      observed verdict. Operators use this to quarantine flapping chips
+      harder or to ignore a known-noisy source on specific fleets.
+
+  admit(ctx) -> bool | str | None
+      Admission throttle on the attach planes (``ctx``: op
+      "prepare"/"allocate", claim/resource identity). None/True admits;
+      False or a reason string rejects — the caller surfaces a typed
+      rejection, it never crashes the RPC.
+
+Misbehaving policies cannot take the daemon down, by construction:
+
+- **sandbox** — policy source is exec'd with a curated builtins table
+  (no ``__import__``, no ``open``, no ``getattr``/``eval``/``exec``)
+  AND the loader statically rejects any dunder-name access in the
+  module's AST — ``().__class__.__base__.__subclasses__()``-style
+  object-graph walks, the classic curated-builtins escape, fail at
+  LOAD time with PolicyLoadError. The sandbox is a guard rail against
+  operator mistakes and casual capability creep, not a substitute for
+  reviewing what lands in ``--policy-dir``: policy files come from the
+  node's filesystem, which is already a privileged surface.
+- **per-hook call deadline** — every invocation is wall-clocked; a
+  result that arrives after ``hook_deadline_ms`` is DISCARDED (builtin
+  behavior wins), counted, and charged to the breaker. Python cannot
+  preempt a hot loop, so the deadline bounds *damage*, not latency of a
+  single call — the breaker bounds repetition.
+- **circuit breaker** — each hook function carries a
+  resilience.CircuitBreaker; raising or slow calls trip it OPEN and the
+  engine skips the hook (builtin behavior) until the cooldown's
+  half-open probe succeeds.
+
+Decisions are observable: per-hook counters + breaker states on /status
+(``policy``) and /metrics (``tdp_policy_*``), and a bounded
+recent-decision ring on ``/debug/policy``.
+
+The engine is OPT-IN per process: servers and the DRA driver hold
+``policy=None`` by default, and every hot-path consultation starts with
+a None/has-hook check — the zero-lock read-path gates run without an
+engine and are unaffected. With hooks loaded, a consultation takes the
+hook's breaker lock; that is the documented cost of running operator
+code on the decision path.
+
+Fault site ``policy.hook`` (raising kind) fires inside the guarded
+invocation — an armed error/timeout is indistinguishable from a raising
+or slow policy, which is exactly what test_chaos.py scripts.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from . import faults
+from .epoch import AtomicCounter
+from .resilience import CircuitBreaker
+
+log = logging.getLogger(__name__)
+
+HOOK_NAMES = ("score_allocation", "health_verdict", "admit")
+DECISION_RING = 64
+
+# What operator policy code may use. Deliberately small: pure-compute
+# builtins only — no import machinery, no I/O, no attribute bypasses
+# (getattr/setattr/vars/globals are out: they are the classic sandbox
+# escape primitives), no exec/eval/compile.
+SAFE_BUILTINS: Dict[str, Any] = {
+    "abs": abs, "all": all, "any": any, "bool": bool, "dict": dict,
+    "divmod": divmod, "enumerate": enumerate, "filter": filter,
+    "float": float, "frozenset": frozenset, "int": int, "len": len,
+    "list": list, "map": map, "max": max, "min": min, "range": range,
+    "repr": repr, "reversed": reversed, "round": round, "set": set,
+    "sorted": sorted, "str": str, "sum": sum, "tuple": tuple, "zip": zip,
+    "True": True, "False": False, "None": None,
+    "ValueError": ValueError, "KeyError": KeyError, "TypeError": TypeError,
+}
+
+
+class PolicyLoadError(Exception):
+    """The policy source failed to load (syntax error, sandbox
+    violation at module body, non-callable hook). Loading is fail-loud:
+    a daemon must refuse to start with a broken policy rather than run
+    silently without it."""
+
+
+class _Hook:
+    """One loaded hook function + its failure containment."""
+
+    __slots__ = ("module", "name", "fn", "breaker", "calls", "errors",
+                 "deadline_exceeded", "rejected_open", "overrides")
+
+    def __init__(self, module: str, name: str, fn: Callable,
+                 breaker: CircuitBreaker) -> None:
+        self.module = module
+        self.name = name
+        self.fn = fn
+        self.breaker = breaker
+        self.calls = AtomicCounter()
+        self.errors = AtomicCounter()
+        self.deadline_exceeded = AtomicCounter()
+        self.rejected_open = AtomicCounter()
+        self.overrides = AtomicCounter()
+
+
+class PolicyEngine:
+    """Loads policy modules and serves the three decision points.
+
+    Loading happens once at startup (cli.main); after ``load_*`` the
+    hook table is immutable, so ``has_hook`` is one dict read and an
+    engine with no hooks costs the hot paths one attribute check."""
+
+    def __init__(self, hook_deadline_ms: float = 25.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if hook_deadline_ms <= 0:
+            raise ValueError("hook_deadline_ms must be > 0")
+        self.hook_deadline_ms = hook_deadline_ms
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        self._clock = clock
+        self._hooks: Dict[str, List[_Hook]] = {n: [] for n in HOOK_NAMES}
+        self.modules: List[str] = []
+        self.invalid_overrides = AtomicCounter()
+        # recent decisions for /debug/policy: C-atomic bounded appends,
+        # read by list() copy — no lock on either side
+        self._decisions: deque = deque(maxlen=DECISION_RING)
+
+    # ----------------------------------------------------------- loading
+
+    @staticmethod
+    def _reject_dunders(module_name: str, source: str) -> None:
+        """Static sandbox half: no dunder-name access anywhere in the
+        policy AST. Attribute walks like ``().__class__.__base__`` are
+        the standard escape out of a curated-builtins exec — pure
+        decision functions never need them."""
+        import ast
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            raise PolicyLoadError(f"policy {module_name}: {exc}") from exc
+
+        def dunder(name: str) -> bool:
+            return name.startswith("__") and name.endswith("__")
+
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, ast.Attribute) and dunder(node.attr):
+                name = node.attr
+            elif isinstance(node, ast.Name) and dunder(node.id):
+                name = node.id
+            if name is not None:
+                raise PolicyLoadError(
+                    f"policy {module_name}: dunder access {name!r} at "
+                    f"line {node.lineno} is not allowed (sandbox)")
+
+    def load_source(self, module_name: str, source: str) -> None:
+        """Compile + exec one policy module under the sandbox and
+        register any hook functions it defines."""
+        self._reject_dunders(module_name, source)
+        try:
+            code = compile(source, f"<policy:{module_name}>", "exec")
+        except SyntaxError as exc:
+            raise PolicyLoadError(f"policy {module_name}: {exc}") from exc
+        namespace: Dict[str, Any] = {"__builtins__": dict(SAFE_BUILTINS)}
+        try:
+            exec(code, namespace)   # noqa: S102 — sandboxed by builtins
+        except Exception as exc:
+            raise PolicyLoadError(
+                f"policy {module_name} failed at load: "
+                f"{type(exc).__name__}: {exc}") from exc
+        found = 0
+        for hook_name in HOOK_NAMES:
+            fn = namespace.get(hook_name)
+            if fn is None:
+                continue
+            if not callable(fn):
+                raise PolicyLoadError(
+                    f"policy {module_name}: {hook_name} is not callable")
+            breaker = CircuitBreaker(
+                failure_threshold=self._breaker_threshold,
+                reset_timeout_s=self._breaker_cooldown_s,
+                clock=self._clock,
+                name=f"policy.{module_name}.{hook_name}")
+            self._hooks[hook_name].append(
+                _Hook(module_name, hook_name, fn, breaker))
+            found += 1
+        if not found:
+            raise PolicyLoadError(
+                f"policy {module_name}: defines none of {HOOK_NAMES}")
+        self.modules.append(module_name)
+        log.info("policy: loaded %s (%d hook(s))", module_name, found)
+
+    def load_dir(self, path: str) -> int:
+        """Load every ``*.py`` under `path` (sorted; fail-loud on the
+        first broken module). Returns the module count."""
+        import os
+        try:
+            entries = sorted(e for e in os.listdir(path)
+                             if e.endswith(".py"))
+        except OSError as exc:
+            raise PolicyLoadError(f"policy dir {path!r}: {exc}") from exc
+        for entry in entries:
+            with open(os.path.join(path, entry), "r",
+                      encoding="utf-8") as f:
+                self.load_source(entry.removesuffix(".py"), f.read())
+        return len(entries)
+
+    def has_hook(self, hook_name: str) -> bool:
+        return bool(self._hooks.get(hook_name))
+
+    # --------------------------------------------------------- invocation
+
+    def _invoke(self, hook_name: str, ctx: dict,
+                ) -> "tuple[Optional[Any], Optional[_Hook]]":
+        """Run the hook chain for one decision; the FIRST non-None
+        result wins and STOPS the chain (later hooks' results could
+        never apply, so charging callers their latency would be pure
+        waste). Raising, slow, or breaker-open hooks contribute nothing
+        (builtin behavior); every outcome is counted. Returns
+        (value, winning hook) — the CALLER credits the winner's
+        override counter only when the value actually changed behavior
+        (a policy answering 'keep builtin' is not an override)."""
+        for hook in self._hooks[hook_name]:
+            if not hook.breaker.allow():
+                hook.rejected_open.add()
+                continue
+            hook.calls.add()
+            t0 = self._clock()
+            try:
+                # the fault point rides INSIDE the guarded call: an
+                # armed error/timeout is a raising policy, exactly
+                faults.fire("policy.hook", hook=hook_name,
+                            module=hook.module)
+                value = hook.fn(dict(ctx))
+                elapsed_ms = (self._clock() - t0) * 1e3
+            except Exception as exc:
+                hook.errors.add()
+                hook.breaker.record_failure()
+                log.warning("policy %s.%s raised: %s (builtin behavior "
+                            "kept)", hook.module, hook_name, exc)
+                continue
+            if elapsed_ms > self.hook_deadline_ms:
+                # post-hoc deadline: the result is discarded, the slow
+                # call charged to the breaker — Python cannot preempt
+                # the call itself, but repetition is bounded
+                hook.deadline_exceeded.add()
+                hook.breaker.record_failure()
+                log.warning("policy %s.%s exceeded deadline "
+                            "(%.1f ms > %g ms); result discarded",
+                            hook.module, hook_name, elapsed_ms,
+                            self.hook_deadline_ms)
+                continue
+            hook.breaker.record_success()
+            if value is not None:
+                return value, hook
+        return None, None
+
+    def _note_decision(self, hook_name: str, ctx: dict,
+                       outcome: str, detail: object = None) -> None:
+        self._decisions.append({
+            "hook": hook_name, "outcome": outcome, "detail": detail,
+            "ctx": {k: v for k, v in ctx.items()
+                    if isinstance(v, (str, int, float, bool))},
+            "ts": time.time()})
+
+    # ------------------------------------------------------ decision API
+
+    def score_allocation(self, ctx: dict) -> Optional[List[str]]:
+        """A validated override of the preferred-allocation choice, or
+        None (builtin wins). Invalid overrides are counted and dropped."""
+        if not self.has_hook("score_allocation"):
+            return None
+        # validation inputs are snapshotted BEFORE the hook runs: the
+        # hook receives a shallow ctx copy whose LISTS it could mutate,
+        # and validating against post-mutation state would let a policy
+        # smuggle a nonexistent device past the validator
+        must = list(ctx.get("must_include", ()))
+        size = int(ctx.get("size", 0))
+        legal = set(ctx.get("available", ())) | set(must)
+        value, winner = self._invoke("score_allocation", ctx)
+        if value is None:
+            return None
+        try:
+            ids = [str(x) for x in value]
+        except TypeError:
+            ids = None
+        if (ids is None or len(ids) != size or len(set(ids)) != len(ids)
+                or not set(ids) <= legal or not set(must) <= set(ids)):
+            self.invalid_overrides.add()
+            self._note_decision("score_allocation", ctx, "invalid",
+                                detail=repr(value)[:120])
+            log.warning("policy: score_allocation override %r is not a "
+                        "valid allocation (size=%d, must=%s); builtin "
+                        "choice kept", value, size, must)
+            return None
+        winner.overrides.add()
+        self._note_decision("score_allocation", ctx, "override",
+                            detail=ids)
+        return ids
+
+    def health_verdict(self, device: str, healthy: bool,
+                       source: str) -> bool:
+        """One source's verdict after policy; the observed verdict when
+        no hook overrides."""
+        if not self.has_hook("health_verdict"):
+            return healthy
+        ctx = {"device": device, "healthy": healthy, "source": source}
+        value, winner = self._invoke("health_verdict", ctx)
+        if value is None or bool(value) == healthy:
+            return healthy
+        winner.overrides.add()
+        self._note_decision("health_verdict", ctx, "override",
+                            detail=bool(value))
+        return bool(value)
+
+    def admit(self, ctx: dict) -> Optional[str]:
+        """None = admitted; a reason string = rejected (the caller
+        surfaces it as a typed rejection)."""
+        if not self.has_hook("admit"):
+            return None
+        value, winner = self._invoke("admit", ctx)
+        if value is None or value is True:
+            # an explicit True is plain admission — builtin behavior,
+            # not an override
+            return None
+        reason = value if isinstance(value, str) else "rejected by policy"
+        winner.overrides.add()
+        self._note_decision("admit", ctx, "reject", detail=reason)
+        return reason
+
+    # ----------------------------------------------------------- surface
+
+    def snapshot(self) -> dict:
+        """Lock-free /status body: per-hook counters + breaker states
+        (AtomicCounter sums and breaker snapshots)."""
+        hooks = []
+        for name in HOOK_NAMES:
+            for hook in self._hooks[name]:
+                hooks.append({
+                    "hook": name, "module": hook.module,
+                    "calls": hook.calls.value,
+                    "overrides": hook.overrides.value,
+                    "errors": hook.errors.value,
+                    "deadline_exceeded": hook.deadline_exceeded.value,
+                    "rejected_while_open": hook.rejected_open.value,
+                    "breaker": hook.breaker.snapshot(),
+                })
+        return {"modules": list(self.modules),
+                "hook_deadline_ms": self.hook_deadline_ms,
+                "invalid_overrides": self.invalid_overrides.value,
+                "hooks": hooks}
+
+    def debug(self) -> dict:
+        """The /debug/policy body: the snapshot plus the bounded
+        recent-decision ring (C-atomic deque copy)."""
+        out = self.snapshot()
+        out["recent_decisions"] = list(self._decisions)
+        return out

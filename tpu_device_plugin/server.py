@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import grpc
 
 from . import allocate as allocate_mod
+from . import broker as broker_mod
 from . import epoch as epoch_mod
 from . import faults
 from . import kubeletapi as api
@@ -93,6 +94,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         health_listener=None,
         health_hub: Optional[HealthHub] = None,
         lifecycle=None,
+        policy=None,
     ) -> None:
         # arm-time validation, matching faults.py's fail-loud convention: a
         # NaN window makes every condvar timeout comparison silently false
@@ -117,6 +119,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # deque append (note_allocation_event) — the Allocate read-path
         # gate stays at zero registered-lock acquisitions.
         self._lifecycle = lifecycle
+        # Optional policy.PolicyEngine (operator hooks): None (the
+        # default, and what the zero-lock gates run against) costs every
+        # consultation one attribute check. With hooks loaded, scoring/
+        # health/admission decisions consult operator code under the
+        # engine's deadline + breaker containment.
+        self._policy = policy
         # serializes listener deliveries; see set_devices_health
         self._listener_lock = lockdep.instrument(
             "server.TpuDevicePlugin._listener_lock", threading.Lock())
@@ -258,7 +266,25 @@ class TpuDevicePlugin(api.DevicePluginServicer):
 
     def set_devices_health(self, device_ids: Sequence[str], healthy: bool,
                            source: str = "fs") -> None:
-        """Record one source's verdict; a device is Healthy iff ALL sources agree.
+        """Record one source's verdict (after any policy override); a
+        device is Healthy iff ALL sources agree. Policy health-verdict
+        hooks run HERE — before the store lock, never under it — so a
+        slow operator hook can delay this delivery but can never stall
+        parked ListAndWatch waiters."""
+        engine = self._policy
+        if engine is not None and engine.has_hook("health_verdict"):
+            flipped = [i for i in device_ids
+                       if engine.health_verdict(i, healthy, source)
+                       != healthy]
+            if flipped:
+                gone = set(flipped)
+                self._apply_devices_health(flipped, not healthy, source)
+                device_ids = [i for i in device_ids if i not in gone]
+        self._apply_devices_health(device_ids, healthy, source)
+
+    def _apply_devices_health(self, device_ids: Sequence[str],
+                              healthy: bool, source: str) -> None:
+        """The policy-free writer body of set_devices_health.
 
         Health has two independent observers — the filesystem watcher and the
         native liveness probe — that see different failure modes (a removed
@@ -719,6 +745,30 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                                       str(exc))
                     if len(memo) < PREF_CACHE_SIZE:
                         memo[key] = ids
+                # Policy scoring override (policy.py): operator hooks may
+                # replace the builtin choice, composing with the
+                # placement engine — the ctx carries the builtin answer
+                # AND its ICI contiguity score so a policy can keep it
+                # unless its own objective dominates. Runs AFTER the memo
+                # (policies may be stateful; caching their answers would
+                # freeze them) and only when a hook is loaded — the
+                # default None engine costs one attribute check.
+                engine = self._policy
+                if engine is not None \
+                        and engine.has_hook("score_allocation"):
+                    coords_of = index.coords_of
+                    override = engine.score_allocation({
+                        "resource": self.resource_name,
+                        "available": list(creq.available_deviceIDs),
+                        "must_include": list(creq.must_include_deviceIDs),
+                        "size": creq.allocation_size,
+                        "builtin_choice": list(ids),
+                        "builtin_score": placement.selection_score(
+                            self.torus_dims,
+                            [coords_of.get(i) for i in ids]),
+                    })
+                    if override is not None:
+                        ids = override
                 # Score the answer's ICI contiguity (placement.py): 1.0 =
                 # the chosen chips ARE one axis-aligned sub-box (one ICI
                 # ring/tile), lower = stragglers. Scored on every call
@@ -751,12 +801,29 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         return resp
 
     def _allocate_impl(self, request, context):
+        engine = self._policy
+        if engine is not None and engine.has_hook("admit"):
+            reason = engine.admit({
+                "op": "allocate", "resource": self.resource_name,
+                "devices": sum(len(c.devices_ids)
+                               for c in request.container_requests)})
+            if reason is not None:
+                log.warning("%s: allocate rejected by policy: %s",
+                            self.resource_name, reason)
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              f"policy rejected allocation: {reason}")
         try:
             # the epoch id keys the planner's precompiled fragments: a
             # health flip publishes a new epoch, so the next plan starts a
             # fresh fragment cache — no invalidation listeners
             return self._planner.allocate_response(
                 request, epoch=self._store.current.epoch_id)
+        except broker_mod.BrokerUnavailable as exc:
+            # the privileged broker is gone (crash, injected drop): the
+            # typed-unavailable degradation — the kubelet retries, and a
+            # broker respawn + handshake recovers without restarting us
+            log.error("%s: allocate degraded: %s", self.resource_name, exc)
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
         except allocate_mod.AllocationError as exc:
             log.error("%s: allocate failed: %s", self.resource_name, exc)
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))

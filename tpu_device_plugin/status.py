@@ -21,6 +21,17 @@ dependency-free endpoint for liveness probes and debugging:
                    ?claim=<uid> / ?bdf=<raw id> / ?op=<prefix> /
                    ?limit=<n>, plus the slow-span log — the "what
                    happened to claim X" surface (docs/observability.md)
+  GET /debug/policy -> the policy engine (policy.py): loaded modules,
+                   per-hook call/override/error/deadline counters,
+                   breaker states, and the bounded recent-decision
+                   ring. 404 when no policy engine is attached.
+  GET /debug/broker -> the privilege broker (broker.py): the client's
+                   crossing counters plus — in spawn mode — the broker
+                   process's own audit (held fds, per-op counts, the
+                   recent-crossing ring with daemon-side span links).
+                   This endpoint performs ONE broker IPC round-trip;
+                   /status deliberately serves only the local
+                   client-side counters.
   GET /debug/defrag -> the defrag advisor (placement.py): given
                    ?shape=2x2[&generation=v5e], the minimal claim
                    migrations that would free a contiguous ICI box for
@@ -123,6 +134,17 @@ class StatusServer:
                         claim=first("claim"), bdf=first("bdf"),
                         op=first("op"), limit=limit),
                         sort_keys=True).encode())
+                elif route == "/debug/policy":
+                    body = outer.policy_debug()
+                    if body is None:
+                        return self._send(
+                            404, b"no policy engine attached", "text/plain")
+                    self._send(200, json.dumps(body,
+                                               sort_keys=True).encode())
+                elif route == "/debug/broker":
+                    self._send(200, json.dumps(
+                        outer.broker_debug(), sort_keys=True,
+                        default=str).encode())
                 elif route == "/debug/defrag":
                     if outer.dra_driver is None:
                         return self._send(
@@ -182,6 +204,21 @@ class StatusServer:
         generation — the handler answers 400)."""
         return self.dra_driver.propose_defrag(shape, generation)
 
+    def policy_debug(self):
+        """The /debug/policy body (None when no engine is attached):
+        PolicyEngine.debug() — snapshot + recent-decision ring."""
+        engine = getattr(self.manager, "policy_engine", None)
+        if engine is None:
+            return None
+        return engine.debug()
+
+    def broker_debug(self) -> dict:
+        """The /debug/broker body: the full broker stats — one IPC
+        round-trip in spawn mode (held fds, per-op audit), just the
+        local crossing counters in-process."""
+        from . import broker
+        return broker.get_client().stats()
+
     def flight(self, claim=None, bdf=None, op=None, limit=None) -> dict:
         """The /debug/flight body: merged span ring (time-ordered,
         filtered), the slow-span log, and the recorder's own stats.
@@ -237,6 +274,16 @@ class StatusServer:
         # slow-span pressure — lock-free reads like everything else here
         from . import trace
         out["trace"] = trace.stats()
+        # privilege-boundary crossings (broker.py): the CLIENT-side
+        # counters only — lock-free AtomicCounter reads; the broker
+        # process's own audit (an IPC round-trip) lives on /debug/broker
+        from . import broker
+        out["broker"] = broker.get_client().client_stats()
+        # operator policy decisions (policy.py): per-hook counters +
+        # breaker states when an engine is loaded
+        engine = getattr(self.manager, "policy_engine", None)
+        if engine is not None:
+            out["policy"] = engine.snapshot()
         # hot-read-path lock accounting (lockdep.read_path): only present
         # under TDP_LOCKDEP=1 — steady-state acquisitions pinned at 0 by
         # the read-path gate (tests/test_epoch.py)
@@ -710,6 +757,72 @@ class StatusServer:
             for site, n in sorted(fired.items()):
                 lines.append(f'tdp_fault_fires_total{{site="{_esc(site)}"}} '
                              f'{n}')
+        # privilege-boundary crossings (broker.py): client-side counters,
+        # present in every scrape whichever mode the daemon runs in
+        brk = s.get("broker") or {}
+        lines += [
+            "# HELP tdp_broker_crossings_total Privilege-boundary "
+            "crossings through the broker seam (broker.ipc spans; "
+            "in-process and spawned modes both count).",
+            "# TYPE tdp_broker_crossings_total counter",
+            f"tdp_broker_crossings_total {brk.get('crossings_total', 0)}",
+            "# HELP tdp_broker_errors_total Broker crossings that failed "
+            "(connection lost, refused, injected drop).",
+            "# TYPE tdp_broker_errors_total counter",
+            f"tdp_broker_errors_total {brk.get('errors_total', 0)}",
+            "# HELP tdp_broker_spawn_mode Privilege separation active "
+            "(1 = privileged operations run in a separate broker "
+            "process).",
+            "# TYPE tdp_broker_spawn_mode gauge",
+            f"tdp_broker_spawn_mode {int(brk.get('mode') == 'spawn')}",
+        ]
+        # operator policy decisions (policy.py): emitted only when an
+        # engine is loaded, like the dra section
+        pol = s.get("policy")
+        if pol is not None:
+            lines += [
+                "# HELP tdp_policy_invalid_overrides_total Policy "
+                "scoring overrides discarded as invalid allocations.",
+                "# TYPE tdp_policy_invalid_overrides_total counter",
+                f"tdp_policy_invalid_overrides_total "
+                f"{pol.get('invalid_overrides', 0)}",
+            ]
+            hooks = pol.get("hooks", [])
+            for help_text, family, key in (
+                    ("Policy hook invocations.",
+                     "tdp_policy_hook_calls_total", "calls"),
+                    ("Policy hook decisions that overrode builtin "
+                     "behavior.",
+                     "tdp_policy_hook_overrides_total", "overrides"),
+                    ("Policy hook invocations that raised (builtin "
+                     "behavior kept).",
+                     "tdp_policy_hook_errors_total", "errors"),
+                    ("Policy hook results discarded for exceeding the "
+                     "per-call deadline.",
+                     "tdp_policy_hook_deadline_exceeded_total",
+                     "deadline_exceeded"),
+                    ("Policy hook consultations skipped while the "
+                     "hook's circuit breaker was open.",
+                     "tdp_policy_hook_rejected_open_total",
+                     "rejected_while_open")):
+                lines += [f"# HELP {family} {help_text}",
+                          f"# TYPE {family} counter"]
+                for h in hooks:
+                    lines.append(
+                        f'{family}{{hook="{_esc(h["hook"])}",module='
+                        f'"{_esc(h["module"])}"}} {h.get(key, 0)}')
+            lines += [
+                "# HELP tdp_policy_breaker_open Policy hook circuit "
+                "breaker state (1 = open/half-open: hook skipped, "
+                "builtin behavior).",
+                "# TYPE tdp_policy_breaker_open gauge",
+            ]
+            for h in hooks:
+                state = h.get("breaker", {}).get("state", "closed")
+                lines.append(
+                    f'tdp_policy_breaker_open{{hook="{_esc(h["hook"])}",'
+                    f'module="{_esc(h["module"])}"}} '
+                    f'{int(state != "closed")}')
         # flight-recorder exposition (trace.py): latency histograms
         # (_bucket/_sum/_count families) + the trace-plane counters
         from . import trace

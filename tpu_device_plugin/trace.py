@@ -553,6 +553,9 @@ _register("tdp_kubeapi_rtt_ms",
 _register("tdp_pacing_delay_ms",
           "Publish-pacer admission delay before a ResourceSlice publish "
           "wave (kubeapi.PublishPacer; 0-delay waves are not recorded).")
+_register("tdp_broker_crossing_ms",
+          "Privilege-boundary crossing wall time (broker.ipc span: one "
+          "broker operation, in-process or over the broker IPC).")
 
 
 def histogram(name: str) -> Histogram:

@@ -23,11 +23,11 @@ from typing import Dict, List, Sequence
 
 import grpc
 
+from . import broker as broker_mod
 from . import lockdep
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
-from .discovery import read_link_basename
 from .healthhub import HubSubscription
 from .kubeletapi import pb
 from .naming import sanitize_name
@@ -50,6 +50,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         health_listener=None,
         health_hub=None,
         lifecycle=None,
+        policy=None,
     ) -> None:
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
@@ -57,7 +58,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         super().__init__(cfg, type_name, registry, devices=[],
                          health_shim=health_shim, cdi_enabled=cdi_enabled,
                          health_listener=health_listener,
-                         health_hub=health_hub, lifecycle=lifecycle)
+                         health_hub=health_hub, lifecycle=lifecycle,
+                         policy=policy)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
@@ -162,7 +164,10 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                     if p.provider == "mdev":
                         self._validate_mdev(p)
                         add(self.cfg.dev_path("dev/vfio/vfio"), "/dev/vfio/vfio")
-                        group = read_link_basename(
+                        # via the privilege seam: spawn mode brokers the
+                        # readlink, a read-only daemon never touches the
+                        # host tree during Allocate
+                        group = broker_mod.seam_read_link(
                             os.path.join(self.cfg.mdev_base_path, uuid, "iommu_group"))
                         if group is not None:
                             add(self.cfg.dev_path("dev/vfio", group),
