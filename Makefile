@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker test-broker-spawn fleet-soak clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -193,6 +193,17 @@ test-broker-spawn:
 fleet-soak:
 	TDP_CHAOS_SOAK=1 TDP_LOCKDEP=1 JAX_PLATFORMS=cpu \
 		$(PYTHON) -m pytest tests/test_fleetsim.py -q -k soak
+
+# Full-length continuous autopilot soak (ISSUE 12, gated like the other
+# soaks): 256 nodes / >= 100k claim events of OVERLAPPING boot storms,
+# claim storms, hot-unplugs, migrations, defrag waves and rolling
+# upgrades on the watch-stream fabric, with watch chaos + kubeapi.watch
+# faults firing throughout and the soak invariants checked continuously
+# (fleetsim.fleet_invariants). Writes docs/bench_autopilot_r14.json —
+# the artifact the r14 perf-honesty guard pins. The CI smoke leg runs
+# the --quick (N=8, ~60 s) shape with TDP_LOCKDEP=1.
+soak-autopilot:
+	TDP_CHAOS_SOAK=1 JAX_PLATFORMS=cpu $(PYTHON) bench.py --autopilot
 
 # Validate the multi-chip sharding path on a virtual CPU mesh.
 dryrun:

@@ -2161,10 +2161,129 @@ def run_broker(quick=False):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_autopilot(quick=False):
+    """`bench.py --autopilot` (r14): the continuous fleet autopilot soak
+    (tpu_device_plugin/autopilot.py; make soak-autopilot / the CI smoke
+    leg).
+
+    Two phases, both counted facts:
+
+      - SOAK: N in-process nodes under OVERLAPPING storms — claim
+        batches, multi-host slices, health-flip waves, hot-unplugs with
+        orphan cleanup + replug readmission, handoff migrations, defrag
+        advisories, rolling upgrade waves, republish (boot) waves —
+        with the fabric's watch-stream chaos (breaks / duplicate
+        deliveries) AND the kubeapi.watch fault sites firing
+        throughout, and the soak invariants (exactly-once fabric +
+        multiclaim audits, zero lost claims, zero orphaned specs,
+        checkpoint/fabric agreement) checked CONTINUOUSLY by a
+        dedicated thread, then once more after quiesce (0 orphans
+        left). Full shape: 256 nodes / >= 100k claim events. Quick
+        (CI): 8 nodes, ~25 s, every storm type still enabled.
+      - READ/REPAIR: the steady-state fabric-read comparison — a
+        polling fleet pays one liveness GET per node per reconcile
+        tick, the watch fleet's established streams cover wipe
+        detection (reads ~0; the one-time seeding relists reported
+        separately) — and the watch fleet must still HEAL a slice
+        wiped behind its driver. Acceptance: >= 5x fewer steady-state
+        reads, pinned by test_perf_honesty on the committed artifact.
+
+    Writes docs/bench_autopilot_r14.json ($BENCH_AUTOPILOT_OUT
+    overrides; --quick defaults to the sibling *_quick file so the
+    committed acceptance artifact is never clobbered by a smoke run).
+    """
+    from tpu_device_plugin import faults
+    from tpu_device_plugin.autopilot import (AutopilotConfig,
+                                             FleetAutopilot,
+                                             measure_read_repair)
+
+    if quick:
+        cfg = AutopilotConfig(
+            nodes=8, duration_s=25.0, claim_event_target=0, seed=1337,
+            claim_workers=4, multiclaim_workers=1, flip_workers=1,
+            unplug_workers=1, migration_workers=1, defrag_workers=1,
+            upgrade_workers=1, upgrade_wave_size=2,
+            boot_workers=1, boot_wave_size=4,
+            pinned_per_nodes=4, invariant_interval_s=2.0)
+    else:
+        cfg = AutopilotConfig(
+            # the storm runs until BOTH bounds are met: ≥30 min of
+            # overlapping chaos AND ≥100k claim events — the duration
+            # floor keeps the continuous invariant checker (one
+            # full-fleet sweep is minutes at 256 nodes under storm
+            # load) doing several passes DURING the run
+            nodes=256, duration_s=1800.0, claim_event_target=100_000,
+            # wall budget sized for the 100k-event target on a small
+            # shared box, not a latency claim — the soak runs until
+            # the event target lands
+            max_wall_s=3300.0, seed=1337,
+            # worker pools sized so the single GIL serves BOTH the
+            # storm (48 claim workers landed ~190 events/s — 2.9x the
+            # target, starving the checker to ~1 sweep / 5 min) and
+            # the continuous invariant checker's full-fleet sweeps
+            claim_workers=24, claims_per_batch=4,
+            multiclaim_workers=2, flip_workers=4,
+            unplug_workers=2, migration_workers=2, defrag_workers=2,
+            upgrade_workers=2, upgrade_wave_size=8,
+            boot_workers=2, boot_wave_size=16,
+            pinned_per_nodes=8, invariant_interval_s=5.0,
+            # production-shaped idle cost at 256 nodes: long-poll
+            # rotations every 25 s and bookmarks every 5 s, so the GIL
+            # serves claim events instead of stream-churn overhead
+            watch_timeout_s=25.0, watch_resync_s=60.0,
+            bookmark_interval_s=5.0)
+    pilot = FleetAutopilot(cfg)
+    try:
+        report = pilot.run(raise_on_violation=False)
+    finally:
+        faults.reset()
+    read_repair = measure_read_repair(n_nodes=8 if quick else 16,
+                                      rounds=12)
+    out = {"quick": quick, "soak": report, "read_repair": read_repair}
+    print(f"autopilot soak: nodes={cfg.nodes} "
+          f"claim_events={report['counters']['claim_events']} "
+          f"ok={report['ok']} violations={len(report['violations'])} | "
+          f"read/repair {read_repair['poll_reads']} poll vs "
+          f"{read_repair['watch_reads']} watch reads "
+          f"({read_repair['read_reduction_x']}x)", file=sys.stderr)
+    default_name = ("bench_autopilot_r14_quick.json" if quick
+                    else "bench_autopilot_r14.json")
+    out_path = os.environ.get("BENCH_AUTOPILOT_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "docs", default_name)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    return {
+        "metric": "autopilot_watch_read_reduction",
+        "value": read_repair["read_reduction_x"],
+        "unit": "x",
+        # acceptance: >= 5x fewer steady-state fabric reads with the
+        # watch plane, soak green under overlapping chaos
+        "vs_baseline": round(read_repair["read_reduction_x"] / 5.0, 3),
+        "baseline_source": "ISSUE 12 acceptance: autopilot soak "
+                           "completes with every continuous invariant "
+                           "green while kubeapi.watch faults fire, and "
+                           "watch-driven convergence pays >= 5x fewer "
+                           "steady-state fabric reads than guarded-PUT "
+                           "read/repair polling",
+        "soak_ok": report["ok"],
+        "claim_events": report["counters"]["claim_events"],
+        "invariant_checks": report["counters"]["invariant_checks"],
+        "nodes": cfg.nodes,
+        "matrix_file": out_path,
+    }
+
+
 def main() -> int:
     import logging
     logging.disable(logging.CRITICAL)  # keep the one-line contract
 
+    if "--autopilot" in sys.argv:
+        out = run_autopilot(quick="--quick" in sys.argv)
+        print(json.dumps(out))
+        # the CI smoke leg (and make soak-autopilot) must go red when
+        # the soak ends with invariant violations — the report is still
+        # printed and the artifact still written for the post-mortem
+        return 0 if out["soak_ok"] else 1
     if "--broker" in sys.argv:
         print(json.dumps(run_broker(quick="--quick" in sys.argv)))
         return 0

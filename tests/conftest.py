@@ -54,7 +54,8 @@ if _lockdep_on:
 # an owner whose stop() path lost it
 _OWNED_THREAD_PREFIXES = (
     "healthhub", "dra-prepare", "dra-ckpt", "dra-reserve", "restart-",
-    "plugin-start", "status-http", "health-", "dp-",
+    "plugin-start", "status-http", "health-", "dp-", "reflector-",
+    "autopilot-",
 )
 
 

@@ -85,6 +85,14 @@ SURFACES = {
     ("kubeapi.PublishPacer", "stats[*]"): {
         "status": "dra.pacing.publish_waves_total",
         "metrics": "tpu_plugin_dra_publish_waves_total"},
+    # watch-stream convergence plane (ISSUE 12): the event counter
+    # anchors the reflector's dict group; streams/relists/resyncs/
+    # degraded twins surface under the same dra.watch.* status object
+    # and their own metric families (asserted present by the docs half
+    # of this audit via perf.md/observability.md)
+    ("kubeapi.Reflector", "stats[*]"): {
+        "status": "dra.watch.watch_events_total",
+        "metrics": "tpu_plugin_dra_watch_events_total"},
     ("lifecycle_fsm.DeviceLifecycle", "transition_counts[*]"): {
         "status": "lifecycle.transitions",
         "metrics": "lifecycle_transitions_total"},

@@ -91,6 +91,19 @@ class FakeApiServer:
                     name = self.path.rsplit("/", 1)[-1]
                     return self._send(200, {"metadata": {
                         "name": name, "uid": f"uid-{name}"}})
+                if "watch=" in self.path:
+                    # no watch support here: a reflector pointed at this
+                    # fake must take its typed degraded-polling ladder
+                    return self._send(400, {"reason": "watch unsupported"})
+                if self.path.split("?", 1)[0].rstrip("/").endswith(
+                        "/resourceslices"):
+                    # collection LIST (the watch reconciler's relist; this
+                    # fake serves no watch streams, so a reflector pointed
+                    # here exercises the typed degraded-polling ladder)
+                    return self._send(200, {
+                        "kind": "ResourceSliceList",
+                        "metadata": {"resourceVersion": str(outer._rv)},
+                        "items": list(outer.slices.values())})
                 if "/resourceslices/" in self.path:
                     name = self.path.rsplit("/", 1)[-1]
                     if name in outer.slices:
@@ -841,6 +854,51 @@ def test_change_free_republish_still_heals_deleted_slice(host, apiserver):
     apiserver.slices.clear()
     assert driver.publish_resource_slices()
     assert apiserver.slices, "deleted slice not recreated by no-op republish"
+
+
+def test_foreign_low_generation_recreate_never_regresses_sequence(
+        host, apiserver):
+    """A foreign delete + recreate resets pool.generation to 1. The next
+    publish must continue THIS driver's sequence (max(live, last) + 1),
+    never replay 2..N — old allocations would look newer than the live
+    pool and the fabric's exactly-once audit would see regressed
+    generations. A matching-projection recreate is divergence too: it is
+    not adopted as the delta baseline, and the guarded PUT restores the
+    advertised generation."""
+    import copy
+    _, cfg = host
+    driver = make_driver(cfg, apiserver)
+    assert driver.publish_resource_slices()              # generation 1
+    assert driver.apply_health({"0000:00:04.0": False})  # 2
+    assert driver.apply_health({"0000:00:04.0": True})   # 3
+    name = next(iter(apiserver.slices))
+    assert apiserver.slices[name]["spec"]["pool"]["generation"] == 3
+
+    def foreign_recreate(mutate=None):
+        obj = copy.deepcopy(apiserver.slices[name])
+        obj["spec"]["pool"]["generation"] = 1
+        apiserver._rv += 1
+        obj["metadata"]["resourceVersion"] = str(apiserver._rv)
+        if mutate:
+            mutate(obj)
+        apiserver.slices[name] = obj
+
+    # DIVERGED projection: the recreate dropped a device; the repair
+    # publish continues the sequence (4), never replays 2
+    foreign_recreate(lambda o: o["spec"]["devices"].pop())
+    driver._last_publish = None            # what a watch repair does
+    assert driver.publish_resource_slices()
+    assert apiserver.slices[name]["spec"]["pool"]["generation"] == 4
+
+    # MATCHING projection at a REGRESSED generation: flagged diverged
+    # (the watch reconciler would repair it), never adopted as the
+    # delta baseline — the guarded PUT restores the generation (5)
+    foreign_recreate()
+    assert driver._slice_diverged(apiserver.slices[name])
+    driver._last_publish = None
+    assert driver.publish_resource_slices()
+    assert apiserver.slices[name]["spec"]["pool"]["generation"] == 5
+    driver.stop()
 
 
 def test_apply_health_noop_transitions_do_not_publish(host, apiserver):

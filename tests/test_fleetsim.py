@@ -15,7 +15,8 @@ import threading
 import pytest
 
 from tpu_device_plugin import faults
-from tpu_device_plugin.fleetsim import FleetApiServer, FleetSim
+from tpu_device_plugin.fleetsim import (FleetApiServer, FleetSim,
+                                        assert_fleet_invariants)
 from tpu_device_plugin.kubeapi import ApiClient, ApiError, PublishPacer
 
 
@@ -325,7 +326,10 @@ def test_fleet_soak_64_node_boot_storm_with_chaos():
     + rolling upgrade with the chaos registry armed (publish refusals and
     apiserver transport faults firing mid-storm), under TDP_LOCKDEP=1
     (the make target bakes it in). Every fleet contract must hold
-    through the faults."""
+    through the faults — and the soak invariant pass
+    (fleetsim.assert_fleet_invariants, shared with the autopilot's
+    continuous checker) is asserted BETWEEN storms, not only at the
+    end."""
     faults.reset()
     faults.arm("dra.publish", kind="drop", count=8)
     faults.arm("kubeapi.request", kind="error", count=8)
@@ -345,15 +349,19 @@ def test_fleet_soak_64_node_boot_storm_with_chaos():
                 if missing:
                     assert node.driver.publish_resource_slices()
             assert sim.assert_converged()
+            assert_fleet_invariants(sim)
             flip = sim.flip_wave(4)
             assert flip["converged"] and flip["exactly_once"]
+            assert_fleet_invariants(sim)
             attach = sim.attach_storm(4)
             assert attach["errors"] == []
             assert attach["prepared_total"] == 256
+            assert_fleet_invariants(sim)
             wave = sim.drain_upgrade_wave(16)
             assert wave["converged"] and wave["exactly_once"]
             assert wave["prepared_total"] == 256
             assert boot["exactly_once"]
+            assert_fleet_invariants(sim)
         finally:
             sim.stop()
     finally:

@@ -1078,3 +1078,53 @@ def test_broker_crossings_per_attach_is_live_not_just_recorded(short_root):
         assert 1 <= per_attach <= 2, per_attach
     finally:
         broker.set_client(prev)
+
+
+def test_bench_autopilot_r14_pins_watch_convergence_soak():
+    """Round-14 pins against the RECORDED docs/bench_autopilot_r14.json
+    (ISSUE 12 acceptance): the 256-node / 100k-claim-event autopilot
+    soak with EVERY overlapping storm type completed green under watch
+    chaos + kubeapi.watch faults (continuous invariant checks, final
+    quiesce with zero orphans, exactly-once fabric + multiclaim
+    audits), and watch-driven convergence paid >= 5x fewer steady-state
+    fabric reads than guarded-PUT read/repair polling."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_autopilot_r14.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    assert d["quick"] is False
+    soak = d["soak"]
+    assert soak["ok"] and soak["converged"], soak.get("violations")
+    assert soak["violations"] == []
+    assert soak["config"]["nodes"] >= 256
+    assert soak["config"]["watch"] and soak["config"]["watch_chaos"] \
+        and soak["config"]["watch_faults"]
+    c = soak["counters"]
+    assert c["claim_events"] >= 100_000
+    # invariants were checked CONTINUOUSLY, not only at the end
+    assert c["invariant_checks"] >= 5
+    # every storm type of the acceptance list actually overlapped
+    for storm in ("prepares", "unprepares", "multiclaims_placed",
+                  "flip_storms", "unplugs", "readmits", "migrations",
+                  "upgrades", "republish_waves"):
+        assert c[storm] >= 1, (storm, c)
+    fi = soak["final_invariants"]
+    assert fi["ok"] and fi["exactly_once"] \
+        and fi["multiclaim_exactly_once"]
+    assert fi["orphaned_claims"] == 0       # zero lost/orphaned claims
+    # the watch plane carried the soak and its faults fired throughout
+    assert soak["watch"]["watch_events_total"] > 0
+    assert sum(soak["faults_fired"].values()) >= 10
+    # a cross-node flight-recorder claim story was reconstructed
+    story = soak["claim_story"]
+    assert story is not None and story["spans"] >= 2
+    assert story["source"] != story["target"]
+
+    rr = d["read_repair"]
+    assert rr["read_reduction_x"] >= 5.0, rr
+    assert rr["watch_reads"] < rr["poll_reads"]
+    assert rr["wipe_healed_by_watch"] and rr["exactly_once"]

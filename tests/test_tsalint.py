@@ -811,3 +811,50 @@ def test_broker_boundary_project_whitelist_names_the_seams():
         "tpu_device_plugin/discovery.py",
         "tpu_device_plugin/native/__init__.py",
     }
+
+
+THREAD_LIST_TRACKED = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._threads = []
+
+    def spawn(self, n):
+        for _ in range(n):
+            thread = threading.Thread(target=self.run, daemon=True)
+            self._threads.append(thread)
+            thread.start()
+
+    def run(self):
+        pass
+
+    def stop(self):
+        for thread in self._threads:
+            thread.join(timeout=5)
+"""
+
+
+def test_thread_list_append_plus_loop_join_is_clean():
+    """The tracked-thread-LIST pattern (ISSUE 12, autopilot worker
+    pools): threads appended to one attribute and joined by a stop
+    path looping that attribute are reaped — no finding."""
+    assert run(THREAD_LIST_TRACKED) == []
+
+
+def test_thread_list_without_loop_join_still_fires():
+    leaked = THREAD_LIST_TRACKED.replace(
+        "        for thread in self._threads:\n"
+        "            thread.join(timeout=5)",
+        "        pass")
+    findings = run(leaked)
+    assert [f.detail for f in findings] == ["not-joined:Thread"]
+
+
+def test_thread_list_join_over_other_attr_does_not_vouch():
+    """Looping a DIFFERENT list must not credit the tracked one."""
+    wrong = THREAD_LIST_TRACKED.replace(
+        "for thread in self._threads:",
+        "for thread in self._others:")
+    findings = run(wrong)
+    assert [f.detail for f in findings] == ["not-joined:Thread"]

@@ -1,0 +1,609 @@
+"""Watch-stream convergence plane tests (ISSUE 12).
+
+Fabric side (fleetsim.FleetApiServer WATCH semantics): monotonic
+resourceVersions on chunked long-poll streams, bookmark events, 410 Gone
+on compacted resume, bounded per-watcher queues whose overflow
+force-closes the stream (slow-consumer semantics), injectable breaks /
+duplicate deliveries.
+
+Client side (kubeapi.Reflector): list+watch with resourceVersion
+tracking, relist on 410/stream break through the resilience backoff,
+periodic resync, the at-least-once delivery contract, and the typed
+degraded paced-relist mode when watch support is missing.
+
+Daemon side (dra.DraDriver.start_watch_reconciler): a slice wiped or
+mutated behind the driver is observed and repaired through the guarded
+write path — exactly-once audited — and duplicate deliveries are
+idempotent on the DRA inventory.
+"""
+
+import json
+import time
+
+import pytest
+
+from tpu_device_plugin import faults
+from tpu_device_plugin.fleetsim import FleetApiServer, FleetSim
+from tpu_device_plugin.kubeapi import ApiClient, ApiError, Reflector
+from tpu_device_plugin.resilience import BackoffPolicy
+
+SLICES = "/apis/resource.k8s.io/v1beta1/resourceslices"
+
+
+def _post_slice(api, name, generation=1, devices=()):
+    return api.post_json(SLICES, {
+        "metadata": {"name": name},
+        "spec": {"pool": {"generation": generation},
+                 "devices": [{"name": d} for d in devices]}})
+
+
+def _put_slice(api, obj):
+    return api.put_json(f"{SLICES}/{obj['metadata']['name']}", obj)
+
+
+@pytest.fixture()
+def fabric():
+    servers = []
+
+    def build(**kw):
+        kw.setdefault("bookmark_interval_s", 0.1)
+        srv = FleetApiServer(**kw)
+        servers.append(srv)
+        return srv
+
+    yield build
+    for srv in servers:
+        srv.stop()
+
+
+@pytest.fixture()
+def reflect():
+    refs = []
+
+    def build(api, **kw):
+        kw.setdefault("resync_interval_s", 60.0)
+        kw.setdefault("poll_interval_s", 0.1)
+        kw.setdefault("watch_timeout_s", 1.0)
+        ref = Reflector(api, SLICES, **kw)
+        refs.append(ref)
+        ref.start()
+        return ref
+
+    yield build
+    for ref in refs:
+        ref.stop()
+
+
+def _wait(predicate, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# --------------------------------------------------------------- fabric
+
+
+def test_fabric_watch_delivers_events_with_monotonic_rvs(fabric):
+    """Each write lands on the stream exactly once, in order, carrying a
+    strictly increasing resourceVersion; the list's resourceVersion is a
+    valid resume cursor (no replay of pre-list events)."""
+    srv = fabric()
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    _post_slice(api, "pre")                    # lands BEFORE the list
+    lst = api.get_json(SLICES)
+    resume = lst["metadata"]["resourceVersion"]
+    with api.stream(f"{SLICES}?watch=1&resourceVersion={resume}"
+                    f"&timeoutSeconds=3", read_timeout_s=5) as resp:
+        _post_slice(api, "s1")
+        obj = _put_slice(api, api.get_json(f"{SLICES}/s1")
+                         | {"spec": {"pool": {"generation": 2},
+                                     "devices": []}})
+        api.delete(f"{SLICES}/s1")
+        events, rvs = [], []
+        deadline = time.monotonic() + 5
+        while len(events) < 3 and time.monotonic() < deadline:
+            line = resp.readline()
+            if not line:
+                break
+            evt = json.loads(line)
+            if evt["type"] == "BOOKMARK":
+                continue
+            events.append((evt["type"],
+                           evt["object"]["metadata"]["name"]))
+            rvs.append(int(
+                evt["object"]["metadata"]["resourceVersion"]))
+    assert events == [("ADDED", "s1"), ("MODIFIED", "s1"),
+                      ("DELETED", "s1")]
+    assert rvs == sorted(rvs) and len(set(rvs)) == 3
+    assert rvs[0] > int(resume)        # "pre" was not replayed
+    assert obj["metadata"]["name"] == "s1"
+
+
+def test_fabric_watch_410_on_compacted_resume(fabric):
+    """A resume cursor older than the compaction horizon answers 410
+    Gone — the client cannot be caught up event-by-event."""
+    srv = fabric(watch_backlog=4)
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    for i in range(8):                 # 8 events, backlog 4: compaction
+        _post_slice(api, f"s{i}")
+    with pytest.raises(ApiError) as exc:
+        with api.stream(f"{SLICES}?watch=1&resourceVersion=1"
+                        f"&timeoutSeconds=1"):
+            pass
+    assert exc.value.code == 410
+    assert srv.snapshot()["watch_410_total"] == 1
+    # a fresh cursor still works
+    lst = api.get_json(SLICES)
+    with api.stream(
+            f"{SLICES}?watch=1&resourceVersion="
+            f"{lst['metadata']['resourceVersion']}&timeoutSeconds=0.2"):
+        pass
+
+
+def test_fabric_watch_bypasses_the_admission_gate(fabric):
+    """Long-lived watch streams must not eat the 429 admission capacity
+    the storms are measured against."""
+    srv = fabric(max_inflight=1)
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    with api.stream(f"{SLICES}?watch=1&resourceVersion=0"
+                    f"&timeoutSeconds=5", read_timeout_s=10):
+        # the single admission slot is still free for a plain request
+        node = api.get_json("/api/v1/nodes/n1")
+        assert node["metadata"]["name"] == "n1"
+    assert srv.snapshot()["throttled_total"] == 0
+
+
+# ------------------------------------------------------------ reflector
+
+
+class _Store:
+    """An idempotent materialized view + per-(name, rv) apply counts —
+    the double-apply detector."""
+
+    def __init__(self):
+        self.state = {}
+        self.applied = {}
+        self.syncs = 0
+
+    def on_event(self, evt):
+        obj = evt["object"]
+        name = obj["metadata"]["name"]
+        key = (name, obj["metadata"]["resourceVersion"])
+        self.applied[key] = self.applied.get(key, 0) + 1
+        if evt["type"] == "DELETED":
+            self.state.pop(name, None)
+        else:
+            self.state[name] = obj
+
+    def on_sync(self, items):
+        self.syncs += 1
+        self.state = {o["metadata"]["name"]: o for o in items}
+
+
+def test_reflector_resume_after_410_relists_without_loss_or_double_apply(
+        fabric, reflect):
+    """The kubeapi.watch.stale fault poisons the resume cursor; the 410
+    answer forces a relist. Nothing is lost (the view converges to the
+    fabric) and nothing is double-applied (absent the dup fault, no
+    (object, rv) event is delivered twice)."""
+    srv = fabric()
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    store = _Store()
+    ref = reflect(api, on_event=store.on_event, on_sync=store.on_sync)
+    _wait(lambda: ref.snapshot()["watch_streams_established_total"] >= 1)
+    _post_slice(api, "a")
+    _wait(lambda: "a" in store.state)
+    faults.arm("kubeapi.watch.stale", kind="drop", count=1)
+    try:
+        srv.close_watch_streams()      # force re-establishment
+        _wait(lambda: ref.snapshot()["watch_410_total"] >= 1,
+              msg="410 relist")
+        _post_slice(api, "b")
+        _wait(lambda: "b" in store.state)
+    finally:
+        faults.reset()
+    with srv._lock:
+        live = set(srv.slices)
+    assert set(store.state) == live
+    doubles = {k: n for k, n in store.applied.items() if n > 1}
+    assert not doubles, f"events double-applied: {doubles}"
+    snap = ref.snapshot()
+    assert snap["watch_breaks_total"] >= 1
+    assert snap["watch_relists_total"] >= 2     # initial + post-410
+
+
+def test_reflector_bookmark_only_stream_advances_the_cursor(
+        fabric, reflect):
+    """An idle stream's bookmarks advance the resume cursor without
+    data events, so the next rotation resumes at the server's rv and
+    never replays."""
+    srv = fabric()
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    _post_slice(api, "idle")           # history BEFORE the reflector
+    store = _Store()
+    ref = reflect(api, on_event=store.on_event, on_sync=store.on_sync,
+                  watch_timeout_s=0.5)
+    # wait through at least one clean rotation AND several bookmarks
+    _wait(lambda: (
+        ref.snapshot()["watch_streams_established_total"] >= 2
+        and ref.snapshot()["watch_bookmarks_total"] >= 3),
+        msg="bookmark-carrying rotations")
+    # zero data events were delivered, yet the cursor tracked the
+    # server's rv across rotations — no replay of the pre-list history
+    snap = ref.snapshot()
+    assert snap["watch_events_total"] == 0
+    assert snap["watch_relists_total"] == 1      # the seeding list only
+    with srv._lock:
+        assert ref._rv == srv._rv
+
+
+def test_reflector_slow_consumer_force_close_recovers_via_relist(
+        fabric, reflect):
+    """A consumer that cannot keep up overflows its bounded server-side
+    queue; the fabric drops the queue and force-closes the stream with
+    the 410-shaped ERROR event; the reflector relists and converges."""
+    srv = fabric(watch_queue_max=4)
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    store = _Store()
+    ref = reflect(api, on_event=store.on_event, on_sync=store.on_sync)
+    _wait(lambda: ref.snapshot()["watch_streams_established_total"] >= 1)
+    # the injected per-event delivery STALL makes the consumer slow:
+    # the producer outruns the 4-event queue bound while the handler
+    # sleeps inside a delivery
+    srv.arm_watch_chaos(stall_s=0.08, seed=3)
+    writer = ApiClient(srv.url, token_path="/nonexistent")
+    for i in range(24):
+        _post_slice(writer, f"flood-{i}")
+    _wait(lambda: srv.snapshot()["watch_force_closed_total"] >= 1,
+          msg="force close")
+    srv.disarm_watch_chaos()
+    _wait(lambda: ref.snapshot()["watch_410_total"] >= 1,
+          msg="410-shaped error → relist")
+    _wait(lambda: len(store.state) == 24, msg="relist convergence")
+    with srv._lock:
+        assert set(store.state) == set(srv.slices)
+
+
+def test_reflector_degrades_to_paced_relist_and_recovers(fabric, reflect):
+    """A fabric without watch support (400s every watch request) pushes
+    the reflector into the TYPED degraded mode: paced relists keep the
+    view converging, the gauge reads 1, and restoring watch support
+    heals it — event-driven again, gauge back to 0."""
+    srv = fabric(watch_enabled=False)
+    api = ApiClient(srv.url, token_path="/nonexistent")
+    store = _Store()
+    ref = reflect(api, on_event=store.on_event, on_sync=store.on_sync,
+                  degrade_after=2)
+    _wait(lambda: ref.snapshot()["watch_degraded_mode"] == 1,
+          msg="degraded entry")
+    assert ref.snapshot()["watch_degraded_entries_total"] == 1
+    assert not ref.stream_live()
+    relists0 = ref.snapshot()["watch_relists_total"]
+    _post_slice(api, "while-degraded")
+    _wait(lambda: "while-degraded" in store.state,
+          msg="paced-relist convergence")
+    assert ref.snapshot()["watch_relists_total"] > relists0
+    srv.watch_enabled = True           # the apiserver upgrade
+    _wait(lambda: ref.snapshot()["watch_degraded_mode"] == 0,
+          msg="degraded exit")
+    _post_slice(api, "after-recovery")
+    _wait(lambda: "after-recovery" in store.state)
+    assert ref.stream_live()
+
+
+def test_reflector_relist_failures_climb_the_degradation_ladder(reflect):
+    """A permanently failing LIST is a failing convergence plane: it
+    climbs the SAME typed degradation ladder as stream breaks
+    (watch_degraded_mode=1, paced polling) instead of looping on
+    backoff forever with the gauge still 0 — and a relist failure
+    never counts as a stream break."""
+    api = ApiClient("http://127.0.0.1:9", token_path="/nonexistent")
+    ref = reflect(api, degrade_after=2,
+                  backoff=BackoffPolicy(base_s=0.01, cap_s=0.05))
+    _wait(lambda: ref.snapshot()["watch_degraded_mode"] == 1,
+          msg="degraded entry from relist failures")
+    snap = ref.snapshot()
+    assert snap["watch_breaks_total"] == 0
+    assert snap["watch_relists_total"] == 0
+    assert not ref.stream_live()
+
+
+def test_reflector_error_event_first_line_still_climbs_the_ladder():
+    """A watch stream that establishes (200) but only ever delivers a
+    server-sent non-410 ERROR event is a FAILING stream: the ERROR
+    line itself must not count as stream health, or the ladder resets
+    every establishment and degraded mode can never engage."""
+    class Resp:
+        def __init__(self):
+            self._data = json.dumps(
+                {"type": "ERROR",
+                 "object": {"code": 500, "message": "boom"}}
+            ).encode() + b"\n"
+
+        def read1(self, n):
+            data, self._data = self._data, b""
+            return data
+
+    class Stream:
+        def __enter__(self):
+            return Resp()
+
+        def __exit__(self, *exc):
+            return False
+
+        def close(self):
+            pass
+
+    class Api:
+        def get_json(self, path):
+            return {"metadata": {"resourceVersion": "1"}, "items": []}
+
+        def stream(self, path, read_timeout_s=None):
+            return Stream()
+
+    ref = Reflector(Api(), SLICES, name="err-stream",
+                    poll_interval_s=0.02, degrade_after=2,
+                    backoff=BackoffPolicy(base_s=0.005, cap_s=0.02))
+    ref.start()
+    try:
+        _wait(lambda: ref.snapshot()["watch_degraded_mode"] == 1,
+              msg="degraded entry from ERROR-event streams")
+    finally:
+        ref.stop()
+    assert ref.snapshot()["watch_breaks_total"] >= 2
+    assert not ref.stream_live()
+
+
+def test_reflector_stop_unblocks_a_stream_mid_establishment():
+    """stop() must be prompt even when the watch stream is still
+    ESTABLISHING (parked in connect/getresponse against a stalled
+    apiserver/LB): the stream handle is published before establishment
+    and close() latches, so stop() tears it down NOW instead of the
+    thread outliving stop() by a full read timeout."""
+    import threading
+
+    established = threading.Event()
+
+    class Stream:
+        def __init__(self):
+            self.closed = threading.Event()
+
+        def __enter__(self):
+            established.set()
+            # park like getresponse() against a stalled LB until
+            # close() wakes us
+            self.closed.wait(timeout=30)
+            raise ApiError("torn by close", code=0)
+
+        def __exit__(self, *exc):
+            return False
+
+        def close(self):
+            self.closed.set()
+
+    class Api:
+        def get_json(self, path):
+            return {"metadata": {"resourceVersion": "1"}, "items": []}
+
+        def stream(self, path, read_timeout_s=None):
+            return Stream()
+
+    ref = Reflector(Api(), SLICES, name="parked",
+                    poll_interval_s=0.05,
+                    backoff=BackoffPolicy(base_s=0.01, cap_s=0.02))
+    ref.start()
+    assert established.wait(5), "stream never began establishing"
+    t0 = time.monotonic()
+    ref.stop()
+    assert time.monotonic() - t0 < 5, "stop() was not prompt"
+    assert not ref._thread.is_alive()
+
+
+def test_reflector_relist_404_reresolves_a_callable_path():
+    """A 404 on LIST may mean the collection's API version was dropped
+    by a control-plane upgrade: the on_list_404 hook invalidates the
+    owner's cached version and the CALLABLE path re-resolves on the
+    next attempt — the reflector recovers instead of 404ing forever."""
+    state = {"version": "v1beta1", "listed": []}
+
+    class Api:
+        def get_json(self, path):
+            state["listed"].append(path)
+            if "v1beta1" in path:
+                raise ApiError("dropped version", code=404)
+            return {"metadata": {"resourceVersion": "5"}, "items": []}
+
+        def stream(self, path, read_timeout_s=None):
+            raise ApiError("watch unsupported", code=400)
+
+    def resolve():
+        return (f"/apis/resource.k8s.io/{state['version']}"
+                "/resourceslices")
+
+    def on_404():
+        state["version"] = "v1"
+
+    ref = Reflector(Api(), resolve, on_list_404=on_404, name="re404",
+                    poll_interval_s=0.05,
+                    backoff=BackoffPolicy(base_s=0.01, cap_s=0.05))
+    ref.start()
+    try:
+        _wait(lambda: ref.snapshot()["watch_relists_total"] >= 1,
+              msg="relist on the re-resolved path")
+    finally:
+        ref.stop()
+    assert any("/v1/" in p for p in state["listed"]), state["listed"]
+    assert ref.path.endswith("/v1/resourceslices")
+
+
+# ------------------------------------------------- DRA driver integration
+
+
+@pytest.fixture()
+def watch_fleet():
+    sims = []
+
+    def build(**kw):
+        kw.setdefault("n_nodes", 2)
+        kw.setdefault("latency_s", 0.0)
+        kw.setdefault("max_inflight", 0)
+        kw.setdefault("watch", True)
+        kw.setdefault("watch_resync_s", 30.0)
+        kw.setdefault("watch_poll_s", 0.2)
+        kw.setdefault("watch_timeout_s", 1.0)
+        sim = FleetSim(**kw)
+        sims.append(sim)
+        return sim
+
+    yield build
+    for sim in sims:
+        sim.stop()
+
+
+def test_dra_watch_repairs_wipe_and_divergence_exactly_once(watch_fleet):
+    """THE convergence acceptance: a slice wiped behind the driver is
+    healed by a watch-triggered repair (generation sequence CONTINUED,
+    not reset — the exactly-once audit must stay green), and a foreign
+    writer's mutation is repaired back to the desired projection."""
+    sim = watch_fleet()
+    assert sim.boot_storm()["published_ok"] == 2
+    node = sim.nodes[0]
+    name = node.driver.slice_name()
+    api = node.driver.api
+    # wipe
+    api.delete(f"{SLICES}/{name}")
+    _wait(lambda: name in sim.apiserver.slices, msg="wipe healed")
+    assert node.driver.watch_repairs.value >= 1
+    # foreign mutation (impersonating writer bumps the generation)
+    live = api.get_json(f"{SLICES}/{name}")
+    live["spec"]["devices"] = live["spec"]["devices"][:1]
+    live["spec"]["pool"]["generation"] += 1
+    api.put_json(f"{SLICES}/{name}", live)
+
+    def converged():
+        try:
+            return sim.assert_converged()
+        except AssertionError:
+            return False
+
+    _wait(converged, msg="divergence healed")
+    audit = sim.apiserver.exactly_once_audit()
+    assert audit["exactly_once"], audit
+
+
+def test_dra_unchanged_republish_skips_reads_only_while_watch_live(
+        watch_fleet):
+    """Steady-state read/repair churn: with a live stream an unchanged
+    republish pays ZERO fabric reads (counted skip); with the watch
+    stopped the liveness GET comes back — the ladder never trades a
+    read away for a blind spot."""
+    sim = watch_fleet(n_nodes=1)
+    sim.boot_storm()
+    node = sim.nodes[0]
+    _wait(node.driver._watch_live, msg="stream live")
+    reads0 = sim.apiserver.snapshot()["slice_reads_total"]
+    assert node.driver.publish_resource_slices()
+    assert sim.apiserver.snapshot()["slice_reads_total"] == reads0
+    assert node.driver.publish_stats["watch_read_skips"] == 1
+    # stop the watch: the next unchanged republish GETs again
+    node.driver._slice_watch.stop()
+    node.driver._slice_watch = None
+    assert node.driver.publish_resource_slices()
+    assert sim.apiserver.snapshot()["slice_reads_total"] == reads0 + 1
+    assert node.driver.publish_stats["watch_read_skips"] == 1
+
+
+def test_dra_deferred_watch_evidence_forces_the_liveness_get(watch_fleet):
+    """A DELETED observation arriving while a publish holds the lock is
+    DEFERRED, not dropped: the next unchanged-projection publish pays
+    its classic liveness GET (healing a wipe within one republish
+    period) instead of taking the watch_read_skips fast path — and the
+    consumed deferral restores the fast path afterwards."""
+    sim = watch_fleet(n_nodes=1)
+    sim.boot_storm()
+    node = sim.nodes[0]
+    d = node.driver
+    _wait(d._watch_live, msg="stream live")
+    with d._publish_lock:
+        d._on_slice_watch_event({"type": "DELETED", "object": {
+            "metadata": {"name": d.slice_name(),
+                         "resourceVersion": str(10 ** 9)}}})
+        # never acted on against the half-updated window
+        assert d.watch_repairs.value == 0
+    assert d._watch_evidence_pending()
+    # a FAILED attempt must keep the deferral for the retry: the
+    # republish retry would otherwise skip straight back over it
+    faults.arm("kubeapi.request", kind="error", count=1)
+    try:
+        assert not d.publish_resource_slices()
+    finally:
+        faults.reset()
+    assert d._watch_evidence_pending()
+    reads0 = sim.apiserver.snapshot()["slice_reads_total"]
+    assert d.publish_resource_slices()
+    assert sim.apiserver.snapshot()["slice_reads_total"] == reads0 + 1
+    assert d.publish_stats["watch_read_skips"] == 0
+    assert not d._watch_evidence_pending()
+    assert d.publish_resource_slices()
+    assert sim.apiserver.snapshot()["slice_reads_total"] == reads0 + 1
+    assert d.publish_stats["watch_read_skips"] == 1
+
+
+def test_dra_duplicate_watch_deliveries_are_idempotent_on_inventory(
+        watch_fleet):
+    """kubeapi.watch.dup fires on every event: duplicates must trigger
+    NO repairs (an event matching the desired projection is a no-op),
+    the inventory converges, and the write audit stays exactly-once."""
+    sim = watch_fleet()
+    sim.boot_storm()
+    node = sim.nodes[0]
+    faults.arm("kubeapi.watch.dup", kind="drop", count=None,
+               probability=1.0)
+    try:
+        # one flip at a time, letting deliveries drain against a STABLE
+        # desired state between writes — so any repair the duplicates
+        # trigger is attributable to the duplicates, not to an event
+        # racing an in-flight publish
+        for healthy in (False, True):
+            node.plugin.set_devices_health([node.bdfs[0]],
+                                           healthy=healthy, source="t")
+            _wait(lambda: sim.watch_totals()["watch_events_total"] > 0)
+            time.sleep(0.3)
+        _wait(lambda: sim.watch_totals()
+              ["watch_duplicate_deliveries_total"] >= 2, msg="dups")
+    finally:
+        faults.reset()
+    totals = sim.watch_totals()
+    assert totals["watch_duplicate_deliveries_total"] >= 2, totals
+    assert sim.assert_converged()
+    assert sim.apiserver.exactly_once_audit()["exactly_once"]
+    # duplicates never read as divergence: no repairs fired
+    assert totals.get("watch_repairs_total", 0) == 0, totals
+
+
+def test_watch_stats_zero_surface_without_reconciler(short_root):
+    """A driver in pre-watch polling mode still serves the full
+    fixed-key watch surface (zeros, enabled: False) so /status paths
+    and the counter-drift audit always resolve."""
+    from tests.fakehost import FakeChip, FakeHost
+    from tests.test_dra import FakeApiServer, make_driver
+    from tpu_device_plugin.config import Config
+
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", device_id="0063",
+                           iommu_group="11"))
+    cfg = Config().with_root(host.root)
+    apiserver = FakeApiServer()
+    try:
+        driver = make_driver(cfg, apiserver)
+        stats = driver.watch_stats()
+        assert stats["enabled"] is False
+        for key in ("watch_streams_active", "watch_events_total",
+                    "watch_relists_total", "watch_resyncs_total",
+                    "watch_degraded_mode", "watch_repairs_total"):
+            assert stats[key] == 0
+    finally:
+        apiserver.stop()

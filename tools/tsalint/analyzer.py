@@ -427,6 +427,10 @@ class _FunctionWalker(ast.NodeVisitor):
                 node.func.attr in ("join", "cancel"):
             target = _render(node.func.value) or ""
             attr = self._self_attr(node.func.value)
+            if attr is None and isinstance(node.func.value, ast.Name):
+                # loop variable over a tracked list: `for t in
+                # self.X: t.join(...)` joins self.X's members
+                attr = self._loop_aliases.get(node.func.value.id)
             if attr is not None:
                 target = f"self.{attr}"
             if node.func.attr == "join":
@@ -529,7 +533,30 @@ class _FunctionWalker(ast.NodeVisitor):
     def visit_Lambda(self, node: ast.Lambda) -> None:
         pass   # opaque: runs later, elsewhere
 
+    def visit_For(self, node: ast.For) -> None:
+        # `for t in self.X:` — inside the loop body, `t` aliases an
+        # element of self.X. This is the tracked-thread-LIST pattern
+        # (a worker pool appends its threads to one attribute and a
+        # stop path loops the list joining each member), which the
+        # thread-lifecycle rule must credit like a direct attr join.
+        attr = self._self_attr(node.iter)
+        scoped = attr is not None and isinstance(node.target, ast.Name)
+        if scoped:
+            prev = self._loop_aliases.get(node.target.id)
+            self._loop_aliases[node.target.id] = attr
+        self.generic_visit(node)
+        if scoped:
+            # the alias means "an element of self.X" only INSIDE the
+            # loop body: leaking it past the loop would credit a later
+            # unrelated reuse of the name (t = Timer(); ... t.cancel())
+            # to the wrong attribute
+            if prev is None:
+                self._loop_aliases.pop(node.target.id, None)
+            else:
+                self._loop_aliases[node.target.id] = prev
+
     def run(self) -> _FuncFacts:
+        self._loop_aliases: Dict[str, str] = {}
         for stmt in getattr(self._func, "body", []):
             self.visit(stmt)
         self._finish_threads()
@@ -563,6 +590,18 @@ class _FunctionWalker(ast.NodeVisitor):
                     base = _render(tgt.value)
                     if base:
                         daemon_sets.append(base)
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call):
+                # self.X.append(t) — the tracked-thread-LIST binding
+                # (joined by a stop path's `for t in self.X: t.join()`)
+                call = stmt.value
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "append" and call.args and \
+                        isinstance(call.args[0], ast.Name):
+                    recv = self._assign_target(call.func.value)
+                    if recv and recv.startswith("self."):
+                        assigns.append(
+                            (f"{call.args[0].id}->{recv}", stmt.lineno))
         for site in self.facts.threads:
             direct = [a for a, line in assigns if line == site.line]
             if direct:

@@ -167,6 +167,14 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     "kubeapi.PublishPacer": {
         "stats[*]": "kubeapi.PublishPacer._cond",
     },
+    # watch-stream reflector (ISSUE 12): stream/event/relist/resync/
+    # degradation counters mutate under the reflector's own lock;
+    # snapshot() reads them lock-free (fixed-key C-atomic dict copy).
+    # DraDriver.watch_repairs is an epoch.AtomicCounter (lock-free
+    # owned, no entry by design — like ApiClient.throttled_total).
+    "kubeapi.Reflector": {
+        "stats[*]": "kubeapi.Reflector._lock",
+    },
     "resilience.BackoffPolicy": {
         "attempts": "resilience.BackoffPolicy._lock",
         "total_attempts": "resilience.BackoffPolicy._lock",

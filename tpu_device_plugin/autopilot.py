@@ -1,0 +1,761 @@
+"""Continuous fleet autopilot — the overlapping-storm soak driver (ISSUE 12).
+
+Real fleets never settle: boot storms land while claims churn, chips
+fall off the bus mid-migration, rolling upgrades overlap defrag waves.
+The PR 9-11 storms each exercised ONE shape at a time with a quiet
+fleet around it; this module runs them ALL at once, for as long as
+asked, against the watch-stream fabric — and checks the soak invariants
+CONTINUOUSLY (fleetsim.fleet_invariants), not only at the end:
+
+  - CLAIM STORMS: worker pools attach + detach claim batches on random
+    nodes (the 100k-claim-event engine of the r14 soak);
+  - MULTI-HOST SLICES: placement-engine claims prepared across nodes,
+    torn down, residue-audited (exactly-once multiclaim commits);
+  - FLIP WAVES: health flip storms whose publishes must coalesce;
+  - HOT-UNPLUGS: surprise removals orphan claims, the orphans are
+    cleaned up kubelet-style, the chip replugs and readmits;
+  - DEFRAG WAVES: advisor proposals applied via the PR 7 migration
+    handoff (the cross-node flight-recorder claim story);
+  - ROLLING UPGRADES: drain → driver rebuild against the same
+    checkpoint → restore, in waves (claims must survive every wave);
+  - BOOT STORMS: republish waves across node groups.
+
+Chaos rides on top: the fabric's watch-stream chaos (breaks, duplicate
+deliveries, stalls — FleetApiServer.arm_watch_chaos) plus the
+`kubeapi.watch` / `kubeapi.watch.dup` / `kubeapi.watch.stale` fault
+sites fire THROUGHOUT a run with `watch_faults=True`, so every
+convergence claim is measured under the event-driven, always-degrading
+conditions the ISSUE names.
+
+Concurrency model: one try-acquired lock per node serializes the
+disruptive ops on that node (upgrade's driver swap, unplug's device
+removal) against claim batches, while storms overlap freely ACROSS
+nodes; multi-node ops (multiclaim, defrag, upgrade waves) additionally
+serialize on one fleet lock and take their node locks in index order —
+a static lock order, no deadlocks. These are soak-harness locks, not
+daemon locks: the daemon's own concurrency is exactly what the storms
+exercise.
+
+Used by `bench.py --autopilot` (docs/bench_autopilot_r14.json), the CI
+autopilot smoke leg, and `make soak-autopilot`.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from concurrent import futures
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import faults
+from . import placement
+from . import trace
+from .fleetsim import FleetSim, fleet_invariants
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class AutopilotConfig:
+    """Knobs for one soak run. The defaults are the CI smoke shape
+    (N=8, ~60 s); `bench.py --autopilot` scales them to the r14
+    acceptance run (256 nodes, ≥100k claim events)."""
+    nodes: int = 8
+    devices_per_node: int = 4
+    duration_s: float = 60.0
+    # run until BOTH the duration elapsed and this many claim events
+    # (prepares + unprepares + orphans) landed; 0 = duration-bound only
+    claim_event_target: int = 0
+    max_wall_s: float = 0.0          # 0 = duration_s * 6 + 120
+    seed: int = 1337
+    latency_s: float = 0.0
+    max_inflight: int = 0
+    # storm worker pools (0 disables a storm type)
+    claim_workers: int = 4
+    claims_per_batch: int = 4
+    multiclaim_workers: int = 1
+    flip_workers: int = 1
+    unplug_workers: int = 1
+    migration_workers: int = 1
+    defrag_workers: int = 1
+    upgrade_workers: int = 1
+    upgrade_wave_size: int = 2
+    boot_workers: int = 1
+    boot_wave_size: int = 4
+    pinned_per_nodes: int = 4        # one long-lived claim per K nodes
+    invariant_interval_s: float = 2.0
+    # watch plane + chaos
+    watch: bool = True
+    watch_resync_s: float = 10.0
+    watch_poll_s: float = 0.5
+    # IDLE-COST knobs, scaled with fleet size: a stream re-establishes
+    # every watch_timeout_s and every idle stream emits a bookmark per
+    # bookmark_interval_s — at 256 nodes the N=8 defaults would spend
+    # the whole GIL on rotation/bookmark churn (128 TCP setups/s + 512
+    # bookmark parses/s) instead of claim events
+    watch_timeout_s: float = 2.0
+    bookmark_interval_s: float = 0.5
+    watch_chaos: bool = True         # fabric-side break/dup/stall
+    watch_chaos_break_p: float = 0.02
+    watch_chaos_dup_p: float = 0.05
+    watch_chaos_stall_s: float = 0.0
+    watch_faults: bool = True        # kubeapi.watch* fault sites
+    watch_fault_p: float = 0.02
+    shapes: tuple = ("1x2", "2x2")   # multiclaim shapes
+
+
+class FleetAutopilot:
+    """Drive a FleetSim through overlapping storms with continuous
+    invariant checking. run() returns the soak report dict; failures
+    are REPORTED (report["ok"] is False with the violations), and also
+    raised at the end unless raise_on_violation=False."""
+
+    def __init__(self, cfg: AutopilotConfig,
+                 sim: Optional[FleetSim] = None) -> None:
+        self.cfg = cfg
+        self._own_sim = sim is None
+        self.sim = sim or FleetSim(
+            n_nodes=cfg.nodes, devices_per_node=cfg.devices_per_node,
+            latency_s=cfg.latency_s, max_inflight=cfg.max_inflight,
+            seed=cfg.seed, watch=cfg.watch,
+            watch_resync_s=cfg.watch_resync_s,
+            watch_poll_s=cfg.watch_poll_s,
+            watch_timeout_s=cfg.watch_timeout_s,
+            bookmark_interval_s=cfg.bookmark_interval_s)
+        self._stop_evt = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # harness locks (see the module docstring's concurrency model)
+        self._node_locks = [threading.Lock() for _ in self.sim.nodes]
+        self._fleet_lock = threading.Lock()
+        self._lock = threading.Lock()          # counters + shared state
+        self.counters: Dict[str, int] = {
+            "claim_events": 0, "prepares": 0, "unprepares": 0,
+            "claim_errors_retried": 0, "claim_errors_final": 0,
+            "multiclaims_placed": 0, "multiclaims_unplaceable": 0,
+            "multiclaims_rolled_back": 0, "flip_storms": 0,
+            "unplugs": 0, "orphans": 0, "orphans_cleaned": 0,
+            "readmits": 0, "migrations": 0, "migrations_skipped": 0,
+            "defrag_moves": 0, "defrag_skipped": 0,
+            "defrag_recoveries": 0, "upgrades": 0, "republish_waves": 0,
+            "invariant_checks": 0,
+        }
+        self._wave_seq = 0
+        self._pinned: Dict[str, str] = {}      # uid -> node name
+        self._torn_down: List[str] = []        # multiclaim uids torn down
+        self.violations: List[str] = []
+        self._story: Optional[dict] = None     # one migrated claim's spans
+
+    # ------------------------------------------------------------ helpers
+
+    def _count(self, **deltas: int) -> None:
+        with self._lock:
+            for key, d in deltas.items():
+                self.counters[key] += d
+
+    def _next_wave(self) -> int:
+        with self._lock:
+            self._wave_seq += 1
+            return self._wave_seq
+
+    def _running(self) -> bool:
+        return not self._stop_evt.is_set()
+
+    def _pick_node(self, rng: random.Random):
+        i = rng.randrange(len(self.sim.nodes))
+        return i, self.sim.nodes[i]
+
+    def _try_node(self, i: int) -> bool:
+        return self._node_locks[i].acquire(blocking=False)
+
+    def _release_node(self, i: int) -> None:
+        self._node_locks[i].release()
+
+    def _spawn(self, fn, name: str, *args) -> None:
+        def guarded() -> None:
+            try:
+                fn(*args)
+            except Exception as exc:
+                # a dead storm worker IS a soak failure: recording it
+                # as a violation keeps the report honest (a silently
+                # ended upgrade storm would otherwise leave ok=True on
+                # the strength of its earlier waves)
+                log.exception("autopilot: worker %s died", name)
+                with self._lock:
+                    self.violations.append(f"worker {name} died: {exc!r}")
+
+        thread = threading.Thread(target=guarded, daemon=True,
+                                  name=f"autopilot-{name}")
+        self._threads.append(thread)
+        thread.start()
+
+    def _retry_claims(self, op, uids: List[str],
+                      attempts: int = 3) -> List[str]:
+        """The shared per-claim retry contract every storm uses: run a
+        fleet claim op (attach/detach) until each claim's error clears
+        or `attempts` rounds pass, counting retries and persistent
+        failures. Returns the claims that SUCCEEDED — stragglers were
+        counted `claim_errors_final` and stay wherever the op left
+        them; callers must never pretend they completed."""
+        succeeded: List[str] = []
+        pending = list(uids)
+        for _attempt in range(attempts):
+            resp = op(pending)
+            failed = [u for u in pending if resp.claims[u].error]
+            failed_set = set(failed)
+            succeeded += [u for u in pending if u not in failed_set]
+            if not failed:
+                return succeeded
+            self._count(claim_errors_retried=len(failed))
+            pending = failed
+            time.sleep(0.01)
+        self._count(claim_errors_final=len(pending))
+        return succeeded
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        for thread in self._threads:
+            thread.join(timeout=30)
+
+    # ------------------------------------------------------------- storms
+
+    def _claim_worker(self, wid: int) -> None:
+        cfg = self.cfg
+        rng = random.Random((cfg.seed << 8) ^ wid)
+        while self._running():
+            i, node = self._pick_node(rng)
+            if not self._try_node(i):
+                time.sleep(0.002)
+                continue
+            try:
+                uids = node.register_claims(cfg.claims_per_batch,
+                                            wave=self._next_wave())
+                succeeded = self._retry_claims(node.attach, uids)
+                if succeeded:
+                    self._count(prepares=len(succeeded),
+                                claim_events=len(succeeded))
+                done: List[str] = []
+                if succeeded:
+                    done = self._retry_claims(node.detach, succeeded)
+                    self._count(unprepares=len(done),
+                                claim_events=len(done))
+                # deregister only claims the node no longer holds
+                # prepared: never-attached ones and clean detaches. A
+                # detach straggler stays in the fabric registry so the
+                # checkpoint/fabric agreement invariant keeps seeing a
+                # consistent pair instead of a phantom "lost claim".
+                for uid in uids:
+                    if uid not in succeeded or uid in done:
+                        self.sim.apiserver.remove_claim("fleet", uid)
+            finally:
+                self._release_node(i)
+
+    def _multiclaim_worker(self, wid: int) -> None:
+        cfg = self.cfg
+        rng = random.Random((cfg.seed << 9) ^ wid)
+        while self._running():
+            shape = rng.choice(cfg.shapes)
+            uid = f"mc-{self._next_wave()}"
+            with self._fleet_lock:
+                res = self.sim.prepare_slice(shape, uid, best_effort=True)
+                if res.get("placed"):
+                    shards = res["shards"]
+                    self._count(
+                        multiclaims_placed=1,
+                        prepares=len(shards), claim_events=len(shards))
+                    # tear straight back down (the storm's job is churn;
+                    # capacity pinning is the pinned claims' job)
+                    by_name = self.sim._node_by_name()
+                    all_clean = True
+                    for node_name, _raws in shards:
+                        sub = f"{uid}-{node_name}"
+                        resp = by_name[node_name].detach([sub])
+                        if resp.claims[sub].error:
+                            # leave the sub-claim registered: its
+                            # checkpoint entry survives, and the residue
+                            # audit must not expect a torn-down uid
+                            all_clean = False
+                            continue
+                        self._count(unprepares=1, claim_events=1)
+                        self.sim.apiserver.remove_claim("fleet", sub)
+                    if all_clean:
+                        with self._lock:
+                            self._torn_down.append(uid)
+                elif res.get("rolled_back"):
+                    self._count(multiclaims_rolled_back=1)
+                    with self._lock:
+                        self._torn_down.append(uid)
+                else:
+                    self._count(multiclaims_unplaceable=1)
+            time.sleep(rng.uniform(0.01, 0.1))
+
+    def _flip_worker(self, wid: int) -> None:
+        rng = random.Random((self.cfg.seed << 10) ^ wid)
+        while self._running():
+            i, node = self._pick_node(rng)
+            if not self._try_node(i):
+                time.sleep(0.002)
+                continue
+            try:
+                node.flip_storm(rng.randrange(2, 6))
+                self._count(flip_storms=1)
+            finally:
+                self._release_node(i)
+            time.sleep(rng.uniform(0.01, 0.1))
+
+    def _unplug_worker(self, wid: int) -> None:
+        rng = random.Random((self.cfg.seed << 11) ^ wid)
+        while self._running():
+            i, node = self._pick_node(rng)
+            if not self._try_node(i):
+                time.sleep(0.002)
+                continue
+            try:
+                bdf = rng.choice(node.bdfs)
+                on_device = [
+                    uid for uid, entry in list(
+                        node.driver._checkpoint.items())
+                    if bdf in entry.get("device_raws", ())
+                    and "orphaned" not in entry]
+                node.driver.on_devices_gone([(bdf, on_device)])
+                self._count(unplugs=1, orphans=len(on_device),
+                            claim_events=len(on_device))
+                # kubelet-style cleanup of the orphaned claims, then the
+                # replug readmission (same registry = same identity).
+                # Only claims whose detach SUCCEEDED count as cleaned /
+                # leave the fabric — a failed unprepare keeps both its
+                # checkpoint entry and its fabric record, so the quiesce
+                # orphan check points at a real leak, not at counters
+                # that already claimed the cleanup happened
+                if on_device:
+                    cleaned = self._retry_claims(node.detach, on_device)
+                    for uid in cleaned:
+                        self.sim.apiserver.remove_claim("fleet", uid)
+                    with self._lock:
+                        for uid in cleaned:
+                            self._pinned.pop(uid, None)
+                    self._count(orphans_cleaned=len(cleaned))
+                node.driver.set_inventory(node.driver.registry,
+                                          node.driver.generations)
+                node.driver.publish_resource_slices()
+                self._count(readmits=1)
+            finally:
+                self._release_node(i)
+            time.sleep(rng.uniform(0.05, 0.25))
+
+    def _migration_worker(self, wid: int) -> None:
+        """VMI migration storm: move a long-lived (pinned) claim to a
+        different node through the PR 7 handoff machinery — unprepare at
+        the source emits the durable record, the destination's prepare
+        validates it (claim UID + allocation generation). The first
+        completed migration's /debug/flight-shaped claim story (spans
+        from BOTH nodes' drivers) is captured into the soak report."""
+        rng = random.Random((self.cfg.seed << 15) ^ wid)
+        by_name = self.sim._node_by_name()
+        while self._running():
+            time.sleep(rng.uniform(0.1, 0.4))
+            with self._lock:
+                pinned = list(self._pinned.items())
+            if not pinned:
+                continue
+            uid, src_name = rng.choice(pinned)
+            src = by_name.get(src_name)
+            others = [n for n in self.sim.nodes if n.name != src_name]
+            if src is None or not others:
+                continue
+            dst = rng.choice(others)
+            with self._fleet_lock:
+                entry = dict(src.driver._checkpoint).get(uid)
+                free = sorted(dst.host_view().free)
+                if entry is None or not free:
+                    self._count(migrations_skipped=1)
+                    continue
+                mig = {"claim": uid,
+                       "devices": list(entry.get("device_raws", ())),
+                       "target_devices": free[:max(
+                           1, len(entry.get("device_raws", ())))]}
+                locks = sorted({self.sim.nodes.index(src),
+                                self.sim.nodes.index(dst)})
+                for li in locks:
+                    self._node_locks[li].acquire()
+                try:
+                    moved = self._apply_one_migration(
+                        src, dst, mig, counter="migrations")
+                finally:
+                    for li in reversed(locks):
+                        self._node_locks[li].release()
+                if not moved:
+                    self._count(migrations_skipped=1)
+
+    def _defrag_worker(self, wid: int) -> None:
+        cfg = self.cfg
+        rng = random.Random((cfg.seed << 12) ^ wid)
+        by_name = self.sim._node_by_name()
+        while self._running():
+            time.sleep(rng.uniform(0.05, 0.3))
+            with self._fleet_lock:
+                # propose over a bounded node sample: a 256-node fleet's
+                # full cross-product proposal is not the point here
+                sample = rng.sample(self.sim.nodes,
+                                    min(8, len(self.sim.nodes)))
+                try:
+                    prop = placement.propose_defrag(
+                        placement.parse_shape(rng.choice(cfg.shapes)),
+                        [n.host_view() for n in sample])
+                except Exception:
+                    continue
+                moves = [m for m in prop.get("migrations", ())
+                         if m.get("target_node") is not None]
+                if not moves or prop.get("placeable"):
+                    self._count(defrag_skipped=1)
+                    continue
+                mig = moves[0]
+                src = by_name[mig["source_node"]]
+                dst = by_name[mig["target_node"]]
+                locks = sorted({self.sim.nodes.index(src),
+                                self.sim.nodes.index(dst)})
+                for li in locks:
+                    self._node_locks[li].acquire()
+                try:
+                    if not self._apply_one_migration(src, dst, mig):
+                        self._count(defrag_skipped=1)
+                finally:
+                    for li in reversed(locks):
+                        self._node_locks[li].release()
+
+    def _apply_one_migration(self, src, dst, mig: dict,
+                             counter: str = "defrag_moves") -> bool:
+        uid = mig["claim"]
+        resp = src.detach([uid])
+        if resp.claims[uid].error:
+            return False
+        record = src.driver.export_handoff(uid)
+        names = dst.host_view().names
+        try:
+            devices = [{"device": names[r]}
+                       for r in mig["target_devices"]]
+        except KeyError:
+            devices = None
+        if devices is not None:
+            self.sim.apiserver.add_claim(
+                "fleet", uid, uid, dst.driver.driver_name, devices)
+            if record is not None:
+                dst.driver.import_handoff(record)
+            resp = dst.attach([uid])
+            if not resp.claims[uid].error:
+                self._count(prepares=1, unprepares=1, claim_events=2,
+                            **{counter: 1})
+                with self._lock:
+                    if uid in self._pinned:
+                        self._pinned[uid] = dst.name
+                    # the report's sample story must SPAN node
+                    # boundaries (prepare on A, unprepare, prepare
+                    # on B) — intra-node defrag moves don't qualify
+                    if self._story is None and src.name != dst.name:
+                        spans = trace.snapshot(claim=uid, limit=64)
+                        self._story = {
+                            "claim": uid, "source": src.name,
+                            "target": dst.name, "spans": len(spans),
+                            "ops": sorted({s.get("op") for s in spans}),
+                        }
+                return True
+        # recovery: the destination refused (churn won the race) — put
+        # the claim back at the source so nothing is lost
+        self.sim.apiserver.add_claim(
+            "fleet", uid, uid, src.driver.driver_name,
+            [{"device": src.host_view().names[r]}
+             for r in mig["devices"]])
+        back = src.attach([uid])
+        if back.claims[uid].error:
+            with self._lock:
+                self.violations.append(
+                    f"migration lost claim {uid}: "
+                    f"{back.claims[uid].error}")
+        else:
+            self._count(defrag_recoveries=1)
+        return False
+
+    def _upgrade_worker(self, wid: int) -> None:
+        cfg = self.cfg
+        rng = random.Random((cfg.seed << 13) ^ wid)
+        while self._running():
+            time.sleep(rng.uniform(0.2, 0.8))
+            start = rng.randrange(len(self.sim.nodes))
+            # dedupe: a wave wider than the fleet wraps onto the same
+            # indices, and acquiring a non-reentrant node lock twice
+            # would deadlock this worker INSIDE the fleet lock
+            wave = sorted({(start + k) % len(self.sim.nodes)
+                           for k in range(cfg.upgrade_wave_size)})
+            with self._fleet_lock:
+                for i in wave:
+                    self._node_locks[i].acquire()
+                try:
+                    for i in wave:
+                        node = self.sim.nodes[i]
+                        node.drain()
+                        node.upgrade()     # asserts claims survived
+                        node.restore()
+                        self._count(upgrades=1)
+                finally:
+                    for i in reversed(wave):
+                        self._node_locks[i].release()
+
+    def _boot_worker(self, wid: int) -> None:
+        cfg = self.cfg
+        rng = random.Random((cfg.seed << 14) ^ wid)
+        while self._running():
+            time.sleep(rng.uniform(0.2, 0.8))
+            group = rng.sample(self.sim.nodes,
+                               min(cfg.boot_wave_size,
+                                   len(self.sim.nodes)))
+            with futures.ThreadPoolExecutor(
+                    max_workers=len(group)) as pool:
+                list(pool.map(
+                    lambda n: n.driver.publish_resource_slices(), group))
+            self._count(republish_waves=1)
+
+    def _invariant_worker(self) -> None:
+        while self._running():
+            self._stop_evt.wait(timeout=self.cfg.invariant_interval_s)
+            with self._lock:
+                torn = list(self._torn_down)
+            report = fleet_invariants(self.sim, torn_down_multiclaims=torn)
+            self._count(invariant_checks=1)
+            if not report["ok"]:
+                with self._lock:
+                    self.violations.extend(report["violations"])
+                log.error("autopilot invariants violated: %s",
+                          report["violations"])
+
+    # --------------------------------------------------------------- run
+
+    def _pin_claims(self) -> None:
+        """Long-lived single-chip claims (defrag material / unplug
+        victims), one per cfg.pinned_per_nodes nodes."""
+        for i in range(0, len(self.sim.nodes), self.cfg.pinned_per_nodes):
+            node = self.sim.nodes[i]
+            free = sorted(node.host_view().free)
+            if not free:
+                continue
+            uid = f"pin-{node.name}"
+            try:
+                node.claim_devices(uid, [free[0]])
+            except AssertionError:
+                continue
+            self._count(prepares=1, claim_events=1)
+            with self._lock:
+                self._pinned[uid] = node.name
+
+    def _teardown_pinned(self) -> None:
+        by_name = self.sim._node_by_name()
+        with self._lock:
+            pinned = dict(self._pinned)
+            self._pinned.clear()
+        for uid, node_name in pinned.items():
+            node = by_name.get(node_name)
+            if node is None:
+                continue
+            # same contract as the storm workers: the fabric record
+            # leaves only with a SUCCESSFUL detach — removing it for a
+            # still-prepared claim would manufacture a phantom "lost
+            # claim" in the final invariant pass
+            if self._retry_claims(node.detach, [uid]):
+                self._count(unprepares=1, claim_events=1)
+                self.sim.apiserver.remove_claim("fleet", uid)
+
+    def run(self, raise_on_violation: bool = True) -> dict:
+        # the owned sim must die even when the storm phase raises —
+        # leaked reflector/fabric threads and the tempdir otherwise
+        # outlive the failure and fail unrelated later tests through
+        # the conftest owned-thread leak guard
+        try:
+            return self._run(raise_on_violation)
+        finally:
+            if self._own_sim:
+                self.sim.stop()
+
+    def _run(self, raise_on_violation: bool) -> dict:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        max_wall = cfg.max_wall_s or (cfg.duration_s * 6 + 120)
+        try:
+            boot = self.sim.boot_storm()
+            if cfg.watch_chaos:
+                self.sim.apiserver.arm_watch_chaos(
+                    break_p=cfg.watch_chaos_break_p,
+                    dup_p=cfg.watch_chaos_dup_p,
+                    stall_s=cfg.watch_chaos_stall_s, seed=cfg.seed)
+            if cfg.watch_faults:
+                faults.arm("kubeapi.watch", kind="error", count=None,
+                           probability=cfg.watch_fault_p)
+                faults.arm("kubeapi.watch.dup", kind="drop", count=None,
+                           probability=cfg.watch_fault_p * 2)
+                faults.arm("kubeapi.watch.stale", kind="drop", count=None,
+                           probability=cfg.watch_fault_p / 2)
+            self._pin_claims()
+            for w in range(cfg.claim_workers):
+                self._spawn(self._claim_worker, f"claims-{w}", w)
+            for w in range(cfg.multiclaim_workers):
+                self._spawn(self._multiclaim_worker, f"mc-{w}", w)
+            for w in range(cfg.flip_workers):
+                self._spawn(self._flip_worker, f"flips-{w}", w)
+            for w in range(cfg.unplug_workers):
+                self._spawn(self._unplug_worker, f"unplug-{w}", w)
+            for w in range(cfg.migration_workers):
+                self._spawn(self._migration_worker, f"migrate-{w}", w)
+            for w in range(cfg.defrag_workers):
+                self._spawn(self._defrag_worker, f"defrag-{w}", w)
+            for w in range(cfg.upgrade_workers):
+                self._spawn(self._upgrade_worker, f"upgrade-{w}", w)
+            for w in range(cfg.boot_workers):
+                self._spawn(self._boot_worker, f"boot-{w}", w)
+            self._spawn(self._invariant_worker, "invariants")
+            while True:
+                elapsed = time.monotonic() - t0
+                with self._lock:
+                    events = self.counters["claim_events"]
+                if elapsed >= max_wall:
+                    log.warning("autopilot: max wall %.0fs hit at %d "
+                                "claim events", max_wall, events)
+                    break
+                if elapsed >= cfg.duration_s and (
+                        not cfg.claim_event_target
+                        or events >= cfg.claim_event_target):
+                    break
+                time.sleep(0.2)
+        finally:
+            self.stop()
+            if cfg.watch_faults:
+                for site in ("kubeapi.watch", "kubeapi.watch.dup",
+                             "kubeapi.watch.stale"):
+                    faults.disarm(site)
+            self.sim.apiserver.disarm_watch_chaos()
+        # quiesce: tear down the pinned claims, settle every slice, then
+        # the FINAL invariant pass must be green WITH zero orphans left
+        self._teardown_pinned()
+        self.sim.settle()
+        with self._lock:
+            torn = list(self._torn_down)
+        final = fleet_invariants(self.sim, torn_down_multiclaims=torn)
+        self._count(invariant_checks=1)
+        converged = False
+        try:
+            converged = self.sim.assert_converged()
+        except AssertionError as exc:
+            self.violations.append(f"final convergence: {exc}")
+        if not final["ok"]:
+            self.violations.extend(final["violations"])
+        if final["orphaned_claims"]:
+            self.violations.append(
+                f"{final['orphaned_claims']} orphaned claims left after "
+                f"quiesce (expected 0)")
+        wall_s = time.monotonic() - t0
+        report = {
+            "config": {
+                "nodes": cfg.nodes,
+                "devices_per_node": cfg.devices_per_node,
+                "duration_s": cfg.duration_s,
+                "claim_event_target": cfg.claim_event_target,
+                "seed": cfg.seed,
+                "watch": cfg.watch,
+                "watch_chaos": cfg.watch_chaos,
+                "watch_faults": cfg.watch_faults,
+            },
+            "wall_s": round(wall_s, 1),
+            "boot_published_ok": boot["published_ok"],
+            "counters": dict(self.counters),
+            "violations": list(self.violations),
+            "ok": not self.violations and converged,
+            "converged": converged,
+            "final_invariants": {
+                "ok": final["ok"],
+                "orphaned_claims": final["orphaned_claims"],
+                "prepared_total": final["prepared_total"],
+                "exactly_once": final["audit"]["exactly_once"],
+                "multiclaim_exactly_once":
+                    final["multiclaim"]["exactly_once"],
+            },
+            "watch": self.sim.watch_totals(),
+            "fabric": self.sim.apiserver.snapshot(),
+            "faults_fired": {site: n for site, n in faults.stats().items()
+                             if site.startswith("kubeapi.watch")},
+            "claim_story": self._story,
+        }
+        if raise_on_violation and not report["ok"]:
+            raise AssertionError(
+                "autopilot soak failed: " + "; ".join(
+                    self.violations or ["not converged"]))
+        return report
+
+
+# ------------------------------------------------- read/repair comparison
+
+
+def measure_read_repair(n_nodes: int = 16, rounds: int = 10,
+                        seed: int = 7) -> dict:
+    """Steady-state read/repair fabric reads: guarded-PUT polling vs
+    watch-driven convergence (the r14 acceptance comparison).
+
+    Both fleets run `rounds` reconcile ticks of an UNCHANGED inventory —
+    the read/repair loop a timer-driven reconciler must run to notice a
+    wiped/diverged slice within its interval. The polling fleet pays one
+    liveness GET per node per tick; the watch fleet's established
+    streams cover wipe detection, so its ticks read nothing (the one-
+    time relists that seeded the streams are reported separately as
+    `watch_setup_lists`, not hidden in the ratio)."""
+
+    def _tick_reads(sim: FleetSim) -> int:
+        before = sim.apiserver.snapshot()["slice_reads_total"]
+        for _ in range(rounds):
+            for node in sim.nodes:
+                node.driver.publish_resource_slices()
+        return sim.apiserver.snapshot()["slice_reads_total"] - before
+
+    poll = FleetSim(n_nodes=n_nodes, latency_s=0.0, max_inflight=0,
+                    seed=seed, watch=False)
+    try:
+        poll.boot_storm()
+        poll_reads = _tick_reads(poll)
+    finally:
+        poll.stop()
+    watch = FleetSim(n_nodes=n_nodes, latency_s=0.0, max_inflight=0,
+                     seed=seed, watch=True, watch_resync_s=60.0,
+                     watch_poll_s=0.5, watch_timeout_s=5.0)
+    try:
+        watch.boot_storm()
+        # wait for every node's stream to establish (bounded)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(n.driver._watch_live() for n in watch.nodes):
+                break
+            time.sleep(0.05)
+        setup_lists = watch.apiserver.snapshot()["list_total"]
+        watch_reads = _tick_reads(watch)
+        # the watch must still HEAL: wipe one slice behind its driver
+        victim = watch.nodes[0]
+        name = victim.driver.slice_name()
+        victim.driver.api.delete(
+            f"/apis/resource.k8s.io/v1beta1/resourceslices/{name}")
+        deadline = time.monotonic() + 15
+        healed = False
+        while time.monotonic() < deadline:
+            with watch.apiserver._lock:
+                healed = name in watch.apiserver.slices
+            if healed:
+                break
+            time.sleep(0.05)
+        audit_ok = watch.apiserver.exactly_once_audit()["exactly_once"]
+    finally:
+        watch.stop()
+    return {
+        "nodes": n_nodes,
+        "rounds": rounds,
+        "poll_reads": poll_reads,
+        "watch_reads": watch_reads,
+        "watch_setup_lists": setup_lists,
+        "read_reduction_x": round(poll_reads / max(1, watch_reads), 1),
+        "wipe_healed_by_watch": healed,
+        "exactly_once": audit_ok,
+    }

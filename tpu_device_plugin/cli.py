@@ -182,6 +182,19 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser.add_argument("--dra-registry-path", default=None,
                         help=f"kubelet plugin-registration watch dir "
                              f"(default: {cfg.dra_registry_path})")
+    parser.add_argument("--no-slice-watch", action="store_true",
+                        help="disable the watch-driven slice reconciler "
+                             "(kubeapi.Reflector) and keep the pre-watch "
+                             "read/repair behavior; with the watch on, a "
+                             "slice wiped or mutated behind the driver is "
+                             "observed as an event and repaired through "
+                             "the guarded-write path, and an apiserver "
+                             "without watch support degrades to paced "
+                             "relist polling automatically")
+    parser.add_argument("--slice-watch-resync", type=float, default=300.0,
+                        help="watch reconciler resync interval in seconds "
+                             "(the periodic relist that backstops missed "
+                             "events; default 300)")
     parser.add_argument("--status-port", type=int, default=0,
                         help="serve /healthz and /status on this port "
                              "(0 disables)")
@@ -528,6 +541,12 @@ def main(argv=None) -> int:
         # allocated; a hot-unplugged device with prepared claims orphans
         # them in the checkpoint and leaves the published ResourceSlice
         dra_driver.attach_lifecycle(manager.device_lifecycle)
+        # watch-driven slice convergence (ISSUE 12): the reflector
+        # replaces the read/repair churn; degradation to paced relist
+        # polling is the reflector's own ladder, never a hang
+        if not args.no_slice_watch and dra_driver.api is not None:
+            dra_driver.start_watch_reconciler(
+                resync_interval_s=args.slice_watch_resync)
 
     def handle_drain(signum, frame):
         # flag-set only: drain() takes locks the interrupted main thread

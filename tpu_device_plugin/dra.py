@@ -60,7 +60,7 @@ from .log import get_logger
 from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
                        live_mdev_type)
 from .config import Config
-from .kubeapi import ApiClient, ApiError, PublishPacer
+from .kubeapi import ApiClient, ApiError, PublishPacer, Reflector
 from .resilience import BackoffPolicy
 from .kubeletapi import draapi, drapb, regpb
 from .naming import GenerationInfo, sanitize_name
@@ -272,8 +272,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # write; any interleaved writer surfaces as a 409 and falls back.
         # Guarded by _publish_lock (only _publish_locked touches it).
         self._last_publish: Optional[dict] = None
-        # delta vs full publish counters for /status + /metrics
-        self.publish_stats = {"full": 0, "delta": 0, "delta_conflicts": 0}
+        # delta vs full publish counters for /status + /metrics.
+        # watch_read_skips counts unchanged-projection publishes that
+        # skipped their liveness GET because a live watch stream covers
+        # the wipe-detection the GET existed for (ISSUE 12) — the
+        # steady-state read/repair churn the watch plane removes.
+        self.publish_stats = {"full": 0, "delta": 0, "delta_conflicts": 0,
+                              "watch_read_skips": 0}
         # serializes slice publishes against each other AND against
         # stop(withdraw_slice=True): an in-flight retry publish racing the
         # withdraw could otherwise POST the slice back after the delete
@@ -290,6 +295,46 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             api=api,
             base_window_s=getattr(cfg, "publish_pace_base_s", 0.0),
             max_window_s=getattr(cfg, "publish_pace_max_s", 2.0))
+        # ---- watch-driven slice convergence (ISSUE 12) -------------------
+        # An informer-style reflector (kubeapi.Reflector) over the
+        # resourceslices collection replaces the read/repair churn: a
+        # slice wiped or mutated behind our back is OBSERVED as a watch
+        # event and repaired through the normal guarded-write path,
+        # instead of being discovered by periodic liveness GETs. Started
+        # explicitly (start_watch_reconciler — cli.main / fleetsim wire
+        # it); None = the pre-watch polling behavior, unchanged. The
+        # reflector degrades to paced-relist polling by itself when the
+        # apiserver loses (or never had) watch support — typed, counted,
+        # /status-visible, never a hang.
+        self._slice_watch: Optional[Reflector] = None
+        # repairs triggered by watch observations (lock-free owned,
+        # like the trace-plane counters)
+        self.watch_repairs = epoch_mod.AtomicCounter()
+        # Watch observations of a wipe/divergence arriving while a
+        # publish holds _publish_lock are DEFERRED (acting on evidence
+        # read against a half-updated window is wrong, but FORGETTING
+        # it would leave the wipe unhealed until the resync backstop):
+        # the reflector thread bumps _watch_deferred_seq, and while it
+        # is ahead of _watch_deferred_ack the unchanged-projection
+        # publish pays its classic liveness GET instead of taking the
+        # watch_read_skips fast path. The ack advances only to the seq
+        # captured BEFORE a publish that SUCCEEDED — a failed attempt
+        # keeps the deferral for the republish retry, and evidence
+        # arriving mid-publish outruns the ack and forces another GET.
+        # GIL-atomic ints: seq has one writer (the reflector thread),
+        # ack has one writer (the publish path under _publish_lock).
+        self._watch_deferred_seq = 0
+        self._watch_deferred_ack = 0
+        # True once this driver has successfully published its slice at
+        # least once — the watch reconciler must not "repair" a slice
+        # that was never published (boot is the publisher's job)
+        self._has_published = False
+        # highest pool generation this driver ever published (process
+        # lifetime): a repair that RECREATES a wiped slice continues the
+        # sequence instead of resetting to 1 — a reset would make old
+        # allocations look newer than the live pool AND replay already-
+        # used generations into the fabric's exactly-once write audit
+        self._last_generation = 0
         # name-stability records (see _assign_slice_names), persisted
         # beside the claim checkpoint so neither an inventory swap nor a
         # driver restart (DaemonSet upgrade) can re-point a published name
@@ -733,6 +778,188 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         if not self.publish_resource_slices():
             self._arm_republish_retry()
 
+    # ---------------------------- watch-driven convergence (ISSUE 12)
+
+    def start_watch_reconciler(
+            self, resync_interval_s: float = 300.0,
+            poll_interval_s: float = 30.0,
+            watch_timeout_s: float = 30.0,
+            backoff=None) -> bool:
+        """Move slice read/repair onto watch-driven convergence.
+
+        A reflector list+watches the resourceslices collection; every
+        observation of OUR slice is checked against the desired
+        projection, and a divergence (wiped, mutated by another writer)
+        is repaired through publish_resource_slices — the guarded-write
+        path, so exactly-once is untouched. While the stream is live the
+        publish path also skips its unchanged-projection liveness GET
+        (`publish_stats["watch_read_skips"]`): wipe detection is the
+        watch's job now. The periodic resync relist is the missed-event
+        backstop, and the reflector's own degradation ladder (paced
+        relist polling) covers fabrics without watch support. Returns
+        False without an API client (nothing to watch)."""
+        if self.api is None:
+            return False
+        if self._slice_watch is not None:
+            return True
+        self._slice_watch = Reflector(
+            # callable path + on_list_404: a control-plane upgrade that
+            # drops the cached resource.k8s.io version turns every
+            # relist into a 404 — the hook invalidates the cache and
+            # the re-resolved path recovers on the next attempt
+            self.api, lambda: f"{self._resource_api()}/resourceslices",
+            on_event=self._on_slice_watch_event,
+            on_sync=self._on_slice_watch_sync,
+            on_list_404=self._note_api_404,
+            name=f"slice-{self.node_name}",
+            resync_interval_s=resync_interval_s,
+            poll_interval_s=poll_interval_s,
+            watch_timeout_s=watch_timeout_s,
+            backoff=backoff,
+            # narrow both list and watch to OUR slice: without this a
+            # fleet of N drivers each receives (and parses, and
+            # discards) all N slices' events — O(N^2) apiserver egress
+            # for a reconciler that only ever acts on one name. The
+            # handlers still name-check: a server that ignores the
+            # selector is correct, just louder.
+            query=f"fieldSelector=metadata.name={self.slice_name()}")
+        self._slice_watch.start()
+        log.info("DRA: slice watch reconciler started (resync %.0fs, "
+                 "degraded-poll %.0fs)", resync_interval_s,
+                 poll_interval_s)
+        return True
+
+    def _watch_live(self) -> bool:
+        """The watch plane currently covers wipe detection (lock-free)."""
+        ref = self._slice_watch
+        return ref is not None and ref.stream_live()
+
+    def _on_slice_watch_event(self, evt: dict) -> None:
+        """Watch handler — IDEMPOTENT by construction (the reflector's
+        at-least-once contract): an event matching the desired
+        projection (our own publish echo, a duplicate delivery) changes
+        nothing; only a real divergence triggers the guarded repair.
+
+        STALENESS guard: watch delivery lags writes, so an event can
+        describe a state OLDER than our own latest write (a flip
+        storm's intermediate publishes arriving after the final one).
+        Comparing that history against current desired would read as
+        divergence and spam repair publishes — an event older than our
+        last write's resourceVersion is history, not evidence."""
+        obj = evt.get("object") or {}
+        if ((obj.get("metadata") or {}).get("name")) != self.slice_name():
+            return
+        last = self._last_publish          # GIL-atomic ref read
+        try:
+            last_rv = int(last["rv"]) if last else 0
+        except (TypeError, ValueError):
+            last_rv = 0
+        try:
+            evt_rv = int((obj.get("metadata") or {})
+                         .get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            evt_rv = 0
+        if evt_rv and last_rv and evt_rv <= last_rv:
+            # older: stale history. EQUAL: the echo of our own last
+            # write (resourceVersions are per-resource monotonic, so
+            # the same rv IS the state we just wrote) — returning here
+            # spares a full build_slice + projection compare per
+            # publish on the reflector thread
+            return
+        if evt.get("type") == "DELETED":
+            if self._should_repair():
+                self._watch_repair("deleted")
+            elif self._repair_wanted():
+                self._watch_deferred_seq += 1
+            return
+        if self._should_repair():
+            if self._slice_diverged(obj):
+                self._watch_repair("diverged")
+        elif self._repair_wanted() and self._slice_diverged(obj):
+            # divergence read against an in-flight publish's window may
+            # be a false positive — deferring costs one liveness GET,
+            # never a spurious repair publish
+            self._watch_deferred_seq += 1
+
+    def _on_slice_watch_sync(self, items: list) -> None:
+        """Relist/resync handler: the full collection state — the
+        missed-event backstop. Same idempotency contract as the event
+        handler."""
+        mine = [obj for obj in items
+                if ((obj.get("metadata") or {}).get("name"))
+                == self.slice_name()]
+        if self._should_repair():
+            if not mine:
+                self._watch_repair("missing")
+            elif self._slice_diverged(mine[0]):
+                self._watch_repair("diverged")
+        elif self._repair_wanted():
+            if not mine or self._slice_diverged(mine[0]):
+                self._watch_deferred_seq += 1
+
+    def _repair_wanted(self) -> bool:
+        # repair only what we ever published, never after stop(), and
+        # never an inventory-empty state (that withdraws the slice —
+        # absence IS the desired state there)
+        if not self._has_published or self._stopped:
+            return False
+        return bool(self._inv_store.current.by_name)
+
+    def _should_repair(self) -> bool:
+        # a publish in flight already carries current state: an event
+        # observed against its half-updated window is not divergence
+        # evidence — but it is not FORGOTTEN either: the handlers defer
+        # it (_watch_deferred) so the next unchanged-projection publish
+        # keeps its liveness GET, and the resync backstop still covers
+        # the rest
+        return self._repair_wanted() and not self._publish_lock.locked()
+
+    def _slice_diverged(self, live_obj: dict) -> bool:
+        live_spec = live_obj.get("spec") or {}
+        live_gen = ((live_spec.get("pool") or {}).get("generation")) or 1
+        if live_gen < self._last_generation:
+            # a foreign delete+recreate reset pool.generation: even with
+            # a matching device projection the live pool now claims to be
+            # OLDER than allocations we already handed out, breaking
+            # stale-allocation detection — that is divergence too
+            return True
+        desired = self.build_slice()
+        return (self._spec_projection(live_spec)
+                != self._spec_projection(desired["spec"]))
+
+    def _watch_repair(self, reason: str) -> None:
+        self.watch_repairs.add()
+        trace.event("dra.watch.repair", reason=reason)
+        log.warning("DRA: watch observed slice %s %s; repairing via the "
+                    "guarded publish path", self.slice_name(), reason)
+        # the observed divergence invalidates the delta baseline: a wiped
+        # slice's cached rv is dead, a foreign write bumped it — and the
+        # unchanged-projection fast paths (watch-read skip, delta PUT)
+        # must not conclude "nothing to do" from a cache the fabric just
+        # contradicted. The repair publish then takes the classic
+        # GET-or-POST read-modify-write, which heals both shapes.
+        with self._publish_lock:
+            self._last_publish = None
+        # the repair publish below acks any deferred observation it
+        # covers (the _paced_publish seq/ack handshake) — on success
+        # only, so a failed repair keeps the deferral for the retry
+        if not self.publish_resource_slices():
+            self._arm_republish_retry()
+
+    def watch_stats(self) -> dict:
+        """The /status + /metrics watch-plane surface: the reflector's
+        counters (zeros when no reconciler is attached — polling mode)
+        plus the repair counter. Lock-free."""
+        ref = self._slice_watch
+        if ref is None:
+            out = {key: 0 for key in Reflector.STAT_KEYS}
+            out["enabled"] = False
+        else:
+            out = ref.snapshot()
+            out["enabled"] = True
+        out["watch_repairs_total"] = self.watch_repairs.value
+        return out
+
     def apply_gone(self, raws) -> bool:
         """Hot-unplug: REMOVE departed devices from the published
         inventory entirely.
@@ -1048,9 +1275,22 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             self.republish_backoff.reset()
         return ok
 
+    def _watch_evidence_pending(self) -> bool:
+        return self._watch_deferred_ack != self._watch_deferred_seq
+
     def _paced_publish(self) -> bool:
         with self._publish_lock:
-            return self._publish_locked()
+            seq0 = self._watch_deferred_seq
+            ok = self._publish_locked()
+            if ok:
+                # every successful outcome resolves the evidence that
+                # existed when we started: the guarded PUT proved our
+                # cached rv still live, the classic path re-read the
+                # fabric, create/withdraw re-established the desired
+                # state. Evidence deferred DURING this publish has
+                # seq > seq0 and stays pending.
+                self._watch_deferred_ack = seq0
+            return ok
 
     def _publish_locked(self) -> bool:
         with self._lock:
@@ -1085,6 +1325,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                     log.error("DRA: slice delete failed: %s", exc)
                     return False
             self._last_publish = None
+            self._has_published = False   # absence is the desired state
             return True
         # Delta fast path: this driver is the slice's only legitimate
         # writer, so the rv/generation/projection of OUR last write is
@@ -1102,7 +1343,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # below instead: its GET doubles as the liveness check that
             # recreates a slice wiped behind our back (a change-free
             # republish healed that before the delta path existed, and
-            # must keep doing so).
+            # must keep doing so) — UNLESS a live watch stream covers
+            # wipe detection (ISSUE 12): a DELETED/diverged event repairs
+            # through _watch_repair, so the probe read is pure churn and
+            # is skipped, counted. A degraded or absent watch keeps the
+            # GET: the ladder never trades a read away for a blind spot.
             if proj != cached["projection"]:
                 desired["metadata"]["resourceVersion"] = cached["rv"]
                 try:
@@ -1131,7 +1376,21 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                              desired["spec"]["pool"]["generation"],
                              len(desired["spec"]["devices"]))
                     return True
-        desired = self.build_slice(version=version)
+            elif self._watch_live():
+                if not self._watch_evidence_pending():
+                    self.publish_stats["watch_read_skips"] += 1
+                    return True
+                # a wipe/divergence observation arrived while an
+                # earlier publish held the lock and was never acted on:
+                # fall through to the classic liveness GET this round
+                # instead of skipping it, so the deferred evidence
+                # heals within one republish period rather than
+                # waiting for resync (acked in _paced_publish on
+                # success only)
+        # a CREATE continues the generation sequence (1 on first boot;
+        # last+1 when recreating a slice wiped behind our back)
+        desired = self.build_slice(
+            pool_generation=self._last_generation + 1, version=version)
         try:
             live = self.api.get_json(path)
         except ApiError as exc:
@@ -1155,14 +1414,23 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             return True
         live_spec = live.get("spec") or {}
         live_gen = ((live_spec.get("pool") or {}).get("generation")) or 1
-        if self._spec_projection(live_spec) == \
+        # a foreign recreate can carry a LOWER generation than we already
+        # published (delete + recreate resets it to 1); the floor keeps
+        # the sequence monotonic so old allocations never look newer than
+        # the live pool and the exactly-once audit never sees a replay
+        floor_gen = max(live_gen, self._last_generation)
+        if live_gen >= self._last_generation and \
+                self._spec_projection(live_spec) == \
                 self._spec_projection(desired["spec"]):
             # adopt the live object as the delta baseline: the next health
-            # flip can go straight to the guarded-PUT path
+            # flip can go straight to the guarded-PUT path. A live object
+            # with a REGRESSED generation is never adopted, even with a
+            # matching projection — the guarded PUT below restores the
+            # advertised generation the fleet's staleness checks rely on.
             self._remember_publish(live, live, self._spec_projection(
                 live_spec), version, generation=live_gen)
             return True
-        desired = self.build_slice(pool_generation=live_gen + 1,
+        desired = self.build_slice(pool_generation=floor_gen + 1,
                                    version=version)
         desired["metadata"]["resourceVersion"] = (
             (live.get("metadata") or {}).get("resourceVersion"))
@@ -1177,7 +1445,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._remember_publish(
             updated, desired, self._spec_projection(desired["spec"]), version)
         log.info("DRA: updated ResourceSlice %s to pool generation %d "
-                 "(%d devices)", name, live_gen + 1,
+                 "(%d devices)", name, floor_gen + 1,
                  len(desired["spec"]["devices"]))
         return True
 
@@ -1186,10 +1454,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                           generation: Optional[int] = None) -> None:
         """Record the apiserver's view of our last write for the delta path;
         an apiserver that returns no resourceVersion just disables it."""
+        self._has_published = True   # the watch reconciler may repair now
         rv = ((live_obj or {}).get("metadata") or {}).get("resourceVersion")
         if generation is None:
             generation = ((desired.get("spec") or {}).get("pool")
                           or {}).get("generation") or 1
+        self._last_generation = max(self._last_generation, generation)
         if not rv:
             self._last_publish = None
             return
@@ -2219,6 +2489,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._stopping.set()
         if timer is not None:
             timer.cancel()
+        # stop the watch reconciler first: a late watch event must not
+        # "repair" the slice a withdraw below is about to delete
+        watch, self._slice_watch = self._slice_watch, None
+        if watch is not None:
+            watch.stop()
         with self._serve_lock:
             self._stop_servers_locked()
         # reap the hub-triggered re-serve runner: it checks _stopped under

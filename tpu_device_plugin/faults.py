@@ -42,6 +42,17 @@ Instrumented sites and their semantics:
                      during NodeUnprepareResources fails before the
                      checkpoint mutation: the unprepare errors per-claim
                      and the kubelet retry re-runs it (exactly-once)
+  kubeapi.watch      raising — the watch stream read fails mid-stream
+                     (armed kind=error models a stream BREAK, kind=
+                     timeout a STALL that tripped the read deadline);
+                     the reflector's recovery is backoff + relist
+  kubeapi.watch.dup  value   — the next watch event is delivered TWICE
+                     (at-least-once pressure: every downstream handler
+                     must be idempotent)
+  kubeapi.watch.stale value  — the reflector resumes its next watch from
+                     a resourceVersion the server has long compacted:
+                     the server answers 410 Gone and the reflector must
+                     relist without losing or double-applying events
   broker.ipc         value   — the next broker crossing (broker.py
                      client) fails as if the privileged broker process
                      had died: the caller gets the typed
@@ -115,6 +126,9 @@ _VALUE_KINDS = ("drop", "false")
 _SITE_CATEGORY: Dict[str, str] = {
     "kubelet.register": "raising",
     "kubeapi.request": "raising",
+    "kubeapi.watch": "raising",
+    "kubeapi.watch.dup": "value",
+    "kubeapi.watch.stale": "value",
     "native.probe": "value",
     "inotify.poll": "value",
     "dra.publish": "value",

@@ -35,6 +35,7 @@ suite (tests/test_fleetsim.py), and `make fleet-soak`.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -46,6 +47,7 @@ from concurrent import futures
 from dataclasses import replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlsplit
 
 from . import placement
 from .config import Config
@@ -75,6 +77,21 @@ def _fakehost():
     return FakeChip, FakeHost
 
 
+def _name_selector(path: str) -> Optional[str]:
+    """The metadata.name fieldSelector of a request path, or None for
+    an unfiltered read — the one selector shape the fabric honors
+    (enough for the per-node slice reflectors; anything else reads as
+    unfiltered, which is correct-but-louder)."""
+    query = parse_qs(urlsplit(path).query)
+    sel = (query.get("fieldSelector") or [""])[0]
+    # only a SOLE metadata.name clause filters: a compound selector
+    # (metadata.name=a,spec.nodeName=b) must fall back to unfiltered,
+    # not filter on the garbage name "a,spec.nodeName=b"
+    if sel.startswith("metadata.name=") and "," not in sel:
+        return sel[len("metadata.name="):] or None
+    return None
+
+
 class _FleetHTTPServer(ThreadingHTTPServer):
     # listen backlog: the default 5 makes a 64-node barrier-released
     # connect storm hit kernel SYN retransmission timers (seconds of
@@ -82,6 +99,18 @@ class _FleetHTTPServer(ThreadingHTTPServer):
     # a real apiserver's accept queue is never the modeled bottleneck
     request_queue_size = 512
     daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        # torn connections are ROUTINE here: the watch chaos breaks
+        # streams on purpose and reflectors hang up mid-poll on stop —
+        # socketserver's default stack-trace print would bury a soak's
+        # real output. Anything else still prints.
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            ConnectionAbortedError)):
+            return
+        super().handle_error(request, client_address)
 
 
 class FleetApiServer:
@@ -124,7 +153,11 @@ class FleetApiServer:
     """
 
     def __init__(self, latency_s: float = 0.0, max_inflight: int = 0,
-                 congestion_k: int = 0, versions=("v1beta1",)):
+                 congestion_k: int = 0, versions=("v1beta1",),
+                 watch_enabled: bool = True, watch_backlog: int = 4096,
+                 watch_queue_max: int = 128,
+                 watch_timeout_s: float = 30.0,
+                 bookmark_interval_s: float = 0.5):
         self.latency_s = latency_s
         self.max_inflight = max_inflight
         self.congestion_k = congestion_k
@@ -135,11 +168,46 @@ class FleetApiServer:
         self._lock = threading.Lock()
         self._inflight = 0
         self._admitted = 0
+        # ---- WATCH plane (ISSUE 12) -------------------------------------
+        # The push side of the fabric: every accepted slice write appends a
+        # pre-serialized event line under _lock, compacted to the newest
+        # `watch_backlog` events (a watcher resuming from before the
+        # compaction horizon is answered 410 Gone, like etcd compaction).
+        # Each live stream holds a BOUNDED queue; a producer that overflows
+        # it drops the whole queue and force-closes the stream with an
+        # ERROR event (apiserver slow-consumer semantics) — the client's
+        # only correct recovery is a relist. Watch requests bypass the 429
+        # admission gate and the latency model: a real apiserver accounts
+        # long-lived watches separately from request servicing.
+        self.watch_enabled = watch_enabled
+        self.watch_backlog = watch_backlog
+        self.watch_queue_max = watch_queue_max
+        self.watch_timeout_s = watch_timeout_s
+        self.bookmark_interval_s = bookmark_interval_s
+        self._events: collections.deque = collections.deque()  # (rv, bytes)
+        self._compacted_rv = 0
+        self._watchers: List[dict] = []      # live per-stream queue records
+        self._watch_cond = threading.Condition(self._lock)
+        # watch chaos knobs (arm_watch_chaos): per-event break/dup
+        # probabilities + per-event stall, drawn from a seeded RNG
+        self._watch_chaos: Optional[dict] = None
         self.stats = {
             "requests_total": 0,
             "throttled_total": 0,       # 429s sent
             "peak_inflight": 0,         # arrival concurrency
             "peak_admitted": 0,         # concurrency past the 429 gate
+            # read/repair accounting (the r14 bench surface): GETs that
+            # READ slice state — single-object or collection list — vs
+            # long-lived watch streams
+            "slice_reads_total": 0,
+            "list_total": 0,
+            "watch_opened_total": 0,
+            "watch_events_sent_total": 0,
+            "watch_bookmarks_sent_total": 0,
+            "watch_410_total": 0,
+            "watch_force_closed_total": 0,   # slow-consumer closes
+            "watch_chaos_breaks_total": 0,
+            "watch_chaos_dups_total": 0,
         }
         # slice name -> [(t_monotonic, method, pool generation), ...]
         self.write_log: Dict[str, List[tuple]] = {}
@@ -201,6 +269,12 @@ class FleetApiServer:
                         outer._admitted -= 1
 
             def _handle(self, method):
+                # watch streams bypass the admission gate + latency model
+                # (a real apiserver budgets watches separately from request
+                # servicing; a 64-node fleet's 64 idle streams must not eat
+                # the max_inflight capacity storms are measured against)
+                if method == "GET" and "watch=" in (self.path or ""):
+                    return self._do_watch()
                 admitted = self._enter()
                 # service-wall start for _log_write_locked: only writes
                 # the store ACCEPTS are recorded (409 guard conflicts /
@@ -249,9 +323,28 @@ class FleetApiServer:
                     name = path.rsplit("/", 1)[-1]
                     return self._send(200, {"metadata": {
                         "name": name, "uid": f"uid-{name}"}})
+                if path.split("?", 1)[0].rstrip("/").endswith(
+                        "/resourceslices"):
+                    # collection LIST: the reflector's relist/resync read.
+                    # metadata.resourceVersion is the fabric's current rv —
+                    # the watch-resume cursor a client continues from. A
+                    # fieldSelector=metadata.name=X narrows the answer
+                    # like a real apiserver (the cursor stays global).
+                    sel = _name_selector(self.path)
+                    with outer._lock:
+                        outer.stats["list_total"] += 1
+                        outer.stats["slice_reads_total"] += 1
+                        items = [dict(o) for n, o in outer.slices.items()
+                                 if sel is None or n == sel]
+                        rv = outer._rv
+                    return self._send(200, {
+                        "kind": "ResourceSliceList",
+                        "metadata": {"resourceVersion": str(rv)},
+                        "items": items})
                 if "/resourceslices/" in path:
                     name = path.rsplit("/", 1)[-1]
                     with outer._lock:
+                        outer.stats["slice_reads_total"] += 1
                         obj = outer.slices.get(name)
                     if obj is not None:
                         return self._send(200, obj)
@@ -278,6 +371,7 @@ class FleetApiServer:
                     outer.slices[name] = obj
                     outer._log_write_locked(name, "POST", obj,
                                             self._req_t0)
+                    outer._append_event_locked("ADDED", obj)
                 return self._send(201, obj)
 
             def _do_PUT(self):
@@ -295,14 +389,223 @@ class FleetApiServer:
                     outer.slices[name] = obj
                     outer._log_write_locked(name, "PUT", obj,
                                             self._req_t0)
+                    outer._append_event_locked("MODIFIED", obj)
                 return self._send(200, obj)
 
             def _do_DELETE(self):
                 name = self.path.rsplit("/", 1)[-1]
                 with outer._lock:
-                    if outer.slices.pop(name, None) is None:
+                    live = outer.slices.pop(name, None)
+                    if live is None:
                         return self._send(404, {})
+                    # deletes carry a fresh rv like any other write, so a
+                    # watcher's resume cursor advances past the tombstone
+                    outer._rv += 1
+                    tomb = dict(live, metadata=dict(
+                        live.get("metadata") or {},
+                        resourceVersion=str(outer._rv)))
+                    outer._append_event_locked("DELETED", tomb)
                 return self._send(200, {})
+
+            # ------------------------------------------- WATCH (ISSUE 12)
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+                self.wfile.flush()
+
+            def _do_watch(self):
+                """Chunked long-poll watch stream over the resourceslices
+                collection: newline-delimited JSON events from the resume
+                resourceVersion forward, BOOKMARK events on idle, ERROR +
+                close on slow-consumer overflow, 410 when the resume rv
+                predates the compaction horizon."""
+                parts = urlsplit(self.path)
+                if not parts.path.rstrip("/").endswith("/resourceslices") \
+                        or not outer.watch_enabled:
+                    # watch is a slice-collection surface; elsewhere (or
+                    # with the plane disabled) answer like an apiserver
+                    # that does not serve it — the client's degradation
+                    # ladder, not its retry loop, owns this signal. The
+                    # refusal is still a served request (a degraded
+                    # fleet's per-cycle probes must show in the load
+                    # accounting)
+                    with outer._lock:
+                        outer.stats["requests_total"] += 1
+                    return self._send(400, {"reason": "watch unsupported"})
+                query = parse_qs(parts.query)
+                try:
+                    resume_rv = int((query.get("resourceVersion")
+                                     or ["0"])[0])
+                except ValueError:
+                    resume_rv = 0
+                try:
+                    timeout_s = float((query.get("timeoutSeconds")
+                                       or [outer.watch_timeout_s])[0])
+                except ValueError:
+                    timeout_s = outer.watch_timeout_s
+                sel = _name_selector(self.path)
+                with outer._lock:
+                    outer.stats["requests_total"] += 1
+                    if resume_rv < outer._compacted_rv:
+                        # the resume point was compacted away: the client
+                        # cannot be caught up event-by-event — relist
+                        outer.stats["watch_410_total"] += 1
+                        gone = True
+                    else:
+                        gone = False
+                        watcher = {
+                            "queue": collections.deque(
+                                (rv, line) for rv, name, line
+                                in outer._events
+                                if rv > resume_rv
+                                and (sel is None or name == sel)),
+                            "name": sel,
+                            "overflowed": False,
+                            "closed": False,
+                        }
+                        if len(watcher["queue"]) > outer.watch_queue_max:
+                            watcher["overflowed"] = True
+                            watcher["queue"].clear()
+                        outer._watchers.append(watcher)
+                        outer.stats["watch_opened_total"] += 1
+                if gone:
+                    return self._send(410, {"reason": "Expired",
+                                            "code": 410})
+                deadline = time.monotonic() + timeout_s
+                clean = True
+                # the watcher is registered from here on: every exit —
+                # including a client that tore the connection before the
+                # header flush below made it out — must pass the finally
+                # that deregisters it, or the dead record would keep
+                # receiving (and overflowing on) every subsequent event
+                # for the fabric's lifetime
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    # flush NOW: wbufsize buffers the headers, and an
+                    # idle stream's first write is its first bookmark —
+                    # without this the client's getresponse() blocks a
+                    # whole bookmark interval per establishment
+                    self.wfile.flush()
+                    while True:
+                        if time.monotonic() >= deadline:
+                            # rotation applies to BUSY streams too: a
+                            # steady event flow must not pin a long-poll
+                            # open forever, or the client's rotation-
+                            # resume path only ever runs idle
+                            return
+                        with outer._watch_cond:
+                            bookmark_at = (time.monotonic()
+                                           + outer.bookmark_interval_s)
+                            while (not watcher["queue"]
+                                   and not watcher["overflowed"]
+                                   and not watcher["closed"]):
+                                now = time.monotonic()
+                                wake = min(deadline, bookmark_at)
+                                if now >= wake:
+                                    break
+                                outer._watch_cond.wait(timeout=wake - now)
+                            if watcher["closed"]:
+                                clean = False   # abrupt: chaos/shutdown
+                                return
+                            overflowed = watcher["overflowed"]
+                            if overflowed:
+                                outer.stats["watch_force_closed_total"] \
+                                    += 1
+                            batch = list(watcher["queue"])
+                            watcher["queue"].clear()
+                            rv_now = outer._rv
+                        if overflowed:
+                            # slow consumer: the queue overflowed and was
+                            # dropped — events are LOST on this stream,
+                            # so force-close with the 410-shaped ERROR a
+                            # real apiserver sends; the client must
+                            # relist. Written OUTSIDE the fabric lock: a
+                            # slow consumer is by definition not draining
+                            # its socket, and a sendall blocked on its
+                            # full TCP buffer must not stall every other
+                            # request the fabric is serving
+                            err = json.dumps({
+                                "type": "ERROR",
+                                "object": {"code": 410,
+                                           "reason": "Expired",
+                                           "message": "slow consumer"}})
+                            self._chunk(err.encode() + b"\n")
+                            return
+                        if not batch:
+                            if time.monotonic() >= deadline:
+                                return   # clean rotation: client re-watches
+                            # idle past the bookmark interval: advance the
+                            # client's resume cursor without data
+                            with outer._lock:
+                                outer.stats[
+                                    "watch_bookmarks_sent_total"] += 1
+                            bookmark = json.dumps({
+                                "type": "BOOKMARK",
+                                "object": {"metadata": {
+                                    "resourceVersion": str(rv_now)}}})
+                            self._chunk(bookmark.encode() + b"\n")
+                            continue
+                        delivered = 0
+                        for _rv, line in batch:
+                            # re-read per delivery: chaos armed MID-
+                            # STREAM must bite the already-open streams
+                            chaos = outer._watch_chaos
+                            if chaos is not None:
+                                clean = self._chaos_deliver(chaos, line)
+                                if not clean:
+                                    break
+                            else:
+                                self._chunk(line + b"\n")
+                            delivered += 1
+                        # one lock crossing per BATCH, not per event —
+                        # this loop runs on every watcher thread and a
+                        # per-event acquisition serializes busy streams
+                        # against the whole fabric
+                        if delivered:
+                            with outer._lock:
+                                outer.stats[
+                                    "watch_events_sent_total"] += delivered
+                        if not clean:
+                            return
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    clean = False   # client went away mid-write
+                finally:
+                    with outer._lock:
+                        try:
+                            outer._watchers.remove(watcher)
+                        except ValueError:
+                            pass
+                    if clean:
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            pass
+                    self.close_connection = True
+
+            def _chaos_deliver(self, chaos: dict, line: bytes) -> bool:
+                """Deliver one event under the armed watch chaos: stall,
+                duplicate, or break the stream. Returns False when the
+                stream was broken (caller closes abruptly)."""
+                rng = chaos["rng"]
+                if chaos["stall_s"] > 0:
+                    time.sleep(chaos["stall_s"])
+                if chaos["break_p"] > 0 and rng.random() < chaos["break_p"]:
+                    # abrupt mid-stream break: no terminating chunk — the
+                    # client sees a torn chunked body (IncompleteRead)
+                    with outer._lock:
+                        outer.stats["watch_chaos_breaks_total"] += 1
+                    return False
+                self._chunk(line + b"\n")
+                if chaos["dup_p"] > 0 and rng.random() < chaos["dup_p"]:
+                    with outer._lock:
+                        outer.stats["watch_chaos_dups_total"] += 1
+                    self._chunk(line + b"\n")
+                return True
 
         self.server = _FleetHTTPServer(("127.0.0.1", 0), Handler)
         self.thread = threading.Thread(
@@ -317,6 +620,60 @@ class FleetApiServer:
                .get("generation")) or 1
         self.write_log.setdefault(name, []).append((now, method, gen))
         self.write_walls.append(now - t0)
+
+    # --------------------------------------------- watch plane (ISSUE 12)
+
+    def _append_event_locked(self, etype: str, obj: dict) -> None:
+        """Append one pre-serialized watch event (caller holds _lock):
+        fan out to every live watcher's bounded queue (overflow = the
+        whole queue drops and the stream force-closes), compact the
+        global log to `watch_backlog`, wake the streams."""
+        rv = int((obj.get("metadata") or {}).get("resourceVersion")
+                 or self._rv)
+        name = (obj.get("metadata") or {}).get("name")
+        line = json.dumps({"type": etype, "object": obj}).encode()
+        self._events.append((rv, name, line))
+        while len(self._events) > self.watch_backlog:
+            old_rv, _name, _old = self._events.popleft()
+            self._compacted_rv = old_rv
+        for watcher in self._watchers:
+            if watcher["overflowed"]:
+                continue
+            if watcher["name"] is not None and watcher["name"] != name:
+                continue   # fieldSelector'd stream: not its object
+            watcher["queue"].append((rv, line))
+            if len(watcher["queue"]) > self.watch_queue_max:
+                watcher["overflowed"] = True
+                watcher["queue"].clear()
+        self._watch_cond.notify_all()
+
+    def arm_watch_chaos(self, break_p: float = 0.0, dup_p: float = 0.0,
+                        stall_s: float = 0.0, seed: int = 0) -> None:
+        """Arm per-event watch-stream chaos: `break_p` = probability an
+        event delivery abruptly tears the stream (client must relist or
+        re-watch), `dup_p` = probability an event is delivered twice
+        (at-least-once pressure on handler idempotency), `stall_s` =
+        per-event delivery stall. Seeded so soaks replay."""
+        self._watch_chaos = {"break_p": break_p, "dup_p": dup_p,
+                             "stall_s": stall_s,
+                             "rng": random.Random(seed)}
+
+    def disarm_watch_chaos(self) -> None:
+        self._watch_chaos = None
+
+    def close_watch_streams(self) -> int:
+        """Force-close every live watch stream abruptly (deterministic
+        break injection for tests). Returns the number closed."""
+        with self._watch_cond:
+            n = len(self._watchers)
+            for watcher in self._watchers:
+                watcher["closed"] = True
+            self._watch_cond.notify_all()
+        return n
+
+    def watch_streams_active(self) -> int:
+        with self._lock:
+            return len(self._watchers)
 
     @property
     def url(self) -> str:
@@ -426,6 +783,7 @@ class FleetApiServer:
                 "exactly_once": not duplicated and not regressed}
 
     def stop(self) -> None:
+        self.close_watch_streams()   # unblock long-poll handler threads
         self.server.shutdown()
         self.server.server_close()
         if self.thread.is_alive():
@@ -441,9 +799,14 @@ class FleetNode:
     def __init__(self, root: str, index: int, apiserver: FleetApiServer,
                  n_devices: int = 4, pace_max_s: float = 2.0,
                  pace_base_s: float = 0.0, pace: bool = True,
-                 seed: int = 0, device_id: str = "0063"):
+                 seed: int = 0, device_id: str = "0063",
+                 watch: bool = False, watch_resync_s: float = 5.0,
+                 watch_poll_s: float = 0.5, watch_timeout_s: float = 2.0):
         FakeChip, FakeHost = _fakehost()
         self._pace = pace
+        # watch-driven convergence (ISSUE 12): sim-speed reflector knobs
+        self._watch = watch
+        self._watch_knobs = (watch_resync_s, watch_poll_s, watch_timeout_s)
         self.index = index
         self.name = f"node-{index:03d}"
         self.root = os.path.join(root, self.name)
@@ -492,6 +855,11 @@ class FleetNode:
             else 0.0,
             max_attempts=16 if self._pace else 50,
             rng=random.Random((self._seed << 16) ^ self.index))
+        if self._watch:
+            resync_s, poll_s, timeout_s = self._watch_knobs
+            driver.start_watch_reconciler(resync_interval_s=resync_s,
+                                          poll_interval_s=poll_s,
+                                          watch_timeout_s=timeout_s)
         return driver
 
     def _health_listener(self, current: Dict[str, bool]) -> None:
@@ -760,13 +1128,17 @@ class FleetSim:
                  pace: bool = True, pace_max_s: float = 2.0,
                  pace_base_s: float = 0.0,
                  seed: int = 0, root: Optional[str] = None,
-                 build_workers: int = 16, device_id: str = "0063"):
+                 build_workers: int = 16, device_id: str = "0063",
+                 watch: bool = False, watch_resync_s: float = 5.0,
+                 watch_poll_s: float = 0.5, watch_timeout_s: float = 2.0,
+                 bookmark_interval_s: float = 0.5):
         self.n_nodes = n_nodes
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="tdpfleet-")
-        self.apiserver = FleetApiServer(latency_s=latency_s,
-                                        max_inflight=max_inflight,
-                                        congestion_k=congestion_k)
+        self.apiserver = FleetApiServer(
+            latency_s=latency_s, max_inflight=max_inflight,
+            congestion_k=congestion_k,
+            bookmark_interval_s=bookmark_interval_s)
         with futures.ThreadPoolExecutor(
                 max_workers=min(build_workers, max(1, n_nodes))) as pool:
             self.nodes: List[FleetNode] = list(pool.map(
@@ -775,7 +1147,11 @@ class FleetSim:
                                     pace_max_s=pace_max_s,
                                     pace_base_s=pace_base_s,
                                     pace=pace, seed=seed,
-                                    device_id=device_id),
+                                    device_id=device_id,
+                                    watch=watch,
+                                    watch_resync_s=watch_resync_s,
+                                    watch_poll_s=watch_poll_s,
+                                    watch_timeout_s=watch_timeout_s),
                 range(n_nodes)))
 
     def _storm(self, fn) -> List:
@@ -1110,9 +1486,139 @@ class FleetSim:
                 totals[key] += snap[key]
         return totals
 
-    def stop(self) -> None:
+    def watch_totals(self) -> dict:
+        """Fleet-wide watch-plane counters (sums of every driver's
+        watch_stats; `watch_degraded_nodes` counts nodes currently in
+        the degraded paced-relist mode)."""
+        totals: Dict[str, int] = {"watch_degraded_nodes": 0}
         for node in self.nodes:
-            node.stop()
+            snap = node.driver.watch_stats()
+            totals["watch_degraded_nodes"] += snap.pop(
+                "watch_degraded_mode", 0)
+            snap.pop("enabled", None)
+            for key, value in snap.items():
+                totals[key] = totals.get(key, 0) + value
+        return totals
+
+    def stop(self) -> None:
+        # node.stop() blocks on reflector/server joins that can each
+        # wait out an in-flight relist against a congested fabric; at
+        # fleet scale a serial march multiplies that into minutes, so
+        # tear nodes down in parallel and keep the fabric up until the
+        # last node has let go of it
+        if len(self.nodes) > 1:
+            with futures.ThreadPoolExecutor(
+                    max_workers=min(32, len(self.nodes)),
+                    thread_name_prefix="fleet-stop") as pool:
+                list(pool.map(lambda node: node.stop(), self.nodes))
+        else:
+            for node in self.nodes:
+                node.stop()
         self.apiserver.stop()
         if self._own_root:
             shutil.rmtree(self.root, ignore_errors=True)
+
+
+# ---------------------------------------- continuous invariants (ISSUE 12)
+
+
+def fleet_invariants(sim: FleetSim, torn_down_multiclaims=(),
+                     confirm=None) -> dict:
+    """One pass of the soak invariant checks, shared by the autopilot's
+    continuous checker and the fleet-soak suite — asserted DURING a run,
+    not only at its end:
+
+      1. exactly-once fabric write audit (strictly-increasing, never-
+         duplicated slice generations);
+      2. exactly-once multiclaim audit (≤1 commit per uid, begin-first);
+      3. zero residue for TORN-DOWN multiclaims (aborted or fully
+         unprepared): no per-node checkpoint entries, CDI specs, or
+         fabric sub-claim records survive;
+      4. checkpoint/fabric claim agreement: every non-orphaned prepared
+         claim on every node is known to the fabric's claim registry —
+         a prepared claim the fabric forgot is a LOST claim;
+      5. zero orphaned spec files: every per-claim CDI spec on disk
+         belongs to a checkpointed claim.
+
+    Checks 4 and 5 race in-flight prepares by design (a spec is written
+    moments before its checkpoint entry); suspects are therefore
+    re-verified once through `confirm` (a callable run between the two
+    looks, default ~50 ms sleep) and only REPEATED offenders are
+    violations. Returns {"ok", "violations", "orphaned_claims",
+    "prepared_total", "audit", "multiclaim"}."""
+    if confirm is None:
+        confirm = lambda: time.sleep(0.05)   # noqa: E731
+    violations: List[str] = []
+    audit = sim.apiserver.exactly_once_audit()
+    if not audit["exactly_once"]:
+        violations.append(
+            f"fabric write audit: duplicated={audit['duplicated_generations']}"
+            f" regressed={audit['regressed_generations']}")
+    maudit = sim.apiserver.multiclaim_audit()
+    if not maudit["exactly_once"]:
+        violations.append(
+            f"multiclaim audit: duplicated={maudit['duplicated_commits']} "
+            f"unbegun={maudit['unbegun_commits']}")
+    for uid in torn_down_multiclaims:
+        residue = sim.slice_residue(uid)
+        if residue:
+            violations.append(f"multiclaim {uid} residue: {residue}")
+
+    def _suspects():
+        found: List[tuple] = []
+        with sim.apiserver._lock:
+            fabric_claims = {name for (_ns, name) in sim.apiserver.claims}
+        orphaned = 0
+        prepared = 0
+        for node in sim.nodes:
+            driver = node.driver
+            checkpoint = dict(driver._checkpoint)   # C-atomic copy
+            for uid, entry in checkpoint.items():
+                if "orphaned" in entry:
+                    orphaned += 1
+                    continue
+                prepared += 1
+                if uid not in fabric_claims:
+                    found.append(("lost", node.name, uid))
+            prefix = f"{driver._driver_fs}-claim-"
+            try:
+                names = os.listdir(driver.cdi_dir)
+            except OSError:
+                names = []
+            for fn in names:
+                if not (fn.startswith(prefix) and fn.endswith(".json")):
+                    continue
+                uid = fn[len(prefix):-len(".json")]
+                if uid not in checkpoint:
+                    found.append(("orphan-spec", node.name, uid))
+        return found, orphaned, prepared
+
+    # the clean case (no suspects) pays exactly one full-fleet sweep —
+    # this runs every invariant_interval_s at soak scale, so the counts
+    # ride along with whichever pass ran last instead of a third sweep
+    first, orphaned, prepared = _suspects()
+    if first:
+        confirm()
+        second, orphaned, prepared = _suspects()
+        for kind, node_name, uid in sorted(set(first) & set(second)):
+            if kind == "lost":
+                violations.append(
+                    f"{node_name}: claim {uid} prepared in the checkpoint "
+                    f"but unknown to the fabric (lost claim)")
+            else:
+                violations.append(
+                    f"{node_name}: claim spec {uid} on disk with no "
+                    f"checkpoint entry (orphaned spec)")
+    return {"ok": not violations, "violations": violations,
+            "orphaned_claims": orphaned, "prepared_total": prepared,
+            "audit": audit, "multiclaim": maudit}
+
+
+def assert_fleet_invariants(sim: FleetSim,
+                            torn_down_multiclaims=()) -> dict:
+    """fleet_invariants, raising AssertionError on any violation."""
+    report = fleet_invariants(sim, torn_down_multiclaims)
+    if not report["ok"]:
+        raise AssertionError("fleet invariants violated: "
+                             + "; ".join(report["violations"]))
+    return report

@@ -15,10 +15,11 @@ import json
 import logging
 import os
 import random
+import socket
 import ssl
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 from urllib.parse import urlsplit
 
 from . import epoch as epoch_mod
@@ -287,10 +288,7 @@ class ApiClient:
         machinery, not re-admit."""
         return getattr(self._throttle_tls, "last_code", None)
 
-    def _request_once(self, path: str, method: str, body: Optional[bytes],
-                      content_type: Optional[str], url: str) -> bytes:
-        """One logical request: pool checkout, send, narrow stale-keep-alive
-        retry, status handling. Raises ApiError on any failure."""
+    def _auth_headers(self, content_type: Optional[str] = None) -> dict:
         headers = {}
         if content_type:
             headers["Content-Type"] = content_type
@@ -300,6 +298,26 @@ class ApiClient:
                 headers["Authorization"] = f"Bearer {f.read().strip()}"
         except OSError:
             pass  # no token (e.g. test server without auth)
+        return headers
+
+    def stream(self, path: str, read_timeout_s: Optional[float] = None):
+        """Context manager: one DEDICATED streaming GET (watch streams).
+
+        Yields the live http.client.HTTPResponse — the caller readline()s
+        newline-delimited events off it (http.client decodes chunked
+        transfer transparently). The connection is never pooled: a watch
+        holds its connection for the stream's whole life, and returning it
+        would poison the pool with a half-read body. Breaker contract
+        matches request(): fail fast while open, the ESTABLISHMENT outcome
+        feeds the breaker (a mid-stream tear is the watch protocol's
+        normal rotation signal, not an apiserver-health signal)."""
+        return _ApiStream(self, path, read_timeout_s)
+
+    def _request_once(self, path: str, method: str, body: Optional[bytes],
+                      content_type: Optional[str], url: str) -> bytes:
+        """One logical request: pool checkout, send, narrow stale-keep-alive
+        retry, status handling. Raises ApiError on any failure."""
+        headers = self._auth_headers(content_type)
         for attempt in (0, 1):
             if attempt == 0:
                 conn, reused = self._get_conn()
@@ -374,6 +392,544 @@ class ApiClient:
         return self.request(
             path, method="PATCH", body=json.dumps(obj).encode(),
             content_type="application/strategic-merge-patch+json")
+
+
+class _StreamLineReader:
+    """Newline-delimited reader over a chunked HTTPResponse that can TELL
+    a clean stream end from a torn one: readline() returns b"" only when
+    the server terminated the chunked body properly; an abrupt tear
+    raises http.client.IncompleteRead. (HTTPResponse.readline itself
+    cannot — its peek() swallows IncompleteRead by design, so a mid-
+    stream connection tear reads exactly like a clean rotation and a
+    watch client would silently resume over a window where events may
+    have been lost.)"""
+
+    def __init__(self, resp) -> None:
+        self._resp = resp
+        self._buf = b""
+
+    def readline(self) -> bytes:
+        while b"\n" not in self._buf:
+            chunk = self._resp.read1(65536)
+            if not chunk:
+                if self._buf:
+                    # mid-line tear: the event was cut off
+                    raise http.client.IncompleteRead(self._buf)
+                return b""
+            self._buf += chunk
+        line, _, self._buf = self._buf.partition(b"\n")
+        return line + b"\n"
+
+
+class _ApiStream:
+    """One dedicated streaming GET (ApiClient.stream).
+
+    __enter__ establishes the connection and returns the live
+    HTTPResponse; __exit__ closes it. close() is safe from ANOTHER
+    thread — it shuts the socket, which unblocks a reader parked in
+    readline() (the reflector's stop path)."""
+
+    def __init__(self, api: "ApiClient", path: str,
+                 read_timeout_s: Optional[float]):
+        self.api = api
+        self.path = path
+        self.read_timeout_s = read_timeout_s
+        self._conn = None
+        self._closed = False
+
+    def __enter__(self):
+        api = self.api
+        url = api.server + self.path
+        if not api.breaker.allow():
+            raise ApiError(f"GET {url}: circuit breaker open "
+                           f"(apiserver failing; next probe within "
+                           f"{api.breaker.reset_timeout_s:.0f}s)", code=0)
+        conn = api._new_conn()
+        if self.read_timeout_s is not None:
+            conn.timeout = self.read_timeout_s
+        self._conn = conn
+        if self._closed:
+            # close() raced establishment (Reflector.stop() landing
+            # before the connection object existed): without this
+            # latch check the connect below would proceed and park in
+            # getresponse until the read timeout, defeating the prompt
+            # shutdown close() exists to provide
+            self.close()
+            raise ApiError(f"GET {url}: stream closed", code=0)
+        try:
+            conn.request("GET", api._base_path + self.path,
+                         headers=api._auth_headers())
+            resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as exc:
+            api.breaker.record_failure()
+            self.close()
+            raise ApiError(f"GET {url}: {exc}") from exc
+        if resp.status >= 300:
+            try:
+                data = resp.read()
+            except (http.client.HTTPException, OSError) as exc:
+                # the connection tore mid-error-body: still a typed
+                # establishment failure (and a 5xx-shaped one — the
+                # server was already failing the request), never a raw
+                # exception that skips breaker accounting and leaks the
+                # socket until GC
+                api.breaker.record_failure()
+                self.close()
+                raise ApiError(f"GET {url}: HTTP {resp.status}, body "
+                               f"torn: {exc}", code=resp.status) from exc
+            if resp.status == 429:
+                api.throttled_total.add()
+            if resp.status >= 500:
+                api.breaker.record_failure()
+            else:
+                api.breaker.record_success()   # answered: alive
+            self.close()
+            raise ApiError(
+                f"GET {url}: HTTP {resp.status} "
+                f"{data.decode('utf-8', 'replace')[:300]}",
+                code=resp.status)
+        api.breaker.record_success()
+        return resp
+
+    def close(self) -> None:
+        self._closed = True
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            # shutdown BEFORE close: close() alone only drops the fd
+            # refcount — a reader parked in recv() on another thread
+            # (the reflector's readline) stays blocked until the next
+            # bookmark or the read timeout; shutdown() wakes it NOW,
+            # which is what makes Reflector.stop() prompt at fleet scale
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+# ------------------------------------------------------------- reflector
+
+# consecutive watch-establishment/stream failures before the reflector
+# DEGRADES to paced-relist polling (the pre-watch read/repair shape).
+# Degradation is typed, counted (watch_degraded_mode / *_entries_total)
+# and self-healing: every degraded cycle still probes the watch, and a
+# successful establishment restores event-driven convergence.
+WATCH_DEGRADE_AFTER = 3
+
+
+class Reflector:
+    """Informer-style list+watch reflector over one collection path.
+
+    The convergence contract (ISSUE 12):
+
+    - LIST seeds state and the resume resourceVersion; WATCH streams
+      events from there, each event (and BOOKMARK) advancing the cursor.
+    - A clean stream end (server timeout rotation) re-watches from the
+      cursor — no relist, no event loss.
+    - A stream BREAK/STALL (transport tear, read deadline, injected
+      `kubeapi.watch` fault) relists through the decorrelated-jitter
+      backoff; `410 Gone` (cursor compacted, slow-consumer force-close,
+      injected `kubeapi.watch.stale`) relists immediately.
+    - A periodic RESYNC relist is the missed-event backstop: even an
+      event lost to a bug upstream is repaired within one resync period.
+    - AT-LEAST-ONCE delivery: relists, resyncs, duplicate deliveries
+      (`kubeapi.watch.dup`) and bookmark replays mean every handler MUST
+      be idempotent — `on_event(evt)` receives raw watch events,
+      `on_sync(items)` full list states, and neither may assume it sees
+      a state exactly once.
+    - After WATCH_DEGRADE_AFTER consecutive stream failures the
+      reflector DEGRADES to paced-relist polling (`poll_interval_s`),
+      probing the watch each cycle to recover — convergence never hangs
+      on a fabric that lost (or never had) watch support.
+
+    Counters in `stats` mutate under `_lock` (tsalint COUNTERS entry);
+    snapshot() is the lock-free fixed-key read /status serves. The run
+    thread is tracked and joined by stop() (thread-lifecycle lint)."""
+
+    STAT_KEYS = (
+        "watch_streams_active",
+        "watch_streams_established_total",
+        "watch_events_total",
+        "watch_bookmarks_total",
+        "watch_relists_total",
+        "watch_resyncs_total",
+        "watch_410_total",
+        "watch_breaks_total",
+        "watch_duplicate_deliveries_total",
+        "watch_handler_errors_total",
+        "watch_degraded_mode",
+        "watch_degraded_entries_total",
+    )
+
+    def __init__(self, api: ApiClient,
+                 path: Union[str, Callable[[], str]],
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 on_sync: Optional[Callable[[list], None]] = None,
+                 name: str = "",
+                 resync_interval_s: float = 300.0,
+                 poll_interval_s: float = 30.0,
+                 watch_timeout_s: float = 30.0,
+                 degrade_after: int = WATCH_DEGRADE_AFTER,
+                 backoff: Optional[BackoffPolicy] = None,
+                 rng: Optional[random.Random] = None,
+                 query: str = "",
+                 on_list_404: Optional[Callable[[], None]] = None) -> None:
+        self.api = api
+        # a callable path is re-resolved per request: an owner whose
+        # collection lives under a DISCOVERED API version (the DRA
+        # slice reconciler) can invalidate its cached version from
+        # on_list_404 and the very next relist/watch lands on the
+        # re-discovered path — a control-plane upgrade that drops the
+        # old version cannot 404 the reflector forever
+        self._path_src = path
+        self.on_list_404 = on_list_404
+        # extra query string (no leading separator) appended to BOTH the
+        # list and watch requests — e.g. a fieldSelector narrowing the
+        # stream to this node's own slice, so a fleet of N watchers is
+        # N streams of 1 object each, not N streams of N objects
+        self.query = query
+        self.on_event = on_event
+        self.on_sync = on_sync
+        self.name = name or self.path.rsplit("/", 1)[-1]
+        self.resync_interval_s = resync_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.watch_timeout_s = watch_timeout_s
+        self.degrade_after = max(1, degrade_after)
+        self.backoff = backoff or BackoffPolicy(base_s=0.2, cap_s=10.0,
+                                                rng=rng)
+        self._lock = lockdep.instrument(
+            "kubeapi.Reflector._lock", threading.Lock())
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._live_stream: Optional[_ApiStream] = None
+        self._rv = 0
+        self._consec_failures = 0
+        # True from a stream establishment until ANY loss of event
+        # coverage — a break, a 410 (events were lost to compaction /
+        # force-close), a failed relist. stream_live() requires it:
+        # "a stream was once established" is not "wipe detection is
+        # covered NOW".
+        self._stream_ok = False
+        self.stats = {key: 0 for key in self.STAT_KEYS}
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"reflector-{self.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        stream = self._live_stream       # GIL-atomic peek
+        if stream is not None:
+            stream.close()               # unblocks a parked readline
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+
+    def snapshot(self) -> dict:
+        """Lock-free stats read (fixed-key dict: C-atomic copy +
+        GIL-atomic int reads) — the /status surface."""
+        return dict(self.stats)
+
+    @property
+    def path(self) -> str:
+        src = self._path_src
+        return src() if callable(src) else src
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.stats["watch_degraded_mode"])
+
+    def stream_live(self) -> bool:
+        """True while the watch plane is healthy: a stream has been
+        established, the most recent attempt did not fail, and the
+        reflector is not degraded. Deliberately TRUE across the clean
+        timeout rotation between two long-polls (the cursor carries
+        over, nothing can be missed) and FALSE from a stream break until
+        the post-relist stream re-establishes — the signal the DRA
+        publish path uses to skip its liveness GET. Also FALSE from a
+        410 (compaction / slow-consumer force-close: events were LOST)
+        or a failed relist until the next establishment — the loop may
+        be stuck relisting against a congested apiserver, and skipping
+        the liveness GET then would trade a read away for a blind
+        spot."""
+        thread = self._thread
+        return (not self.degraded
+                and self._consec_failures == 0
+                and self._stream_ok
+                and thread is not None and thread.is_alive())
+
+    # ---------------------------------------------------------- run loop
+
+    def _run(self) -> None:
+        need_list = True
+        next_resync = time.monotonic() + self.resync_interval_s
+        while not self._stop.is_set():
+            if need_list or time.monotonic() >= next_resync:
+                resync = not need_list
+                try:
+                    self._relist(resync=resync)
+                except Exception as exc:
+                    log.warning("reflector %s: relist failed: %s",
+                                self.name, exc)
+                    if (isinstance(exc, ApiError) and exc.code == 404
+                            and self.on_list_404 is not None):
+                        # the collection path itself may be stale (its
+                        # API version dropped by a control-plane
+                        # upgrade): let the owner invalidate its cached
+                        # version so a callable path re-resolves on the
+                        # next attempt
+                        try:
+                            self.on_list_404()
+                        except Exception:
+                            log.exception("reflector %s: on_list_404 "
+                                          "hook raised", self.name)
+                    # a failing LIST is a failing convergence plane: it
+                    # climbs the same degradation ladder as stream
+                    # breaks — a permanently dead LIST must surface as
+                    # watch_degraded_mode=1 + paced polling, not loop
+                    # on backoff forever with the gauge still 0
+                    self._note_stream_failure(exc, relist=True)
+                    continue
+                self.backoff.reset()
+                need_list = False
+                next_resync = time.monotonic() + self.resync_interval_s
+            try:
+                self._watch_once()
+                # clean server-side rotation: re-watch from the cursor
+            except ApiError as exc:
+                if exc.code == 410:
+                    # compacted cursor / slow-consumer force-close: the
+                    # stream cannot be caught up event-by-event. Events
+                    # were LOST, so the plane is not covering until the
+                    # relist + re-watch land; pace the relist (one
+                    # backoff step, reset on relist success) so a
+                    # sustained overflow loop cannot hammer the
+                    # apiserver with back-to-back full LISTs. 410 is
+                    # protocol, not failure: it never counts toward the
+                    # degradation ladder.
+                    self._stream_ok = False
+                    with self._lock:
+                        self.stats["watch_410_total"] += 1
+                    trace.event("kubeapi.watch.gone", path=self.path)
+                    need_list = True
+                    self._sleep(self.backoff.next_delay())
+                    continue
+                need_list = self._note_stream_failure(exc)
+            except Exception as exc:
+                need_list = self._note_stream_failure(exc)
+
+    def _note_stream_failure(self, exc: BaseException, *,
+                             relist: bool = False) -> bool:
+        """Count a stream break/stall — or a failed relist, which is
+        just as much a loss of convergence coverage (relist=True skips
+        the break counter but climbs the same degradation ladder) —
+        maybe enter degraded mode, sleep the appropriate pace. Returns
+        True (a relist is always required: events may have been lost
+        mid-tear)."""
+        self._stream_ok = False
+        if self._stop.is_set():
+            # the tear IS the shutdown (stop() closing a parked or
+            # establishing stream) — not a fabric failure to count,
+            # degrade on, or sleep through
+            return True
+        self._consec_failures += 1
+        with self._lock:
+            if not relist:
+                self.stats["watch_breaks_total"] += 1
+            if (self._consec_failures >= self.degrade_after
+                    and not self.stats["watch_degraded_mode"]):
+                self.stats["watch_degraded_mode"] = 1
+                self.stats["watch_degraded_entries_total"] += 1
+                degraded_now = True
+            else:
+                degraded_now = False
+        if degraded_now:
+            log.warning(
+                "reflector %s: %d consecutive watch failures (%s); "
+                "DEGRADED to paced-relist polling every %.1fs (watch "
+                "re-probed each cycle)", self.name, self._consec_failures,
+                exc, self.poll_interval_s)
+            trace.event("kubeapi.watch.degraded", path=self.path)
+        else:
+            log.debug("reflector %s: watch stream failed (%s); relisting",
+                      self.name, exc)
+        self._sleep(self.poll_interval_s if self.degraded
+                    else self.backoff.next_delay())
+        return True
+
+    def _on_healthy(self) -> None:
+        """The stream PROVED itself — first event/bookmark read, or a
+        clean zero-event rotation. Deliberately NOT called at bare
+        establishment: an apiserver/LB that answers the watch GET but
+        tears the stream before delivering anything would otherwise
+        reset the failure counter every cycle and the degradation
+        ladder could never engage."""
+        self._consec_failures = 0
+        self._stream_ok = True
+        with self._lock:
+            if self.stats["watch_degraded_mode"]:
+                self.stats["watch_degraded_mode"] = 0
+                recovered = True
+            else:
+                recovered = False
+        if recovered:
+            log.info("reflector %s: watch stream re-established; leaving "
+                     "degraded polling", self.name)
+            trace.event("kubeapi.watch.recovered", path=self.path)
+
+    def _sleep(self, delay_s: float) -> None:
+        self._stop.wait(timeout=delay_s)
+
+    # ---------------------------------------------------------- phases
+
+    def _relist(self, resync: bool) -> None:
+        path = (f"{self.path}?{self.query}" if self.query else self.path)
+        obj = self.api.get_json(path)
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        try:
+            self._rv = int(rv)
+        except (TypeError, ValueError):
+            pass   # keep the old cursor; the next event will advance it
+        with self._lock:
+            self.stats["watch_relists_total"] += 1
+            if resync:
+                self.stats["watch_resyncs_total"] += 1
+        if self.on_sync is not None:
+            try:
+                self.on_sync(obj.get("items") or [])
+            except Exception:
+                with self._lock:
+                    self.stats["watch_handler_errors_total"] += 1
+                log.exception("reflector %s: on_sync handler raised",
+                              self.name)
+
+    def _watch_once(self) -> None:
+        rv = self._rv
+        # fault point "kubeapi.watch.stale" (value): resume from a cursor
+        # the server compacted long ago — the next answer is 410 Gone
+        if faults.fire("kubeapi.watch.stale"):
+            rv = -1
+        path = (f"{self.path}?watch=1&resourceVersion={rv}"
+                f"&timeoutSeconds={self.watch_timeout_s:g}")
+        if self.query:
+            path += f"&{self.query}"
+        stream = self.api.stream(
+            path, read_timeout_s=self.watch_timeout_s + 5.0)
+        # publish BEFORE establishment: stop() must be able to close a
+        # stream still parked in connect/getresponse (_ApiStream.close
+        # is safe pre-connect and latches, so establishment cannot
+        # resurrect it). The ordering pairs with stop() — it sets
+        # _stop, then peeks _live_stream; we set _live_stream, then
+        # check _stop — so one side always sees the other.
+        self._live_stream = stream
+        if self._stop.is_set():
+            self._live_stream = None
+            stream.close()
+            return
+        try:
+            self._watch_stream(stream)
+        finally:
+            self._live_stream = None
+
+    def _watch_stream(self, stream: "_ApiStream") -> None:
+        with trace.span("kubeapi.watch.stream", path=self.path):
+            with stream as resp:
+                reader = _StreamLineReader(resp)
+                with self._lock:
+                    self.stats["watch_streams_active"] += 1
+                    self.stats["watch_streams_established_total"] += 1
+                healthy = False
+                try:
+                    while not self._stop.is_set():
+                        # fault point "kubeapi.watch" (raising): the
+                        # stream read fails — kind=error a break,
+                        # kind=timeout a stall past the read deadline
+                        faults.fire("kubeapi.watch", path=self.path)
+                        line = reader.readline()
+                        if not line:
+                            # clean rotation: proves the stream even
+                            # with zero events; re-watch from _rv
+                            if not healthy:
+                                self._on_healthy()
+                            return
+                        self._handle_line(line)
+                        if not healthy:
+                            # only a line that PARSED as a non-ERROR
+                            # event counts as stream health: a
+                            # server-sent ERROR (slow-consumer
+                            # force-close, a 410-shaped one) raises out
+                            # of _handle_line above, and resetting the
+                            # ladder first would let a server that
+                            # streams an ERROR every establishment pin
+                            # _consec_failures at 0 forever
+                            healthy = True
+                            self._on_healthy()
+                finally:
+                    with self._lock:
+                        self.stats["watch_streams_active"] -= 1
+
+    def _handle_line(self, line: bytes) -> None:
+        evt = json.loads(line)
+        etype = evt.get("type")
+        obj = evt.get("object") or {}
+        if etype == "BOOKMARK":
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            try:
+                self._rv = int(rv)
+            except (TypeError, ValueError):
+                pass
+            with self._lock:
+                self.stats["watch_bookmarks_total"] += 1
+            return
+        if etype == "ERROR":
+            # server-sent error event (slow-consumer force-close sends a
+            # 410-shaped one): surface it under the ApiError contract so
+            # the run loop's 410/relist classification applies
+            code = obj.get("code")
+            raise ApiError(f"watch {self.path}: server error event "
+                           f"{obj}", code=int(code or 0))
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        try:
+            self._rv = int(rv)
+        except (TypeError, ValueError):
+            pass
+        with self._lock:
+            self.stats["watch_events_total"] += 1
+        self._deliver(evt)
+        # fault point "kubeapi.watch.dup" (value): the event is delivered
+        # twice — the at-least-once contract every handler must survive
+        if faults.fire("kubeapi.watch.dup"):
+            with self._lock:
+                self.stats["watch_duplicate_deliveries_total"] += 1
+                self.stats["watch_events_total"] += 1
+            self._deliver(dict(evt))
+
+    def _deliver(self, evt: dict) -> None:
+        if self.on_event is None:
+            return
+        try:
+            self.on_event(evt)
+        except Exception:
+            with self._lock:
+                self.stats["watch_handler_errors_total"] += 1
+            log.exception("reflector %s: on_event handler raised "
+                          "(resync will repair)", self.name)
 
 
 # ---------------------------------------------------------------- pacing

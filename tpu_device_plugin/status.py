@@ -320,6 +320,11 @@ class StatusServer:
                 # wave/coalesce/throttle counters and the live adaptive
                 # admission window — lock-free snapshot
                 "pacing": d.pacer.snapshot(),
+                # watch-stream convergence plane (kubeapi.Reflector):
+                # stream/event/relist/resync counters, the degraded-mode
+                # gauge, and watch-triggered repairs — zeros (enabled:
+                # false) when the driver runs in pre-watch polling mode
+                "watch": d.watch_stats(),
             }
             # attach plane: in-flight claim tasks, prepare pool size, and
             # group-commit effectiveness (commits vs claims coalesced)
@@ -675,6 +680,49 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_pacing_window_ms gauge",
                 f"tpu_plugin_dra_pacing_window_ms "
                 f"{s['dra']['pacing']['window_ms']}",
+                # watch-stream convergence plane (ISSUE 12)
+                "# HELP tpu_plugin_dra_watch_streams_active Watch streams "
+                "currently established against the apiserver.",
+                "# TYPE tpu_plugin_dra_watch_streams_active gauge",
+                f"tpu_plugin_dra_watch_streams_active "
+                f"{s['dra']['watch']['watch_streams_active']}",
+                "# HELP tpu_plugin_dra_watch_events_total Watch events "
+                "delivered to the slice reconciler (at-least-once; "
+                "duplicates counted).",
+                "# TYPE tpu_plugin_dra_watch_events_total counter",
+                f"tpu_plugin_dra_watch_events_total "
+                f"{s['dra']['watch']['watch_events_total']}",
+                "# HELP tpu_plugin_dra_watch_relists_total Collection "
+                "relists (watch resume after 410/stream break, degraded "
+                "polling, and resyncs).",
+                "# TYPE tpu_plugin_dra_watch_relists_total counter",
+                f"tpu_plugin_dra_watch_relists_total "
+                f"{s['dra']['watch']['watch_relists_total']}",
+                "# HELP tpu_plugin_dra_watch_resyncs_total Periodic "
+                "resync relists (the missed-event backstop).",
+                "# TYPE tpu_plugin_dra_watch_resyncs_total counter",
+                f"tpu_plugin_dra_watch_resyncs_total "
+                f"{s['dra']['watch']['watch_resyncs_total']}",
+                "# HELP tpu_plugin_dra_watch_degraded_mode Watch plane "
+                "degraded to paced-relist polling (1 = degraded; typed, "
+                "self-healing).",
+                "# TYPE tpu_plugin_dra_watch_degraded_mode gauge",
+                f"tpu_plugin_dra_watch_degraded_mode "
+                f"{s['dra']['watch']['watch_degraded_mode']}",
+                "# HELP tpu_plugin_dra_watch_repairs_total Slice repairs "
+                "triggered by watch observations (wiped/diverged/missing "
+                "slices republished through the guarded-write path).",
+                "# TYPE tpu_plugin_dra_watch_repairs_total counter",
+                f"tpu_plugin_dra_watch_repairs_total "
+                f"{s['dra']['watch']['watch_repairs_total']}",
+                "# HELP tpu_plugin_dra_publish_reads_skipped_total "
+                "Unchanged-projection publishes that skipped their "
+                "liveness GET because a live watch stream covers wipe "
+                "detection.",
+                "# TYPE tpu_plugin_dra_publish_reads_skipped_total "
+                "counter",
+                f"tpu_plugin_dra_publish_reads_skipped_total "
+                f"{s['dra']['publish_stats']['watch_read_skips']}",
                 # slice placement / fragmentation (placement.py)
                 "# HELP tpu_plugin_dra_frag_recomputes_total Fragmentation "
                 "snapshot rebuilds (one per inventory-epoch publish or "

@@ -105,6 +105,10 @@ SLOW_THRESHOLDS_MS: Dict[str, float] = {
     "server.Allocate": 50.0,
     "server.GetPreferredAllocation": 50.0,
     "server.ListAndWatch.send": 50.0,
+    # a watch stream's span lasts its whole long-poll rotation BY DESIGN
+    # (the server-side timeoutSeconds); duration here is lifetime, not
+    # latency, so it can never be "slow"
+    "kubeapi.watch.stream": float("inf"),
 }
 # how many slow spans the bounded log retains for /debug/flight
 _SLOW_RING = 64
