@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -178,6 +178,15 @@ bench-placement:
 # CI bench-smoke runs the --quick variant.
 bench-broker:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --broker
+
+# Attach transport-endgame bench (docs/perf.md "Transport endgame"):
+# pre-serialized hot responses — the calibrated attach wall (<200 us
+# pin), the isolated serialization A/B (same handlers, byte plane on
+# vs off), measured scheduler-wakeup and gRPC no-op RTT floors, and
+# the counted bytes-reused/serializations-per-warm-attach guards.
+# Writes docs/bench_transport_r15.json. CI bench-smoke runs --quick.
+bench-transport:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --transport
 
 # Broker + policy suites over the REAL two-process path: every
 # seam-facing assertion re-executed with a spawned broker process per

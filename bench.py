@@ -52,7 +52,7 @@ from tpu_device_plugin.config import Config
 from tpu_device_plugin.discovery import (HostSnapshot, count_reads, discover,
                                          discover_passthrough)
 from tpu_device_plugin.kubeletapi import pb
-from tpu_device_plugin.server import TpuDevicePlugin
+from tpu_device_plugin.server import LOOPBACK_GRPC_OPTIONS, TpuDevicePlugin
 from tpu_device_plugin.vtpu import VtpuDevicePlugin
 
 ITERATIONS = 300
@@ -94,10 +94,11 @@ def _build_host(root, n_devices, device_id="0063"):
 
 
 def _serve(plugin, workers=4):
-    # same channel options as the production server (server.py:169): the
-    # bench must measure the config the kubelet actually talks to
+    # same channel options as the production server (server.py
+    # LOOPBACK_GRPC_OPTIONS): the bench must measure the config the
+    # kubelet actually talks to
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=workers),
-                         options=(("grpc.optimization_target", "latency"),))
+                         options=LOOPBACK_GRPC_OPTIONS)
     api.add_device_plugin_servicer(server, plugin)
     server.add_insecure_port(f"unix://{plugin.socket_path}")
     server.start()
@@ -848,7 +849,7 @@ def _attach_burst_cell(driver, apiserver, names, k, rounds=5, workers=None):
     }
 
 
-def _calibrate_syscalls(root):
+def _calibrate_syscalls(root, rounds=300):
     """Per-syscall p50 cost of exactly the calls the attach path makes,
     measured against the same tree in the same run. The TOCTOU
     revalidation is LIVE sysfs I/O by design, so its syscall floor is an
@@ -865,7 +866,8 @@ def _calibrate_syscalls(root):
     with open(p, "w") as f:
         f.write("0x1ae0\n")
     link = os.path.join(d, "l")
-    os.symlink(p, link)
+    if not os.path.islink(link):
+        os.symlink(p, link)
     fd = os.open(p, os.O_RDONLY)
     try:
         costs = {}
@@ -875,7 +877,7 @@ def _calibrate_syscalls(root):
                          ("fstat", lambda: os.fstat(fd)),
                          ("listdir", lambda: os.listdir(d))):
             ts = []
-            for _ in range(300):
+            for _ in range(rounds):
                 t0 = time.perf_counter()
                 fn()
                 ts.append((time.perf_counter() - t0) * 1e6)
@@ -1221,9 +1223,10 @@ def run_trace_overhead(quick=False):
                 "privilege crossing is traceable by design; 0 events "
                 "warm). The documented bound the honesty guard enforces: "
                 "recorded overhead <= 35 us AND <= 10% of the untraced "
-                "wall (observed ~21 us / ~4% in this sandboxed kernel, "
+                "wall (in this sandboxed kernel, "
                 "where a monotonic read costs what a native syscall "
-                "does)"),
+                "does; observed 19-30 us / 4-7% across recordings, "
+                "swinging with co-tenant load)"),
             "trace_spans_per_attach": spans_per_attach,
             "trace_events_per_attach": events_per_attach,
             "traced_wall_p50_us": round(traced_p50, 1),
@@ -1250,6 +1253,423 @@ def run_trace_overhead(quick=False):
         return out
     finally:
         trace.reset()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _measure_sched_wakeup(rounds=300):
+    """Measured cross-thread scheduler-wakeup cost: an Event ping-pong
+    between two threads, half a round trip per handoff. This is the
+    queueing/wakeup floor a gRPC unary RPC pays at least twice (request
+    handoff to a server worker, response handoff back) — measured in-run,
+    not estimated, because it is an environment property exactly like the
+    r09 syscall floor."""
+    ev_req, ev_resp = threading.Event(), threading.Event()
+    stop = [False]
+
+    def responder():
+        while True:
+            ev_req.wait()
+            ev_req.clear()
+            if stop[0]:
+                return
+            ev_resp.set()
+
+    t = threading.Thread(target=responder, daemon=True,
+                         name="bench-wakeup-responder")
+    t.start()
+    samples = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        ev_req.set()
+        ev_resp.wait()
+        ev_resp.clear()
+        samples.append((time.perf_counter() - t0) * 1e6 / 2)
+    stop[0] = True
+    ev_req.set()
+    t.join(timeout=2)
+    return statistics.median(samples)
+
+
+def run_transport(quick=False):
+    """`bench.py --transport` (r15): the attach RPC transport endgame.
+
+    r09 made the daemon's attach compute lock-free and attributed the
+    remaining wall to handler compute + the live TOCTOU sysfs floor; the
+    gRPC transport + protobuf serialization + queueing were reported
+    UNCLAIMED. r15 attacks the serving side (pre-serialized epoch-keyed
+    response bytes, RawResponse passthrough serializers, loopback channel
+    tuning) and decomposes what remains — each component MEASURED in-run:
+
+      - `wall_p50_us`: daemon-side attach critical path with the byte
+        plane live (cold preferred memo + Allocate, handlers driven with
+        RAW_CONTEXT so they produce exactly the wire bytes the transport
+        serializer forwards untouched).
+      - `sysfs_io_floor_p50_us`: counted attach syscalls x in-run
+        calibrated per-syscall cost (r09 methodology, unchanged by this
+        round — the TOCTOU guard stays live by design).
+      - HEADLINE `value` = wall - floor: the environment-calibrated wall
+        the < 200 us acceptance pin guards (raw wall in this sandboxed
+        kernel is dominated by ~20-30 us syscalls that cost <1 us on the
+        native kernel BENCH_r05 recorded).
+      - serialization: interleaved A/B per iteration — the PRE-PR path
+        (build response protos per call + the SerializeToString the
+        transport then paid) vs the byte plane (the live handlers,
+        including their span/lockdep overhead — the comparison is biased
+        AGAINST the byte plane, which makes the win honest).
+      - queueing/scheduler wakeup: measured Event ping-pong handoff
+        (half a round trip), the floor a unary RPC pays >= 2x.
+      - gRPC framing: measured no-op RPC (GetDevicePluginOptions — empty
+        request, 2-field response) over the tuned loopback channel;
+        `grpc_framing_p50_us` = noop RTT - 2 x wakeup is the only
+        DERIVED number, and it is arithmetic on two measured ones.
+      - `transport_wall_p50_us`: the r05-comparable 2-RPC gRPC wall with
+        the byte plane + RawResponse passthrough + tuned channel live,
+        and the residual it leaves unattributed.
+      - COUNTED (load-insensitive, the CI pins): bytes-reused and
+        serializations per WARM attach — 2 reused, 0 serializations, or
+        the byte plane is not actually serving bytes.
+
+    Writes docs/bench_transport_r15.json ($BENCH_TRANSPORT_OUT overrides).
+    """
+    iters = 400 if quick else 2000
+    warm = 40 if quick else 100
+    iters_grpc = 80 if quick else ITERATIONS
+    warm_grpc = 10 if quick else WARMUP
+    root = tempfile.mkdtemp(prefix="tdptransport-")
+    try:
+        _build_host(root, 8)
+        cfg = Config().with_root(root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        registry, generations = discover_passthrough(cfg)
+        devices = registry.devices_by_model["0063"]
+        torus = generations["0063"].host_topology
+        plugin = TpuDevicePlugin(cfg, "v5e", registry, devices,
+                                 torus_dims=torus)
+        all_ids = [d.bdf for d in devices]
+        pref_req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=all_ids, allocation_size=4)])
+        RAW = api.RAW_CONTEXT
+
+        def attach_bytes_once():
+            """One serving-side attach on the byte plane: the handlers
+            produce the exact wire payloads (RawResponse) the passthrough
+            serializer forwards; the client-side parse between the two
+            RPCs is excluded from the timed windows (the kubelet pays it,
+            not the daemon)."""
+            plugin._pref_cache.clear()
+            t0 = time.perf_counter()
+            pref_raw = plugin.GetPreferredAllocation(pref_req, RAW)
+            t1 = time.perf_counter()
+            picked = list(pb.PreferredAllocationResponse.FromString(
+                pref_raw.data).container_responses[0].deviceIDs)
+            alloc_req = pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=picked)])
+            t2 = time.perf_counter()
+            alloc_raw = plugin.Allocate(alloc_req, RAW)
+            t3 = time.perf_counter()
+            assert len(alloc_raw.data) > 50
+            return (t1 - t0) + (t3 - t2), (t1 - t0), (t3 - t2)
+
+        # The A/B twin: byte_plane=False routes the SAME handlers (same
+        # spans, same read-path brackets, same TOCTOU revalidation)
+        # through the pre-PR build-protos-per-call path; the explicit
+        # SerializeToString is what the transport serializer then paid.
+        # Only the serialization strategy differs between the arms.
+        plugin_reser = TpuDevicePlugin(cfg, "v5e", registry, devices,
+                                       torus_dims=torus, byte_plane=False)
+
+        def attach_reser_once():
+            plugin_reser._pref_cache.clear()
+            t0 = time.perf_counter()
+            pref = plugin_reser.GetPreferredAllocation(pref_req, None)
+            pref_bytes = pref.SerializeToString()
+            t1 = time.perf_counter()
+            alloc_req = pb.AllocateRequest(container_requests=[
+                pb.ContainerAllocateRequest(devices_ids=list(
+                    pref.container_responses[0].deviceIDs))])
+            t2 = time.perf_counter()
+            aresp = plugin_reser.Allocate(alloc_req, None)
+            alloc_bytes = aresp.SerializeToString()
+            t3 = time.perf_counter()
+            assert pref_bytes and len(alloc_bytes) > 50
+            return (t1 - t0) + (t3 - t2)
+
+        # exact syscall counts for one steady-state attach (counted —
+        # load-insensitive; the floor multiplies these by the adjacent
+        # per-epoch calibration below)
+        for _ in range(3):
+            attach_bytes_once()                      # warm slow paths
+        syscalls = _count_attach_syscalls(lambda: attach_bytes_once())
+
+        # interleaved A/B + INTERLEAVED floor calibration: one sample of
+        # each attach-path syscall is taken per iteration, against the
+        # same tree, BETWEEN the timed attaches — so the wall medians and
+        # the per-syscall calibration medians see the exact same
+        # co-tenant load distribution. A floor calibrated in its own
+        # block minutes away mispairs by 100+ us run-to-run on this
+        # shared core (a spike inside a short calibration block can even
+        # push the paired difference negative); time-interleaved medians
+        # subtract meaningfully.
+        for _ in range(warm):
+            attach_bytes_once()
+            attach_reser_once()
+        cal_dir = os.path.join(root, "sys", "bus", "pci", "devices",
+                               "_cal")
+        os.makedirs(cal_dir, exist_ok=True)
+        cal_file = os.path.join(cal_dir, "f")
+        with open(cal_file, "w") as f:
+            f.write("0x1ae0\n")
+        cal_link = os.path.join(cal_dir, "l")
+        os.symlink(cal_file, cal_link)
+        cal_fd = os.open(cal_file, os.O_RDONLY)
+        cal_fns = (("stat", lambda: os.stat(cal_file)),
+                   ("readlink", lambda: os.readlink(cal_link)),
+                   ("pread", lambda: os.pread(cal_fd, 256, 0)),
+                   ("fstat", lambda: os.fstat(cal_fd)),
+                   ("listdir", lambda: os.listdir(cal_dir)))
+        cal_samples = {name: [] for name, _ in cal_fns}
+        bytes_us, reser_us, pref_us, alloc_us = [], [], [], []
+        try:
+            for _i in range(iters):
+                wb, p, a = attach_bytes_once()
+                wr = attach_reser_once()
+                bytes_us.append(wb * 1e6)
+                pref_us.append(p * 1e6)
+                alloc_us.append(a * 1e6)
+                reser_us.append(wr * 1e6)
+                for name, fn in cal_fns:
+                    t0 = time.perf_counter()
+                    fn()
+                    cal_samples[name].append(
+                        (time.perf_counter() - t0) * 1e6)
+        finally:
+            os.close(cal_fd)
+        cal = {name: round(statistics.median(ts), 2)
+               for name, ts in cal_samples.items()}
+        floor_us = sum(syscalls[name] * cal[name] for name in syscalls)
+        # per-epoch paired differences (recorded for drift visibility,
+        # not pinned — the run-median pair is the headline)
+        n_epochs = EPOCHS
+        per_epoch = len(bytes_us) // n_epochs
+        calibrated_per_epoch = []
+        for e in range(n_epochs):
+            sl = slice(e * per_epoch, (e + 1) * per_epoch)
+            floor_e = sum(
+                syscalls[name]
+                * statistics.median(cal_samples[name][sl])
+                for name in syscalls)
+            calibrated_per_epoch.append(
+                statistics.median(bytes_us[sl]) - floor_e)
+
+        # ISOLATED serialization component (the breakdown's
+        # "serialization" number): response CONSTRUCTION only, with the
+        # TOCTOU revalidation stubbed to a no-op on two dedicated
+        # planners — the live-syscall floor (~12 x 30-50 us in this
+        # sandbox, high variance) otherwise swamps the ~tens-of-us
+        # serialization delta the A/B exists to measure. Interleaved per
+        # iteration like every A/B here; the revalidation is NOT part of
+        # either arm by construction, so stubbing it is isolation, not
+        # dishonesty (the end-to-end arms above keep it live).
+        class _NoReval:
+            mode = "inproc"
+
+            def revalidate_batch(self, planner, items):
+                return None
+
+        from tpu_device_plugin.allocate import AllocationPlanner
+        iso_bytes_planner = AllocationPlanner(
+            cfg, registry, "v5e", broker_client=_NoReval())
+        iso_reser_planner = AllocationPlanner(
+            cfg, registry, "v5e", broker_client=_NoReval(),
+            byte_records=False)
+        iso_req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devices_ids=all_ids[:4])])
+        iso_bytes_planner.allocate_response_bytes(iso_req, epoch=1)  # warm
+        iso_reser_planner.allocate_response(iso_req, epoch=1)
+        iso_bytes_us, iso_reser_us = [], []
+        for i in range(iters + warm):
+            t0 = time.perf_counter()
+            data = iso_bytes_planner.allocate_response_bytes(iso_req,
+                                                             epoch=1)
+            t1 = time.perf_counter()
+            wire = iso_reser_planner.allocate_response(
+                iso_req, epoch=1).SerializeToString()
+            t2 = time.perf_counter()
+            if i >= warm:
+                iso_bytes_us.append((t1 - t0) * 1e6)
+                iso_reser_us.append((t2 - t1) * 1e6)
+            assert len(data) > 50 and len(wire) > 50
+
+        # warm serving wall: the kubelet re-asking with an unchanged
+        # availability set — the full byte-reuse path end to end
+        plugin.GetPreferredAllocation(pref_req, RAW)   # prime the memo
+        warm_req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devices_ids=all_ids[:4])])
+        warm_us = []
+        for i in range(iters // 2 + warm // 2):
+            t0 = time.perf_counter()
+            plugin.GetPreferredAllocation(pref_req, RAW)
+            plugin.Allocate(warm_req, RAW)
+            if i >= warm // 2:
+                warm_us.append((time.perf_counter() - t0) * 1e6)
+
+        # COUNTED: bytes reused / serializations per warm attach
+        r0 = plugin._alloc_bytes_reused.value
+        s0 = plugin._alloc_serializations.value
+        plugin.GetPreferredAllocation(pref_req, RAW)
+        plugin.Allocate(warm_req, RAW)
+        reused_per_attach = plugin._alloc_bytes_reused.value - r0
+        ser_per_attach = plugin._alloc_serializations.value - s0
+
+        # queueing/scheduler-wakeup floor (measured)
+        wakeup_us = _measure_sched_wakeup()
+
+        # gRPC phase: no-op RTT + the r05-comparable 2-RPC wall over the
+        # tuned loopback channel with the passthrough serializers live
+        server = _serve(plugin, workers=4)
+        noop_us = []
+        with grpc.insecure_channel(
+                f"unix://{plugin.socket_path}",
+                options=LOOPBACK_GRPC_OPTIONS) as ch:
+            stub = api.DevicePluginStub(ch)
+            for i in range(iters_grpc + warm_grpc):
+                t0 = time.perf_counter()
+                stub.GetDevicePluginOptions(pb.Empty(), timeout=5)
+                if i >= warm_grpc:
+                    noop_us.append((time.perf_counter() - t0) * 1e6)
+            _, transport_us = _attach_path(stub, all_ids, 4,
+                                           iters_grpc, warm_grpc)
+        server.stop(0)
+
+        wall_p50 = statistics.median(bytes_us)
+        wall_best = _min_epoch_p50(bytes_us, epochs=n_epochs)
+        warm_p50 = statistics.median(warm_us)
+        reser_p50 = statistics.median(reser_us)
+        noop_p50 = statistics.median(noop_us)
+        transport_p50 = statistics.median(transport_us)
+        # the PINNED number: run-median wall minus the time-interleaved
+        # run-median floor — both halves saw the same load distribution
+        calibrated = wall_p50 - floor_us
+
+        # r09's recorded daemon overhead is the like-for-like baseline
+        # for the calibrated wall (same estimator composition, same
+        # environment-calibration discipline)
+        r09_overhead = 86.3
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "docs", "bench_attach_r09.json")) as f:
+                r09_overhead = float(
+                    json.load(f)["daemon_overhead_p50_us"])
+        except (OSError, KeyError, ValueError, TypeError):
+            pass
+        out = {
+            "metric": "attach_wall_calibrated_p50_us",
+            "value": round(calibrated, 1),
+            "unit": "us",
+            "vs_baseline": round(r09_overhead / calibrated, 3)
+            if calibrated > 0 else None,
+            "baseline_source": (
+                "r09 daemon_overhead_p50_us (docs/bench_attach_r09.json: "
+                "attach wall minus the counted-syscalls x in-run-"
+                "calibrated sysfs floor — the environment-comparable "
+                "number). r15 keeps the estimator composition (cold "
+                "preferred memo + Allocate, direct servicer calls) but "
+                "measures the handlers PRODUCING THE WIRE BYTES "
+                "(RAW_CONTEXT — the exact payload the passthrough "
+                "serializer forwards), work r09's message-returning "
+                "estimator never paid, so the ratio UNDERSTATES the "
+                "serving-side win. The <200 us acceptance pin guards "
+                "`value` = run-median wall minus the TIME-INTERLEAVED "
+                "run-median floor (one sample of each attach syscall "
+                "taken between the timed attaches, so both medians see "
+                "the identical co-tenant load distribution — a floor "
+                "calibrated in its own block mispairs by 100+ us on "
+                "this shared core; calibrated_per_epoch_us records the "
+                "per-epoch paired drift). The A/B arms run the SAME handler code "
+                "interleaved per iteration (byte_plane=False routes the "
+                "identical spans/brackets/TOCTOU through the pre-PR "
+                "build-protos-per-call + SerializeToString path) — only "
+                "the serialization strategy differs; because the live "
+                "syscall floor's variance dominates those end-to-end "
+                "arms in this sandbox, the PINNED serialization number "
+                "is the isolated pair (serialization_*_p50_us: response "
+                "construction only, revalidation stubbed on both arms). "
+                "transport_wall_p50_us is the r05-comparable 2-RPC gRPC "
+                "wall, now with passthrough serializers + loopback "
+                "tuning; its queueing and framing components are "
+                "measured (sched_wakeup, noop RTT), framing and the "
+                "residual are the only derived fields"),
+            "wall_p50_us": round(wall_p50, 1),
+            "wall_best_epoch_p50_us": round(wall_best, 1),
+            "calibrated_per_epoch_us": [round(c, 1)
+                                        for c in calibrated_per_epoch],
+            "wall_p99_us": round(
+                statistics.quantiles(bytes_us, n=100)[98], 1),
+            "pref_cold_p50_us": round(statistics.median(pref_us), 1),
+            "allocate_p50_us": round(statistics.median(alloc_us), 1),
+            "warm_wall_p50_us": round(warm_p50, 1),
+            # the r09 floor discipline
+            "sysfs_syscalls_per_attach": syscalls,
+            "sysfs_syscalls_per_attach_total": sum(syscalls.values()),
+            "syscall_cost_calibration_us": cal,
+            "sysfs_io_floor_p50_us": round(floor_us, 1),
+            # serialization, isolated (the breakdown component + the
+            # robust pin: response construction only, revalidation
+            # stubbed on BOTH arms — no syscall noise)
+            "serialization_reserialize_p50_us": round(
+                statistics.median(iso_reser_us), 1),
+            "serialization_bytes_p50_us": round(
+                statistics.median(iso_bytes_us), 1),
+            "serialization_saved_p50_us": round(
+                statistics.median(iso_reser_us)
+                - statistics.median(iso_bytes_us), 1),
+            # serialization, end-to-end (recorded unpinned: the live
+            # syscall floor's variance dominates arm-to-arm deltas)
+            "ab_reserialize_wall_p50_us": round(reser_p50, 1),
+            "ab_bytes_wall_p50_us": round(wall_p50, 1),
+            "serialization_p50_us": round(reser_p50 - wall_p50, 1),
+            # queueing + framing (measured; framing derived from the two)
+            "sched_wakeup_p50_us": round(wakeup_us, 1),
+            "grpc_noop_rtt_p50_us": round(noop_p50, 1),
+            "grpc_framing_p50_us": round(noop_p50 - 2 * wakeup_us, 1),
+            # the kubelet-visible 2-RPC wall and what it leaves over
+            "transport_wall_p50_us": round(transport_p50, 1),
+            "transport_wall_p99_us": round(
+                statistics.quantiles(transport_us, n=100)[98], 1),
+            "transport_vs_r05": round(761.9 / transport_p50, 3),
+            "transport_unattributed_p50_us": round(
+                transport_p50 - 2 * noop_p50 - warm_p50, 1),
+            # counted (load-insensitive): the CI pins
+            "bytes_reused_per_warm_attach": reused_per_attach,
+            "serializations_per_warm_attach": ser_per_attach,
+            "devices_advertised": len(devices),
+            "allocation_size": 4,
+            "iterations": iters,
+            "quick": quick,
+        }
+        out_path = os.environ.get("BENCH_TRANSPORT_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "docs", "bench_transport_r15.json")
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+        out["matrix_file"] = os.path.relpath(
+            out_path, os.path.dirname(os.path.abspath(__file__)))
+        print(f"  attach wall p50 {wall_p50:7.1f} us - interleaved floor "
+              f"{floor_us:.1f} us = calibrated {calibrated:7.1f} us "
+              f"(<200 pin; per-epoch "
+              f"{[round(c) for c in calibrated_per_epoch]}) | "
+              f"serialization (isolated) "
+              f"{out['serialization_reserialize_p50_us']:.1f} -> "
+              f"{out['serialization_bytes_p50_us']:.1f} us (saved "
+              f"{out['serialization_saved_p50_us']:.1f}) | warm "
+              f"{warm_p50:6.1f} us | wakeup {wakeup_us:.1f} us | noop RTT "
+              f"{noop_p50:.1f} us | transport {transport_p50:7.1f} us | "
+              f"warm attach counted: {reused_per_attach} reused / "
+              f"{ser_per_attach} serialized", file=sys.stderr)
+        return out
+    finally:
         shutil.rmtree(root, ignore_errors=True)
 
 
@@ -2307,6 +2727,9 @@ def main() -> int:
         return 0
     if "--trace-overhead" in sys.argv:
         print(json.dumps(run_trace_overhead(quick="--quick" in sys.argv)))
+        return 0
+    if "--transport" in sys.argv:
+        print(json.dumps(run_transport(quick="--quick" in sys.argv)))
         return 0
     if "--attach" in sys.argv:
         result = run_attach(quick="--quick" in sys.argv)

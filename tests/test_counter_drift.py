@@ -35,6 +35,24 @@ SURFACES = {
     ("server.TpuDevicePlugin", "_restart_count"): {
         "status": "plugins[*].restarts",
         "metrics": "tpu_plugin_restarts_total"},
+    # response byte plane (round 15): lock-free-owned (tsalint LOCKFREE
+    # sentinel) but surfaced like every other counter — the drift test
+    # fails if either surface is missed
+    ("server.TpuDevicePlugin", "_alloc_bytes_reused"): {
+        "status": "plugins[*].response_bytes.reused",
+        "metrics": "tpu_plugin_alloc_bytes_reused_total"},
+    ("server.TpuDevicePlugin", "_alloc_serializations"): {
+        "status": "plugins[*].response_bytes.serializations",
+        "metrics": "tpu_plugin_alloc_serializations_total"},
+    ("server.TpuDevicePlugin", "_self_dial_reuses"): {
+        "status": "plugins[*].self_dial_reuses",
+        "metrics": "tpu_plugin_self_dial_reuses_total"},
+    ("dra.DraDriver", "_ack_bytes_reused"): {
+        "status": "dra.ack_bytes.reused",
+        "metrics": "tpu_plugin_dra_ack_bytes_reused_total"},
+    ("dra.DraDriver", "_ack_serializations"): {
+        "status": "dra.ack_bytes.serializations",
+        "metrics": "tpu_plugin_dra_ack_serializations_total"},
     ("healthhub.HealthHub", "_probe_cycles"): {
         "status": "health.probe_cycles_total",
         "metrics": "tpu_plugin_health_probe_cycles_total"},

@@ -1128,3 +1128,114 @@ def test_bench_autopilot_r14_pins_watch_convergence_soak():
     assert rr["read_reduction_x"] >= 5.0, rr
     assert rr["watch_reads"] < rr["poll_reads"]
     assert rr["wipe_healed_by_watch"] and rr["exactly_once"]
+
+
+def test_bench_transport_r15_pins_preserialized_attach():
+    """Round-15 honesty pins against the RECORDED
+    docs/bench_transport_r15.json (ISSUE 13, transport endgame):
+
+      - the environment-calibrated attach wall (raw wall minus the
+        counted-syscalls x in-run-calibrated sysfs floor, r09's
+        discipline) is under the 200 us acceptance target with the byte
+        plane live;
+      - the serialization A/B holds on the ISOLATED pair (response
+        construction only, revalidation stubbed on both arms — the
+        end-to-end arms are recorded but unpinned because the live
+        syscall floor's variance dominates them): the byte plane
+        assembles a response cheaper than build-protos + serialize;
+      - COUNTED: a warm attach reuses exactly 2 pre-serialized responses
+        (GetPreferredAllocation + Allocate) and pays 0 response-plane
+        serializations;
+      - the TOCTOU revalidation stayed live (readlink per allocated
+        member — caching it away would be the dishonest speedup);
+      - the wall decomposition is present and each non-derived component
+        was measured in-run (sched wakeup, noop RTT, syscall
+        calibration).
+    """
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_transport_r15.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    # the acceptance pin: sub-200 us environment-calibrated attach wall —
+    # run-median wall minus the TIME-INTERLEAVED run-median floor (both
+    # halves of the subtraction saw the same co-tenant load
+    # distribution; the per-epoch paired drift is recorded alongside)
+    assert d["value"] < 200, d
+    assert d["value"] == pytest.approx(
+        d["wall_p50_us"] - d["sysfs_io_floor_p50_us"], abs=0.2)
+    assert len(d["calibrated_per_epoch_us"]) >= 4
+    # the serialization A/B (isolated pair — syscall-noise-free)
+    assert d["serialization_bytes_p50_us"] \
+        <= d["serialization_reserialize_p50_us"], d
+    assert d["serialization_saved_p50_us"] >= 0, d
+    # the end-to-end arms are recorded alongside (unpinned)
+    assert d["ab_bytes_wall_p50_us"] > 0 \
+        and d["ab_reserialize_wall_p50_us"] > 0
+    # counted: the byte plane is live, not just recorded
+    assert d["bytes_reused_per_warm_attach"] == 2
+    assert d["serializations_per_warm_attach"] == 0
+    # counted: the TOCTOU guard stayed live
+    sys_counts = d["sysfs_syscalls_per_attach"]
+    assert sys_counts["readlink"] == d["allocation_size"]
+    assert d["sysfs_io_floor_p50_us"] > 0
+    # the breakdown components were measured in-run
+    assert d["sched_wakeup_p50_us"] > 0
+    assert d["grpc_noop_rtt_p50_us"] > 0
+    assert d["syscall_cost_calibration_us"]["stat"] > 0
+    assert d["transport_wall_p50_us"] > 0
+    assert d["devices_advertised"] == 8 and d["allocation_size"] == 4
+
+
+def test_attach_bytes_reused_is_live_not_just_recorded_r15(short_root):
+    """Runtime half of the r15 pin (counted, load-insensitive — the CI
+    bench-smoke job runs this next to the artifact pins): a WARM attach
+    on the current tree serves both hot responses from pre-serialized
+    bytes (2 reused), pays zero response-plane serializations, and the
+    raw payloads parse back identical to the message path's protos."""
+    import os
+
+    from tests.fakehost import FakeChip, FakeHost
+    from tpu_device_plugin import kubeletapi as kapi
+    from tpu_device_plugin.config import Config
+    from tpu_device_plugin.discovery import discover_passthrough
+    from tpu_device_plugin.kubeletapi import pb
+    from tpu_device_plugin.server import TpuDevicePlugin
+
+    host = FakeHost(short_root)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i), numa_node=i // 2))
+    cfg = Config().with_root(host.root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, generations = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"],
+                             torus_dims=generations["0062"].host_topology)
+    ids = sorted(registry.bdf_to_group)
+    pref_req = pb.PreferredAllocationRequest(container_requests=[
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=ids, allocation_size=2)])
+    alloc_req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=ids[:2])])
+    # warm-up: memo miss + fragment builds are allowed to serialize
+    plugin.GetPreferredAllocation(pref_req, None)
+    plugin.Allocate(alloc_req, None)
+    expected_pref = plugin.GetPreferredAllocation(pref_req, None)
+    expected_alloc = plugin._planner.allocate_response(
+        alloc_req, epoch=plugin._store.current.epoch_id)
+
+    r0 = plugin._alloc_bytes_reused.value
+    s0 = plugin._alloc_serializations.value
+    pref_raw = plugin.GetPreferredAllocation(pref_req, kapi.RAW_CONTEXT)
+    alloc_raw = plugin.Allocate(alloc_req, kapi.RAW_CONTEXT)
+    assert plugin._alloc_bytes_reused.value - r0 == 2, \
+        "warm attach did not serve both responses from the byte plane"
+    assert plugin._alloc_serializations.value - s0 == 0, \
+        "warm attach paid a response-plane serialization"
+    assert pb.PreferredAllocationResponse.FromString(
+        pref_raw.data) == expected_pref
+    assert pb.AllocateResponse.FromString(alloc_raw.data) == expected_alloc

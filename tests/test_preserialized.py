@@ -1,0 +1,264 @@
+"""Pre-serialized hot-response correctness (ISSUE 13, transport endgame).
+
+The byte plane (epoch.encode_delimited + the epoch-keyed segment caches
+in allocate.py / server.py / dra.py) must be INVISIBLE on the wire: a
+response assembled from cached byte segments has to parse back identical
+to the proto the message path would have built — across an epoch bump, a
+health flip, a multi-container request, and a policy-hook override (the
+policy path must bypass the byte cache, never serve a stale winner).
+"""
+
+import os
+import shutil
+import tempfile
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import kubeletapi as api
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.kubeletapi import drapb, pb
+from tpu_device_plugin.server import TpuDevicePlugin
+
+RAW = api.RAW_CONTEXT
+
+
+@pytest.fixture()
+def rig():
+    root = tempfile.mkdtemp(prefix="tdpbytes-")
+    host = FakeHost(root)
+    for i in range(4):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                               iommu_group=str(11 + i),
+                               vfio_dev=f"vfio{i}", numa_node=i // 2))
+    host.enable_iommufd()
+    cfg = Config().with_root(root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, generations = discover_passthrough(cfg)
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"],
+                             torus_dims=generations["0062"].host_topology,
+                             cdi_enabled=True)
+    yield host, cfg, registry, generations, plugin
+    shutil.rmtree(root, ignore_errors=True)
+
+
+def _alloc_req(ids):
+    return pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=ids)])
+
+
+def _fresh_allocate(plugin, req):
+    """The freshly-built proto the byte path must be indistinguishable
+    from: the planner's message path at the SAME epoch."""
+    return plugin._planner.allocate_response(
+        req, epoch=plugin._store.current.epoch_id)
+
+
+# ------------------------------------------------------------ Allocate
+
+
+def test_allocate_bytes_parse_identical_to_fresh_proto(rig):
+    _, _, registry, _, plugin = rig
+    ids = sorted(registry.bdf_to_group)
+    req = _alloc_req(ids[:2])
+    raw = plugin.Allocate(req, RAW)
+    assert isinstance(raw, api.RawResponse)
+    parsed = pb.AllocateResponse.FromString(raw.data)
+    assert parsed == _fresh_allocate(plugin, req)
+    # the parse-path direct call serves the same bytes
+    assert plugin.Allocate(req, None) == parsed
+    # the response carries everything the reference contract needs
+    cresp = parsed.container_responses[0]
+    assert cresp.envs and cresp.devices and cresp.cdi_devices
+
+
+def test_allocate_bytes_identical_across_epoch_bump_and_health_flip(rig):
+    host, _, registry, _, plugin = rig
+    ids = sorted(registry.bdf_to_group)
+    req = _alloc_req(ids[:2])
+    before = pb.AllocateResponse.FromString(plugin.Allocate(req, RAW).data)
+    ep0 = plugin._store.current.epoch_id
+    # health flip: down then up — two epoch publishes, fragment caches
+    # retired by construction (epoch-keyed)
+    plugin.set_devices_health([ids[0]], False, source="t")
+    plugin.set_devices_health([ids[0]], True, source="t")
+    assert plugin._store.current.epoch_id == ep0 + 2
+    after = pb.AllocateResponse.FromString(plugin.Allocate(req, RAW).data)
+    assert after == before == _fresh_allocate(plugin, req)
+
+
+def test_allocate_bytes_multi_container_coalesced(rig):
+    """The coalesced multi-container fast path: one request, two
+    containers — parse-identical to the per-container message path AND
+    one privilege crossing for the whole request."""
+    from tpu_device_plugin import broker
+
+    _, _, registry, _, plugin = rig
+    ids = sorted(registry.bdf_to_group)
+    req = pb.AllocateRequest(container_requests=[
+        pb.ContainerAllocateRequest(devices_ids=ids[:2]),
+        pb.ContainerAllocateRequest(devices_ids=ids[2:4])])
+    expected = _fresh_allocate(plugin, req)
+    before = broker.get_client().client_stats()["crossings_total"]
+    raw = plugin.Allocate(req, RAW)
+    crossings = (broker.get_client().client_stats()["crossings_total"]
+                 - before)
+    assert pb.AllocateResponse.FromString(raw.data) == expected
+    assert len(expected.container_responses) == 2
+    assert crossings == 1, \
+        f"multi-container Allocate paid {crossings} crossings (want 1: " \
+        f"the coalesced batched revalidation)"
+
+
+def test_allocate_warm_path_reuses_bytes_and_serializes_nothing(rig):
+    _, _, registry, _, plugin = rig
+    ids = sorted(registry.bdf_to_group)
+    req = _alloc_req(ids[:2])
+    plugin.Allocate(req, RAW)          # warm (fragment builds serialize)
+    r0 = plugin._alloc_bytes_reused.value
+    s0 = plugin._alloc_serializations.value
+    for _ in range(3):
+        plugin.Allocate(req, RAW)
+    assert plugin._alloc_bytes_reused.value - r0 == 3
+    assert plugin._alloc_serializations.value - s0 == 0
+
+
+# ------------------------------------------- GetPreferredAllocation
+
+
+def _pref_req(ids, size=2):
+    return pb.PreferredAllocationRequest(container_requests=[
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=ids, allocation_size=size)])
+
+
+def test_pref_bytes_parse_identical_and_reused_across_epoch_bump(rig):
+    _, _, registry, _, plugin = rig
+    ids = sorted(registry.bdf_to_group)
+    req = _pref_req(ids)
+    first = plugin.GetPreferredAllocation(req, None)     # miss: serializes
+    r0 = plugin._alloc_bytes_reused.value
+    raw = plugin.GetPreferredAllocation(req, RAW)        # warm: byte memo
+    assert isinstance(raw, api.RawResponse)
+    assert pb.PreferredAllocationResponse.FromString(raw.data) == first
+    assert plugin._alloc_bytes_reused.value == r0 + 1
+    # epoch bump retires the memo wholesale; the recomputed answer (the
+    # scan is pure in availability/size, health is not an input) still
+    # parses identical
+    plugin.set_devices_health([ids[0]], False, source="t")
+    misses0 = plugin._pref_misses.value
+    again = plugin.GetPreferredAllocation(req, RAW)
+    assert plugin._pref_misses.value == misses0 + 1
+    assert pb.PreferredAllocationResponse.FromString(again.data) == first
+
+
+def test_policy_override_bypasses_pref_byte_cache(rig):
+    """The hazard: the memo holds the BUILTIN answer's bytes; with a
+    scoring hook loaded, a memo hit must never short-circuit past the
+    policy — the override is serialized fresh, the cached builtin bytes
+    are never served, and the bytes-reused counter does not move."""
+    from tests.test_policy import engine_with
+
+    _, cfg, registry, generations, _ = rig
+    engine = engine_with(
+        "def score_allocation(ctx):\n"
+        "    ranked = sorted(ctx['available'], reverse=True)\n"
+        "    return ranked[:ctx['size']]\n")
+    plugin = TpuDevicePlugin(cfg, "v4", registry,
+                             registry.devices_by_model["0062"],
+                             torus_dims=generations["0062"].host_topology,
+                             policy=engine)
+    ids = sorted(registry.bdf_to_group)
+    req = _pref_req(ids)
+    want = sorted(ids, reverse=True)[:2]
+    first = plugin.GetPreferredAllocation(req, RAW)
+    assert list(pb.PreferredAllocationResponse.FromString(first.data)
+                .container_responses[0].deviceIDs) == want
+    # the memo now holds the builtin answer (+ its bytes) for this key —
+    # prove the SECOND call (a memo hit) still serves the override
+    key = next(iter(plugin._pref_cache))
+    builtin_ids = plugin._pref_cache[key][0]
+    assert list(builtin_ids) != want
+    r0 = plugin._alloc_bytes_reused.value
+    second = plugin.GetPreferredAllocation(req, RAW)
+    assert list(pb.PreferredAllocationResponse.FromString(second.data)
+                .container_responses[0].deviceIDs) == want
+    assert plugin._alloc_bytes_reused.value == r0, \
+        "a policy-overridden answer must never count as byte reuse"
+
+
+# -------------------------------------------------------- ListAndWatch
+
+
+def test_lw_raw_send_is_the_epoch_payload(rig):
+    _, _, _, _, plugin = rig
+    ep = plugin._store.current
+    raw = plugin._lw_response(ep, raw=True)
+    assert isinstance(raw, api.RawResponse)
+    assert raw.data == ep.lw_payload
+    assert (pb.ListAndWatchResponse.FromString(raw.data)
+            == plugin._lw_response(ep))
+
+
+# ------------------------------------------------- DRA prepare acks
+
+
+def test_dra_prepare_ack_bytes_parse_identical_and_reused():
+    from tests.test_dra import FakeApiServer, make_driver
+
+    root = tempfile.mkdtemp(prefix="tdpdraack-")
+    apiserver = FakeApiServer()
+    try:
+        host = FakeHost(root)
+        for i in range(2):
+            host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0",
+                                   device_id="0063",
+                                   iommu_group=str(11 + i)))
+        cfg = Config().with_root(root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        driver = make_driver(cfg, apiserver)
+        from tpu_device_plugin.dra import slice_device_name
+        name = slice_device_name("0000:00:04.0")
+        apiserver.add_claim("ns", "c1", "uid-1", driver.driver_name,
+                            [{"device": name}])
+        claim = drapb.Claim(namespace="ns", name="c1", uid="uid-1")
+        req = drapb.NodePrepareResourcesRequest(claims=[claim])
+
+        first = driver.NodePrepareResources(req, None)
+        assert first.claims["uid-1"].error == ""
+        assert len(first.claims["uid-1"].devices) == 1
+        # the freshly-built proto the ack bytes must match
+        entry = driver._checkpoint["uid-1"]
+        expected = drapb.NodePrepareResourcesResponse()
+        expected.claims["uid-1"].devices.extend(
+            drapb.Device(**d) for d in entry["devices"])
+        assert first == expected
+
+        # idempotent kubelet retry: the ack segment is REUSED (counted)
+        r0 = driver._ack_bytes_reused.value
+        s0 = driver._ack_serializations.value
+        raw = driver.NodePrepareResources(req, RAW)
+        assert isinstance(raw, api.RawResponse)
+        assert (drapb.NodePrepareResourcesResponse.FromString(raw.data)
+                == expected)
+        assert driver._ack_bytes_reused.value == r0 + 1
+        assert driver._ack_serializations.value == s0
+
+        # a failed claim's error ack rides the same assembly
+        bad = drapb.Claim(namespace="ns", name="nope", uid="uid-missing")
+        both = driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[claim, bad]), RAW)
+        parsed = drapb.NodePrepareResourcesResponse.FromString(both.data)
+        assert parsed.claims["uid-1"] == expected.claims["uid-1"]
+        assert parsed.claims["uid-missing"].error != ""
+
+        # unprepare retires the cached segment with the entry
+        driver.NodeUnprepareResources(
+            drapb.NodeUnprepareResourcesRequest(claims=[claim]), None)
+        assert "uid-1" not in driver._ack_cache
+        driver.stop()
+    finally:
+        apiserver.stop()
+        shutil.rmtree(root, ignore_errors=True)

@@ -269,6 +269,51 @@ def test_counter_in_helper_called_under_lock_is_clean():
     assert run(COUNTER_VIA_PRIVATE_HELPER, counters=counters) == []
 
 
+LOCKFREE_COUNTER_PLAIN_MUTATION = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reused = AtomicCounter()
+
+    def bad(self):
+        self.reused += 1
+
+    def also_bad(self):
+        with self._lock:
+            self.reused = self.reused + 1
+"""
+
+LOCKFREE_COUNTER_CLEAN = """
+class C:
+    def __init__(self):
+        self.reused = AtomicCounter()
+
+    def good(self):
+        self.reused.add()
+"""
+
+
+def test_lockfree_counter_plain_mutation_fires():
+    """Round 15: a counter registered with the LOCKFREE sentinel is
+    epoch.AtomicCounter-owned — ANY plain attribute mutation is a
+    finding, even under a lock (re-locking a lock-free counter is as
+    wrong as mutating it bare)."""
+    from tools.tsalint.config import LOCKFREE
+    counters = {"mod.C": {"reused": LOCKFREE}}
+    findings = run(LOCKFREE_COUNTER_PLAIN_MUTATION, counters=counters)
+    assert rules(findings) == ["counter-lock"]
+    assert {f.qualname for f in findings} == {"mod.C.bad", "mod.C.also_bad"}
+    assert "AtomicCounter" in findings[0].message
+
+
+def test_lockfree_counter_add_is_clean():
+    from tools.tsalint.config import LOCKFREE
+    counters = {"mod.C": {"reused": LOCKFREE}}
+    assert run(LOCKFREE_COUNTER_CLEAN, counters=counters) == []
+
+
 # ------------------------------------------------------------ fault sites
 
 

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # lint environment needs no runtime dependencies for this)
 from tpu_device_plugin.lockdep import find_cycles
 
-from .config import LintConfig
+from .config import LOCKFREE, LintConfig
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 THREAD_FACTORIES = {"Thread", "Timer"}
@@ -957,6 +957,18 @@ class Analyzer:
             for held, form, line in facts.counters:
                 owner = self.counter_owner(cls, mod, form)
                 if owner is None:
+                    continue
+                if owner == LOCKFREE:
+                    # lock-free-owned counter (epoch.AtomicCounter): ANY
+                    # plain attribute mutation breaks the contract — the
+                    # sharded cells are the only legal mutation path
+                    findings.append(Finding(
+                        rule="counter-lock", path=facts.path, qualname=qual,
+                        line=line,
+                        message=f"lock-free counter {form} mutated as a "
+                                f"plain attribute — epoch.AtomicCounter "
+                                f"counters mutate only via .add()",
+                        detail=f"{form}@{LOCKFREE}"))
                     continue
                 if owner not in set(held) | ctx:
                     findings.append(Finding(

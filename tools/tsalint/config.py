@@ -91,6 +91,17 @@ HOT_LOCKS = frozenset({
     "dra.DraDriver._ckpt_cond",
 })
 
+# Ownership sentinel for LOCK-FREE counters (round 15): a counter mapped
+# to this value is owned by epoch.AtomicCounter (sharded per-thread
+# cells, mutated only via .add()) — there IS no owning lock, and the
+# counter-lock rule instead fails on ANY plain attribute mutation
+# (`self.x += 1` / read-modify-write assign) of the attr: re-locking a
+# lock-free counter silently, or mutating it as a bare int, both break
+# the zero-lock read-path contract. The counter-drift audit
+# (tests/test_counter_drift.py) still requires a /status + /metrics
+# surface for every entry, lock-free or not.
+LOCKFREE = "<lock-free: epoch.AtomicCounter>"
+
 # The broker-boundary whitelist (rule 7, ISSUE 11): the ONLY files that
 # may contain privileged calls. Path-suffix matched, because the two
 # __init__.py files would collide as module stems:
@@ -116,6 +127,11 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     # restart counter keeps classic lock ownership.
     "server.TpuDevicePlugin": {
         "_restart_count": "server.TpuDevicePlugin._lifecycle_lock",
+        # response byte plane (round 15): AtomicCounters — any plain
+        # `+= 1` on these attrs is a finding (LOCKFREE sentinel)
+        "_alloc_bytes_reused": LOCKFREE,
+        "_alloc_serializations": LOCKFREE,
+        "_self_dial_reuses": LOCKFREE,
     },
     "healthhub.HealthHub": {
         "_probe_cycles": "healthhub.HealthHub._lock",
@@ -139,6 +155,9 @@ COUNTERS: Dict[str, Dict[str, str]] = {
         # writer-side, the advisor bumps after building its proposal);
         # /status reads them lock-free via a fixed-key C-atomic dict copy
         "placement_stats[*]": "dra.DraDriver._lock",
+        # prepare-ack byte plane (round 15): AtomicCounters (LOCKFREE)
+        "_ack_bytes_reused": LOCKFREE,
+        "_ack_serializations": LOCKFREE,
     },
     # device lifecycle FSM: every transition/orphan/swap counter mutates
     # under the FSM writer lock; stats() reads them lock-free (GIL-atomic

@@ -62,7 +62,7 @@ from .allocate import (AllocationError, AllocationPlanner, LiveAttrReader,
 from .config import Config
 from .kubeapi import ApiClient, ApiError, PublishPacer, Reflector
 from .resilience import BackoffPolicy
-from .kubeletapi import draapi, drapb, regpb
+from .kubeletapi import RawResponse, draapi, drapb, regpb, wants_raw
 from .naming import GenerationInfo, sanitize_name
 from .registry import Registry, TpuDevice, TpuPartition
 
@@ -411,6 +411,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # next prepare of that claim UID (in-memory: the record's source
         # of truth is the SOURCE node's checkpoint)
         self._incoming_handoffs: Dict[str, dict] = {}
+        # ---- prepare-ack byte plane (round 15) --------------------------
+        # uid -> (devices-list object, serialized NodePrepareResourceResponse
+        # payload). A prepared claim's ack is deterministic given its
+        # checkpoint entry's devices list, so the segment is serialized
+        # ONCE and reused by every kubelet retry; invalidation is BY
+        # CONSTRUCTION via object identity — any path that changes a
+        # claim's devices builds a NEW list (the orphan-mark swap copies
+        # the entry but keeps the list: the ack is still correct), and
+        # unprepare pops the cache with the entry. In-memory only (the
+        # JSON checkpoint stays bytes-free); single-key dict ops are
+        # GIL-atomic, so pool workers never lock here.
+        self._ack_cache: Dict[str, Tuple[object, bytes]] = {}
+        self._ack_bytes_reused = epoch_mod.AtomicCounter()
+        self._ack_serializations = epoch_mod.AtomicCounter()
         # host lifecycle FSM (lifecycle_fsm.DeviceLifecycle), attached by
         # cli.py via attach_lifecycle; None when running DRA standalone
         self._lifecycle = None
@@ -530,8 +544,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 info = generations.get(model)
                 gen = info.name if info else f"tpu-{model}"
                 if gen not in planners:
+                    # message path only (prepare consumes plan() specs
+                    # for the CDI spec file): no byte records
                     planners[gen] = AllocationPlanner(
-                        self.cfg, registry, gen)
+                        self.cfg, registry, gen, byte_records=False)
                 entries.extend((d.bdf, "chip", gen, d) for d in devs)
             for type_name, parts in sorted(registry.partitions_by_type.items()):
                 entries.extend((p.uuid, "partition", type_name, p)
@@ -568,7 +584,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             self._inv_store.publish(epoch_mod.build_inventory_epoch(
                 self._inv_store.current.epoch_id + 1, by_name, planners,
                 # vfio-backed logical partitions ride their parent's planner
-                AllocationPlanner(self.cfg, registry, "vtpu-parent"),
+                AllocationPlanner(self.cfg, registry, "vtpu-parent",
+                                  byte_records=False),
                 frozenset(self._unhealthy),
                 frozenset(self._departed.values())))
             self._recompute_fragmentation_locked()
@@ -1986,7 +2003,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return specs, envs
 
     def _prepare_claim(self, claim: drapb.Claim,
-                       task: dict) -> List[drapb.Device]:
+                       task: dict) -> List[dict]:
         # Policy admission throttle (policy.py): BEFORE any state is
         # touched, so a rejected claim leaves nothing to roll back. The
         # rejection is this claim's error string; the kubelet retries and
@@ -2022,7 +2039,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 specs, envs = self._plan_devices(
                     results, self._inventory_snapshot())
                 self._write_claim_spec(claim.uid, specs, envs)
-            return [drapb.Device(**d) for d in entry["devices"]]
+            return entry["devices"]
         results, generation = self._allocation_results(claim)
         # re-snapshot AFTER the API round-trip: a hot-unplug that published
         # a new epoch while the fetch was in flight is observed here, so
@@ -2108,7 +2125,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 self._lifecycle.note_allocated(raw, claim.uid)
         log.info("DRA: prepared claim %s/%s (%d devices)",
                  claim.namespace, claim.name, len(devices))
-        return [drapb.Device(**d) for d in devices]
+        return devices
 
     def _unprepare_claim(self, claim: drapb.Claim, task: dict) -> None:
         # Caller holds the per-claim-UID lock (see _prepare_claim), which
@@ -2177,6 +2194,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             if record is not None:
                 with self._lock:
                     self.handoff_stats["handoffs_emitted_total"] += 1
+            # the claim's pre-serialized ack retires with its entry (the
+            # deletion is durable at this point; a re-prepare re-builds)
+            self._ack_cache.pop(claim.uid, None)
             self._note_released(entry, claim.uid)
         log.info("DRA: unprepared claim %s/%s%s",
                  claim.namespace, claim.name,
@@ -2309,25 +2329,57 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # individually instead of the RuntimeError failing the RPC
             return [run_one(c) for c in claims]
 
+    def _ack_segment(self, uid: str, devices: List[dict]) -> bytes:
+        """Serialized NodePrepareResourceResponse payload for one prepared
+        claim — built once per (uid, devices-list identity), reused by
+        every kubelet retry (the byte plane's DRA half). Counted on the
+        reused/serializations ledger (/status dra.ack_bytes)."""
+        cached = self._ack_cache.get(uid)       # GIL-atomic; no lock
+        if cached is not None and cached[0] is devices:
+            self._ack_bytes_reused.add()
+            return cached[1]
+        payload = drapb.NodePrepareResourceResponse(
+            devices=[drapb.Device(**d) for d in devices]).SerializeToString()
+        self._ack_serializations.add()
+        self._ack_cache[uid] = (devices, payload)
+        return payload
+
+    def ack_byte_stats(self) -> Dict[str, int]:
+        return {"reused": self._ack_bytes_reused.value,
+                "serializations": self._ack_serializations.value}
+
     def NodePrepareResources(self, request, context):
-        resp = drapb.NodePrepareResourcesResponse()
         claims = list(request.claims)
-        prepared: Dict[str, List[drapb.Device]] = {}
+        prepared: Dict[str, bytes] = {}
 
         def prepare_one(claim, task):
-            prepared[claim.uid] = self._prepare_claim(claim, task)
+            prepared[claim.uid] = self._ack_segment(
+                claim.uid, self._prepare_claim(claim, task))
 
         with trace.span("dra.NodePrepareResources", claims=len(claims)):
             errors = self._run_claim_tasks(
                 claims, prepare_one, op="dra.prepare.claim",
                 hist="tdp_prepare_wall_ms")
+        # Response assembly is bytes concatenation: one map-entry record
+        # per claim (key = uid, value = the pre-serialized ack payload).
+        # Error acks are serialized per call — failure is not a hot path.
+        segments = []
         for claim, error in zip(claims, errors):
-            out = resp.claims[claim.uid]
             if error is not None:
-                out.error = error
+                value = drapb.NodePrepareResourceResponse(
+                    error=error).SerializeToString()
+                self._ack_serializations.add()
             else:
-                out.devices.extend(prepared[claim.uid])
-        return resp
+                value = prepared[claim.uid]
+            entry = (epoch_mod.encode_delimited(1, claim.uid.encode("utf-8"))
+                     + epoch_mod.encode_delimited(2, value))
+            segments.append(epoch_mod.encode_delimited(1, entry))
+        data = b"".join(segments)
+        if wants_raw(context):
+            # the passthrough serializer (kubeletapi.draapi) writes these
+            # bytes to the wire with no parse and no re-serialize
+            return RawResponse(data)
+        return drapb.NodePrepareResourcesResponse.FromString(data)
 
     def NodeUnprepareResources(self, request, context):
         resp = drapb.NodeUnprepareResourcesResponse()

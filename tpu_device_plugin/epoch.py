@@ -48,9 +48,42 @@ from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 from . import lockdep
 
 __all__ = ["AtomicCounter", "Epoch", "EpochStore", "InventoryEpoch",
-           "build_inventory_epoch", "build_server_epoch"]
+           "build_inventory_epoch", "build_server_epoch",
+           "encode_delimited", "encode_varint"]
 
 _EMPTY_MAP: Mapping = MappingProxyType({})
+
+
+# --- pre-serialized response assembly (round 15) -----------------------------
+# The ListAndWatch payload proved the pattern: serialize once at publish
+# time, reuse the bytes per send. Extending it to Allocate /
+# GetPreferredAllocation / DRA prepare acks needs one protobuf wire fact:
+# a length-delimited field record is self-contained, and concatenating
+# records of a repeated/map field yields the same parse as building the
+# message whole. These two helpers are the entire assembly vocabulary —
+# epoch-keyed caches hold serialized sub-message bytes, and the hot path
+# concatenates records instead of re-building + re-serializing protos
+# (tests/test_preserialized.py pins parse-identity against fresh builds).
+
+def encode_varint(n: int) -> bytes:
+    """Protobuf base-128 varint encoding of a non-negative int."""
+    out = bytearray()
+    while True:
+        bit = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(bit | 0x80)
+        else:
+            out.append(bit)
+            return bytes(out)
+
+
+def encode_delimited(field_number: int, payload: bytes) -> bytes:
+    """One length-delimited (wire type 2) field record: tag + length +
+    payload. `payload` is serialized sub-message bytes or UTF-8 string
+    bytes — the two length-delimited kinds the response planes use."""
+    return (encode_varint((field_number << 3) | 2)
+            + encode_varint(len(payload)) + payload)
 
 
 class AtomicCounter:

@@ -15,11 +15,14 @@ from .api import (  # noqa: F401
     DEVICE_PLUGIN_PATH,
     HEALTHY,
     KUBELET_SOCKET,
+    RAW_CONTEXT,
     UNHEALTHY,
     DevicePluginServicer,
     DevicePluginStub,
+    RawResponse,
     RegistrationServicer,
     RegistrationStub,
     add_device_plugin_servicer,
     add_registration_servicer,
+    wants_raw,
 )

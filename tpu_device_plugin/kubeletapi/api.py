@@ -23,6 +23,56 @@ _DEVICE_PLUGIN_SERVICE = "v1beta1.DevicePlugin"
 _REGISTRATION_SERVICE = "v1beta1.Registration"
 
 
+# -- pre-serialized response passthrough (round 15) ----------------------------
+# The hot handlers (ListAndWatch sends, Allocate, GetPreferredAllocation,
+# DRA prepare acks) assemble responses from pre-serialized epoch-keyed
+# byte segments. On the gRPC path those bytes must reach the wire
+# WITHOUT a parse + re-serialize round-trip, so the response serializers
+# below pass a RawResponse payload through untouched; any other return
+# value serializes normally (message-path fallbacks, every other RPC).
+
+class RawResponse:
+    """Pre-serialized response bytes for the passthrough serializers."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+
+
+class _RawContextSentinel:
+    """Marker context for bench/tests: handlers given this context return
+    their RawResponse exactly as the transport serializer would see it
+    (a real gRPC ServicerContext triggers the same path in production)."""
+
+    def abort(self, code, details):
+        raise RuntimeError(f"handler aborted under RAW_CONTEXT: "
+                           f"{code} {details}")
+
+
+RAW_CONTEXT = _RawContextSentinel()
+
+
+def wants_raw(context) -> bool:
+    """True when the handler's return feeds a passthrough serializer
+    (real gRPC transport) or the caller explicitly asked for wire bytes
+    (RAW_CONTEXT); direct in-process callers (tests, bench handler-
+    compute loops, fleetsim) get parsed messages instead."""
+    return context is RAW_CONTEXT or isinstance(context, grpc.ServicerContext)
+
+
+def raw_or(serialize):
+    """Wrap a protobuf SerializeToString into a RawResponse-passthrough
+    response serializer."""
+
+    def _serialize(msg):
+        if type(msg) is RawResponse:
+            return msg.data
+        return serialize(msg)
+
+    return _serialize
+
+
 class DevicePluginServicer:
     """Server-side interface for the DevicePlugin service (5 RPCs)."""
 
@@ -52,17 +102,19 @@ def add_device_plugin_servicer(server: grpc.Server, servicer: DevicePluginServic
         "ListAndWatch": grpc.unary_stream_rpc_method_handler(
             servicer.ListAndWatch,
             request_deserializer=pb.Empty.FromString,
-            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+            response_serializer=raw_or(
+                pb.ListAndWatchResponse.SerializeToString),
         ),
         "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
             servicer.GetPreferredAllocation,
             request_deserializer=pb.PreferredAllocationRequest.FromString,
-            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+            response_serializer=raw_or(
+                pb.PreferredAllocationResponse.SerializeToString),
         ),
         "Allocate": grpc.unary_unary_rpc_method_handler(
             servicer.Allocate,
             request_deserializer=pb.AllocateRequest.FromString,
-            response_serializer=pb.AllocateResponse.SerializeToString,
+            response_serializer=raw_or(pb.AllocateResponse.SerializeToString),
         ),
         "PreStartContainer": grpc.unary_unary_rpc_method_handler(
             servicer.PreStartContainer,

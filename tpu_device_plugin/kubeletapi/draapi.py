@@ -14,6 +14,7 @@ import grpc
 
 from . import dra_v1beta1_pb2 as drapb
 from . import pluginregistration_v1_pb2 as regpb
+from .api import raw_or
 
 # -- kubelet contract constants ------------------------------------------------
 DRA_API_VERSION = "v1beta1"
@@ -56,7 +57,9 @@ def add_dra_plugin_servicer(server: grpc.Server,
         "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
             servicer.NodePrepareResources,
             request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
-            response_serializer=(
+            # RawResponse passthrough (api.py): prepare acks are assembled
+            # from pre-serialized per-claim segments on the gRPC path
+            response_serializer=raw_or(
                 drapb.NodePrepareResourcesResponse.SerializeToString),
         ),
         "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(

@@ -61,6 +61,18 @@ PREF_CACHE_SIZE = 128
 # re-sends the final state afterwards).
 LW_MAX_DEFER_WINDOWS = 10
 
+# Channel/server options for the loopback-unix-socket regime the kubelet
+# actually talks to (round 15, transport endgame): bias for latency over
+# throughput, and drop the BDP probe — bandwidth estimation is WAN
+# machinery, and on a loopback unix socket it only adds ping traffic the
+# small unary attach responses then queue behind. Shared by the serving
+# side, the self-dial readiness probe, and the bench rig (bench.py),
+# which must measure the production configuration.
+LOOPBACK_GRPC_OPTIONS = (
+    ("grpc.optimization_target", "latency"),
+    ("grpc.http2.bdp_probe", 0),
+)
+
 
 class RegistrationError(Exception):
     """register() failed. Subclasses tell callers whether the failure is
@@ -95,6 +107,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         health_hub: Optional[HealthHub] = None,
         lifecycle=None,
         policy=None,
+        byte_plane: bool = True,
     ) -> None:
         # arm-time validation, matching faults.py's fail-loud convention: a
         # NaN window makes every condvar timeout comparison silently false
@@ -181,15 +194,38 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                                             torus_dims=self.torus_dims)
         self._allowed_bdfs = frozenset(d.bdf for d in self.devices)
         # per-(cfg, registry, resource) precomputation for the Allocate hot
-        # path; rebuilt with the server on every rediscovery restart
+        # path; rebuilt with the server on every rediscovery restart.
+        # byte_records rides the byte_plane knob: the A/B/escape-hatch
+        # message path must not build (or ledger) records it never serves
         self._planner = allocate_mod.AllocationPlanner(
             cfg, registry, resource_suffix,
-            allowed_bdfs=self._allowed_bdfs, cdi_enabled=cdi_enabled)
+            allowed_bdfs=self._allowed_bdfs, cdi_enabled=cdi_enabled,
+            byte_records=byte_plane)
         # last few successful allocations, surfaced on /status for debugging
         # VMI attach issues (what was handed out, when); deque appends are
         # C-atomic, so the hot path records without a lock
         self._recent_allocs: deque = deque(maxlen=16)
         self._alloc_count = epoch_mod.AtomicCounter()
+        # The response byte plane (round 15): hot RPC answers (Allocate +
+        # GetPreferredAllocation) served from pre-serialized epoch-keyed
+        # bytes vs response-plane protobuf serializations actually paid.
+        # The serializations counter is SHARED with the planner (fragment
+        # segment builds count on the same ledger); both are lock-free
+        # owned (AtomicCounter) — the zero-lock gate covers them.
+        # `byte_plane=False` restores the build-protos-per-call path
+        # through the SAME handlers — the bench's interleaved A/B arm
+        # and an operator escape hatch, never the production default.
+        self._byte_plane = byte_plane
+        self._alloc_bytes_reused = epoch_mod.AtomicCounter()
+        self._alloc_serializations = self._planner.serializations
+        # long-lived self-dial channel (round 15 satellite): restart
+        # storms used to pay a fresh grpc channel setup per readiness
+        # probe; one channel per socket path is kept and re-used across
+        # restarts (gRPC re-dials the same unix target), closed only by
+        # the terminal stop(). (path, channel); replaced if the socket
+        # path changes (the vTPU subclass re-points it post-construction).
+        self._self_dial: Optional[Tuple[str, grpc.Channel]] = None
+        self._self_dial_reuses = epoch_mod.AtomicCounter()
         # Memo for the GetPreferredAllocation box scan (see handler): a
         # plain dict the WRITER swaps wholesale on every epoch publish, so
         # a lookup is one GIL-atomic dict.get and invalidation is by
@@ -198,7 +234,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # the swap). Invariant: the scan result depends on (availability,
         # must-include, size) over a static torus, never health, so a
         # stale hit is impossible even across the swap.
-        self._pref_cache: Dict[tuple, list] = {}
+        # value = (ids, serialized container-response record | None):
+        # round 15 caches the BUILTIN answer's bytes next to the ids
+        # (None when byte_plane is off — the record is never built)
+        self._pref_cache: Dict[tuple, Tuple[list, Optional[bytes]]] = {}
         self._pref_hits = epoch_mod.AtomicCounter()
         self._pref_misses = epoch_mod.AtomicCounter()
         # ListAndWatch re-sends since start (initial snapshots excluded):
@@ -353,10 +392,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             server = grpc.server(
                 futures.ThreadPoolExecutor(
                     max_workers=8, thread_name_prefix=f"dp-{self.resource_suffix}"),
-                # Allocate sits on the pod-admission critical path: bias the
-                # transport for latency over throughput (measured ~35 us/RTT
-                # on the bench host's loopback unix socket).
-                options=(("grpc.optimization_target", "latency"),))
+                # Allocate sits on the pod-admission critical path: the
+                # loopback-unix-socket tuning (latency bias, no BDP probe)
+                options=LOOPBACK_GRPC_OPTIONS)
             api.add_device_plugin_servicer(server, self)
             server.add_insecure_port(f"unix://{self.socket_path}")
             server.start()
@@ -372,9 +410,43 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             log.info("%s: serving on %s", self.resource_name, self.socket_path)
 
     def _wait_ready(self) -> None:
-        """Self-dial until our own socket answers (reference :186-213)."""
-        with grpc.insecure_channel(f"unix://{self.socket_path}") as ch:
-            grpc.channel_ready_future(ch).result(timeout=self.cfg.grpc_timeout_s)
+        """Self-dial until our own socket answers (reference :186-213).
+
+        The channel is LONG-LIVED (round 15 satellite): a kubelet restart
+        storm bounces every plugin through restart() -> start() ->
+        _wait_ready(), and a fresh `grpc.insecure_channel` per probe paid
+        channel construction + connection state machinery every bounce.
+        One cached channel per socket path re-dials the same unix target
+        across restarts; the terminal stop() closes it."""
+        grpc.channel_ready_future(self._self_channel()).result(
+            timeout=self.cfg.grpc_timeout_s)
+
+    def _self_channel(self) -> grpc.Channel:
+        """The cached self-dial channel (created lazily so the vTPU
+        subclass's post-construction socket re-point is honored; replaced
+        if the path ever changes)."""
+        cached = self._self_dial
+        if cached is not None and cached[0] == self.socket_path:
+            self._self_dial_reuses.add()
+            return cached[1]
+        if cached is not None:
+            try:
+                cached[1].close()
+            except Exception:   # noqa: BLE001 — best-effort close
+                pass
+        ch = grpc.insecure_channel(f"unix://{self.socket_path}",
+                                   options=LOOPBACK_GRPC_OPTIONS)
+        self._self_dial = (self.socket_path, ch)
+        return ch
+
+    def _close_self_channel(self) -> None:
+        cached = self._self_dial
+        self._self_dial = None
+        if cached is not None:
+            try:
+                cached[1].close()
+            except Exception:   # noqa: BLE001
+                pass
 
     def register(self) -> None:
         """Announce this plugin to the kubelet (reference :288-309).
@@ -497,6 +569,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         self._closed.set()
         with self._lifecycle_lock:
             self._teardown()
+            self._close_self_channel()
         # reap the socket-loss restart runner: it observes _closed at its
         # next check (every wait is _closed-keyed), so a bounded join
         # suffices — unless WE are that runner (stop called from a restart
@@ -594,6 +667,16 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 # precompiled per-IOMMU-group Allocate fragment cache
                 # (allocate._GroupFragment) effectiveness
                 "alloc_fragments": self._planner.fragment_stats(),
+                # the response byte plane (round 15): hot responses served
+                # from pre-serialized epoch-keyed bytes vs response-plane
+                # protobuf serializations actually paid (fragment/memo
+                # segment builds + message-path fallbacks)
+                "response_bytes": {
+                    "reused": self._alloc_bytes_reused.value,
+                    "serializations": self._alloc_serializations.value,
+                },
+                # long-lived self-dial channel reuses across restarts
+                "self_dial_reuses": self._self_dial_reuses.value,
                 # recovery-activity counters (resilience.BackoffPolicy):
                 # how many backoff delays restart() has issued
                 "restart_backoff": self._restart_backoff.snapshot(),
@@ -636,17 +719,22 @@ class TpuDevicePlugin(api.DevicePluginServicer):
     def GetDevicePluginOptions(self, request, context):
         return pb.DevicePluginOptions(get_preferred_allocation_available=True)
 
-    def _lw_response(self, ep: epoch_mod.Epoch) -> pb.ListAndWatchResponse:
+    def _lw_response(self, ep: epoch_mod.Epoch, raw: bool = False):
         """Assemble one stream send from the epoch's pre-serialized
-        payload: a single parse, no locks, no per-device deep copies (the
-        old _snapshot serialize/deserialize-per-device under the device-
-        table condition). The lockdep read-path gate pins this at zero
-        registered-lock acquisitions."""
+        payload. On the gRPC transport (`raw`) the payload is forwarded
+        as-is (api.RawResponse — the passthrough serializer writes the
+        epoch's bytes to the wire with NO parse and NO re-serialize);
+        direct callers get a single parse (no locks, no per-device deep
+        copies — the old _snapshot serialize/deserialize-per-device under
+        the device-table condition). The lockdep read-path gate pins both
+        shapes at zero registered-lock acquisitions."""
         with lockdep.read_path("server.ListAndWatch.assembly"), \
                 trace.span("server.ListAndWatch.send",
                            resource=self.resource_name,
                            epoch_id=ep.epoch_id,
                            devices=len(ep.device_health)):
+            if raw:
+                return api.RawResponse(ep.lw_payload)
             return pb.ListAndWatchResponse.FromString(ep.lw_payload)
 
     def ListAndWatch(self, request, context):
@@ -669,9 +757,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         chaos guarantees ride on this)."""
         store = self._store
         ep = store.current
+        raw = api.wants_raw(context)
         log.info("%s: ListAndWatch stream opened (%d devices)",
                  self.resource_name, len(ep.device_health))
-        yield self._lw_response(ep)
+        yield self._lw_response(ep, raw)
 
         if not context.add_callback(store.poke):
             return  # RPC already terminated
@@ -701,7 +790,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             self._lw_resends.add()
             log.info("%s: device state changed; re-sending %d devices",
                      self.resource_name, len(ep.device_health))
-            yield self._lw_response(ep)
+            yield self._lw_response(ep, raw)
 
     def GetPreferredAllocation(self, request, context):
         # span INSIDE the read-path bracket: the zero-lock gate
@@ -711,7 +800,6 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 trace.span("server.GetPreferredAllocation",
                            resource=self.resource_name,
                            epoch_id=self._store.current.epoch_id):
-            resp = pb.PreferredAllocationResponse()
             index = self._alloc_index
             # The ICI sub-box scan is pure in (availability, must-include,
             # size) over a static torus, and the kubelet re-asks with the
@@ -721,17 +809,28 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             # lookup is ONE GIL-atomic dict.get — the old path took the
             # device-table condition plus the memo lock per RPC. A racing
             # publish mid-RPC just misses into a recompute of the same
-            # pure result (health is not an input to the scan).
+            # pure result (health is not an input to the scan). Since
+            # round 15 the memo value is (ids, serialized container-
+            # response record): a warm hit serves pre-serialized bytes,
+            # and the whole response is assembled by concatenation.
             epoch_id = self._store.current.epoch_id
             memo = self._pref_cache
+            engine = self._policy
+            byte_plane = self._byte_plane
+            scoring_hook = (engine is not None
+                            and engine.has_hook("score_allocation"))
+            segments = []
+            chosen = []
+            fresh = 0
             for creq in request.container_requests:
                 key = (epoch_id,
                        tuple(creq.available_deviceIDs),
                        tuple(creq.must_include_deviceIDs),
                        creq.allocation_size)
-                ids = memo.get(key)
-                if ids is not None:
+                hit = memo.get(key)
+                if hit is not None:
                     self._pref_hits.add()
+                    ids, rec = hit
                 else:
                     self._pref_misses.add()
                     try:
@@ -743,8 +842,10 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     except MustIncludeTooLarge as exc:
                         context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                                       str(exc))
+                    rec = self._pref_record(ids) if byte_plane else None
+                    fresh += 1
                     if len(memo) < PREF_CACHE_SIZE:
-                        memo[key] = ids
+                        memo[key] = (ids, rec)
                 # Policy scoring override (policy.py): operator hooks may
                 # replace the builtin choice, composing with the
                 # placement engine — the ctx carries the builtin answer
@@ -752,10 +853,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                 # unless its own objective dominates. Runs AFTER the memo
                 # (policies may be stateful; caching their answers would
                 # freeze them) and only when a hook is loaded — the
-                # default None engine costs one attribute check.
-                engine = self._policy
-                if engine is not None \
-                        and engine.has_hook("score_allocation"):
+                # default None engine costs one attribute check. An
+                # override BYPASSES the byte cache: the memoized record
+                # is the BUILTIN answer's bytes, and serving it would
+                # resurrect a winner the policy just overruled — the
+                # override is serialized fresh and never memoized.
+                if scoring_hook:
                     coords_of = index.coords_of
                     override = engine.score_allocation({
                         "resource": self.resource_name,
@@ -769,6 +872,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     })
                     if override is not None:
                         ids = override
+                        rec = self._pref_record(ids) if byte_plane else None
+                        fresh += 1
                 # Score the answer's ICI contiguity (placement.py): 1.0 =
                 # the chosen chips ARE one axis-aligned sub-box (one ICI
                 # ring/tile), lower = stragglers. Scored on every call
@@ -781,13 +886,52 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                     self._last_placement_score = placement.selection_score(
                         self.torus_dims, [coords_of.get(i) for i in ids])
                     self._placement_scored.add()
+                segments.append(rec)
+                chosen.append(ids)
+            if byte_plane:
+                if segments and not fresh:
+                    # every container segment came from the byte memo
+                    # (an empty request reuses nothing)
+                    self._alloc_bytes_reused.add()
+                return self._finish_bytes(b"".join(segments),
+                                          pb.PreferredAllocationResponse,
+                                          context)
+            # byte plane disabled (A/B arm / escape hatch): build the
+            # response message per call — the transport serializes it
+            resp = pb.PreferredAllocationResponse()
+            for ids in chosen:
                 resp.container_responses.append(
                     pb.ContainerPreferredAllocationResponse(deviceIDs=ids))
+            self._alloc_serializations.add()
             return resp
+
+    def _pref_record(self, ids) -> bytes:
+        """One serialized PreferredAllocationResponse.container_responses
+        record (counted: the response plane's serialization ledger)."""
+        self._alloc_serializations.add()
+        return epoch_mod.encode_delimited(
+            1, pb.ContainerPreferredAllocationResponse(
+                deviceIDs=ids).SerializeToString())
+
+    def _finish_bytes(self, data: bytes, cls, context):
+        """Deliver assembled response bytes: raw passthrough on the gRPC
+        transport (api.RawResponse — the serializer forwards the payload
+        untouched), ONE parse for direct in-process callers (tests,
+        bench, fleetsim)."""
+        if api.wants_raw(context):
+            return api.RawResponse(data)
+        return cls.FromString(data)
 
     def Allocate(self, request, context):
         """Template method: log → subclass impl → record for /status.
-        Failed allocations abort inside the impl and are never recorded."""
+        Failed allocations abort inside the impl and are never recorded.
+
+        The impl returns either pre-serialized AllocateResponse BYTES
+        (the passthrough byte plane — counted bytes_reused only when the
+        whole response came from cached records, matching the
+        GetPreferredAllocation convention) or a built message (the vTPU
+        path and other fallbacks — counted as a response serialization,
+        since the transport must serialize it)."""
         ids = [list(c.devices_ids) for c in request.container_requests]
         log.info("%s: Allocate(%s)", self.resource_name, ids)
         with lockdep.read_path("server.Allocate"), \
@@ -796,8 +940,20 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                            resource=self.resource_name,
                            epoch_id=self._store.current.epoch_id,
                            devices=sum(len(i) for i in ids)):
+            # reuse accounting by ledger delta: a cold byte-path request
+            # (fragment builds after an epoch bump) serializes segments
+            # and must not also count as a reuse. A concurrent cold call
+            # on another thread can suppress this call's reuse count —
+            # a rare undercount, never an overcount.
+            ser_before = self._alloc_serializations.value
             resp = self._allocate_impl(request, context)
             self.record_allocation(ids)
+            if isinstance(resp, bytes):
+                if ids and self._alloc_serializations.value == ser_before:
+                    self._alloc_bytes_reused.add()
+                resp = self._finish_bytes(resp, pb.AllocateResponse, context)
+            else:
+                self._alloc_serializations.add()
         return resp
 
     def _allocate_impl(self, request, context):
@@ -815,7 +971,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         try:
             # the epoch id keys the planner's precompiled fragments: a
             # health flip publishes a new epoch, so the next plan starts a
-            # fresh fragment cache — no invalidation listeners
+            # fresh fragment cache — no invalidation listeners. The byte
+            # plane assembles the response from the fragments' serialized
+            # records (one privilege crossing per REQUEST, even
+            # multi-container — the coalesced fast path); byte_plane=False
+            # (the bench A/B arm) keeps the build-protos-per-call path.
+            if self._byte_plane:
+                return self._planner.allocate_response_bytes(
+                    request, epoch=self._store.current.epoch_id)
             return self._planner.allocate_response(
                 request, epoch=self._store.current.epoch_id)
         except broker_mod.BrokerUnavailable as exc:

@@ -306,6 +306,10 @@ class StatusServer:
                 # (hot-unplug) awaiting replug readmission
                 "orphaned_claims": d.orphaned_claims(),
                 "departed_devices": d.departed_devices(),
+                # prepare-ack byte plane (round 15): acks served from
+                # pre-serialized per-claim segments vs serializations
+                # paid — lock-free AtomicCounter reads
+                "ack_bytes": d.ack_byte_stats(),
                 # slice placement (placement.py): per-generation
                 # fragmentation records (largest placeable sub-box vs
                 # free capacity, recomputed per epoch publish) and the
@@ -433,6 +437,37 @@ class StatusServer:
                     f'tpu_plugin_alloc_fragment_total{{resource='
                     f'"{_esc(p["resource"])}",outcome="{outcome}"}} '
                     f'{frags.get(key, 0)}')
+        # the response byte plane (round 15, transport endgame): hot RPC
+        # responses served from pre-serialized epoch-keyed bytes vs the
+        # protobuf serializations the response plane still pays
+        lines += ["# HELP tpu_plugin_alloc_bytes_reused_total Hot RPC "
+                  "responses (Allocate + GetPreferredAllocation) served "
+                  "from pre-serialized epoch-keyed bytes.",
+                  "# TYPE tpu_plugin_alloc_bytes_reused_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_alloc_bytes_reused_total'
+                f'{{resource="{_esc(p["resource"])}"}} '
+                f'{p.get("response_bytes", {}).get("reused", 0)}')
+        lines += ["# HELP tpu_plugin_alloc_serializations_total Response-"
+                  "plane protobuf serializations paid on the allocate "
+                  "path (fragment/memo segment builds at miss time + "
+                  "message-path fallbacks).",
+                  "# TYPE tpu_plugin_alloc_serializations_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_alloc_serializations_total'
+                f'{{resource="{_esc(p["resource"])}"}} '
+                f'{p.get("response_bytes", {}).get("serializations", 0)}')
+        lines += ["# HELP tpu_plugin_self_dial_reuses_total Readiness "
+                  "probes served by the long-lived self-dial channel "
+                  "instead of a fresh gRPC channel per restart.",
+                  "# TYPE tpu_plugin_self_dial_reuses_total counter"]
+        for p in s["plugins"]:
+            lines.append(
+                f'tpu_plugin_self_dial_reuses_total'
+                f'{{resource="{_esc(p["resource"])}"}} '
+                f'{p.get("self_dial_reuses", 0)}')
         disc = s.get("discovery")
         if disc:
             lines += [
@@ -657,6 +692,19 @@ class StatusServer:
                 "# TYPE tpu_plugin_dra_checkpoint_bytes gauge",
                 f"tpu_plugin_dra_checkpoint_bytes "
                 f"{s['dra']['checkpoint_bytes']}",
+                # prepare-ack byte plane (round 15, transport endgame)
+                "# HELP tpu_plugin_dra_ack_bytes_reused_total "
+                "NodePrepareResources claim acks served from the "
+                "pre-serialized per-claim segment cache.",
+                "# TYPE tpu_plugin_dra_ack_bytes_reused_total counter",
+                f"tpu_plugin_dra_ack_bytes_reused_total "
+                f"{s['dra']['ack_bytes']['reused']}",
+                "# HELP tpu_plugin_dra_ack_serializations_total Prepare-"
+                "ack protobuf serializations paid (first build per "
+                "claim + error acks).",
+                "# TYPE tpu_plugin_dra_ack_serializations_total counter",
+                f"tpu_plugin_dra_ack_serializations_total "
+                f"{s['dra']['ack_bytes']['serializations']}",
                 "# HELP tpu_plugin_dra_publish_waves_total ResourceSlice "
                 "publish waves sent through the pacing layer "
                 "(kubeapi.PublishPacer).",

@@ -55,11 +55,15 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
         self.cdi_uuids = cdi_uuids
+        # byte_plane=False: every vTPU response is assembled per request
+        # (both _allocate_impl and GetPreferredAllocation are overridden
+        # with message-path implementations), so the inherited planner
+        # must not build — or ledger — byte records nothing reads
         super().__init__(cfg, type_name, registry, devices=[],
                          health_shim=health_shim, cdi_enabled=cdi_enabled,
                          health_listener=health_listener,
                          health_hub=health_hub, lifecycle=lifecycle,
-                         policy=policy)
+                         policy=policy, byte_plane=False)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
@@ -68,7 +72,10 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         # devices=[] (allowed_bdfs=frozenset()) and would reject every
         # parent; this one is unscoped — partition membership is already
         # validated against self.partitions before plan() is called.
-        self._parent_planner = AllocationPlanner(cfg, registry, type_name)
+        # Message path only (vTPU responses are assembled per request),
+        # so no byte records are built or ledgered.
+        self._parent_planner = AllocationPlanner(cfg, registry, type_name,
+                                                 byte_records=False)
         # partition set is fixed for this server's lifetime (rediscovery
         # rebuilds the server) — index it once, not per RPC
         self._by_uuid = {p.uuid: p for p in self.partitions}
@@ -231,8 +238,12 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         """Pack partitions onto the fewest parent chips (anti-fragmentation),
         preferring parents on the NUMA node the allocation started on.
         Pure compute over the construction-time partition index — the
-        read-path bracket pins it lock-free like the base class's."""
+        read-path bracket pins it lock-free like the base class's.
+        Message path by design (the packing depends on the live request's
+        availability set, so there is nothing epoch-stable to
+        pre-serialize): counted on the serialization ledger."""
         with lockdep.read_path("server.GetPreferredAllocation"):
+            self._alloc_serializations.add()
             return self._preferred_impl(request, context)
 
     def _preferred_impl(self, request, context):
