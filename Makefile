@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -170,6 +170,19 @@ bench-scale:
 # variant.
 bench-placement:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --placement
+
+# Fleet placement control plane bench (docs/design.md "Fleet placement
+# control plane"): the r12 placement-quality comparison rerun at 256
+# simulated nodes THROUGH the cluster scheduler — selector-filtered
+# decisions consumed from the watch-stream slice cache, cross-host
+# meshes on the pod grid, fragmentation-over-churn curves for the
+# engine and the naive first-free baseline, and a global defrag wave
+# applied node-by-node via migration handoff — every cell exactly-once
+# on the fabric, multiclaim and scheduler commit-log audits. Writes
+# docs/bench_fleetplace_r16.json. CI bench-smoke runs the --quick
+# (N=16) variant.
+bench-fleet-placement:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet-placement
 
 # Privilege-separation bench (docs/design.md "Privilege separation"):
 # the attach path in BOTH broker modes — counted crossings per attach

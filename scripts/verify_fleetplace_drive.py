@@ -1,0 +1,111 @@
+"""ISSUE 14 drive: real daemon with --host-coords — the published
+ResourceSlice carries the ICI topology attributes, fleetplace parses it
+back into a placement grid, a compiled selector matches every chip, and
+/debug/defrag serves the per-generation fragmentation records alongside
+the proposal (400 on a generation with no host view / overflow shape).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from fakehost import FakeChip, FakeHost  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
+
+root = tempfile.mkdtemp(prefix="vfyfp-", dir="/tmp")
+fh = FakeHost(root)
+for i in range(8):
+    fh.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                         iommu_group=str(10 + i), numa_node=i // 4,
+                         serial=f"sn-{i}"))
+os.makedirs(os.path.join(root, "device-plugins"), exist_ok=True)
+api = FakeApiServer()
+port = 18271
+env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+           NODE_NAME="node-fp")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+     "--dra", "--api-server", api.url, "--status-port", str(port),
+     "--host-coords", "1,2", "-v"],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+try:
+    slice_obj = None
+    for _ in range(100):
+        slices = dict(api.slices)
+        if slices:
+            slice_obj = json.loads(json.dumps(next(iter(slices.values()))))
+            if slice_obj.get("spec", {}).get("devices"):
+                break
+        time.sleep(0.2)
+    assert slice_obj is not None, "daemon never published a ResourceSlice"
+
+    from tpu_device_plugin.fleetplace import (
+        compile_selector, device_attrs, host_views_from_slices)
+
+    entries = slice_obj["spec"]["devices"]
+    assert len(entries) == 8
+    for entry in entries:
+        attrs = device_attrs(entry)
+        assert attrs["generation"] == "v5e", attrs
+        assert (attrs["torusX"], attrs["torusY"]) == (2, 4), attrs
+        assert attrs["ringSize"] == 4, attrs
+        assert attrs["hostId"] == "node-fp", attrs
+        assert attrs["ringId"].startswith("node-fp/v5e/"), attrs
+        assert (attrs["hostX"], attrs["hostY"]) == (1, 2), attrs
+    print("OK: published slice carries ICI topology attributes "
+          "(coords, torus dims, ringSize/ringId, hostId, pod slot 1,2)")
+
+    views, idx = host_views_from_slices(
+        {slice_obj["metadata"]["name"]: slice_obj}, {})
+    view = views["v5e"][0]
+    assert view.dims == (2, 4) and len(view.free) == 8
+    assert view.host_coords == (1, 2)
+    print("OK: fleetplace rebuilt the placement grid from the "
+          "published slice (2x4 torus, pod slot (1,2), 8 free)")
+
+    sel = compile_selector('topology.generation == "v5e" && '
+                           'topology.ring_size >= 4 && '
+                           'topology.host_id == "node-fp"')
+    matched = sum(sel.matches(device_attrs(e)) for e in entries)
+    assert matched == 8, sel.snapshot()
+    assert compile_selector('topology.generation == "v4"').matches(
+        device_attrs(entries[0])) is False
+    print("OK: compiled selector matches all 8 published chips "
+          "(and a v4 selector matches none)")
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/defrag?shape=2x2",
+            timeout=5) as r:
+        prop = json.load(r)
+    assert prop["placeable"] is True
+    frag = prop["fragmentation"]["v5e"]
+    assert frag["free"] == 8 and frag["fragmentation"] == 0.0, frag
+    print("OK: /debug/defrag carries the per-generation fragmentation "
+          "records alongside the proposal")
+
+    for bad in ("shape=2x2&generation=nope", "shape=4294967296x2"):
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/defrag?{bad}", timeout=5)
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 400, (bad, exc.code)
+        else:
+            raise AssertionError(f"{bad} did not 400")
+    print("OK: unknown generation + overflow shape answer 400")
+    print("FLEETPLACE DRIVE PASS")
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except Exception:
+        proc.kill()
+    api.stop()

@@ -1,0 +1,654 @@
+"""Fleet placement control plane tests (ISSUE 14).
+
+Selector-engine edge cases (unknown attribute, type mismatch, empty =
+match-all, malformed fails at COMPILE not at match), the cross-host
+mesh algebra (pod-grid wrap-around windows), the reflector-fed slice
+cache and its published-attribute parser (pinned against the daemon's
+REAL build_slice output so publisher and parser cannot drift), the
+cluster fragmentation rollup, global defrag waves applied through the
+migration-handoff machinery, the cluster-wide exactly-once commit-log
+audit (including a scheduler decision replayed under injected faults),
+the zero-lock read-path gates for selector evaluation and fleet
+accounting, and the flight-recorder span every decision emits.
+"""
+
+import os
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import fleetplace, lockdep, placement
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import discover_passthrough
+from tpu_device_plugin.dra import DraDriver
+from tpu_device_plugin.fleetplace import (
+    CompiledSelector, FleetScheduler, SelectorError, SliceCache,
+    cluster_fragmentation, compile_selector, device_attrs,
+    host_views_from_slices)
+from tpu_device_plugin.placement import HostView
+
+
+# ------------------------------------------------------ selector engine
+
+
+def test_selector_typed_comparisons_and_boolean_ops():
+    s = compile_selector(
+        'topology.generation == "v5e" && topology.ring_size >= 4')
+    assert s.matches({"generation": "v5e", "ringSize": 4})
+    assert not s.matches({"generation": "v5e", "ringSize": 2})
+    assert not s.matches({"generation": "v4", "ringSize": 8})
+    s2 = compile_selector('numaNode != 0 || bdf == "0000:00:04.0"')
+    assert s2.matches({"numaNode": 0, "bdf": "0000:00:04.0"})
+    assert s2.matches({"numaNode": 1, "bdf": "x"})
+    assert not s2.matches({"numaNode": 0, "bdf": "x"})
+
+
+def test_selector_empty_is_match_all():
+    for text in ("", "   ", None):
+        s = compile_selector(text)
+        assert s.matches({}) and s.matches({"anything": 1})
+        assert s.snapshot()["matches_total"] == 2
+
+
+def test_selector_unknown_attribute_is_no_match_counted():
+    s = compile_selector("topology.no_such_attr >= 4")
+    assert not s.matches({"ringSize": 8})
+    assert s.snapshot()["unknown_attribute_total"] == 1
+    # negation of a poisoned predicate is still NO MATCH, not a
+    # surprise True (the miss aborts the whole evaluation)
+    s2 = compile_selector("!(topology.no_such_attr >= 4)")
+    assert not s2.matches({"ringSize": 8})
+    assert s2.snapshot()["unknown_attribute_total"] == 1
+
+
+def test_selector_type_mismatch_is_no_match_counted():
+    cases = [
+        ('topology.generation >= 4', {"generation": "v5e"}),
+        ('topology.ring_size == "v5e"', {"ringSize": 4}),
+        ('topology.healthy < true', {"healthy": True}),   # no bool order
+        ('topology.ring_size in ["a", "b"]', {"ringSize": 4}),
+    ]
+    for text, attrs in cases:
+        s = compile_selector(text)
+        assert not s.matches(attrs), text
+        assert s.snapshot()["type_mismatch_total"] == 1, text
+    # a bare non-bool operand cannot stand as a predicate
+    s = compile_selector("topology.ring_size")
+    assert not s.matches({"ringSize": 4})
+    assert s.snapshot()["type_mismatch_total"] == 1
+
+
+def test_selector_short_circuit_never_touches_poisoned_branch():
+    s = compile_selector('topology.ring_size >= 4 || missing == 1')
+    assert s.matches({"ringSize": 8})          # left True: right unread
+    assert s.snapshot()["unknown_attribute_total"] == 0
+    s2 = compile_selector('topology.ring_size >= 99 && missing == 1')
+    assert not s2.matches({"ringSize": 8})     # left False: right unread
+    assert s2.snapshot()["unknown_attribute_total"] == 0
+
+
+@pytest.mark.parametrize("bad", [
+    "topology.generation ==",          # dangling operator
+    "(topology.ring_size >= 4",        # unbalanced paren
+    "topology.ring_size >= 4)",        # trailing input
+    "ring_size ~ 4",                   # unknown operator
+    "in [1, 2]",                       # 'in' with no left operand
+    'x in [1, "a"]',                   # mixed-type list literal
+    "x in [1, 2",                      # unterminated list
+    "&& true",                         # operator with no left term
+    'x == "unterminated',              # bad string token
+])
+def test_selector_malformed_fails_at_compile_not_at_match(bad):
+    with pytest.raises(SelectorError):
+        compile_selector(bad)
+
+
+def test_selector_membership_bools_and_negation():
+    s = compile_selector('topology.generation in ["v5e", "v5p"]')
+    assert s.matches({"generation": "v5p"})
+    assert not s.matches({"generation": "v4"})
+    s2 = compile_selector("!healthy")
+    assert s2.matches({"healthy": False})
+    assert not s2.matches({"healthy": True})
+    s3 = compile_selector("healthy == true")
+    assert s3.matches({"healthy": True})
+
+
+def test_selector_string_escapes_consistent_across_positions():
+    """A quoted literal denotes the SAME value in == and in contexts
+    (the list-literal position shares the operand's unescape)."""
+    attrs = {"hostId": 'a"b', "path": "a\\b"}
+    assert compile_selector('host_id == "a\\"b"').matches(attrs)
+    assert compile_selector('host_id in ["a\\"b"]').matches(attrs)
+    assert compile_selector('path == "a\\\\b"').matches(attrs)
+    assert compile_selector('path in ["a\\\\b", "other"]').matches(attrs)
+
+
+def test_selector_snake_case_resolves_wire_camel_case():
+    """Selectors read like specs (`ring_size`); the wire publishes
+    camelCase (`ringSize`); both prefixes address the same map."""
+    attrs = {"ringSize": 8, "hostId": "n7", "iciX": 1}
+    assert compile_selector("topology.ring_size == 8").matches(attrs)
+    assert compile_selector('device.host_id == "n7"').matches(attrs)
+    assert compile_selector("ici_x == 1").matches(attrs)
+    assert compile_selector("ringSize == 8").matches(attrs)
+
+
+def test_device_attrs_flattens_both_api_shapes():
+    v1beta1 = {"name": "d0", "basic": {"attributes": {
+        "generation": {"string": "v5e"}, "ringSize": {"int": 4},
+        "healthy": {"bool": True}}}}
+    flat = {"name": "d0", "attributes": {
+        "generation": {"string": "v5e"}, "ringSize": {"int": 4},
+        "healthy": {"bool": True}}}
+    for entry in (v1beta1, flat):
+        attrs = device_attrs(entry)
+        assert attrs["generation"] == "v5e"
+        assert attrs["ringSize"] == 4
+        assert attrs["healthy"] is True
+        assert attrs["name"] == "d0"
+
+
+# -------------------------------------------------- cross-host mesh
+
+
+def _mesh_view(node, host_coords, dims=(2, 4), occupied=()):
+    import itertools
+    coords, names = {}, {}
+    for c in itertools.product(*[range(d) for d in dims]):
+        raw = f"{node}-c" + "-".join(str(x) for x in c)
+        coords[raw] = c
+        names[raw] = raw
+    raw_at = {c: r for r, c in coords.items()}
+    claims = {f"{node}-claim-{i}": (raw_at[c],)
+              for i, c in enumerate(occupied)}
+    held = {r for raws in claims.values() for r in raws}
+    return HostView(node=node, dims=dims, coords=coords, names=names,
+                    free=frozenset(r for r in coords if r not in held),
+                    departed=frozenset(), claims=claims,
+                    host_coords=host_coords)
+
+
+def test_cyclic_cover_wraps_pod_axes():
+    assert placement.cyclic_cover([(0, 0), (0, 3)], (4, 4)) == 2
+    assert placement.cyclic_cover([(0, 0), (0, 2)], (4, 4)) == 3
+    assert placement.cyclic_cover([(0, 0), (3, 0)], (4, 4)) == 2
+    assert placement.mesh_score([(0, 0), (0, 3)], (4, 4)) == 1.0
+    assert placement.mesh_score([(0, 0), (0, 2)], (4, 4)) < 1.0
+    assert placement.mesh_score([(0, 0), None], (4, 4)) == 0.0
+
+
+def test_multi_host_plan_requires_pod_adjacency():
+    """With the pod grid modeled, two fully-free hosts only tile a mesh
+    when a wrap-aware host-grid window joins them. A 2x8 slice over
+    2x4-host tori on a 1x4 pod row needs two hosts side by side along
+    the pod's second axis — including the wrap pair (0,0)+(0,3)."""
+    adjacent = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 1))]
+    gap = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 2))]
+    wrap = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 3))]
+    plan = placement.plan_slice((2, 8), adjacent, pod_dims=(1, 4))
+    assert plan is not None and plan.score == 1.0 and plan.hosts == 2
+    assert placement.plan_slice((2, 8), gap, pod_dims=(1, 4)) is None
+    plan_w = placement.plan_slice((2, 8), wrap, pod_dims=(1, 4))
+    assert plan_w is not None and plan_w.score == 1.0
+    # a 4x4 needs two hosts stacked along pod axis 0 — a 1x4 row has
+    # no such link, however free the tori are
+    assert placement.plan_slice((4, 4), adjacent,
+                                pod_dims=(1, 4)) is None
+
+
+def test_mesh_scatter_scores_down_non_adjacent_hosts():
+    gap = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 2))]
+    plan = placement.plan_slice((2, 8), gap, best_effort=True,
+                                pod_dims=(1, 4))
+    assert plan is not None and plan.hosts == 2
+    assert 0.0 < plan.score < 1.0
+    assert plan.score == placement.mesh_score([(0, 0), (0, 2)], (1, 4))
+
+
+def test_coordinate_less_views_legacy_vs_modeled_pod():
+    legacy = [_mesh_view("a", None), _mesh_view("b", None)]
+    # pod grid unmodeled: inter-host edges unknown, legacy 1.0 holds
+    plan = placement.plan_slice((4, 4), legacy)
+    assert plan is not None and plan.score == 1.0 and plan.hosts == 2
+    # pod grid MODELED: a coordinate-less host cannot prove adjacency,
+    # so it never joins a score-1.0 mesh (mid-rollout honesty)
+    assert placement.plan_slice((2, 8), legacy, pod_dims=(1, 4)) is None
+    # ... and a coordinate-bearing adjacent pair keeps its constraint
+    # even when an unrelated host lacks coordinates
+    mixed = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 1)),
+             _mesh_view("c", None)]
+    plan_m = placement.plan_slice((2, 8), mixed, pod_dims=(1, 4))
+    assert plan_m is not None and plan_m.score == 1.0
+    assert {s[0] for s in plan_m.shards} == {"a", "b"}
+
+
+def test_rank_mismatched_pod_grid_never_claims_contiguity():
+    """A 2-D pod grid over 3-D v4-style host cubes (2x2x1) cannot
+    prove adjacency on the missing axis — the generation must form NO
+    contiguous multi-host plan rather than silently reverting to the
+    legacy any-two-tori-score-1.0 claim."""
+    cubes = [_mesh_view("a", (0, 0), dims=(2, 2, 1)),
+             _mesh_view("b", (0, 1), dims=(2, 2, 1))]
+    # rank-matched pod model: a 3-D pod grid proves the link
+    plan = placement.plan_slice((2, 4, 1), cubes, pod_dims=(1, 2, 1))
+    assert plan is None   # 2D host_coords don't match the 3D pod
+    cubes3d = [_mesh_view("a", (0, 0, 0), dims=(2, 2, 1)),
+               _mesh_view("b", (0, 1, 0), dims=(2, 2, 1))]
+    plan3 = placement.plan_slice((2, 4, 1), cubes3d,
+                                 pod_dims=(1, 2, 1))
+    assert plan3 is not None and plan3.score == 1.0
+    # rank-MISMATCHED pod model (2-D grid, 3-D hosts): unprovable —
+    # no contiguous plan, not a false 1.0
+    assert placement.plan_slice((2, 4, 1), cubes,
+                                pod_dims=(1, 2)) is None
+    assert placement.plan_slice((2, 4, 1), cubes3d,
+                                pod_dims=(1, 2)) is None
+
+
+def test_single_host_plan_still_preferred_over_mesh():
+    views = [_mesh_view("a", (0, 0)), _mesh_view("b", (0, 1))]
+    plan = placement.plan_slice((2, 2), views, pod_dims=(1, 4))
+    assert plan is not None and plan.hosts == 1 and plan.score == 1.0
+
+
+# ----------------------------------------- slice cache + parsed views
+
+
+def _slice_obj(node, gen="v5e", dims=(2, 4), host=(0, 0), rv=1):
+    import itertools
+    devices = []
+    for i, c in enumerate(itertools.product(*[range(d) for d in dims])):
+        attrs = {
+            "type": {"string": "passthrough"},
+            "generation": {"string": gen},
+            "bdf": {"string": f"0000:00:{4 + i:02x}.0"},
+            "ringSize": {"int": max(dims)},
+            "hostId": {"string": node},
+        }
+        for axis, coord in zip("xyz", c):
+            attrs[f"ici{axis.upper()}"] = {"int": coord}
+        for axis, d in zip("xyz", dims):
+            attrs[f"torus{axis.upper()}"] = {"int": d}
+        if host is not None:
+            for axis, coord in zip("xyz", host):
+                attrs[f"host{axis.upper()}"] = {"int": coord}
+        devices.append({"name": f"{node}-dev-{i}",
+                        "basic": {"attributes": attrs}})
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-slice", "resourceVersion": str(rv)},
+        "spec": {"driver": "tpu.example.com", "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": devices},
+    }
+
+
+def test_slice_cache_events_idempotent_and_delete():
+    cache = SliceCache()
+    cache.on_sync([_slice_obj("n0"), _slice_obj("n1")])
+    assert set(cache.snapshot()) == {"n0-slice", "n1-slice"}
+    snap_before = cache.snapshot()
+    evt = {"type": "MODIFIED", "object": _slice_obj("n0", rv=2)}
+    cache.on_event(evt)
+    cache.on_event(dict(evt))      # at-least-once duplicate delivery
+    assert len(cache.snapshot()) == 2
+    # snapshots are immutable swaps: the old one is untouched
+    assert snap_before["n0-slice"]["metadata"]["resourceVersion"] == "1"
+    cache.on_event({"type": "DELETED", "object": _slice_obj("n0")})
+    assert set(cache.snapshot()) == {"n1-slice"}
+    cache.on_event({"type": "DELETED", "object": _slice_obj("n0")})
+    assert set(cache.snapshot()) == {"n1-slice"}
+
+
+def test_host_views_from_slices_rebuild_grids_and_ledger():
+    slices = {s["metadata"]["name"]: s
+              for s in (_slice_obj("n0", host=(0, 0)),
+                        _slice_obj("n1", host=(0, 1)))}
+    claims = {"u1": (("u1-n0", "n0",
+                      ("0000:00:04.0", "0000:00:05.0")),)}
+    views, attrs_index = host_views_from_slices(slices, claims)
+    assert set(views) == {"v5e"}
+    by_node = {v.node: v for v in views["v5e"]}
+    assert by_node["n0"].dims == (2, 4)
+    assert by_node["n0"].host_coords == (0, 0)
+    assert by_node["n1"].host_coords == (0, 1)
+    assert len(by_node["n0"].free) == 6          # 8 - 2 claimed
+    # claims keyed by the NODE-LEVEL sub-claim id — the id the node
+    # driver's checkpoint holds, so defrag advisories name claims the
+    # handoff machinery can really unprepare
+    assert by_node["n0"].claims["u1-n0"] == \
+        ("0000:00:04.0", "0000:00:05.0")
+    # the SAME bdfs on n1 stay free: the ledger is (node, bdf)-keyed
+    assert len(by_node["n1"].free) == 8
+    assert attrs_index[("n0", "v5e")]["0000:00:04.0"]["ringSize"] == 4
+
+
+def test_published_slice_parses_back_to_the_drivers_own_view(short_root):
+    """THE anti-drift pin: the daemon's real build_slice output —
+    topology attributes and all (the ISSUE 14 satellite) — parses back
+    into exactly the host view the driver computes locally."""
+    from dataclasses import replace as dc_replace
+    host = FakeHost(short_root)
+    for i in range(8):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 4))
+    cfg = dc_replace(Config().with_root(host.root), host_coords=(1, 2))
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    registry, generations = discover_passthrough(cfg)
+    driver = DraDriver(cfg, registry, generations, node_name="pub-n")
+    try:
+        obj = driver.build_slice()
+        # satellite: every chip entry publishes coords, torus dims,
+        # generation, ring/host ids, pod-grid slot
+        for entry in obj["spec"]["devices"]:
+            attrs = device_attrs(entry)
+            assert attrs["generation"] == "v5e"
+            assert (attrs["torusX"], attrs["torusY"]) == (2, 4)
+            assert attrs["ringSize"] == 4
+            assert attrs["hostId"] == "pub-n"
+            assert attrs["ringId"].startswith("pub-n/v5e/")
+            assert (attrs["hostX"], attrs["hostY"]) == (1, 2)
+            assert "iciX" in attrs and "iciY" in attrs
+        views, _idx = host_views_from_slices(
+            {obj["metadata"]["name"]: obj}, {})
+        parsed = views["v5e"][0]
+        local = driver.host_views()["v5e"]
+        assert parsed.dims == local.dims
+        assert dict(parsed.coords) == dict(local.coords)
+        assert parsed.free == local.free
+        assert parsed.host_coords == (1, 2)
+        # a selector can address the published fields
+        sel = compile_selector(
+            'topology.generation == "v5e" && topology.ring_size >= 4 '
+            '&& topology.host_id == "pub-n"')
+        idx = {e["name"]: device_attrs(e) for e in obj["spec"]["devices"]}
+        assert all(sel.matches(a) for a in idx.values())
+    finally:
+        driver.stop()
+
+
+# ------------------------------------------- cluster fragmentation
+
+
+def test_cluster_fragmentation_rolls_up_hosts_and_meshes():
+    views = {"v5e": [
+        _mesh_view("a", (0, 0)),                       # fully free
+        _mesh_view("b", (0, 1)),                       # fully free
+        _mesh_view("c", (1, 0), occupied=[(0, 0)]),    # 7 free
+        _mesh_view("d", (1, 1), occupied=[(0, 1), (1, 2)]),
+    ]}
+    roll = cluster_fragmentation(views, pod_dims=(2, 2))["v5e"]
+    assert roll["hosts"] == 4 and roll["chips"] == 32
+    assert roll["free"] == 8 + 8 + 7 + 6
+    assert roll["fully_free_hosts"] == 2
+    assert roll["largest_free_box"] == 8
+    assert roll["largest_free_mesh"] == 16       # a+b adjacent windows
+    assert 0.0 < roll["fragmentation"] < 1.0
+    assert roll["fragmentation"] == round(1.0 - 16 / 29, 4)
+    # without the pod grid the mesh term vanishes
+    roll2 = cluster_fragmentation(views)["v5e"]
+    assert roll2["largest_free_mesh"] == 0
+    assert roll2["fragmentation"] == round(1.0 - 8 / 29, 4)
+
+
+# ------------------------------------------------- scheduler (fleetsim)
+
+
+@pytest.fixture()
+def fleet():
+    from tpu_device_plugin.fleetsim import FleetSim
+    sim = FleetSim(n_nodes=4, devices_per_node=8, latency_s=0.0,
+                   max_inflight=0, seed=14)
+    for node in sim.nodes:
+        node.driver.publish_resource_slices()
+    yield sim
+    sim.stop()
+
+
+def _release_all(sched, sim):
+    for uid in list(sched._claims):
+        sched.release(uid)
+
+
+def test_scheduler_selector_filtering_and_decisions(fleet):
+    sched = fleet.scheduler(watch=False)
+    res = sched.schedule(
+        "2x2", "sel-1",
+        selector='topology.generation == "v5e" && topology.ring_size >= 4')
+    assert res["placed"] and res["score"] == 1.0
+    miss = sched.schedule("2x2", "sel-2",
+                          selector='topology.generation == "v4"')
+    assert not miss["placed"] and miss["reason"] == "unplaceable"
+    with pytest.raises(SelectorError):
+        sched.schedule("2x2", "sel-3", selector="topology.generation ==")
+    assert sched.snapshot()["selector_compile_errors_total"] == 1
+    # compile-once: the selector cache holds one entry per text
+    assert sched.selector('topology.generation == "v4"') is \
+        sched.selector('topology.generation == "v4"')
+    audit = sched.audit(fabric_audit=fleet.apiserver.multiclaim_audit())
+    assert audit["exactly_once"]
+    _release_all(sched, fleet)
+
+
+def test_scheduler_cross_host_mesh_through_watch_cache(fleet):
+    """Decisions consume the PR 12 Reflector's slice cache: LIST seeds
+    it, the published topology attributes rebuild the grids, and a
+    cross-host mesh claim commits through the multiclaim fabric."""
+    sched = fleet.scheduler(watch=True, resync_s=1.0)
+    sched.start()
+    try:
+        assert sched.wait_synced(timeout_s=15, min_slices=4)
+        res = sched.schedule("4x4", "mesh-1")
+        assert res["placed"] and res["score"] == 1.0 and res["hosts"] == 2
+        nodes = [n for n, _raws in res["shards"]]
+        coords = {node.name: node.cfg.host_coords
+                  for node in fleet.nodes}
+        assert placement.mesh_score(
+            [coords[n] for n in nodes], fleet.pod_dims) == 1.0
+        audit = sched.audit(
+            fabric_audit=fleet.apiserver.multiclaim_audit())
+        assert audit["exactly_once"] and audit["fabric_agrees"]
+        assert sched.release("mesh-1")
+    finally:
+        sched.stop()
+
+
+def test_scheduler_decision_replayed_under_faults_exactly_once(fleet):
+    """The ISSUE 14 convergence pin: a scheduler decision whose shard
+    prepare dies on an injected checkpoint.write fault rolls back
+    cleanly (no residue), and the REPLAYED decision converges with
+    exactly ONE commit on the cluster-wide log — fabric cross-check
+    included."""
+    from tpu_device_plugin import faults
+    sched = fleet.scheduler(watch=False)
+    faults.arm("checkpoint.write", kind="error", count=1)
+    try:
+        res = sched.schedule("4x4", "replay-1")
+        assert not res["placed"] and res.get("rolled_back")
+        assert fleet.slice_residue("replay-1") == []
+    finally:
+        faults.disarm("checkpoint.write")
+    res2 = sched.schedule("4x4", "replay-1")
+    assert res2["placed"]
+    audit = sched.audit(fabric_audit=fleet.apiserver.multiclaim_audit())
+    assert audit["exactly_once"], audit
+    assert audit["committed"].count("replay-1") == 1
+    entries = [k for k, uid, _d in sched._log if uid == "replay-1"]
+    assert entries.count("committed") == 1
+    assert entries.count("aborted") == 1
+    assert sched.release("replay-1")
+
+
+def test_defrag_wave_applied_node_by_node_via_handoff(fleet):
+    """Global wave: checkerboard one host so a 2x2 is unplaceable,
+    plan the wave over EVERY host's view, apply it node-by-node through
+    the PR 7 handoff machinery, and verify placeability flips."""
+    sched = fleet.scheduler(watch=False)
+    node = fleet.nodes[0]
+    view = node.host_view()
+    raw_at = {c: r for r, c in view.coords.items()}
+    # occupy the rest of the fleet so the wave must work on node 0
+    blockers = []
+    for i, other in enumerate(fleet.nodes[1:]):
+        uid = f"wavefill-{i}"
+        other.claim_devices(uid, sorted(other.host_view().free))
+        blockers.append(uid)
+    for i, c in enumerate([(0, 1), (1, 0), (0, 3), (1, 2)]):
+        node.claim_devices(f"wave-claim-{i}", [raw_at[c]])
+    handoffs_before = sum(
+        n.driver.handoff_stats["handoffs_completed_total"]
+        for n in fleet.nodes)
+    proposal = sched.plan_defrag_wave("2x2")
+    assert not proposal["placeable"] and proposal["satisfiable"]
+    assert proposal["moves"] >= 1
+    assert proposal["cluster_fragmentation"]["fragmentation"] > 0
+    report = sched.apply_defrag_wave(proposal)
+    assert report["moves_applied"] == report["moves_planned"] >= 1
+    handoffs_after = sum(
+        n.driver.handoff_stats["handoffs_completed_total"]
+        for n in fleet.nodes)
+    assert handoffs_after - handoffs_before == report["moves_applied"]
+    views, _ = sched.views_by_generation()
+    plan = placement.plan_slice((2, 2), views["v5e"])
+    assert plan is not None and plan.score == 1.0
+    assert sched.snapshot()["defrag_moves_total"] >= 1
+    audit = sched.audit()
+    assert audit["exactly_once"]
+    # unknown generation = typed 400-shaped error
+    with pytest.raises(ValueError):
+        sched.plan_defrag_wave("2x2", generation="nope")
+    # cleanup for the module-scoped fleet
+    for i in range(4):
+        node.detach([f"wave-claim-{i}"])
+    for i, other in enumerate(fleet.nodes[1:]):
+        other.detach([f"wavefill-{i}"])
+
+
+def test_defrag_migrates_scheduler_claims_then_release_clean(fleet):
+    """The claim-uid plane regression (review finding): a defrag wave
+    migrating SCHEDULER-placed claims in cache mode must unprepare the
+    real node-level sub-claims (not phantom parent uids), re-point the
+    ledger, and a later release of the migrated tenant must leave ZERO
+    residue anywhere — node checkpoints, CDI dirs, fabric records."""
+    sched = fleet.scheduler(watch=True, resync_s=1.0)
+    sched.start()
+    try:
+        assert sched.wait_synced(timeout_s=15, min_slices=4)
+        # fill three hosts through the scheduler; pack the fourth with
+        # eight single-chip tenants, then release a checkerboard of
+        # them so a 2x2 is unplaceable-but-satisfiable there
+        for i in range(3):
+            assert sched.schedule("2x4", f"mig-fill-{i}")["placed"]
+        singles = []
+        for i in range(8):
+            res = sched.schedule("1", f"mig-one-{i}")
+            assert res["placed"], res
+            singles.append((f"mig-one-{i}", res["shards"]))
+        board_node = singles[0][1][0][0]
+        coords_of = {}
+        for uid, shards in singles:
+            node_name, raws = shards[0]
+            assert node_name == board_node   # pristine-avoid packs one
+            view = next(v for v in sched.views_by_generation()[0]["v5e"]
+                        if v.node == board_node)
+            coords_of[uid] = view.coords[raws[0]]
+        checker = {(0, 0), (0, 2), (1, 1), (1, 3)}
+        for uid, c in coords_of.items():
+            if c in checker:
+                assert sched.release(uid)
+        plan = placement.plan_slice(
+            (2, 2), sched.views_by_generation()[0]["v5e"])
+        assert plan is None
+        prop = sched.plan_defrag_wave("2x2")
+        assert not prop["placeable"] and prop["satisfiable"]
+        assert prop["moves"] >= 1
+        # every named migration is a node-level claim id the board
+        # node's checkpoint really holds
+        for mig in prop["migrations"]:
+            assert mig["claim"].startswith("mig-one-")
+        report = sched.apply_defrag_wave(prop)
+        assert report["moves_applied"] == report["moves_planned"]
+        plan2 = placement.plan_slice(
+            (2, 2), sched.views_by_generation()[0]["v5e"])
+        assert plan2 is not None and plan2.score == 1.0
+        # release EVERY remaining tenant — including migrated ones —
+        # then prove nothing is left anywhere
+        for uid in list(sched._claims):
+            assert sched.release(uid), uid
+        for node in fleet.nodes:
+            assert node.driver.prepared_claim_count() == 0, node.name
+        with fleet.apiserver._lock:
+            assert not fleet.apiserver.claims
+        audit = sched.audit(
+            fabric_audit=fleet.apiserver.multiclaim_audit())
+        assert audit["exactly_once"], audit
+    finally:
+        sched.stop()
+
+
+# --------------------------------------------- zero-lock read gates
+
+
+def test_selector_and_fleet_accounting_reads_acquire_zero_locks():
+    """THE ISSUE 14 read-path gate: selector evaluation and fleet
+    accounting run on lock-free snapshots — counted by lockdep proxies
+    inside the `fleetplace.select` / `fleetplace.frag` brackets."""
+    with lockdep.scoped():
+        cache = SliceCache()
+        cache.on_sync([_slice_obj("n0", host=(0, 0)),
+                       _slice_obj("n1", host=(0, 1))])
+        sched = FleetScheduler(cache=cache, pod_dims=(1, 2))
+        sel = 'topology.generation == "v5e" && topology.ring_size >= 4'
+        sched.selector(sel)         # compile outside the measured reads
+        lockdep.reset()
+        for _ in range(5):
+            views, _c = sched.eligible_views(sel)
+            assert len(views) == 2
+            frag = sched.fragmentation()
+            assert frag["v5e"]["free"] == 16
+        stats = lockdep.path_stats()
+        for path in ("fleetplace.select", "fleetplace.frag"):
+            rec = stats[path]
+            assert rec["calls"] >= 5, stats
+            assert rec["lock_acquisitions"] == 0, \
+                f"{path} acquired {rec['lock_acquisitions']} locks"
+
+
+def test_schedule_decisions_are_flight_recorder_spans():
+    from tpu_device_plugin import trace
+    cache = SliceCache()
+    cache.on_sync([_slice_obj("n0", host=(0, 0))])
+    sched = FleetScheduler(cache=cache, pod_dims=(1, 1))
+    res = sched.schedule("2x2", "span-claim-1")
+    assert res["placed"] and res.get("advisory")   # plan-only mode
+    spans = trace.snapshot(claim="span-claim-1")
+    assert any(s["op"] == "fleetplace.schedule" for s in spans)
+
+
+def test_audit_detects_seeded_violations():
+    cache = SliceCache()
+    sched = FleetScheduler(cache=cache)
+    # duplicated commit
+    sched._note("decided", "dup", None)
+    sched._note("committed", "dup", None)
+    sched._note("committed", "dup", None)
+    # commit with no decision
+    sched._note("committed", "ghost", None)
+    # dirty abort: a prepared shard never rolled back
+    sched._note("decided", "dirty", None)
+    sched._note("shard_prepared", "dirty", "dirty-n0")
+    sched._note("aborted", "dirty", "boom")
+    audit = sched.audit()
+    assert not audit["exactly_once"]
+    assert audit["duplicated_commits"] == ["dup"]
+    assert audit["undecided_commits"] == ["ghost"]
+    assert audit["dirty_aborts"] == ["dirty"]
+    # fabric disagreement surfaces
+    audit2 = sched.audit(fabric_audit={"exactly_once": True,
+                                       "committed": ["other"]})
+    assert not audit2["fabric_agrees"]
+    assert "dup" in audit2["scheduler_only"]
+    assert audit2["fabric_only"] == ["other"]

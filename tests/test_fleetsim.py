@@ -377,9 +377,10 @@ def placement_fleet():
     placement facts are counted, never timed."""
     sims = []
 
-    def build(n_nodes=3):
+    def build(n_nodes=3, pod_dims=None):
         sim = FleetSim(n_nodes=n_nodes, devices_per_node=8,
-                       latency_s=0.0, max_inflight=0, seed=7)
+                       latency_s=0.0, max_inflight=0, seed=7,
+                       pod_dims=pod_dims)
         sims.append(sim)
         return sim
 
@@ -425,9 +426,11 @@ def test_four_chip_request_lands_on_one_ring_on_fragmented_host(
 
 
 def test_multi_host_slice_tiles_full_tori(placement_fleet):
-    """4x4 over 2x4 hosts = two whole tori; a host with any claim is
-    ineligible, and the committed claim is audited exactly-once."""
-    sim = placement_fleet(n_nodes=3)
+    """4x4 over 2x4 hosts = two whole tori joined by a pod-level ICI
+    link (ISSUE 14: the hosts must be ADJACENT on the pod grid, not
+    just free); a host with any claim is ineligible, and the committed
+    claim is audited exactly-once."""
+    sim = placement_fleet(n_nodes=3, pod_dims=(3, 1))
     dirty = sim.nodes[2]
     dirty.claim_devices("pin", [sorted(dirty.host_view().free)[0]])
     res = sim.prepare_slice("4x4", "mesh-16")
@@ -447,7 +450,9 @@ def test_multi_host_failure_rolls_back_whole_claim(placement_fleet):
     orphaned per-node specs or checkpoint entries anywhere, and both
     fabric audits stay exactly-once under an armed dra.publish fault."""
     faults.reset()
-    sim = placement_fleet(n_nodes=2)
+    # (2,1) pod column: the two hosts share a pod-level ICI link, so
+    # the 4x4 plans (and then deterministically fails mid-prepare)
+    sim = placement_fleet(n_nodes=2, pod_dims=(2, 1))
     try:
         free_before = [len(n.host_view().free) for n in sim.nodes]
         plan_nodes = [n.name for n in sim.nodes]

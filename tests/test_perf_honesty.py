@@ -965,6 +965,64 @@ def test_bench_placement_r12_pins_placement_quality():
     assert big["engine"]["placed"] == big["requests"], big
 
 
+def test_bench_fleetplace_r16_pins_cluster_placement():
+    """Round-16 fleet-placement pins against the RECORDED
+    docs/bench_fleetplace_r16.json (counted facts, CI-safe): the main
+    cell ran at 256 simulated nodes with CROSS-HOST slices through the
+    watch-stream slice cache, the engine beats the naive first-free
+    baseline on contiguity (strictly, and on mean score), the
+    fragmentation-over-churn curves are recorded for both arms, the
+    global defrag wave flipped an unplaceable 2x2 placeable via the
+    migration-handoff machinery, and EVERY cell audited exactly-once on
+    the fabric write log, the fabric multiclaim log, and the
+    cluster-wide scheduler commit log (fabric cross-check agreeing)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_fleetplace_r16.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    for cell in d["cells"]:
+        assert cell["exactly_once"], cell
+        assert cell["multiclaim_exactly_once"], cell
+        assert cell["scheduler_audit_exactly_once"], cell
+        assert cell["fabric_agrees"], cell
+
+    main = next(c for c in d["cells"] if c.get("nodes") == 256
+                and "engine" in c)
+    eng, nai = main["engine"], main["naive"]
+    assert main["chips"] == 2048
+    assert eng["contiguous"] > nai["contiguous"], main
+    assert eng["mean_score"] > nai["mean_score"], main
+    # cross-host slices were genuinely exercised and landed contiguous
+    assert eng["cross_host_requests"] >= 4, main
+    assert eng["cross_host_contiguous"] >= 1, main
+    # decisions consumed the watch-stream Reflector's slice cache
+    assert main["watch"]["cache_syncs"] >= 1, main
+    assert main["watch"]["cache_slices"] == 256, main
+    # the compiled-once selector evaluated without a single unknown-
+    # attribute or type miss against the published topology attributes
+    assert main["selector"]["evals_total"] > 0, main
+    assert main["selector"]["unknown_attribute_total"] == 0, main
+    assert main["selector"]["type_mismatch_total"] == 0, main
+    # fragmentation-over-churn curves recorded for BOTH arms
+    curve = main["fragmentation_over_churn"]
+    assert len(curve) >= 5, main
+    assert all("engine_fragmentation" in p and "naive_fragmentation"
+               in p for p in curve)
+    assert main["naive_multiclaim_exactly_once"], main
+
+    wave = next(c for c in d["cells"]
+                if c.get("cell") == "global_defrag_wave")
+    assert wave["moves_applied"] == wave["moves_planned"] >= 1, wave
+    assert wave["handoffs_completed"] == wave["moves_applied"], wave
+    assert not wave["placeable_before"] and wave["placeable_after"], wave
+    assert wave["fragmentation_after"] < wave["fragmentation_before"], \
+        wave
+
+
 def test_placement_scoring_zero_locks_is_live_not_just_recorded(
         short_root):
     """LIVE half of the r12 placement pin (the ISSUE 10 CI guard,

@@ -72,6 +72,26 @@ def test_parse_shape_rejects_malformed(bad):
         placement.parse_shape(bad)
 
 
+@pytest.mark.parametrize("bad", [
+    "0x2", "-1", "2x-3", [0], [2, -1],          # zero/negative axes
+    "4294967296x2", str(1 << 40),               # per-axis overflow
+    "1024x1024", [256, 256, 256],               # volume overflow
+    "2xa", "x", [2.5], None, object(),          # non-integer shapes
+])
+def test_parse_shape_rejects_degenerate_with_typed_error(bad):
+    """ISSUE 14 regression: zero/negative/overflow dimensions raise the
+    TYPED ShapeError (a ValueError, so /debug/defrag's 400 mapping
+    holds) instead of planning degenerate boxes — a 2^32-axis shape
+    must die at parse, not in _boxes' interval table."""
+    with pytest.raises(placement.ShapeError):
+        placement.parse_shape(bad)
+
+
+def test_parse_shape_accepts_bounds():
+    assert placement.parse_shape("1024") == (1024,)
+    assert placement.parse_shape([256, 256]) == (256, 256)
+
+
 def test_orientations_pad_and_permute():
     assert placement.orientations((4,), 2) == ((1, 4), (4, 1))
     # trailing 1-axes collapse: 2x2x1 on a 2D torus is just 2x2
@@ -350,12 +370,20 @@ def test_debug_defrag_endpoint_over_http(rig):
             prop = json.load(r)
         assert not prop["placeable"] and prop["satisfiable"]
         assert prop["moves"] >= 1 and prop["target"]["node"] == "n"
-        # malformed requests answer 400, not a stack trace
+        # ISSUE 14 satellite: the advisory carries the per-generation
+        # fragmentation records alongside the proposal (same values
+        # /status publishes), keyed by generation
+        assert prop["fragmentation"]["v5e"]["free"] == 4
+        assert prop["fragmentation"]["v5e"]["fragmentation"] > 0
+        # malformed requests answer 400, not a stack trace — including
+        # a generation with NO host view and overflow shapes
         for bad in ("/debug/defrag", "/debug/defrag?shape=0x2",
-                    "/debug/defrag?shape=2x2&generation=nope"):
+                    "/debug/defrag?shape=4294967296x2",
+                    "/debug/defrag?shape=2x2&generation=nope",
+                    "/debug/defrag?shape=2x2&generation="):
             with pytest.raises(urllib.error.HTTPError) as exc:
                 urllib.request.urlopen(base + bad, timeout=5)
-            assert exc.value.code == 400
+            assert exc.value.code == 400, bad
     finally:
         server.stop()
 

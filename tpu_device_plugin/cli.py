@@ -20,6 +20,26 @@ from .config import Config
 from .lifecycle import PluginManager
 
 
+def _parse_host_coords(text) -> "tuple[int, ...] | None":
+    """'x,y[,z]' → pod-grid coordinate tuple. A malformed value fails
+    LOUDLY (like the typo'd $TDP_BROKER contract): silently dropping it
+    would leave this host invisible to cross-host mesh planning with no
+    operator signal."""
+    if text is None or str(text).strip() == "":
+        return None
+    try:
+        coords = tuple(int(p) for p in str(text).split(","))
+    except ValueError:
+        raise SystemExit(
+            f"--host-coords/$TDP_HOST_COORDS {text!r} is not 'x,y[,z]' "
+            f"(comma-separated integers)") from None
+    if not coords or any(c < 0 for c in coords):
+        raise SystemExit(
+            f"--host-coords/$TDP_HOST_COORDS {text!r} must be "
+            f"non-negative integers")
+    return coords
+
+
 def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
     parser = argparse.ArgumentParser(
         prog="tpu-device-plugin",
@@ -49,6 +69,13 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="JSON overriding the device-id → generation table")
     parser.add_argument("--topology-file", default=None,
                         help="JSON mapping BDF → ICI torus coordinates")
+    parser.add_argument("--host-coords",
+                        default=os.environ.get("TDP_HOST_COORDS"),
+                        help="this host's slot on the pod-level host "
+                             "grid, 'x,y[,z]' — published as hostX/hostY"
+                             "[/hostZ] ResourceSlice attributes for the "
+                             "fleet placement control plane "
+                             "($TDP_HOST_COORDS)")
     parser.add_argument("--partition-config", default=None,
                         help="JSON declaring logical vTPU partitions")
     parser.add_argument("--max-partitions-per-chip", type=int,
@@ -327,6 +354,7 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             d.strip() for d in args.vfio_drivers.split(",") if d.strip()),
         generation_map_path=args.generation_map,
         topology_hints_path=args.topology_file,
+        host_coords=_parse_host_coords(args.host_coords),
         partition_config_path=args.partition_config,
         max_partitions_per_chip=args.max_partitions_per_chip,
         partition_node_permissions=args.partition_node_permissions,

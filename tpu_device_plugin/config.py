@@ -65,6 +65,13 @@ class Config:
     # Optional JSON file mapping BDF → ICI torus coordinates for hosts whose
     # physical chip order differs from BDF order.
     topology_hints_path: Optional[str] = None
+    # This host's slot on the POD-LEVEL host grid (e.g. (0, 3) on a 4x8
+    # v5e pod), published as hostX/hostY[/hostZ] ResourceSlice attributes
+    # so the fleet placement control plane (fleetplace.py) can model the
+    # pod's wrap-around inter-host ICI links. None = unknown (the fleet
+    # scheduler then treats cross-host contiguity for this host as
+    # unmodeled). Set via --host-coords "x,y[,z]" / $TDP_HOST_COORDS.
+    host_coords: Optional[tuple[int, ...]] = None
 
     # --- vTPU partitions ----------------------------------------------------
     # Optional JSON file declaring logical partitions of physical chips for

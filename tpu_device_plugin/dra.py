@@ -597,7 +597,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             self._save_sticky_names()
 
     def _device_entry(self, name: str, kind: str, group_name: str,
-                      obj, version: str = "v1beta1") -> dict:
+                      obj, version: str = "v1beta1",
+                      info=None) -> dict:
         if kind == "chip":
             d: TpuDevice = obj
             attrs = {
@@ -612,6 +613,28 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             if d.ici_coords is not None:
                 for axis, coord in zip("xyz", d.ici_coords):
                     attrs[f"ici{axis.upper()}"] = {"int": coord}
+            # Published ICI topology (the PR 10 follow-on): torus dims,
+            # ring/host ids and the pod-grid slot give fleet-side
+            # selectors (fleetplace.py) real fields to match against —
+            # `topology.ring_size >= 4`, `topology.host_id == ...` —
+            # and let the cluster scheduler rebuild this host's
+            # placement grid from the slice alone.
+            if info is not None and d.ici_coords is not None:
+                dims = tuple(info.host_topology)
+                for axis, dim in zip("xyz", dims):
+                    attrs[f"torus{axis.upper()}"] = {"int": dim}
+                attrs["ringSize"] = {"int": max(dims)}
+                attrs["hostId"] = {"string": self.node_name}
+                # the chip's wrap-around ICI ring on the host torus:
+                # its coordinates with the longest axis projected out
+                ring_axis = dims.index(max(dims))
+                ring = [str(c) for i, c in enumerate(d.ici_coords)
+                        if i != ring_axis]
+                attrs["ringId"] = {"string": "/".join(
+                    [self.node_name, group_name] + ring)}
+            if self.cfg.host_coords is not None:
+                for axis, coord in zip("xyz", self.cfg.host_coords):
+                    attrs[f"host{axis.upper()}"] = {"int": int(coord)}
         else:
             p: TpuPartition = obj
             attrs = {
@@ -642,8 +665,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # read the inventory epoch, no lock: the slice body is a pure
         # function of one immutable snapshot
         ep = self._inv_store.current
+        infos = {info.name: info for info in self.generations.values()}
         devices = [self._device_entry(name, kind, group_name, obj,
-                                      version)
+                                      version,
+                                      info=infos.get(group_name))
                    for name, (kind, group_name, obj)
                    in ep.by_name.items()
                    if self._raw_id(kind, obj) not in ep.unhealthy]
@@ -1197,7 +1222,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 node=self.node_name, dims=g["dims"], coords=g["coords"],
                 names=g["names"], free=frozenset(g["free"]),
                 departed=frozenset(g["departed"]),
-                claims={uid: raws for uid, raws in claims.items() if raws})
+                claims={uid: raws for uid, raws in claims.items() if raws},
+                host_coords=self.cfg.host_coords)
         return views
 
     def _recompute_fragmentation_locked(self) -> None:
@@ -1235,10 +1261,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             generation = next(iter(views))
         view = views.get(generation)
         if view is None:
+            # a named generation with NO host view (never discovered, or
+            # every chip departed without a surviving grid) is a caller
+            # error the /debug/defrag handler answers 400, not an empty
+            # advisory that reads as "nothing to do"
             raise ValueError(
                 f"unknown generation {generation!r}; have {sorted(views)}")
         proposal = placement.propose_defrag(shape, [view])
         proposal["generation"] = generation
+        # the advisory carries the SAME per-generation fragmentation
+        # records /status + /metrics publish (lock-free swap-read), so
+        # an operator reading a proposal sees the scores that motivated
+        # it without a second scrape
+        proposal["fragmentation"] = dict(self.fragmentation_stats())
         with self._lock:
             self.placement_stats["defrag_proposals_total"] += 1
             if not proposal["satisfiable"]:

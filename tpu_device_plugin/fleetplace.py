@@ -1,0 +1,1142 @@
+"""Fleet placement control plane — cluster-wide ICI slice scheduler.
+
+PR 10's placement engine (placement.py) plans within ONE daemon's host
+view; production TPU fleets place slices across thousands of hosts.
+This module is the scheduler-side consumer ROADMAP item 1 names: it
+merges every daemon's PUBLISHED host view — the ResourceSlices the
+fleet's drivers keep converged through the PR 12 watch plane — into one
+cluster placement decision. Like gpu_ext moves GPU policy out of the
+fixed driver into operator-extensible programs (PAPERS.md), the
+placement decision moves out of the per-host daemon into a control
+plane driven by typed selector expressions over the topology attributes
+the daemons publish (dra._device_entry: ICI coords, torus dims,
+generation, ring/host ids).
+
+Three planes, all reading lock-free snapshots:
+
+1. **Selector engine.** CEL-style typed attribute expressions —
+   `topology.generation == "v5e" && topology.ring_size >= 4` — compiled
+   ONCE (`compile_selector`; malformed text raises SelectorError at
+   compile, never at match) and evaluated over per-device attribute
+   views (`device_attrs`). Pure compute over immutable inputs: no
+   selector evaluation ever takes a lock. Semantics: an empty selector
+   matches everything; a predicate over an unknown attribute or a
+   type-mismatched comparison poisons its boolean branch to NO MATCH
+   (counted, never raised to callers) — short-circuit `&&`/`||` mean an
+   already-decided branch never touches the bad predicate.
+
+2. **Slice cache + fleet views.** `SliceCache` is the scheduler-side
+   informer cache: the PR 12 kubeapi.Reflector feeds it (`on_sync` /
+   `on_event`, both idempotent under the at-least-once contract), the
+   writer swaps an immutable snapshot under its lock, and every reader
+   — selector filtering, placement planning, fragmentation accounting —
+   consumes the snapshot without locking. `host_views_from_slices`
+   parses published topology attributes back into placement.HostView
+   grids, overlaying the scheduler's own claim ledger (a scheduler
+   knows what IT placed; slices advertise capacity, not tenancy).
+
+3. **FleetScheduler.** Cluster decisions end-to-end: selector-filtered
+   views → placement.plan_slice with the POD-LEVEL host grid (cross-
+   host wrap-around ICI meshes, mesh_score contiguity) → execution
+   through the fleetsim multiclaim fabric — with ONE commit log
+   spanning scheduler decision → per-node sub-claims → rollback,
+   audited exactly-once cluster-wide (`audit`), every decision a
+   flight-recorder span (`fleetplace.schedule`), and fleet-global
+   fragmentation rolled up per generation (`cluster_fragmentation`)
+   to drive globally-planned defrag waves applied node-by-node through
+   the PR 7 migration-handoff machinery.
+
+docs/design.md "Fleet placement control plane" documents the selector
+grammar, the cross-host mesh model, and the global defrag sequence.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import re
+import threading
+import time
+from dataclasses import replace
+from types import MappingProxyType
+from typing import (Callable, Dict, List, Mapping, Optional, Sequence,
+                    Tuple)
+
+from . import lockdep, trace
+from .epoch import AtomicCounter
+from .placement import HostView, volume
+
+log = logging.getLogger(__name__)
+
+__all__ = ["SelectorError", "CompiledSelector", "compile_selector",
+           "device_attrs", "SliceCache", "host_views_from_slices",
+           "cluster_fragmentation", "FleetScheduler"]
+
+
+# ====================================================================
+# selector engine
+# ====================================================================
+
+
+class SelectorError(ValueError):
+    """A selector that cannot compile: bad token, unbalanced parens,
+    dangling operator, mixed-type list literal. Raised at COMPILE time
+    — a malformed expression must fail loudly when the operator writes
+    it, never silently at match time."""
+
+
+class _EvalMiss(Exception):
+    """Internal: a predicate touched an unknown attribute or mismatched
+    types. Poisons the enclosing boolean branch to no-match; counted by
+    CompiledSelector.matches, never raised to callers."""
+
+    __slots__ = ("kind",)
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<lparen>\() | (?P<rparen>\)) |
+      (?P<lbracket>\[) | (?P<rbracket>\]) | (?P<comma>,) |
+      (?P<cmp>==|!=|<=|>=|<|>) |
+      (?P<andop>&&) | (?P<orop>\|\|) | (?P<notop>!) |
+      (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*') |
+      (?P<int>-?\d+\b) |
+      (?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*)
+    )""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    tokens: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None or m.end() == m.start():
+            rest = text[pos:].lstrip()
+            if not rest:
+                break
+            raise SelectorError(
+                f"selector: unrecognized input at {rest[:20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind is None:      # trailing whitespace
+            continue
+        tokens.append((kind, m.group(kind)))
+    return tokens
+
+
+def _type_name(value) -> str:
+    # bool before int: isinstance(True, int) holds in Python, but a
+    # selector comparing a bool attribute against 1 is a type error
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, int):
+        return "int"
+    return "string"
+
+
+_CMP_OPS: Dict[str, Callable] = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+_ORDER_OPS = {"<", "<=", ">", ">="}
+
+_MISSING = object()
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+def resolve_attr(attrs: Mapping[str, object], ident: str):
+    """Selector identifier → published attribute value. `topology.` /
+    `device.` prefixes address the same flat attribute map the daemon
+    publishes; snake_case falls back to the camelCase the wire uses
+    (`topology.ring_size` → `ringSize`). Returns _MISSING when no
+    candidate resolves."""
+    suffix = ident.split(".", 1)[1] \
+        if ident.split(".", 1)[0] in ("topology", "device") \
+        and "." in ident else ident
+    for cand in (ident, suffix, _camel(suffix)):
+        if cand in attrs:
+            return attrs[cand]
+    return _MISSING
+
+
+class _Parser:
+    """Recursive-descent over the token list; every production returns
+    a closure. Value closures: attrs -> python value (raising _EvalMiss
+    on unknown attributes). Boolean closures: attrs -> bool."""
+
+    def __init__(self, tokens: List[Tuple[str, str]]) -> None:
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.tokens[self.pos] if self.pos < len(self.tokens) \
+            else None
+
+    def take(self, kind: Optional[str] = None) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise SelectorError("selector: unexpected end of expression")
+        if kind is not None and tok[0] != kind:
+            raise SelectorError(f"selector: expected {kind}, got "
+                                f"{tok[1]!r}")
+        self.pos += 1
+        return tok
+
+    # ------------------------------------------------------- grammar
+
+    def parse(self) -> Callable:
+        fn = self.expr()
+        if self.peek() is not None:
+            raise SelectorError(
+                f"selector: trailing input at {self.peek()[1]!r}")
+        return fn
+
+    def expr(self) -> Callable:
+        terms = [self.and_()]
+        while self.peek() and self.peek()[0] == "orop":
+            self.take()
+            terms.append(self.and_())
+        if len(terms) == 1:
+            return terms[0]
+
+        def run_or(attrs, _terms=tuple(terms)):
+            for t in _terms:
+                if t(attrs):
+                    return True
+            return False
+        return run_or
+
+    def and_(self) -> Callable:
+        terms = [self.unary()]
+        while self.peek() and self.peek()[0] == "andop":
+            self.take()
+            terms.append(self.unary())
+        if len(terms) == 1:
+            return terms[0]
+
+        def run_and(attrs, _terms=tuple(terms)):
+            for t in _terms:
+                if not t(attrs):
+                    return False
+            return True
+        return run_and
+
+    def unary(self) -> Callable:
+        if self.peek() and self.peek()[0] == "notop":
+            self.take()
+            inner = self.unary()
+            return lambda attrs: not inner(attrs)
+        return self.primary()
+
+    def primary(self) -> Callable:
+        tok = self.peek()
+        if tok is None:
+            raise SelectorError("selector: unexpected end of expression")
+        if tok[0] == "lparen":
+            self.take()
+            inner = self.expr()
+            self.take("rparen")
+            return inner
+        lhs, lhs_desc = self.operand()
+        nxt = self.peek()
+        if nxt is not None and nxt[0] == "cmp":
+            op = self.take()[1]
+            rhs, _rhs_desc = self.operand()
+            return self._comparison(lhs, op, rhs)
+        if nxt is not None and nxt[0] == "ident" and nxt[1] == "in":
+            self.take()
+            members = self.list_literal()
+            return self._membership(lhs, members)
+        # bare operand: must evaluate to a bool attribute/literal
+
+        def run_bare(attrs, _lhs=lhs, _desc=lhs_desc):
+            value = _lhs(attrs)
+            if not isinstance(value, bool):
+                raise _EvalMiss("type_mismatch")
+            return value
+        return run_bare
+
+    @staticmethod
+    def _unquote(text: str) -> str:
+        """Decode one string-literal token — shared by the operand and
+        list-literal positions so the same quoted token denotes the
+        same value in `==` and `in` contexts."""
+        return text[1:-1].replace("\\" + text[0], text[0]) \
+            .replace("\\\\", "\\")
+
+    def operand(self) -> Tuple[Callable, str]:
+        tok = self.take()
+        kind, text = tok
+        if kind == "string":
+            value = self._unquote(text)
+            return (lambda attrs, _v=value: _v), f"string {value!r}"
+        if kind == "int":
+            value = int(text)
+            return (lambda attrs, _v=value: _v), f"int {value}"
+        if kind == "ident":
+            if text in ("true", "false"):
+                value = text == "true"
+                return (lambda attrs, _v=value: _v), f"bool {text}"
+            if text == "in":
+                raise SelectorError("selector: 'in' needs a left operand")
+
+            def run_ident(attrs, _name=text):
+                value = resolve_attr(attrs, _name)
+                if value is _MISSING:
+                    raise _EvalMiss("unknown_attribute")
+                return value
+            return run_ident, f"attribute {text}"
+        raise SelectorError(f"selector: expected a value, got {text!r}")
+
+    def list_literal(self) -> Tuple:
+        self.take("lbracket")
+        members: List = []
+        while True:
+            tok = self.peek()
+            if tok is None:
+                raise SelectorError("selector: unterminated list literal")
+            if tok[0] == "rbracket":
+                self.take()
+                break
+            if members:
+                self.take("comma")
+                tok = self.peek()
+                if tok is not None and tok[0] == "rbracket":
+                    self.take()
+                    break
+            kind, text = self.take()
+            if kind == "string":
+                members.append(self._unquote(text))
+            elif kind == "int":
+                members.append(int(text))
+            elif kind == "ident" and text in ("true", "false"):
+                members.append(text == "true")
+            else:
+                raise SelectorError(
+                    f"selector: list literals hold literals only, got "
+                    f"{text!r}")
+        if members and len({_type_name(m) for m in members}) > 1:
+            raise SelectorError("selector: mixed-type list literal")
+        return tuple(members)
+
+    @staticmethod
+    def _comparison(lhs: Callable, op: str, rhs: Callable) -> Callable:
+        fn = _CMP_OPS[op]
+        ordered = op in _ORDER_OPS
+
+        def run_cmp(attrs):
+            a = lhs(attrs)
+            b = rhs(attrs)
+            ta, tb = _type_name(a), _type_name(b)
+            if ta != tb or (ordered and ta == "bool"):
+                raise _EvalMiss("type_mismatch")
+            return fn(a, b)
+        return run_cmp
+
+    @staticmethod
+    def _membership(lhs: Callable, members: Tuple) -> Callable:
+        member_type = _type_name(members[0]) if members else None
+
+        def run_in(attrs):
+            value = lhs(attrs)
+            if member_type is not None \
+                    and _type_name(value) != member_type:
+                raise _EvalMiss("type_mismatch")
+            return value in members
+        return run_in
+
+
+class CompiledSelector:
+    """One compiled selector: `matches(attrs)` over a per-device
+    attribute view. Stateless between calls except the lock-free
+    AtomicCounter stats — safe to share across scheduler threads, safe
+    inside zero-lock read paths."""
+
+    __slots__ = ("text", "_fn", "stats")
+
+    STAT_KEYS = ("evals_total", "matches_total",
+                 "unknown_attribute_total", "type_mismatch_total")
+
+    def __init__(self, text: str, fn: Optional[Callable]) -> None:
+        self.text = text
+        self._fn = fn
+        self.stats = {key: AtomicCounter() for key in self.STAT_KEYS}
+
+    def matches(self, attrs: Mapping[str, object]) -> bool:
+        self.stats["evals_total"].add()
+        if self._fn is None:          # empty selector: match-all
+            self.stats["matches_total"].add()
+            return True
+        try:
+            ok = bool(self._fn(attrs))
+        except _EvalMiss as miss:
+            self.stats[f"{miss.kind}_total"].add()
+            ok = False
+        if ok:
+            self.stats["matches_total"].add()
+        return ok
+
+    def snapshot(self) -> Dict[str, int]:
+        return {key: counter.value
+                for key, counter in self.stats.items()}
+
+
+def compile_selector(text: str) -> CompiledSelector:
+    """Compile a selector expression ONCE; evaluate many times.
+    Raises SelectorError on malformed input — compile is where
+    expressions fail, match never raises. An empty/whitespace selector
+    compiles to match-all."""
+    text = (text or "").strip()
+    if not text:
+        return CompiledSelector(text, None)
+    return CompiledSelector(text, _Parser(_tokenize(text)).parse())
+
+
+def device_attrs(entry: Mapping) -> Dict[str, object]:
+    """Flatten one ResourceSlice device entry's typed attributes
+    ({"string"|"int"|"bool": v}, v1beta1 "basic"-nested or v1 flat)
+    into the plain {name: value} view selectors evaluate over. The
+    device's published name rides along as "name"."""
+    basic = entry.get("basic")
+    attrs = (basic or {}).get("attributes") if isinstance(basic, Mapping) \
+        else entry.get("attributes")
+    out: Dict[str, object] = {}
+    for name, tv in (attrs or {}).items():
+        if not isinstance(tv, Mapping):
+            continue
+        if "string" in tv:
+            out[name] = str(tv["string"])
+        elif "bool" in tv:
+            out[name] = bool(tv["bool"])
+        elif "int" in tv:
+            out[name] = int(tv["int"])
+    out.setdefault("name", entry.get("name"))
+    return out
+
+
+# ====================================================================
+# scheduler-side slice cache (the PR 12 Reflector's consumer)
+# ====================================================================
+
+
+class SliceCache:
+    """Informer cache over published ResourceSlices, fed by a
+    kubeapi.Reflector (`on_sync` for LIST states, `on_event` for watch
+    events — both idempotent, surviving the at-least-once delivery
+    contract). The writer (reflector thread) mutates its private dict
+    under `_lock` and swaps an IMMUTABLE MappingProxyType snapshot;
+    `snapshot()` readers never lock — fleet accounting and selector
+    evaluation run against one frozen cluster state."""
+
+    def __init__(self) -> None:
+        self._lock = lockdep.instrument(
+            "fleetplace.SliceCache._lock", threading.Lock())
+        self._by_name: Dict[str, dict] = {}
+        self._snap: Mapping[str, dict] = MappingProxyType({})
+        self.syncs = AtomicCounter()
+        self.events = AtomicCounter()
+
+    def on_sync(self, items: Sequence[dict]) -> None:
+        self.syncs.add()
+        fresh = {}
+        for obj in items or ():
+            name = ((obj.get("metadata") or {}).get("name"))
+            # real apiserver LIST items omit per-item kind (only the
+            # List envelope carries one) — skip an item only when a
+            # kind IS present and names something else
+            if name and obj.get("kind") in (None, "ResourceSlice"):
+                fresh[name] = obj
+        with self._lock:
+            self._by_name = fresh
+            self._snap = MappingProxyType(dict(fresh))
+
+    def on_event(self, evt: dict) -> None:
+        self.events.add()
+        obj = evt.get("object") or {}
+        name = (obj.get("metadata") or {}).get("name")
+        if not name:
+            return
+        with self._lock:
+            if evt.get("type") == "DELETED":
+                self._by_name.pop(name, None)
+            else:
+                self._by_name[name] = obj
+            self._snap = MappingProxyType(dict(self._by_name))
+
+    def snapshot(self) -> Mapping[str, dict]:
+        """Lock-free: one attribute read of an immutable mapping."""
+        return self._snap
+
+
+_AXES = "xyz"
+
+
+def _axis_attrs(attrs: Mapping[str, object], prefix: str
+                ) -> Optional[Tuple[int, ...]]:
+    """("iciX","iciY"[,"iciZ"]) / ("torusX",..) / ("hostX",..) →
+    coordinate tuple, None when the leading axis is absent."""
+    out: List[int] = []
+    for axis in _AXES:
+        value = attrs.get(f"{prefix}{axis.upper()}")
+        if not isinstance(value, int) or isinstance(value, bool):
+            break
+        out.append(value)
+    return tuple(out) if out else None
+
+
+def host_views_from_slices(
+    slices: Mapping[str, dict],
+    claims: Mapping[str, Tuple[Tuple[str, str, Tuple[str, ...]], ...]],
+) -> Tuple[Dict[str, List[HostView]],
+           Dict[Tuple[str, str], Dict[str, Dict[str, object]]]]:
+    """Published ResourceSlices + the scheduler's claim ledger → the
+    cluster's placement views.
+
+    The ledger maps uid -> ((sub_uid, node, raws), ...): each shard
+    carries its NODE-LEVEL claim identity (`<uid>-<node>` at placement
+    time, stable across defrag migrations), and the views' claims maps
+    are keyed by those sub-uids — the ids the node drivers' checkpoints
+    actually hold — so a defrag advisory computed over these views
+    names claims the handoff machinery can really unprepare.
+
+    Returns (views_by_generation, attrs_index): one HostView per
+    (node, generation) grouped by generation name, plus the per-device
+    attribute views ((node, generation) -> bdf -> attrs) selector
+    filtering evaluates. Pure compute over the immutable cache
+    snapshot: devices without ICI coords or torus dims (partitions,
+    pre-topology daemons) are skipped — a scheduler cannot place a mesh
+    on chips whose topology it cannot see. Departed chips never appear
+    (the daemon prunes them from its slice), so scheduler-side views
+    carry no departed holes; per-daemon /status keeps that accounting.
+    """
+    grids: Dict[Tuple[str, str], dict] = {}
+    attrs_index: Dict[Tuple[str, str], Dict[str, Dict[str, object]]] = {}
+    # keyed (node, raw): BDFs repeat across hosts — every node
+    # enumerates 0000:00:04.0 — so a bare-BDF key would mark one
+    # claim's chips busy fleet-wide
+    claimed: Dict[Tuple[str, str], str] = {}
+    claim_raws: Dict[Tuple[str, str], Dict[str, List[str]]] = {}
+    for _uid, shards in claims.items():
+        for sub_uid, node, raws in shards:
+            for raw in raws:
+                claimed[(node, raw)] = sub_uid
+    for obj in slices.values():
+        spec = obj.get("spec") or {}
+        node = spec.get("nodeName")
+        if not node:
+            continue
+        for entry in spec.get("devices") or ():
+            attrs = device_attrs(entry)
+            generation = attrs.get("generation")
+            bdf = attrs.get("bdf")
+            coords = _axis_attrs(attrs, "ici")
+            dims = _axis_attrs(attrs, "torus")
+            if not generation or not bdf or coords is None or dims is None:
+                continue
+            if len(coords) != len(dims):
+                continue
+            key = (node, str(generation))
+            g = grids.setdefault(key, {
+                "dims": dims, "coords": {}, "names": {}, "free": set(),
+                "host_coords": _axis_attrs(attrs, "host")})
+            g["coords"][bdf] = coords
+            g["names"][bdf] = str(attrs.get("name"))
+            attrs_index.setdefault(key, {})[bdf] = attrs
+            uid = claimed.get((node, bdf))
+            if uid is None:
+                g["free"].add(bdf)
+            else:
+                claim_raws.setdefault(key, {}).setdefault(
+                    uid, []).append(bdf)
+    views: Dict[str, List[HostView]] = {}
+    for (node, generation), g in sorted(grids.items()):
+        views.setdefault(generation, []).append(HostView(
+            node=node, dims=g["dims"],
+            coords=g["coords"], names=g["names"],
+            free=frozenset(g["free"]), departed=frozenset(),
+            claims={uid: tuple(raws) for uid, raws
+                    in claim_raws.get((node, generation), {}).items()},
+            host_coords=g["host_coords"]))
+    return views, attrs_index
+
+
+def _view_attrs(generation: str, view: HostView, raw: str
+                ) -> Dict[str, object]:
+    """Synthesize the published attribute view for one chip of a
+    driver-side HostView — the same fields dra._device_entry puts on
+    the wire, so selector semantics cannot drift between the watch-fed
+    and the direct-views scheduler modes."""
+    dims = view.dims
+    out: Dict[str, object] = {
+        "generation": generation,
+        "bdf": raw,
+        "name": view.names.get(raw, raw),
+        "ringSize": max(dims),
+        "hostId": view.node,
+    }
+    coords = view.coords.get(raw)
+    if coords is not None:
+        for axis, coord in zip(_AXES, coords):
+            out[f"ici{axis.upper()}"] = coord
+        ring_axis = dims.index(max(dims))
+        ring = [str(c) for i, c in enumerate(coords) if i != ring_axis]
+        out["ringId"] = "/".join([view.node, generation] + ring)
+    for axis, d in zip(_AXES, dims):
+        out[f"torus{axis.upper()}"] = d
+    if view.host_coords is not None:
+        for axis, coord in zip(_AXES, view.host_coords):
+            out[f"host{axis.upper()}"] = coord
+    return out
+
+
+# ====================================================================
+# fleet-global fragmentation accounting
+# ====================================================================
+
+
+def _largest_free_mesh(views: Sequence[HostView],
+                       pod_dims: Optional[Tuple[int, ...]]) -> int:
+    """Chips in the largest wrap-aware host-grid window made entirely
+    of FULLY-FREE hosts — the biggest cross-host slice placeable right
+    now. 0 when the pod grid is unmodeled or fewer than two hosts are
+    fully free."""
+    from . import placement
+    if pod_dims is None:
+        return 0
+    free_hosts = [v for v in views
+                  if v.host_coords is not None
+                  and len(v.host_coords) == len(pod_dims)
+                  and len(v.free_coords()) == volume(v.dims)
+                  and not v.departed]
+    if len(free_hosts) < 2:
+        return 0
+    by_dims: Dict[Tuple[int, ...], List[HostView]] = {}
+    for v in free_hosts:
+        by_dims.setdefault(v.dims, []).append(v)
+    best = 0
+    for dims, hosts in by_dims.items():
+        host_vol = volume(dims)
+        slots = {v.host_coords for v in hosts}
+        # windows scanned largest-volume-first so the first hit wins
+        shapes = sorted(
+            itertools.product(*[range(1, p + 1) for p in pod_dims]),
+            key=volume, reverse=True)
+        for counts in shapes:
+            n = volume(counts)
+            # n >= 2: a (1,1) window is a single host, not a mesh —
+            # counting it would report cross-host capacity that does
+            # not exist (largest_free_box already covers it)
+            if n < 2 or n * host_vol <= best or n > len(slots):
+                continue
+            if placement._mesh_window(counts, hosts, pod_dims) is not None:
+                best = n * host_vol
+                break
+    return best
+
+
+def cluster_fragmentation(
+    views_by_gen: Mapping[str, Sequence[HostView]],
+    pod_dims: Optional[Tuple[int, ...]] = None,
+) -> Dict[str, dict]:
+    """Many hosts' fragmentation records rolled into one cluster curve
+    per generation — the record the bench's fragmentation-over-churn
+    curves and the defrag planner read. Pure compute over immutable
+    views (lock-free by construction):
+
+      hosts/chips/free        cluster totals
+      fully_free_hosts        whole tori available for cross-host tiling
+      largest_free_box        best single-host contiguous placement
+      largest_free_mesh       best cross-host wrap-window placement
+      fragmentation           1 - largest_placeable/free (0.0 = one
+                              placement reaches every free chip)
+      mean_host_fragmentation per-host scores averaged (the per-daemon
+                              records' rollup)
+    """
+    from . import placement
+    out: Dict[str, dict] = {}
+    for generation, views in sorted(views_by_gen.items()):
+        records = [placement.fragmentation(v) for v in views]
+        free = sum(r["free"] for r in records)
+        largest_box = max((r["largest_free_box"] for r in records),
+                          default=0)
+        largest_mesh = _largest_free_mesh(views, pod_dims)
+        largest = max(largest_box, largest_mesh)
+        out[generation] = {
+            "hosts": len(views),
+            "chips": sum(r["chips"] for r in records),
+            "free": free,
+            "departed": sum(r["departed"] for r in records),
+            "fully_free_hosts": sum(
+                1 for v in views
+                if len(v.free_coords()) == volume(v.dims)
+                and not v.departed),
+            "largest_free_box": largest_box,
+            "largest_free_mesh": largest_mesh,
+            "fragmentation": 0.0 if free == 0
+            else round(1.0 - largest / free, 4),
+            "mean_host_fragmentation": round(
+                sum(r["fragmentation"] for r in records)
+                / max(1, len(records)), 4),
+        }
+    return out
+
+
+# ====================================================================
+# the scheduler
+# ====================================================================
+
+
+class FleetScheduler:
+    """Cluster-wide slice scheduler over the published topology.
+
+    Views come from the reflector-fed SliceCache (production shape) or
+    a `views_source` callable returning {generation: [HostView]}
+    (tests/benches without a watch plane). Decisions execute through an
+    `executor` — fleetsim.FleetSim is the reference implementation
+    (`execute_plan` / `release_plan` / `apply_defrag`), carrying the
+    fabric's cross-node multiclaim records — and EVERY lifecycle step
+    lands in one commit log: decision → per-node sub-claims → rollback/
+    commit, audited exactly-once by `audit()`. All reads (selector
+    filtering, views, fragmentation) are lock-free snapshot reads
+    bracketed by lockdep read paths, pinned at zero lock acquisitions
+    by tests/test_fleetplace.py."""
+
+    def __init__(self, executor=None,
+                 cache: Optional[SliceCache] = None,
+                 reflector=None,
+                 views_source: Optional[Callable[[], Mapping[
+                     str, Sequence[HostView]]]] = None,
+                 pod_dims: Optional[Tuple[int, ...]] = None) -> None:
+        if cache is None and views_source is None:
+            raise ValueError("FleetScheduler needs a SliceCache or a "
+                             "views_source")
+        self.executor = executor
+        self.cache = cache
+        self.reflector = reflector
+        self._views_source = views_source
+        self.pod_dims = tuple(pod_dims) if pod_dims else None
+        # claim ledger: uid -> ((sub_uid, node, raws), ...) — each
+        # shard carries its node-level claim identity, minted at
+        # placement (`<uid>-<node>`) and KEPT across defrag migrations
+        # (the node checkpoints know the claim by that id wherever it
+        # lives now). Copy-on-write swaps keep readers lock-free (the
+        # GIL makes the attribute store atomic).
+        self._claims: Dict[str, Tuple] = {}
+        # identity-memoized cluster views: both the cache snapshot and
+        # the ledger are swapped wholesale (never mutated), so reusing
+        # the parse while both references are unchanged is exact —
+        # steady-state reads stop re-parsing 2048 device entries per
+        # decision at 256 nodes
+        self._views_memo: Optional[Tuple] = None
+        self._claims_lock = lockdep.instrument(
+            "fleetplace.FleetScheduler._claims_lock", threading.Lock())
+        # THE commit log: (kind, uid, detail) tuples, append-only.
+        # list.append is GIL-atomic; audit() reads a C-atomic copy.
+        self._log: List[Tuple[str, str, object]] = []
+        self._selectors: Dict[str, CompiledSelector] = {}
+        self._selector_lock = lockdep.instrument(
+            "fleetplace.FleetScheduler._selector_lock", threading.Lock())
+        self.stats = {key: AtomicCounter() for key in (
+            "decisions_total", "placed_total", "unplaceable_total",
+            "rollbacks_total", "releases_total", "defrag_waves_total",
+            "defrag_moves_total", "selector_compile_errors_total")}
+
+    # ------------------------------------------------------- control
+
+    def start(self) -> None:
+        if self.reflector is not None:
+            self.reflector.start()
+
+    def stop(self) -> None:
+        if self.reflector is not None:
+            self.reflector.stop()
+
+    def wait_synced(self, timeout_s: float = 10.0,
+                    min_slices: int = 0) -> bool:
+        """Block until the reflector's first LIST seeded the cache (and
+        at least `min_slices` slices are visible) — the scheduler's
+        boot barrier. True on sync, False on timeout."""
+        if self.cache is None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.cache.syncs.value > 0 \
+                    and len(self.cache.snapshot()) >= min_slices:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # ------------------------------------------------- views + selectors
+
+    def selector(self, text: str) -> CompiledSelector:
+        """Compile-once cache: one CompiledSelector per expression text,
+        its stats accumulating across decisions. Compile failures count
+        and re-raise (SelectorError)."""
+        text = (text or "").strip()
+        compiled = self._selectors.get(text)    # lock-free hit
+        if compiled is not None:
+            return compiled
+        try:
+            compiled = compile_selector(text)
+        except SelectorError:
+            self.stats["selector_compile_errors_total"].add()
+            raise
+        with self._selector_lock:
+            compiled = self._selectors.setdefault(text, compiled)
+        return compiled
+
+    def views_by_generation(self) -> Tuple[
+            Dict[str, List[HostView]],
+            Dict[Tuple[str, str], Dict[str, Dict[str, object]]]]:
+        """The merged cluster view: every daemon's published host view
+        + the scheduler's own ledger. Lock-free snapshot reads. In
+        views_source mode the attribute index is SYNTHESIZED from the
+        views with the same fields the daemon publishes, so selectors
+        behave identically with or without a watch plane."""
+        if self.cache is not None:
+            snap = self.cache.snapshot()
+            claims = self._claims
+            memo = self._views_memo
+            if memo is not None and memo[0] is snap \
+                    and memo[1] is claims:
+                return memo[2], memo[3]
+            views, idx = host_views_from_slices(snap, claims)
+            self._views_memo = (snap, claims, views, idx)
+            return views, idx
+        views = {gen: list(vs)
+                 for gen, vs in self._views_source().items()}
+        attrs_index: Dict[Tuple[str, str],
+                          Dict[str, Dict[str, object]]] = {}
+        for gen, vs in views.items():
+            for view in vs:
+                attrs_index[(view.node, gen)] = {
+                    raw: _view_attrs(gen, view, raw)
+                    for raw in view.coords}
+        return views, attrs_index
+
+    @staticmethod
+    def _filter_views(views_by_gen: Mapping[str, Sequence[HostView]],
+                      attrs_index, compiled: CompiledSelector
+                      ) -> Dict[str, List[HostView]]:
+        """Per-generation selector filtering: each view's FREE set
+        narrows to the chips whose published attributes match; a view
+        left with no matching free chip still participates as occupancy
+        (its claims can still block boxes) but offers nothing."""
+        out: Dict[str, List[HostView]] = {}
+        for generation, views in views_by_gen.items():
+            filtered: List[HostView] = []
+            for view in views:
+                index = attrs_index.get((view.node, generation))
+                if compiled._fn is None or index is None:
+                    filtered.append(view)
+                    continue
+                keep = frozenset(
+                    raw for raw in view.free
+                    if compiled.matches(index.get(raw, {})))
+                if keep != view.free:
+                    view = replace(view, free=keep)
+                filtered.append(view)
+            out[generation] = filtered
+        return out
+
+    def eligible_views(self, selector_text: str = ""
+                       ) -> Tuple[List[HostView], CompiledSelector]:
+        """Selector-filtered cluster views, flattened across
+        generations. Runs inside the `fleetplace.select` read-path
+        bracket — zero registered locks, counted."""
+        compiled = self.selector(selector_text)
+        with lockdep.read_path("fleetplace.select"):
+            views_by_gen, attrs_index = self.views_by_generation()
+            filtered = self._filter_views(views_by_gen, attrs_index,
+                                          compiled)
+            return [v for views in filtered.values()
+                    for v in views], compiled
+
+    # ---------------------------------------------------- decisions
+
+    def _note(self, kind: str, uid: str, detail=None) -> None:
+        self._log.append((kind, uid, detail))
+
+    def schedule(self, shape, uid: str, selector: str = "",
+                 best_effort: bool = False,
+                 fail_node: Optional[str] = None) -> dict:
+        """One cluster placement decision end-to-end: selector-filtered
+        views → plan (cross-host mesh aware) → execution through the
+        multiclaim fabric — logged decision → sub-claims → rollback/
+        commit, spanned on the flight recorder."""
+        from . import placement
+        shape = placement.parse_shape(shape)
+        self.stats["decisions_total"].add()
+        with trace.span("fleetplace.schedule", claim_uid=uid,
+                        shape="x".join(str(d) for d in shape),
+                        selector=selector or ""):
+            views, _compiled = self.eligible_views(selector)
+            plan = placement.plan_slice(shape, views,
+                                        best_effort=best_effort,
+                                        pod_dims=self.pod_dims)
+            self._note("decided", uid, {
+                "shape": list(shape), "selector": selector or "",
+                "shards": None if plan is None
+                else [[n, list(r)] for n, r in plan.shards]})
+            if plan is None:
+                self.stats["unplaceable_total"].add()
+                self._note("unplaceable", uid, None)
+                trace.event("fleetplace.unplaceable", claim_uid=uid)
+                return {"uid": uid, "placed": False,
+                        "reason": "unplaceable"}
+            if self.executor is None:
+                # plan-only mode (dry runs / what-if): the decision is
+                # logged as advisory, never committed
+                self._note("advisory", uid, None)
+                return {"uid": uid, "placed": True, "advisory": True,
+                        "score": plan.score, "hosts": plan.hosts,
+                        "shards": [(n, list(r)) for n, r in plan.shards]}
+            result = self.executor.execute_plan(
+                plan, uid, fail_node=fail_node, observer=self._note)
+            if result.get("placed"):
+                with self._claims_lock:
+                    fresh = dict(self._claims)
+                    fresh[uid] = tuple(
+                        (f"{uid}-{node}", node, tuple(raws))
+                        for node, raws in plan.shards)
+                    self._claims = fresh
+                self.stats["placed_total"].add()
+            else:
+                self.stats["rollbacks_total"].add()
+            return result
+
+    def release(self, uid: str) -> bool:
+        """Release a committed decision's sub-claims node-by-node (the
+        tenant went away). Each shard is released by its LEDGER
+        identity (sub_uid, current node) — correct even after a defrag
+        wave moved the claim to a different host. Logged; the ledger
+        swap keeps readers lock-free."""
+        shards = self._claims.get(uid)
+        if shards is None:
+            return False
+        with trace.span("fleetplace.release", claim_uid=uid):
+            if self.executor is not None:
+                self.executor.release_subclaims(
+                    [(sub_uid, node) for sub_uid, node, _raws in shards])
+            with self._claims_lock:
+                fresh = dict(self._claims)
+                fresh.pop(uid, None)
+                self._claims = fresh
+            self._note("released", uid, None)
+            self.stats["releases_total"].add()
+        return True
+
+    # ------------------------------------------------- fragmentation
+
+    def fragmentation(self) -> Dict[str, dict]:
+        """Fleet-global fragmentation rollup (cluster curves), read
+        lock-free inside the `fleetplace.frag` bracket."""
+        with lockdep.read_path("fleetplace.frag"):
+            views_by_gen, _ = self.views_by_generation()
+            return cluster_fragmentation(views_by_gen,
+                                         pod_dims=self.pod_dims)
+
+    def plan_defrag_wave(self, shape, generation: Optional[str] = None,
+                         selector: str = "") -> dict:
+        """Plan one globally-coordinated defrag wave: the cluster-wide
+        advisory (placement.propose_defrag over EVERY host's view, so
+        migration targets resolve across the fleet) plus the rollup
+        curves before the wave. Raises ValueError (typed, HTTP-400
+        shaped) when the named generation has no host view."""
+        from . import placement
+        shape = placement.parse_shape(shape)
+        views_by_gen, attrs_index = self.views_by_generation()
+        if generation is None and len(views_by_gen) == 1:
+            generation = next(iter(views_by_gen))
+        views = views_by_gen.get(generation)
+        if not views:
+            raise ValueError(
+                f"unknown generation {generation!r}; have "
+                f"{sorted(views_by_gen)}")
+        if selector:
+            # filter WITHIN the named generation only: a node serving
+            # several generations must not leak its other tori into
+            # this advisory as free capacity
+            views = self._filter_views(
+                {generation: views}, attrs_index,
+                self.selector(selector))[generation]
+        proposal = placement.propose_defrag(shape, views)
+        proposal["generation"] = generation
+        proposal["cluster_fragmentation"] = cluster_fragmentation(
+            {generation: views}, pod_dims=self.pod_dims)[generation]
+        return proposal
+
+    def apply_defrag_wave(self, proposal: dict) -> dict:
+        """Apply a planned wave NODE-BY-NODE through the PR 7 handoff
+        machinery: migrations grouped by source node, each group one
+        executor.apply_defrag call (unprepare → durable handoff record
+        → re-point fabric claim → import + validate at destination),
+        every move logged and spanned. Returns the wave report."""
+        if self.executor is None:
+            raise RuntimeError("no executor attached")
+        migrations = [m for m in proposal.get("migrations", ())
+                      if m.get("target_node") is not None]
+        by_source: Dict[str, List[dict]] = {}
+        for mig in migrations:
+            by_source.setdefault(mig["source_node"], []).append(mig)
+        # counted at wave START so a retried wave after a mid-apply
+        # failure gets a fresh id in the log
+        self.stats["defrag_waves_total"].add()
+        wave_id = f"wave-{self.stats['defrag_waves_total'].value}"
+        moves = 0
+        with trace.span("fleetplace.defrag.wave", wave=wave_id):
+            self._note("defrag_wave", wave_id,
+                       {"moves_planned": len(migrations)})
+            for node in sorted(by_source):
+                group = by_source[node]
+                with trace.span("fleetplace.defrag.node", node=node,
+                                moves=len(group)):
+                    # one executor call PER migration: the ledger
+                    # re-point and the log entry land immediately after
+                    # each completed move, so a failure mid-group
+                    # leaves every already-moved claim's ledger shard
+                    # naming its REAL new home (a later release then
+                    # unprepares the right node)
+                    for mig in group:
+                        applied = self.executor.apply_defrag(
+                            {"migrations": [mig]})
+                        moves += applied
+                        self._migrate_ledger(mig)
+                        self._note("defrag_move", mig["claim"], {
+                            "wave": wave_id, "source": node,
+                            "target": mig["target_node"]})
+                        self.stats["defrag_moves_total"].add()
+        return {"wave": wave_id, "moves_planned": len(migrations),
+                "moves_applied": moves}
+
+    def _migrate_ledger(self, mig: dict) -> None:
+        """Re-point a migrated claim's ledger shard at its new home.
+        The advisory names the NODE-LEVEL claim id (the views' claims
+        maps are sub-uid-keyed), so resolve it back to its ledger
+        parent; the sub-uid itself is KEPT — the destination driver
+        imported the handoff under that id, and a later release must
+        unprepare by it. A migration of a claim the scheduler never
+        placed (a direct/foreign tenant) is a no-op here — the drivers'
+        own state is ground truth for those."""
+        sub_uid = mig["claim"]
+        # resolve AND rebuild under the ledger lock like every other
+        # writer: a racing release() popping the parent between a
+        # lock-free lookup and the swap would be resurrected by the
+        # stale re-insert (permanently busy chips, failing releases)
+        with self._claims_lock:
+            parent = None
+            for uid, shards in self._claims.items():
+                if any(s == sub_uid for s, _n, _r in shards):
+                    parent = uid
+                    break
+            if parent is None:
+                return
+            fresh_shards = tuple(
+                (s, mig["target_node"],
+                 tuple(mig.get("target_devices") or ()))
+                if s == sub_uid else (s, node, raws)
+                for s, node, raws in self._claims[parent])
+            fresh = dict(self._claims)
+            fresh[parent] = fresh_shards
+            self._claims = fresh
+
+    # ----------------------------------------------------- the audit
+
+    def audit(self, fabric_audit: Optional[dict] = None) -> dict:
+        """Exactly-once over THE commit log — one log spanning scheduler
+        decision → per-node sub-claims → rollback/commit, cluster-wide:
+
+          - every uid's first entry is its decision;
+          - at most ONE commit per uid, and nothing after it;
+          - every abort is clean: each sub-claim prepared since the
+            latest decision was rolled back first.
+
+        `fabric_audit` (FleetApiServer.multiclaim_audit()) cross-checks
+        the fabric's view: the sets of committed uids must agree — a
+        commit only one side knows is a lost or replayed claim."""
+        entries = list(self._log)          # C-atomic copy
+        by_uid: Dict[str, List[Tuple[str, object]]] = {}
+        for kind, uid, detail in entries:
+            if kind in ("defrag_wave",):
+                continue
+            by_uid.setdefault(uid, []).append((kind, detail))
+        duplicated: List[str] = []
+        undecided: List[str] = []
+        dirty_aborts: List[str] = []
+        post_commit: List[str] = []
+        committed: List[str] = []
+        for uid, seq in sorted(by_uid.items()):
+            kinds = [k for k, _d in seq]
+            if kinds and kinds[0] not in ("decided", "defrag_move",
+                                          "released"):
+                undecided.append(uid)
+            n_commit = kinds.count("committed")
+            if n_commit > 1:
+                duplicated.append(uid)
+            if n_commit:
+                committed.append(uid)
+                # a committed claim may later be released or migrated
+                # by a defrag wave; anything else after its commit is
+                # a replayed decision
+                after = kinds[kinds.index("committed") + 1:]
+                if any(k not in ("released", "defrag_move")
+                       for k in after):
+                    post_commit.append(uid)
+            prepared: set = set()
+            for kind, detail in seq:
+                if kind == "decided":
+                    prepared = set()
+                elif kind == "shard_prepared":
+                    prepared.add(detail)
+                elif kind == "shard_rolled_back":
+                    prepared.discard(detail)
+                elif kind == "aborted" and prepared:
+                    dirty_aborts.append(uid)
+                    break
+        out = {
+            "decisions_audited": len(by_uid),
+            "committed": sorted(committed),
+            "duplicated_commits": sorted(duplicated),
+            "undecided_commits": sorted(undecided),
+            "dirty_aborts": sorted(dirty_aborts),
+            "entries_after_commit": sorted(post_commit),
+            "exactly_once": not (duplicated or undecided or dirty_aborts
+                                 or post_commit),
+        }
+        if fabric_audit is not None:
+            fabric_committed = set(fabric_audit.get("committed") or ())
+            ours = set(committed)
+            out["fabric_agrees"] = (
+                fabric_audit.get("exactly_once", False)
+                and fabric_committed == ours)
+            out["fabric_only"] = sorted(fabric_committed - ours)
+            out["scheduler_only"] = sorted(ours - fabric_committed)
+            out["exactly_once"] = (out["exactly_once"]
+                                   and out["fabric_agrees"])
+        return out
+
+    def snapshot(self) -> dict:
+        """Lock-free stats read: AtomicCounter sums + ledger/log sizes
+        (GIL-atomic len reads)."""
+        out = {key: counter.value for key, counter in self.stats.items()}
+        out["claims"] = len(self._claims)
+        out["log_entries"] = len(self._log)
+        out["selectors_compiled"] = len(self._selectors)
+        if self.reflector is not None:
+            out["reflector"] = self.reflector.snapshot()
+        if self.cache is not None:
+            out["cache_slices"] = len(self.cache.snapshot())
+            out["cache_syncs"] = self.cache.syncs.value
+            out["cache_events"] = self.cache.events.value
+        return out

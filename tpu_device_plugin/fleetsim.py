@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import os
 import random
 import shutil
@@ -726,6 +727,8 @@ class FleetApiServer:
         dangling = sorted(u for u, ps in phases.items()
                           if "commit" not in ps and "abort" not in ps)
         return {"claims_audited": len(phases),
+                "committed": sorted(u for u, ps in phases.items()
+                                    if "commit" in ps),
                 "duplicated_commits": duplicated,
                 "unbegun_commits": unbegun,
                 "pending": dangling,
@@ -801,7 +804,8 @@ class FleetNode:
                  pace_base_s: float = 0.0, pace: bool = True,
                  seed: int = 0, device_id: str = "0063",
                  watch: bool = False, watch_resync_s: float = 5.0,
-                 watch_poll_s: float = 0.5, watch_timeout_s: float = 2.0):
+                 watch_poll_s: float = 0.5, watch_timeout_s: float = 2.0,
+                 host_coords=None):
         FakeChip, FakeHost = _fakehost()
         self._pace = pace
         # watch-driven convergence (ISSUE 12): sim-speed reflector knobs
@@ -820,7 +824,13 @@ class FleetNode:
         self.cfg = replace(Config().with_root(self.root),
                            publish_pace_base_s=pace_base_s,
                            publish_pace_max_s=pace_max_s,
-                           lw_debounce_s=0.0)
+                           lw_debounce_s=0.0,
+                           # the node's slot on the pod-level host grid
+                           # (published as hostX/hostY slice attributes,
+                           # carried on every HostView) — the fleet
+                           # scheduler's cross-host mesh model
+                           host_coords=tuple(host_coords)
+                           if host_coords is not None else None)
         os.makedirs(self.cfg.device_plugin_path, exist_ok=True)
         self.registry, self.generations = discover_passthrough(self.cfg)
         self.device_id = device_id
@@ -1131,10 +1141,20 @@ class FleetSim:
                  build_workers: int = 16, device_id: str = "0063",
                  watch: bool = False, watch_resync_s: float = 5.0,
                  watch_poll_s: float = 0.5, watch_timeout_s: float = 2.0,
-                 bookmark_interval_s: float = 0.5):
+                 bookmark_interval_s: float = 0.5,
+                 pod_dims: Optional[tuple] = None):
         self.n_nodes = n_nodes
         self._own_root = root is None
         self.root = root or tempfile.mkdtemp(prefix="tdpfleet-")
+        # the pod-level host grid: node i sits at (i // cols, i % cols),
+        # wrap-around ICI links closing each axis (the fleetplace mesh
+        # model). Default: the tightest near-square grid holding the
+        # fleet (256 nodes -> 16x16).
+        if pod_dims is None:
+            cols = math.isqrt(n_nodes - 1) + 1 if n_nodes > 1 else 1
+            pod_dims = (-(-n_nodes // cols), cols)
+        self.pod_dims = tuple(pod_dims)
+        cols = self.pod_dims[-1]
         self.apiserver = FleetApiServer(
             latency_s=latency_s, max_inflight=max_inflight,
             congestion_k=congestion_k,
@@ -1151,7 +1171,8 @@ class FleetSim:
                                     watch=watch,
                                     watch_resync_s=watch_resync_s,
                                     watch_poll_s=watch_poll_s,
-                                    watch_timeout_s=watch_timeout_s),
+                                    watch_timeout_s=watch_timeout_s,
+                                    host_coords=(i // cols, i % cols)),
                 range(n_nodes)))
 
     def _storm(self, fn) -> List:
@@ -1317,11 +1338,27 @@ class FleetSim:
         """
         shape = placement.parse_shape(shape)
         plan = placement.plan_slice(shape, self.host_views(),
-                                    best_effort=best_effort)
+                                    best_effort=best_effort,
+                                    pod_dims=self.pod_dims)
         if plan is None:
             return {"uid": uid, "placed": False, "reason": "unplaceable"}
+        return self.execute_plan(plan, uid, fail_node=fail_node)
+
+    def execute_plan(self, plan: "placement.SlicePlan", uid: str,
+                     fail_node: Optional[str] = None,
+                     observer=None) -> dict:
+        """Execute an already-made placement decision through the
+        multiclaim fabric — the fleetplace.FleetScheduler executor seam
+        (prepare_slice delegates here after planning locally).
+        `observer(kind, uid, detail)` mirrors every lifecycle step —
+        shard prepared / failed / rolled back, aborted, committed —
+        into the caller's commit log, so the scheduler's cluster-wide
+        exactly-once audit spans decision → per-node sub-claims →
+        rollback on ONE log."""
+        note = observer if observer is not None \
+            else (lambda kind, u, detail=None: None)
         by_node = self._node_by_name()
-        self.apiserver.multiclaim_begin(uid, shape, plan.shards)
+        self.apiserver.multiclaim_begin(uid, plan.shape, plan.shards)
         prepared: List[tuple] = []
         error = None
         for node_name, raws in plan.shards:
@@ -1337,8 +1374,10 @@ class FleetSim:
             err = resp.claims[sub_uid].error
             if err:
                 error = f"{node_name}: {err}"
+                note("shard_failed", uid, sub_uid)
                 break
             prepared.append((node, sub_uid))
+            note("shard_prepared", uid, sub_uid)
         if error is not None:
             # whole-claim rollback: unprepare is idempotent and durable
             # (the deletion rides the group commit before ACK), so after
@@ -1349,6 +1388,7 @@ class FleetSim:
                     raise AssertionError(
                         f"rollback unprepare of {sub_uid} failed: "
                         f"{resp.claims[sub_uid].error}")
+                note("shard_rolled_back", uid, sub_uid)
             # ... and neither does the fabric: every registered sub-claim
             # (prepared or not, including the failed node's) is deleted,
             # like the controller garbage-collecting its slice of an
@@ -1356,15 +1396,74 @@ class FleetSim:
             for node_name, _raws in plan.shards:
                 self.apiserver.remove_claim("fleet", f"{uid}-{node_name}")
             self.apiserver.multiclaim_abort(uid, error)
+            note("aborted", uid, error)
             return {"uid": uid, "placed": False, "rolled_back": True,
                     "error": error,
                     "residue": self.slice_residue(uid)}
         self.apiserver.multiclaim_commit(uid)
+        note("committed", uid, None)
         return {"uid": uid, "placed": True, "score": plan.score,
                 "hosts": plan.hosts,
                 "shards": [(node, list(raws))
                            for node, raws in plan.shards],
                 "sub_claims": [sub for _n, sub in prepared]}
+
+    def release_subclaims(self, pairs) -> None:
+        """Release node-level sub-claims by explicit (sub_uid, node)
+        identity — the scheduler's tenant-departure path, correct even
+        after defrag migrations moved a sub-claim to a host other than
+        the one its id was minted on. Idempotent like unprepare."""
+        by_node = self._node_by_name()
+        for sub_uid, node_name in pairs:
+            node = by_node[node_name]
+            resp = node.detach([sub_uid])
+            if resp.claims[sub_uid].error:
+                raise AssertionError(
+                    f"release unprepare of {sub_uid} on {node_name} "
+                    f"failed: {resp.claims[sub_uid].error}")
+            self.apiserver.remove_claim("fleet", sub_uid)
+
+    def release_plan(self, uid: str, shards) -> None:
+        """Release a committed multi-host claim's per-node sub-claims
+        by their placement-time (node, raws) shards — callers that
+        tracked migrations use release_subclaims directly."""
+        self.release_subclaims([(f"{uid}-{node_name}", node_name)
+                                for node_name, _raws in shards])
+
+    def _views_by_gen(self) -> Dict[str, List["placement.HostView"]]:
+        """Every node's driver-side host views grouped by generation —
+        the scheduler's views_source when no watch plane is wired."""
+        out: Dict[str, List["placement.HostView"]] = {}
+        for node in self.nodes:
+            for gen, view in node.driver.host_views().items():
+                out.setdefault(gen, []).append(view)
+        return out
+
+    def scheduler(self, watch: bool = True, resync_s: float = 5.0,
+                  poll_s: float = 0.5, timeout_s: float = 2.0):
+        """Build the fleet placement control plane over THIS fleet
+        (fleetplace.FleetScheduler): decisions consume the PR 12
+        watch-stream Reflector's slice cache — LIST seeds it, watch
+        events converge it, published topology attributes rebuild the
+        host grids — and execute through the multiclaim fabric.
+        `watch=False` falls back to direct driver views (deterministic
+        unit tests without a reflector thread)."""
+        from .fleetplace import FleetScheduler, SliceCache
+        from .kubeapi import Reflector
+        if not watch:
+            return FleetScheduler(executor=self,
+                                  views_source=self._views_by_gen,
+                                  pod_dims=self.pod_dims)
+        cache = SliceCache()
+        api = ApiClient(self.apiserver.url, token_path="/nonexistent")
+        reflector = Reflector(
+            api, "/apis/resource.k8s.io/v1beta1/resourceslices",
+            on_event=cache.on_event, on_sync=cache.on_sync,
+            name="fleetplace-slices", resync_interval_s=resync_s,
+            poll_interval_s=poll_s, watch_timeout_s=timeout_s)
+        return FleetScheduler(executor=self, cache=cache,
+                              reflector=reflector,
+                              pod_dims=self.pod_dims)
 
     def slice_residue(self, uid: str) -> List[str]:
         """State left behind by multi-host claim `uid`: per-node sub-claim

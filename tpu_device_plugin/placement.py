@@ -56,20 +56,56 @@ from .topology import Coords, _boxes
 
 log = logging.getLogger(__name__)
 
-__all__ = ["HostView", "SlicePlan", "parse_shape", "orientations",
-           "selection_score", "largest_fit", "scatter_score",
+__all__ = ["HostView", "SlicePlan", "ShapeError", "parse_shape",
+           "orientations", "selection_score", "largest_fit",
+           "scatter_score", "cyclic_cover", "mesh_score",
            "fragmentation", "plan_slice", "propose_defrag"]
+
+# Shape sanity bounds. No shipping TPU torus axis exceeds double digits
+# and no slice exceeds a few thousand chips; a request like
+# "4294967296x2" is a typo (or an attack on _boxes' O(dims^2) per-axis
+# interval table), not a slice. Rejecting it typed at parse time keeps
+# every downstream planner free of degenerate-box special cases.
+MAX_SHAPE_AXIS = 1024
+MAX_SHAPE_VOLUME = 1 << 16
+
+
+class ShapeError(ValueError):
+    """A slice-shape string/tuple that cannot describe a real mesh:
+    non-integer, empty, zero/negative axis, or axis/volume overflow.
+    Subclasses ValueError so existing 400-mapping handlers keep
+    working."""
 
 
 def parse_shape(text) -> Coords:
-    """"2x2x1" / "4" / [2, 2] → validated dims tuple (every axis >= 1)."""
-    if isinstance(text, (tuple, list)):
-        dims = tuple(int(d) for d in text)
-    else:
-        dims = tuple(int(p) for p in str(text).lower().split("x") if p != "")
+    """"2x2x1" / "4" / [2, 2] → validated dims tuple (every axis >= 1,
+    bounded by MAX_SHAPE_AXIS / MAX_SHAPE_VOLUME). Raises ShapeError
+    (a ValueError) on anything degenerate — zero, negative, or
+    overflow axes must fail HERE, not plan a degenerate box."""
+    try:
+        if isinstance(text, (tuple, list)):
+            if any(isinstance(d, float) and not d.is_integer()
+                   for d in text):
+                raise ValueError("fractional axis")
+            dims = tuple(int(d) for d in text)
+        else:
+            dims = tuple(int(p) for p in str(text).lower().split("x")
+                         if p != "")
+    except (TypeError, ValueError):
+        raise ShapeError(f"invalid slice shape {text!r}: want NxN[xN] "
+                         f"with integer axes") from None
     if not dims or any(d < 1 for d in dims):
-        raise ValueError(f"invalid slice shape {text!r}: want NxN[xN] with "
+        raise ShapeError(f"invalid slice shape {text!r}: want NxN[xN] with "
                          f"every axis >= 1")
+    if any(d > MAX_SHAPE_AXIS for d in dims):
+        raise ShapeError(f"invalid slice shape {text!r}: axis exceeds "
+                         f"{MAX_SHAPE_AXIS}")
+    vol = 1
+    for d in dims:
+        vol *= d
+    if vol > MAX_SHAPE_VOLUME:
+        raise ShapeError(f"invalid slice shape {text!r}: volume {vol} "
+                         f"exceeds {MAX_SHAPE_VOLUME}")
     return dims
 
 
@@ -152,6 +188,11 @@ class HostView:
       departed  raws hot-unplugged (lifecycle GONE): a hole that counts
                 toward fragmentation but can never be freed or targeted
       claims    claim uid -> raws it occupies (migratable blockers)
+      host_coords  this host's slot on the POD-LEVEL host grid (None =
+                unknown): pod wrap-around ICI links join neighboring
+                host tori into larger meshes, so a multi-host plan over
+                coordinate-bearing hosts is contiguous only when the
+                chosen hosts tile a (wrap-aware) box of the host grid
     """
 
     node: str
@@ -161,6 +202,7 @@ class HostView:
     free: frozenset
     departed: frozenset
     claims: Mapping[str, Tuple[str, ...]]
+    host_coords: Optional[Coords] = None
 
     def free_coords(self) -> frozenset:
         return frozenset(self.coords[r] for r in self.free
@@ -197,6 +239,47 @@ def fragmentation(view: HostView) -> dict:
     }
 
 
+def _cyclic_span(values: Sequence[int], dim: int) -> int:
+    """Length of the shortest wrap-aware interval on a ring of size
+    `dim` covering `values` — the 1-D building block of cyclic_cover.
+    On a pod axis with wrap-around ICI, hosts {0, dim-1} are adjacent:
+    their span is 2, not dim."""
+    pts = sorted(set(v % dim for v in values))
+    if len(pts) >= dim:
+        return dim
+    # the minimal covering interval is the ring minus the largest gap
+    largest_gap = max(
+        (b - a for a, b in zip(pts, pts[1:])),
+        default=0)
+    largest_gap = max(largest_gap, pts[0] + dim - pts[-1])
+    return dim - largest_gap + 1 if largest_gap else 1
+
+
+def cyclic_cover(points: Sequence[Coords], pod_dims: Coords) -> int:
+    """Minimal wrap-aware covering-box volume of host-grid `points` on
+    the pod torus `pod_dims` — the cross-host analogue of
+    selection_score's covering box, with per-axis wrap-around because
+    pod-level ICI links close each host-grid axis into a ring."""
+    cover = 1
+    for axis, dim in enumerate(pod_dims):
+        cover *= _cyclic_span([p[axis] for p in points], dim)
+    return cover
+
+
+def mesh_score(points: Sequence[Coords], pod_dims: Coords) -> float:
+    """Inter-host ICI contiguity of a chosen host set: hosts / minimal
+    wrap-aware covering box. 1.0 = the hosts tile one (possibly
+    wrapped) box of the pod grid, so every cross-host hop rides a real
+    pod-level ICI link; lower = host stragglers whose collectives
+    leave the mesh. 0.0 when any host's grid slot is unknown."""
+    if not points or any(p is None for p in points):
+        return 0.0
+    if any(len(p) != len(pod_dims) for p in points):
+        return 0.0
+    cover = cyclic_cover(points, pod_dims)
+    return round(len(set(points)) / cover, 4) if cover else 0.0
+
+
 @dataclass(frozen=True)
 class SlicePlan:
     """One placement decision: per-host shards + how contiguous it is."""
@@ -231,17 +314,23 @@ def _host_boxes(view: HostView, shape: Coords):
 
 def _single_host_plan(shape: Coords, views: Sequence[HostView]
                       ) -> Optional[SlicePlan]:
-    """Best free sub-box across hosts: best-fit by post-placement
+    """Best free sub-box across hosts: avoid breaking a PRISTINE
+    (fully-free) host first — a whole torus is cross-host mesh capacity
+    the fleet scheduler can tile larger slices from, and one stray
+    chip destroys it (ISSUE 14) — then best-fit by post-placement
     fragmentation (leave the tightest host tightest), node name as the
     deterministic tie-break."""
     best: Optional[Tuple[tuple, SlicePlan]] = None
     for view in views:
+        free_coords = view.free_coords()
+        pristine = int(len(free_coords) == volume(view.dims)
+                       and not view.departed)
         for raws, boxset in _host_boxes(view, shape):
-            remaining = view.free_coords() - boxset
+            remaining = free_coords - boxset
             frag_after = 0.0 if not remaining \
                 else 1.0 - largest_fit(view.dims, remaining) / len(remaining)
-            key = (round(frag_after, 6), len(view.free), view.node,
-                   sorted(boxset))
+            key = (pristine, round(frag_after, 6), len(view.free),
+                   view.node, sorted(boxset))
             if best is None or key < best[0]:
                 best = (key, SlicePlan(shape=shape,
                                        shards=((view.node, raws),),
@@ -249,17 +338,110 @@ def _single_host_plan(shape: Coords, views: Sequence[HostView]
     return best[1] if best else None
 
 
-def _multi_host_plan(shape: Coords, views: Sequence[HostView]
+def _whole_torus_shard(view: HostView) -> Tuple[str, Tuple[str, ...]]:
+    return (view.node, tuple(raw for _c, raw in sorted(
+        (c, raw) for raw, c in view.coords.items())))
+
+
+def _mesh_window(counts: Coords, candidates: Sequence[HostView],
+                 pod_dims: Coords) -> Optional[List[HostView]]:
+    """A counts-shaped window of fully-free hosts on the pod grid,
+    wrap-around allowed per axis (pod-level wrap links close each host
+    axis into a ring). Deterministic: windows scanned in start order,
+    hosts returned in window (row-major) order."""
+    if any(c > p for c, p in zip(counts, pod_dims)):
+        return None
+    at: Dict[Coords, HostView] = {}
+    for v in candidates:
+        if v.host_coords is not None \
+                and len(v.host_coords) == len(pod_dims):
+            at[tuple(v.host_coords)] = v
+    if len(at) < volume(counts):
+        return None
+    seen: set = set()
+    for start in itertools.product(*[range(p) for p in pod_dims]):
+        cells = tuple(itertools.product(
+            *[tuple((s + k) % p for k in range(c))
+              for s, c, p in zip(start, counts, pod_dims)]))
+        key = frozenset(cells)
+        if key in seen:
+            continue          # full-axis windows repeat under rotation
+        seen.add(key)
+        if all(c in at for c in cells):
+            return [at[c] for c in cells]
+    return None
+
+
+def _multi_host_plan(shape: Coords, views: Sequence[HostView],
+                     pod_dims: Optional[Coords] = None
                      ) -> Optional[SlicePlan]:
     """Tile `shape` as a grid of FULLY-FREE host tori — the physical TPU
     model: cross-host ICI links join whole host blocks, so a multi-host
     slice is only a mesh when every member host contributes its complete
-    torus (v4: 2x2x1 cubes; v5e: 2x4 trays)."""
+    torus (v4: 2x2x1 cubes; v5e: 2x4 trays).
+
+    When the caller names the pod grid (`pod_dims`), a contiguous
+    multi-host plan must come from COORDINATE-BEARING hosts tiling a
+    wrap-aware window of that grid — a host pair with no known
+    pod-level ICI link between them is not a mesh, however free both
+    tori are, and a coordinate-less host (mid-rollout daemon) cannot
+    PROVE adjacency, so it never joins a score-1.0 mesh (best_effort's
+    scatter tiers still reach it). The pod grid must model the SAME
+    axes as the host torus: a rank-mismatched `pod_dims` (a 2-D grid
+    over 3-D v4/v5p host cubes) cannot prove adjacency either, so that
+    generation forms no contiguous multi-host plan rather than
+    silently reverting to the legacy claim — model a 3-D pod for 3-D
+    hosts. With `pod_dims` unmodeled the legacy behavior holds:
+    inter-host edges unknown, any whole-tori set scores 1.0."""
     by_dims: Dict[Coords, List[HostView]] = {}
     for view in views:
         full = view.free_coords()
         if len(full) == volume(view.dims) and not view.departed:
             by_dims.setdefault(view.dims, []).append(view)
+    mesh_aware = pod_dims is not None
+    for dims, candidates in sorted(by_dims.items()):
+        if mesh_aware:
+            if len(pod_dims) != len(dims):
+                continue   # rank-mismatched pod model: unprovable
+            pool = [v for v in candidates if v.host_coords is not None
+                    and len(v.host_coords) == len(pod_dims)]
+        else:
+            pool = candidates
+        for oriented in orientations(shape, len(dims)):
+            if any(s % d for s, d in zip(oriented, dims)):
+                continue
+            counts = tuple(s // d for s, d in zip(oriented, dims))
+            n_hosts = volume(counts)
+            if n_hosts < 2 or n_hosts > len(pool):
+                continue
+            if mesh_aware:
+                window = _mesh_window(counts, pool, pod_dims)
+                if window is None:
+                    continue   # free tori exist but no contiguous mesh
+                chosen = window
+            else:
+                chosen = sorted(pool, key=lambda v: v.node)[:n_hosts]
+            return SlicePlan(
+                shape=shape,
+                shards=tuple(_whole_torus_shard(v) for v in chosen),
+                score=1.0, hosts=n_hosts)
+    return None
+
+
+def _mesh_scatter_plan(shape: Coords, views: Sequence[HostView],
+                       pod_dims: Coords) -> Optional[SlicePlan]:
+    """Best-effort cross-host fallback BETWEEN the contiguous mesh and
+    the raw chip scatter: whole free tori chosen greedily by pod-grid
+    closeness when no contiguous window exists. Scored honestly by
+    mesh_score — some cross-host hops leave the pod ICI mesh."""
+    by_dims: Dict[Coords, List[HostView]] = {}
+    for view in views:
+        if view.host_coords is None or len(view.host_coords) != len(pod_dims):
+            continue
+        if len(view.free_coords()) == volume(view.dims) \
+                and not view.departed:
+            by_dims.setdefault(view.dims, []).append(view)
+    best: Optional[SlicePlan] = None
     for dims, candidates in sorted(by_dims.items()):
         for oriented in orientations(shape, len(dims)):
             if any(s % d for s, d in zip(oriented, dims)):
@@ -268,14 +450,28 @@ def _multi_host_plan(shape: Coords, views: Sequence[HostView]
                                    for s, d in zip(oriented, dims)))
             if n_hosts < 2 or n_hosts > len(candidates):
                 continue
-            chosen = sorted(candidates, key=lambda v: v.node)[:n_hosts]
-            shards = tuple(
-                (v.node, tuple(raw for _c, raw in sorted(
-                    (c, raw) for raw, c in v.coords.items())))
-                for v in chosen)
-            return SlicePlan(shape=shape, shards=shards, score=1.0,
-                             hosts=n_hosts)
-    return None
+            # greedy: seed at each candidate, grow by minimal cyclic
+            # cover; keep the best-scoring seed (deterministic order)
+            for seed in sorted(candidates, key=lambda v: v.node):
+                chosen = [seed]
+                pool = [v for v in candidates if v is not seed]
+                while len(chosen) < n_hosts:
+                    pick = min(pool, key=lambda v: (cyclic_cover(
+                        [c.host_coords for c in chosen] + [v.host_coords],
+                        pod_dims), v.node))
+                    chosen.append(pick)
+                    pool.remove(pick)
+                score = mesh_score([v.host_coords for v in chosen],
+                                   pod_dims)
+                plan = SlicePlan(
+                    shape=shape,
+                    shards=tuple(_whole_torus_shard(v) for v in chosen),
+                    score=score, hosts=n_hosts)
+                if best is None or plan.score > best.score:
+                    best = plan
+                if best.score == 1.0:
+                    return best
+    return best
 
 
 def _scatter_plan(shape: Coords, views: Sequence[HostView]
@@ -310,20 +506,26 @@ def _scatter_plan(shape: Coords, views: Sequence[HostView]
 
 
 def plan_slice(shape: Coords, views: Sequence[HostView],
-               best_effort: bool = False) -> Optional[SlicePlan]:
+               best_effort: bool = False,
+               pod_dims: Optional[Coords] = None) -> Optional[SlicePlan]:
     """Place `shape` across `views`.
 
     Contiguous placements only (score 1.0): one host sub-box, else a
-    whole-torus multi-host tiling. `best_effort=True` adds the scatter
-    fallback (score < 1.0) so callers can place-and-measure instead of
-    failing — the bench's naive baseline and the fleetsim storms use it.
-    Returns None when nothing fits.
+    whole-torus multi-host tiling — wrap-aware-contiguous on the pod
+    host grid when `pod_dims` + HostView.host_coords model the
+    pod-level ICI links. `best_effort=True` adds the degraded tiers
+    (score < 1.0): first whole free tori chosen by pod-grid closeness
+    (mesh_score), then the raw chip scatter — so callers can
+    place-and-measure instead of failing. The bench's naive baseline
+    and the fleetsim storms use it. Returns None when nothing fits.
     """
     if not views:
         return None
     plan = _single_host_plan(shape, views)
     if plan is None:
-        plan = _multi_host_plan(shape, views)
+        plan = _multi_host_plan(shape, views, pod_dims=pod_dims)
+    if plan is None and best_effort and pod_dims is not None:
+        plan = _mesh_scatter_plan(shape, views, pod_dims)
     if plan is None and best_effort:
         plan = _scatter_plan(shape, views)
     return plan

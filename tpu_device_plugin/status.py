@@ -35,10 +35,15 @@ dependency-free endpoint for liveness probes and debugging:
   GET /debug/defrag -> the defrag advisor (placement.py): given
                    ?shape=2x2[&generation=v5e], the minimal claim
                    migrations that would free a contiguous ICI box for
-                   that shape on this node (docs/design.md "Slice
-                   placement" documents the proposal format). Requires
-                   the DRA driver; advisory only — applying it rides
-                   the migration-handoff machinery.
+                   that shape on this node, plus the per-generation
+                   fragmentation records that motivated it
+                   (docs/observability.md documents the query params;
+                   docs/design.md "Slice placement" the proposal
+                   format). 400 on a malformed/overflow shape or a
+                   generation with no host view. Requires the DRA
+                   driver; advisory only — applying it rides the
+                   migration-handoff machinery (fleet-wide:
+                   fleetplace.FleetScheduler.apply_defrag_wave).
 
 Disabled by default (--status-port 0).
 
