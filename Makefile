@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -200,6 +200,17 @@ bench-broker:
 # Writes docs/bench_transport_r15.json. CI bench-smoke runs --quick.
 bench-transport:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --transport
+
+# Fleet trace + SLO plane bench (ISSUE 15): a 256-node autopilot soak
+# whose migrated claim story reconstructs purely from the fleet trace
+# query, a scheduler-placed multi-host slice's full waterfall (decision
+# -> per-shard prepare -> broker crossing -> handoff -> destination
+# prepare) replayed from ONE /debug/fleet/trace?trace= query, and the
+# SLO burn-rate gauge moved by an injected latency fault with its
+# exemplar resolving on the same query. Writes
+# docs/bench_tracefleet_r17.json. CI bench-smoke runs --quick (N=16).
+bench-trace-fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trace-fleet
 
 # Broker + policy suites over the REAL two-process path: every
 # seam-facing assertion re-executed with a spawned broker process per

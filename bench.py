@@ -1221,11 +1221,14 @@ def run_trace_overhead(quick=False):
                 "GetPreferredAllocation + Allocate + the broker.ipc "
                 "crossing of the batched TOCTOU revalidation — every "
                 "privilege crossing is traceable by design; 0 events "
-                "warm). The documented bound the honesty guard enforces: "
+                "warm). Since r17 every span also mints/inherits its "
+                "W3C trace context (per-thread RNG ids, zero locks) — "
+                "the propagation plane is LIVE in this measurement. "
+                "The documented bound the honesty guard enforces: "
                 "recorded overhead <= 35 us AND <= 10% of the untraced "
                 "wall (in this sandboxed kernel, "
                 "where a monotonic read costs what a native syscall "
-                "does; observed 19-30 us / 4-7% across recordings, "
+                "does; observed 19-32 us / 4-8% across recordings, "
                 "swinging with co-tenant load)"),
             "trace_spans_per_attach": spans_per_attach,
             "trace_events_per_attach": events_per_attach,
@@ -3004,10 +3007,212 @@ def run_autopilot(quick=False):
     }
 
 
+def run_trace_fleet(quick=False):
+    """`bench.py --trace-fleet` (r17): the fleet trace-propagation + SLO
+    plane, end to end — make bench-trace-fleet.
+
+    Three counted cells against ONE 256-node fleet (quick: 16):
+
+      - SOAK: an autopilot soak (all storm types, watch chaos +
+        kubeapi.watch faults firing) whose migrated pinned claim's
+        cross-node story is reconstructed PURELY from the fleet trace
+        query (fleetplace.FleetFlight — the /debug/fleet/trace?trace=
+        body) at migration time, not stitched ad hoc.
+      - WATERFALL: after quiesce, a scheduler-placed MULTI-HOST slice
+        (fleetplace.FleetScheduler over the pod mesh) has one shard
+        migrated cross-host via the PR 7 handoff machinery; a SINGLE
+        trace= query must then replay every stage — scheduler decision,
+        per-shard prepare, broker crossing, handoff, destination
+        prepare — across >= 3 nodes plus the scheduler, time-ordered.
+      - SLO: the publish_rtt burn-rate gauge provably moves under an
+        injected kubeapi latency fault (the r17 faults kind "delay"),
+        latches a multiwindow breach, and its exemplar trace id
+        resolves to real spans on the same fleet trace query.
+
+    Everything asserted here is COUNTED (ops present, nodes answering,
+    burn deltas) — no wall-clock claims. Writes
+    docs/bench_tracefleet_r17.json ($BENCH_TRACEFLEET_OUT overrides;
+    --quick defaults to the sibling *_quick file)."""
+    from tpu_device_plugin import faults, slo, trace
+    from tpu_device_plugin.autopilot import AutopilotConfig, FleetAutopilot
+    from tpu_device_plugin.fleetsim import FleetSim
+
+    n_nodes = 16 if quick else 256
+    sim = FleetSim(
+        n_nodes=n_nodes, devices_per_node=8, latency_s=0.0,
+        max_inflight=0, seed=17, watch=True,
+        watch_resync_s=60.0, watch_poll_s=0.5,
+        watch_timeout_s=2.0 if quick else 25.0,
+        bookmark_interval_s=0.5 if quick else 5.0)
+    try:
+        trace.reset()
+        # ---- cell 1: the autopilot soak, story from the fleet trace
+        cfg = AutopilotConfig(
+            nodes=n_nodes, devices_per_node=8, seed=17,
+            duration_s=10.0 if quick else 60.0,
+            claim_event_target=0 if quick else 2000,
+            max_wall_s=120.0 if quick else 900.0,
+            claim_workers=4 if quick else 16, claims_per_batch=4,
+            multiclaim_workers=1, flip_workers=1 if quick else 2,
+            unplug_workers=1, migration_workers=2, defrag_workers=1,
+            upgrade_workers=1, upgrade_wave_size=2 if quick else 8,
+            boot_workers=1, boot_wave_size=4 if quick else 16,
+            pinned_per_nodes=2 if quick else 8,
+            invariant_interval_s=2.0 if quick else 5.0,
+            watch_timeout_s=2.0 if quick else 25.0,
+            watch_resync_s=60.0,
+            bookmark_interval_s=0.5 if quick else 5.0)
+        pilot = FleetAutopilot(cfg, sim=sim)
+        try:
+            soak = pilot.run(raise_on_violation=False)
+        finally:
+            faults.reset()
+        story = soak.get("claim_story")
+        # ---- cell 2: the scheduler waterfall on the quiesced fleet
+        trace.reset()        # a fresh ring: the waterfall must stand alone
+        sched = sim.scheduler(watch=False)
+        shape = "2x8"        # two whole (2,4) host tori on the pod mesh
+        res = sched.schedule(shape, "wf-r17")
+        if not res.get("placed"):
+            raise AssertionError(
+                f"waterfall claim unplaceable after quiesce: {res}")
+        tid = res["trace_id"]
+        shards = list(sched._claims["wf-r17"])
+        sub_uid, src_name, raws = shards[0]
+        used = {node for _s, node, _r in shards}
+        dst = next(n for n in sim.nodes
+                   if n.name not in used
+                   and len(n.host_view().free) >= len(raws))
+        sched.apply_defrag_wave({"migrations": [{
+            "claim": sub_uid, "source_node": src_name,
+            "target_node": dst.name, "devices": list(raws),
+            "target_devices": sorted(dst.host_view().free)[:len(raws)]}]})
+        waterfall = sim.fleet_flight().trace(tid)
+        ops = set(waterfall["ops"])
+        hosts = [n for n in waterfall["nodes"] if n != "scheduler"]
+        prep_nodes = {r["node"] for r in waterfall["spans"]
+                      if r["op"] == "dra.prepare.claim"}
+        stages = {
+            "scheduler_decision": "fleetplace.schedule" in ops,
+            "per_shard_prepare": set(n for _s, n, _r in shards)
+            <= prep_nodes,
+            "broker_crossing": "broker.ipc" in ops,
+            "source_release": "dra.unprepare.claim" in ops,
+            "handoff": "dra.handoff.completed" in ops,
+            "destination_prepare": dst.name in prep_nodes,
+        }
+        ts = [r["ts"] for r in waterfall["spans"]]
+        wf_cell = {
+            "trace_id": tid, "shape": shape,
+            "hosts_planned": res["hosts"],
+            "migrated_shard": sub_uid,
+            "migration": f"{src_name} -> {dst.name}",
+            "nodes": waterfall["nodes"],
+            "host_count": len(hosts),
+            "spans": len(waterfall["spans"]),
+            "ops": sorted(ops),
+            "stages": stages,
+            "time_ordered": ts == sorted(ts),
+            "single_query": f"/debug/fleet/trace?trace={tid}",
+        }
+        # ---- cell 3: SLO burn under injected latency, exemplar resolves
+        clock = time.monotonic
+        eng = slo.SLOEngine([slo.Objective(
+            "publish_rtt", "tdp_kubeapi_rtt_ms", threshold_ms=100.0,
+            target=0.99, fast_window_s=120.0, slow_window_s=600.0)],
+            now=clock)
+        victim = sim.nodes[0]
+        victim.driver.publish_resource_slices()      # good baseline RTTs
+        eng.evaluate()
+        burn_before = eng.snapshot()["objectives"]["publish_rtt"][
+            "burn_rate_fast"]
+        faults.arm("kubeapi.request", kind="delay", count=6,
+                   delay_s=0.15)
+        try:
+            with trace.span("bench.slow-publish"):
+                slow_tid = trace.current_context()["trace_id"]
+                victim.driver.api.get_json(
+                    f"/api/v1/nodes/{victim.name}")
+                victim.driver.api.get_json(
+                    f"/api/v1/nodes/{victim.name}")
+        finally:
+            faults.disarm("kubeapi.request")
+        time.sleep(1.1)            # past the engine's sample gap
+        rec = eng.evaluate()["publish_rtt"]
+        exemplar = (rec.get("exemplar") or {}).get("trace_id")
+        resolved = bool(exemplar
+                        and sim.fleet_flight().trace(exemplar)["spans"])
+        slo_cell = {
+            "burn_before": burn_before,
+            "burn_after": rec["burn_rate_fast"],
+            "bad_total": rec["bad_total"],
+            "breached": rec["breached"],
+            "breaches_total": eng.snapshot()["breaches_total"],
+            "exemplar_trace": exemplar,
+            "exemplar_is_injected_request": exemplar == slow_tid,
+            "exemplar_resolved_on_fleet_trace": resolved,
+        }
+        out = {
+            "metric": "tracefleet_waterfall_host_count",
+            "value": len(hosts),
+            "unit": "nodes",
+            "baseline_source": (
+                "ISSUE 15 acceptance: a 256-node autopilot soak cell "
+                "reconstructs a migrated multi-host slice claim's full "
+                "waterfall (scheduler decision -> per-shard prepare -> "
+                "broker crossing -> handoff -> destination prepare) "
+                "from a SINGLE /debug/fleet/trace?trace= query, and an "
+                "SLO burn-rate gauge provably moves under an injected "
+                "latency fault with its exemplar resolvable on the "
+                "same query"),
+            "quick": quick,
+            "soak": {
+                "nodes": n_nodes,
+                "ok": soak["ok"],
+                "violations": soak["violations"],
+                "claim_events": soak["counters"]["claim_events"],
+                "migrations": soak["counters"]["migrations"]
+                + soak["counters"]["defrag_moves"],
+                "claim_story": story,
+            },
+            "waterfall": wf_cell,
+            "slo": slo_cell,
+            "propagation": {k: v for k, v in trace.stats().items()
+                            if k.startswith("ctx_")},
+        }
+    finally:
+        faults.reset()
+        sim.stop()
+        trace.reset()
+    default_name = ("bench_tracefleet_r17_quick.json" if quick
+                    else "bench_tracefleet_r17.json")
+    out_path = os.environ.get("BENCH_TRACEFLEET_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "docs", default_name)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    out["matrix_file"] = out_path
+    print(f"trace fleet: soak nodes={n_nodes} "
+          f"events={out['soak']['claim_events']} ok={out['soak']['ok']} "
+          f"story={'yes' if story else 'NO'} | waterfall hosts="
+          f"{len(hosts)} stages={sum(stages.values())}/{len(stages)} | "
+          f"slo burn {slo_cell['burn_before']} -> "
+          f"{slo_cell['burn_after']} breached={slo_cell['breached']} "
+          f"exemplar_resolved={slo_cell['exemplar_resolved_on_fleet_trace']}",
+          file=sys.stderr)
+    return out
+
+
 def main() -> int:
     import logging
     logging.disable(logging.CRITICAL)  # keep the one-line contract
 
+    if "--trace-fleet" in sys.argv:
+        out = run_trace_fleet(quick="--quick" in sys.argv)
+        print(json.dumps(out))
+        ok = (out["soak"]["ok"] and all(out["waterfall"]["stages"]
+                                        .values())
+              and out["slo"]["exemplar_resolved_on_fleet_trace"])
+        return 0 if ok else 1
     if "--autopilot" in sys.argv:
         out = run_autopilot(quick="--quick" in sys.argv)
         print(json.dumps(out))

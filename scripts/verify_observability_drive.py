@@ -8,8 +8,17 @@ operator would during an incident:
      span + checkpoint flush + apiserver RTT + orphan event), time-ordered
   3. GET /debug/flight?bdf=<bdf> -> the device's lifecycle transitions
   4. /metrics carries the trace histogram families (strict families)
-  5. SIGHUP -> flight-recorder dump file written
-  6. stderr is structured key=value and carries span context (claim_uid)
+  5. the fleet trace plane (r17): the claim's trace id from its flight
+     records resolves on /debug/fleet/trace?trace= as a node-labeled
+     waterfall, and /debug/flight?since_ms= pages the ring as a
+     bounded drain
+  6. the SLO plane (r17): an injected latency fault ($TDP_FAULTS
+     kubeapi.request:delay) moves the publish_rtt burn-rate gauge on
+     /status, latches a breach, and the exemplar trace id attached to
+     the burning objective resolves on /debug/fleet/trace
+  7. SIGHUP -> flight-recorder dump file written, carrying histogram
+     snapshots + SLO/burn state alongside the merged ring
+  8. stderr is structured key=value and carries span context (claim_uid)
 Prints OBSERVABILITY DRIVE PASS on success.
 """
 import json
@@ -49,7 +58,12 @@ sim = DeviceManagerSim(os.path.join(root, "device-plugins"))
 api = FakeApiServer()
 port = 18171
 env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-           NODE_NAME="node-a", TDP_TRACE_DUMP_PATH=dump_path)
+           NODE_NAME="node-a", TDP_TRACE_DUMP_PATH=dump_path,
+           # latency injection (r17): every apiserver round-trip pays
+           # +300 ms — the attach path's claim GET lands as a bad
+           # publish_rtt sample, so the SLO burn-rate gauge must move
+           # and latch a breach with a resolvable exemplar
+           TDP_FAULTS="kubeapi.request:delay:delay=0.3")
 stderr_f = open(stderr_path, "w")
 proc = subprocess.Popen(
     [sys.executable, "-m", "tpu_device_plugin", "--root", root,
@@ -131,16 +145,63 @@ try:
     assert "tdp_trace_spans_total" in m
     print("OK: /metrics carries the trace histogram families")
 
-    # 5. SIGHUP dumps the ring (dedicated dump signal; SIGUSR2 stays undrain)
+    # 5. fleet trace plane: the claim's trace id resolves on
+    # /debug/fleet/trace?trace= as a node-labeled waterfall
+    prep = [r for r in flight["spans"] if r["op"] == "dra.prepare.claim"]
+    assert prep and prep[-1].get("trace_id"), "prepare span has no trace id"
+    tid = prep[-1]["trace_id"]
+    waterfall = get(f"/debug/fleet/trace?trace={tid}")
+    assert waterfall["trace"] == tid
+    wf_ops = set(waterfall["ops"])
+    assert "dra.prepare.claim" in wf_ops, wf_ops
+    assert "kubeapi.request" in wf_ops, wf_ops
+    assert all(r.get("node") for r in waterfall["spans"])
+    print(f"OK: /debug/fleet/trace?trace= replays the claim waterfall "
+          f"({len(waterfall['spans'])} spans, nodes={waterfall['nodes']})")
+    # ... and /debug/flight?since_ms= pages the ring as a bounded drain
+    page = get("/debug/flight?since_ms=0&limit=5")
+    # >= : a page legitimately extends through an equal-timestamp run
+    assert len(page["spans"]) >= 5 and page["more"] is True
+    page2 = get(f"/debug/flight?since_ms={page['next_since_ms']}&limit=5")
+    assert page2["spans"], "second drain page empty"
+    assert page2["spans"][0]["ts"] * 1e3 > page["next_since_ms"] - 1e-6
+    print("OK: /debug/flight?since_ms= drains the ring in bounded pages")
+
+    # 6. SLO plane: the injected kubeapi latency moved the publish_rtt
+    # burn-rate gauge, latched a breach, and its exemplar resolves
+    def slo_burning():
+        rec = get("/status")["slo"]["objectives"]["publish_rtt"]
+        return rec["burn_rate_fast"] > 0 and rec["bad_total"] > 0
+    wait_for(slo_burning, "publish_rtt burn rate moved under the "
+             "injected latency fault")
+    slo = get("/status")["slo"]
+    rec = slo["objectives"]["publish_rtt"]
+    assert slo["breaches_total"] >= 1, slo
+    assert rec["exemplar"] and rec["exemplar"]["trace_id"], rec
+    ex_tid = rec["exemplar"]["trace_id"]
+    ex_wf = get(f"/debug/fleet/trace?trace={ex_tid}")
+    assert ex_wf["spans"], "exemplar trace id did not resolve"
+    m = get("/metrics")
+    assert 'tpu_plugin_slo_burn_rate{slo="publish_rtt",window="fast"}' in m
+    assert f'trace_id="{ex_tid}"' in m, "exemplar info series missing"
+    print(f"OK: SLO breach under injected latency (burn_fast="
+          f"{rec['burn_rate_fast']}), exemplar {ex_tid[:8]}... resolves "
+          f"to {len(ex_wf['spans'])} spans")
+
+    # 7. SIGHUP dumps the ring (dedicated dump signal; SIGUSR2 stays
+    # undrain) — with histogram + SLO context for the post-mortem
     proc.send_signal(signal.SIGHUP)
     wait_for(lambda: os.path.exists(dump_path), "SIGHUP flight dump")
     with open(dump_path) as f:
         dump = json.load(f)
     assert dump["reason"] == "SIGHUP"
     assert any(r["op"] == "dra.prepare.claim" for r in dump["spans"])
-    print(f"OK: dump carries {len(dump['spans'])} spans")
+    assert "tdp_kubeapi_rtt_ms" in dump["histograms"]
+    assert dump["slo"]["objectives"]["publish_rtt"]["bad_total"] > 0
+    print(f"OK: dump carries {len(dump['spans'])} spans + histogram "
+          f"snapshots + SLO state")
 
-    # 6. structured key=value logs with span context
+    # 8. structured key=value logs with span context
     stderr_f.flush()
     with open(stderr_path) as f:
         logs = f.read()

@@ -120,6 +120,47 @@ def test_measure_read_repair_watch_vs_polling():
     assert out["exactly_once"]
 
 
+def test_migrated_pinned_claim_story_reconstructs_from_fleet_trace():
+    """ACCEPTANCE (ISSUE 15 satellite): the report's cross-node claim
+    story is reconstructed PURELY from the fleet trace query
+    (/debug/fleet/trace?trace= body via fleetplace.FleetFlight), not
+    from ad-hoc snapshot stitching — driven deterministically through
+    the autopilot's own migration applier."""
+    from tpu_device_plugin import trace
+    trace.reset()
+    cfg = AutopilotConfig(nodes=2, duration_s=0.1, seed=7,
+                          watch=False, watch_chaos=False,
+                          watch_faults=False)
+    pilot = FleetAutopilot(cfg)
+    try:
+        src, dst = pilot.sim.nodes
+        uid = "pin-story"
+        free_src = sorted(src.host_view().free)
+        src.claim_devices(uid, [free_src[0]])
+        with pilot._lock:
+            pilot._pinned[uid] = src.name
+        mig = {"claim": uid, "devices": [free_src[0]],
+               "target_devices": [sorted(dst.host_view().free)[0]]}
+        assert pilot._apply_one_migration(src, dst, mig,
+                                          counter="migrations")
+        story = pilot._story
+        assert story is not None
+        # the story IS a fleet-trace reconstruction: one trace id, the
+        # endpoint that serves it, both hosts present, all three acts
+        assert story["endpoint"] == \
+            f"/debug/fleet/trace?trace={story['trace_id']}"
+        assert {src.name, dst.name} <= set(story["nodes"])
+        for needed in ("dra.prepare.claim", "dra.unprepare.claim",
+                       "dra.handoff.completed"):
+            assert needed in story["ops"], (needed, story["ops"])
+        # and the same query over the collector returns the same spans
+        replay = pilot.sim.fleet_flight().trace(story["trace_id"])
+        assert len(replay["spans"]) == story["spans"]
+    finally:
+        pilot.sim.stop()
+        faults.reset()
+
+
 def test_autopilot_report_counts_claim_events_toward_target():
     """claim_event_target extends the run past duration_s until the
     event budget is met (the 100k-event lever of the full soak)."""

@@ -317,6 +317,26 @@ def test_every_crossing_is_audited_with_span_context(served):
     assert crossing["span"] is not None
     assert crossing["span"]["op"] == "broker.ipc"
     assert crossing["span"]["seq"] == crossing_span["seq"]
+    # r17: the frame carries the FULL trace context, so the broker-side
+    # audit entry (and the broker process's own broker.serve span) join
+    # the caller's fleet trace
+    assert crossing["span"]["trace_id"] == crossing_span["trace_id"]
+    assert crossing["span"]["span_id"] == crossing_span["span_id"]
+    trace.reset()
+
+
+def test_span_context_carries_full_trace_context():
+    """brokeripc.span_context(): {op, seq} pre-r17 shape extended with
+    the active span's trace_id/span_id (one counted propagation); None
+    outside any span."""
+    trace.reset()
+    assert brokeripc.span_context() is None
+    with trace.span("dra.prepare.claim", claim_uid="c1") as sp:
+        ctx = brokeripc.span_context()
+        assert ctx["op"] == "dra.prepare.claim"
+        assert ctx["trace_id"] == sp.trace_id
+        assert ctx["span_id"] == sp.span_id
+    assert trace.stats()["ctx_propagated_total"] == 1
     trace.reset()
 
 

@@ -147,6 +147,23 @@ SURFACES = {
     ("faults", "_fired[*]"): {
         "status": "faults.fired",
         "metrics": "tdp_fault_fires_total"},
+    # trace propagation (ISSUE 15): lock-free AtomicCounters (tsalint
+    # LOCKFREE sentinel), surfaced like every other counter
+    ("trace", "_ctx_propagated"): {
+        "status": "trace.ctx_propagated_total",
+        "metrics": "tdp_trace_ctx_propagated_total"},
+    ("trace", "_ctx_attached"): {
+        "status": "trace.ctx_attached_total",
+        "metrics": "tdp_trace_ctx_attached_total"},
+    ("trace", "_ctx_dropped"): {
+        "status": "trace.ctx_dropped_total",
+        "metrics": "tdp_trace_ctx_dropped_total"},
+    # SLO engine (ISSUE 15): the eval counter anchors the dict group;
+    # the breach twin surfaces under the same slo.* status object and
+    # its own family (pinned by the docs half via observability.md)
+    ("slo.SLOEngine", "counters[*]"): {
+        "status": "slo.evals_total",
+        "metrics": "tpu_plugin_slo_evals_total"},
 }
 
 

@@ -87,7 +87,8 @@ def test_configure_spec_grammar():
                      "native.probe:drop:p=0.25,inotify.poll")
     armed = faults.armed_sites()
     assert armed["kubelet.register"] == {"kind": "error", "remaining": 3,
-                                         "probability": 1.0, "fires": 0}
+                                         "probability": 1.0, "fires": 0,
+                                         "delay_s": 0.0}
     assert armed["native.probe"]["probability"] == 0.25
     assert armed["native.probe"]["remaining"] is None
     # bare site: defaults to the site's natural kind, not blanket "error"

@@ -628,6 +628,62 @@ def test_schedule_decisions_are_flight_recorder_spans():
     assert any(s["op"] == "fleetplace.schedule" for s in spans)
 
 
+def test_fleet_trace_waterfall_scheduler_to_migrated_shard(fleet):
+    """ACCEPTANCE (ISSUE 15, small-N live half): ONE trace= query over
+    the fleet flight collector reconstructs a scheduler-placed claim's
+    full waterfall — scheduler decision → per-shard prepare (with its
+    broker crossing) → migration handoff → destination prepare — across
+    3+ nodes, purely from the /debug/fleet/trace body shape."""
+    from tpu_device_plugin import trace
+    sim = fleet
+    sched = sim.scheduler(watch=False)
+    res = sched.schedule("1x2", "wf-claim")
+    assert res["placed"]
+    tid = res["trace_id"]
+    assert tid and len(tid) == 32
+    # migrate the shard to ANOTHER host via the handoff machinery
+    sub_uid, node_name, raws = list(sched._claims["wf-claim"])[0]
+    by_name = sim._node_by_name()
+    src = by_name[node_name]
+    dst = next(n for n in sim.nodes if n.name != node_name
+               and len(n.host_view().free) >= len(raws))
+    sched.apply_defrag_wave({"migrations": [{
+        "claim": sub_uid, "source_node": src.name,
+        "target_node": dst.name, "devices": list(raws),
+        "target_devices": sorted(dst.host_view().free)[:len(raws)]}]})
+    story = sim.fleet_flight().trace(tid)
+    assert story["trace"] == tid
+    ops = set(story["ops"])
+    for needed in ("fleetplace.schedule", "dra.prepare.claim",
+                   "broker.ipc", "dra.unprepare.claim",
+                   "dra.handoff.completed"):
+        assert needed in ops, (needed, sorted(ops))
+    # scheduler + source host + destination host all answer
+    assert {"scheduler", src.name, dst.name} <= set(story["nodes"])
+    # time-ordered: the decision precedes every shard span
+    ts = [r["ts"] for r in story["spans"]]
+    assert ts == sorted(ts)
+    by_op = {r["op"]: i for i, r in enumerate(story["spans"])}
+    assert by_op["fleetplace.schedule"] <= by_op["dra.prepare.claim"]
+    # the unprepare/destination-prepare joined via LINKS (their own
+    # trace ids differ; the link carries tid)
+    unprep = [r for r in story["spans"]
+              if r["op"] == "dra.unprepare.claim"]
+    assert unprep and unprep[-1]["link"]["trace_id"] == tid
+    # the fabric's multiclaim record names the decision's trace
+    with sim.apiserver._lock:
+        rec = sim.apiserver.multiclaims["wf-claim"]
+    assert trace.parse_traceparent(rec["traceparent"])["trace_id"] == tid
+    _release_all(sched, sim)
+
+
+def test_schedule_returns_trace_id_even_when_unplaceable(fleet):
+    sched = fleet.scheduler(watch=False)
+    res = sched.schedule("64x64", "huge-claim")
+    assert not res["placed"]
+    assert res["trace_id"] and len(res["trace_id"]) == 32
+
+
 def test_audit_detects_seeded_violations():
     cache = SliceCache()
     sched = FleetScheduler(cache=cache)

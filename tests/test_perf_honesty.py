@@ -965,6 +965,115 @@ def test_bench_placement_r12_pins_placement_quality():
     assert big["engine"]["placed"] == big["requests"], big
 
 
+def test_bench_tracefleet_r17_pins_fleet_trace_and_slo_plane():
+    """Round-17 fleet-trace + SLO pins against the RECORDED
+    docs/bench_tracefleet_r17.json (counted facts, CI-safe):
+
+      - the soak cell ran at 256 nodes, ended green, and its migrated
+        pinned claim's cross-node story was reconstructed purely from
+        the fleet trace query (the /debug/fleet/trace?trace= body —
+        the story names its endpoint and spans BOTH hosts);
+      - a scheduler-placed multi-host slice's SINGLE trace= query
+        replayed every waterfall stage — scheduler decision, per-shard
+        prepare, broker crossing, source release, handoff, destination
+        prepare — time-ordered, across >= 3 hosts plus the scheduler;
+      - the SLO burn-rate gauge moved under the injected latency fault
+        (strictly, from a zero baseline), latched a breach, and its
+        exemplar trace id was the injected request's own trace AND
+        resolved to real spans on the same fleet trace query;
+      - context propagation was live (propagated + attached counted,
+        zero malformed drops)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_tracefleet_r17.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    soak = d["soak"]
+    assert soak["nodes"] == 256
+    assert soak["ok"] and soak["violations"] == []
+    assert soak["claim_events"] >= 2000
+    story = soak["claim_story"]
+    assert story is not None, "soak captured no migrated claim story"
+    assert story["endpoint"] == \
+        f"/debug/fleet/trace?trace={story['trace_id']}"
+    assert {story["source"], story["target"]} <= set(story["nodes"])
+    for needed in ("dra.prepare.claim", "dra.unprepare.claim",
+                   "dra.handoff.completed"):
+        assert needed in story["ops"], (needed, story["ops"])
+
+    wf = d["waterfall"]
+    assert all(wf["stages"].values()), wf["stages"]
+    assert wf["host_count"] >= 3, wf
+    assert "scheduler" in wf["nodes"]
+    assert wf["time_ordered"] is True
+    assert wf["hosts_planned"] >= 2          # genuinely multi-host
+    assert wf["single_query"] == \
+        f"/debug/fleet/trace?trace={wf['trace_id']}"
+
+    s = d["slo"]
+    assert s["burn_after"] > s["burn_before"]
+    assert s["burn_before"] == 0.0
+    assert s["breached"] and s["breaches_total"] >= 1
+    assert s["exemplar_is_injected_request"] is True
+    assert s["exemplar_resolved_on_fleet_trace"] is True
+
+    prop = d["propagation"]
+    assert prop["ctx_propagated_total"] > 0
+    assert prop["ctx_attached_total"] > 0
+    assert prop["ctx_dropped_total"] == 0
+
+
+def test_fleet_trace_reconstruction_is_live_not_just_recorded_r17(
+        short_root):
+    """Runtime half of the r17 pin: a migrated claim's cross-host story
+    reconstructs from ONE FleetFlight trace query on a live 2-node
+    fleet — prepare, source release (linked), handoff completion and
+    destination prepare all under the ORIGINATING trace id."""
+    from tpu_device_plugin import trace as trace_mod
+    from tpu_device_plugin.fleetsim import FleetSim
+
+    trace_mod.reset()
+    sim = FleetSim(n_nodes=2, devices_per_node=4, latency_s=0.0,
+                   max_inflight=0, seed=3, watch=False,
+                   root=short_root)
+    try:
+        sim.boot_storm()
+        src, dst = sim.nodes
+        uid = "r17-live"
+        raw = sorted(src.host_view().free)[0]
+        src.claim_devices(uid, [raw])
+        tid = trace_mod.parse_traceparent(
+            dict(src.driver._checkpoint)[uid]["traceparent"])["trace_id"]
+        # migrate via the handoff machinery
+        resp = src.detach([uid])
+        assert not resp.claims[uid].error
+        record = src.driver.export_handoff(uid)
+        target = sorted(dst.host_view().free)[0]
+        sim.apiserver.add_claim(
+            "fleet", uid, uid, dst.driver.driver_name,
+            [{"device": dst.host_view().names[target]}])
+        dst.driver.import_handoff(record)
+        resp = dst.attach([uid])
+        assert not resp.claims[uid].error
+        story = sim.fleet_flight().trace(tid)
+        assert {src.name, dst.name} <= set(story["nodes"])
+        ops = set(story["ops"])
+        for needed in ("dra.prepare.claim", "dra.unprepare.claim",
+                       "dra.handoff.completed", "broker.ipc"):
+            assert needed in ops, (needed, sorted(ops))
+        # destination prepare CONTINUED the origin trace (link joined)
+        dest_prep = [r for r in story["spans"]
+                     if r["op"] == "dra.prepare.claim"
+                     and r["node"] == dst.name]
+        assert dest_prep and dest_prep[-1]["link"]["trace_id"] == tid
+    finally:
+        sim.stop()
+        trace_mod.reset()
+
+
 def test_bench_fleetplace_r16_pins_cluster_placement():
     """Round-16 fleet-placement pins against the RECORDED
     docs/bench_fleetplace_r16.json (counted facts, CI-safe): the main

@@ -1,0 +1,260 @@
+"""SLO plane tests (tpu_device_plugin/slo.py, ISSUE 15).
+
+Covers the objective math (bucket-exact bad counting at the snapped
+threshold), the multi-window burn-rate computation under a synthetic
+clock, the breach latch (transition counted + slo.breach flight event
+carrying the exemplar trace), the /status + /metrics surfaces, the
+crash-dump satellite (histogram snapshots + SLO state in the dumped
+JSON, parsed back), config loading fail-loudness, and the LIVE
+acceptance drill: an injected latency fault (the new faults kind
+"delay") on the kubeapi path provably moves the publish_rtt burn-rate
+gauge with an exemplar trace id that resolves on the fleet trace
+query."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpu_device_plugin import faults, slo, trace
+
+
+@pytest.fixture(autouse=True)
+def clean_trace():
+    trace.reset()
+    yield
+    trace.reset()
+    faults.reset()
+
+
+def _engine(clock, **kw):
+    defaults = dict(threshold_ms=50.0, target=0.99,
+                    fast_window_s=60.0, slow_window_s=300.0)
+    defaults.update(kw)
+    return slo.SLOEngine(
+        [slo.Objective("att", "tdp_attach_wall_ms", **defaults)],
+        now=lambda: clock[0])
+
+
+# ------------------------------------------------------------ objective math
+
+
+def test_bad_counting_snaps_to_the_next_bucket_bound():
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for v in (1.0, 40.0, 49.0, 51.0, 20000.0):
+        hist.observe(v)
+    total, bad, bound = slo._counts(hist.snapshot(), 50.0)
+    assert (total, bad, bound) == (5, 2, 50.0)     # 51ms + 20s are bad
+    # a threshold between bounds snaps UP (45 -> the 50ms bucket)
+    _total, bad2, bound2 = slo._counts(hist.snapshot(), 45.0)
+    assert (bad2, bound2) == (2, 50.0)
+    # beyond the last bound: only +Inf overflow is bad
+    _total, bad3, bound3 = slo._counts(hist.snapshot(), 99999.0)
+    assert bad3 == 1 and bound3 == float("inf")
+
+
+def test_objective_validation_and_config_loading_fail_loud(tmp_path):
+    with pytest.raises(slo.SLOConfigError):
+        slo.Objective("x", "no_such_histogram", 50.0, 0.99).validate()
+    with pytest.raises(slo.SLOConfigError):
+        slo.Objective("x", "tdp_attach_wall_ms", 50.0, 1.5).validate()
+    with pytest.raises(slo.SLOConfigError):
+        slo.Objective("x", "tdp_attach_wall_ms", -1.0, 0.99).validate()
+    with pytest.raises(slo.SLOConfigError):
+        slo.load_objectives("not json at all {")
+    with pytest.raises(slo.SLOConfigError):
+        slo.load_objectives('[{"name": "a", "bogus_field": 1}]')
+    with pytest.raises(slo.SLOConfigError):
+        slo.load_objectives(json.dumps([
+            {"name": "a", "histogram": "tdp_attach_wall_ms",
+             "threshold_ms": 50.0, "target": 0.99},
+            {"name": "a", "histogram": "tdp_kubeapi_rtt_ms",
+             "threshold_ms": 50.0, "target": 0.99}]))   # duplicate name
+    # a valid file loads
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps([
+        {"name": "mine", "histogram": "tdp_kubeapi_rtt_ms",
+         "threshold_ms": 100.0, "target": 0.999, "burn_fast": 10.0}]))
+    objs = slo.load_objectives(str(path))
+    assert objs[0].name == "mine" and objs[0].burn_fast == 10.0
+    # every default objective validates against a registered histogram
+    for obj in slo.default_objectives():
+        obj.validate()
+
+
+# ----------------------------------------------------------- burn + breach
+
+
+def test_burn_rates_windows_and_breach_latch_with_synthetic_clock():
+    clock = [1000.0]
+    eng = _engine(clock)
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for _ in range(100):
+        hist.observe(1.0)
+    eng.evaluate()                                  # baseline sample
+    clock[0] += 30
+    st = eng.evaluate()["att"]
+    assert st["burn_rate_fast"] == 0.0 and not st["breached"]
+    # 50 bad of 50 new observations: error rate 1.0 -> burn 100x
+    for _ in range(50):
+        hist.observe(500.0, exemplar="ab" * 16)
+    st = eng.evaluate()["att"]
+    assert st["burn_rate_fast"] == pytest.approx(100.0)
+    assert st["burn_rate_slow"] == pytest.approx(100.0)
+    assert st["breached"] is True
+    assert st["exemplar"]["trace_id"] == "ab" * 16
+    assert eng.snapshot()["breaches_total"] == 1
+    # the breach is a flight-recorder event carrying the exemplar
+    evs = trace.snapshot(op="slo.breach")
+    assert evs and evs[0]["attrs"]["slo"] == "att"
+    assert evs[0]["attrs"]["exemplar_trace"] == "ab" * 16
+    # re-evaluating while burning does NOT re-count (latched)
+    eng.evaluate()
+    assert eng.snapshot()["breaches_total"] == 1
+    # cool: the fast window passes with good traffic only -> unlatch
+    clock[0] += 120
+    for _ in range(500):
+        hist.observe(1.0)
+    eng.evaluate()
+    clock[0] += 59
+    st = eng.evaluate()["att"]
+    assert st["burn_rate_fast"] < 14.4 and st["breached"] is False
+    # a SECOND incident counts a second breach
+    for _ in range(200):
+        hist.observe(500.0)
+    st = eng.evaluate()["att"]
+    assert st["breached"] and eng.snapshot()["breaches_total"] == 2
+
+
+def test_short_lived_engine_reports_actual_window_honestly():
+    clock = [50.0]
+    eng = _engine(clock)
+    trace.histogram("tdp_attach_wall_ms").observe(1.0)
+    eng.evaluate()
+    clock[0] += 10                       # engine is 10s old, window 60s
+    st = eng.evaluate()["att"]
+    assert st["window_fast_actual_s"] == pytest.approx(10.0)
+
+
+def test_budget_remaining_tracks_lifetime_error_budget():
+    clock = [0.0]
+    eng = _engine(clock, target=0.9)     # 10% budget
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for _ in range(95):
+        hist.observe(1.0)
+    for _ in range(5):
+        hist.observe(500.0)
+    st = eng.evaluate()["att"]
+    # 5% bad of a 10% budget: half the budget left
+    assert st["budget_remaining"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------- surfaces
+
+
+class _StubManager:
+    def __init__(self):
+        self.running = threading.Event()
+        self.plugins = []
+        self.pending = []
+
+
+def test_status_and_metrics_surfaces_with_exemplar_info():
+    from tpu_device_plugin.status import StatusServer
+    prev = slo.set_engine(slo.SLOEngine())
+    server = StatusServer(_StubManager(), port=0)
+    try:
+        with trace.span("att.bad", histogram="tdp_attach_wall_ms"):
+            tid = trace.current_context()["trace_id"]
+            import time
+            time.sleep(0.06)             # > the 50ms attach objective
+        out = server.status()
+        assert set(out["slo"]["objectives"]) == {
+            "attach_wall", "prepare_wall", "publish_rtt",
+            "watch_convergence"}
+        rec = out["slo"]["objectives"]["attach_wall"]
+        assert rec["bad_total"] == 1
+        assert rec["exemplar"]["trace_id"] == tid
+        text = server.metrics()
+        assert ('tpu_plugin_slo_burn_rate{slo="attach_wall",'
+                'window="fast"}') in text
+        assert 'tpu_plugin_slo_bad_total{slo="attach_wall"} 1' in text
+        assert (f'tpu_plugin_slo_exemplar_info{{slo="attach_wall",'
+                f'trace_id="{tid}"}} 1') in text
+        assert "tpu_plugin_slo_evals_total" in text
+    finally:
+        server._httpd.server_close()
+        slo.set_engine(prev)
+
+
+def test_crash_dump_carries_histograms_and_slo_state(tmp_path):
+    """Satellite: the crash/SIGHUP dump includes histogram snapshots and
+    the current SLO/burn state alongside the merged ring — parsed back
+    from the dumped JSON."""
+    engine = slo.SLOEngine()
+    engine.attach_to_dumps()
+    try:
+        with trace.span("crash.attach", histogram="tdp_attach_wall_ms"):
+            pass
+        path = str(tmp_path / "crash-dump.json")
+        assert trace.dump("unit-crash", path=path) == path
+        with open(path) as f:
+            payload = json.load(f)
+        assert any(r["op"] == "crash.attach" for r in payload["spans"])
+        hist = payload["histograms"]["tdp_attach_wall_ms"]
+        assert hist["count"] == 1
+        assert hist["buckets"][-1][1] == 1           # cumulative shape
+        slo_state = payload["slo"]
+        assert "attach_wall" in slo_state["objectives"]
+        assert slo_state["objectives"]["attach_wall"]["target"] == 0.99
+        assert slo_state["evals_total"] >= 1
+    finally:
+        trace.unregister_dump_extra("slo")
+
+
+# ------------------------------------------------------- the live drill
+
+
+def test_injected_latency_fault_moves_burn_rate_with_resolvable_exemplar(
+        short_root):
+    """ACCEPTANCE (live half): an armed kubeapi.request delay fault makes
+    real publish RTTs breach the publish_rtt objective — the burn-rate
+    gauge moves, a breach latches, and the exemplar trace id resolves to
+    the offending request's spans on the fleet-trace query path."""
+    from tests.test_dra import FakeApiServer
+    from tpu_device_plugin.fleetplace import FleetFlight
+    from tpu_device_plugin.kubeapi import ApiClient
+    clock = [0.0]
+    eng = slo.SLOEngine([slo.Objective(
+        "publish_rtt", "tdp_kubeapi_rtt_ms", threshold_ms=100.0,
+        target=0.99, fast_window_s=60.0, slow_window_s=300.0)],
+        now=lambda: clock[0])
+    api = FakeApiServer()
+    try:
+        client = ApiClient(api.url, token_path="/nonexistent")
+        with trace.span("drill.request"):
+            client.get_json("/api/v1/nodes/n1")      # fast: good sample
+        eng.evaluate()                               # baseline
+        clock[0] += 5
+        before = eng.evaluate()["publish_rtt"]
+        assert before["burn_rate_fast"] == 0.0
+        with faults.injected("kubeapi.request", kind="delay", count=3,
+                             delay_s=0.15):
+            with trace.span("drill.slow-request"):
+                tid = trace.current_context()["trace_id"]
+                client.get_json("/api/v1/nodes/n1")  # slow: bad sample
+        clock[0] += 5
+        after = eng.evaluate()["publish_rtt"]
+        assert after["burn_rate_fast"] > before["burn_rate_fast"]
+        assert after["bad_total"] == before["bad_total"] + 1
+        assert after["breached"] is True
+        assert after["exemplar"]["trace_id"] == tid
+        # the exemplar resolves on the fleet trace plane
+        ff = FleetFlight()
+        ff.add_local_source("node-a")
+        story = ff.trace(after["exemplar"]["trace_id"])
+        assert "kubeapi.request" in story["ops"]
+        assert "drill.slow-request" in story["ops"]
+    finally:
+        api.stop()

@@ -126,6 +126,192 @@ def test_snapshot_filters_claim_bdf_op_and_limit():
     assert trace.snapshot(limit=1)[0]["op"] == "b.three"
 
 
+# ------------------------------------------------- trace context (ISSUE 15)
+
+
+def test_root_span_mints_context_and_children_inherit_it():
+    with trace.span("c.root") as root:
+        ctx = trace.current_context()
+        with trace.span("c.child"):
+            child_ctx = trace.current_context()
+        with trace.span("c.sibling"):
+            pass
+    assert ctx is not None and len(ctx["trace_id"]) == 32
+    assert len(ctx["span_id"]) == 16
+    assert root.trace_id == ctx["trace_id"]
+    # children share the trace, each with its own span id
+    assert child_ctx["trace_id"] == ctx["trace_id"]
+    assert child_ctx["span_id"] != ctx["span_id"]
+    recs = trace.snapshot(op="c.")
+    assert {r["trace_id"] for r in recs} == {ctx["trace_id"]}
+    assert len({r["span_id"] for r in recs}) == 3
+    # a NEW root mints a NEW trace
+    with trace.span("c.other"):
+        other = trace.current_context()
+    assert other["trace_id"] != ctx["trace_id"]
+    # outside any span: no context, no propagation
+    assert trace.current_context() is None
+    assert trace.propagate() is None
+
+
+def test_traceparent_round_trip_and_malformed_inputs_counted_dropped():
+    with trace.span("tp.root"):
+        wire = trace.propagate()
+        ctx = trace.current_context()
+    assert wire == f"00-{ctx['trace_id']}-{ctx['span_id']}-01"
+    parsed = trace.parse_traceparent(wire)
+    assert parsed["trace_id"] == ctx["trace_id"]
+    assert parsed["span_id"] == ctx["span_id"]
+    assert parsed["sampled"] is True
+    assert trace.stats()["ctx_propagated_total"] == 1
+    before = trace.stats()["ctx_dropped_total"]
+    for bad in ("", "garbage", "00-zz-yy-01", None, 42,
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace
+                "00-" + "1" * 32 + "-" + "0" * 16 + "-01"):  # zero span
+        assert trace.parse_traceparent(bad) is None
+    assert trace.stats()["ctx_dropped_total"] == before + 7
+
+
+def test_link_adopts_on_root_records_on_child_and_inherits_down():
+    with trace.span("l.origin"):
+        wire = trace.propagate()
+        origin = trace.current_context()
+    # a linked ROOT adopts the remote trace id (the boundary crossing
+    # continues the trace) and records the remote parent as the link
+    with trace.span("l.remote-root", link=wire):
+        assert trace.current_context()["trace_id"] == origin["trace_id"]
+    rec = trace.snapshot(op="l.remote-root")[0]
+    assert rec["trace_id"] == origin["trace_id"]
+    assert rec["link"]["span_id"] == origin["span_id"]
+    # a linked CHILD keeps the local trace but records the link — and
+    # grandchildren inherit it like attrs
+    with trace.span("l.local-root"):
+        local = trace.current_context()
+        with trace.span("l.linked-child", link=wire):
+            with trace.span("l.grandchild"):
+                pass
+    child = trace.snapshot(op="l.linked-child")[0]
+    assert child["trace_id"] == local["trace_id"]
+    assert child["link"]["trace_id"] == origin["trace_id"]
+    grand = trace.snapshot(op="l.grandchild")[0]
+    assert grand["link"]["trace_id"] == origin["trace_id"]
+    assert trace.stats()["ctx_attached_total"] == 2   # explicit links only
+    # dict-shaped links (the brokeripc/handoff carriers) work too
+    with trace.span("l.dict-link", link={"trace_id": origin["trace_id"],
+                                         "span_id": origin["span_id"]}):
+        assert trace.current_context()["trace_id"] == origin["trace_id"]
+    # a malformed link degrades to no-link (counted), never raises
+    with trace.span("l.bad-link", link="not-a-traceparent"):
+        pass
+    assert "link" not in trace.snapshot(op="l.bad-link")[0]
+
+
+def test_snapshot_trace_filter_matches_own_id_and_links():
+    with trace.span("f.origin", claim_uid="u-f"):
+        wire = trace.propagate()
+        tid = trace.current_context()["trace_id"]
+    with trace.span("f.unrelated"):
+        pass
+    with trace.span("f.migration"):
+        with trace.span("f.dest-prepare", link=wire):
+            pass
+    ops = {r["op"] for r in trace.snapshot(trace=tid)}
+    assert ops == {"f.origin", "f.dest-prepare"}
+    # events join the trace through span inheritance and through links
+    with trace.span("f.origin2", link=wire):
+        trace.event("f.evt")
+    assert "f.evt" in {r["op"] for r in trace.snapshot(trace=tid)}
+    evt_alone = trace.parse_traceparent(wire)
+    trace.event("f.lone-evt", link=evt_alone)
+    assert "f.lone-evt" in {r["op"] for r in trace.snapshot(trace=tid)}
+
+
+def test_since_ms_cursor_paginates_oldest_first_without_overlap():
+    for i in range(10):
+        with trace.span("pg.op", i=i):
+            pass
+    full = trace.snapshot(op="pg.")
+    assert len(full) == 10
+    # drain in pages of 3 from cursor 0; strict-greater cursor means no
+    # record repeats and none is lost
+    seen = []
+    cursor = 0.0
+    for _ in range(10):
+        page, more = trace.drain(cursor, limit=3, op="pg.")
+        if not page:
+            assert not more
+            break
+        assert [r["attrs"]["i"] for r in page] == sorted(
+            r["attrs"]["i"] for r in page)   # oldest first
+        seen += [r["attrs"]["i"] for r in page]
+        cursor = page[-1]["ts"] * 1e3
+        assert more == (len(seen) < 10)
+    assert seen == list(range(10))
+
+
+def test_drain_page_extends_through_an_equal_timestamp_run():
+    """The cursor is a timestamp: a page boundary inside a run of
+    records sharing one ts would make the strictly-greater resume skip
+    the run's tail — drain() must extend the page through it."""
+    with trace.span("eq.root"):
+        pass
+    recs = trace.snapshot(op="eq.root")
+    base_ts = recs[0]["ts"]
+    # forge a run of 4 records sharing one timestamp (concurrent
+    # threads can produce this for real; forging keeps it deterministic)
+    ring = trace._ring()
+    for i in range(4):
+        ring.store({"kind": "event", "op": "eq.run", "thread": "t",
+                    "seq": 1000 + i, "parent": None,
+                    "ts": base_ts + 1.0, "outcome": "ok",
+                    "attrs": {"i": i}})
+    page, more = trace.drain(0.0, limit=2, op="eq.")
+    # limit 2 lands mid-run: the page extends through the whole run
+    run = [r for r in page if r["op"] == "eq.run"]
+    assert len(run) == 4 and more is False
+    # a full drain loop loses nothing
+    seen, cursor = [], 0.0
+    while True:
+        page, more = trace.drain(cursor, limit=2, op="eq.run")
+        if not page:
+            break
+        seen += [r["attrs"]["i"] for r in page]
+        cursor = page[-1]["ts"] * 1e3
+    assert sorted(seen) == [0, 1, 2, 3]
+
+
+def test_histogram_exemplars_carry_the_observing_spans_trace():
+    with trace.span("ex.slow", histogram="tdp_attach_wall_ms") as sp:
+        time.sleep(0.002)
+        tid = trace.current_context()["trace_id"]
+    del sp
+    snap = trace.histogram("tdp_attach_wall_ms").snapshot()
+    assert snap["exemplars"], snap
+    assert any(ex["trace_id"] == tid for ex in snap["exemplars"])
+    # the exemplar's trace resolves back to the span that observed it
+    assert trace.snapshot(trace=tid)[0]["op"] == "ex.slow"
+
+
+def test_dump_carries_histogram_snapshots_and_registered_extras(tmp_path):
+    trace.observe("tdp_kubeapi_rtt_ms", 7.0)
+    trace.register_dump_extra("extra_block", lambda: {"k": 1})
+    trace.register_dump_extra("raising_extra",
+                              lambda: (_ for _ in ()).throw(
+                                  RuntimeError("post-mortem boom")))
+    try:
+        path = str(tmp_path / "dump.json")
+        assert trace.dump("unit", path=path) == path
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["histograms"]["tdp_kubeapi_rtt_ms"]["count"] == 1
+        assert payload["extra_block"] == {"k": 1}
+        # a raising extra degrades to an error note, never kills the dump
+        assert "post-mortem boom" in payload["raising_extra"]["error"]
+    finally:
+        trace.unregister_dump_extra("extra_block")
+        trace.unregister_dump_extra("raising_extra")
+
+
 # ------------------------------------------------------------ concurrency
 
 
@@ -393,6 +579,74 @@ def test_debug_flight_endpoint_serves_filtered_ring():
         with pytest.raises(urllib.error.HTTPError) as err:
             _get_json(server.port, "/debug/flight?claim=")
         assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_debug_flight_trace_filter_pagination_and_fleet_endpoint():
+    from tpu_device_plugin.status import StatusServer
+    server = StatusServer(_StubManager(), port=0)
+    server.start()
+    try:
+        with trace.span("fleet.origin", claim_uid="u-x"):
+            tid = trace.current_context()["trace_id"]
+            with trace.span("fleet.child"):
+                pass
+        with trace.span("fleet.noise"):
+            pass
+        # ?trace= narrows to the one trace
+        body = _get_json(server.port, f"/debug/flight?trace={tid}")
+        assert {r["op"] for r in body["spans"]} == \
+            {"fleet.origin", "fleet.child"}
+        # ?since_ms= pages oldest-first with a resumable cursor
+        page = _get_json(server.port, "/debug/flight?since_ms=0&limit=2")
+        assert len(page["spans"]) == 2 and page["more"] is True
+        page2 = _get_json(
+            server.port,
+            f"/debug/flight?since_ms={page['next_since_ms']}&limit=2")
+        assert page2["spans"] and not any(
+            r["seq"] == page["spans"][-1]["seq"]
+            and r["thread"] == page["spans"][-1]["thread"]
+            for r in page2["spans"])
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.port, "/debug/flight?since_ms=bogus")
+        assert err.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.port, "/debug/flight?trace=")
+        assert err.value.code == 400
+        # the fleet endpoint serves the local ring under the fleet shape
+        wf = _get_json(server.port, f"/debug/fleet/trace?trace={tid}")
+        assert wf["trace"] == tid
+        assert {r["op"] for r in wf["spans"]} == \
+            {"fleet.origin", "fleet.child"}
+        assert all(r["node"] == "local" for r in wf["spans"])
+        assert wf["nodes"] == ["local"]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.port, "/debug/fleet/trace")
+        assert err.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_fleet_flight_merges_http_sources_and_degrades_on_failure():
+    """FleetFlight over a REAL /debug/flight HTTP endpoint (the
+    production source shape) + a dead source: the waterfall renders the
+    answering node and notes the dead one."""
+    from tpu_device_plugin.fleetplace import FleetFlight
+    from tpu_device_plugin.status import StatusServer
+    server = StatusServer(_StubManager(), port=0)
+    server.start()
+    try:
+        with trace.span("hf.op", claim_uid="u-h"):
+            tid = trace.current_context()["trace_id"]
+        ff = FleetFlight()
+        ff.add_http_source("node-a", f"http://127.0.0.1:{server.port}")
+        ff.add_http_source("node-dead", "http://127.0.0.1:9/")  # refused
+        story = ff.trace(tid)
+        assert [r["op"] for r in story["spans"]] == ["hf.op"]
+        assert story["spans"][0]["node"] == "node-a"
+        assert "node-dead" in story["source_errors"]
+        assert story["sources"] == 2
     finally:
         server.stop()
 

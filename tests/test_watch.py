@@ -494,6 +494,49 @@ def test_dra_watch_repairs_wipe_and_divergence_exactly_once(watch_fleet):
     assert audit["exactly_once"], audit
 
 
+def test_watch_repair_links_causal_write_trace_and_observes_convergence(
+        watch_fleet):
+    """r17 propagation through the watch plane: a foreign write made
+    inside a span carries its traceparent to the fabric (request
+    header), the fabric stamps it on the watch events the write causes,
+    and the repairing driver (a) links the causal trace on its
+    dra.watch.repair event and (b) observes tdp_watch_convergence_ms
+    with that trace as the bucket exemplar — the SLO plane's
+    watch-convergence objective fed end-to-end."""
+    from tpu_device_plugin import trace
+    trace.reset()
+    conv_before = trace.histogram(
+        "tdp_watch_convergence_ms").snapshot()["count"]
+    sim = watch_fleet()
+    assert sim.boot_storm()["published_ok"] == 2
+    node = sim.nodes[0]
+    name = node.driver.slice_name()
+    api = node.driver.api
+    with trace.span("foreign.writer"):
+        foreign_tid = trace.current_context()["trace_id"]
+        live = api.get_json(f"{SLICES}/{name}")
+        live["spec"]["devices"] = live["spec"]["devices"][:1]
+        live["spec"]["pool"]["generation"] += 1
+        api.put_json(f"{SLICES}/{name}", live)
+    _wait(lambda: node.driver.watch_repairs.value >= 1,
+          msg="watch repair triggered")
+    _wait(lambda: trace.histogram(
+        "tdp_watch_convergence_ms").snapshot()["count"] > conv_before,
+        msg="convergence lag observed")
+    repairs = trace.snapshot(op="dra.watch.repair")
+    linked = [r for r in repairs
+              if (r.get("link") or {}).get("trace_id") == foreign_tid]
+    assert linked, repairs
+    # the causal write's trace is the convergence histogram's exemplar
+    snap = trace.histogram("tdp_watch_convergence_ms").snapshot()
+    assert any(ex["trace_id"] == foreign_tid
+               for ex in snap["exemplars"]), snap["exemplars"]
+    # ...and resolves on the fleet trace query, joining writer + repair
+    story = sim.fleet_flight().trace(foreign_tid)
+    assert "dra.watch.repair" in story["ops"]
+    trace.reset()
+
+
 def test_dra_unchanged_republish_skips_reads_only_while_watch_live(
         watch_fleet):
     """Steady-state read/repair churn: with a live stream an unchanged

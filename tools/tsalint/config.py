@@ -209,6 +209,24 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     "faults": {
         "_fired[*]": "faults._lock",
     },
+    # trace propagation (round 17): the module-level context counters
+    # are epoch.AtomicCounter (LOCKFREE — any plain rebind-as-count or
+    # augmented assignment is a finding; reset()'s reconstruction is
+    # initialization, which the rule ignores by design)
+    "trace": {
+        "_ctx_propagated": LOCKFREE,
+        "_ctx_attached": LOCKFREE,
+        "_ctx_dropped": LOCKFREE,
+    },
+    # SLO engine (round 17): eval/breach counters mutate under the
+    # engine's own plain lock (deliberately UNregistered with lockdep —
+    # the /status scrape drives evaluate() inside the zero-lock-gated
+    # status read path, and the cold writer lock must stay invisible to
+    # the gate like trace.py's maintenance lock); snapshot() reads them
+    # via a C-atomic dict copy
+    "slo.SLOEngine": {
+        "counters[*]": "slo.SLOEngine._lock",
+    },
 }
 
 

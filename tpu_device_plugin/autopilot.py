@@ -447,6 +447,7 @@ class FleetAutopilot:
             if not resp.claims[uid].error:
                 self._count(prepares=1, unprepares=1, claim_events=2,
                             **{counter: 1})
+                capture = False
                 with self._lock:
                     if uid in self._pinned:
                         self._pinned[uid] = dst.name
@@ -454,15 +455,46 @@ class FleetAutopilot:
                     # boundaries (prepare on A, unprepare, prepare
                     # on B) — intra-node defrag moves don't qualify
                     if self._story is None and src.name != dst.name:
-                        spans = trace.snapshot(claim=uid, limit=64)
-                        self._story = {
-                            "claim": uid, "source": src.name,
-                            "target": dst.name, "spans": len(spans),
-                            "ops": sorted({s.get("op") for s in spans}),
-                        }
+                        capture = True
+                if capture:
+                    story = self._fleet_trace_story(uid, src, dst)
+                    if story is not None:
+                        with self._lock:
+                            if self._story is None:
+                                self._story = story
                 return True
         # recovery: the destination refused (churn won the race) — put
         # the claim back at the source so nothing is lost
+        return self._migration_recover(src, uid, mig)
+
+    def _fleet_trace_story(self, uid: str, src, dst):
+        """Reconstruct the migrated claim's cross-node story PURELY from
+        the fleet trace query (fleetplace.FleetFlight — the exact
+        /debug/fleet/trace?trace= body): the destination checkpoint
+        entry names the trace that originally placed the claim, and one
+        trace= query must replay prepare → unprepare/handoff →
+        destination-prepare across both hosts. Returns None when the
+        trace does not (yet) span both nodes — the capturer retries on
+        a later migration."""
+        tp = (dict(dst.driver._checkpoint).get(uid) or {}) \
+            .get("traceparent")
+        ctx = trace.parse_traceparent(tp) if tp else None
+        if ctx is None:
+            return None
+        waterfall = self.sim.fleet_flight().trace(ctx["trace_id"])
+        nodes = set(waterfall["nodes"])
+        if not {src.name, dst.name} <= nodes:
+            return None
+        return {
+            "claim": uid, "source": src.name, "target": dst.name,
+            "trace_id": ctx["trace_id"],
+            "endpoint": f"/debug/fleet/trace?trace={ctx['trace_id']}",
+            "nodes": waterfall["nodes"],
+            "spans": len(waterfall["spans"]),
+            "ops": waterfall["ops"],
+        }
+
+    def _migration_recover(self, src, uid: str, mig: dict) -> bool:
         self.sim.apiserver.add_claim(
             "fleet", uid, uid, src.driver.driver_name,
             [{"device": src.host_view().names[r]}

@@ -659,7 +659,19 @@ class BrokerServer:
                     "error": "handshake required before any operation"}, []
                 self._audit_note(req, False, reply["error"])
             else:
-                reply, fds = self._dispatch(req)
+                # broker-side span LINKED to the caller's context (the
+                # frame's span field, r17): the privileged process's own
+                # flight ring joins the serving daemon's trace — the
+                # root span here adopts the caller's trace id, so a
+                # fleet trace query over the broker's ring finds the
+                # crossing. A pre-r17 frame ({op, seq} only) is NOT
+                # malformed context — it just carries none.
+                caller = req.get("span") or {}
+                link = caller if "trace_id" in caller else None
+                with trace.span("broker.serve", link=link,
+                                broker_op=str(req.get("op")),
+                                caller_op=caller.get("op")):
+                    reply, fds = self._dispatch(req)
                 if req.get("op") == "hello" and reply.get("ok"):
                     helloed = True
             try:

@@ -187,10 +187,19 @@ def span_context() -> Optional[dict]:
     """The caller's active flight-recorder span as a small JSON-able
     context (None outside any span, or with tracing disabled). Carried on
     every request so the broker's audit ring links each privilege
-    crossing back to the daemon-side trace."""
+    crossing back to the daemon-side trace. Since round 17 the context
+    is the FULL trace-propagation carrier — `trace_id`/`span_id` ride
+    along (counted as one propagation), so the broker process opens its
+    own linked `broker.serve` span and its audit-ring entries join the
+    caller's fleet trace (`/debug/fleet/trace?trace=`)."""
     from . import trace
     stack = getattr(trace._tls, "stack", None)
     if not stack:
         return None
     span = stack[-1]
-    return {"op": span.op, "seq": span.seq}
+    out = {"op": span.op, "seq": span.seq}
+    ctx = trace.propagate_context()
+    if ctx is not None:
+        out["trace_id"] = ctx["trace_id"]
+        out["span_id"] = ctx["span_id"]
+    return out

@@ -229,6 +229,12 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="bind address for the status endpoint (the "
                              "default serves kubelet httpGet probes on the "
                              "pod IP)")
+    parser.add_argument("--slo-config", default=None,
+                        help="JSON file of SLO objectives overriding the "
+                             "shipped defaults (slo.py; env $TDP_SLO_CONFIG;"
+                             " docs/observability.md 'SLO objective "
+                             "config') — malformed config fails boot "
+                             "loudly, it never silently monitors nothing")
     parser.add_argument("--discover-only", action="store_true",
                         help="run discovery once, print the inventory as "
                              "JSON, and exit (ops/debug; no kubelet contact)")
@@ -441,6 +447,16 @@ def main(argv=None) -> int:
     # analysis ($TDP_TRACE_DUMP_PATH overrides the location)
     from . import trace
     trace.install_crash_hook()
+    # SLO plane (slo.py): the process-global engine gets the operator's
+    # objectives (--slo-config / $TDP_SLO_CONFIG; defaults otherwise)
+    # and registers its burn-rate state as the "slo" section of every
+    # crash/SIGHUP flight dump. SLOConfigError propagates — a malformed
+    # objective must fail boot, not silently monitor nothing.
+    from . import slo
+    slo_spec = args.slo_config or os.environ.get("TDP_SLO_CONFIG")
+    if slo_spec:
+        slo.set_engine(slo.SLOEngine(slo.load_objectives(slo_spec)))
+    slo.get_engine().attach_to_dumps()
     if args.discover_only:
         print(dump_inventory(cfg))
         return 0

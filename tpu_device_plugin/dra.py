@@ -908,15 +908,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # spares a full build_slice + projection compare per
             # publish on the reflector thread
             return
+        # the evidence context (r17): when the fabric stamped the
+        # causal write's traceparent onto this event, the repair joins
+        # that trace and the convergence-lag histogram carries it as
+        # the exemplar
+        evidence = (time.monotonic(), evt.get("traceparent"))
         if evt.get("type") == "DELETED":
             if self._should_repair():
-                self._watch_repair("deleted")
+                self._watch_repair("deleted", evidence=evidence)
             elif self._repair_wanted():
                 self._watch_deferred_seq += 1
             return
         if self._should_repair():
             if self._slice_diverged(obj):
-                self._watch_repair("diverged")
+                self._watch_repair("diverged", evidence=evidence)
         elif self._repair_wanted() and self._slice_diverged(obj):
             # divergence read against an in-flight publish's window may
             # be a false positive — deferring costs one liveness GET,
@@ -969,24 +974,43 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return (self._spec_projection(live_spec)
                 != self._spec_projection(desired["spec"]))
 
-    def _watch_repair(self, reason: str) -> None:
+    def _watch_repair(self, reason: str, evidence=None) -> None:
+        # evidence = (monotonic arrival of the divergence observation,
+        # the causal write's traceparent when the fabric stamped one):
+        # the repair event links the causing trace, and a successful
+        # repair observes the watch-convergence-lag histogram with that
+        # trace as the bucket exemplar (the SLO plane's fourth objective)
+        t0, raw_tp = evidence or (time.monotonic(), None)
+        ctx = trace.parse_traceparent(raw_tp) if raw_tp else None
         self.watch_repairs.add()
-        trace.event("dra.watch.repair", reason=reason)
         log.warning("DRA: watch observed slice %s %s; repairing via the "
                     "guarded publish path", self.slice_name(), reason)
-        # the observed divergence invalidates the delta baseline: a wiped
-        # slice's cached rv is dead, a foreign write bumped it — and the
-        # unchanged-projection fast paths (watch-read skip, delta PUT)
-        # must not conclude "nothing to do" from a cache the fabric just
-        # contradicted. The repair publish then takes the classic
-        # GET-or-POST read-modify-write, which heals both shapes.
-        with self._publish_lock:
-            self._last_publish = None
-        # the repair publish below acks any deferred observation it
-        # covers (the _paced_publish seq/ack handshake) — on success
-        # only, so a failed repair keeps the deferral for the retry
-        if not self.publish_resource_slices():
-            self._arm_republish_retry()
+        # the repair is a node-stamped SPAN (not a bare event): the
+        # repair publish's kubeapi spans inherit node= — the fleet
+        # trace collector attributes the repair to the host that ran
+        # it, never to the unattributed "scheduler" bucket — and its
+        # duration is the repair wall itself
+        with trace.span("dra.watch.repair", reason=reason, link=ctx,
+                        node=self.node_name):
+            # the observed divergence invalidates the delta baseline: a
+            # wiped slice's cached rv is dead, a foreign write bumped
+            # it — and the unchanged-projection fast paths (watch-read
+            # skip, delta PUT) must not conclude "nothing to do" from a
+            # cache the fabric just contradicted. The repair publish
+            # then takes the classic GET-or-POST read-modify-write,
+            # which heals both shapes.
+            with self._publish_lock:
+                self._last_publish = None
+            # the repair publish below acks any deferred observation it
+            # covers (the _paced_publish seq/ack handshake) — on success
+            # only, so a failed repair keeps the deferral for the retry
+            if self.publish_resource_slices():
+                trace.observe(
+                    "tdp_watch_convergence_ms",
+                    (time.monotonic() - t0) * 1e3,
+                    exemplar=ctx["trace_id"] if ctx else None)
+            else:
+                self._arm_republish_retry()
 
     def watch_stats(self) -> dict:
         """The /status + /metrics watch-plane surface: the reflector's
@@ -2117,6 +2141,12 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 # collapse before reaching the runtime.
                 "cdi_device_ids": [self._claim_cdi_id(claim.uid)],
             })
+        # trace affinity (r17): the entry carries the trace that placed
+        # the claim — a migrating claim's handoff record forwards it, so
+        # this prepare CONTINUES the original trace when it completes a
+        # handoff, and a fresh prepare stamps its own active context
+        traceparent = (handoff or {}).get("traceparent") \
+            or trace.propagate()
         with self._lock:
             self._checkpoint[claim.uid] = {
                 "name": claim.name,
@@ -2128,6 +2158,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 # allocation generation (handoff validation input)
                 "device_raws": raws,
                 "generation": generation,
+                "traceparent": traceparent,
             }
             # a claim prepared HERE retires any handoff record this node
             # emitted for it (round-trip migration back to the source):
@@ -2152,6 +2183,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             with self._lock:
                 if self._incoming_handoffs.pop(claim.uid, None) is not None:
                     self.handoff_stats["handoffs_completed_total"] += 1
+            # the waterfall's "handoff" act: recorded inside the prepare
+            # span (inherits claim_uid/node + the handoff's trace link)
+            trace.event("dra.handoff.completed",
+                        source=handoff.get("source_node", "?"),
+                        generation=handoff.get("generation"))
             log.info("DRA: migration handoff for claim %s/%s completed "
                      "(source %s)", claim.namespace, claim.name,
                      handoff.get("source_node", "?"))
@@ -2251,6 +2287,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                         for d in entry.get("devices", ())],
             "source_node": self.node_name,
             "emitted_at": time.time(),
+            # trace propagation (r17): the trace that originally placed
+            # the claim rides the handoff, so source-unprepare →
+            # destination-prepare is ONE trace across hosts
+            "traceparent": entry.get("traceparent"),
         }
 
     def _prune_handoffs_locked(self) -> None:
@@ -2326,7 +2366,8 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     # ------------------------------------------------------------- RPCs
 
     def _run_claim_tasks(self, claims, fn, op: str,
-                         hist: Optional[str] = None) -> List[Optional[str]]:
+                         hist: Optional[str] = None,
+                         link_for=None) -> List[Optional[str]]:
         """Run `fn(claim, task)` for every claim — on the bounded prepare
         pool when the request carries several — returning the per-claim
         error string (None = success). ANY exception becomes that claim's
@@ -2335,7 +2376,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         and kill the whole multi-claim RPC. `op`/`hist` name the
         per-claim trace span and its latency histogram — explicit at the
         two call sites, so a callback rename can never silently detach
-        tdp_prepare_wall_ms from the prepare path."""
+        tdp_prepare_wall_ms from the prepare path. `link_for(claim)`
+        returns the claim's carried trace context (a staged handoff
+        record's traceparent on prepare, the checkpoint entry's on
+        unprepare) so the per-claim span JOINS the trace that originally
+        placed the claim — the cross-host migration waterfall."""
 
         def run_one(claim) -> Optional[str]:
             # Per-claim child span of the burst fan-out: runs on a pool
@@ -2344,7 +2389,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # kubeapi fetch — inherit claim_uid for /debug/flight?claim=)
             try:
                 with trace.span(op, histogram=hist, claim_uid=claim.uid,
-                                namespace=claim.namespace, name=claim.name), \
+                                namespace=claim.namespace, name=claim.name,
+                                link=(link_for(claim) if link_for
+                                      else None)), \
                         self._claim_task() as tsk, \
                         self._claim_lock(claim.uid):
                     fn(claim, tsk)
@@ -2391,10 +2438,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             prepared[claim.uid] = self._ack_segment(
                 claim.uid, self._prepare_claim(claim, task))
 
-        with trace.span("dra.NodePrepareResources", claims=len(claims)):
+        # node= rides the RPC root span (children inherit): the fleet
+        # flight collector labels each waterfall record by it, and a
+        # per-node /debug/flight-shaped source filters on it in fleetsim
+        with trace.span("dra.NodePrepareResources", claims=len(claims),
+                        node=self.node_name):
             errors = self._run_claim_tasks(
                 claims, prepare_one, op="dra.prepare.claim",
-                hist="tdp_prepare_wall_ms")
+                hist="tdp_prepare_wall_ms",
+                # a staged migration handoff carries the trace that
+                # originally placed the claim: the destination prepare
+                # links it (GIL-atomic dict read; no staged record = no
+                # link — never counted as a drop)
+                link_for=lambda c: (self._incoming_handoffs.get(c.uid)
+                                    or {}).get("traceparent"))
         # Response assembly is bytes concatenation: one map-entry record
         # per claim (key = uid, value = the pre-serialized ack payload).
         # Error acks are serialized per call — failure is not a hot path.
@@ -2419,9 +2476,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
     def NodeUnprepareResources(self, request, context):
         resp = drapb.NodeUnprepareResourcesResponse()
         claims = list(request.claims)
-        with trace.span("dra.NodeUnprepareResources", claims=len(claims)):
+        with trace.span("dra.NodeUnprepareResources", claims=len(claims),
+                        node=self.node_name):
             errors = self._run_claim_tasks(
-                claims, self._unprepare_claim, op="dra.unprepare.claim")
+                claims, self._unprepare_claim, op="dra.unprepare.claim",
+                # the checkpoint entry carries the trace that placed the
+                # claim (stamped at prepare): a migration's source-side
+                # unprepare links it, so source release + destination
+                # prepare read as ONE trace across hosts
+                link_for=lambda c: (self._checkpoint.get(c.uid)
+                                    or {}).get("traceparent"))
         for claim, error in zip(claims, errors):
             out = resp.claims[claim.uid]
             if error is not None:

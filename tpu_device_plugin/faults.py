@@ -75,11 +75,15 @@ or via environment (read by cli.main at startup):
     TDP_FAULTS='kubelet.register:error:count=3,kubeapi.request:timeout:p=0.5'
     TDP_FAULTS_SEED=1337
 
-Spec grammar: `site[:kind][:count=N][:p=F]` joined by commas. `kind` is
-one of error (FaultInjected), timeout (TimeoutError), oserror
-(ConnectionResetError), or drop/false (non-raising; `fire` returns True),
-defaulting to the site's natural kind (error for raising sites, drop for
-value sites). Each site honors only its own category — see
+Spec grammar: `site[:kind][:count=N][:p=F][:delay=S]` joined by commas.
+`kind` is one of error (FaultInjected), timeout (TimeoutError), oserror
+(ConnectionResetError), drop/false (non-raising; `fire` returns True),
+or delay (LATENCY injection: `fire` sleeps `delay=S` seconds then
+returns False — the call proceeds, just slow; honored at EVERY site
+regardless of category because it neither raises nor alters the
+return — the SLO plane's burn-rate drills arm it on the attach path),
+defaulting to the site's natural kind (error for raising sites, drop
+for value sites). Each site honors only its own category — see
 `_SITE_CATEGORY` — and env specs reject unknown sites outright, so a
 typo'd schedule aborts the run instead of silently injecting nothing.
 `count` bounds how many times the fault fires (default unlimited);
@@ -93,6 +97,7 @@ import logging
 import os
 import random
 import threading
+import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Iterator, Optional
 
@@ -116,6 +121,11 @@ _RAISING_KINDS: Dict[str, Callable[[str], BaseException]] = {
         f"injected connection reset at {site}"),
 }
 _VALUE_KINDS = ("drop", "false")
+# the latency kind: fire() sleeps then returns False — the call
+# PROCEEDS, just slow. Neither raising nor value, so it is honored at
+# every site whatever its category (the SLO burn-rate drills arm it on
+# attach-path sites like kubeapi.request).
+_DELAY_KIND = "delay"
 
 # What each instrumented production site can honor. A raising kind armed
 # on a value site would not simulate the documented failure — it would
@@ -143,16 +153,19 @@ _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 
 
 class _FaultPoint:
-    __slots__ = ("kind", "remaining", "probability", "exc_factory", "fires")
+    __slots__ = ("kind", "remaining", "probability", "exc_factory",
+                 "fires", "delay_s")
 
     def __init__(self, kind: str, remaining: Optional[int],
                  probability: float,
-                 exc_factory: Optional[Callable[[], BaseException]]):
+                 exc_factory: Optional[Callable[[], BaseException]],
+                 delay_s: float = 0.0):
         self.kind = kind
         self.remaining = remaining    # None = unlimited
         self.probability = probability
         self.exc_factory = exc_factory
         self.fires = 0
+        self.delay_s = delay_s        # kind="delay" only
 
 
 _lock = lockdep.instrument("faults._lock", threading.Lock())
@@ -169,32 +182,44 @@ def seed(n: int) -> None:
 
 def arm(site: str, kind: str = "error", count: Optional[int] = 1,
         probability: float = 1.0,
-        exc: Optional[Callable[[], BaseException]] = None) -> None:
-    """Arm `site`: the next `count` consultations fire (raise or return
-    True per kind) with the given probability. `exc` overrides the kind's
-    exception factory (a zero-arg callable returning the exception)."""
+        exc: Optional[Callable[[], BaseException]] = None,
+        delay_s: float = 0.0) -> None:
+    """Arm `site`: the next `count` consultations fire (raise, return
+    True, or sleep `delay_s` per kind) with the given probability. `exc`
+    overrides the kind's exception factory (a zero-arg callable
+    returning the exception)."""
     global _armed
-    if exc is None and kind not in _RAISING_KINDS and kind not in _VALUE_KINDS:
-        raise ValueError(f"unknown fault kind {kind!r} "
-                         f"(known: {sorted(_RAISING_KINDS) + list(_VALUE_KINDS)})")
+    if exc is None and kind not in _RAISING_KINDS \
+            and kind not in _VALUE_KINDS and kind != _DELAY_KIND:
+        raise ValueError(
+            f"unknown fault kind {kind!r} (known: "
+            f"{sorted(_RAISING_KINDS) + list(_VALUE_KINDS) + [_DELAY_KIND]})")
     if count is not None and count < 1:
         raise ValueError("count must be >= 1 (or None for unlimited)")
-    category = "raising" if (exc is not None or kind in _RAISING_KINDS) \
-        else "value"
-    expected = _SITE_CATEGORY.get(site)
-    if expected is not None and category != expected:
-        raise ValueError(
-            f"site {site!r} honors only {expected} kinds, not {kind!r} — "
-            f"a mismatched kind would {'kill the daemon thread' if expected == 'value' else 'inject nothing while counting fires'}")
+    if kind == _DELAY_KIND and exc is None:
+        if delay_s <= 0:
+            raise ValueError("kind='delay' needs delay_s > 0")
+        # latency is category-agnostic: the consulted call proceeds
+        # unchanged after the sleep, so no site contract is violated
+    else:
+        category = "raising" if (exc is not None or kind in _RAISING_KINDS) \
+            else "value"
+        expected = _SITE_CATEGORY.get(site)
+        if expected is not None and category != expected:
+            raise ValueError(
+                f"site {site!r} honors only {expected} kinds, not {kind!r} — "
+                f"a mismatched kind would {'kill the daemon thread' if expected == 'value' else 'inject nothing while counting fires'}")
     factory = exc
     if factory is None and kind in _RAISING_KINDS:
         maker = _RAISING_KINDS[kind]
         factory = lambda: maker(site)  # noqa: E731 — site-bound closure
     with _lock:
-        _points[site] = _FaultPoint(kind, count, probability, factory)
+        _points[site] = _FaultPoint(kind, count, probability, factory,
+                                    delay_s=delay_s)
         _armed = True
-    log.warning("fault point ARMED: %s kind=%s count=%s p=%g",
-                site, kind, count if count is not None else "inf", probability)
+    log.warning("fault point ARMED: %s kind=%s count=%s p=%g delay=%gs",
+                site, kind, count if count is not None else "inf",
+                probability, delay_s)
 
 
 def disarm(site: Optional[str] = None) -> None:
@@ -240,6 +265,8 @@ def fire(site: str, **ctx: object) -> bool:
         point.fires += 1
         _fired[site] = _fired.get(site, 0) + 1
         factory = point.exc_factory
+        kind = point.kind
+        delay_s = point.delay_s
     log.warning("fault point FIRED: %s%s", site,
                 f" ({ctx})" if ctx else "")
     # flight-recorder marker: an injected fault becomes a span event —
@@ -251,6 +278,12 @@ def fire(site: str, **ctx: object) -> bool:
                 **{k: str(v) for k, v in ctx.items()})
     if factory is not None:
         raise factory()
+    if kind == _DELAY_KIND:
+        # latency injection: sleep OUTSIDE the lock, then let the call
+        # proceed — False tells the site "not injected", which is true:
+        # nothing was dropped or failed, it was only made slow
+        time.sleep(delay_s)
+        return False
     return True
 
 
@@ -275,7 +308,8 @@ def armed_sites() -> Dict[str, Dict[str, object]]:
     diagnostic listing, but do NOT derive compound facts (e.g. an armed
     budget) from two fields of one snapshot."""
     return {site: {"kind": p.kind, "remaining": p.remaining,
-                   "probability": p.probability, "fires": p.fires}
+                   "probability": p.probability, "fires": p.fires,
+                   "delay_s": p.delay_s}
             for site, p in list(_points.items())}
 
 
@@ -283,10 +317,11 @@ def armed_sites() -> Dict[str, Dict[str, object]]:
 def injected(site: str, kind: str = "error", count: Optional[int] = 1,
              probability: float = 1.0,
              exc: Optional[Callable[[], BaseException]] = None,
-             ) -> Iterator[None]:
+             delay_s: float = 0.0) -> Iterator[None]:
     """Scope-bound arming for tests: disarms the site on exit even when
     the fault's budget was not exhausted."""
-    arm(site, kind=kind, count=count, probability=probability, exc=exc)
+    arm(site, kind=kind, count=count, probability=probability, exc=exc,
+        delay_s=delay_s)
     try:
         yield
     finally:
@@ -311,15 +346,19 @@ def configure(spec: str) -> None:
                 else _DEFAULT_KIND[category])
         count: Optional[int] = None
         probability = 1.0
+        delay_s = 0.0
         for opt in fields[2:]:
             key, _, value = opt.partition("=")
             if key == "count":
                 count = int(value)
             elif key == "p":
                 probability = float(value)
+            elif key == "delay":
+                delay_s = float(value)
             else:
                 raise ValueError(f"unknown fault option {opt!r} in {part!r}")
-        arm(site, kind=kind, count=count, probability=probability)
+        arm(site, kind=kind, count=count, probability=probability,
+            delay_s=delay_s)
 
 
 def configure_from_env(env: str = "TDP_FAULTS",

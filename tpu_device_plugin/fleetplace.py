@@ -53,6 +53,7 @@ grammar, the cross-host mesh model, and the global defrag sequence.
 from __future__ import annotations
 
 import itertools
+import json
 import logging
 import re
 import threading
@@ -70,7 +71,7 @@ log = logging.getLogger(__name__)
 
 __all__ = ["SelectorError", "CompiledSelector", "compile_selector",
            "device_attrs", "SliceCache", "host_views_from_slices",
-           "cluster_fragmentation", "FleetScheduler"]
+           "cluster_fragmentation", "FleetScheduler", "FleetFlight"]
 
 
 # ====================================================================
@@ -693,6 +694,106 @@ def cluster_fragmentation(
 
 
 # ====================================================================
+# fleet flight collector (the cross-node trace waterfall, ISSUE 15)
+# ====================================================================
+
+
+class FleetFlight:
+    """Scheduler-side flight collector: merges per-node ``/debug/flight``
+    rings into ONE cross-node, cross-process waterfall for a trace id —
+    the ``/debug/fleet/trace?trace=`` body.
+
+    Sources are named fetch callables taking a query dict ({"trace":
+    id}) and returning the /debug/flight JSON shape ({"spans": [...]}).
+    ``add_http_source`` pulls a real daemon's endpoint over HTTP (the
+    production deployment shape); fleetsim builds in-process sources of
+    the SAME shape (FleetSim.fleet_flight) — one per node, filtered by
+    the ``node`` attribute its driver stamps on every RPC span. A
+    source that fails to answer degrades to a per-source error note
+    (an incident view must render the nodes that DID answer).
+
+    Merging dedupes by the records' process-unique identity
+    ((thread, seq, ts, op) — per-node sources backed by one shared
+    in-process recorder overlap by construction), labels every record
+    with its node (the span's own ``node`` attr wins over the source
+    name), and returns the records time-ordered: the waterfall."""
+
+    def __init__(self) -> None:
+        self._sources: Dict[str, Callable[[dict], dict]] = {}
+
+    def add_source(self, name: str,
+                   fetch: Callable[[dict], dict]) -> None:
+        self._sources[name] = fetch
+
+    def add_http_source(self, name: str, base_url: str,
+                        timeout_s: float = 5.0) -> None:
+        """Pull `name`'s flight ring from its status endpoint
+        (`<base_url>/debug/flight?trace=...`) — the real-deployment
+        source shape."""
+        import urllib.parse
+        import urllib.request
+
+        base = base_url.rstrip("/")
+
+        def fetch(query: dict) -> dict:
+            qs = urllib.parse.urlencode(
+                {k: v for k, v in query.items() if v is not None})
+            with urllib.request.urlopen(
+                    f"{base}/debug/flight?{qs}", timeout=timeout_s) as r:
+                return json.loads(r.read())
+        self.add_source(name, fetch)
+
+    def add_local_source(self, name: str = "local") -> None:
+        """THIS process's recorder as a source (the single-daemon
+        deployment: /debug/fleet/trace serves the local ring until an
+        operator registers the fleet's endpoints)."""
+        self.add_source(
+            name, lambda query: {"spans": trace.snapshot(
+                trace=query.get("trace"))})
+
+    def sources(self) -> List[str]:
+        return sorted(self._sources)
+
+    def trace(self, trace_id: str, limit: Optional[int] = None) -> dict:
+        """The merged waterfall for one trace id: every source's
+        matching records (own trace_id OR span-link match — the
+        migration-handoff joins), deduped, node-labeled, time-ordered.
+        `limit` keeps the newest N after the merge."""
+        merged: List[dict] = []
+        seen: set = set()
+        errors: Dict[str, str] = {}
+        for name, fetch in sorted(self._sources.items()):
+            try:
+                body = fetch({"trace": trace_id})
+            except Exception as exc:
+                errors[name] = str(exc)
+                continue
+            for rec in body.get("spans") or ():
+                key = (rec.get("thread"), rec.get("seq"),
+                       rec.get("ts"), rec.get("op"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                rec = dict(rec)
+                rec["node"] = (rec.get("attrs") or {}).get("node") or name
+                merged.append(rec)
+        merged.sort(key=lambda r: (r.get("ts", 0), r.get("seq", 0)))
+        if limit is not None and limit >= 0:
+            merged = merged[len(merged) - min(limit, len(merged)):]
+        # nodes/ops summarize the RETURNED page (post-limit), so a
+        # limited body is internally consistent — never a node with
+        # zero spans in the waterfall it headlines
+        return {
+            "trace": trace_id,
+            "spans": merged,
+            "nodes": sorted({r["node"] for r in merged}),
+            "ops": sorted({r["op"] for r in merged}),
+            "sources": len(self._sources),
+            "source_errors": errors,
+        }
+
+
+# ====================================================================
 # the scheduler
 # ====================================================================
 
@@ -881,6 +982,12 @@ class FleetScheduler:
         with trace.span("fleetplace.schedule", claim_uid=uid,
                         shape="x".join(str(d) for d in shape),
                         selector=selector or ""):
+            # the decision's trace id is THE fleet trace handle: shard
+            # prepares, broker crossings and later migration handoffs
+            # all join it, and every schedule() result returns it so a
+            # caller can open /debug/fleet/trace?trace= directly
+            ctx = trace.current_context()
+            trace_id = ctx["trace_id"] if ctx else None
             views, _compiled = self.eligible_views(selector)
             plan = placement.plan_slice(shape, views,
                                         best_effort=best_effort,
@@ -894,16 +1001,18 @@ class FleetScheduler:
                 self._note("unplaceable", uid, None)
                 trace.event("fleetplace.unplaceable", claim_uid=uid)
                 return {"uid": uid, "placed": False,
-                        "reason": "unplaceable"}
+                        "reason": "unplaceable", "trace_id": trace_id}
             if self.executor is None:
                 # plan-only mode (dry runs / what-if): the decision is
                 # logged as advisory, never committed
                 self._note("advisory", uid, None)
                 return {"uid": uid, "placed": True, "advisory": True,
+                        "trace_id": trace_id,
                         "score": plan.score, "hosts": plan.hosts,
                         "shards": [(n, list(r)) for n, r in plan.shards]}
             result = self.executor.execute_plan(
                 plan, uid, fail_node=fail_node, observer=self._note)
+            result.setdefault("trace_id", trace_id)
             if result.get("placed"):
                 with self._claims_lock:
                     fresh = dict(self._claims)

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from . import placement
+from . import trace
 from .config import Config
 from .discovery import discover_passthrough
 from .dra import DraDriver, slice_device_name
@@ -270,6 +271,12 @@ class FleetApiServer:
                         outer._admitted -= 1
 
             def _handle(self, method):
+                # trace propagation (r17): the client stamps its active
+                # span's context on every request (kubeapi Traceparent
+                # header); the fabric threads it into the watch events
+                # the write causes, so a watch-driven repair can link
+                # the causal write's trace
+                self._traceparent = self.headers.get("Traceparent")
                 # watch streams bypass the admission gate + latency model
                 # (a real apiserver budgets watches separately from request
                 # servicing; a 64-node fleet's 64 idle streams must not eat
@@ -372,7 +379,8 @@ class FleetApiServer:
                     outer.slices[name] = obj
                     outer._log_write_locked(name, "POST", obj,
                                             self._req_t0)
-                    outer._append_event_locked("ADDED", obj)
+                    outer._append_event_locked("ADDED", obj,
+                                               self._traceparent)
                 return self._send(201, obj)
 
             def _do_PUT(self):
@@ -390,7 +398,8 @@ class FleetApiServer:
                     outer.slices[name] = obj
                     outer._log_write_locked(name, "PUT", obj,
                                             self._req_t0)
-                    outer._append_event_locked("MODIFIED", obj)
+                    outer._append_event_locked("MODIFIED", obj,
+                                               self._traceparent)
                 return self._send(200, obj)
 
             def _do_DELETE(self):
@@ -405,7 +414,8 @@ class FleetApiServer:
                     tomb = dict(live, metadata=dict(
                         live.get("metadata") or {},
                         resourceVersion=str(outer._rv)))
-                    outer._append_event_locked("DELETED", tomb)
+                    outer._append_event_locked("DELETED", tomb,
+                                               self._traceparent)
                 return self._send(200, {})
 
             # ------------------------------------------- WATCH (ISSUE 12)
@@ -624,15 +634,21 @@ class FleetApiServer:
 
     # --------------------------------------------- watch plane (ISSUE 12)
 
-    def _append_event_locked(self, etype: str, obj: dict) -> None:
+    def _append_event_locked(self, etype: str, obj: dict,
+                             traceparent: Optional[str] = None) -> None:
         """Append one pre-serialized watch event (caller holds _lock):
         fan out to every live watcher's bounded queue (overflow = the
         whole queue drops and the stream force-closes), compact the
-        global log to `watch_backlog`, wake the streams."""
+        global log to `watch_backlog`, wake the streams. `traceparent`
+        (the causing write's request header, r17) rides the event
+        top-level, so a watch consumer can link the causal trace."""
         rv = int((obj.get("metadata") or {}).get("resourceVersion")
                  or self._rv)
         name = (obj.get("metadata") or {}).get("name")
-        line = json.dumps({"type": etype, "object": obj}).encode()
+        evt = {"type": etype, "object": obj}
+        if traceparent:
+            evt["traceparent"] = traceparent
+        line = json.dumps(evt).encode()
         self._events.append((rv, name, line))
         while len(self._events) > self.watch_backlog:
             old_rv, _name, _old = self._events.popleft()
@@ -683,12 +699,17 @@ class FleetApiServer:
 
     # ------------------------------------------- multi-host claim records
 
-    def multiclaim_begin(self, uid: str, shape, shards) -> None:
+    def multiclaim_begin(self, uid: str, shape, shards,
+                         traceparent: Optional[str] = None) -> None:
         with self._lock:
             self.multiclaims[uid] = {
                 "shape": list(shape),
                 "shards": [(node, list(raws)) for node, raws in shards],
                 "phase": "pending",
+                # the scheduler decision's trace context (r17): the
+                # fabric's cross-node claim record names the trace a
+                # /debug/fleet/trace query reconstructs
+                "traceparent": traceparent,
             }
             self.multiclaim_log.append(
                 (time.monotonic(), uid, "begin", len(shards)))
@@ -1358,7 +1379,8 @@ class FleetSim:
         note = observer if observer is not None \
             else (lambda kind, u, detail=None: None)
         by_node = self._node_by_name()
-        self.apiserver.multiclaim_begin(uid, plan.shape, plan.shards)
+        self.apiserver.multiclaim_begin(uid, plan.shape, plan.shards,
+                                        traceparent=trace.propagate())
         prepared: List[tuple] = []
         error = None
         for node_name, raws in plan.shards:
@@ -1464,6 +1486,26 @@ class FleetSim:
         return FleetScheduler(executor=self, cache=cache,
                               reflector=reflector,
                               pod_dims=self.pod_dims)
+
+    def fleet_flight(self):
+        """The fleet's trace collector (fleetplace.FleetFlight). This
+        in-process sim shares ONE recorder across every node, so the
+        collector reads it ONCE per query (a per-node source each
+        re-merging the same rings would cost N+1 full scans for an
+        identical result — the dedupe would collapse them anyway) and
+        labels each record by the ``node`` attr its driver stamps on
+        every RPC root / repair span; control-plane spans carry no node
+        attr and label as ``scheduler``. Production fleets register
+        add_http_source per daemon — that is where multi-source merging
+        actually happens, under the same /debug/flight body shape this
+        source serves."""
+        from .fleetplace import FleetFlight
+        ff = FleetFlight()
+        ff.add_source(
+            "scheduler",
+            lambda query: {"spans": trace.snapshot(
+                trace=query.get("trace"))})
+        return ff
 
     def slice_residue(self, uid: str) -> List[str]:
         """State left behind by multi-host claim `uid`: per-node sub-claim

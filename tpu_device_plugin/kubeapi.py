@@ -318,6 +318,13 @@ class ApiClient:
         """One logical request: pool checkout, send, narrow stale-keep-alive
         retry, status handling. Raises ApiError on any failure."""
         headers = self._auth_headers(content_type)
+        # trace propagation (r17): the active span's context rides every
+        # apiserver request as the standard W3C header — the fleetsim
+        # fabric threads it into the watch events the write causes, and
+        # a real apiserver's audit log records it. Counted propagated.
+        traceparent = trace.propagate()
+        if traceparent is not None:
+            headers["Traceparent"] = traceparent
         for attempt in (0, 1):
             if attempt == 0:
                 conn, reused = self._get_conn()

@@ -55,6 +55,26 @@ log = get_logger(__name__)
 # fallback, and a bounded no-evict dict is the only shape that stays
 # GIL-atomic without a lock.
 PREF_CACHE_SIZE = 128
+
+
+def _invocation_link(context) -> Optional[str]:
+    """The caller's ``traceparent`` from gRPC invocation metadata, if it
+    sent one (r17): an instrumented kubelet — or the fleet scheduler
+    driving the servicer surface — joins the daemon's trace instead of
+    the daemon minting a parallel one. None for direct in-process calls
+    (context=None) and metadata-less callers; malformed values are
+    counted dropped at link-coercion time, never raised into the RPC."""
+    meta = getattr(context, "invocation_metadata", None)
+    if meta is None:
+        return None
+    try:
+        pairs = meta()
+    except Exception:
+        return None
+    for key, value in pairs or ():
+        if key == "traceparent":
+            return value
+    return None
 # Starvation cap for the ListAndWatch coalesce window: a relentless flap
 # storm may never produce a quiet window, so after this many windows of
 # deferral the current state is sent anyway (the trailing edge still
@@ -799,7 +819,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         with lockdep.read_path("server.GetPreferredAllocation"), \
                 trace.span("server.GetPreferredAllocation",
                            resource=self.resource_name,
-                           epoch_id=self._store.current.epoch_id):
+                           epoch_id=self._store.current.epoch_id,
+                           link=_invocation_link(context)):
             index = self._alloc_index
             # The ICI sub-box scan is pure in (availability, must-include,
             # size) over a static torus, and the kubelet re-asks with the
@@ -939,7 +960,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                            histogram="tdp_attach_wall_ms",
                            resource=self.resource_name,
                            epoch_id=self._store.current.epoch_id,
-                           devices=sum(len(i) for i in ids)):
+                           devices=sum(len(i) for i in ids),
+                           link=_invocation_link(context)):
             # reuse accounting by ledger delta: a cold byte-path request
             # (fragment builds after an epoch bump) serializes segments
             # and must not also count as a reuse. A concurrent cold call

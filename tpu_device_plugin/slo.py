@@ -1,0 +1,454 @@
+"""slo — declarative latency objectives + multi-window burn rates.
+
+Raw histograms (trace.py) answer "what is the p99"; an operator running
+the fleet against an error budget asks a different question: **how fast
+am I burning the budget right now, and which trace do I open?** This
+module is the objective layer over the existing lock-free histograms:
+
+- An **objective** declares a latency contract over one histogram:
+  "``target`` of observations land within ``threshold_ms``" (the
+  threshold SNAPS to the histogram's next bucket bound — the math is
+  exact against the recorded buckets, never interpolated).
+- The engine samples each histogram's (total, bad) cumulative counts
+  into a bounded ring and computes **multi-window burn rates** — the
+  classic fast (5 m) + slow (1 h) pair: ``burn = error_rate /
+  (1 - target)`` over each window, where burn 1.0 = exactly consuming
+  the budget, 14.4 = a 30-day budget gone in 2 days. A **breach** is
+  the multiwindow gate (fast AND slow over their thresholds, with real
+  bad deltas in the window) — page-worthy, not noise — counted,
+  recorded as a ``slo.breach`` flight-recorder event, and latched until
+  the fast window cools below its threshold.
+- Every burning objective carries an **exemplar trace id** — the latest
+  over-threshold observation's trace, pulled from the histogram's
+  per-bucket exemplar slots (trace.Histogram) — so a moving
+  ``tpu_plugin_slo_burn_rate`` gauge links straight to
+  ``/debug/fleet/trace?trace=<exemplar>``.
+
+Surfaces: ``/status`` ``slo`` section + ``tpu_plugin_slo_*`` on
+``/metrics`` (status.StatusServer), and the engine registers itself as
+a trace-dump extra so every crash/SIGHUP flight dump carries the
+current SLO/burn state next to the span ring (docs/observability.md
+"SLO objectives").
+
+Concurrency: readers (``snapshot()``, the /metrics render) are
+lock-free — the engine swaps one immutable state mapping per
+evaluation, and the counters dict is read via a C-atomic copy. The
+writer side (``evaluate()``) serializes on a PLAIN, deliberately
+UNregistered lock, same contract as trace.py's maintenance lock: it is
+cold-path (one evaluation per scrape, rate-limited sampling), invisible
+to the zero-lock read-path gates, and never held while touching any
+registered lock. tsalint COUNTERS owns ``counters[*]`` under
+``slo.SLOEngine._lock``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from types import MappingProxyType
+from typing import Deque, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from . import trace
+
+log = logging.getLogger(__name__)
+
+__all__ = ["SLOConfigError", "Objective", "SLOEngine",
+           "default_objectives", "load_objectives", "get_engine",
+           "set_engine", "render_prometheus"]
+
+# the classic multiwindow pair (SRE workbook): the fast window catches
+# a budget-destroying incident in minutes, the slow window keeps a
+# brief blip from paging
+DEFAULT_FAST_WINDOW_S = 300.0
+DEFAULT_SLOW_WINDOW_S = 3600.0
+DEFAULT_BURN_FAST = 14.4        # 2% of a 30-day budget per hour
+DEFAULT_BURN_SLOW = 6.0
+# at most one ring sample per second per objective; 2h of history at
+# that cap bounds each ring
+_SAMPLE_GAP_S = 1.0
+_SAMPLE_RING = 7200
+
+
+class SLOConfigError(ValueError):
+    """An objective spec that cannot load: unknown histogram, target
+    outside (0, 1), non-positive threshold/window. Raised at LOAD time —
+    a malformed objective must fail the daemon's boot, never silently
+    monitor nothing."""
+
+
+@dataclass(frozen=True)
+class Objective:
+    name: str
+    histogram: str               # a trace.py-registered histogram family
+    threshold_ms: float          # good = observation <= threshold
+    target: float                # fraction of good observations promised
+    fast_window_s: float = DEFAULT_FAST_WINDOW_S
+    slow_window_s: float = DEFAULT_SLOW_WINDOW_S
+    burn_fast: float = DEFAULT_BURN_FAST
+    burn_slow: float = DEFAULT_BURN_SLOW
+
+    def validate(self) -> "Objective":
+        if not self.name:
+            raise SLOConfigError("objective needs a name")
+        try:
+            trace.histogram(self.histogram)
+        except KeyError:
+            raise SLOConfigError(
+                f"objective {self.name!r}: unknown histogram "
+                f"{self.histogram!r}") from None
+        if not 0.0 < self.target < 1.0:
+            raise SLOConfigError(
+                f"objective {self.name!r}: target must be in (0, 1), "
+                f"got {self.target!r}")
+        if self.threshold_ms <= 0:
+            raise SLOConfigError(
+                f"objective {self.name!r}: threshold_ms must be > 0")
+        if self.fast_window_s <= 0 or self.slow_window_s <= 0:
+            raise SLOConfigError(
+                f"objective {self.name!r}: windows must be > 0")
+        return self
+
+
+def default_objectives() -> List[Objective]:
+    """The shipped objective set, one per plane the operators page on.
+    Thresholds sit on histogram bucket bounds (the math snaps there
+    anyway); targets are the contract docs/observability.md documents."""
+    return [
+        Objective("attach_wall", "tdp_attach_wall_ms",
+                  threshold_ms=50.0, target=0.99),
+        Objective("prepare_wall", "tdp_prepare_wall_ms",
+                  threshold_ms=250.0, target=0.99),
+        Objective("publish_rtt", "tdp_kubeapi_rtt_ms",
+                  threshold_ms=100.0, target=0.99),
+        Objective("watch_convergence", "tdp_watch_convergence_ms",
+                  threshold_ms=1000.0, target=0.99),
+    ]
+
+
+def load_objectives(spec) -> List[Objective]:
+    """Objective list from a declarative spec: a JSON file path, a JSON
+    string, or an already-parsed list of dicts (docs/observability.md
+    "SLO objective config" documents the fields). Fail-loud
+    (SLOConfigError) on anything malformed."""
+    if isinstance(spec, str):
+        text = spec
+        if not spec.lstrip().startswith("["):
+            try:
+                with open(spec, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except OSError as exc:
+                raise SLOConfigError(
+                    f"SLO config {spec!r} is neither a JSON list nor a "
+                    f"readable file: {exc}") from exc
+        try:
+            spec = json.loads(text)
+        except ValueError as exc:
+            raise SLOConfigError(f"SLO config is not JSON: {exc}") from exc
+    if not isinstance(spec, list):
+        raise SLOConfigError(
+            f"SLO config must be a list of objectives, got "
+            f"{type(spec).__name__}")
+    out: List[Objective] = []
+    for i, item in enumerate(spec):
+        if not isinstance(item, dict):
+            raise SLOConfigError(f"objective #{i} is not an object")
+        unknown = set(item) - {
+            "name", "histogram", "threshold_ms", "target",
+            "fast_window_s", "slow_window_s", "burn_fast", "burn_slow"}
+        if unknown:
+            raise SLOConfigError(
+                f"objective #{i}: unknown fields {sorted(unknown)}")
+        try:
+            obj = Objective(**item)
+        except TypeError as exc:
+            raise SLOConfigError(f"objective #{i}: {exc}") from exc
+        out.append(obj.validate())
+    names = [o.name for o in out]
+    if len(names) != len(set(names)):
+        raise SLOConfigError(f"duplicate objective names in {names}")
+    return out
+
+
+def _counts(snap: dict, threshold_ms: float) -> Tuple[int, int, float]:
+    """(total, bad, effective_bound) from one histogram snapshot: bad =
+    observations STRICTLY above the smallest bucket bound >= threshold
+    (the snap point — exact against the recorded buckets)."""
+    total = snap["count"]
+    buckets = snap["buckets"]
+    # threshold beyond the last finite bound: only +Inf overflow is bad
+    good = buckets[-1][1] if buckets else total
+    bound = float("inf")
+    for le, cumulative in buckets:
+        if le >= threshold_ms:
+            good = cumulative
+            bound = le
+            break
+    return total, total - good, bound
+
+
+class SLOEngine:
+    """The objective evaluator. One per process (``get_engine()``);
+    ``evaluate()`` is driven by the /status scrape path (and anything
+    else that wants fresh burn rates), ``snapshot()`` is the lock-free
+    read every surface consumes."""
+
+    def __init__(self, objectives: Optional[Sequence[Objective]] = None,
+                 now=time.monotonic) -> None:
+        objectives = list(objectives if objectives is not None
+                          else default_objectives())
+        for obj in objectives:
+            obj.validate()
+        self.objectives: Tuple[Objective, ...] = tuple(objectives)
+        self._now = now
+        # PLAIN unregistered lock (see module doc): cold-path writer
+        # serialization, invisible to the zero-lock read-path gates
+        self._lock = threading.Lock()
+        # name -> deque[(t, total, bad)] — the burn-rate baselines
+        self._samples: Dict[str, Deque[Tuple[float, int, int]]] = {
+            obj.name: deque(maxlen=_SAMPLE_RING) for obj in objectives}
+        self._breached: Dict[str, bool] = {
+            obj.name: False for obj in objectives}
+        # counters[*] owned by slo.SLOEngine._lock (tsalint COUNTERS);
+        # /status reads them via a C-atomic dict copy
+        self.counters: Dict[str, int] = {
+            "evals_total": 0, "breaches_total": 0}
+        self._state: Mapping[str, dict] = MappingProxyType({})
+
+    # ------------------------------------------------------------ writer
+
+    def _burn(self, obj: Objective,
+              samples: Deque[Tuple[float, int, int]],
+              now: float, total: int, bad: int,
+              window_s: float) -> Tuple[float, float, int]:
+        """(burn_rate, actual_window_s, bad_delta) over `window_s`: the
+        baseline is the OLDEST sample still inside the window (an engine
+        younger than the window honestly reports its shorter actual
+        window rather than extrapolating). Scanned newest-first and
+        stopped at the window edge, so an evaluation pays O(window),
+        not O(full sample ring)."""
+        baseline: Optional[Tuple[float, int, int]] = None
+        horizon = now - window_s
+        for sample in reversed(samples):
+            if sample[0] < horizon:
+                break
+            baseline = sample
+        if baseline is None and samples:
+            baseline = samples[-1]
+        if baseline is None:
+            return 0.0, 0.0, 0
+        d_total = total - baseline[1]
+        d_bad = bad - baseline[2]
+        if d_total <= 0:
+            return 0.0, now - baseline[0], 0
+        error_rate = d_bad / d_total
+        return (error_rate / (1.0 - obj.target),
+                now - baseline[0], d_bad)
+
+    @staticmethod
+    def _exemplar(snap: dict, bound: float) -> Optional[dict]:
+        """The latest exemplar from a bucket ABOVE the objective's snap
+        bound — a trace that actually violated the contract. When the
+        bound IS +Inf (threshold beyond the last finite bucket), the
+        overflow bucket itself holds every bad observation, so its
+        exemplar qualifies — excluding it would leave exactly those
+        objectives exemplar-less."""
+        best: Optional[dict] = None
+        for ex in snap.get("exemplars") or ():
+            le = float("inf") if ex["le"] == "+Inf" else float(ex["le"])
+            if le <= bound and le != float("inf"):
+                continue
+            if best is None or ex["ts"] > best["ts"]:
+                best = ex
+        return best
+
+    def evaluate(self, now: Optional[float] = None) -> Mapping[str, dict]:
+        """One evaluation pass: sample every objective's histogram,
+        recompute both windows' burn rates, latch/unlatch breaches
+        (transitions to breached count + emit a ``slo.breach``
+        flight-recorder event carrying the exemplar trace), and swap the
+        immutable state snapshot readers consume."""
+        if now is None:
+            now = self._now()
+        with self._lock:
+            self.counters["evals_total"] += 1
+            fresh: Dict[str, dict] = {}
+            for obj in self.objectives:
+                snap = trace.histogram(obj.histogram).snapshot()
+                total, bad, bound = _counts(snap, obj.threshold_ms)
+                samples = self._samples[obj.name]
+                if not samples or now - samples[-1][0] >= _SAMPLE_GAP_S:
+                    samples.append((now, total, bad))
+                fast, fast_w, fast_bad = self._burn(
+                    obj, samples, now, total, bad, obj.fast_window_s)
+                slow, slow_w, _slow_bad = self._burn(
+                    obj, samples, now, total, bad, obj.slow_window_s)
+                exemplar = self._exemplar(snap, bound)
+                was = self._breached[obj.name]
+                if not was and fast >= obj.burn_fast \
+                        and slow >= obj.burn_slow and fast_bad > 0:
+                    self._breached[obj.name] = True
+                    self.counters["breaches_total"] += 1
+                    trace.event(
+                        "slo.breach", slo=obj.name,
+                        histogram=obj.histogram,
+                        burn_fast=round(fast, 2),
+                        burn_slow=round(slow, 2),
+                        exemplar_trace=(exemplar or {}).get("trace_id"))
+                    log.warning(
+                        "SLO BREACH: %s burn fast=%.1f slow=%.1f "
+                        "(threshold %gms target %g) exemplar=%s",
+                        obj.name, fast, slow, obj.threshold_ms,
+                        obj.target, (exemplar or {}).get("trace_id"))
+                elif was and fast < obj.burn_fast:
+                    self._breached[obj.name] = False
+                budget = 1.0 - obj.target
+                fresh[obj.name] = {
+                    "histogram": obj.histogram,
+                    "threshold_ms": obj.threshold_ms,
+                    "effective_bound_ms": ("+Inf" if bound == float("inf")
+                                           else bound),
+                    "target": obj.target,
+                    "good_total": total - bad,
+                    "bad_total": bad,
+                    "burn_rate_fast": round(fast, 4),
+                    "burn_rate_slow": round(slow, 4),
+                    "window_fast_s": obj.fast_window_s,
+                    "window_slow_s": obj.slow_window_s,
+                    "window_fast_actual_s": round(fast_w, 1),
+                    "window_slow_actual_s": round(slow_w, 1),
+                    "budget_remaining": round(
+                        1.0 - (bad / total / budget), 4) if total else 1.0,
+                    "breached": self._breached[obj.name],
+                    "exemplar": exemplar,
+                }
+            self._state = MappingProxyType(fresh)
+        return self._state
+
+    # ------------------------------------------------------------ readers
+
+    def snapshot(self) -> dict:
+        """Lock-free: one immutable-mapping attribute read + a C-atomic
+        counters copy. The /status ``slo`` section."""
+        counters = dict(self.counters)
+        return {"objectives": {name: dict(rec)
+                               for name, rec in self._state.items()},
+                "evals_total": counters["evals_total"],
+                "breaches_total": counters["breaches_total"]}
+
+    def dump_state(self) -> dict:
+        """The trace-dump extra (register via attach_to_dumps): the full
+        burn-rate state for the post-mortem, re-evaluated so a crash
+        dump is current, not one scrape stale."""
+        try:
+            self.evaluate()
+        except Exception:               # a dump must never fail on this
+            pass
+        return self.snapshot()
+
+    def attach_to_dumps(self) -> None:
+        """Register this engine's state as the ``slo`` section of every
+        crash/SIGHUP flight dump."""
+        trace.register_dump_extra("slo", self.dump_state)
+
+
+# --------------------------------------------------- process-global seam
+
+_engine: Optional[SLOEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> SLOEngine:
+    """The process-global engine (built with the default objectives on
+    first use, like the trace plane itself — the SLO surfaces are part
+    of the always-on observability plane, not opt-in wiring)."""
+    global _engine
+    engine = _engine
+    if engine is None:
+        with _engine_lock:
+            if _engine is None:
+                _engine = SLOEngine()
+            engine = _engine
+    return engine
+
+
+def set_engine(engine: Optional[SLOEngine]) -> Optional[SLOEngine]:
+    """Swap the process-global engine (cli --slo-config, tests).
+    Returns the previous one."""
+    global _engine
+    with _engine_lock:
+        prev, _engine = _engine, engine
+    return prev
+
+
+# ----------------------------------------------------------- /metrics
+
+def render_prometheus(engine: SLOEngine) -> List[str]:
+    """tpu_plugin_slo_* families for the /metrics scrape (strict
+    text-format: HELP/TYPE per family, contiguous). Reads the lock-free
+    snapshot — the caller (status.metrics) drives evaluate() via
+    status()."""
+    from .status import _esc
+    snap = engine.snapshot()
+    objectives = snap["objectives"]
+    lines: List[str] = [
+        "# HELP tpu_plugin_slo_burn_rate Error-budget burn rate per "
+        "objective and window (1 = exactly consuming the budget).",
+        "# TYPE tpu_plugin_slo_burn_rate gauge",
+    ]
+    for name, rec in sorted(objectives.items()):
+        for window in ("fast", "slow"):
+            lines.append(
+                f'tpu_plugin_slo_burn_rate{{slo="{_esc(name)}",'
+                f'window="{window}"}} {rec[f"burn_rate_{window}"]}')
+    lines += ["# HELP tpu_plugin_slo_breached Objective currently in "
+              "multiwindow breach (latched until the fast window cools).",
+              "# TYPE tpu_plugin_slo_breached gauge"]
+    for name, rec in sorted(objectives.items()):
+        lines.append(f'tpu_plugin_slo_breached{{slo="{_esc(name)}"}} '
+                     f'{int(rec["breached"])}')
+    lines += ["# HELP tpu_plugin_slo_bad_total Observations over the "
+              "objective threshold (derived from the histogram buckets; "
+              "monotone).",
+              "# TYPE tpu_plugin_slo_bad_total counter"]
+    for name, rec in sorted(objectives.items()):
+        lines.append(f'tpu_plugin_slo_bad_total{{slo="{_esc(name)}"}} '
+                     f'{rec["bad_total"]}')
+    lines += ["# HELP tpu_plugin_slo_good_total Observations within the "
+              "objective threshold.",
+              "# TYPE tpu_plugin_slo_good_total counter"]
+    for name, rec in sorted(objectives.items()):
+        lines.append(f'tpu_plugin_slo_good_total{{slo="{_esc(name)}"}} '
+                     f'{rec["good_total"]}')
+    lines += ["# HELP tpu_plugin_slo_budget_remaining Lifetime error "
+              "budget remaining (1 = untouched; negative = overspent).",
+              "# TYPE tpu_plugin_slo_budget_remaining gauge"]
+    for name, rec in sorted(objectives.items()):
+        lines.append(
+            f'tpu_plugin_slo_budget_remaining{{slo="{_esc(name)}"}} '
+            f'{rec["budget_remaining"]}')
+    lines += ["# HELP tpu_plugin_slo_breaches_total Multiwindow breach "
+              "transitions since start (slo.breach flight-recorder "
+              "events).",
+              "# TYPE tpu_plugin_slo_breaches_total counter",
+              f"tpu_plugin_slo_breaches_total {snap['breaches_total']}",
+              "# HELP tpu_plugin_slo_evals_total Engine evaluation "
+              "passes (one per /status scrape).",
+              "# TYPE tpu_plugin_slo_evals_total counter",
+              f"tpu_plugin_slo_evals_total {snap['evals_total']}",
+              "# HELP tpu_plugin_slo_exemplar_info Latest over-threshold "
+              "observation's trace per objective (present whenever one "
+              "was ever recorded — join with the burn/breached series "
+              "before paging); the trace_id label resolves on "
+              "/debug/fleet/trace.",
+              "# TYPE tpu_plugin_slo_exemplar_info gauge"]
+    for name, rec in sorted(objectives.items()):
+        ex = rec.get("exemplar")
+        if ex:
+            lines.append(
+                f'tpu_plugin_slo_exemplar_info{{slo="{_esc(name)}",'
+                f'trace_id="{_esc(ex["trace_id"])}"}} 1')
+    return lines

@@ -19,8 +19,21 @@ dependency-free endpoint for liveness probes and debugging:
   GET /debug/flight -> the flight recorder (trace.py): the merged span
                    ring as time-ordered JSON, filterable by
                    ?claim=<uid> / ?bdf=<raw id> / ?op=<prefix> /
-                   ?limit=<n>, plus the slow-span log — the "what
-                   happened to claim X" surface (docs/observability.md)
+                   ?trace=<trace id> / ?limit=<n>, plus the slow-span
+                   log — the "what happened to claim X" surface
+                   (docs/observability.md). ?since_ms=<epoch ms> turns
+                   the query into a limit-bounded paginated DRAIN:
+                   oldest-first records strictly newer than the cursor,
+                   with next_since_ms/more in the body — a large ring
+                   exports in pages instead of all-or-nothing
+  GET /debug/fleet/trace -> the fleet trace waterfall
+                   (fleetplace.FleetFlight): ?trace=<trace id> merges
+                   every registered flight source (this daemon by
+                   default; per-node sources in fleetsim / registered
+                   HTTP endpoints in real fleets) into one cross-node,
+                   cross-process, node-labeled, time-ordered story —
+                   the "follow one slice claim across hosts and the
+                   broker" surface (docs/observability.md)
   GET /debug/policy -> the policy engine (policy.py): loaded modules,
                    per-hook call/override/error/deadline counters,
                    breaker states, and the bounded recent-decision
@@ -73,9 +86,14 @@ def _esc(value) -> str:
 
 class StatusServer:
     def __init__(self, manager, port: int = 0, host: str = "127.0.0.1",
-                 dra_driver=None):
+                 dra_driver=None, fleet_flight=None):
         self.manager = manager
         self.dra_driver = dra_driver
+        # /debug/fleet/trace collector (fleetplace.FleetFlight): None
+        # builds a local-only collector lazily on first query — a
+        # single daemon serves its own ring under the SAME endpoint
+        # shape a scheduler-side aggregator serves the fleet's
+        self.fleet_flight = fleet_flight
         # assembly accounting of the most recent /metrics render (series,
         # parts, bytes_joined == bytes_rendered): the O(series) scrape
         # guard reads this (test_perf_honesty.py, bench.py --scale)
@@ -117,14 +135,15 @@ class StatusServer:
                     # keep_blank_values: "?claim=" with an empty value
                     # (a typo'd $UID in an incident script) must NOT
                     # silently degrade to the whole unfiltered ring —
-                    # no claim/bdf/op is the empty string, so reject it
+                    # no claim/bdf/op/trace is the empty string, so
+                    # reject it
                     query = parse_qs(parts.query, keep_blank_values=True)
 
                     def first(key):
                         values = query.get(key)
                         return values[0] if values else None
 
-                    for key in ("claim", "bdf", "op"):
+                    for key in ("claim", "bdf", "op", "trace"):
                         if first(key) == "":
                             return self._send(
                                 400, f"empty {key} filter".encode(),
@@ -135,9 +154,34 @@ class StatusServer:
                     except ValueError:
                         return self._send(400, b"limit must be an integer",
                                           "text/plain")
+                    since_ms = first("since_ms")
+                    try:
+                        since_ms = (float(since_ms)
+                                    if since_ms is not None else None)
+                    except ValueError:
+                        return self._send(
+                            400, b"since_ms must be a number (epoch "
+                            b"milliseconds)", "text/plain")
                     self._send(200, json.dumps(outer.flight(
                         claim=first("claim"), bdf=first("bdf"),
-                        op=first("op"), limit=limit),
+                        op=first("op"), limit=limit,
+                        trace=first("trace"), since_ms=since_ms),
+                        sort_keys=True).encode())
+                elif route == "/debug/fleet/trace":
+                    query = parse_qs(parts.query, keep_blank_values=True)
+                    trace_id = (query.get("trace") or [None])[0]
+                    if not trace_id:
+                        return self._send(
+                            400, b"trace=<trace id> query parameter "
+                            b"required", "text/plain")
+                    limit = (query.get("limit") or [None])[0]
+                    try:
+                        limit = int(limit) if limit is not None else None
+                    except ValueError:
+                        return self._send(400, b"limit must be an integer",
+                                          "text/plain")
+                    self._send(200, json.dumps(
+                        outer.fleet_trace(trace_id, limit=limit),
                         sort_keys=True).encode())
                 elif route == "/debug/policy":
                     body = outer.policy_debug()
@@ -224,21 +268,55 @@ class StatusServer:
         from . import broker
         return broker.get_client().stats()
 
-    def flight(self, claim=None, bdf=None, op=None, limit=None) -> dict:
+    def flight(self, claim=None, bdf=None, op=None, limit=None,
+               trace=None, since_ms=None) -> dict:
         """The /debug/flight body: merged span ring (time-ordered,
         filtered), the slow-span log, and the recorder's own stats.
         Entirely lock-free (trace.snapshot merges C-atomic ring copies) —
         draining the flight recorder during an incident can never stall
-        the paths being debugged."""
-        from . import trace
-        return {
+        the paths being debugged.
+
+        With `since_ms` the query becomes one page of a bounded DRAIN
+        (trace.drain — the one paging implementation): oldest-first
+        records strictly newer than the cursor, `limit` per page
+        (extended through an equal-timestamp run so the cursor never
+        loses a record), plus `next_since_ms` (the last returned
+        record's ts — pass it back for the next page) and `more` — a
+        10k-span ring exports in pages instead of all-or-nothing."""
+        from . import trace as trace_mod
+        body = {
             "filters": {"claim": claim, "bdf": bdf, "op": op,
-                        "limit": limit},
-            "spans": trace.snapshot(claim=claim, bdf=bdf, op=op,
-                                    limit=limit),
-            "slow": trace.slow_spans(),
-            "stats": trace.stats(),
+                        "limit": limit, "trace": trace,
+                        "since_ms": since_ms},
+            "slow": trace_mod.slow_spans(),
+            "stats": trace_mod.stats(),
         }
+        if since_ms is not None:
+            page, more = trace_mod.drain(since_ms, limit=limit,
+                                         claim=claim, bdf=bdf, op=op,
+                                         trace=trace)
+            body["spans"] = page
+            body["more"] = more
+            body["next_since_ms"] = (page[-1]["ts"] * 1e3 if page
+                                     else since_ms)
+        else:
+            body["spans"] = trace_mod.snapshot(
+                claim=claim, bdf=bdf, op=op, limit=limit, trace=trace)
+        return body
+
+    def fleet_trace(self, trace_id: str, limit=None) -> dict:
+        """The /debug/fleet/trace body: the merged cross-node waterfall
+        for one trace id (fleetplace.FleetFlight). Without a registered
+        fleet collector this daemon serves its OWN ring under the fleet
+        endpoint shape — the single-node degenerate fleet."""
+        ff = self.fleet_flight
+        if ff is None:
+            from .fleetplace import FleetFlight
+            ff = FleetFlight()
+            name = getattr(self.dra_driver, "node_name", None) or "local"
+            ff.add_local_source(str(name))
+            self.fleet_flight = ff
+        return ff.trace(trace_id, limit=limit)
 
     def _status_impl(self) -> dict:
         from . import faults
@@ -279,6 +357,15 @@ class StatusServer:
         # slow-span pressure — lock-free reads like everything else here
         from . import trace
         out["trace"] = trace.stats()
+        # SLO plane (slo.py): the scrape drives one burn-rate evaluation
+        # (the writer side takes only the engine's plain unregistered
+        # lock — invisible to the zero-lock gate, same contract as the
+        # trace maintenance lock), then surfaces the lock-free snapshot
+        from . import slo as slo_mod
+        slo_engine = getattr(self.manager, "slo_engine", None) \
+            or slo_mod.get_engine()
+        slo_engine.evaluate()
+        out["slo"] = slo_engine.snapshot()
         # privilege-boundary crossings (broker.py): the CLIENT-side
         # counters only — lock-free AtomicCounter reads; the broker
         # process's own audit (an IPC round-trip) lives on /debug/broker
@@ -928,6 +1015,13 @@ class StatusServer:
         # (_bucket/_sum/_count families) + the trace-plane counters
         from . import trace
         lines += trace.render_prometheus()
+        # SLO plane (slo.py): burn rates, breach state, budget, exemplar
+        # info — evaluated by the status() call above, rendered from the
+        # lock-free snapshot
+        from . import slo as slo_mod
+        lines += slo_mod.render_prometheus(
+            getattr(getattr(self, "manager", None), "slo_engine", None)
+            or slo_mod.get_engine())
         # ONE join materializes the scrape: every byte of the response is
         # produced exactly once (list-append assembly — incremental `+=`
         # string building re-copies the accumulated prefix per line,

@@ -28,7 +28,8 @@ Three surfaces, one sharded-cells design (epoch.AtomicCounter's trick):
   kubeapi RTT) with per-thread cells summed at read; exposed in
   Prometheus text format (``_bucket``/``_sum``/``_count``) on /metrics.
 - **Flight recorder** — ``snapshot()`` merges every thread's ring into
-  one time-ordered list (optionally filtered by claim/bdf/op); the
+  one time-ordered list (optionally filtered by claim/bdf/op/trace/
+  since_ms); the
   status server serves it as ``/debug/flight``. Spans exceeding a
   per-op threshold (``$TDP_TRACE_SLOW_MS`` overrides the default) are
   additionally kept in a bounded slow-span log and emitted through the
@@ -53,6 +54,25 @@ store (~2-4 us in this sandbox); ``bench.py --trace-overhead`` measures
 it on the live attach path and docs/bench_attach_r10.json pins the
 bound (guarded by tests/test_perf_honesty.py). ``$TDP_TRACE=0``
 disables recording entirely (spans become a cached no-op context).
+
+**Trace propagation (round 17).** Every span carries a W3C-traceparent-
+style context: a 128-bit ``trace_id`` minted at the ROOT span of a
+thread's stack (per-thread RNG, no locks) and inherited by every child,
+plus a 64-bit ``span_id`` per span. The context crosses the process and
+privilege boundaries this system owns as an explicit carrier field —
+``propagate()`` returns the active span's ``traceparent`` string (one
+counted propagation), and a receiving boundary passes it back in as
+``span(op, link=...)``. A link NEVER mutates a remote ring (per-thread
+rings stay single-writer): a linked ROOT span ADOPTS the remote
+trace_id (the trace continues across the boundary), while a linked
+child keeps its local trace and records the remote context under
+``"link"`` — and ``snapshot(trace=...)`` matches a record by its own
+trace_id OR its link's, so a cross-host migration reads as ONE trace.
+Children inherit their parent's link like they inherit attrs, so the
+whole subtree under a linked span stays query-reachable. Malformed
+inbound context is dropped and counted, never raised
+(``ctx_dropped_total``). docs/observability.md carries the
+boundary-by-boundary carrier taxonomy.
 """
 
 from __future__ import annotations
@@ -60,21 +80,26 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
+import re
 import sys
 import threading
 import time
 from bisect import bisect_right
 from collections import deque
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from .epoch import AtomicCounter
 
 log = logging.getLogger(__name__)
 
-__all__ = ["span", "event", "snapshot", "slow_spans", "stats", "dump",
-           "install_crash_hook", "uninstall_crash_hook", "configure",
-           "reset", "histogram", "observe", "render_prometheus",
-           "Histogram", "enabled"]
+__all__ = ["span", "event", "snapshot", "drain", "slow_spans", "stats",
+           "dump", "install_crash_hook", "uninstall_crash_hook",
+           "configure", "reset", "histogram", "observe",
+           "render_prometheus", "Histogram", "enabled",
+           "current_context", "propagate", "format_traceparent",
+           "parse_traceparent", "register_dump_extra",
+           "unregister_dump_extra"]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -141,6 +166,10 @@ class _TLS(threading.local):
         self.gen = -1
         self.stack: List["_Span"] = []
         self.seq = 0
+        # per-thread id RNG (trace_id/span_id minting): seeded once from
+        # os.urandom so ids are unique across processes/hosts, then pure
+        # compute — no locks, no syscalls on the hot path
+        self.rng: Optional[random.Random] = None
 
 
 _tls = _TLS()
@@ -168,6 +197,14 @@ _slow: deque = deque(maxlen=_SLOW_RING)
 _spans_total = AtomicCounter()
 _events_total = AtomicCounter()
 _slow_total = AtomicCounter()
+# trace-propagation accounting (round 17) — all epoch.AtomicCounter
+# (lock-free by design; tsalint COUNTERS carries LOCKFREE entries):
+# propagated = contexts handed to an outbound boundary (propagate()),
+# attached = remote contexts accepted as span/event links,
+# dropped = inbound contexts refused as malformed (never raised)
+_ctx_propagated = AtomicCounter()
+_ctx_attached = AtomicCounter()
+_ctx_dropped = AtomicCounter()
 
 
 def enabled() -> bool:
@@ -195,15 +232,19 @@ def reset() -> None:
     """Retire every ring, the slow log and the counters (test isolation).
     The generation bump makes every thread's cached ring stale, so the
     next record lands in a fresh ring registered under the new
-    generation."""
+    generation. Dump extras stay registered (they are wiring, not
+    state)."""
     global _rings, _gen, _spans_total, _events_total, _slow_total, \
-        _retired_lost
+        _retired_lost, _ctx_propagated, _ctx_attached, _ctx_dropped
     _gen += 1
     _rings = []
     _slow.clear()
     _spans_total = AtomicCounter()
     _events_total = AtomicCounter()
     _slow_total = AtomicCounter()
+    _ctx_propagated = AtomicCounter()
+    _ctx_attached = AtomicCounter()
+    _ctx_dropped = AtomicCounter()
     with _maintenance_lock:
         _retired_lost = 0
     for hist in _histograms.values():
@@ -242,6 +283,104 @@ def _next_seq() -> int:
     return _tls.seq
 
 
+def _id_rng() -> random.Random:
+    rng = _tls.rng
+    if rng is None:
+        rng = _tls.rng = random.Random(
+            int.from_bytes(os.urandom(16), "big")
+            ^ (threading.get_ident() << 64) ^ time.monotonic_ns())
+    return rng
+
+
+# --------------------------------------------------- trace context (r17)
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-(?P<trace>[0-9a-f]{32})-(?P<span>[0-9a-f]{16})"
+    r"-(?P<flags>[0-9a-f]{2})$")
+_HEX32 = re.compile(r"^[0-9a-f]{32}$")
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
+
+
+def current_context() -> Optional[Dict[str, object]]:
+    """The active span's trace context on THIS thread (None outside any
+    span, or with tracing disabled): {"trace_id", "span_id", "sampled"}.
+    Pure thread-local reads — zero locks."""
+    stack = _tls.stack
+    if not stack:
+        return None
+    sp = stack[-1]
+    return {"trace_id": sp.trace_id, "span_id": sp.span_id,
+            "sampled": True}
+
+
+def format_traceparent(ctx: Mapping[str, object]) -> str:
+    """Context dict → the W3C traceparent wire string
+    (``00-<trace_id>-<span_id>-01``)."""
+    flags = "01" if ctx.get("sampled", True) else "00"
+    return f"00-{ctx['trace_id']}-{ctx['span_id']}-{flags}"
+
+
+def parse_traceparent(text: object) -> Optional[Dict[str, object]]:
+    """Wire string → context dict, or None (counted ctx_dropped_total)
+    on anything malformed — an inbound boundary must degrade to 'no
+    context', never raise into the request path. All-zero ids are
+    invalid per the W3C spec."""
+    if not isinstance(text, str):
+        _ctx_dropped.add()
+        return None
+    m = _TRACEPARENT_RE.match(text.strip().lower())
+    if m is None or set(m.group("trace")) == {"0"} \
+            or set(m.group("span")) == {"0"}:
+        _ctx_dropped.add()
+        return None
+    return {"trace_id": m.group("trace"), "span_id": m.group("span"),
+            "sampled": bool(int(m.group("flags"), 16) & 1)}
+
+
+def _coerce_link(link: object) -> Optional[Dict[str, object]]:
+    """Normalize an inbound context (traceparent string, or a dict
+    carrying trace_id/span_id — the brokeripc/handoff carrier shapes)
+    into a validated link dict. None in → None out (no counting);
+    malformed in → None out, counted dropped."""
+    if link is None:
+        return None
+    if isinstance(link, str):
+        return parse_traceparent(link)
+    if isinstance(link, Mapping):
+        tp = link.get("traceparent")
+        if tp is not None:
+            return parse_traceparent(tp)
+        trace_id, span_id = link.get("trace_id"), link.get("span_id")
+        if isinstance(trace_id, str) and _HEX32.match(trace_id) \
+                and isinstance(span_id, str) and _HEX16.match(span_id):
+            return {"trace_id": trace_id, "span_id": span_id,
+                    "sampled": bool(link.get("sampled", True))}
+    _ctx_dropped.add()
+    return None
+
+
+def propagate() -> Optional[str]:
+    """The active span's traceparent string for an OUTBOUND boundary
+    (brokeripc frame, apiserver request header, handoff record,
+    checkpoint entry); None outside any span. Every non-None return is
+    one counted propagation."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    _ctx_propagated.add()
+    return format_traceparent(ctx)
+
+
+def propagate_context() -> Optional[Dict[str, object]]:
+    """propagate() in dict shape ({"trace_id", "span_id", "sampled"}) —
+    the brokeripc frame carrier. Counted like propagate()."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    _ctx_propagated.add()
+    return ctx
+
+
 class _NullSpan:
     """Cached no-op context for $TDP_TRACE=0: one call + two no-op
     dunders, mirroring lockdep's disabled read_path cost."""
@@ -266,9 +405,11 @@ class _Span:
     stored at __exit__ — in-flight spans are not visible to snapshots
     (the flight recorder records completed work)."""
 
-    __slots__ = ("op", "attrs", "histogram", "t0", "ts", "seq", "parent")
+    __slots__ = ("op", "attrs", "histogram", "t0", "ts", "seq", "parent",
+                 "trace_id", "span_id", "link")
 
     def __init__(self, op: str, histogram: Optional[str],
+                 link: Optional[Dict[str, object]],
                  attrs: Dict[str, Any]) -> None:
         self.op = op
         self.histogram = histogram
@@ -277,6 +418,9 @@ class _Span:
         self.ts = 0.0
         self.seq = 0
         self.parent: Optional[int] = None
+        self.trace_id = ""
+        self.span_id = ""
+        self.link = link
 
     def set(self, **attrs: Any) -> None:
         """Attach attributes discovered mid-span (e.g. a probe verdict)."""
@@ -287,11 +431,25 @@ class _Span:
         if stack:
             parent = stack[-1]
             self.parent = parent.seq
+            # trace context inheritance: one trace id per local span tree
+            self.trace_id = parent.trace_id
+            if self.link is None:
+                # links inherit like attrs: the whole subtree under a
+                # linked span stays reachable from the remote trace id
+                self.link = parent.link
             # inheritance: a child born inside a claim/bdf-scoped span
             # carries that context without replumbing call signatures
             merged = dict(parent.attrs)
             merged.update(self.attrs)
             self.attrs = merged
+        elif self.link is not None:
+            # a linked ROOT adopts the remote trace id — the boundary
+            # crossing continues the caller's trace instead of minting a
+            # parallel one (the remote parent stays recorded as the link)
+            self.trace_id = self.link["trace_id"]       # type: ignore
+        else:
+            self.trace_id = f"{_id_rng().getrandbits(128):032x}"
+        self.span_id = f"{_id_rng().getrandbits(64):016x}"
         self.seq = _next_seq()
         stack.append(self)
         self.ts = time.time()
@@ -305,25 +463,30 @@ class _Span:
             stack.pop()
         elif self in stack:             # defensive: mis-nested exits
             stack.remove(self)
+        ring = _ring()
         rec = {
             "kind": "span",
             "op": self.op,
-            "thread": threading.current_thread().name,
+            "thread": ring.thread,      # the ring caches the name
             "seq": self.seq,
             "parent": self.parent,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
             "ts": self.ts,
             "dur_ms": round(dur_ms, 3),
             "outcome": "ok" if exc is None else "error",
             "attrs": self.attrs,
         }
+        if self.link is not None:
+            rec["link"] = self.link
         if exc is not None:
             rec["error"] = f"{type(exc).__name__}: {exc}"
-        _ring().store(rec)
+        ring.store(rec)
         _spans_total.add()
         if self.histogram is not None:
             hist = _histograms.get(self.histogram)
             if hist is not None:
-                hist.observe(dur_ms)
+                hist.observe(dur_ms, exemplar=self.trace_id)
         threshold = SLOW_THRESHOLDS_MS.get(self.op, _slow_default_ms)
         if dur_ms >= threshold:
             _slow_total.add()
@@ -334,51 +497,89 @@ class _Span:
                 self.attrs)
 
 
-def span(op: str, histogram: Optional[str] = None, **attrs: Any):
+def span(op: str, histogram: Optional[str] = None, link: Any = None,
+         **attrs: Any):
     """Open a span: ``with trace.span("server.Allocate", resource=r): ...``
 
     Disabled ($TDP_TRACE=0): a cached no-op. Enabled: records into this
     thread's ring at exit; `histogram` names a registered Histogram that
-    observes the span's duration (ms). Zero registered locks either way —
-    safe inside every lockdep.read_path bracket.
+    observes the span's duration (ms). `link` attaches a REMOTE trace
+    context (traceparent string or a {trace_id, span_id} dict — a
+    handoff record, a brokeripc frame, a gRPC metadata header): a linked
+    root adopts the remote trace id, a linked child records it, and
+    either way ``snapshot(trace=...)`` finds the span from the remote
+    trace. Zero registered locks either way — safe inside every
+    lockdep.read_path bracket.
     """
     if not _enabled:
         return _NULL_SPAN
-    return _Span(op, histogram, attrs)
+    if link is None:        # the hot-path shape: no boundary crossed
+        return _Span(op, histogram, None, attrs)
+    coerced = _coerce_link(link)
+    if coerced is not None:
+        _ctx_attached.add()
+    return _Span(op, histogram, coerced, attrs)
 
 
-def event(op: str, **attrs: Any) -> None:
+def event(op: str, link: Any = None, **attrs: Any) -> None:
     """Record a point-in-time event (fault fired, lifecycle transition).
     Inherits the active span's attributes on this thread, so an injected
-    fault inside a probe span carries the probe's bdf."""
+    fault inside a probe span carries the probe's bdf. `link` attaches a
+    remote trace context like span(link=...) — the event joins that
+    trace when it has no local span to inherit one from."""
     if not _enabled:
         return
+    if link is None:
+        coerced = None
+    else:
+        coerced = _coerce_link(link)
+        if coerced is not None:
+            _ctx_attached.add()
     stack = _tls.stack
+    trace_id: Optional[str] = None
     if stack:
-        merged = dict(stack[-1].attrs)
+        top = stack[-1]
+        merged = dict(top.attrs)
         merged.update(attrs)
         attrs = merged
-        parent: Optional[int] = stack[-1].seq
+        parent: Optional[int] = top.seq
+        trace_id = top.trace_id
+        if coerced is None:
+            coerced = top.link
     else:
         parent = None
-    _ring().store({
+        if coerced is not None:
+            trace_id = coerced["trace_id"]      # type: ignore[assignment]
+    ring = _ring()
+    rec: Dict[str, Any] = {
         "kind": "event",
         "op": op,
-        "thread": threading.current_thread().name,
+        "thread": ring.thread,
         "seq": _next_seq(),
         "parent": parent,
         "ts": time.time(),
         "outcome": "ok",
         "attrs": attrs,
-    })
+    }
+    if trace_id is not None:
+        rec["trace_id"] = trace_id
+    if coerced is not None:
+        rec["link"] = coerced
+    ring.store(rec)
     _events_total.add()
 
 
 # ------------------------------------------------------------- read side
 
 def _matches(rec: dict, claim: Optional[str], bdf: Optional[str],
-             op: Optional[str]) -> bool:
+             op: Optional[str], trace: Optional[str],
+             since_ms: Optional[float]) -> bool:
     if op is not None and not rec["op"].startswith(op):
+        return False
+    if trace is not None and rec.get("trace_id") != trace \
+            and (rec.get("link") or {}).get("trace_id") != trace:
+        return False
+    if since_ms is not None and rec["ts"] * 1e3 <= since_ms:
         return False
     attrs = rec.get("attrs") or {}
     if claim is not None and attrs.get("claim_uid") != claim:
@@ -391,7 +592,9 @@ def _matches(rec: dict, claim: Optional[str], bdf: Optional[str],
 
 def snapshot(claim: Optional[str] = None, bdf: Optional[str] = None,
              op: Optional[str] = None,
-             limit: Optional[int] = None) -> List[dict]:
+             limit: Optional[int] = None,
+             trace: Optional[str] = None,
+             since_ms: Optional[float] = None) -> List[dict]:
     """Merge every thread's ring into one time-ordered record list.
 
     Lock-free and tear-free: `list(ring.buf)` snapshots each ring's slots
@@ -399,18 +602,51 @@ def snapshot(claim: Optional[str] = None, bdf: Optional[str] = None,
     record (writers store fully-built dicts), and (thread, seq) is unique,
     so a snapshot can never contain a torn or duplicated span — at worst
     it misses records stored after its ring copy. Filters: claim matches
-    attrs.claim_uid; bdf matches attrs.bdf/attrs.device; op is a prefix.
-    `limit` keeps the newest N after filtering.
+    attrs.claim_uid; bdf matches attrs.bdf/attrs.device; op is a prefix;
+    trace matches a record's own trace_id OR its link's (the cross-host
+    waterfall read); since_ms keeps records strictly newer than that
+    epoch-milliseconds cursor. `limit` keeps the newest N after
+    filtering. For a limit-bounded oldest-first drain use `drain()` —
+    THE one paging implementation the /debug/flight endpoint serves.
     """
     records: List[dict] = []
     for ring in list(_rings):
         for rec in list(ring.buf):
-            if rec is not None and _matches(rec, claim, bdf, op):
+            if rec is not None and _matches(rec, claim, bdf, op, trace,
+                                            since_ms):
                 records.append(rec)
     records.sort(key=lambda r: (r["ts"], r["seq"]))
     if limit is not None and limit >= 0:
         records = records[len(records) - min(limit, len(records)):]
     return records
+
+
+def drain(since_ms: float, limit: Optional[int] = None,
+          claim: Optional[str] = None, bdf: Optional[str] = None,
+          op: Optional[str] = None,
+          trace: Optional[str] = None) -> Tuple[List[dict], bool]:
+    """One page of a bounded oldest-first drain: (page, more).
+
+    The cursor contract: records strictly newer than `since_ms`, oldest
+    first, at most `limit` per page — EXTENDED through any run of
+    records sharing the page-final timestamp, because the resume cursor
+    is that timestamp and a strictly-greater cursor would otherwise
+    skip the equal-ts records a plain slice left behind (concurrent
+    threads can share a time.time() float). A caller looping
+    `page, more = drain(cursor, N); cursor = page[-1]["ts"] * 1e3`
+    therefore never re-reads and never loses a record. A non-positive
+    limit reads as unbounded: an empty page with more=True would leave
+    the caller's cursor unable to advance — a busy loop, not a drain.
+    """
+    records = snapshot(claim=claim, bdf=bdf, op=op, trace=trace,
+                       since_ms=since_ms)
+    if limit is None or limit <= 0 or limit >= len(records):
+        return records, False
+    end = limit
+    last_ts = records[end - 1]["ts"]
+    while end < len(records) and records[end]["ts"] == last_ts:
+        end += 1
+    return records[:end], end < len(records)
 
 
 def slow_spans() -> List[dict]:
@@ -440,6 +676,12 @@ def stats() -> dict:
         "spans_overwritten_total": overwritten,
         "slow_spans_total": _slow_total.value,
         "slow_threshold_ms": _slow_default_ms,
+        # trace propagation (round 17): outbound contexts handed to a
+        # boundary / remote contexts attached as links / malformed
+        # inbound contexts refused
+        "ctx_propagated_total": _ctx_propagated.value,
+        "ctx_attached_total": _ctx_attached.value,
+        "ctx_dropped_total": _ctx_dropped.value,
     }
 
 
@@ -470,7 +712,8 @@ class Histogram:
     by thread churn (the idle-exiting checkpoint writer respawns per
     burst)."""
 
-    __slots__ = ("name", "help", "bounds", "_cells", "_local")
+    __slots__ = ("name", "help", "bounds", "_cells", "_local",
+                 "_exemplars")
 
     def __init__(self, name: str, help_text: str,
                  bounds: Tuple[float, ...] = DEFAULT_BUCKETS_MS) -> None:
@@ -481,6 +724,13 @@ class Histogram:
         # (len(bounds)+1) + [value sum]
         self._cells: List[list] = []
         self._local = threading.local()
+        # per-bucket exemplar slots: the last (trace_id, value_ms, ts)
+        # observed into each bucket — immutable tuples stored with one
+        # C-atomic slot write (last-writer-wins across threads is exactly
+        # the semantics wanted: ANY offending trace links the bucket to
+        # a real /debug/fleet/trace story)
+        self._exemplars: List[Optional[tuple]] = \
+            [None] * (len(self.bounds) + 1)
 
     def _reset(self) -> None:
         # retire the cells wholesale (reset()); threads re-register on
@@ -488,6 +738,7 @@ class Histogram:
         # against membership via the home-list identity below
         self._cells = []
         self._local = threading.local()
+        self._exemplars = [None] * (len(self.bounds) + 1)
 
     def _claim_cell(self) -> list:
         me = threading.current_thread()
@@ -500,7 +751,8 @@ class Histogram:
             self._cells.append([me, cell])
             return cell
 
-    def observe(self, value_ms: float) -> None:
+    def observe(self, value_ms: float,
+                exemplar: Optional[str] = None) -> None:
         cell = getattr(self._local, "cell", None)
         cells = self._cells
         if cell is None or getattr(self._local, "home", None) is not cells:
@@ -510,11 +762,31 @@ class Histogram:
         i = bisect_right(self.bounds, value_ms)
         cell[i] += 1                    # owner thread only: exact
         cell[-1] += value_ms            # sum (float; owner-only)
+        if exemplar:
+            # one C-atomic slot store of an immutable tuple — a scrape
+            # racing this sees either the old or the new exemplar, whole
+            self._exemplars[i] = (exemplar, value_ms, time.time())
+
+    def exemplars(self) -> List[dict]:
+        """The per-bucket exemplars, JSON-shaped (lock-free: one C-atomic
+        list copy of immutable tuples): [{"le", "trace_id", "value_ms",
+        "ts"}, ...] for the buckets that have one."""
+        out: List[dict] = []
+        for i, ex in enumerate(list(self._exemplars)):
+            if ex is None:
+                continue
+            le = self.bounds[i] if i < len(self.bounds) else float("inf")
+            out.append({"le": "+Inf" if le == float("inf")
+                        else format(le, "g"),
+                        "trace_id": ex[0], "value_ms": round(ex[1], 3),
+                        "ts": ex[2]})
+        return out
 
     def snapshot(self) -> dict:
         """{"buckets": [(le, cumulative_count), ...], "count": n,
-        "sum": total_ms} — buckets cumulative, Prometheus-style; count
-        derived from the same copied bucket values (see class doc)."""
+        "sum": total_ms, "exemplars": [...]} — buckets cumulative,
+        Prometheus-style; count derived from the same copied bucket
+        values (see class doc)."""
         n_buckets = len(self.bounds) + 1
         per_bucket = [0] * n_buckets
         total = 0.0
@@ -529,7 +801,7 @@ class Histogram:
             running += n
             buckets.append((bound, running))
         return {"buckets": buckets, "count": sum(per_bucket),
-                "sum": round(total, 6)}
+                "sum": round(total, 6), "exemplars": self.exemplars()}
 
 
 # The registered histogram families (ms). The HELP text doubles as the
@@ -560,16 +832,21 @@ _register("tdp_pacing_delay_ms",
 _register("tdp_broker_crossing_ms",
           "Privilege-boundary crossing wall time (broker.ipc span: one "
           "broker operation, in-process or over the broker IPC).")
+_register("tdp_watch_convergence_ms",
+          "Watch convergence lag: wall time from a divergence-evidencing "
+          "watch observation to the repair publish landing "
+          "(dra.start_watch_reconciler).")
 
 
 def histogram(name: str) -> Histogram:
     return _histograms[name]
 
 
-def observe(name: str, value_ms: float) -> None:
+def observe(name: str, value_ms: float,
+            exemplar: Optional[str] = None) -> None:
     hist = _histograms.get(name)
     if hist is not None and _enabled:
-        hist.observe(value_ms)
+        hist.observe(value_ms, exemplar=exemplar)
 
 
 def _fmt_bound(bound: float) -> str:
@@ -610,16 +887,47 @@ def render_prometheus() -> List[str]:
         "overwritten before any reader drained them.",
         "# TYPE tdp_trace_spans_overwritten_total counter",
         f"tdp_trace_spans_overwritten_total {s['spans_overwritten_total']}",
+        "# HELP tdp_trace_ctx_propagated_total Trace contexts handed to "
+        "an outbound boundary (broker frame, apiserver header, handoff "
+        "record, fabric multiclaim).",
+        "# TYPE tdp_trace_ctx_propagated_total counter",
+        f"tdp_trace_ctx_propagated_total {s['ctx_propagated_total']}",
+        "# HELP tdp_trace_ctx_attached_total Remote trace contexts "
+        "attached as span/event links (lock-free: per-thread rings stay "
+        "single-writer).",
+        "# TYPE tdp_trace_ctx_attached_total counter",
+        f"tdp_trace_ctx_attached_total {s['ctx_attached_total']}",
+        "# HELP tdp_trace_ctx_dropped_total Inbound trace contexts "
+        "refused as malformed (degraded to no-context, never raised).",
+        "# TYPE tdp_trace_ctx_dropped_total counter",
+        f"tdp_trace_ctx_dropped_total {s['ctx_dropped_total']}",
     ]
     return lines
 
 
 # --------------------------------------------------------- crash artifact
 
+# Post-mortem sections contributed by other planes (the SLO engine
+# registers "slo"): dump() merges each callable's result into the
+# payload, so a crash/SIGHUP artifact carries the latency + burn-rate
+# context alongside the span ring. A raising extra degrades to an error
+# note — dumping must never add a second crash to the one reported.
+_dump_extras: Dict[str, Callable[[], object]] = {}
+
+
+def register_dump_extra(name: str, fn: Callable[[], object]) -> None:
+    _dump_extras[name] = fn
+
+
+def unregister_dump_extra(name: str) -> None:
+    _dump_extras.pop(name, None)
+
+
 def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
-    """Write the merged ring + slow log + stats to a JSON file; returns
-    the path (None when the write failed — dumping must never add a
-    second crash to the one being reported). Default path:
+    """Write the merged ring + slow log + stats + histogram snapshots
+    (and any registered extras, e.g. SLO/burn-rate state) to a JSON
+    file; returns the path (None when the write failed — dumping must
+    never add a second crash to the one being reported). Default path:
     $TDP_TRACE_DUMP_PATH, else tdp-flight-<pid>.json under $TMPDIR."""
     path = path or os.environ.get("TDP_TRACE_DUMP_PATH") or os.path.join(
         os.environ.get("TMPDIR", "/tmp"), f"tdp-flight-{os.getpid()}.json")
@@ -630,7 +938,14 @@ def dump(reason: str, path: Optional[str] = None) -> Optional[str]:
         "stats": stats(),
         "slow": slow_spans(),
         "spans": snapshot(),
+        "histograms": {name: _histograms[name].snapshot()
+                       for name in sorted(_histograms)},
     }
+    for name, fn in list(_dump_extras.items()):
+        try:
+            payload[name] = fn()
+        except Exception as exc:       # a post-mortem extra must not
+            payload[name] = {"error": str(exc)}   # kill the dump
     try:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(payload, f, indent=1, sort_keys=True)
