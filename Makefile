@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -211,6 +211,16 @@ bench-transport:
 # docs/bench_tracefleet_r17.json. CI bench-smoke runs --quick (N=16).
 bench-trace-fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --trace-fleet
+
+# Self-heal closed-loop bench (ISSUE 16): a 256-node autopilot soak
+# with a ramped kubeapi delay fault; asserts the full remediation
+# chain — burn rise -> breach latch -> policy-approved audited actions
+# (pacer backoff + exemplar->node placement bias) -> dilution recovery
+# -> knob rollback — all reconstructed from ONE
+# /debug/fleet/trace?trace= query. Writes docs/bench_selfheal_r18.json.
+# CI bench-smoke runs --quick (N=16).
+bench-selfheal:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --selfheal
 
 # Broker + policy suites over the REAL two-process path: every
 # seam-facing assertion re-executed with a spawned broker process per

@@ -1,0 +1,127 @@
+"""End-to-end drive of the self-heal plane (PR 16).
+
+Real daemon (cli.main subprocess) with --dra + status server, booted
+under the r17 latency fault ($TDP_FAULTS kubeapi.request:delay) with
+the remediation engine on by default:
+  1. claim traffic under the fault burns the publish/prepare SLOs and
+     latches breaches (the /status polls drive the evaluations)
+  2. the remediation engine's BACKGROUND thread — never the scrape —
+     applies the policy-gated knobs: pacer_backoff (+ the attach
+     plane's admission_throttle once prepare_wall breaches too)
+  3. /status remediation.* shows the active actions, counters moved
+  4. /debug/remediation replays the audit ring; the applied entry
+     carries the breach's exemplar trace id
+  5. /debug/flight?trace=<that id> shows the remediation.action span
+     on the SAME trace as the breaching kubeapi request — the one-query
+     causal chain, daemon-local
+  6. the tpu_plugin_remediation_* families are on /metrics
+Prints REMEDIATION DRIVE PASS on success.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import grpc  # noqa: E402
+from fakehost import FakeChip, FakeHost  # noqa: E402
+from test_dra import FakeApiServer  # noqa: E402
+from tpu_device_plugin.kubeletapi import draapi, drapb  # noqa: E402
+
+root = tempfile.mkdtemp(prefix="vfyrem-", dir="/tmp")
+fh = FakeHost(root)
+for i in range(2):
+    fh.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                         iommu_group=str(10 + i)))
+os.makedirs(os.path.join(root, "device-plugins"), exist_ok=True)
+api = FakeApiServer()
+port = 18191
+env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+           NODE_NAME="node-a",
+           # +300 ms on every apiserver round-trip: publish_rtt (and,
+           # through the claim GET inside prepare, prepare_wall) burn
+           TDP_FAULTS="kubeapi.request:delay:delay=0.3")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "tpu_device_plugin", "--root", root,
+     "--dra", "--api-server", api.url, "--status-port", str(port),
+     "--health-poll-seconds", "0.3"],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=2) as r:
+        body = r.read()
+    return json.loads(body) if path != "/metrics" else body.decode()
+
+
+def wait_for(pred, what, timeout=40):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        try:
+            if pred():
+                print(f"OK: {what}")
+                return
+        except Exception:
+            pass
+        time.sleep(0.3)
+    raise SystemExit(f"FAIL: timeout waiting for {what}")
+
+
+try:
+    wait_for(lambda: get("/status"), "daemon up")
+    wait_for(lambda: api.slices, "ResourceSlice published")
+    # claim traffic: each prepare's claim GET pays the +300ms delay —
+    # bad publish_rtt/prepare_wall samples that burn the SLO budget
+    dra_sock = os.path.join(root, "plugins/cloud-tpus.google.com/dra.sock")
+    with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+        stub = draapi.DraPluginStub(ch)
+        for i in range(6):
+            api.add_claim("ns", f"vm{i}", f"uid-{i}",
+                          "cloud-tpus.google.com",
+                          [{"device": "d0000-00-04-0"}], generation=5)
+            resp = stub.NodePrepareResources(
+                drapb.NodePrepareResourcesRequest(claims=[
+                    drapb.Claim(namespace="ns", name=f"vm{i}",
+                                uid=f"uid-{i}")]), timeout=15)
+            err = resp.claims[f"uid-{i}"].error
+            if err:   # a typed shed IS the remediation throttle working
+                assert "shed" in err, err
+                print(f"OK: prepare uid-{i} shed with typed reason: "
+                      f"{err!r}")
+            stub.NodeUnprepareResources(
+                drapb.NodeUnprepareResourcesRequest(claims=[
+                    drapb.Claim(namespace="ns", name=f"vm{i}",
+                                uid=f"uid-{i}")]), timeout=15)
+    print("OK: claim traffic generated under the latency fault")
+    wait_for(lambda: get("/status")["slo"]["objectives"]["publish_rtt"]
+             ["breached"], "publish_rtt breach latched")
+    wait_for(lambda: get("/status")["remediation"]["actions_total"] >= 1,
+             "remediation engine acted (background tick)")
+    st = get("/status")["remediation"]
+    active = {a["action"] for a in st["active_actions"]}
+    assert "pacer_backoff" in active, st
+    print(f"OK: pacer_backoff active on /status (active={sorted(active)})")
+    dbg = get("/debug/remediation")
+    applied = [a for a in dbg["audit"] if a["status"] == "applied"]
+    assert applied and applied[0]["trace_id"], dbg["audit"]
+    tid = applied[0]["trace_id"]
+    flight = get(f"/debug/flight?trace={tid}")
+    ops = {s.get("op") for s in flight["spans"]}
+    assert "remediation.action" in ops, ops
+    print(f"OK: remediation.action span on the breach trace {tid[:8]}... "
+          f"(ops={sorted(o for o in ops if o)})")
+    m = get("/metrics")
+    assert "tpu_plugin_remediation_actions_total" in m
+    print("OK: tpu_plugin_remediation_actions_total on /metrics")
+    print("REMEDIATION DRIVE PASS")
+finally:
+    proc.terminate()
+    proc.wait(timeout=10)
+    api.stop()

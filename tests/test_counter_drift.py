@@ -137,6 +137,10 @@ SURFACES = {
     ("resilience.CircuitBreaker", "rejected"): {
         "status": "dra.api_breaker.rejected",
         "metrics": "tpu_plugin_kubeapi_breaker_rejected_total"},
+    ("resilience.CircuitBreaker", "half_open_rejected"): {
+        "status": "dra.api_breaker.half_open_rejected",
+        "metrics":
+            "tpu_plugin_kubeapi_breaker_half_open_rejected_total"},
     ("resilience.CircuitBreaker", "_consecutive_failures"): {
         # transient breaker state (resets on success): /status only
         "status": "dra.api_breaker.consecutive_failures",
@@ -164,6 +168,12 @@ SURFACES = {
     ("slo.SLOEngine", "counters[*]"): {
         "status": "slo.evals_total",
         "metrics": "tpu_plugin_slo_evals_total"},
+    # remediation engine (ISSUE 16): the action counter anchors the
+    # dict group; the rollback/veto/shed twins surface under the same
+    # remediation.* status object and their own families
+    ("remediation.RemediationEngine", "counters[*]"): {
+        "status": "remediation.actions_total",
+        "metrics": "tpu_plugin_remediation_actions_total"},
 }
 
 

@@ -88,11 +88,72 @@ def test_configure_spec_grammar():
     armed = faults.armed_sites()
     assert armed["kubelet.register"] == {"kind": "error", "remaining": 3,
                                          "probability": 1.0, "fires": 0,
-                                         "delay_s": 0.0}
+                                         "delay_s": 0.0, "jitter_s": 0.0,
+                                         "ramp_s": 0.0}
     assert armed["native.probe"]["probability"] == 0.25
     assert armed["native.probe"]["remaining"] is None
     # bare site: defaults to the site's natural kind, not blanket "error"
     assert armed["inotify.poll"]["kind"] == "drop"
+
+
+def test_configure_spec_delay_jitter_ramp():
+    faults.configure("kubeapi.request:delay:delay=0.2:jitter=0.05:ramp=30")
+    armed = faults.armed_sites()["kubeapi.request"]
+    assert armed["kind"] == "delay"
+    assert armed["delay_s"] == 0.2
+    assert armed["jitter_s"] == 0.05
+    assert armed["ramp_s"] == 30.0
+
+
+def test_delay_jitter_spreads_sleeps_uniformly(monkeypatch):
+    """jitter=J: each sleep is drawn uniformly from [delay-J, delay+J]
+    (seeded, so the schedule replays)."""
+    sleeps = []
+    monkeypatch.setattr("tpu_device_plugin.faults.time.sleep",
+                        sleeps.append)
+    faults.seed(7)
+    faults.arm("j", kind="delay", count=None, delay_s=0.1, jitter_s=0.05)
+    for _ in range(50):
+        assert faults.fire("j") is False     # delay: call proceeds
+    assert all(0.05 - 1e-9 <= s <= 0.15 + 1e-9 for s in sleeps)
+    assert len(set(round(s, 6) for s in sleeps)) > 1   # actually jittered
+    replay = list(sleeps)
+    sleeps.clear()
+    faults.reset()
+    faults.seed(7)
+    faults.arm("j", kind="delay", count=None, delay_s=0.1, jitter_s=0.05)
+    for _ in range(50):
+        faults.fire("j")
+    assert sleeps == replay
+
+
+def test_delay_ramp_scales_linearly_from_arm_time(monkeypatch):
+    """ramp=R: the sleep grows linearly from 0 at arm time to full
+    strength R seconds later (a soak's gradual degradation, not a step)."""
+    sleeps = []
+    monkeypatch.setattr("tpu_device_plugin.faults.time.sleep",
+                        sleeps.append)
+    clock = [1000.0]
+    monkeypatch.setattr("tpu_device_plugin.faults.time.monotonic",
+                        lambda: clock[0])
+    faults.arm("r", kind="delay", count=None, delay_s=0.4, ramp_s=10.0)
+    faults.fire("r")                         # t=0: no degradation yet
+    clock[0] += 5.0
+    faults.fire("r")                         # mid-ramp: half strength
+    clock[0] += 5.0
+    faults.fire("r")                         # ramp complete: full delay
+    clock[0] += 100.0
+    faults.fire("r")                         # stays at full strength
+    assert sleeps == pytest.approx([0.0, 0.2, 0.4, 0.4])
+
+
+def test_jitter_and_ramp_require_delay_kind():
+    with pytest.raises(ValueError, match="kind='delay'"):
+        faults.arm("x", kind="error", jitter_s=0.1)
+    with pytest.raises(ValueError, match="kind='delay'"):
+        faults.arm("x", kind="drop", ramp_s=1.0)
+    with pytest.raises(ValueError):
+        faults.arm("x", kind="delay", delay_s=0.1, jitter_s=-1.0)
 
 
 def test_configure_rejects_unknown_option():

@@ -20,6 +20,7 @@ from tpu_device_plugin import faults, lockdep, trace
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.discovery import discover_passthrough
 from tpu_device_plugin.lifecycle import PluginManager
+from tpu_device_plugin.remediation import RemediationEngine
 from tpu_device_plugin.server import TpuDevicePlugin
 from tpu_device_plugin.status import StatusServer, _esc
 
@@ -87,6 +88,14 @@ def full_scrape(short_root):
         manager.device_lifecycle.sync_inventory({"0000:00:04.0": None})
         driver = make_driver(cfg, apiserver)
         driver.publish_resource_slices()
+        # self-heal plane attached: the tpu_plugin_remediation_*
+        # families and the /status remediation section are in the scrape
+        manager.remediation_engine = RemediationEngine(pacer=driver.pacer)
+        manager.remediation_engine.on_transition(
+            {"slo": "attach-p99", "kind": "breach",
+             "histogram": "tdp_attach_wall_ms",
+             "exemplar": {"trace_id": "ab" * 16}})
+        manager.remediation_engine.tick()        # remediation counters move
         faults.arm("dra.publish", kind="drop", count=1)
         faults.fire("dra.publish")               # fault stats exist
         trace.observe("tdp_attach_wall_ms", 1.25)
@@ -118,7 +127,9 @@ def test_every_series_has_help_and_type_and_parses(full_scrape):
                    "tpu_plugin_lifecycle_invalid_transitions_total",
                    "tdp_fault_fires_total", "tdp_trace_spans_total",
                    "tdp_read_path_lock_acquisitions_total",
-                   "tdp_attach_wall_ms"):
+                   "tdp_attach_wall_ms",
+                   "tpu_plugin_remediation_actions_total",
+                   "tpu_plugin_kubeapi_breaker_half_open_rejected_total"):
         assert family in types, f"family {family} missing from scrape"
 
 

@@ -1406,3 +1406,87 @@ def test_attach_bytes_reused_is_live_not_just_recorded_r15(short_root):
     assert pb.PreferredAllocationResponse.FromString(
         pref_raw.data) == expected_pref
     assert pb.AllocateResponse.FromString(alloc_raw.data) == expected_alloc
+
+
+def test_bench_selfheal_r18_pins_closed_loop():
+    """Round-18 self-heal pins against the RECORDED
+    docs/bench_selfheal_r18.json (counted facts, CI-safe):
+
+      - the soak ran at 256 nodes with the self-heal drill armed and
+        ended green (every storm invariant plus every drill link);
+      - EVERY link of the closed loop held: the ramped delay fault
+        latched a breach with an exemplar, the remediation engine acted
+        through the policy remediate gate (call counted), the exemplar
+        attributed to the victim node (placement-biased away), good
+        traffic recovered the burn, and every knob rolled back;
+      - the story's burn provably ROSE at breach and fell back under
+        the fast threshold at recovery;
+      - ONE /debug/fleet/trace?trace=<exemplar> query carried the slow
+        node-stamped publish, the remediation actions and the
+        rollbacks — the endpoint is named in the story;
+      - zero remediation errors, zero vetoes, and no hysteresis skips
+        were needed for the single incident (no flapping)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_selfheal_r18.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    soak = d["soak"]
+    assert soak["nodes"] == 256
+    assert soak["ok"] and soak["violations"] == []
+    assert soak["claim_events"] >= 2000
+    assert all(d["chain"].values()), d["chain"]
+
+    story = d["story"]
+    assert story["breached"] is True and story["recovered"] is True
+    assert story["burn_at_breach"] > 14.4      # over the fast threshold
+    assert story["burn_at_recovery"] < 14.4
+    assert story["actions"] >= 2 and story["rollbacks"] >= 2
+    assert story["policy_remediate_calls"] >= story["actions"]
+    assert story["endpoint"] == \
+        f"/debug/fleet/trace?trace={story['trace_id']}"
+    assert story["victim"] in story["nodes"]
+    acted = {a["action"] for a in story["active_actions"]}
+    assert {"pacer_backoff", "node_bias"} <= acted
+    for op in ("dra.publish", "kubeapi.request", "remediation.action",
+               "remediation.rollback"):
+        assert op in story["ops"], (op, story["ops"])
+    c = story["counters"]
+    assert c["errors_total"] == 0 and c["vetoes_total"] == 0
+    assert c["actions_total"] == c["rollbacks_total"]
+
+
+def test_selfheal_closed_loop_is_live_not_just_recorded_r18(short_root):
+    """Runtime half of the r18 pin: the drill itself — breach latch,
+    policy-gated knob turns, exemplar->node attribution, latched
+    recovery, rollback — runs green on a live 2-node fleet, and the
+    whole chain reconstructs from ONE fleet-trace query."""
+    from tpu_device_plugin import faults
+    from tpu_device_plugin import trace as trace_mod
+    from tpu_device_plugin.autopilot import AutopilotConfig, FleetAutopilot
+    from tpu_device_plugin.fleetsim import FleetSim
+
+    trace_mod.reset()
+    sim = FleetSim(n_nodes=2, devices_per_node=4, latency_s=0.0,
+                   max_inflight=0, seed=18, watch=False,
+                   root=short_root)
+    try:
+        sim.boot_storm()
+        cfg = AutopilotConfig(nodes=2, selfheal=True,
+                              selfheal_fault_ramp_s=0.5)
+        pilot = FleetAutopilot(cfg, sim=sim)
+        story = pilot._selfheal_drill()
+        assert pilot.violations == [], pilot.violations
+        assert story["breached"] and story["recovered"]
+        assert story["actions"] >= 2 and story["rollbacks"] >= 2
+        assert story["victim"] in story["nodes"]
+        for op in ("remediation.action", "remediation.rollback",
+                   "kubeapi.request"):
+            assert op in story["ops"], (op, story["ops"])
+    finally:
+        faults.reset()
+        sim.stop()
+        trace_mod.reset()

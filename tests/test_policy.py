@@ -418,7 +418,8 @@ def test_debug_policy_404_without_engine(short_root):
 
 
 def test_hook_names_are_the_documented_contract():
-    assert HOOK_NAMES == ("score_allocation", "health_verdict", "admit")
+    assert HOOK_NAMES == ("score_allocation", "health_verdict", "admit",
+                          "remediate")
 
 
 def test_shipped_example_policy_loads_and_decides():

@@ -1,0 +1,505 @@
+"""remediation engine — SLO-closed-loop self-healing (ISSUE 16).
+
+What must hold: a latched SLO breach queued via on_transition turns a
+knob on tick() (pacer floor, admission throttle, node bias + drain,
+defrag wave) — every turn policy-gated, exemplar-trace-linked, audited;
+a latched recovery rolls the knob back; hysteresis (cool-downs, window
+budget, holder sets) means no flapping and no storms; every shed is
+typed and counted, never silent."""
+
+import threading
+
+import pytest
+
+from tpu_device_plugin import trace
+from tpu_device_plugin.policy import PolicyEngine
+from tpu_device_plugin.remediation import (RemediationEngine, TokenBucket,
+                                           render_prometheus)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakePacer:
+    def __init__(self):
+        self.floor = None
+        self.cleared = 0
+
+    def set_backoff_floor(self, floor_s):
+        self.floor = floor_s
+
+    def clear_backoff_floor(self):
+        self.floor = None
+        self.cleared += 1
+
+
+class FakeScheduler:
+    """Just the seams remediation drives; stats mimics the
+    AtomicCounter dict shape."""
+
+    class _Counter:
+        def __init__(self):
+            self.value = 0
+
+    def __init__(self):
+        self.stats = {"unplaceable_total": self._Counter()}
+        self.biased = []
+        self.cleared = []
+        self.drains = []
+        self.waves = []
+        self.proposal = {"placeable": False, "migrations": [
+            {"claim": "c1", "source_node": "node-1",
+             "target_node": "node-2", "devices": [], "target_devices": []}]}
+
+    def bias_away(self, node, reason=""):
+        self.biased.append((node, reason))
+
+    def clear_bias(self, node):
+        self.cleared.append(node)
+
+    def plan_drain(self, node, generation=None):
+        self.drains.append(node)
+        return {"node": node, "generation": "g1", "moves": 1,
+                "resolved": 1, "migrations": [
+                    {"claim": "c9", "source_node": node,
+                     "target_node": "node-2", "devices": [],
+                     "target_devices": []}]}
+
+    def plan_defrag_wave(self, shape, generation=None, selector=""):
+        return dict(self.proposal)
+
+    def apply_defrag_wave(self, proposal):
+        self.waves.append(proposal)
+        moves = [m for m in proposal.get("migrations", ())
+                 if m.get("target_node")]
+        return {"wave": f"w{len(self.waves)}", "moves_planned": len(moves),
+                "moves_applied": len(moves)}
+
+
+class FakeFlight:
+    def __init__(self, nodes=("scheduler", "node-3")):
+        self.nodes = list(nodes)
+        self.queries = []
+
+    def trace(self, trace_id, limit=None):
+        self.queries.append(trace_id)
+        return {"trace": trace_id, "spans": [], "nodes": list(self.nodes),
+                "ops": [], "sources": 1, "source_errors": {}}
+
+
+TID = "ab" * 16
+TID2 = "cd" * 16
+
+
+def _breach(slo="attach-p99", histogram="tdp_attach_wall_ms", tid=TID):
+    return {"slo": slo, "kind": "breach", "histogram": histogram,
+            "burn_fast": 20.0, "burn_slow": 8.0,
+            "exemplar": {"trace_id": tid, "le": 250.0, "ts": 0.0}}
+
+
+def _recovered(slo="attach-p99", histogram="tdp_attach_wall_ms"):
+    return {"slo": slo, "kind": "recovered", "histogram": histogram,
+            "burn_fast": 0.0, "burn_slow": 0.1, "exemplar": None}
+
+
+@pytest.fixture(autouse=True)
+def _trace_ring():
+    trace.configure(enabled=True)
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _engine(clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("pacer", FakePacer())
+    kw.setdefault("scheduler", FakeScheduler())
+    kw.setdefault("now", clock)
+    return RemediationEngine(**kw), clock
+
+
+# ------------------------------------------------------------ TokenBucket
+
+def test_token_bucket_burst_then_rate_refill():
+    clock = FakeClock()
+    bucket = TokenBucket(rate=2.0, burst=3, now=clock)
+    assert [bucket.take() for _ in range(3)] == [True, True, True]
+    assert bucket.take() is False
+    clock.advance(0.5)  # 1 token back at 2/s
+    assert bucket.take() is True
+    assert bucket.take() is False
+    clock.advance(10.0)  # refill caps at burst
+    assert [bucket.take() for _ in range(3)] == [True, True, True]
+    assert bucket.take() is False
+
+
+def test_token_bucket_rejects_nonpositive_config():
+    with pytest.raises(ValueError):
+        TokenBucket(rate=0, burst=1)
+    with pytest.raises(ValueError):
+        TokenBucket(rate=1, burst=0)
+
+
+# ------------------------------------------------- breach → action → audit
+
+def test_breach_turns_pacer_and_admission_knobs():
+    eng, _ = _engine()
+    eng.on_transition(_breach())
+    # queue-only: nothing acted yet
+    assert eng.pacer.floor is None
+    assert eng.counters["transitions_total"] == 1
+    report = eng.tick()
+    assert report["processed"] == 1
+    assert report["actions"] == 2
+    assert eng.pacer.floor == pytest.approx(eng.pacer_floor_s)
+    assert eng.admit() is None  # within burst
+    snap = eng.snapshot()
+    assert snap["actions_total"] == 2
+    assert {a["action"] for a in snap["active_actions"]} == \
+        {"pacer_backoff", "admission_throttle"}
+    assert all(a["trace_id"] == TID for a in snap["active_actions"])
+    assert snap["last_trace_ids"]["pacer_backoff"] == TID
+    audit = eng.debug()["audit"]
+    assert [e["status"] for e in audit] == ["applied", "applied"]
+    assert all(e["slo"] == "attach-p99" for e in audit)
+
+
+def test_action_span_links_breach_exemplar_trace():
+    eng, _ = _engine()
+    eng.on_transition(_breach())
+    eng.tick()
+    # the linked ROOT span adopts the breach trace id — ONE
+    # /debug/fleet/trace?trace=<exemplar> query shows the whole chain
+    spans = trace.snapshot(op="remediation.action", trace=TID)
+    assert len(spans) == 2
+    assert {s["attrs"]["action"] for s in spans} == \
+        {"pacer_backoff", "admission_throttle"}
+    assert all(s["trace_id"] == TID for s in spans)
+
+
+def test_admission_shed_is_typed_and_counted():
+    eng, _ = _engine(shed_burst=2, shed_rate=1.0)
+    assert eng.admit() is None  # no throttle armed: lock-free pass
+    eng.on_transition(_breach())
+    eng.tick()
+    assert eng.admit() is None
+    assert eng.admit() is None
+    reason = eng.admit()  # burst of 2 exhausted, clock frozen
+    assert reason is not None
+    assert "attach-p99" in reason and TID in reason
+    assert eng.counters["sheds_total"] == 1
+
+
+def test_kubeapi_histogram_gets_pacer_only():
+    eng, _ = _engine()
+    eng.on_transition(_breach(slo="kubeapi-rtt",
+                              histogram="tdp_kubeapi_rtt_ms"))
+    eng.tick()
+    assert eng.pacer.floor is not None
+    assert eng._shed_bucket is None
+    assert eng.admit() is None
+
+
+def test_unknown_histogram_defaults_to_admission_throttle_only():
+    eng, _ = _engine()
+    eng.on_transition(_breach(slo="custom", histogram="tdp_custom_ms"))
+    eng.tick()
+    assert eng.pacer.floor is None
+    assert eng._shed_bucket is not None
+
+
+# ------------------------------------------------------------- hysteresis
+
+def test_cooldown_skips_are_counted_and_audited():
+    eng, clock = _engine(cooldown_s=30.0)
+    eng.on_transition(_breach())
+    eng.tick()
+    applied = eng.counters["actions_total"]
+    clock.advance(5.0)  # inside cool-down
+    eng.on_transition(_breach(tid=TID2))
+    eng.tick()
+    assert eng.counters["actions_total"] == applied
+    assert eng.counters["cooldown_skips_total"] == 2
+    assert any(e["status"] == "skipped_cooldown"
+               for e in eng.debug()["audit"])
+    snap = eng.snapshot()
+    assert snap["cooldowns"]  # live countdowns surfaced
+
+
+def test_action_window_budget_blocks_storms():
+    eng, clock = _engine(cooldown_s=0.0, max_actions_per_window=3,
+                         action_window_s=300.0)
+    for i in range(4):
+        eng.on_transition(_breach(slo=f"slo-{i}",
+                                  histogram=f"tdp_h{i}_ms"))
+        eng.tick()
+        clock.advance(1.0)
+    # 4 distinct SLOs each want the admission knob; budget caps at 3
+    assert eng.counters["actions_total"] == 3
+    assert eng.counters["window_skips_total"] == 1
+    clock.advance(400.0)  # window slides: budget refills
+    eng.on_transition(_breach(slo="slo-9", histogram="tdp_h9_ms"))
+    eng.tick()
+    assert eng.counters["actions_total"] == 4
+
+
+def test_no_flapping_under_oscillating_transitions():
+    """The engine-side half of the no-flap guarantee (the SLO latch is
+    the other half, tests/test_slo.py): repeated breach events inside
+    the cool-down re-turn nothing, and only a latched recovery rolls
+    back — counters stay at one apply / one rollback per incident."""
+    eng, clock = _engine(cooldown_s=60.0)
+    for _ in range(5):
+        eng.on_transition(_breach())
+        eng.tick()
+        clock.advance(5.0)
+    assert eng.counters["actions_total"] == 2
+    assert eng.counters["rollbacks_total"] == 0
+    eng.on_transition(_recovered())
+    eng.tick()
+    assert eng.counters["rollbacks_total"] == 2
+    assert eng.snapshot()["active_actions"] == []
+    assert eng.pacer.cleared == 1
+
+
+# ------------------------------------------------------------ policy gate
+
+def test_policy_veto_is_counted_and_knob_untouched():
+    policy = PolicyEngine()
+    policy.load_source("ops", (
+        "def remediate(ctx):\n"
+        "    if ctx['action'] == 'pacer_backoff':\n"
+        "        return 'pacer is being babysat manually'\n"
+        "    return None\n"))
+    eng, _ = _engine(policy=policy)
+    eng.on_transition(_breach())
+    eng.tick()
+    assert eng.pacer.floor is None  # vetoed knob untouched
+    assert eng._shed_bucket is not None  # approved knob applied
+    assert eng.counters["vetoes_total"] == 1
+    assert eng.counters["actions_total"] == 1
+    vetoed = [e for e in eng.debug()["audit"] if e["status"] == "vetoed"]
+    assert len(vetoed) == 1
+    assert vetoed[0]["detail"] == "pacer is being babysat manually"
+
+
+def test_policy_approval_passes_action_context():
+    seen = []
+    policy = PolicyEngine()
+    policy.load_source("ops", "def remediate(ctx):\n    return None\n")
+    # observe through the policy decision log instead of the sandbox
+    eng, _ = _engine(policy=policy)
+    eng.on_transition(_breach())
+    eng.tick()
+    del seen
+    assert eng.counters["vetoes_total"] == 0
+    assert eng.counters["actions_total"] == 2
+    snap = policy.snapshot()
+    remediate = [h for h in snap["hooks"]
+                 if h["hook"] == "remediate"]
+    assert remediate and remediate[0]["calls"] == 2
+
+
+# ---------------------------------------------------- rollback semantics
+
+def test_rollback_waits_for_last_holding_slo():
+    eng, clock = _engine(cooldown_s=0.0)
+    eng.on_transition(_breach(slo="attach-p99"))
+    eng.tick()
+    clock.advance(1.0)
+    eng.on_transition(_breach(slo="prepare-p99",
+                              histogram="tdp_prepare_wall_ms", tid=TID2))
+    eng.tick()
+    # both SLOs hold both knobs
+    snap = eng.snapshot()
+    holders = {a["action"]: a["slos"] for a in snap["active_actions"]}
+    assert holders["admission_throttle"] == ["attach-p99", "prepare-p99"]
+    eng.on_transition(_recovered(slo="attach-p99"))
+    eng.tick()
+    assert eng.counters["rollbacks_total"] == 0  # prepare still burning
+    assert eng.pacer.floor is not None
+    eng.on_transition(_recovered(slo="prepare-p99",
+                                 histogram="tdp_prepare_wall_ms"))
+    eng.tick()
+    assert eng.counters["rollbacks_total"] == 2
+    assert eng.pacer.floor is None
+    assert eng.admit() is None  # throttle cleared
+
+
+def test_rollback_span_links_original_breach_trace():
+    eng, _ = _engine(cooldown_s=0.0)
+    eng.on_transition(_breach())
+    eng.tick()
+    eng.on_transition(_recovered())
+    eng.tick()
+    spans = trace.snapshot(op="remediation.rollback", trace=TID)
+    # recovery events carry no exemplar — the rollback span links the
+    # ORIGINAL breach trace id kept on the active-knob entry
+    assert len(spans) == 2
+    assert all(s["trace_id"] == TID for s in spans)
+
+
+def test_recovery_without_active_actions_is_noop():
+    eng, _ = _engine()
+    eng.on_transition(_recovered())
+    report = eng.tick()
+    assert report["rollbacks"] == 0
+    assert eng.counters["rollbacks_total"] == 0
+
+
+# ----------------------------------------------- exemplar → node → bias
+
+def test_node_attribution_biases_and_drains_repeat_offender():
+    flight = FakeFlight(nodes=["scheduler", "node-3"])
+    eng, clock = _engine(fleet_flight=flight, cooldown_s=0.0,
+                         node_hits_threshold=2)
+    eng.on_transition(_breach())
+    eng.tick()
+    assert eng.scheduler.biased == []  # one hit: below threshold
+    clock.advance(1.0)
+    eng.on_transition(_breach(tid=TID2))
+    eng.tick()
+    assert eng.scheduler.biased == [("node-3", "slo=attach-p99")]
+    assert eng.scheduler.drains == ["node-3"]
+    assert len(eng.scheduler.waves) == 1  # drain fed the handoff path
+    assert eng.snapshot()["node_hits"] == {"node-3": 2}
+    active = {a["action"]: a for a in eng.snapshot()["active_actions"]}
+    assert active["node_bias"]["target"] == "node-3"
+
+
+def test_node_bias_rolls_back_on_recovery():
+    flight = FakeFlight(nodes=["scheduler", "node-3"])
+    eng, clock = _engine(fleet_flight=flight, cooldown_s=0.0,
+                         node_hits_threshold=1)
+    eng.on_transition(_breach())
+    eng.tick()
+    assert eng.scheduler.biased
+    eng.on_transition(_recovered())
+    eng.tick()
+    assert eng.scheduler.cleared == ["node-3"]
+
+
+def test_scheduler_only_attribution_never_biases():
+    # control-plane-only waterfall: no node label crosses threshold
+    flight = FakeFlight(nodes=["scheduler"])
+    eng, _ = _engine(fleet_flight=flight, node_hits_threshold=1)
+    eng.on_transition(_breach())
+    eng.tick()
+    assert eng.scheduler.biased == []
+
+
+# --------------------------------------------------- fragmentation burst
+
+def test_unplaceable_burst_triggers_defrag_wave():
+    eng, _ = _engine(unplaceable_burst=5, cooldown_s=0.0)
+    sched = eng.scheduler
+    eng.tick()  # establishes the baseline, no action
+    assert len(sched.waves) == 0
+    sched.stats["unplaceable_total"].value = 3
+    eng.tick()  # delta 3 < 5: below burst
+    assert len(sched.waves) == 0
+    sched.stats["unplaceable_total"].value = 20
+    report = eng.tick()  # delta 17 ≥ 5: wave
+    assert report["burst"] == 17
+    assert len(sched.waves) == 1
+    audit = [e for e in eng.debug()["audit"] if e["status"] == "applied"]
+    assert audit[-1]["slo"] == "unplaceable_burst"
+
+
+def test_defrag_wave_skips_when_already_placeable():
+    eng, _ = _engine(unplaceable_burst=1, cooldown_s=0.0)
+    sched = eng.scheduler
+    sched.proposal = {"placeable": True, "migrations": []}
+    eng.tick()
+    sched.stats["unplaceable_total"].value = 10
+    eng.tick()
+    assert sched.waves == []  # action ran, applied nothing
+    applied = [e for e in eng.debug()["audit"] if e["status"] == "applied"]
+    assert applied[-1]["detail"] == {"moves_applied": 0,
+                                    "reason": "already placeable"}
+
+
+# -------------------------------------------------- containment/surface
+
+def test_failing_knob_is_counted_not_raised():
+    class BrokenPacer(FakePacer):
+        def set_backoff_floor(self, floor_s):
+            raise RuntimeError("pacer wedged")
+
+    eng, _ = _engine(pacer=BrokenPacer())
+    eng.on_transition(_breach())
+    eng.tick()  # must not raise
+    assert eng.counters["errors_total"] == 1
+    assert eng.counters["actions_total"] == 1  # throttle still applied
+    errs = [e for e in eng.debug()["audit"] if e["status"] == "error"]
+    assert "pacer wedged" in errs[0]["detail"]
+
+
+def test_missing_components_skip_gracefully():
+    eng = RemediationEngine()  # nothing wired at all
+    eng.on_transition(_breach())
+    report = eng.tick()
+    # admission throttle needs no wiring; pacer action skipped silently
+    assert report["actions"] == 1
+    assert eng.counters["errors_total"] == 0
+
+
+def test_on_transition_is_safe_under_concurrent_ticks():
+    eng, _ = _engine(cooldown_s=0.0)
+
+    def pump():
+        for _ in range(200):
+            eng.on_transition(_breach())
+
+    threads = [threading.Thread(target=pump) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(5):
+        eng.tick()
+    for t in threads:
+        t.join()
+    eng.tick()
+    snap = eng.snapshot()
+    assert snap["transitions_total"] == 600
+    assert snap["ticks_total"] == 6
+    assert snap["pending_transitions"] == 0
+
+
+def test_background_thread_start_stop():
+    eng, _ = _engine()
+    eng.start(interval_s=0.01)
+    eng.start(interval_s=0.01)  # idempotent
+    eng.on_transition(_breach())
+    deadline = threading.Event()
+    for _ in range(200):
+        if eng.counters["actions_total"]:
+            break
+        deadline.wait(0.01)
+    eng.stop()
+    assert eng.counters["actions_total"] >= 1
+    assert eng._thread is None
+
+
+def test_render_prometheus_strict_families():
+    eng, _ = _engine()
+    eng.on_transition(_breach())
+    eng.tick()
+    lines = render_prometheus(eng)
+    text = "\n".join(lines)
+    assert "# HELP tpu_plugin_remediation_actions_total" in text
+    assert "# TYPE tpu_plugin_remediation_actions_total counter" in text
+    assert "tpu_plugin_remediation_actions_total 2" in text
+    assert "tpu_plugin_remediation_active_actions 2" in text
+    # strict shape: every sample line's family has HELP+TYPE above it
+    helped = {l.split()[2] for l in lines if l.startswith("# HELP")}
+    typed = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    sampled = {l.split()[0] for l in lines if not l.startswith("#")}
+    assert sampled <= helped == typed

@@ -140,4 +140,82 @@ def test_breaker_snapshot_shape():
     b.record_failure()
     snap = b.snapshot()
     assert snap == {"state": "closed", "consecutive_failures": 1,
-                    "trips": 0, "rejected": 0}
+                    "trips": 0, "rejected": 0, "half_open_rejected": 0}
+
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """Regression (ISSUE 16 satellite): N threads racing the half-open
+    transition must yield exactly ONE executed probe — the losers fail
+    fast as open and are counted — and a STALE result from a caller
+    admitted before the trip must not resolve the probe window."""
+    import threading
+
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open"
+    clock.advance(5.0)                     # cooldown elapsed: probe window
+
+    n = 8
+    admitted = []
+    barrier = threading.Barrier(n)
+
+    def racer(i):
+        barrier.wait()
+        if b.allow():
+            admitted.append(i)
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(admitted) == 1, admitted    # exactly one probe executed
+    assert b.state == "half-open"
+    assert b.half_open_rejected == n - 1   # losers counted, typed
+    assert b.rejected >= n - 1
+
+    # a stale success from THIS thread (not the probe owner) must not
+    # close the circuit under the probe's feet
+    b.record_success()
+    assert b.state == "half-open"
+    # nor may a stale failure re-trip it and restart the cooldown
+    trips_before = b.trips
+    b.record_failure()
+    assert b.state == "half-open"
+    assert b.trips == trips_before
+
+
+def test_breaker_probe_owner_resolves_window_cross_thread():
+    """The probe handed to thread T is resolved only by T: T's success
+    closes the circuit even while stale results from other threads are
+    being discarded."""
+    import threading
+
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0, clock=clock)
+    b.record_failure()
+    clock.advance(5.0)
+
+    outcome = {}
+
+    def probe():
+        outcome["admitted"] = b.allow()
+        # a stale success from the main thread lands mid-probe …
+        ready.set()
+        stale_done.wait(timeout=5)
+        # … then the probe's own success closes the circuit
+        b.record_success()
+
+    ready = threading.Event()
+    stale_done = threading.Event()
+    t = threading.Thread(target=probe)
+    t.start()
+    ready.wait(timeout=5)
+    assert b.state == "half-open"
+    b.record_success()                     # stale: discarded
+    assert b.state == "half-open"
+    stale_done.set()
+    t.join(timeout=5)
+    assert outcome["admitted"]
+    assert b.state == "closed"

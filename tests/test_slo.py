@@ -112,19 +112,123 @@ def test_burn_rates_windows_and_breach_latch_with_synthetic_clock():
     # re-evaluating while burning does NOT re-count (latched)
     eng.evaluate()
     assert eng.snapshot()["breaches_total"] == 1
-    # cool: the fast window passes with good traffic only -> unlatch
+    # a fast-window dip alone does NOT unlatch: the slow window still
+    # carries the incident (recovery latches via the slow window only)
     clock[0] += 120
     for _ in range(500):
         hist.observe(1.0)
     eng.evaluate()
     clock[0] += 59
     st = eng.evaluate()["att"]
-    assert st["burn_rate_fast"] < 14.4 and st["breached"] is False
+    assert st["burn_rate_fast"] < 14.4
+    assert st["burn_rate_slow"] >= 6.0
+    assert st["breached"] is True            # still latched
+    assert eng.snapshot()["recoveries_total"] == 0
+    # cool: once the incident leaves the SLOW window too -> recovery
+    clock[0] += 200
+    st = eng.evaluate()["att"]
+    assert st["burn_rate_slow"] < 6.0 and st["breached"] is False
+    assert eng.snapshot()["recoveries_total"] == 1
+    evs = trace.snapshot(op="slo.recovered")
+    assert evs and evs[0]["attrs"]["slo"] == "att"
     # a SECOND incident counts a second breach
     for _ in range(200):
         hist.observe(500.0)
     st = eng.evaluate()["att"]
     assert st["breached"] and eng.snapshot()["breaches_total"] == 2
+
+
+def test_restart_mid_breach_does_not_relatch_from_half_empty_window():
+    """Satellite (ISSUE 16): a daemon restart mid-breach hands a FRESH
+    engine a histogram carrying lifetime bad counts. The young engine
+    must not instantly re-latch from that half-empty window — burn is
+    computed from post-restart deltas only, and window_actual reports
+    the engine's real (short) coverage. A truly continuing incident
+    (new bad deltas) still latches."""
+    clock = [1000.0]
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for _ in range(100):
+        hist.observe(1.0)
+    for _ in range(100):
+        hist.observe(500.0)        # the pre-restart incident: 50% bad
+    eng = _engine(clock)           # "restarted": empty sample ring
+    st = eng.evaluate()["att"]
+    assert st["breached"] is False
+    assert st["window_fast_actual_s"] == 0.0   # honest: no history yet
+    clock[0] += 5
+    st = eng.evaluate()["att"]
+    # no post-restart traffic: the lifetime bad counts are NOT burn
+    assert st["burn_rate_fast"] == 0.0 and st["burn_rate_slow"] == 0.0
+    assert st["breached"] is False
+    assert st["window_fast_actual_s"] == pytest.approx(5.0)
+    assert eng.snapshot()["breaches_total"] == 0
+    # the incident actually continuing (fresh bad deltas) re-latches
+    for _ in range(50):
+        hist.observe(500.0)
+    clock[0] += 5
+    st = eng.evaluate()["att"]
+    assert st["breached"] is True
+
+
+def test_latch_does_not_flap_under_oscillating_fault():
+    """Hysteresis (ISSUE 16 acceptance): a fault oscillating at the
+    fast-window cadence latches ONE breach and holds it — the slow
+    window rides through the quiet half-periods, so breaches_total
+    counts incidents, not oscillations."""
+    clock = [1000.0]
+    eng = _engine(clock)           # fast 60s / slow 300s
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for _ in range(100):
+        hist.observe(1.0)
+    eng.evaluate()
+    # 6 half-periods of 45s: bad burst, quiet, bad burst, quiet ...
+    for period in range(6):
+        clock[0] += 45
+        if period % 2 == 0:
+            for _ in range(30):
+                hist.observe(500.0)
+        else:
+            for _ in range(30):
+                hist.observe(1.0)
+        st = eng.evaluate()["att"]
+        if period >= 1:
+            assert st["breached"] is True, period   # held, no flap
+    snap = eng.snapshot()
+    assert snap["breaches_total"] == 1
+    assert snap["recoveries_total"] == 0
+
+
+def test_subscribers_fire_on_breach_and_recovery_transitions():
+    """subscribe(): one callback per latched transition, carrying the
+    exemplar — the seam remediation.py rides."""
+    clock = [1000.0]
+    eng = _engine(clock)
+    events = []
+    eng.subscribe(lambda e: events.append(e))
+    eng.subscribe(lambda e: (_ for _ in ()).throw(RuntimeError("bad")))
+    hist = trace.histogram("tdp_attach_wall_ms")
+    for _ in range(100):
+        hist.observe(1.0)
+    eng.evaluate()
+    clock[0] += 30
+    for _ in range(50):
+        hist.observe(500.0, exemplar="cd" * 16)
+    eng.evaluate()                 # breach (raising subscriber contained)
+    assert [e["kind"] for e in events] == ["breach"]
+    assert events[0]["slo"] == "att"
+    assert events[0]["exemplar"]["trace_id"] == "cd" * 16
+    # steady-state burning: no repeat events (latched)
+    clock[0] += 10
+    eng.evaluate()
+    assert len(events) == 1
+    # recovery: one "recovered" event once the slow window cools
+    clock[0] += 120
+    for _ in range(500):
+        hist.observe(1.0)
+    eng.evaluate()
+    clock[0] += 400
+    eng.evaluate()
+    assert [e["kind"] for e in events] == ["breach", "recovered"]
 
 
 def test_short_lived_engine_reports_actual_window_honestly():

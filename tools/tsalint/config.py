@@ -201,6 +201,7 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     "resilience.CircuitBreaker": {
         "trips": "resilience.CircuitBreaker._lock",
         "rejected": "resilience.CircuitBreaker._lock",
+        "half_open_rejected": "resilience.CircuitBreaker._lock",
         "_consecutive_failures": "resilience.CircuitBreaker._lock",
     },
     "discovery.HostSnapshot": {
@@ -226,6 +227,14 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     # via a C-atomic dict copy
     "slo.SLOEngine": {
         "counters[*]": "slo.SLOEngine._lock",
+    },
+    # remediation engine (round 18): action/rollback/veto/shed counters
+    # mutate under the engine's own plain lock — deliberately
+    # UNregistered like the SLO engine's (on_transition fires on the
+    # zero-lock-gated /status scrape thread); snapshot() reads a
+    # C-atomic dict copy
+    "remediation.RemediationEngine": {
+        "counters[*]": "remediation.RemediationEngine._lock",
     },
 }
 

@@ -105,6 +105,16 @@ class AutopilotConfig:
     watch_faults: bool = True        # kubeapi.watch* fault sites
     watch_fault_p: float = 0.02
     shapes: tuple = ("1x2", "2x2")   # multiclaim shapes
+    # self-heal drill (ISSUE 16): after the storm quiesces, a RAMPED
+    # delay fault burns a publish-RTT SLO against one victim node; the
+    # report's selfheal_story must show the whole closed loop — breach
+    # latches, the remediation engine acts (policy-approved, audited),
+    # good traffic recovers the burn, the knobs roll back — all
+    # reconstructed from ONE fleet-trace query on the breach exemplar
+    selfheal: bool = False
+    selfheal_fault_delay_s: float = 0.4
+    selfheal_fault_jitter_s: float = 0.05
+    selfheal_fault_ramp_s: float = 2.0
 
 
 class FleetAutopilot:
@@ -494,6 +504,143 @@ class FleetAutopilot:
             "ops": waterfall["ops"],
         }
 
+    def _selfheal_drill(self):
+        """The ISSUE 16 closed loop, end-to-end against the quiesced
+        fleet: a RAMPED delay fault on the victim's API path burns a
+        publish-RTT SLO → the breach latches with an exemplar → the
+        remediation engine (policy-gated) backs the victim's pacer off,
+        sheds admission, and — the exemplar attributing to the victim —
+        biases placement away from it → good traffic dilutes the burn
+        below target → the latched recovery rolls every knob back.
+        Returns the story dict; missing links go to self.violations."""
+        from . import slo
+        from .policy import PolicyEngine
+        from .remediation import RemediationEngine
+        cfg = self.cfg
+        victim = self.sim.nodes[0]
+        flight = self.sim.fleet_flight()
+        scheduler = self.sim.scheduler(watch=False)
+        engine = slo.SLOEngine([slo.Objective(
+            "publish_rtt", "tdp_kubeapi_rtt_ms", threshold_ms=100.0,
+            target=0.99, fast_window_s=60.0, slow_window_s=300.0)])
+        policy = PolicyEngine()
+        # an operator hook that APPROVES but proves the gate ran (its
+        # call counter lands in the story)
+        policy.load_source("selfheal_ops",
+                           "def remediate(ctx):\n    return None\n")
+        rem = RemediationEngine(
+            pacer=victim.driver.pacer, scheduler=scheduler,
+            policy=policy, fleet_flight=flight,
+            cooldown_s=0.5, node_hits_threshold=1)
+        engine.subscribe(rem.on_transition)
+        story = {"victim": victim.name}
+        # quiesce the watch plane first: its steady drip of good-RTT
+        # relists would eat the count-limited fault fires AND dilute
+        # the fast window before the breach can latch (parallel stops —
+        # a serial march of reflector joins is minutes at 256 nodes)
+        from concurrent import futures as _futures
+        with _futures.ThreadPoolExecutor(
+                max_workers=min(32, len(self.sim.nodes)),
+                thread_name_prefix="selfheal-quiesce") as pool:
+            list(pool.map(
+                lambda n: n.driver.stop_watch_reconciler(),
+                self.sim.nodes))
+
+        def bad(msg):
+            with self._lock:
+                self.violations.append(f"selfheal: {msg}")
+
+        victim.driver.publish_resource_slices()     # good baseline RTTs
+        engine.evaluate()
+        faults.arm("kubeapi.request", kind="delay", count=8,
+                   delay_s=cfg.selfheal_fault_delay_s,
+                   jitter_s=cfg.selfheal_fault_jitter_s,
+                   ramp_s=cfg.selfheal_fault_ramp_s)
+        try:
+            # spread the bad publishes over the ramp: early fires sleep
+            # a sub-threshold sliver, late ones the full delay — the
+            # burn RISES instead of stepping
+            for _ in range(6):
+                victim.driver.publish_resource_slices()
+                time.sleep(cfg.selfheal_fault_ramp_s / 5)
+        finally:
+            faults.disarm("kubeapi.request")
+        time.sleep(1.1)                     # past the engine sample gap
+        rec = engine.evaluate()["publish_rtt"]
+        story["burn_at_breach"] = rec["burn_rate_fast"]
+        story["breached"] = rec["breached"]
+        tid = (rec.get("exemplar") or {}).get("trace_id")
+        story["trace_id"] = tid
+        story["endpoint"] = f"/debug/fleet/trace?trace={tid}"
+        if not rec["breached"] or not tid:
+            bad(f"breach did not latch (burn={rec['burn_rate_fast']}, "
+                f"exemplar={tid})")
+            return story
+        tick = rem.tick()
+        story["actions"] = tick["actions"]
+        snap = rem.snapshot()
+        story["active_actions"] = [
+            {"action": a["action"], "target": a["target"]}
+            for a in snap["active_actions"]]
+        story["policy_remediate_calls"] = sum(
+            h["calls"] for h in policy.snapshot()["hooks"]
+            if h["hook"] == "remediate")
+        if tick["actions"] == 0:
+            bad("breach latched but no remediation action applied")
+        if victim.driver.pacer.snapshot().get("backoff_floor_ms", 0) <= 0:
+            bad("pacer backoff floor not set on the victim")
+        if victim.name not in scheduler.biased_nodes():
+            bad(f"victim {victim.name} not placement-biased "
+                f"(attribution failed; nodes seen: {snap['node_hits']})")
+        # recovery by dilution: enough good publishes shrink the windows'
+        # error rate below target — the latched recovery needs the SLOW
+        # burn under its threshold, not the incident to slide out
+        for _ in range(40):
+            victim.driver.publish_resource_slices()
+        time.sleep(1.1)
+        deadline = time.monotonic() + 30.0
+        while engine.snapshot()["recoveries_total"] == 0 \
+                and time.monotonic() < deadline:
+            for _ in range(20):
+                victim.driver.publish_resource_slices()
+            time.sleep(1.1)
+            engine.evaluate()
+        rec = engine.evaluate()["publish_rtt"]
+        story["burn_at_recovery"] = rec["burn_rate_fast"]
+        story["recovered"] = not rec["breached"]
+        if rec["breached"]:
+            bad(f"burn did not recover (fast={rec['burn_rate_fast']}, "
+                f"slow={rec['burn_rate_slow']})")
+            return story
+        tick = rem.tick()
+        story["rollbacks"] = tick["rollbacks"]
+        if tick["rollbacks"] == 0:
+            bad("recovery latched but no knob rolled back")
+        if victim.driver.pacer.snapshot().get("backoff_floor_ms", 0) != 0:
+            bad("pacer backoff floor still set after rollback")
+        if victim.name in scheduler.biased_nodes():
+            bad("victim still placement-biased after rollback")
+        story["counters"] = {
+            k: v for k, v in rem.snapshot().items()
+            if isinstance(v, int) and k.endswith("_total")}
+        # THE acceptance gate: one fleet-trace query on the breach
+        # exemplar replays the whole loop — the slow publish on the
+        # victim, the remediation actions, the rollbacks
+        waterfall = flight.trace(tid)
+        story["nodes"] = waterfall["nodes"]
+        story["ops"] = waterfall["ops"]
+        story["spans"] = len(waterfall["spans"])
+        for op, what in (("kubeapi.request", "the slow request"),
+                         ("remediation.action", "the corrective action"),
+                         ("remediation.rollback", "the rollback")):
+            if op not in waterfall["ops"]:
+                bad(f"one-query waterfall missing {what} ({op}); "
+                    f"has {waterfall['ops']}")
+        if victim.name not in waterfall["nodes"]:
+            bad(f"one-query waterfall not attributed to the victim; "
+                f"nodes={waterfall['nodes']}")
+        return story
+
     def _migration_recover(self, src, uid: str, mig: dict) -> bool:
         self.sim.apiserver.add_claim(
             "fleet", uid, uid, src.driver.driver_name,
@@ -683,6 +830,12 @@ class FleetAutopilot:
             self.violations.append(
                 f"{final['orphaned_claims']} orphaned claims left after "
                 f"quiesce (expected 0)")
+        # self-heal drill (ISSUE 16): runs against the quiesced fleet so
+        # the injected latency burns ONLY the drill's SLO, never the
+        # storm's convergence checks above
+        selfheal_story = None
+        if cfg.selfheal:
+            selfheal_story = self._selfheal_drill()
         wall_s = time.monotonic() - t0
         report = {
             "config": {
@@ -694,6 +847,7 @@ class FleetAutopilot:
                 "watch": cfg.watch,
                 "watch_chaos": cfg.watch_chaos,
                 "watch_faults": cfg.watch_faults,
+                "selfheal": cfg.selfheal,
             },
             "wall_s": round(wall_s, 1),
             "boot_published_ok": boot["published_ok"],
@@ -714,6 +868,7 @@ class FleetAutopilot:
             "faults_fired": {site: n for site, n in faults.stats().items()
                              if site.startswith("kubeapi.watch")},
             "claim_story": self._story,
+            "selfheal_story": selfheal_story,
         }
         if raise_on_violation and not report["ok"]:
             raise AssertionError(

@@ -235,6 +235,14 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                              " docs/observability.md 'SLO objective "
                              "config') — malformed config fails boot "
                              "loudly, it never silently monitors nothing")
+    parser.add_argument("--no-remediation", action="store_true",
+                        help="disable the SLO-closed-loop remediation "
+                             "engine (remediation.py): with it on (the "
+                             "default), a latched SLO breach backs the "
+                             "publish pacer off and sheds admission above "
+                             "a token rate — every action policy-gated "
+                             "(remediate hook), audited, trace-linked, "
+                             "and rolled back on recovery")
     parser.add_argument("--discover-only", action="store_true",
                         help="run discovery once, print the inventory as "
                              "JSON, and exit (ops/debug; no kubelet contact)")
@@ -540,6 +548,16 @@ def main(argv=None) -> int:
                               label_prefix=cfg.resource_namespace)
         inventory_sinks.append(lambda reg, gens: labeler.publish(
             node_facts(cfg, reg, gens)))
+    # SLO-closed-loop remediation (remediation.py): subscribes to the
+    # engine above; breach → pacer backoff + typed admission shed,
+    # recovery → rollback. Every action runs the policy remediate gate.
+    # Off with --no-remediation; without a DRA driver the pacer knob is
+    # simply absent and only the admission throttle can arm.
+    remediation_engine = None
+    if not args.no_remediation:
+        from .remediation import RemediationEngine
+        remediation_engine = RemediationEngine(policy=policy_engine)
+        slo.get_engine().subscribe(remediation_engine.on_transition)
     dra_driver = None
     health_listener = None
     if args.dra:
@@ -549,7 +567,13 @@ def main(argv=None) -> int:
         server_url = args.api_server or in_cluster_server()
         api = ApiClient(server_url) if server_url else None
         dra_driver = DraDriver(cfg, Registry(), {}, node_name=args.node_name,
-                               api=api, policy=policy_engine)
+                               api=api, policy=policy_engine,
+                               remediation=remediation_engine)
+        if remediation_engine is not None:
+            # the knob the self-heal plane turns on a burning publish/
+            # attach SLO — wired here because the pacer is born with the
+            # driver, after the engine
+            remediation_engine.pacer = dra_driver.pacer
 
         def dra_sink(reg, gens, _d=dra_driver):
             _d.set_inventory(reg, gens)
@@ -575,7 +599,8 @@ def main(argv=None) -> int:
             return ok
     manager = PluginManager(cfg, on_inventory=on_inventory,
                             health_listener=health_listener,
-                            policy_engine=policy_engine)
+                            policy_engine=policy_engine,
+                            remediation_engine=remediation_engine)
     if dra_driver is not None:
         # the DRA driver rides the manager's shared health plane for its
         # registration-socket watch (kubelet-restart recovery) — same hub,
@@ -618,9 +643,15 @@ def main(argv=None) -> int:
         status = StatusServer(manager, args.status_port, host=args.status_host,
                               dra_driver=dra_driver)
         status.start()
+    if remediation_engine is not None:
+        # background tick: queued SLO transitions become knob turns off
+        # the scrape thread (the subscriber callback only queues)
+        remediation_engine.start()
     try:
         manager.run(stop)
     finally:
+        if remediation_engine is not None:
+            remediation_engine.stop()
         if dra_driver is not None:
             dra_driver.stop()
         if status is not None:

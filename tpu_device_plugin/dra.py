@@ -208,6 +208,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         api: Optional[ApiClient] = None,
         driver_name: Optional[str] = None,
         policy=None,
+        remediation=None,
     ) -> None:
         self.cfg = cfg
         self.node_name = node_name or os.environ.get("NODE_NAME") or "node"
@@ -216,6 +217,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         # admit hook per claim (a rejection is that claim's typed error,
         # never the RPC's); None costs one attribute check
         self._policy = policy
+        # Optional remediation.RemediationEngine: its admission throttle
+        # (armed only while an SLO burns) sheds prepares above the token
+        # rate with a typed per-claim error — same retry contract as a
+        # policy rejection; None costs one attribute check
+        self._remediation = remediation
         self.driver_name = driver_name or cfg.resource_namespace
         self._driver_fs = sanitize_name(self.driver_name).lower().replace(
             "_", "-")
@@ -871,6 +877,15 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                  poll_interval_s)
         return True
 
+    def stop_watch_reconciler(self) -> None:
+        """Tear down the slice watch; publish reverts to its liveness
+        GET. Idempotent. The autopilot self-heal drill quiesces the
+        watch plane through this so a count-limited injected fault
+        lands on the victim's publishes instead of stream churn."""
+        watch, self._slice_watch = self._slice_watch, None
+        if watch is not None:
+            watch.stop()
+
     def _watch_live(self) -> bool:
         """The watch plane currently covers wipe detection (lock-free)."""
         ref = self._slice_watch
@@ -1355,7 +1370,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         return self._watch_deferred_ack != self._watch_deferred_seq
 
     def _paced_publish(self) -> bool:
-        with self._publish_lock:
+        # node= rides the publish root span like the prepare RPC root:
+        # the kubeapi.request children share its trace, so a slow
+        # publish's SLO exemplar attributes to THIS node on the fleet
+        # waterfall (remediation.py biases repeat offenders by exactly
+        # that label)
+        with trace.span("dra.publish", node=self.node_name), \
+                self._publish_lock:
             seq0 = self._watch_deferred_seq
             ok = self._publish_locked()
             if ok:
@@ -2076,6 +2097,19 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 raise AllocationError(
                     f"policy rejected claim {claim.namespace}/{claim.name}:"
                     f" {reason}")
+        # Remediation admission throttle (remediation.py): same
+        # before-any-state placement and the same typed-error retry
+        # contract — a shed prepare is THIS claim's error, counted by
+        # the engine, and the kubelet's retry lands once the SLO
+        # recovers (or a token frees up).
+        remediation = self._remediation
+        if remediation is not None:
+            shed = remediation.admit({
+                "op": "prepare", "claim_uid": claim.uid,
+                "namespace": claim.namespace, "name": claim.name})
+            if shed is not None:
+                raise AllocationError(
+                    f"claim {claim.namespace}/{claim.name} shed: {shed}")
         # Caller holds the per-claim-UID lock, so a concurrent retry of the
         # SAME claim waits here while distinct claims run fully parallel.
         # The API-server round-trip and device planning (sysfs reads,

@@ -75,20 +75,26 @@ or via environment (read by cli.main at startup):
     TDP_FAULTS='kubelet.register:error:count=3,kubeapi.request:timeout:p=0.5'
     TDP_FAULTS_SEED=1337
 
-Spec grammar: `site[:kind][:count=N][:p=F][:delay=S]` joined by commas.
-`kind` is one of error (FaultInjected), timeout (TimeoutError), oserror
-(ConnectionResetError), drop/false (non-raising; `fire` returns True),
-or delay (LATENCY injection: `fire` sleeps `delay=S` seconds then
-returns False — the call proceeds, just slow; honored at EVERY site
-regardless of category because it neither raises nor alters the
-return — the SLO plane's burn-rate drills arm it on the attach path),
-defaulting to the site's natural kind (error for raising sites, drop
-for value sites). Each site honors only its own category — see
-`_SITE_CATEGORY` — and env specs reject unknown sites outright, so a
-typo'd schedule aborts the run instead of silently injecting nothing.
-`count` bounds how many times the fault fires (default unlimited);
-`p` is the per-call fire probability (default 1.0), drawn from the module
-RNG so a seeded run replays the same schedule.
+Spec grammar: `site[:kind][:count=N][:p=F][:delay=S][:jitter=J][:ramp=R]`
+joined by commas. `kind` is one of error (FaultInjected), timeout
+(TimeoutError), oserror (ConnectionResetError), drop/false (non-raising;
+`fire` returns True), or delay (LATENCY injection: `fire` sleeps
+`delay=S` seconds then returns False — the call proceeds, just slow;
+honored at EVERY site regardless of category because it neither raises
+nor alters the return — the SLO plane's burn-rate drills arm it on the
+attach path), defaulting to the site's natural kind (error for raising
+sites, drop for value sites). The delay kind takes two optional shaping
+knobs (docs/fault-injection.md "Latency shaping"): `jitter=J` spreads
+each sleep uniformly over [delay-J, delay+J] (clamped at 0, drawn from
+the module RNG so seeded schedules replay), and `ramp=R` scales the
+sleep linearly from 0 at arm time to full strength R seconds later — a
+soak can model gradual degradation instead of a step function. Each
+site honors only its own category — see `_SITE_CATEGORY` — and env
+specs reject unknown sites outright, so a typo'd schedule aborts the
+run instead of silently injecting nothing. `count` bounds how many
+times the fault fires (default unlimited); `p` is the per-call fire
+probability (default 1.0), drawn from the module RNG so a seeded run
+replays the same schedule.
 """
 
 from __future__ import annotations
@@ -154,18 +160,22 @@ _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 
 class _FaultPoint:
     __slots__ = ("kind", "remaining", "probability", "exc_factory",
-                 "fires", "delay_s")
+                 "fires", "delay_s", "jitter_s", "ramp_s", "armed_at")
 
     def __init__(self, kind: str, remaining: Optional[int],
                  probability: float,
                  exc_factory: Optional[Callable[[], BaseException]],
-                 delay_s: float = 0.0):
+                 delay_s: float = 0.0, jitter_s: float = 0.0,
+                 ramp_s: float = 0.0, armed_at: float = 0.0):
         self.kind = kind
         self.remaining = remaining    # None = unlimited
         self.probability = probability
         self.exc_factory = exc_factory
         self.fires = 0
         self.delay_s = delay_s        # kind="delay" only
+        self.jitter_s = jitter_s      # uniform spread around delay_s
+        self.ramp_s = ramp_s          # linear ramp-in from arm time
+        self.armed_at = armed_at      # ramp reference point
 
 
 _lock = lockdep.instrument("faults._lock", threading.Lock())
@@ -183,11 +193,15 @@ def seed(n: int) -> None:
 def arm(site: str, kind: str = "error", count: Optional[int] = 1,
         probability: float = 1.0,
         exc: Optional[Callable[[], BaseException]] = None,
-        delay_s: float = 0.0) -> None:
+        delay_s: float = 0.0, jitter_s: float = 0.0,
+        ramp_s: float = 0.0) -> None:
     """Arm `site`: the next `count` consultations fire (raise, return
     True, or sleep `delay_s` per kind) with the given probability. `exc`
     overrides the kind's exception factory (a zero-arg callable
-    returning the exception)."""
+    returning the exception). For kind='delay', `jitter_s` spreads each
+    sleep uniformly over [delay_s-jitter_s, delay_s+jitter_s] (clamped
+    at 0) and `ramp_s` scales the sleep linearly from 0 at arm time to
+    full strength `ramp_s` seconds later."""
     global _armed
     if exc is None and kind not in _RAISING_KINDS \
             and kind not in _VALUE_KINDS and kind != _DELAY_KIND:
@@ -196,6 +210,12 @@ def arm(site: str, kind: str = "error", count: Optional[int] = 1,
             f"{sorted(_RAISING_KINDS) + list(_VALUE_KINDS) + [_DELAY_KIND]})")
     if count is not None and count < 1:
         raise ValueError("count must be >= 1 (or None for unlimited)")
+    if jitter_s < 0 or ramp_s < 0:
+        raise ValueError("jitter_s and ramp_s must be >= 0")
+    if (jitter_s or ramp_s) and kind != _DELAY_KIND:
+        raise ValueError(
+            "jitter_s/ramp_s shape LATENCY only — they need kind='delay' "
+            f"(got kind={kind!r})")
     if kind == _DELAY_KIND and exc is None:
         if delay_s <= 0:
             raise ValueError("kind='delay' needs delay_s > 0")
@@ -215,11 +235,14 @@ def arm(site: str, kind: str = "error", count: Optional[int] = 1,
         factory = lambda: maker(site)  # noqa: E731 — site-bound closure
     with _lock:
         _points[site] = _FaultPoint(kind, count, probability, factory,
-                                    delay_s=delay_s)
+                                    delay_s=delay_s, jitter_s=jitter_s,
+                                    ramp_s=ramp_s,
+                                    armed_at=time.monotonic())
         _armed = True
-    log.warning("fault point ARMED: %s kind=%s count=%s p=%g delay=%gs",
+    log.warning("fault point ARMED: %s kind=%s count=%s p=%g delay=%gs "
+                "jitter=%gs ramp=%gs",
                 site, kind, count if count is not None else "inf",
-                probability, delay_s)
+                probability, delay_s, jitter_s, ramp_s)
 
 
 def disarm(site: Optional[str] = None) -> None:
@@ -267,6 +290,15 @@ def fire(site: str, **ctx: object) -> bool:
         factory = point.exc_factory
         kind = point.kind
         delay_s = point.delay_s
+        if kind == _DELAY_KIND:
+            # shape the sleep under the lock (the RNG draw must be
+            # serialized for seeded replay); the sleep itself stays out
+            if point.jitter_s > 0:
+                delay_s += _rng.uniform(-point.jitter_s, point.jitter_s)
+            if point.ramp_s > 0:
+                elapsed = time.monotonic() - point.armed_at
+                delay_s *= min(1.0, max(0.0, elapsed / point.ramp_s))
+            delay_s = max(0.0, delay_s)
     log.warning("fault point FIRED: %s%s", site,
                 f" ({ctx})" if ctx else "")
     # flight-recorder marker: an injected fault becomes a span event —
@@ -309,7 +341,8 @@ def armed_sites() -> Dict[str, Dict[str, object]]:
     budget) from two fields of one snapshot."""
     return {site: {"kind": p.kind, "remaining": p.remaining,
                    "probability": p.probability, "fires": p.fires,
-                   "delay_s": p.delay_s}
+                   "delay_s": p.delay_s, "jitter_s": p.jitter_s,
+                   "ramp_s": p.ramp_s}
             for site, p in list(_points.items())}
 
 
@@ -317,11 +350,12 @@ def armed_sites() -> Dict[str, Dict[str, object]]:
 def injected(site: str, kind: str = "error", count: Optional[int] = 1,
              probability: float = 1.0,
              exc: Optional[Callable[[], BaseException]] = None,
-             delay_s: float = 0.0) -> Iterator[None]:
+             delay_s: float = 0.0, jitter_s: float = 0.0,
+             ramp_s: float = 0.0) -> Iterator[None]:
     """Scope-bound arming for tests: disarms the site on exit even when
     the fault's budget was not exhausted."""
     arm(site, kind=kind, count=count, probability=probability, exc=exc,
-        delay_s=delay_s)
+        delay_s=delay_s, jitter_s=jitter_s, ramp_s=ramp_s)
     try:
         yield
     finally:
@@ -347,6 +381,8 @@ def configure(spec: str) -> None:
         count: Optional[int] = None
         probability = 1.0
         delay_s = 0.0
+        jitter_s = 0.0
+        ramp_s = 0.0
         for opt in fields[2:]:
             key, _, value = opt.partition("=")
             if key == "count":
@@ -355,10 +391,14 @@ def configure(spec: str) -> None:
                 probability = float(value)
             elif key == "delay":
                 delay_s = float(value)
+            elif key == "jitter":
+                jitter_s = float(value)
+            elif key == "ramp":
+                ramp_s = float(value)
             else:
                 raise ValueError(f"unknown fault option {opt!r} in {part!r}")
         arm(site, kind=kind, count=count, probability=probability,
-            delay_s=delay_s)
+            delay_s=delay_s, jitter_s=jitter_s, ramp_s=ramp_s)
 
 
 def configure_from_env(env: str = "TDP_FAULTS",

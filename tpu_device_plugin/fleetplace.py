@@ -851,7 +851,17 @@ class FleetScheduler:
         self.stats = {key: AtomicCounter() for key in (
             "decisions_total", "placed_total", "unplaceable_total",
             "rollbacks_total", "releases_total", "defrag_waves_total",
-            "defrag_moves_total", "selector_compile_errors_total")}
+            "defrag_moves_total", "selector_compile_errors_total",
+            "bias_applied_total", "bias_cleared_total",
+            "drains_planned_total")}
+        # remediation seam: nodes the self-heal plane is steering new
+        # placements away from (exemplar->node attribution pinned a
+        # host). Copy-on-write frozenset — the zero-lock decision read
+        # path reads the reference GIL-atomically; writes (rare, one
+        # per remediation action) serialize on _bias_lock.
+        self._avoid_nodes: frozenset = frozenset()
+        self._bias_lock = lockdep.instrument(
+            "fleetplace.FleetScheduler._bias_lock", threading.Lock())
 
     # ------------------------------------------------------- control
 
@@ -961,8 +971,16 @@ class FleetScheduler:
             views_by_gen, attrs_index = self.views_by_generation()
             filtered = self._filter_views(views_by_gen, attrs_index,
                                           compiled)
-            return [v for views in filtered.values()
-                    for v in views], compiled
+            avoid = self._avoid_nodes          # GIL-atomic ref read
+            out = []
+            for views in filtered.values():
+                for v in views:
+                    if v.free and v.node in avoid:
+                        # biased-away host: still occupancy (its claims
+                        # keep blocking boxes) but offers no capacity
+                        v = replace(v, free=frozenset())
+                    out.append(v)
+            return out, compiled
 
     # ---------------------------------------------------- decisions
 
@@ -1045,6 +1063,92 @@ class FleetScheduler:
             self._note("released", uid, None)
             self.stats["releases_total"].add()
         return True
+
+    # --------------------------------------- remediation seams (PR 16)
+
+    def bias_away(self, node: str, reason: str = "") -> bool:
+        """Steer NEW placements off `node`: its free chips stop being
+        offered while its existing claims keep participating as
+        occupancy. Idempotent; logged and counted. The remediation
+        engine applies this when exemplar->node attribution keeps
+        surfacing one host under a burning SLO, and clears it on
+        recovery (clear_bias)."""
+        with self._bias_lock:
+            if node in self._avoid_nodes:
+                return False
+            self._avoid_nodes = self._avoid_nodes | {node}
+        self.stats["bias_applied_total"].add()
+        self._note("bias_applied", node, {"reason": reason})
+        trace.event("fleetplace.bias_applied", node=node,
+                    reason=reason)
+        return True
+
+    def clear_bias(self, node: str) -> bool:
+        """Rollback of bias_away: the node offers capacity again."""
+        with self._bias_lock:
+            if node not in self._avoid_nodes:
+                return False
+            self._avoid_nodes = self._avoid_nodes - {node}
+        self.stats["bias_cleared_total"].add()
+        self._note("bias_cleared", node, None)
+        trace.event("fleetplace.bias_cleared", node=node)
+        return True
+
+    def biased_nodes(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._avoid_nodes))
+
+    def plan_drain(self, node: str,
+                   generation: Optional[str] = None) -> dict:
+        """Plan draining every scheduler-placed claim shard off `node`
+        through the SAME handoff path a defrag wave uses: the returned
+        proposal feeds apply_defrag_wave unchanged (unprepare → durable
+        handoff record → re-point fabric claim → import at the
+        destination, ledger re-pointed move-by-move).
+
+        Destinations are chosen most-free-first within the node's own
+        generation, capacity reserved move-by-move; a shard with no
+        destination is advised with target_node None (apply skips it —
+        a partial drain is honest, not silent)."""
+        views_by_gen, _ = self.views_by_generation()
+        if generation is None:
+            for gen, views in views_by_gen.items():
+                if any(v.node == node for v in views):
+                    generation = gen
+                    break
+        views = views_by_gen.get(generation) or []
+        source = next((v for v in views if v.node == node), None)
+        migrations: List[dict] = []
+        if source is not None:
+            targets = sorted(
+                (v for v in views
+                 if v.node != node and v.node not in self._avoid_nodes),
+                key=lambda v: (-len(v.free), v.node))
+            reserved: Dict[str, set] = {}
+            for uid in sorted(source.claims):
+                raws = sorted(source.claims[uid])
+                mig = {"claim": uid, "source_node": node,
+                       "devices": raws,
+                       "target_node": None, "target_devices": None}
+                for tv in targets:
+                    avail = sorted(tv.free - reserved.get(tv.node,
+                                                          set()))
+                    if len(avail) >= len(raws):
+                        picked = avail[:len(raws)]
+                        reserved.setdefault(tv.node,
+                                            set()).update(picked)
+                        mig["target_node"] = tv.node
+                        mig["target_devices"] = picked
+                        break
+                migrations.append(mig)
+        self.stats["drains_planned_total"].add()
+        resolved = sum(1 for m in migrations
+                       if m["target_node"] is not None)
+        self._note("drain_planned", node, {
+            "generation": generation, "moves": len(migrations),
+            "resolved": resolved})
+        return {"node": node, "generation": generation,
+                "migrations": migrations,
+                "moves": len(migrations), "resolved": resolved}
 
     # ------------------------------------------------- fragmentation
 
@@ -1177,7 +1281,8 @@ class FleetScheduler:
         entries = list(self._log)          # C-atomic copy
         by_uid: Dict[str, List[Tuple[str, object]]] = {}
         for kind, uid, detail in entries:
-            if kind in ("defrag_wave",):
+            if kind in ("defrag_wave", "bias_applied", "bias_cleared",
+                        "drain_planned"):
                 continue
             by_uid.setdefault(uid, []).append((kind, detail))
         duplicated: List[str] = []
@@ -1239,6 +1344,7 @@ class FleetScheduler:
         """Lock-free stats read: AtomicCounter sums + ledger/log sizes
         (GIL-atomic len reads)."""
         out = {key: counter.value for key, counter in self.stats.items()}
+        out["biased_nodes"] = list(self.biased_nodes())
         out["claims"] = len(self._claims)
         out["log_entries"] = len(self._log)
         out["selectors_compiled"] = len(self._selectors)

@@ -1012,6 +1012,10 @@ class PublishPacer:
         # publishing -> idle. All state below is guarded by _cond.
         self._state = "idle"
         self._window_s = self.base_window_s
+        # remediation knob (remediation.py): a floor the drawn window
+        # never goes below while a burning attach/prepare SLO has the
+        # self-heal plane shedding publish pressure. 0 = no floor.
+        self._floor_s = 0.0
         self._wave_seq = 0       # waves opened (leader entered waiting)
         self._done_seq = 0       # waves completed
         self._last_result = False
@@ -1031,8 +1035,25 @@ class PublishPacer:
         atomic int reads), plus the current admission window — the
         /status surface."""
         out = dict(self.stats)
-        out["window_ms"] = round(self._window_s * 1e3, 3)
+        out["window_ms"] = round(max(self._window_s, self._floor_s) * 1e3, 3)
+        out["backoff_floor_ms"] = round(self._floor_s * 1e3, 3)
         return out
+
+    def set_backoff_floor(self, floor_s: float) -> None:
+        """Remediation knob: pin the admission window at >= `floor_s`.
+
+        The AIMD machinery keeps adapting underneath (so organic
+        congestion can still grow the window PAST the floor); the floor
+        only stops fast successes from collapsing it while the SLO
+        plane is actively shedding. Idempotent; clamped to
+        [0, max_window_s]."""
+        with self._cond:
+            self._floor_s = min(self.max_window_s, max(0.0, floor_s))
+
+    def clear_backoff_floor(self) -> None:
+        """Rollback: drop the remediation floor; the window decays back
+        toward base through the normal fast-success path."""
+        self.set_backoff_floor(0.0)
 
     def _wave_start(self) -> None:
         if self.api is not None:
@@ -1103,7 +1124,7 @@ class PublishPacer:
             attempt = 0
             while True:
                 with cond:
-                    window = self._window_s
+                    window = max(self._window_s, self._floor_s)
                     # uniform over the FULL window: a fleet of pacers
                     # with the same window then spreads a simultaneous
                     # storm evenly across it (a [w/2, w] draw would

@@ -47,12 +47,17 @@ log = get_logger(__name__)
 
 class PluginManager:
     def __init__(self, cfg: Config, on_inventory=None,
-                 health_listener=None, policy_engine=None) -> None:
+                 health_listener=None, policy_engine=None,
+                 remediation_engine=None) -> None:
         self.cfg = cfg
         # Optional policy.PolicyEngine, threaded into every plugin server
         # (scoring/health/admission hooks) and surfaced on /status +
         # /debug/policy by status.py. None = builtin behavior everywhere.
         self.policy_engine = policy_engine
+        # Optional remediation.RemediationEngine (the self-heal plane):
+        # threaded into every plugin server as the Allocate-path
+        # admission throttle, surfaced on /status + /debug/remediation.
+        self.remediation_engine = remediation_engine
         # called with (registry, generations) after every (re)discovery —
         # the node labeler publishes per-node facts through this seam; a
         # False return (e.g. API server unreachable at node boot) is retried
@@ -239,6 +244,7 @@ class PluginManager:
                 health_hub=self.health_hub,
                 lifecycle=self.device_lifecycle,
                 policy=self.policy_engine,
+                remediation=self.remediation_engine,
             ))
             log.info("plugin for %s: %d chips (model %s, torus %s)",
                      suffix, len(devs), model,
@@ -278,7 +284,8 @@ class PluginManager:
                 health_listener=self.health_listener,
                 health_hub=self.health_hub,
                 lifecycle=self.device_lifecycle,
-                policy=self.policy_engine))
+                policy=self.policy_engine,
+                remediation=self.remediation_engine))
             log.info("vTPU plugin for %s: %d partitions", type_name, len(parts))
         if self.cfg.cdi_spec_dir:
             from . import cdi

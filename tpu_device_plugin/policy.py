@@ -28,6 +28,16 @@ three decision points:
       False or a reason string rejects — the caller surfaces a typed
       rejection, it never crashes the RPC.
 
+  remediate(ctx) -> bool | str | None
+      Veto/approve an automated remediation action before the
+      remediation engine (remediation.py) applies it (``ctx``: action
+      kind, the breached SLO, the target node/knob and its parameters).
+      None/True approves; False or a reason string VETOES — the action
+      is counted and logged as vetoed, never silently dropped. The same
+      deadline + breaker containment applies: a raising or slow
+      remediate hook falls back to approving the engine's builtin
+      decision.
+
 Misbehaving policies cannot take the daemon down, by construction:
 
 - **sandbox** — policy source is exec'd with a curated builtins table
@@ -78,7 +88,7 @@ from .resilience import CircuitBreaker
 
 log = logging.getLogger(__name__)
 
-HOOK_NAMES = ("score_allocation", "health_verdict", "admit")
+HOOK_NAMES = ("score_allocation", "health_verdict", "admit", "remediate")
 DECISION_RING = 64
 
 # What operator policy code may use. Deliberately small: pure-compute
@@ -348,6 +358,21 @@ class PolicyEngine:
         reason = value if isinstance(value, str) else "rejected by policy"
         winner.overrides.add()
         self._note_decision("admit", ctx, "reject", detail=reason)
+        return reason
+
+    def remediate(self, ctx: dict) -> Optional[str]:
+        """None = the remediation action is approved; a reason string =
+        VETOED (the remediation engine counts and logs the veto, keeps
+        the knob untouched). Same first-non-None-wins chain and
+        containment as admit()."""
+        if not self.has_hook("remediate"):
+            return None
+        value, winner = self._invoke("remediate", ctx)
+        if value is None or value is True:
+            return None
+        reason = value if isinstance(value, str) else "vetoed by policy"
+        winner.overrides.add()
+        self._note_decision("remediate", ctx, "veto", detail=reason)
         return reason
 
     # ----------------------------------------------------------- surface

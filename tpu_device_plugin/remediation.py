@@ -1,0 +1,683 @@
+"""remediation — SLO-closed-loop self-healing (ISSUE 16).
+
+The PR 15 SLO plane *detects*: multi-window burn rates latch a breach,
+carry an exemplar trace, and page an operator. This module *acts* — it
+closes the loop from latched breach to corrective knob to audited
+rollback, driving only machinery the fleet already has:
+
+  - a burning attach/prepare/publish SLO backs the publish pacer off
+    (``PublishPacer.set_backoff_floor`` — the AIMD window stops
+    collapsing while the plane sheds) and throttles claim admission
+    through a token bucket (``admit()`` — the DRA prepare and the
+    device-plugin Allocate seats consult it; every shed is COUNTED and
+    TYPED, never a silent drop);
+  - a fragmentation-driven unplaceable burst triggers a targeted defrag
+    wave through the scheduler's existing handoff path
+    (``FleetScheduler.plan_defrag_wave``/``apply_defrag_wave``);
+  - a host whose exemplar traces keep surfacing (exemplar → node
+    attribution via ``FleetFlight.trace``) is placement-biased away
+    (``FleetScheduler.bias_away``) and drained through the PR 7
+    orphan/handoff migration path (``plan_drain`` feeding the same
+    ``apply_defrag_wave``).
+
+Every action is an OPERATOR DECISION first: the policy engine's
+``remediate`` hook (policy.py — per-hook deadline + circuit breaker,
+first-non-None-wins) may veto or retune any action; vetoes are counted
+and audited, never silently dropped. Every applied action/rollback
+opens a span **linked to the breach's exemplar trace** — a linked root
+adopts the remote trace id (trace.py), so ONE
+``/debug/fleet/trace?trace=<exemplar>`` query reconstructs the whole
+chain: slow request → breach → remediation action → recovery →
+rollback.
+
+Hysteresis — the engine must never flap or storm:
+
+  - per-(action, target) cool-down windows (``cooldown_s``);
+  - a global actions-per-window budget (``max_actions_per_window`` over
+    ``action_window_s``);
+  - knobs roll back ONLY on the SLO engine's latched ``recovered``
+    transition, which itself latches only after the SLOW window's burn
+    drops below target (slo.py) — a fast-window dip mid-incident
+    neither unlatches nor rolls anything back.
+
+Wiring and concurrency: the engine SUBSCRIBES to the SLO engine
+(``SLOEngine.subscribe``). Subscriber callbacks fire on whatever thread
+drove ``evaluate()`` — usually the /status scrape, which runs inside a
+zero-registered-locks read-path bracket — so ``on_transition`` only
+QUEUES under the engine's plain unregistered lock and touches no
+registered lock. All corrective work happens in ``tick()``, driven by
+the background thread (``start()``), the autopilot soak, or tests —
+never by the scrape itself. ``admit()`` is on the prepare path: its
+no-throttle fast path is one attribute read; with a throttle active it
+takes only the engine's plain lock. tsalint COUNTERS owns
+``counters[*]`` under ``remediation.RemediationEngine._lock``.
+
+Surfaces: ``/status`` ``remediation`` section + the
+``tpu_plugin_remediation_*`` families on ``/metrics``
+(status.StatusServer), the audited action log on
+``/debug/remediation``, and the flight-recorder spans/events above
+(docs/observability.md "Remediation").
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from . import trace
+
+log = logging.getLogger(__name__)
+
+__all__ = ["TokenBucket", "RemediationEngine"]
+
+# knob defaults — every one operator-tunable at construction
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_ACTION_WINDOW_S = 300.0
+DEFAULT_MAX_ACTIONS_PER_WINDOW = 8
+DEFAULT_PACER_FLOOR_S = 0.25
+DEFAULT_SHED_RATE = 2.0          # admitted prepares/s while throttling
+DEFAULT_SHED_BURST = 4
+DEFAULT_NODE_HITS = 2            # exemplar→node surfacings before bias
+DEFAULT_UNPLACEABLE_BURST = 5    # unplaceable deltas per tick → defrag
+AUDIT_RING = 256
+
+# which corrective knobs a breach on a histogram reaches for; histograms
+# not listed get the admission throttle only (the one knob that is
+# always safe: it sheds load without touching placement)
+HISTOGRAM_ACTIONS: Dict[str, Tuple[str, ...]] = {
+    "tdp_attach_wall_ms": ("pacer_backoff", "admission_throttle"),
+    "tdp_prepare_wall_ms": ("pacer_backoff", "admission_throttle"),
+    "tdp_kubeapi_rtt_ms": ("pacer_backoff",),
+    "tdp_watch_convergence_ms": ("pacer_backoff",),
+}
+DEFAULT_ACTIONS: Tuple[str, ...] = ("admission_throttle",)
+
+
+class TokenBucket:
+    """The admission-shed bucket: ``take()`` admits while tokens last,
+    refilling at ``rate``/s up to ``burst``. Plain unregistered lock —
+    the prepare path already does API round-trips; one uncontended
+    plain-lock take is noise, and the lock never nests with any
+    registered lock."""
+
+    def __init__(self, rate: float, burst: float,
+                 now: Callable[[], float] = time.monotonic) -> None:
+        if rate <= 0 or burst <= 0:
+            raise ValueError("rate and burst must be > 0")
+        self.rate = rate
+        self.burst = burst
+        self._now = now
+        self._tokens = burst
+        self._last = now()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._now()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"rate": self.rate, "burst": self.burst,
+                    "tokens": round(self._tokens, 3)}
+
+
+def _exemplar_link(exemplar: Optional[dict]) -> Optional[dict]:
+    """A trace link from a breach exemplar. The histogram exemplar
+    carries only the trace id; the link wire shape needs a span id for
+    validity, so one is derived from the trace id — the linked ROOT
+    span adopts the trace id (trace.py), which is the part the
+    one-query reconstruction rides on."""
+    tid = (exemplar or {}).get("trace_id")
+    if not isinstance(tid, str) or len(tid) != 32:
+        return None
+    return {"trace_id": tid, "span_id": tid[:16]}
+
+
+class RemediationEngine:
+    """The closed loop: subscribe → queue → tick → act/rollback.
+
+    Constructor wiring is all optional — an engine with no pacer skips
+    pacer actions (counted ``skipped`` in the audit, never an error),
+    so the same class serves the single-daemon deployment (pacer +
+    admission only) and the scheduler-side fleet deployment (defrag +
+    bias + drain)."""
+
+    ACTION_KINDS = ("pacer_backoff", "admission_throttle",
+                    "defrag_wave", "node_bias")
+
+    def __init__(self,
+                 pacer=None,
+                 scheduler=None,
+                 policy=None,
+                 fleet_flight=None,
+                 cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 action_window_s: float = DEFAULT_ACTION_WINDOW_S,
+                 max_actions_per_window: int =
+                 DEFAULT_MAX_ACTIONS_PER_WINDOW,
+                 pacer_floor_s: float = DEFAULT_PACER_FLOOR_S,
+                 shed_rate: float = DEFAULT_SHED_RATE,
+                 shed_burst: float = DEFAULT_SHED_BURST,
+                 node_hits_threshold: int = DEFAULT_NODE_HITS,
+                 unplaceable_burst: int = DEFAULT_UNPLACEABLE_BURST,
+                 defrag_shape="2x2",
+                 drain_on_bias: bool = True,
+                 now: Callable[[], float] = time.monotonic) -> None:
+        self.pacer = pacer
+        self.scheduler = scheduler
+        self.policy = policy
+        self.fleet_flight = fleet_flight
+        self.cooldown_s = cooldown_s
+        self.action_window_s = action_window_s
+        self.max_actions_per_window = max(1, max_actions_per_window)
+        self.pacer_floor_s = pacer_floor_s
+        self.shed_rate = shed_rate
+        self.shed_burst = shed_burst
+        self.node_hits_threshold = max(1, node_hits_threshold)
+        self.unplaceable_burst = max(1, unplaceable_burst)
+        self.defrag_shape = defrag_shape
+        self.drain_on_bias = drain_on_bias
+        self._now = now
+        # PLAIN unregistered lock (module doc): guards the queue, the
+        # counters, the hysteresis state and the audit ring — and is
+        # NEVER held while a knob (registered locks) is being turned
+        self._lock = threading.Lock()
+        self._pending: List[dict] = []
+        # counters[*] owned by remediation.RemediationEngine._lock
+        # (tsalint COUNTERS); /status reads a C-atomic dict copy
+        self.counters: Dict[str, int] = {
+            "transitions_total": 0,
+            "ticks_total": 0,
+            "actions_total": 0,
+            "rollbacks_total": 0,
+            "vetoes_total": 0,
+            "sheds_total": 0,
+            "cooldown_skips_total": 0,
+            "window_skips_total": 0,
+            "errors_total": 0,
+        }
+        # active knobs: (kind, target) -> {"slos": set, "trace_id",
+        # "applied_at", "detail"} — rolled back when the LAST holding
+        # SLO recovers
+        self._active: Dict[Tuple[str, str], dict] = {}
+        # hysteresis state
+        self._last_action: Dict[Tuple[str, str], float] = {}
+        self._action_times: Deque[float] = deque()
+        # exemplar → node attribution hits across breaches
+        self._node_hits: Dict[str, int] = {}
+        # per-action-kind last applied trace id (the /status surface)
+        self._last_trace: Dict[str, str] = {}
+        # the shed bucket: None = no throttle active (admit() fast
+        # path is this one attribute read)
+        self._shed_bucket: Optional[TokenBucket] = None
+        self._shed_reason = ""
+        # unplaceable-burst baseline (scheduler stats deltas per tick)
+        self._unplaceable_seen: Optional[int] = None
+        self._audit: Deque[dict] = deque(maxlen=AUDIT_RING)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # ----------------------------------------------------- subscription
+
+    def on_transition(self, event: dict) -> None:
+        """The SLOEngine.subscribe listener. Runs on the evaluating
+        thread — possibly the /status scrape inside a zero-lock
+        read-path bracket — so it ONLY queues (plain lock, no
+        registered locks, no knob work)."""
+        with self._lock:
+            self.counters["transitions_total"] += 1
+            self._pending.append(dict(event))
+
+    # ----------------------------------------------------- admission gate
+
+    def admit(self, ctx: Optional[dict] = None) -> Optional[str]:
+        """The admission seat consulted by the DRA prepare path and the
+        device-plugin Allocate path. None = admitted. While a throttle
+        action is active, requests above the token rate get a TYPED
+        reason string (the caller raises/aborts with it) and are
+        counted — never silently dropped."""
+        bucket = self._shed_bucket            # GIL-atomic ref read
+        if bucket is None:
+            return None
+        if bucket.take():
+            return None
+        with self._lock:
+            self.counters["sheds_total"] += 1
+            reason = self._shed_reason
+        return reason or "admission shed by remediation throttle"
+
+    # ------------------------------------------------------------- audit
+
+    def _record(self, status: str, action: str, slo: str,
+                target: str, trace_id: Optional[str],
+                detail: object = None) -> None:
+        self._audit.append({
+            "ts": time.time(), "status": status, "action": action,
+            "slo": slo, "target": target, "trace_id": trace_id,
+            "detail": detail})
+
+    # -------------------------------------------------------- hysteresis
+
+    def _admissible(self, kind: str, target: str, slo: str,
+                    trace_id: Optional[str], now: float) -> bool:
+        """The hysteresis gate, counters + audit under _lock. False =
+        skip (already counted and audited — the caller just moves on)."""
+        key = (kind, target)
+        with self._lock:
+            last = self._last_action.get(key)
+            if last is not None and now - last < self.cooldown_s:
+                self.counters["cooldown_skips_total"] += 1
+                self._record("skipped_cooldown", kind, slo, target,
+                             trace_id)
+                return False
+            while self._action_times and \
+                    now - self._action_times[0] > self.action_window_s:
+                self._action_times.popleft()
+            if len(self._action_times) >= self.max_actions_per_window:
+                self.counters["window_skips_total"] += 1
+                self._record("skipped_window", kind, slo, target,
+                             trace_id)
+                return False
+            # charge the budget now: a policy veto still consumed an
+            # operator decision, and NOT charging it would let a vetoing
+            # policy be hammered once per tick forever
+            self._last_action[key] = now
+            self._action_times.append(now)
+        return True
+
+    # ----------------------------------------------------------- actions
+
+    def _veto(self, kind: str, slo: str, target: str,
+              trace_id: Optional[str], params: dict) -> Optional[str]:
+        """The operator gate: policy.remediate may veto/retune. None =
+        approved. A veto is counted + audited here."""
+        engine = self.policy
+        if engine is None or not engine.has_hook("remediate"):
+            return None
+        ctx = {"action": kind, "slo": slo, "target": target,
+               "trace_id": trace_id or ""}
+        ctx.update(params)
+        reason = engine.remediate(ctx)
+        if reason is None:
+            return None
+        with self._lock:
+            self.counters["vetoes_total"] += 1
+            self._record("vetoed", kind, slo, target, trace_id,
+                         detail=reason)
+        return reason
+
+    def _apply(self, kind: str, slo: str, target: str,
+               exemplar: Optional[dict], params: dict,
+               fn: Callable[[], object]) -> bool:
+        """One action end-to-end: hysteresis → policy gate → spanned
+        execution (linked to the breach exemplar trace) → active-knob
+        registration + audit. Returns True when the knob was turned."""
+        now = self._now()
+        trace_id = (exemplar or {}).get("trace_id")
+        if not self._admissible(kind, target, slo, trace_id, now):
+            return False
+        if self._veto(kind, slo, target, trace_id, params) is not None:
+            return False
+        link = _exemplar_link(exemplar)
+        try:
+            with trace.span("remediation.action", link=link,
+                            action=kind, slo=slo, target=target):
+                detail = fn()
+        except Exception as exc:
+            with self._lock:
+                self.counters["errors_total"] += 1
+                self._record("error", kind, slo, target, trace_id,
+                             detail=f"{type(exc).__name__}: {exc}")
+            log.exception("remediation: %s on %s failed", kind, target)
+            return False
+        with self._lock:
+            self.counters["actions_total"] += 1
+            entry = self._active.get((kind, target))
+            if entry is None:
+                self._active[(kind, target)] = {
+                    "slos": {slo}, "trace_id": trace_id,
+                    "applied_at": now, "detail": detail}
+            else:
+                entry["slos"].add(slo)
+            if trace_id:
+                self._last_trace[kind] = trace_id
+            self._record("applied", kind, slo, target, trace_id,
+                         detail=detail)
+        log.warning("remediation: %s applied (slo=%s target=%s "
+                    "trace=%s): %s", kind, slo, target, trace_id, detail)
+        return True
+
+    def _act_pacer_backoff(self, slo: str, exemplar) -> None:
+        pacer = self.pacer
+        if pacer is None:
+            return
+
+        def turn():
+            pacer.set_backoff_floor(self.pacer_floor_s)
+            return {"floor_s": self.pacer_floor_s}
+
+        self._apply("pacer_backoff", slo, "publish-pacer", exemplar,
+                    {"floor_s": self.pacer_floor_s}, turn)
+
+    def _act_admission_throttle(self, slo: str, exemplar) -> None:
+        trace_id = (exemplar or {}).get("trace_id") or ""
+
+        def turn():
+            # (re)arming is idempotent: a second burning SLO shares the
+            # same bucket, and the typed reason names the newest breach
+            self._shed_reason = (
+                f"remediation admission shed (slo={slo}"
+                f"{', trace=' + trace_id if trace_id else ''})")
+            if self._shed_bucket is None:
+                self._shed_bucket = TokenBucket(
+                    self.shed_rate, self.shed_burst, now=self._now)
+            return {"rate": self.shed_rate, "burst": self.shed_burst}
+
+        self._apply("admission_throttle", slo, "admission", exemplar,
+                    {"rate": self.shed_rate, "burst": self.shed_burst},
+                    turn)
+
+    def _act_defrag_wave(self, slo: str, exemplar) -> None:
+        sched = self.scheduler
+        if sched is None:
+            return
+
+        def turn():
+            proposal = sched.plan_defrag_wave(self.defrag_shape)
+            if proposal.get("placeable"):
+                return {"moves_applied": 0, "reason": "already placeable"}
+            moves = [m for m in proposal.get("migrations", ())
+                     if m.get("target_node") is not None]
+            if not moves:
+                return {"moves_applied": 0, "reason": "no resolvable moves"}
+            report = sched.apply_defrag_wave(proposal)
+            return {"moves_applied": report["moves_applied"],
+                    "wave": report["wave"]}
+
+        self._apply("defrag_wave", slo, f"shape-{self.defrag_shape}",
+                    exemplar, {"shape": str(self.defrag_shape)}, turn)
+
+    def _act_node_bias(self, slo: str, node: str, exemplar) -> None:
+        sched = self.scheduler
+        if sched is None:
+            return
+
+        def turn():
+            sched.bias_away(node, reason=f"slo={slo}")
+            detail = {"biased": node}
+            if self.drain_on_bias:
+                plan = sched.plan_drain(node)
+                if any(m.get("target_node") for m in plan["migrations"]):
+                    report = sched.apply_defrag_wave(plan)
+                    detail["drained"] = report["moves_applied"]
+                else:
+                    detail["drained"] = 0
+            return detail
+
+        self._apply("node_bias", slo, node, exemplar,
+                    {"node": node, "drain": self.drain_on_bias}, turn)
+
+    # --------------------------------------------------------- rollbacks
+
+    def _rollback_knob(self, kind: str, target: str) -> Optional[dict]:
+        """Undo one knob. Returns a detail dict, or None when there is
+        nothing to undo (the wired component went away)."""
+        if kind == "pacer_backoff":
+            if self.pacer is None:
+                return None
+            self.pacer.clear_backoff_floor()
+            return {"floor_cleared": True}
+        if kind == "admission_throttle":
+            self._shed_bucket = None
+            self._shed_reason = ""
+            return {"throttle_cleared": True}
+        if kind == "node_bias":
+            if self.scheduler is None:
+                return None
+            self.scheduler.clear_bias(target)
+            return {"bias_cleared": target}
+        # defrag_wave is one-shot: nothing to roll back, but the active
+        # entry still clears so a later incident can wave again
+        return {}
+
+    def _rollback_for(self, slo: str, exemplar: Optional[dict]) -> int:
+        """Roll back every knob `slo` holds; a knob held by several
+        burning SLOs survives until its LAST holder recovers."""
+        with self._lock:
+            to_undo: List[Tuple[str, str]] = []
+            for key, entry in list(self._active.items()):
+                if slo not in entry["slos"]:
+                    continue
+                entry["slos"].discard(slo)
+                if not entry["slos"]:
+                    to_undo.append(key)
+        undone = 0
+        link = _exemplar_link(exemplar)
+        for kind, target in to_undo:
+            entry = self._active.get((kind, target)) or {}
+            tid = entry.get("trace_id")
+            try:
+                with trace.span("remediation.rollback",
+                                link=link or _exemplar_link(
+                                    {"trace_id": tid}),
+                                action=kind, slo=slo, target=target):
+                    detail = self._rollback_knob(kind, target)
+            except Exception as exc:
+                with self._lock:
+                    self.counters["errors_total"] += 1
+                    self._record("error", kind, slo, target, tid,
+                                 detail=f"rollback: {exc}")
+                log.exception("remediation: rollback of %s on %s failed",
+                              kind, target)
+                continue
+            with self._lock:
+                self._active.pop((kind, target), None)
+                self.counters["rollbacks_total"] += 1
+                self._record("rolled_back", kind, slo, target, tid,
+                             detail=detail)
+            undone += 1
+            log.warning("remediation: %s on %s rolled back (slo=%s "
+                        "recovered)", kind, target, slo)
+        return undone
+
+    # ------------------------------------------------------ attribution
+
+    def _attribute_node(self, exemplar: Optional[dict]) -> Optional[str]:
+        """Exemplar → node via the fleet trace collector: every node
+        labeled on the exemplar's waterfall (drivers stamp ``node=`` on
+        their RPC roots; the unattributed control plane labels as the
+        source name) scores a hit; a node crossing the threshold is the
+        bias/drain candidate."""
+        ff = self.fleet_flight
+        tid = (exemplar or {}).get("trace_id")
+        if ff is None or not tid:
+            return None
+        try:
+            waterfall = ff.trace(tid)
+        except Exception:
+            with self._lock:
+                self.counters["errors_total"] += 1
+            return None
+        hits = [n for n in waterfall.get("nodes", ())
+                if n not in ("scheduler", "local")]
+        candidate = None
+        with self._lock:
+            for node in hits:
+                self._node_hits[node] = self._node_hits.get(node, 0) + 1
+            for node in hits:
+                if self._node_hits[node] >= self.node_hits_threshold:
+                    candidate = node
+                    break
+        return candidate
+
+    # -------------------------------------------------------------- tick
+
+    def _check_unplaceable_burst(self) -> Optional[dict]:
+        """Scheduler-stats delta check: a burst of unplaceable
+        decisions since the last tick is the fragmentation signal (no
+        SLO latches for it — capacity exists, it is just shattered)."""
+        sched = self.scheduler
+        if sched is None:
+            return None
+        unplaceable = sched.stats["unplaceable_total"].value
+        seen, self._unplaceable_seen = self._unplaceable_seen, unplaceable
+        if seen is None:
+            return None
+        if unplaceable - seen < self.unplaceable_burst:
+            return None
+        return {"slo": "unplaceable_burst", "kind": "breach",
+                "histogram": None, "exemplar": None,
+                "delta": unplaceable - seen}
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One remediation pass: drain the queued SLO transitions, act
+        on breaches, roll back on recoveries, and run the
+        fragmentation-burst check. Never called from the /status scrape
+        (knobs take registered locks); the background thread, the
+        autopilot soak, or a test drives it. Returns a tick report."""
+        del now  # hysteresis uses self._now(); kept for call symmetry
+        with self._lock:
+            self.counters["ticks_total"] += 1
+            batch, self._pending = self._pending, []
+        actions = rollbacks = 0
+        burst = self._check_unplaceable_burst()
+        if burst is not None:
+            before = self.counters["actions_total"]
+            self._act_defrag_wave(burst["slo"], None)
+            actions += self.counters["actions_total"] - before
+        for event in batch:
+            slo_name = event.get("slo", "")
+            exemplar = event.get("exemplar")
+            if event.get("kind") == "recovered":
+                rollbacks += self._rollback_for(slo_name, exemplar)
+                continue
+            before = self.counters["actions_total"]
+            kinds = HISTOGRAM_ACTIONS.get(event.get("histogram") or "",
+                                          DEFAULT_ACTIONS)
+            for kind in kinds:
+                if kind == "pacer_backoff":
+                    self._act_pacer_backoff(slo_name, exemplar)
+                elif kind == "admission_throttle":
+                    self._act_admission_throttle(slo_name, exemplar)
+                elif kind == "defrag_wave":
+                    self._act_defrag_wave(slo_name, exemplar)
+            node = self._attribute_node(exemplar)
+            if node is not None and ("node_bias", node) not in self._active:
+                self._act_node_bias(slo_name, node, exemplar)
+            actions += self.counters["actions_total"] - before
+        return {"processed": len(batch), "actions": actions,
+                "rollbacks": rollbacks,
+                "burst": None if burst is None else burst["delta"]}
+
+    # ------------------------------------------------- background driver
+
+    def start(self, interval_s: float = 1.0) -> None:
+        """Run tick() on a daemon thread every `interval_s` — the
+        production wiring (cli.main). Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+
+        def run() -> None:
+            while not self._stop_evt.wait(timeout=interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    with self._lock:
+                        self.counters["errors_total"] += 1
+                    log.exception("remediation tick failed")
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="remediation-tick")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10)
+            self._thread = None
+
+    # ----------------------------------------------------------- surface
+
+    def snapshot(self) -> dict:
+        """The /status ``remediation`` section: totals, active knobs,
+        live cool-downs, per-action last trace id. Counters via a
+        C-atomic dict copy; the rest copied under the plain lock
+        (cold-path read, one scrape per interval)."""
+        counters = dict(self.counters)
+        now = self._now()
+        with self._lock:
+            active = [{
+                "action": kind, "target": target,
+                "slos": sorted(entry["slos"]),
+                "trace_id": entry.get("trace_id"),
+                "age_s": round(now - entry["applied_at"], 1),
+            } for (kind, target), entry in sorted(self._active.items())]
+            cooldowns = {
+                f"{kind}:{target}": round(
+                    max(0.0, self.cooldown_s - (now - t)), 1)
+                for (kind, target), t in sorted(self._last_action.items())
+                if now - t < self.cooldown_s}
+            last_trace = dict(self._last_trace)
+            pending = len(self._pending)
+        bucket = self._shed_bucket
+        return {
+            **counters,
+            "pending_transitions": pending,
+            "active_actions": active,
+            "cooldowns": cooldowns,
+            "last_trace_ids": last_trace,
+            "shed_bucket": None if bucket is None else bucket.snapshot(),
+            "node_hits": dict(self._node_hits),
+        }
+
+    def debug(self) -> dict:
+        """The /debug/remediation body: the snapshot plus the audited
+        action log (bounded ring, oldest first)."""
+        out = self.snapshot()
+        out["audit"] = list(self._audit)
+        return out
+
+
+def render_prometheus(engine: RemediationEngine) -> List[str]:
+    """tpu_plugin_remediation_* families for /metrics (strict
+    text-format: HELP/TYPE per family, contiguous)."""
+    snap = engine.snapshot()
+    lines: List[str] = []
+    families = [
+        ("actions_total", "counter",
+         "Remediation actions applied (policy-approved, audited)."),
+        ("rollbacks_total", "counter",
+         "Remediation knobs rolled back after latched SLO recovery."),
+        ("vetoes_total", "counter",
+         "Remediation actions vetoed by the policy remediate hook."),
+        ("sheds_total", "counter",
+         "Admission requests shed (typed) by the remediation throttle."),
+        ("cooldown_skips_total", "counter",
+         "Actions skipped inside a per-target cool-down window."),
+        ("window_skips_total", "counter",
+         "Actions skipped by the actions-per-window budget."),
+        ("transitions_total", "counter",
+         "SLO breach/recovery transitions received."),
+        ("errors_total", "counter",
+         "Remediation actions or rollbacks that raised."),
+        ("active_actions", "gauge",
+         "Remediation knobs currently applied (not yet rolled back)."),
+    ]
+    for name, kind, help_text in families:
+        lines += [f"# HELP tpu_plugin_remediation_{name} {help_text}",
+                  f"# TYPE tpu_plugin_remediation_{name} {kind}"]
+        value = (len(snap["active_actions"])
+                 if name == "active_actions" else snap[name])
+        lines.append(f"tpu_plugin_remediation_{name} {value}")
+    return lines

@@ -97,6 +97,15 @@ class CircuitBreaker:
     as the probe; its `record_success()` closes the circuit, its
     `record_failure()` re-opens it (and restarts the cooldown). The clock
     is injectable so the state machine is unit-testable without sleeping.
+
+    The probe is OWNED by the thread allow() handed it to: while the
+    circuit is not closed, record_success()/record_failure() from any
+    other thread is a STALE result — a call admitted before the trip
+    finishing late — and must not resolve the probe window (a stale
+    success used to close the circuit under the probe's feet, re-opening
+    the floodgates on an unverified dependency). Losers racing the
+    half-open window fail fast as open and are counted
+    (`half_open_rejected`).
     """
 
     CLOSED = "closed"
@@ -118,8 +127,10 @@ class CircuitBreaker:
         self._state = self.CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
+        self._probe_owner: Optional[int] = None   # half-open probe's thread
         self.trips = 0            # lifetime CLOSED/HALF_OPEN -> OPEN count
         self.rejected = 0         # calls refused while open
+        self.half_open_rejected = 0   # of those, losers racing the probe
 
     @property
     def state(self) -> str:
@@ -138,25 +149,38 @@ class CircuitBreaker:
             if self._state == self.OPEN:
                 if self._clock() - self._opened_at >= self.reset_timeout_s:
                     self._state = self.HALF_OPEN
+                    self._probe_owner = threading.get_ident()
                     return True
                 self.rejected += 1
                 return False
-            # HALF_OPEN: a probe is already in flight
+            # HALF_OPEN: a probe is already in flight — losers racing the
+            # probe window fail fast as open, counted
             self.rejected += 1
+            self.half_open_rejected += 1
             return False
 
     def record_success(self) -> None:
         with self._lock:
+            if self._state != self.CLOSED \
+                    and self._probe_owner != threading.get_ident():
+                # stale success (admitted pre-trip, finished late): it
+                # proves nothing about the dependency NOW — only the
+                # probe's own result may resolve the window
+                return
             self._consecutive_failures = 0
             self._state = self.CLOSED
+            self._probe_owner = None
 
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive_failures += 1
             if self._state == self.HALF_OPEN:
+                if self._probe_owner != threading.get_ident():
+                    return   # stale failure: the probe alone re-opens
                 # failed probe: straight back to open, cooldown restarts
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+                self._probe_owner = None
                 self.trips += 1
             elif (self._state == self.CLOSED
                     and self._consecutive_failures >= self.failure_threshold):
@@ -185,4 +209,5 @@ class CircuitBreaker:
         return {"state": self._state,
                 "consecutive_failures": self._consecutive_failures,
                 "trips": self.trips,
-                "rejected": self.rejected}
+                "rejected": self.rejected,
+                "half_open_rejected": self.half_open_rejected}

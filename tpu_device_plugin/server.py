@@ -127,6 +127,7 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         health_hub: Optional[HealthHub] = None,
         lifecycle=None,
         policy=None,
+        remediation=None,
         byte_plane: bool = True,
     ) -> None:
         # arm-time validation, matching faults.py's fail-loud convention: a
@@ -158,6 +159,12 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # health/admission decisions consult operator code under the
         # engine's deadline + breaker containment.
         self._policy = policy
+        # Optional remediation.RemediationEngine: while the self-heal
+        # plane has an admission throttle armed (burning attach/prepare
+        # SLO), Allocates above the shed rate get a typed
+        # RESOURCE_EXHAUSTED — counted, never a silent drop. The
+        # unarmed fast path is one attribute read.
+        self._remediation = remediation
         # serializes listener deliveries; see set_devices_health
         self._listener_lock = lockdep.instrument(
             "server.TpuDevicePlugin._listener_lock", threading.Lock())
@@ -990,6 +997,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                             self.resource_name, reason)
                 context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                               f"policy rejected allocation: {reason}")
+        remediation = self._remediation
+        if remediation is not None:
+            shed = remediation.admit({"op": "allocate",
+                                      "resource": self.resource_name})
+            if shed is not None:
+                log.warning("%s: allocate shed by remediation: %s",
+                            self.resource_name, shed)
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, shed)
         try:
             # the epoch id keys the planner's precompiled fragments: a
             # health flip publishes a new epoch, so the next plan starts a

@@ -17,7 +17,13 @@ module is the objective layer over the existing lock-free histograms:
   the multiwindow gate (fast AND slow over their thresholds, with real
   bad deltas in the window) — page-worthy, not noise — counted,
   recorded as a ``slo.breach`` flight-recorder event, and latched until
-  the fast window cools below its threshold.
+  the SLOW window cools below its threshold (recovery is a latched
+  transition too: a burning objective whose fast window merely dips is
+  still in breach — unlatching on the fast window alone made the latch
+  flap under oscillating faults, which is exactly what the remediation
+  plane must not act on). Transitions (breach AND recovery) fan out to
+  registered subscribers (``subscribe``) OUTSIDE the engine lock — the
+  remediation engine (remediation.py) is the shipped subscriber.
 - Every burning objective carries an **exemplar trace id** — the latest
   over-threshold observation's trace, pulled from the histogram's
   per-bucket exemplar slots (trace.Histogram) — so a moving
@@ -50,7 +56,8 @@ import time
 from collections import deque
 from dataclasses import dataclass
 from types import MappingProxyType
-from typing import Deque, Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import (Callable, Deque, Dict, List, Mapping, Optional,
+                    Sequence, Tuple)
 
 from . import trace
 
@@ -215,8 +222,12 @@ class SLOEngine:
         # counters[*] owned by slo.SLOEngine._lock (tsalint COUNTERS);
         # /status reads them via a C-atomic dict copy
         self.counters: Dict[str, int] = {
-            "evals_total": 0, "breaches_total": 0}
+            "evals_total": 0, "breaches_total": 0, "recoveries_total": 0}
         self._state: Mapping[str, dict] = MappingProxyType({})
+        # breach/recovery subscribers — registered at wiring time (before
+        # evaluation traffic), fired OUTSIDE _lock so a subscriber may
+        # take its own locks without ordering against the engine's
+        self._subscribers: List[Callable[[dict], None]] = []
 
     # ------------------------------------------------------------ writer
 
@@ -265,14 +276,26 @@ class SLOEngine:
                 best = ex
         return best
 
+    def subscribe(self, listener: Callable[[dict], None]) -> None:
+        """Register a breach/recovery listener. Called once per latched
+        transition with ``{"slo", "kind": "breach"|"recovered",
+        "histogram", "burn_fast", "burn_slow", "exemplar"}`` — OUTSIDE
+        the engine lock, from whichever thread drove evaluate(). A
+        raising listener is logged and never breaks an evaluation.
+        Register at wiring time (before evaluation traffic): the list is
+        append-only and read without a lock."""
+        self._subscribers.append(listener)
+
     def evaluate(self, now: Optional[float] = None) -> Mapping[str, dict]:
         """One evaluation pass: sample every objective's histogram,
         recompute both windows' burn rates, latch/unlatch breaches
-        (transitions to breached count + emit a ``slo.breach``
-        flight-recorder event carrying the exemplar trace), and swap the
-        immutable state snapshot readers consume."""
+        (transitions count + emit ``slo.breach``/``slo.recovered``
+        flight-recorder events carrying the exemplar trace), swap the
+        immutable state snapshot readers consume, and fan latched
+        transitions out to subscribers after the lock is released."""
         if now is None:
             now = self._now()
+        transitions: List[dict] = []
         with self._lock:
             self.counters["evals_total"] += 1
             fresh: Dict[str, dict] = {}
@@ -298,13 +321,36 @@ class SLOEngine:
                         burn_fast=round(fast, 2),
                         burn_slow=round(slow, 2),
                         exemplar_trace=(exemplar or {}).get("trace_id"))
+                    transitions.append({
+                        "slo": obj.name, "kind": "breach",
+                        "histogram": obj.histogram,
+                        "burn_fast": fast, "burn_slow": slow,
+                        "exemplar": exemplar})
                     log.warning(
                         "SLO BREACH: %s burn fast=%.1f slow=%.1f "
                         "(threshold %gms target %g) exemplar=%s",
                         obj.name, fast, slow, obj.threshold_ms,
                         obj.target, (exemplar or {}).get("trace_id"))
-                elif was and fast < obj.burn_fast:
+                elif was and slow < obj.burn_slow \
+                        and fast < obj.burn_fast:
+                    # recovery latches only via the SLOW window: a fast
+                    # dip during a sustained burn must not unlatch (the
+                    # hysteresis the remediation plane leans on)
                     self._breached[obj.name] = False
+                    self.counters["recoveries_total"] += 1
+                    trace.event(
+                        "slo.recovered", slo=obj.name,
+                        histogram=obj.histogram,
+                        burn_fast=round(fast, 2),
+                        burn_slow=round(slow, 2))
+                    transitions.append({
+                        "slo": obj.name, "kind": "recovered",
+                        "histogram": obj.histogram,
+                        "burn_fast": fast, "burn_slow": slow,
+                        "exemplar": exemplar})
+                    log.warning(
+                        "SLO RECOVERED: %s burn fast=%.2f slow=%.2f",
+                        obj.name, fast, slow)
                 budget = 1.0 - obj.target
                 fresh[obj.name] = {
                     "histogram": obj.histogram,
@@ -326,6 +372,13 @@ class SLOEngine:
                     "exemplar": exemplar,
                 }
             self._state = MappingProxyType(fresh)
+        for event in transitions:
+            for listener in self._subscribers:
+                try:
+                    listener(dict(event))
+                except Exception:
+                    log.exception("SLO subscriber failed on %s/%s",
+                                  event["slo"], event["kind"])
         return self._state
 
     # ------------------------------------------------------------ readers
@@ -337,7 +390,8 @@ class SLOEngine:
         return {"objectives": {name: dict(rec)
                                for name, rec in self._state.items()},
                 "evals_total": counters["evals_total"],
-                "breaches_total": counters["breaches_total"]}
+                "breaches_total": counters["breaches_total"],
+                "recoveries_total": counters.get("recoveries_total", 0)}
 
     def dump_state(self) -> dict:
         """The trace-dump extra (register via attach_to_dumps): the full
@@ -405,7 +459,7 @@ def render_prometheus(engine: SLOEngine) -> List[str]:
                 f'tpu_plugin_slo_burn_rate{{slo="{_esc(name)}",'
                 f'window="{window}"}} {rec[f"burn_rate_{window}"]}')
     lines += ["# HELP tpu_plugin_slo_breached Objective currently in "
-              "multiwindow breach (latched until the fast window cools).",
+              "multiwindow breach (latched until the slow window cools).",
               "# TYPE tpu_plugin_slo_breached gauge"]
     for name, rec in sorted(objectives.items()):
         lines.append(f'tpu_plugin_slo_breached{{slo="{_esc(name)}"}} '
@@ -435,6 +489,11 @@ def render_prometheus(engine: SLOEngine) -> List[str]:
               "events).",
               "# TYPE tpu_plugin_slo_breaches_total counter",
               f"tpu_plugin_slo_breaches_total {snap['breaches_total']}",
+              "# HELP tpu_plugin_slo_recoveries_total Latched breach "
+              "recoveries (slo.recovered flight-recorder events; the "
+              "slow window cooled below its threshold).",
+              "# TYPE tpu_plugin_slo_recoveries_total counter",
+              f"tpu_plugin_slo_recoveries_total {snap['recoveries_total']}",
               "# HELP tpu_plugin_slo_evals_total Engine evaluation "
               "passes (one per /status scrape).",
               "# TYPE tpu_plugin_slo_evals_total counter",

@@ -38,6 +38,10 @@ dependency-free endpoint for liveness probes and debugging:
                    per-hook call/override/error/deadline counters,
                    breaker states, and the bounded recent-decision
                    ring. 404 when no policy engine is attached.
+  GET /debug/remediation -> the self-heal plane (remediation.py):
+                   active knobs, cool-downs, totals, and the audited
+                   action log (applied/vetoed/skipped/rolled-back).
+                   404 when no remediation engine is attached.
   GET /debug/broker -> the privilege broker (broker.py): the client's
                    crossing counters plus — in spawn mode — the broker
                    process's own audit (held fds, per-op counts, the
@@ -190,6 +194,14 @@ class StatusServer:
                             404, b"no policy engine attached", "text/plain")
                     self._send(200, json.dumps(body,
                                                sort_keys=True).encode())
+                elif route == "/debug/remediation":
+                    body = outer.remediation_debug()
+                    if body is None:
+                        return self._send(
+                            404, b"no remediation engine attached",
+                            "text/plain")
+                    self._send(200, json.dumps(body,
+                                               sort_keys=True).encode())
                 elif route == "/debug/broker":
                     self._send(200, json.dumps(
                         outer.broker_debug(), sort_keys=True,
@@ -257,6 +269,16 @@ class StatusServer:
         """The /debug/policy body (None when no engine is attached):
         PolicyEngine.debug() — snapshot + recent-decision ring."""
         engine = getattr(self.manager, "policy_engine", None)
+        if engine is None:
+            return None
+        return engine.debug()
+
+    def remediation_debug(self):
+        """The /debug/remediation body (None when no engine is
+        attached): RemediationEngine.debug() — the snapshot plus the
+        audited action log (applied/vetoed/skipped/rolled-back, oldest
+        first, bounded ring)."""
+        engine = getattr(self.manager, "remediation_engine", None)
         if engine is None:
             return None
         return engine.debug()
@@ -376,6 +398,12 @@ class StatusServer:
         engine = getattr(self.manager, "policy_engine", None)
         if engine is not None:
             out["policy"] = engine.snapshot()
+        # self-heal plane (remediation.py): active knobs, cool-downs,
+        # action/rollback/veto/shed totals, per-action last trace id —
+        # plain-lock snapshot, never a knob turn (tick() runs elsewhere)
+        rem = getattr(self.manager, "remediation_engine", None)
+        if rem is not None:
+            out["remediation"] = rem.snapshot()
         # hot-read-path lock accounting (lockdep.read_path): only present
         # under TDP_LOCKDEP=1 — steady-state acquisitions pinned at 0 by
         # the read-path gate (tests/test_epoch.py)
@@ -933,6 +961,13 @@ class StatusServer:
                     "counter",
                     f"tpu_plugin_kubeapi_breaker_rejected_total "
                     f"{breaker['rejected']}",
+                    "# HELP tpu_plugin_kubeapi_breaker_half_open_"
+                    "rejected_total Requests failed fast while losing "
+                    "the half-open single-probe race.",
+                    "# TYPE tpu_plugin_kubeapi_breaker_half_open_"
+                    "rejected_total counter",
+                    f"tpu_plugin_kubeapi_breaker_half_open_rejected_total "
+                    f"{breaker.get('half_open_rejected', 0)}",
                 ]
         fired = (s.get("faults") or {}).get("fired") or {}
         if fired:
@@ -1022,6 +1057,13 @@ class StatusServer:
         lines += slo_mod.render_prometheus(
             getattr(getattr(self, "manager", None), "slo_engine", None)
             or slo_mod.get_engine())
+        # self-heal plane (remediation.py): emitted only when an engine
+        # is attached, like the policy section
+        rem = getattr(getattr(self, "manager", None),
+                      "remediation_engine", None)
+        if rem is not None:
+            from . import remediation as remediation_mod
+            lines += remediation_mod.render_prometheus(rem)
         # ONE join materializes the scrape: every byte of the response is
         # produced exactly once (list-append assembly — incremental `+=`
         # string building re-copies the accumulated prefix per line,

@@ -51,6 +51,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
         health_hub=None,
         lifecycle=None,
         policy=None,
+        remediation=None,
     ) -> None:
         self.partitions = list(partitions)
         # only partitions with a resolvable CDI spec entry get CDI names
@@ -63,7 +64,8 @@ class VtpuDevicePlugin(TpuDevicePlugin):
                          health_shim=health_shim, cdi_enabled=cdi_enabled,
                          health_listener=health_listener,
                          health_hub=health_hub, lifecycle=lifecycle,
-                         policy=policy, byte_plane=False)
+                         policy=policy, remediation=remediation,
+                         byte_plane=False)
         # own socket namespace so a generation and a partition type never collide
         self.socket_path = os.path.join(
             cfg.device_plugin_path, f"{cfg.socket_prefix}-vtpu-{type_name}.sock")
