@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-scale bench-placement bench-fleet-placement bench-broker bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -183,6 +183,17 @@ bench-placement:
 # (N=16) variant.
 bench-fleet-placement:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleet-placement
+
+# Sharded fleet scheduler bench (docs/design.md "Sharded
+# scheduling"): N=4 optimistic-concurrency scheduler shards over one
+# 4096-node fabric vs a single per-claim-commit scheduler on a
+# 16k-claim storm — decisions/sec (>=4x pinned), p99 decision
+# latency, conflict-abort rate under deliberate contention, every
+# cell exactly-once on the multiclaim, write and checkpoint logs.
+# Writes docs/bench_fleetsched_r19.json. CI bench-smoke runs the
+# --quick (N=2, 64 nodes) variant.
+bench-fleetsched:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --fleetsched
 
 # Privilege-separation bench (docs/design.md "Privilege separation"):
 # the attach path in BOTH broker modes — counted crossings per attach

@@ -174,6 +174,18 @@ SURFACES = {
     ("remediation.RemediationEngine", "counters[*]"): {
         "status": "remediation.actions_total",
         "metrics": "tpu_plugin_remediation_actions_total"},
+    # sharded fleet scheduler (ISSUE 17): the wave counter anchors the
+    # scheduler's dict group; conflict/replan twins surface under the
+    # same fleet.* status object and their own families. The
+    # accountant's counters flatten into the SAME fleet.* snapshot —
+    # its delta-apply counter anchors that group, with the
+    # recompute/relist-skip twins pinned by the docs half via perf.md
+    ("fleetplace.FleetScheduler", "stats[*]"): {
+        "status": "fleet.decision_waves_total",
+        "metrics": "tpu_plugin_fleet_decision_waves_total"},
+    ("fleetplace.FragAccountant", "stats[*]"): {
+        "status": "fleet.frag_delta_applies_total",
+        "metrics": "tpu_plugin_fleet_frag_delta_applies_total"},
 }
 
 

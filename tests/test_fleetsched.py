@@ -1,0 +1,335 @@
+"""Sharded fleet scheduler tests (ISSUE 17).
+
+Batched decision waves (one snapshot, one sorted pass, ONE fabric
+commit round per wave — with the PR 4 group-commit firing rules: full
+wave, expired window, or a lone claim committing immediately), the
+optimistic-concurrency CAS commit (a stale observation is a counted
+CLEAN abort: nothing registered, prepares unwound, zero residue), the
+two-scheduler race for the last ICI-contiguous window (exactly one
+commits; the loser replans onto the next-best window with an honestly
+lower contiguity score, its whole story — plan → conflict-abort →
+replan → commit — on ONE trace id), the 410-relist unchanged-identity
+skip (the ISSUE 17 bugfix: a relist must not reparse the unchanged
+fleet), the cross-scheduler exactly-once audit, and the zero-lock
+read-path gate extended through the FragAccountant.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_device_plugin import faults, fleetplace, lockdep, trace
+from tpu_device_plugin.fleetplace import (
+    FleetScheduler, FragAccountant, SliceCache, fleet_audit)
+from tpu_device_plugin.fleetsim import (
+    SyntheticFleet, synthetic_slice_objects)
+from tpu_device_plugin.placement import SlicePlan, parse_shape
+
+
+def _bdf(j):
+    return f"0000:{j:02x}:00.0"
+
+
+def _fill(fleet, uid, node, chip_indexes, shape):
+    """Consume exact chips through the fabric's CAS path (observed
+    gen 0: first write wins) so every scheduler's watch cache sees
+    the occupancy."""
+    plan = SlicePlan(shape=parse_shape(shape),
+                     shards=((node, tuple(_bdf(j)
+                                          for j in chip_indexes)),),
+                     score=1.0, hosts=1)
+    res = fleet.execute_plan(plan, uid, observed={node: 0})
+    assert res["placed"], res
+    return res
+
+
+def _wait(predicate, timeout_s=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _free_by_node(sched):
+    views, _sel = sched.eligible_views()
+    return {v.node: len(v.free) for v in views}
+
+
+# ------------------------------------------------- decision waves
+
+
+def test_wave_batches_one_fabric_commit_round():
+    """A wave of k claims costs ONE snapshot, ONE planning pass and
+    ONE fabric commit round — not k of each."""
+    fleet = SyntheticFleet(8, devices_per_node=8)
+    try:
+        sched = fleet.scheduler(wave_max=16)
+        sched.start()
+        assert sched.wait_synced(10.0)
+        with fleet.apiserver._lock:
+            rounds0 = fleet.apiserver.stats["commit_rounds_total"]
+        for j in range(8):
+            sched.submit("1x2", f"wave-{j}")
+        results = sched.pump(force=True)
+        assert len(results) == 8
+        assert all(r["placed"] for r in results)
+        assert sched.stats["decision_waves_total"].value == 1
+        with fleet.apiserver._lock:
+            rounds = fleet.apiserver.stats["commit_rounds_total"]
+        assert rounds - rounds0 == 1, \
+            f"8-claim wave cost {rounds - rounds0} commit rounds"
+        assert all(r["latency_ms"] >= 0 for r in results)
+        audit = fleet_audit(
+            [sched], fabric_audit=fleet.apiserver.multiclaim_audit(),
+            placement_audit=fleet.apiserver.placement_audit(),
+            checkpoint_audit=fleet.checkpoint_audit())
+        assert audit["exactly_once"], audit
+    finally:
+        fleet.stop()
+
+
+def test_wave_waits_for_company_until_full_or_window():
+    """Two queued claims inside a young wave window do NOT fire; the
+    wave fires when it fills to wave_max."""
+    fleet = SyntheticFleet(4, devices_per_node=8)
+    try:
+        sched = fleet.scheduler(wave_max=4, wave_window_s=60.0)
+        sched.start()
+        assert sched.wait_synced(10.0)
+        sched.submit("1x2", "early-0")
+        sched.submit("1x2", "early-1")
+        assert sched.pump() == []          # not lone, not full, young
+        sched.submit("1x2", "early-2")
+        sched.submit("1x2", "early-3")     # hits wave_max
+        results = sched.pump()
+        assert len(results) == 4
+        assert all(r["placed"] for r in results)
+        assert sched.stats["decision_waves_total"].value == 1
+    finally:
+        fleet.stop()
+
+
+def test_lone_claim_commits_immediately():
+    """The PR 4 lone-claim rule at the scheduler tier: a single queued
+    claim never waits out the wave window."""
+    fleet = SyntheticFleet(2, devices_per_node=8)
+    try:
+        sched = fleet.scheduler(wave_max=64, wave_window_s=60.0)
+        sched.start()
+        assert sched.wait_synced(10.0)
+        sched.submit("1x2", "lone")
+        results = sched.pump()             # NOT forced
+        assert [r["uid"] for r in results] == ["lone"]
+        assert results[0]["placed"]
+        assert sched.stats["decision_waves_total"].value == 1
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------- optimistic concurrency
+
+
+def test_stale_observed_commit_is_counted_clean_abort():
+    """The fabric-side CAS contract: a commit whose observed placement
+    generation is stale is refused atomically — counted, nothing
+    registered, prepares unwound, zero residue — and reports the live
+    generations so the caller can replan."""
+    fleet = SyntheticFleet(2, devices_per_node=8)
+    try:
+        _fill(fleet, "holder", "node-0000", (0, 1), "1x2")
+        plan = SlicePlan(shape=parse_shape("1x2"),
+                         shards=(("node-0000", (_bdf(2), _bdf(3))),),
+                         score=1.0, hosts=1)
+        res = fleet.execute_plan(plan, "stale", observed={"node-0000": 0})
+        assert not res["placed"]
+        assert res["conflict"]
+        assert res["conflicts"] == ["node-0000"]
+        assert res["placement_gens"] == {"node-0000": 1}
+        assert res["residue"] == []
+        assert fleet.slice_residue("stale") == []
+        with fleet.apiserver._lock:
+            assert fleet.apiserver.stats["placement_conflicts_total"] == 1
+        for name, audit in fleet.audits().items():
+            assert audit["exactly_once"], (name, audit)
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.parametrize("faulted", [False, True])
+def test_two_schedulers_race_for_last_contiguous_window(faulted):
+    """ISSUE 17 satellite: two schedulers race for the LAST perfectly
+    contiguous 2x2 window. Exactly one commits it; the loser's abort
+    is clean (no residue on the contested node, no orphaned
+    sub-claims) and its replan lands the next-best window with an
+    honestly LOWER contiguity score — the whole story (plan →
+    conflict-abort → replan → commit) on ONE trace id. The `faulted`
+    leg repeats the race with the chaos registry armed on the
+    apiserver transport."""
+    trace.reset()
+    faults.reset()
+    fleet = SyntheticFleet(4, devices_per_node=8,
+                           commit_crossing_s=0.05)
+    try:
+        s1 = fleet.scheduler(partition=False)
+        s2 = fleet.scheduler(partition=False)
+        for s in (s1, s2):
+            s.start()
+        for s in (s1, s2):
+            assert s.wait_synced(10.0)
+        # node-0000 keeps ONE contiguous 2x2 (cols 0-1 of its 2x4
+        # torus); node-0001 keeps 4 free chips in cols 0 and 2 — a
+        # 2x2 only best-effort, never contiguous; the rest is full
+        _fill(fleet, "fill-n0", "node-0000", (2, 3, 6, 7), "1x4")
+        _fill(fleet, "fill-frag", "node-0001", (1, 5, 3, 7), "1x4")
+        _fill(fleet, "fill-n2", "node-0002", tuple(range(8)), "2x4")
+        _fill(fleet, "fill-n3", "node-0003", tuple(range(8)), "2x4")
+        want = {"node-0000": 4, "node-0001": 4,
+                "node-0002": 0, "node-0003": 0}
+        _wait(lambda: _free_by_node(s1) == want
+              and _free_by_node(s2) == want, msg="fill convergence")
+        if faulted:
+            faults.arm("kubeapi.request", kind="error", count=2)
+        barrier = threading.Barrier(2)
+        res = {}
+
+        def go(sched, uid):
+            barrier.wait()
+            res[uid] = sched.schedule("2x2", uid, best_effort=True)
+
+        threads = [threading.Thread(target=go, args=args)
+                   for args in ((s1, "race-a"), (s2, "race-b"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ra, rb = res["race-a"], res["race-b"]
+        assert ra["placed"] and rb["placed"], (ra, rb)
+        scores = sorted([ra["score"], rb["score"]])
+        assert scores[1] == 1.0, "someone must win the pristine window"
+        assert scores[0] < 1.0, \
+            "loser must land the next-best window at a LOWER score"
+        winner, loser = (ra, rb) if ra["score"] == 1.0 else (rb, ra)
+        winner_node = winner["shards"][0][0]
+        loser_node = loser["shards"][0][0]
+        assert winner_node == "node-0000"
+        assert loser_node == "node-0001"
+        conflicts = (s1.stats["commit_conflicts_total"].value
+                     + s2.stats["commit_conflicts_total"].value)
+        replans = (s1.stats["replans_total"].value
+                   + s2.stats["replans_total"].value)
+        assert conflicts >= 1 and replans >= 1, (conflicts, replans)
+        # the loser left NOTHING behind on the contested node
+        residue = fleet.slice_residue(loser["uid"])
+        assert all(winner_node not in entry for entry in residue), \
+            residue
+        # satellite: the conflicted claim's waterfall on ONE trace id
+        ops = {s_["op"] for s_ in trace.snapshot(trace=loser["trace_id"])}
+        for needed in ("fleetplace.schedule", "fleetplace.conflict_abort",
+                       "fleetplace.replan", "fleetplace.commit"):
+            assert needed in ops, (needed, sorted(ops))
+        # the fills committed through the fabric out-of-band, so the
+        # scheduler-vs-fabric SET comparison cannot hold here — the
+        # placement/checkpoint legs and the fabric's own audit can
+        audit = fleet_audit(
+            [s1, s2],
+            placement_audit=fleet.apiserver.placement_audit(),
+            checkpoint_audit=fleet.checkpoint_audit())
+        assert audit["exactly_once"], audit
+        assert audit["cross_scheduler_duplicates"] == []
+        assert fleet.apiserver.multiclaim_audit()["exactly_once"]
+    finally:
+        fleet.stop()
+        faults.reset()
+
+
+# --------------------------------------------- 410-relist skip (bugfix)
+
+
+def test_relist_unchanged_slices_skip_delta_application():
+    """ISSUE 17 bugfix regression: after a 410-compaction relist, a
+    slice whose resourceVersion/generation identity is unchanged is
+    SKIPPED — counted — instead of reparsed; only the slices that
+    actually moved pay the recompute."""
+    objs, pod_dims = synthetic_slice_objects(8, devices_per_node=4)
+    for i, obj in enumerate(objs):
+        obj["metadata"]["resourceVersion"] = str(i + 1)
+    fresh = {o["metadata"]["name"]: o for o in objs}
+    acc = FragAccountant(pod_dims=pod_dims)
+    acc.on_sync(fresh)
+    assert acc.stats["slice_reparses_total"].value == 8
+    assert acc.stats["relist_unchanged_skips_total"].value == 0
+    # identical relist: ALL skipped, NOTHING reparsed
+    acc.on_sync(fresh)
+    assert acc.stats["relist_unchanged_skips_total"].value == 8
+    assert acc.stats["slice_reparses_total"].value == 8
+    # one slice moved between compactions: exactly one reparse
+    moved = dict(fresh)
+    bumped = dict(moved["node-0003-slice"])
+    bumped["metadata"] = dict(bumped["metadata"],
+                              resourceVersion="99")
+    moved["node-0003-slice"] = bumped
+    acc.on_sync(moved)
+    assert acc.stats["slice_reparses_total"].value == 9
+    assert acc.stats["relist_unchanged_skips_total"].value == 15
+    # duplicate watch delivery hits the same identity skip
+    acc.on_event({"type": "MODIFIED", "object": bumped})
+    assert acc.stats["slice_reparses_total"].value == 9
+    assert acc.stats["relist_unchanged_skips_total"].value == 16
+
+
+# ------------------------------------------------- cross-scheduler audit
+
+
+def test_fleet_audit_flags_cross_scheduler_duplicate_commit():
+    """A claim uid committing on TWO schedulers is the violation the
+    fleet-level audit exists for — per-scheduler logs can each look
+    clean while the union is wrong."""
+    cache1, cache2 = SliceCache(), SliceCache()
+    s1 = FleetScheduler(cache=cache1)
+    s2 = FleetScheduler(cache=cache2)
+    for s in (s1, s2):
+        s._note("decided", "dup", None)
+        s._note("committed", "dup", None)
+    audit = fleet_audit([s1, s2])
+    assert audit["cross_scheduler_duplicates"] == ["dup"]
+    assert not audit["exactly_once"]
+    # each scheduler ALONE audits clean — only the union catches it
+    assert all(a["exactly_once"] for a in audit["per_scheduler"])
+
+
+# --------------------------------------------- zero-lock read gates
+
+
+def test_fleet_reads_stay_zero_lock_through_accountant():
+    """The ISSUE 14 zero-lock read gate survives the ISSUE 17
+    accountant: after a sync AND applied watch deltas, selector
+    evaluation and fragmentation reads still acquire zero registered
+    locks (they run on the accountant's published snapshots)."""
+    objs, pod_dims = synthetic_slice_objects(4, devices_per_node=4)
+    for i, obj in enumerate(objs):
+        obj["metadata"]["resourceVersion"] = str(i + 1)
+    with lockdep.scoped():
+        cache = SliceCache(pod_dims=pod_dims)
+        cache.on_sync(objs)
+        sched = FleetScheduler(cache=cache, pod_dims=pod_dims)
+        # watch deltas land through the accountant's O(1) apply path
+        flip = dict(objs[0])
+        flip["metadata"] = dict(flip["metadata"], resourceVersion="50")
+        cache.on_event({"type": "MODIFIED", "object": flip})
+        assert cache.accountant.stats[
+            "frag_delta_applies_total"].value >= 1
+        lockdep.reset()
+        for _ in range(5):
+            views, _sel = sched.eligible_views()
+            assert len(views) == 4
+            frag = sched.fragmentation()
+            assert frag
+        stats = lockdep.path_stats()
+        for path in ("fleetplace.select", "fleetplace.frag"):
+            rec = stats[path]
+            assert rec["calls"] >= 5, stats
+            assert rec["lock_acquisitions"] == 0, \
+                f"{path} acquired {rec['lock_acquisitions']} locks"

@@ -16,7 +16,7 @@ import pytest
 
 from tests.fakehost import FakeChip, FakeHost
 from tests.test_dra import FakeApiServer, make_driver
-from tpu_device_plugin import faults, lockdep, trace
+from tpu_device_plugin import faults, fleetplace, fleetsim, lockdep, trace
 from tpu_device_plugin.config import Config
 from tpu_device_plugin.discovery import discover_passthrough
 from tpu_device_plugin.lifecycle import PluginManager
@@ -100,7 +100,20 @@ def full_scrape(short_root):
         faults.fire("dra.publish")               # fault stats exist
         trace.observe("tdp_attach_wall_ms", 1.25)
         trace.observe("tdp_kubeapi_rtt_ms", 42.0)
-        server = StatusServer(manager, port=0, dra_driver=driver)
+        # sharded scheduler plane (ISSUE 17): a cache-mode
+        # FleetScheduler fed one synthetic sync + one advisory wave, so
+        # the tpu_plugin_fleet_* families and the /status "fleet"
+        # section are in the scrape
+        objs, pod_dims = fleetsim.synthetic_slice_objects(
+            2, devices_per_node=4)
+        fleet_cache = fleetplace.SliceCache(pod_dims=pod_dims)
+        fleet_cache.on_sync(objs)
+        fleet_sched = fleetplace.FleetScheduler(
+            cache=fleet_cache, pod_dims=pod_dims)
+        fleet_sched.submit("1x2", "scrape-claim")
+        fleet_sched.pump(force=True)             # fleet counters move
+        server = StatusServer(manager, port=0, dra_driver=driver,
+                              fleet_scheduler=fleet_sched)
         try:
             server.status()                      # warm read_path counters
             yield server.metrics(), server

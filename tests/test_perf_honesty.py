@@ -1490,3 +1490,79 @@ def test_selfheal_closed_loop_is_live_not_just_recorded_r18(short_root):
         faults.reset()
         sim.stop()
         trace_mod.reset()
+
+
+def test_bench_fleetsched_r19_pins_sharded_storm():
+    """Round-19 sharded-scheduler pins against the RECORDED
+    docs/bench_fleetsched_r19.json (counted facts, CI-safe):
+
+      - the storm cell ran at 4096 nodes / 16384 claims across FOUR
+        schedulers and placed EVERYTHING — no phantom "unplaceable"
+        (the wait_synced-vs-accountant boot race this round fixed);
+      - N=4 sharded throughput is >= 4x the single-scheduler
+        per-claim-commit baseline, with p99 decision latency recorded
+        in both cells;
+      - the contended (unpartitioned) cell actually exercised the
+        optimistic-concurrency path: counted conflicts, counted
+        replans, a non-zero abort rate — and STILL audited
+        exactly-once;
+      - EVERY cell proves <=1 commit per claim uid on all three audit
+        logs: multiclaim commit log, per-slice write-generation log,
+        node checkpoints."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "bench_fleetsched_r19.json")
+    with open(path) as f:
+        d = json.load(f)
+
+    single, sharded, contended = d["single"], d["sharded"], d["contended"]
+    assert single["nodes"] == 4096 and single["schedulers"] == 1
+    assert single["per_claim_commits"] is True
+    assert sharded["nodes"] == 4096 and sharded["schedulers"] == 4
+    assert sharded["claims"] == 16384 and sharded["partition"] is True
+    assert sharded["unplaceable"] == 0 and sharded["placed"] == 16384
+    assert d["speedup_n4_vs_single"] >= 4.0, d["speedup_n4_vs_single"]
+    assert contended["commit_conflicts"] > 0
+    assert contended["replans"] > 0
+    assert contended["conflict_abort_rate"] > 0
+    for name, cell in (("single", single), ("sharded", sharded),
+                       ("contended", contended)):
+        assert cell["exactly_once"], (name, cell)
+        logs = cell["exactly_once_logs"]
+        for log in ("multiclaim", "write_log", "placement", "checkpoint"):
+            assert logs[log], (name, log, logs)
+        assert cell["decision_p99_ms"] > 0, (name, cell)
+        assert cell["decision_waves"] > 0, (name, cell)
+        assert cell["frag_delta_applies"] > 0, (name, cell)
+
+
+def test_fleetsched_frag_delta_single_flip_at_4096_nodes_is_o1():
+    """Runtime half of the r19 pin, COUNTED: at 4096 nodes, ONE watch
+    event costs ONE slice reparse and ZERO full recomputes — the
+    accountant's decision-state upkeep scales with the event, not the
+    fleet. (A regression to snapshot-rebuild accounting would show
+    4096 reparses here.)"""
+    from tpu_device_plugin.fleetplace import FragAccountant
+    from tpu_device_plugin.fleetsim import synthetic_slice_objects
+
+    objs, pod_dims = synthetic_slice_objects(4096, devices_per_node=8)
+    for i, obj in enumerate(objs):
+        obj["metadata"]["resourceVersion"] = str(i + 1)
+    acc = FragAccountant(pod_dims=pod_dims)
+    acc.on_sync({o["metadata"]["name"]: o for o in objs})
+    assert acc.stats["slice_reparses_total"].value == 4096
+    reparses0 = acc.stats["slice_reparses_total"].value
+    recomputes0 = acc.stats["frag_full_recomputes_total"].value
+    version0 = acc.version
+
+    flip = dict(objs[7])
+    flip["metadata"] = dict(flip["metadata"], resourceVersion="999999")
+    acc.on_event({"type": "MODIFIED", "object": flip})
+
+    assert acc.stats["slice_reparses_total"].value - reparses0 == 1
+    assert acc.stats["frag_full_recomputes_total"].value \
+        - recomputes0 == 0
+    assert acc.stats["frag_delta_applies_total"].value >= 1
+    assert acc.version > version0       # readers see the new state

@@ -236,6 +236,19 @@ COUNTERS: Dict[str, Dict[str, str]] = {
     "remediation.RemediationEngine": {
         "counters[*]": "remediation.RemediationEngine._lock",
     },
+    # sharded fleet scheduler (round 19): decision/wave/conflict/replan
+    # counters are epoch.AtomicCounter in a fixed-key dict (LOCKFREE —
+    # wave planning and CAS replans bump them outside any lock;
+    # snapshot() reads .value)
+    "fleetplace.FleetScheduler": {
+        "stats[*]": LOCKFREE,
+    },
+    # incremental fragmentation accountant (round 19): delta/recompute/
+    # relist-skip counters are AtomicCounters too — bumped on the
+    # reflector writer thread, read lock-free by snapshot()
+    "fleetplace.FragAccountant": {
+        "stats[*]": LOCKFREE,
+    },
 }
 
 

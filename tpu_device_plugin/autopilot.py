@@ -115,6 +115,13 @@ class AutopilotConfig:
     selfheal_fault_delay_s: float = 0.4
     selfheal_fault_jitter_s: float = 0.05
     selfheal_fault_ramp_s: float = 2.0
+    # sharded-scheduler drill (ISSUE 17): after quiesce, N partitioned
+    # FleetSchedulers place a concurrent claim wave over THIS fleet's
+    # fabric through the optimistic CAS commit path, the cross-
+    # scheduler exactly-once audit must hold, and every drill claim is
+    # released back (zero residue). 0 disables the leg.
+    sharded_schedulers: int = 2
+    sharded_claims: int = 8
 
 
 class FleetAutopilot:
@@ -641,6 +648,91 @@ class FleetAutopilot:
                 f"nodes={waterfall['nodes']}")
         return story
 
+    def _sharded_drill(self) -> dict:
+        """Post-quiesce sharded-scheduler leg: N partitioned watch-fed
+        schedulers race a claim wave onto the quiesced fleet through
+        the CAS commit path, the cross-scheduler audit proves <=1
+        commit per claim uid, and every placement is released (the
+        drill must leave the fleet exactly as it found it)."""
+        from . import fleetplace
+        cfg = self.cfg
+        n = cfg.sharded_schedulers
+        scheds = [self.sim.scheduler(
+            watch=True, shard_index=i, shard_count=n, partition=True,
+            wave_max=max(2, cfg.sharded_claims // n))
+            for i in range(n)]
+        story = {"schedulers": n, "claims": cfg.sharded_claims}
+        try:
+            for s in scheds:
+                s.start()
+            for s in scheds:
+                s.wait_synced(timeout_s=30)
+            results: List[List[dict]] = [[] for _ in range(n)]
+
+            def work(i: int) -> None:
+                s = scheds[i]
+                for j in range(i, cfg.sharded_claims, n):
+                    s.submit("1x2", f"soak-shard-{j:04d}")
+                results[i] = s.drain()
+
+            threads: List[threading.Thread] = []
+            for i in range(n):
+                t = threading.Thread(target=work, args=(i,),
+                                     daemon=True,
+                                     name=f"autopilot-shard-{i}")
+                self._threads.append(t)   # stop() reaps stragglers
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            flat = [r for shard in results for r in shard]
+            placed = [r for r in flat if r.get("placed")]
+            # the storm's own multiclaims share this fabric, so the
+            # fleet-level fabric set comparison is out of reach here —
+            # per-scheduler logs, the cross-scheduler duplicate check
+            # and the fabric's CAS placement log still must hold
+            audit = fleetplace.fleet_audit(
+                scheds,
+                placement_audit=self.sim.apiserver.placement_audit())
+            for r in placed:
+                self.sim.release_subclaims(
+                    [(f"{r['uid']}-{node}", node)
+                     for node, _raws in r["shards"]])
+            residue = sorted(
+                line for r in flat
+                for line in self.sim.slice_residue(r["uid"]))
+            story.update({
+                "decided": len(flat),
+                "placed": len(placed),
+                "conflicts": sum(
+                    s.stats["commit_conflicts_total"].value
+                    for s in scheds),
+                "replans": sum(s.stats["replans_total"].value
+                               for s in scheds),
+                "waves": sum(s.stats["decision_waves_total"].value
+                             for s in scheds),
+                "exactly_once": audit["exactly_once"],
+                "residue": residue,
+            })
+            if len(flat) != cfg.sharded_claims:
+                self.violations.append(
+                    f"sharded drill decided {len(flat)} of "
+                    f"{cfg.sharded_claims} claims")
+            if not audit["exactly_once"]:
+                self.violations.append(
+                    "sharded drill: cross-scheduler exactly-once audit "
+                    f"failed: {audit['cross_scheduler_duplicates']}")
+            if residue:
+                self.violations.append(
+                    f"sharded drill left residue: {residue}")
+        finally:
+            for s in scheds:
+                try:
+                    s.stop()
+                except Exception:
+                    log.exception("autopilot: sharded drill stop")
+        return story
+
     def _migration_recover(self, src, uid: str, mig: dict) -> bool:
         self.sim.apiserver.add_claim(
             "fleet", uid, uid, src.driver.driver_name,
@@ -836,6 +928,12 @@ class FleetAutopilot:
         selfheal_story = None
         if cfg.selfheal:
             selfheal_story = self._selfheal_drill()
+        # sharded-scheduler drill (ISSUE 17): also against the quiesced
+        # fleet — its claims must come and go without disturbing the
+        # converged state the checks above just proved
+        sharded_story = None
+        if cfg.sharded_schedulers:
+            sharded_story = self._sharded_drill()
         wall_s = time.monotonic() - t0
         report = {
             "config": {
@@ -848,6 +946,7 @@ class FleetAutopilot:
                 "watch_chaos": cfg.watch_chaos,
                 "watch_faults": cfg.watch_faults,
                 "selfheal": cfg.selfheal,
+                "sharded_schedulers": cfg.sharded_schedulers,
             },
             "wall_s": round(wall_s, 1),
             "boot_published_ok": boot["published_ok"],
@@ -869,6 +968,7 @@ class FleetAutopilot:
                              if site.startswith("kubeapi.watch")},
             "claim_story": self._story,
             "selfheal_story": selfheal_story,
+            "sharded": sharded_story,
         }
         if raise_on_violation and not report["ok"]:
             raise AssertionError(

@@ -58,6 +58,7 @@ import logging
 import re
 import threading
 import time
+import zlib
 from dataclasses import replace
 from types import MappingProxyType
 from typing import (Callable, Dict, List, Mapping, Optional, Sequence,
@@ -71,7 +72,8 @@ log = logging.getLogger(__name__)
 
 __all__ = ["SelectorError", "CompiledSelector", "compile_selector",
            "device_attrs", "SliceCache", "host_views_from_slices",
-           "cluster_fragmentation", "FleetScheduler", "FleetFlight"]
+           "cluster_fragmentation", "FragAccountant", "FleetScheduler",
+           "FleetFlight", "fleet_audit"]
 
 
 # ====================================================================
@@ -436,20 +438,30 @@ class SliceCache:
     kubeapi.Reflector (`on_sync` for LIST states, `on_event` for watch
     events — both idempotent, surviving the at-least-once delivery
     contract). The writer (reflector thread) mutates its private dict
-    under `_lock` and swaps an IMMUTABLE MappingProxyType snapshot;
-    `snapshot()` readers never lock — fleet accounting and selector
-    evaluation run against one frozen cluster state."""
+    under `_lock` and marks it dirty; `snapshot()` rebuilds an
+    IMMUTABLE MappingProxyType copy only when something changed since
+    the last read, so a 16k-commit watch storm costs O(events) writer
+    work, not O(events x fleet) snapshot copies. Readers that hit a
+    clean snapshot never lock — fleet accounting and selector
+    evaluation run against one frozen cluster state.
 
-    def __init__(self) -> None:
+    The cache also feeds a FragAccountant (ISSUE 17): every sync/event
+    is forwarded on the same writer thread, so the incremental per-node
+    placement state converges in lockstep with the raw snapshot."""
+
+    def __init__(self, pod_dims: Optional[Tuple[int, ...]] = None,
+                 accountant: Optional["FragAccountant"] = None) -> None:
         self._lock = lockdep.instrument(
             "fleetplace.SliceCache._lock", threading.Lock())
         self._by_name: Dict[str, dict] = {}
         self._snap: Mapping[str, dict] = MappingProxyType({})
+        self._dirty = False
         self.syncs = AtomicCounter()
         self.events = AtomicCounter()
+        self.accountant = accountant if accountant is not None \
+            else FragAccountant(pod_dims=pod_dims)
 
     def on_sync(self, items: Sequence[dict]) -> None:
-        self.syncs.add()
         fresh = {}
         for obj in items or ():
             name = ((obj.get("metadata") or {}).get("name"))
@@ -461,9 +473,16 @@ class SliceCache:
         with self._lock:
             self._by_name = fresh
             self._snap = MappingProxyType(dict(fresh))
+            self._dirty = False
+        self.accountant.on_sync(fresh)
+        # count the sync only once BOTH planes converged: wait_synced
+        # is the scheduler's boot barrier, and a sync counted before
+        # the accountant finished ingesting would let the first wave
+        # plan against a partially-built view set (seen at 4096 nodes
+        # as a whole wave of phantom "unplaceable" decisions)
+        self.syncs.add()
 
     def on_event(self, evt: dict) -> None:
-        self.events.add()
         obj = evt.get("object") or {}
         name = (obj.get("metadata") or {}).get("name")
         if not name:
@@ -473,10 +492,20 @@ class SliceCache:
                 self._by_name.pop(name, None)
             else:
                 self._by_name[name] = obj
-            self._snap = MappingProxyType(dict(self._by_name))
+            self._dirty = True
+        self.accountant.on_event(evt)
+        self.events.add()      # counted only once fully applied
 
     def snapshot(self) -> Mapping[str, dict]:
-        """Lock-free: one attribute read of an immutable mapping."""
+        """Lock-free on the hot path: one attribute read of an
+        immutable mapping, with a locked O(fleet) rebuild only when
+        events landed since the last read (storms coalesce into one
+        copy per reader visit)."""
+        if self._dirty:
+            with self._lock:
+                if self._dirty:
+                    self._snap = MappingProxyType(dict(self._by_name))
+                    self._dirty = False
         return self._snap
 
 
@@ -527,49 +556,91 @@ def host_views_from_slices(
     # enumerates 0000:00:04.0 — so a bare-BDF key would mark one
     # claim's chips busy fleet-wide
     claimed: Dict[Tuple[str, str], str] = {}
-    claim_raws: Dict[Tuple[str, str], Dict[str, List[str]]] = {}
     for _uid, shards in claims.items():
         for sub_uid, node, raws in shards:
             for raw in raws:
                 claimed[(node, raw)] = sub_uid
     for obj in slices.values():
-        spec = obj.get("spec") or {}
-        node = spec.get("nodeName")
-        if not node:
-            continue
-        for entry in spec.get("devices") or ():
-            attrs = device_attrs(entry)
-            generation = attrs.get("generation")
-            bdf = attrs.get("bdf")
-            coords = _axis_attrs(attrs, "ici")
-            dims = _axis_attrs(attrs, "torus")
-            if not generation or not bdf or coords is None or dims is None:
-                continue
-            if len(coords) != len(dims):
-                continue
-            key = (node, str(generation))
-            g = grids.setdefault(key, {
-                "dims": dims, "coords": {}, "names": {}, "free": set(),
-                "host_coords": _axis_attrs(attrs, "host")})
-            g["coords"][bdf] = coords
-            g["names"][bdf] = str(attrs.get("name"))
-            attrs_index.setdefault(key, {})[bdf] = attrs
-            uid = claimed.get((node, bdf))
-            if uid is None:
-                g["free"].add(bdf)
+        for key, grid, attrs_by_bdf in _parse_slice_grids(obj):
+            g = grids.get(key)
+            if g is None:
+                grids[key] = {"dims": grid["dims"],
+                              "coords": dict(grid["coords"]),
+                              "names": dict(grid["names"]),
+                              "consumed": dict(grid["consumed"]),
+                              "host_coords": grid["host_coords"]}
             else:
-                claim_raws.setdefault(key, {}).setdefault(
-                    uid, []).append(bdf)
+                g["coords"].update(grid["coords"])
+                g["names"].update(grid["names"])
+                g["consumed"].update(grid["consumed"])
+            attrs_index.setdefault(key, {}).update(attrs_by_bdf)
     views: Dict[str, List[HostView]] = {}
     for (node, generation), g in sorted(grids.items()):
-        views.setdefault(generation, []).append(HostView(
-            node=node, dims=g["dims"],
-            coords=g["coords"], names=g["names"],
-            free=frozenset(g["free"]), departed=frozenset(),
-            claims={uid: tuple(raws) for uid, raws
-                    in claim_raws.get((node, generation), {}).items()},
-            host_coords=g["host_coords"]))
+        views.setdefault(generation, []).append(_grid_view(
+            node, generation, g, claimed))
     return views, attrs_index
+
+
+def _parse_slice_grids(obj: Mapping) -> List[tuple]:
+    """One published ResourceSlice → [((node, generation), grid,
+    attrs_by_bdf)]: the per-slice half of host_views_from_slices,
+    shared with the incremental FragAccountant so a delta apply parses
+    exactly what a full rebuild would. `grid["consumed"]` carries the
+    fabric's CAS placement overlay (spec.consumed, ISSUE 17):
+    {bdf: owning multiclaim uid} for chips committed cluster-wide."""
+    spec = obj.get("spec") or {}
+    node = spec.get("nodeName")
+    if not node:
+        return []
+    consumed = spec.get("consumed") or {}
+    grids: Dict[Tuple[str, str], dict] = {}
+    attrs_out: Dict[Tuple[str, str], Dict[str, Dict[str, object]]] = {}
+    for entry in spec.get("devices") or ():
+        attrs = device_attrs(entry)
+        generation = attrs.get("generation")
+        bdf = attrs.get("bdf")
+        coords = _axis_attrs(attrs, "ici")
+        dims = _axis_attrs(attrs, "torus")
+        if not generation or not bdf or coords is None or dims is None:
+            continue
+        if len(coords) != len(dims):
+            continue
+        key = (node, str(generation))
+        g = grids.setdefault(key, {
+            "dims": dims, "coords": {}, "names": {}, "consumed": {},
+            "host_coords": _axis_attrs(attrs, "host")})
+        g["coords"][bdf] = coords
+        g["names"][bdf] = str(attrs.get("name"))
+        if bdf in consumed:
+            g["consumed"][bdf] = str(consumed[bdf])
+        attrs_out.setdefault(key, {})[bdf] = attrs
+    return [(key, grids[key], attrs_out[key]) for key in sorted(grids)]
+
+
+def _grid_view(node: str, generation: str, grid: Mapping,
+               claimed: Mapping[Tuple[str, str], str]) -> HostView:
+    """Assemble one HostView from a parsed grid plus the scheduler's
+    OWN claim ledger overlay. Busy chips come from two planes: the
+    fabric's consumed overlay (cluster-wide committed truth — includes
+    every peer scheduler's placements) and the local ledger (covers the
+    commit-to-watch-event window for this scheduler's claims). Where
+    both know a chip, the ledger's sub-uid wins — it is the id the node
+    driver's checkpoint actually holds, the one defrag can unprepare."""
+    busy: Dict[str, str] = dict(grid["consumed"])
+    for bdf in grid["coords"]:
+        sub_uid = claimed.get((node, bdf))
+        if sub_uid is not None:
+            busy[bdf] = sub_uid
+    claim_raws: Dict[str, List[str]] = {}
+    for bdf in sorted(busy):
+        claim_raws.setdefault(busy[bdf], []).append(bdf)
+    return HostView(
+        node=node, dims=grid["dims"],
+        coords=dict(grid["coords"]), names=dict(grid["names"]),
+        free=frozenset(b for b in grid["coords"] if b not in busy),
+        departed=frozenset(),
+        claims={uid: tuple(raws) for uid, raws in claim_raws.items()},
+        host_coords=grid["host_coords"])
 
 
 def _view_attrs(generation: str, view: HostView, raw: str
@@ -694,6 +765,392 @@ def cluster_fragmentation(
 
 
 # ====================================================================
+# incremental fragmentation accounting (ISSUE 17)
+# ====================================================================
+
+
+class FragAccountant:
+    """Per-node cached placement state, updated per WATCH EVENT instead
+    of reparsed per decision (ISSUE 17): the SliceCache forwards every
+    sync/event on its writer thread, and the accountant keeps — per
+    (node, generation) — the parsed grid, the HostView, the per-host
+    fragmentation record, and a per-generation FragAggregate rollup.
+    A single slice flip costs one slice reparse + one aggregate delta
+    (O(request), counted by `frag_delta_applies_total`); a full
+    recompute happens only when a 410-compaction relist actually
+    changed a slice (`frag_full_recomputes_total`), and relisted
+    slices whose resourceVersion / pool generation / placement
+    generation are UNCHANGED are skipped entirely
+    (`relist_unchanged_skips_total` — the ISSUE 17 bugfix).
+
+    Concurrency: all bookkeeping mutates under `_lock` (the reflector
+    writer thread, plus schedulers feeding back commit deltas via
+    `apply_placement`). Readers NEVER lock: the published surfaces
+    (`views_by_generation`, `attrs_index`, `observed_generations`,
+    `fragmentation`) are plain dicts mutated copy-on-KEY-change —
+    value stores swap in place (GIL-atomic, resize-free), key inserts/
+    deletes replace the whole dict — so the zero-lock read-path gates
+    keep pinning 0. The cross-host mesh term is computed LAZILY by
+    readers and memoized on a writer-bumped epoch (only fully-free-host
+    membership changes invalidate it): a writer-side mesh recompute per
+    event would be O(fully_free_hosts x window shapes), exactly the
+    fleet-proportional cost this class exists to remove."""
+
+    STAT_KEYS = ("frag_delta_applies_total", "frag_full_recomputes_total",
+                 "relist_unchanged_skips_total", "slice_reparses_total")
+
+    def __init__(self, pod_dims: Optional[Tuple[int, ...]] = None) -> None:
+        self.pod_dims = tuple(pod_dims) if pod_dims else None
+        self._lock = lockdep.instrument(
+            "fleetplace.FragAccountant._lock", threading.Lock())
+        self.stats = {key: AtomicCounter() for key in self.STAT_KEYS}
+        # writer-private bookkeeping (under _lock)
+        self._keys: Dict[str, tuple] = {}      # name -> (rv, gen, pgen)
+        self._entries: Dict[str, tuple] = {}   # name -> parsed grids
+        self._sources: Dict[tuple, set] = {}   # (node, gen) -> {name}
+        self._records: Dict[tuple, dict] = {}  # (node, gen) -> frag rec
+        self._fully: Dict[tuple, bool] = {}    # (node, gen) -> fully free
+        self._aggs: Dict[str, object] = {}     # gen -> FragAggregate
+        self._node_slices: Dict[str, set] = {}
+        self._slice_nodes: Dict[str, str] = {}
+        self._slice_pgens: Dict[str, int] = {}
+        # published read surfaces (lock-free readers; see class doc)
+        self._views: Dict[str, Dict[str, HostView]] = {}
+        self._attrs: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        self._gens: Dict[str, int] = {}        # node -> placement gen
+        self._frag: Dict[str, dict] = {}       # gen -> rollup(0) record
+        self._mesh_epoch: Dict[str, int] = {}
+        self._mesh_memo: Dict[str, tuple] = {}  # gen -> (epoch, chips)
+        # monotonic mutation stamp: schedulers memoize their ledger
+        # overlay on it (a GIL-atomic int read)
+        self.version = 0
+
+    @staticmethod
+    def _slice_key(obj: Mapping) -> tuple:
+        """The skip-detection identity: a slice whose resourceVersion,
+        pool generation AND placement generation all match the cached
+        copy cannot change any derived state."""
+        meta = obj.get("metadata") or {}
+        pool = (obj.get("spec") or {}).get("pool") or {}
+        return (meta.get("resourceVersion"), pool.get("generation"),
+                pool.get("placementGeneration", 0))
+
+    # ------------------------------------------------- writer side
+
+    def on_sync(self, fresh: Mapping[str, dict]) -> None:
+        """Full LIST state (initial sync or 410-compaction relist):
+        vanished slices drop, changed slices fully recompute, and
+        generation-identical slices SKIP — counted, so the regression
+        test can prove a relist did not reparse the unchanged fleet."""
+        with self._lock:
+            for name in [n for n in self._keys if n not in fresh]:
+                self._apply_slice_locked(name, None)
+            for name, obj in fresh.items():
+                if self._keys.get(name) == self._slice_key(obj):
+                    self.stats["relist_unchanged_skips_total"].add()
+                    continue
+                self.stats["slice_reparses_total"].add()
+                self.stats["frag_full_recomputes_total"].add()
+                self._apply_slice_locked(name, obj)
+            self._publish_frag_locked()
+
+    def on_event(self, evt: Mapping) -> None:
+        """One watch event -> one slice reparse -> O(1) aggregate
+        deltas. Duplicate deliveries (the at-least-once contract) hit
+        the same unchanged-identity skip as relists."""
+        obj = evt.get("object") or {}
+        name = (obj.get("metadata") or {}).get("name")
+        if not name:
+            return
+        with self._lock:
+            if evt.get("type") == "DELETED":
+                if name in self._keys or name in self._entries:
+                    n = self._apply_slice_locked(name, None)
+                    self._count_deltas(n)
+                    self._publish_frag_locked()
+                return
+            if self._keys.get(name) == self._slice_key(obj):
+                self.stats["relist_unchanged_skips_total"].add()
+                return
+            self.stats["slice_reparses_total"].add()
+            n = self._apply_slice_locked(name, obj)
+            self._count_deltas(n)
+            self._publish_frag_locked()
+
+    def apply_placement(self, slices_delta) -> int:
+        """Commit feedback: the fabric's CAS commit returns the slices
+        it restamped ({name, node, resource_version, generation,
+        placement_generation, consumed}); folding them in immediately
+        closes the commit-to-watch-event window — and stamps the
+        post-commit identity, so the watch event that follows is an
+        unchanged-identity skip (idempotent in either arrival order)."""
+        applied = 0
+        with self._lock:
+            for rec in slices_delta or ():
+                name = rec.get("name")
+                if not name or name not in self._entries:
+                    continue
+                key3 = (rec.get("resource_version"),
+                        rec.get("generation"),
+                        rec.get("placement_generation") or 0)
+                if self._keys.get(name) == key3:
+                    continue
+                consumed = rec.get("consumed") or {}
+                patched = []
+                for ekey, grid, attrs in self._entries[name]:
+                    g = dict(grid)
+                    g["consumed"] = {
+                        b: str(consumed[b])
+                        for b in g["coords"] if b in consumed}
+                    patched.append((ekey, g, attrs))
+                applied += self._store_entries_locked(
+                    name, tuple(patched), key3,
+                    int(rec.get("placement_generation") or 0),
+                    rec.get("node"))
+            if applied:
+                self._publish_frag_locked()
+        self._count_deltas(applied)
+        return applied
+
+    def _count_deltas(self, n: int) -> None:
+        for _ in range(n):
+            self.stats["frag_delta_applies_total"].add()
+
+    def _apply_slice_locked(self, name: str,
+                            obj: Optional[Mapping]) -> int:
+        if obj is None:
+            return self._store_entries_locked(name, (), None, 0, None)
+        spec = obj.get("spec") or {}
+        pool = spec.get("pool") or {}
+        return self._store_entries_locked(
+            name, tuple(_parse_slice_grids(obj)), self._slice_key(obj),
+            int(pool.get("placementGeneration") or 0),
+            spec.get("nodeName"))
+
+    def _store_entries_locked(self, name: str, new_entries: tuple,
+                              key3, pgen: int,
+                              node: Optional[str]) -> int:
+        old_entries = self._entries.pop(name, ())
+        old_keys = {k for k, _g, _a in old_entries}
+        new_keys = {k for k, _g, _a in new_entries}
+        if new_entries:
+            self._entries[name] = new_entries
+            self._keys[name] = key3
+        else:
+            self._keys.pop(name, None)
+        for key in old_keys - new_keys:
+            srcs = self._sources.get(key)
+            if srcs:
+                srcs.discard(name)
+                if not srcs:
+                    del self._sources[key]
+        for key in new_keys - old_keys:
+            self._sources.setdefault(key, set()).add(name)
+        touched = old_keys | new_keys
+        for key in sorted(touched):
+            self._rebuild_key_locked(key)
+        self._update_node_gen_locked(name, node, pgen,
+                                     bool(new_entries))
+        self.version += 1
+        return len(touched)
+
+    def _rebuild_key_locked(self, key: tuple) -> None:
+        from . import placement
+        node, generation = key
+        # merge every contributing slice's grid for this key (several
+        # slices can feed one (node, generation) — the same merge
+        # host_views_from_slices does)
+        grid = None
+        attrs: Dict[str, dict] = {}
+        for name in sorted(self._sources.get(key) or ()):
+            for ekey, egrid, eattrs in self._entries.get(name, ()):
+                if ekey != key:
+                    continue
+                if grid is None:
+                    grid = {"dims": egrid["dims"],
+                            "coords": dict(egrid["coords"]),
+                            "names": dict(egrid["names"]),
+                            "consumed": dict(egrid["consumed"]),
+                            "host_coords": egrid["host_coords"]}
+                else:
+                    grid["coords"].update(egrid["coords"])
+                    grid["names"].update(egrid["names"])
+                    grid["consumed"].update(egrid["consumed"])
+                attrs.update(eattrs)
+        old_rec = self._records.pop(key, None)
+        old_fully = self._fully.pop(key, False)
+        agg = self._aggs.get(generation)
+        if old_rec is not None and agg is not None:
+            agg.remove(old_rec, old_fully)
+        if grid is None:
+            if agg is not None and agg.hosts == 0:
+                del self._aggs[generation]
+            self._publish_view_locked(generation, node, None)
+            self._publish_attrs_locked(key, None)
+            if old_fully:
+                self._bump_mesh_locked(generation)
+            return
+        # base view: the fabric's consumed overlay only — scheduler
+        # ledgers are per-scheduler and overlay downstream
+        view = _grid_view(node, generation, grid, {})
+        rec = placement.fragmentation(view)
+        fully = (not view.departed
+                 and len(view.free) == volume(view.dims))
+        self._records[key] = rec
+        self._fully[key] = fully
+        if agg is None:
+            agg = self._aggs[generation] = placement.FragAggregate()
+        agg.add(rec, fully)
+        self._publish_view_locked(generation, node, view)
+        self._publish_attrs_locked(key, attrs)
+        if fully or old_fully:
+            self._bump_mesh_locked(generation)
+
+    def _update_node_gen_locked(self, name: str, node: Optional[str],
+                                pgen: int, present: bool) -> None:
+        old_node = self._slice_nodes.get(name)
+        if present and node:
+            self._slice_nodes[name] = node
+            self._slice_pgens[name] = pgen or 0
+            self._node_slices.setdefault(node, set()).add(name)
+        else:
+            node = old_node
+            self._slice_nodes.pop(name, None)
+            self._slice_pgens.pop(name, None)
+            if old_node:
+                group = self._node_slices.get(old_node)
+                if group:
+                    group.discard(name)
+                    if not group:
+                        del self._node_slices[old_node]
+        for n in {x for x in (node, old_node) if x}:
+            names = self._node_slices.get(n)
+            if names:
+                self._publish_gen_locked(n, max(
+                    self._slice_pgens.get(m, 0) for m in names))
+            else:
+                self._publish_gen_locked(n, None)
+
+    # published-surface writes: value swap in place, dict copy on any
+    # key-set change (readers iterate these dicts lock-free)
+
+    def _publish_view_locked(self, generation: str, node: str,
+                             view: Optional[HostView]) -> None:
+        views = self._views
+        inner = views.get(generation)
+        if view is None:
+            if not inner or node not in inner:
+                return
+            fresh_inner = dict(inner)
+            del fresh_inner[node]
+            fresh = dict(views)
+            if fresh_inner:
+                fresh[generation] = fresh_inner
+            else:
+                del fresh[generation]
+            self._views = fresh
+        elif inner is not None and node in inner:
+            inner[node] = view
+        else:
+            fresh_inner = dict(inner or {})
+            fresh_inner[node] = view
+            fresh = dict(views)
+            fresh[generation] = fresh_inner
+            self._views = fresh
+
+    def _publish_attrs_locked(self, key: tuple,
+                              attrs: Optional[dict]) -> None:
+        cur = self._attrs
+        if attrs is None:
+            if key in cur:
+                fresh = dict(cur)
+                del fresh[key]
+                self._attrs = fresh
+        elif key in cur:
+            cur[key] = attrs
+        else:
+            fresh = dict(cur)
+            fresh[key] = attrs
+            self._attrs = fresh
+
+    def _publish_gen_locked(self, node: str,
+                            gen: Optional[int]) -> None:
+        gens = self._gens
+        if gen is None:
+            if node in gens:
+                fresh = dict(gens)
+                del fresh[node]
+                self._gens = fresh
+        elif node in gens:
+            gens[node] = gen
+        else:
+            fresh = dict(gens)
+            fresh[node] = gen
+            self._gens = fresh
+
+    def _publish_frag_locked(self) -> None:
+        self._frag = {gen: agg.rollup()
+                      for gen, agg in self._aggs.items()}
+
+    def _bump_mesh_locked(self, generation: str) -> None:
+        fresh = dict(self._mesh_epoch)
+        fresh[generation] = fresh.get(generation, 0) + 1
+        self._mesh_epoch = fresh
+
+    # ------------------------------------------------- reader side
+
+    def views_by_generation(self) -> Mapping[str, Mapping[str, HostView]]:
+        """{generation: {node: HostView}} — the fabric-truth base views
+        (consumed overlay applied, no scheduler ledger). Lock-free."""
+        return self._views
+
+    def attrs_index(self) -> Mapping[Tuple[str, str], Dict[str, dict]]:
+        return self._attrs
+
+    def observed_generations(self) -> Mapping[str, int]:
+        """{node: placement generation} as last seen from the watch
+        plane — the CAS observation a scheduler commits against."""
+        return self._gens
+
+    def fragmentation(self) -> Dict[str, dict]:
+        """The cluster_fragmentation record shape from the maintained
+        aggregates — O(generations) plus a lazily-memoized mesh scan
+        (recomputed only when fully-free-host membership changed).
+        Zero locks: safe inside the fleetplace.frag read-path gate."""
+        out: Dict[str, dict] = {}
+        frag = self._frag
+        views = self._views
+        for generation in sorted(frag):
+            rec = dict(frag[generation])
+            mesh = self._mesh_for(generation, views.get(generation))
+            rec["largest_free_mesh"] = mesh
+            largest = max(rec["largest_free_box"], mesh)
+            free = rec["free"]
+            rec["fragmentation"] = (0.0 if free == 0
+                                    else round(1.0 - largest / free, 4))
+            out[generation] = rec
+        return out
+
+    def _mesh_for(self, generation: str, inner) -> int:
+        if self.pod_dims is None or not inner:
+            return 0
+        epoch = self._mesh_epoch.get(generation, 0)
+        memo = self._mesh_memo.get(generation)
+        if memo is not None and memo[0] == epoch:
+            return memo[1]
+        mesh = _largest_free_mesh(list(inner.values()), self.pod_dims)
+        # racing reader stores are benign: both computed from the same
+        # or a newer epoch's views, and the epoch tag keeps them honest
+        self._mesh_memo[generation] = (epoch, mesh)
+        return mesh
+
+    def snapshot(self) -> dict:
+        out = {key: c.value for key, c in self.stats.items()}
+        out["tracked_slices"] = len(self._keys)
+        return out
+
+
+# ====================================================================
 # fleet flight collector (the cross-node trace waterfall, ISSUE 15)
 # ====================================================================
 
@@ -798,6 +1255,66 @@ class FleetFlight:
 # ====================================================================
 
 
+class _WaveIndex:
+    """Working free-capacity index for ONE decision wave: candidate
+    hosts bucketed by free-chip count, working copies updated as the
+    wave reserves capacity claim-by-claim. A request probes a bounded
+    number of single-host candidates best-fit-first (decision cost
+    scales with the request), falling back to the full fleet planner
+    only for shapes a single host cannot satisfy — the rare path at
+    storm scale."""
+
+    PROBES = 8
+
+    def __init__(self, views: Sequence[HostView]) -> None:
+        self._views: List[HostView] = list(views)
+        self._node_idx: Dict[str, List[int]] = {}
+        self._buckets: Dict[int, Dict[int, None]] = {}
+        for i, v in enumerate(self._views):
+            self._node_idx.setdefault(v.node, []).append(i)
+            if v.free:
+                self._buckets.setdefault(len(v.free), {})[i] = None
+
+    def plan(self, shape, best_effort: bool,
+             pod_dims: Optional[Tuple[int, ...]]):
+        from . import placement
+        need = volume(shape)
+        tried = 0
+        for count in sorted(c for c in self._buckets if c >= need):
+            for i in list(self._buckets[count]):
+                plan = placement.plan_slice(shape, [self._views[i]],
+                                            pod_dims=pod_dims)
+                if plan is not None:
+                    return plan
+                tried += 1
+                if tried >= self.PROBES:
+                    break
+            if tried >= self.PROBES:
+                break
+        return placement.plan_slice(shape, self._views,
+                                    best_effort=best_effort,
+                                    pod_dims=pod_dims)
+
+    def reserve(self, plan) -> None:
+        for node, raws in plan.shards:
+            taken = frozenset(raws)
+            for i in self._node_idx.get(node, ()):
+                view = self._views[i]
+                if not (taken & view.free):
+                    continue
+                old = len(view.free)
+                view = replace(view, free=view.free - taken)
+                self._views[i] = view
+                bucket = self._buckets.get(old)
+                if bucket is not None:
+                    bucket.pop(i, None)
+                    if not bucket:
+                        del self._buckets[old]
+                if view.free:
+                    self._buckets.setdefault(
+                        len(view.free), {})[i] = None
+
+
 class FleetScheduler:
     """Cluster-wide slice scheduler over the published topology.
 
@@ -818,7 +1335,12 @@ class FleetScheduler:
                  reflector=None,
                  views_source: Optional[Callable[[], Mapping[
                      str, Sequence[HostView]]]] = None,
-                 pod_dims: Optional[Tuple[int, ...]] = None) -> None:
+                 pod_dims: Optional[Tuple[int, ...]] = None,
+                 shard_index: int = 0, shard_count: int = 1,
+                 partition: bool = False,
+                 wave_max: int = 64, wave_window_s: float = 0.0,
+                 replan_max: int = 3,
+                 conflict_wait_s: float = 2.0) -> None:
         if cache is None and views_source is None:
             raise ValueError("FleetScheduler needs a SliceCache or a "
                              "views_source")
@@ -827,6 +1349,23 @@ class FleetScheduler:
         self.reflector = reflector
         self._views_source = views_source
         self.pod_dims = tuple(pod_dims) if pod_dims else None
+        # sharded-fleet identity (ISSUE 17): N schedulers share one
+        # fabric; `partition` additionally narrows THIS instance's
+        # offered capacity to its node band so a partitioned fleet
+        # converges with ~zero CAS conflicts, while partition=False
+        # exercises the full optimistic-concurrency conflict path
+        self.shard_index = int(shard_index)
+        self.shard_count = max(1, int(shard_count))
+        self.partition = bool(partition)
+        self.wave_max = max(1, int(wave_max))
+        self.wave_window_s = float(wave_window_s)
+        self.replan_max = max(0, int(replan_max))
+        self.conflict_wait_s = float(conflict_wait_s)
+        self._obs_ok: Optional[bool] = None
+        self._defrag_fb_ok: Optional[bool] = None
+        self._pending: List[dict] = []
+        self._pending_lock = lockdep.instrument(
+            "fleetplace.FleetScheduler._pending_lock", threading.Lock())
         # claim ledger: uid -> ((sub_uid, node, raws), ...) — each
         # shard carries its node-level claim identity, minted at
         # placement (`<uid>-<node>`) and KEPT across defrag migrations
@@ -853,7 +1392,8 @@ class FleetScheduler:
             "rollbacks_total", "releases_total", "defrag_waves_total",
             "defrag_moves_total", "selector_compile_errors_total",
             "bias_applied_total", "bias_cleared_total",
-            "drains_planned_total")}
+            "drains_planned_total", "decision_waves_total",
+            "commit_conflicts_total", "replans_total")}
         # remediation seam: nodes the self-heal plane is steering new
         # placements away from (exemplar->node attribution pinned a
         # host). Copy-on-write frozenset — the zero-lock decision read
@@ -916,14 +1456,22 @@ class FleetScheduler:
         views with the same fields the daemon publishes, so selectors
         behave identically with or without a watch plane."""
         if self.cache is not None:
-            snap = self.cache.snapshot()
+            # incremental path (ISSUE 17): the accountant maintained
+            # the base views per WATCH EVENT; only the ledger overlay
+            # is (re)applied here, memoized on the accountant's
+            # mutation stamp + the ledger identity — a decision never
+            # reparses the fleet
+            acct = self.cache.accountant
+            version = acct.version
             claims = self._claims
             memo = self._views_memo
-            if memo is not None and memo[0] is snap \
+            if memo is not None and memo[0] == version \
                     and memo[1] is claims:
                 return memo[2], memo[3]
-            views, idx = host_views_from_slices(snap, claims)
-            self._views_memo = (snap, claims, views, idx)
+            views = self._overlay_ledger(acct.views_by_generation(),
+                                         claims)
+            idx = acct.attrs_index()
+            self._views_memo = (version, claims, views, idx)
             return views, idx
         views = {gen: list(vs)
                  for gen, vs in self._views_source().items()}
@@ -935,6 +1483,73 @@ class FleetScheduler:
                     raw: _view_attrs(gen, view, raw)
                     for raw in view.coords}
         return views, attrs_index
+
+    def _overlay_ledger(self, base: Mapping[str, Mapping[str, HostView]],
+                        claims: Mapping[str, Tuple]
+                        ) -> Dict[str, List[HostView]]:
+        """Stamp THIS scheduler's ledger onto the accountant's base
+        views. The base busy set is the fabric's consumed overlay
+        (parent multiclaim uids — every peer's commits included); where
+        the ledger also knows a chip, its SUB-uid wins: that is the id
+        the node checkpoint holds, the one defrag can unprepare. Views
+        without ledger chips pass through untouched (shared with the
+        accountant's published dicts — never mutated)."""
+        by_node: Dict[str, Dict[str, str]] = {}
+        for _uid, shards in claims.items():
+            for sub_uid, node, raws in shards:
+                dest = by_node.setdefault(node, {})
+                for raw in raws:
+                    dest[raw] = sub_uid
+        out: Dict[str, List[HostView]] = {}
+        for generation in sorted(base):
+            inner = base[generation]
+            views: List[HostView] = []
+            for node in sorted(inner):
+                view = inner[node]
+                ledger = by_node.get(node)
+                if ledger:
+                    view = self._overlay_view(view, ledger)
+                views.append(view)
+            out[generation] = views
+        return out
+
+    @staticmethod
+    def _overlay_view(view: HostView,
+                      ledger: Mapping[str, str]) -> HostView:
+        busy: Dict[str, str] = {}
+        for uid, raws in view.claims.items():
+            for raw in raws:
+                busy[raw] = uid
+        changed = False
+        for raw, sub_uid in ledger.items():
+            if raw in view.coords and busy.get(raw) != sub_uid:
+                busy[raw] = sub_uid
+                changed = True
+        if not changed:
+            return view
+        claim_raws: Dict[str, List[str]] = {}
+        for raw in sorted(busy):
+            claim_raws.setdefault(busy[raw], []).append(raw)
+        return replace(
+            view,
+            free=frozenset(r for r in view.coords if r not in busy),
+            claims={uid: tuple(raws)
+                    for uid, raws in claim_raws.items()})
+
+    def _owns_node(self, view: HostView) -> bool:
+        """Partition membership for `partition=True` fleets: host-grid
+        row bands when the pod grid is modeled (keeps each shard's
+        nodes ICI-adjacent, so in-shard cross-host meshes survive),
+        stable hashing otherwise."""
+        if self.shard_count <= 1:
+            return True
+        hc = view.host_coords
+        if hc and self.pod_dims:
+            band = max(1, self.pod_dims[0] // self.shard_count)
+            return min(hc[0] // band,
+                       self.shard_count - 1) == self.shard_index
+        return (zlib.crc32(view.node.encode())
+                % self.shard_count) == self.shard_index
 
     @staticmethod
     def _filter_views(views_by_gen: Mapping[str, Sequence[HostView]],
@@ -972,12 +1587,16 @@ class FleetScheduler:
             filtered = self._filter_views(views_by_gen, attrs_index,
                                           compiled)
             avoid = self._avoid_nodes          # GIL-atomic ref read
+            shard = self.partition and self.shard_count > 1
             out = []
             for views in filtered.values():
                 for v in views:
-                    if v.free and v.node in avoid:
-                        # biased-away host: still occupancy (its claims
-                        # keep blocking boxes) but offers no capacity
+                    if v.free and (v.node in avoid
+                                   or (shard
+                                       and not self._owns_node(v))):
+                        # biased-away or out-of-shard host: still
+                        # occupancy (its claims keep blocking boxes)
+                        # but offers no capacity
                         v = replace(v, free=frozenset())
                     out.append(v)
             return out, compiled
@@ -991,12 +1610,17 @@ class FleetScheduler:
                  best_effort: bool = False,
                  fail_node: Optional[str] = None) -> dict:
         """One cluster placement decision end-to-end: selector-filtered
-        views → plan (cross-host mesh aware) → execution through the
-        multiclaim fabric — logged decision → sub-claims → rollback/
-        commit, spanned on the flight recorder."""
+        views → plan (cross-host mesh aware) → optimistic CAS commit
+        through the multiclaim fabric. A conflicting commit (a peer
+        scheduler consumed a planned chip first) is a clean counted
+        abort: the fabric refused atomically, the executor unwound the
+        prepares, and the decision REPLANS against the caught-up cache
+        — plan → conflict-abort → replan → commit all on ONE trace id
+        (the /debug/fleet/trace waterfall of a contended claim)."""
         from . import placement
         shape = placement.parse_shape(shape)
         self.stats["decisions_total"].add()
+        t0 = time.monotonic()
         with trace.span("fleetplace.schedule", claim_uid=uid,
                         shape="x".join(str(d) for d in shape),
                         selector=selector or ""):
@@ -1006,42 +1630,418 @@ class FleetScheduler:
             # caller can open /debug/fleet/trace?trace= directly
             ctx = trace.current_context()
             trace_id = ctx["trace_id"] if ctx else None
-            views, _compiled = self.eligible_views(selector)
-            plan = placement.plan_slice(shape, views,
-                                        best_effort=best_effort,
-                                        pod_dims=self.pod_dims)
-            self._note("decided", uid, {
-                "shape": list(shape), "selector": selector or "",
-                "shards": None if plan is None
-                else [[n, list(r)] for n, r in plan.shards]})
-            if plan is None:
-                self.stats["unplaceable_total"].add()
-                self._note("unplaceable", uid, None)
-                trace.event("fleetplace.unplaceable", claim_uid=uid)
-                return {"uid": uid, "placed": False,
-                        "reason": "unplaceable", "trace_id": trace_id}
-            if self.executor is None:
-                # plan-only mode (dry runs / what-if): the decision is
-                # logged as advisory, never committed
-                self._note("advisory", uid, None)
-                return {"uid": uid, "placed": True, "advisory": True,
-                        "trace_id": trace_id,
-                        "score": plan.score, "hosts": plan.hosts,
-                        "shards": [(n, list(r)) for n, r in plan.shards]}
+            attempt = 0
+            while True:
+                if attempt == 0:
+                    result = self._attempt_once(
+                        shape, uid, selector, best_effort, fail_node,
+                        trace_id)
+                else:
+                    with trace.span("fleetplace.replan",
+                                    claim_uid=uid, attempt=attempt):
+                        result = self._attempt_once(
+                            shape, uid, selector, best_effort,
+                            fail_node, trace_id)
+                if result.get("conflict"):
+                    self.stats["commit_conflicts_total"].add()
+                    trace.event(
+                        "fleetplace.conflict_abort", claim_uid=uid,
+                        attempt=attempt,
+                        nodes=",".join(sorted(
+                            result.get("conflicts") or ())))
+                    if attempt < self.replan_max:
+                        attempt += 1
+                        self.stats["replans_total"].add()
+                        self._await_catchup(
+                            result.get("placement_gens") or {},
+                            result.get("conflicts") or ())
+                        continue
+                break
+            ms = (time.monotonic() - t0) * 1e3
+            result.setdefault("latency_ms", round(ms, 3))
+            trace.observe("tdp_fleet_decision_ms", ms,
+                          exemplar=trace_id)
+            return result
+
+    def _attempt_once(self, shape, uid: str, selector: str,
+                      best_effort: bool, fail_node: Optional[str],
+                      trace_id: Optional[str]) -> dict:
+        """One plan→execute attempt of a decision (the body schedule()
+        replans on CAS conflict). Every attempt logs a fresh `decided`
+        entry — the audit's prepared-set tracking resets with it, so a
+        conflict-unwound attempt followed by a replan stays clean."""
+        from . import placement
+        views, _compiled = self.eligible_views(selector)
+        plan = placement.plan_slice(shape, views,
+                                    best_effort=best_effort,
+                                    pod_dims=self.pod_dims)
+        self._note("decided", uid, {
+            "shape": list(shape), "selector": selector or "",
+            "shards": None if plan is None
+            else [[n, list(r)] for n, r in plan.shards]})
+        if plan is None:
+            self.stats["unplaceable_total"].add()
+            self._note("unplaceable", uid, None)
+            trace.event("fleetplace.unplaceable", claim_uid=uid)
+            return {"uid": uid, "placed": False,
+                    "reason": "unplaceable", "trace_id": trace_id}
+        if self.executor is None:
+            # plan-only mode (dry runs / what-if): the decision is
+            # logged as advisory, never committed
+            self._note("advisory", uid, None)
+            return {"uid": uid, "placed": True, "advisory": True,
+                    "trace_id": trace_id,
+                    "score": plan.score, "hosts": plan.hosts,
+                    "shards": [(n, list(r)) for n, r in plan.shards]}
+        observed = self._observed_for(plan)
+        if observed is None or not self._observed_supported():
             result = self.executor.execute_plan(
                 plan, uid, fail_node=fail_node, observer=self._note)
-            result.setdefault("trace_id", trace_id)
-            if result.get("placed"):
-                with self._claims_lock:
-                    fresh = dict(self._claims)
-                    fresh[uid] = tuple(
-                        (f"{uid}-{node}", node, tuple(raws))
-                        for node, raws in plan.shards)
-                    self._claims = fresh
-                self.stats["placed_total"].add()
+        else:
+            result = self.executor.execute_plan(
+                plan, uid, fail_node=fail_node, observer=self._note,
+                observed=observed)
+        result.setdefault("trace_id", trace_id)
+        if result.get("placed"):
+            self._commit_ledger(uid, plan.shards)
+            self.stats["placed_total"].add()
+            self._apply_commit_feedback(result)
+            trace.event("fleetplace.commit", claim_uid=uid)
+        elif not result.get("conflict"):
+            self.stats["rollbacks_total"].add()
+        return result
+
+    def _commit_ledger(self, uid: str, shards) -> None:
+        with self._claims_lock:
+            fresh = dict(self._claims)
+            fresh[uid] = tuple(
+                (f"{uid}-{node}", node, tuple(raws))
+                for node, raws in shards)
+            self._claims = fresh
+
+    def _observed_for(self, plan) -> Optional[Dict[str, int]]:
+        """The CAS observation: per planned node, the placement
+        generation this scheduler's cache last saw. None in
+        views_source mode — no watch plane, no concurrent peers."""
+        if self.cache is None:
+            return None
+        gens = self.cache.accountant.observed_generations()
+        return {node: gens.get(node, 0) for node, _raws in plan.shards}
+
+    def _observed_supported(self) -> bool:
+        """Does the attached executor's execute_plan take `observed`?
+        Probed once (test doubles predate the CAS protocol)."""
+        flag = self._obs_ok
+        if flag is None:
+            import inspect
+            try:
+                flag = "observed" in inspect.signature(
+                    self.executor.execute_plan).parameters
+            except (TypeError, ValueError):
+                flag = False
+            self._obs_ok = flag
+        return flag
+
+    def _defrag_feedback_ok(self) -> bool:
+        """Does the executor's apply_defrag hand back restamp deltas
+        (deltas_out)? Probed once, like _observed_supported."""
+        flag = self._defrag_fb_ok
+        if flag is None:
+            import inspect
+            try:
+                flag = "deltas_out" in inspect.signature(
+                    self.executor.apply_defrag).parameters
+            except (TypeError, ValueError, AttributeError):
+                flag = False
+            self._defrag_fb_ok = flag
+        return flag
+
+    def _apply_commit_feedback(self, result: Mapping) -> None:
+        """Fold the commit's restamped-slice deltas into the accountant
+        immediately: the cache converges without waiting on the watch
+        round-trip, and the later MODIFIED event lands as an
+        unchanged-identity skip."""
+        placement_rec = result.get("placement")
+        if not placement_rec or self.cache is None:
+            return
+        self.cache.accountant.apply_placement(
+            placement_rec.get("slices") or ())
+
+    def _await_catchup(self, target_gens: Mapping[str, int],
+                       nodes) -> None:
+        """Block (bounded) until the watch plane delivered the peer
+        commit that beat us: replanning before the conflicted nodes'
+        views catch up to the generations the fabric reported would
+        re-pick the same chips and conflict again."""
+        if self.cache is None:
+            return
+        wanted = set(nodes)
+        want = {n: g for n, g in (target_gens or {}).items()
+                if not wanted or n in wanted}
+        if not want:
+            return
+        acct = self.cache.accountant
+        deadline = time.monotonic() + self.conflict_wait_s
+        while time.monotonic() < deadline:
+            gens = acct.observed_generations()
+            if all(gens.get(n, 0) >= g for n, g in want.items()):
+                return
+            time.sleep(0.005)
+
+    # ------------------------------------------- decision waves (r19)
+
+    def submit(self, shape, uid: str, selector: str = "",
+               best_effort: bool = False) -> int:
+        """Queue a claim for the next decision wave. Returns the queue
+        depth; `pump()` fires the wave by the group-commit rules."""
+        from . import placement
+        req = {"shape": placement.parse_shape(shape), "uid": uid,
+               "selector": (selector or ""),
+               "best_effort": bool(best_effort),
+               "t0": time.monotonic()}
+        with self._pending_lock:
+            self._pending.append(req)
+            return len(self._pending)
+
+    def pump(self, force: bool = False) -> List[dict]:
+        """Fire a wave when the PR 4 group-commit rules say so: a full
+        wave (`wave_max`), an expired wave window, or a LONE claim —
+        which commits immediately, never waiting for company that may
+        not come."""
+        with self._pending_lock:
+            if not self._pending:
+                return []
+            age = time.monotonic() - self._pending[0]["t0"]
+            if not (force or len(self._pending) == 1
+                    or len(self._pending) >= self.wave_max
+                    or age >= self.wave_window_s):
+                return []
+            batch = self._pending[:self.wave_max]
+            self._pending = self._pending[self.wave_max:]
+        return self.schedule_wave(batch)
+
+    def drain(self) -> List[dict]:
+        """Flush the queue through forced waves (harness teardown)."""
+        out: List[dict] = []
+        while True:
+            fired = self.pump(force=True)
+            if not fired:
+                return out
+            out.extend(fired)
+
+    def schedule_wave(self, requests,
+                      best_effort: bool = False) -> List[dict]:
+        """One batched decision wave over a claim storm: ONE snapshot
+        acquisition, ONE volume-sorted planning pass against a working
+        free-capacity index (decision cost scales with the request —
+        the accountant keeps the views, the index narrows candidates),
+        and ONE batched fabric commit round for the whole wave — the
+        PR 4 group-commit shape lifted to the scheduler tier. Requests
+        are (shape, uid) pairs or submit()-shaped dicts. CAS conflicts
+        replan in bounded follow-up rounds; every claim's result
+        carries its decision latency and trace id."""
+        from . import placement
+        reqs: List[dict] = []
+        for r in requests:
+            if isinstance(r, Mapping):
+                reqs.append({
+                    "shape": placement.parse_shape(r["shape"]),
+                    "uid": r["uid"],
+                    "selector": (r.get("selector") or ""),
+                    "best_effort": bool(r.get("best_effort",
+                                              best_effort)),
+                    "t0": r.get("t0")})
             else:
-                self.stats["rollbacks_total"].add()
-            return result
+                shape, uid = r
+                reqs.append({"shape": placement.parse_shape(shape),
+                             "uid": uid, "selector": "",
+                             "best_effort": best_effort, "t0": None})
+        if not reqs:
+            return []
+        wave_start = time.monotonic()
+        for req in reqs:
+            if req["t0"] is None:
+                req["t0"] = wave_start
+            self.stats["decisions_total"].add()
+        self.stats["decision_waves_total"].add()
+        wave_id = self.stats["decision_waves_total"].value
+        results: Dict[str, dict] = {}
+        pending = reqs
+        attempt = 0
+        with trace.span("fleetplace.wave", wave=wave_id,
+                        claims=len(reqs), shard=self.shard_index):
+            while pending:
+                batch = self._plan_wave(pending, wave_id, attempt,
+                                        results)
+                if not batch:
+                    break
+                outcomes = self._execute_batch(batch)
+                conflicted: List[Tuple[dict, dict]] = []
+                for item in batch:
+                    uid = item["uid"]
+                    res = outcomes.get(uid) or {
+                        "uid": uid, "placed": False,
+                        "reason": "no_result"}
+                    res.setdefault("trace_id",
+                                   item["req"].get("trace_id"))
+                    if res.get("placed"):
+                        if not res.get("advisory"):
+                            self._commit_ledger(
+                                uid, item["plan"].shards)
+                            self.stats["placed_total"].add()
+                            self._apply_commit_feedback(res)
+                            trace.event("fleetplace.commit",
+                                        claim_uid=uid,
+                                        link=item["req"].get("lctx"))
+                        results[uid] = res
+                    elif res.get("conflict"):
+                        self.stats["commit_conflicts_total"].add()
+                        trace.event(
+                            "fleetplace.conflict_abort",
+                            claim_uid=uid, attempt=attempt,
+                            link=item["req"].get("lctx"),
+                            nodes=",".join(sorted(
+                                res.get("conflicts") or ())))
+                        conflicted.append((item, res))
+                    else:
+                        self.stats["rollbacks_total"].add()
+                        results[uid] = res
+                if not conflicted:
+                    break
+                if attempt >= self.replan_max:
+                    for item, res in conflicted:
+                        results[item["uid"]] = res
+                    break
+                attempt += 1
+                targets: Dict[str, int] = {}
+                for item, res in conflicted:
+                    self.stats["replans_total"].add()
+                    for n, g in (res.get("placement_gens")
+                                 or {}).items():
+                        targets[n] = max(targets.get(n, 0), g)
+                self._await_catchup(targets, ())
+                pending = [item["req"] for item, _res in conflicted]
+        out: List[dict] = []
+        for req in reqs:
+            res = results.get(req["uid"]) or {
+                "uid": req["uid"], "placed": False,
+                "reason": "unplanned"}
+            ms = (time.monotonic() - req["t0"]) * 1e3
+            res.setdefault("latency_ms", round(ms, 3))
+            trace.observe("tdp_fleet_decision_ms", ms,
+                          exemplar=res.get("trace_id"))
+            out.append(res)
+        return out
+
+    def _plan_wave(self, pending: List[dict], wave_id: int,
+                   attempt: int, results: Dict[str, dict]
+                   ) -> List[dict]:
+        """The wave's single sorted planning pass. Per selector group:
+        one eligible_views snapshot, one _WaveIndex, claims planned
+        largest-first (big meshes get first pick of contiguity) with
+        in-wave free-capacity reservations. Observed generations are
+        PRE-BUMPED per in-wave placement on the same node: the fabric
+        applies the batch in order, bumping once per commit, so a later
+        same-node claim's CAS observation anticipates the earlier one's
+        commit instead of conflicting with its own wave."""
+        from . import placement
+        batch: List[dict] = []
+        groups: Dict[str, List[dict]] = {}
+        for req in pending:
+            groups.setdefault(req["selector"], []).append(req)
+        base_gens = None
+        if self.cache is not None:
+            base_gens = dict(
+                self.cache.accountant.observed_generations())
+        wave_bumps: Dict[str, int] = {}
+        for selector in sorted(groups):
+            views, _compiled = self.eligible_views(selector)
+            index = _WaveIndex(views)
+            for req in sorted(groups[selector], key=lambda r: (
+                    -volume(r["shape"]), r["uid"])):
+                uid = req["uid"]
+                op = ("fleetplace.replan" if attempt
+                      else "fleetplace.schedule")
+                with trace.span(op, claim_uid=uid, wave=wave_id,
+                                attempt=attempt,
+                                link=req.get("lctx"),
+                                shape="x".join(
+                                    str(d) for d in req["shape"])):
+                    if req.get("trace_id") is None:
+                        ctx = trace.current_context()
+                        req["trace_id"] = (ctx or {}).get("trace_id")
+                        req["lctx"] = trace.propagate()
+                    plan = index.plan(req["shape"],
+                                      req["best_effort"],
+                                      self.pod_dims)
+                    self._note("decided", uid, {
+                        "shape": list(req["shape"]),
+                        "selector": req["selector"],
+                        "shards": None if plan is None else
+                        [[n, list(r)] for n, r in plan.shards]})
+                    if plan is None:
+                        self.stats["unplaceable_total"].add()
+                        self._note("unplaceable", uid, None)
+                        trace.event("fleetplace.unplaceable",
+                                    claim_uid=uid)
+                        results[uid] = {
+                            "uid": uid, "placed": False,
+                            "reason": "unplaceable",
+                            "trace_id": req["trace_id"]}
+                        continue
+                    index.reserve(plan)
+                    observed = None
+                    if base_gens is not None:
+                        observed = {}
+                        for node, _raws in plan.shards:
+                            observed[node] = (
+                                base_gens.get(node, 0)
+                                + wave_bumps.get(node, 0))
+                        for node, _raws in plan.shards:
+                            wave_bumps[node] = \
+                                wave_bumps.get(node, 0) + 1
+                    batch.append({"plan": plan, "uid": uid,
+                                  "observed": observed,
+                                  "traceparent": req.get("lctx"),
+                                  "req": req})
+        return batch
+
+    def _execute_batch(self, batch: List[dict]) -> Dict[str, dict]:
+        """The wave's single commit round: one executor.execute_wave
+        call (one fabric crossing for every ready claim). Falls back
+        to per-claim execute_plan for executors that predate waves;
+        no executor at all means every plan is advisory."""
+        if self.executor is None:
+            out = {}
+            for item in batch:
+                uid, plan = item["uid"], item["plan"]
+                self._note("advisory", uid, None)
+                out[uid] = {"uid": uid, "placed": True,
+                            "advisory": True,
+                            "trace_id": item["req"].get("trace_id"),
+                            "score": plan.score, "hosts": plan.hosts,
+                            "shards": [(n, list(r))
+                                       for n, r in plan.shards]}
+            return out
+        wave_exec = getattr(self.executor, "execute_wave", None)
+        if wave_exec is not None:
+            items = [{"plan": item["plan"], "uid": item["uid"],
+                      "observed": item["observed"],
+                      "traceparent": item["traceparent"]}
+                     for item in batch]
+            return wave_exec(items, observer=self._note)
+        out = {}
+        for item in batch:
+            if item["observed"] is None \
+                    or not self._observed_supported():
+                res = self.executor.execute_plan(
+                    item["plan"], item["uid"], observer=self._note)
+            else:
+                res = self.executor.execute_plan(
+                    item["plan"], item["uid"], observer=self._note,
+                    observed=item["observed"])
+            out[item["uid"]] = res
+        return out
 
     def release(self, uid: str) -> bool:
         """Release a committed decision's sub-claims node-by-node (the
@@ -1054,8 +2054,13 @@ class FleetScheduler:
             return False
         with trace.span("fleetplace.release", claim_uid=uid):
             if self.executor is not None:
-                self.executor.release_subclaims(
+                deltas = self.executor.release_subclaims(
                     [(sub_uid, node) for sub_uid, node, _raws in shards])
+                # same contract as commit feedback: fold the release's
+                # restamp deltas in now, so the freed chips are offered
+                # before the watch round-trip delivers them
+                if deltas and self.cache is not None:
+                    self.cache.accountant.apply_placement(deltas)
             with self._claims_lock:
                 fresh = dict(self._claims)
                 fresh.pop(uid, None)
@@ -1222,8 +2227,21 @@ class FleetScheduler:
                     # naming its REAL new home (a later release then
                     # unprepares the right node)
                     for mig in group:
-                        applied = self.executor.apply_defrag(
-                            {"migrations": [mig]})
+                        feedback: List[dict] = []
+                        if self._defrag_feedback_ok():
+                            applied = self.executor.apply_defrag(
+                                {"migrations": [mig]},
+                                deltas_out=feedback)
+                        else:
+                            applied = self.executor.apply_defrag(
+                                {"migrations": [mig]})
+                        if feedback and self.cache is not None:
+                            # move feedback = commit feedback: the
+                            # freed source chips and the re-owned
+                            # target chips land in the views now, not
+                            # a watch round-trip later
+                            self.cache.accountant.apply_placement(
+                                feedback)
                         moves += applied
                         self._migrate_ledger(mig)
                         self._note("defrag_move", mig["claim"], {
@@ -1348,10 +2366,69 @@ class FleetScheduler:
         out["claims"] = len(self._claims)
         out["log_entries"] = len(self._log)
         out["selectors_compiled"] = len(self._selectors)
+        out["shard_index"] = self.shard_index
+        out["shard_count"] = self.shard_count
+        out["pending_claims"] = len(self._pending)
         if self.reflector is not None:
             out["reflector"] = self.reflector.snapshot()
         if self.cache is not None:
             out["cache_slices"] = len(self.cache.snapshot())
             out["cache_syncs"] = self.cache.syncs.value
             out["cache_events"] = self.cache.events.value
+            # the accountant's counters flatten into the scheduler's
+            # surface: one /status "fleet" section, one drift row
+            out.update(self.cache.accountant.snapshot())
         return out
+
+
+# ====================================================================
+# the fleet-level audit (N schedulers, one fabric — ISSUE 17)
+# ====================================================================
+
+
+def fleet_audit(schedulers: Sequence[FleetScheduler],
+                fabric_audit: Optional[dict] = None,
+                placement_audit: Optional[dict] = None,
+                checkpoint_audit: Optional[dict] = None) -> dict:
+    """Exactly-once across ALL schedulers on one fabric: each
+    scheduler's own log must audit clean, no claim uid may commit on
+    more than one scheduler, and the UNION of scheduler commits must
+    equal the fabric's committed set (per-scheduler fabric
+    cross-checks would flag every peer's commit as foreign — the
+    fleet-level set comparison is the honest one). The optional
+    placement / checkpoint audits fold in the other two legs of the
+    ISSUE 17 triple audit: multiclaim commit log, per-slice
+    write-generation + placement log, node checkpoints."""
+    per = [s.audit() for s in schedulers]
+    committed_by: Dict[str, List[int]] = {}
+    for i, audit in enumerate(per):
+        for uid in audit["committed"]:
+            committed_by.setdefault(uid, []).append(i)
+    cross_dup = sorted(u for u, owners in committed_by.items()
+                       if len(owners) > 1)
+    ok = all(a["exactly_once"] for a in per) and not cross_dup
+    out: Dict[str, object] = {
+        "schedulers": len(per),
+        "per_scheduler": per,
+        "committed_total": len(committed_by),
+        "cross_scheduler_duplicates": cross_dup,
+    }
+    if fabric_audit is not None:
+        fabric_committed = set(fabric_audit.get("committed") or ())
+        ours = set(committed_by)
+        out["fabric_agrees"] = (
+            fabric_audit.get("exactly_once", False)
+            and fabric_committed == ours)
+        out["fabric_only"] = sorted(fabric_committed - ours)
+        out["scheduler_only"] = sorted(ours - fabric_committed)
+        ok = ok and bool(out["fabric_agrees"])
+    if placement_audit is not None:
+        out["placement_exactly_once"] = bool(
+            placement_audit.get("exactly_once", False))
+        ok = ok and bool(out["placement_exactly_once"])
+    if checkpoint_audit is not None:
+        out["checkpoint_exactly_once"] = bool(
+            checkpoint_audit.get("exactly_once", False))
+        ok = ok and bool(out["checkpoint_exactly_once"])
+    out["exactly_once"] = ok
+    return out

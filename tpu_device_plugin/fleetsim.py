@@ -159,7 +159,8 @@ class FleetApiServer:
                  watch_enabled: bool = True, watch_backlog: int = 4096,
                  watch_queue_max: int = 128,
                  watch_timeout_s: float = 30.0,
-                 bookmark_interval_s: float = 0.5):
+                 bookmark_interval_s: float = 0.5,
+                 commit_crossing_s: float = 0.0):
         self.latency_s = latency_s
         self.max_inflight = max_inflight
         self.congestion_k = congestion_k
@@ -170,6 +171,25 @@ class FleetApiServer:
         self._lock = threading.Lock()
         self._inflight = 0
         self._admitted = 0
+        # ---- placement-consumption registry (ISSUE 17) -------------------
+        # The fabric-side truth a CAS commit races against: per-node device
+        # ownership ({node: {raw: multiclaim uid}}) and a per-node placement
+        # generation, bumped on every place/release/move. Committed
+        # ownership is PROJECTED onto the stored slice objects as a
+        # `spec.consumed` overlay + `spec.pool.placementGeneration` (and
+        # re-injected over driver PUTs, which never carry it), so peer
+        # schedulers converge through the ordinary watch stream. Placements
+        # deliberately do NOT touch spec.pool.generation or the accepted-
+        # write log: the driver-publish exactly-once audit and the
+        # placement plane each keep their own strictly-increasing sequence.
+        self.commit_crossing_s = commit_crossing_s
+        self.node_placements: Dict[str, Dict[str, str]] = {}
+        self.node_placement_gens: Dict[str, int] = {}
+        # (t_monotonic, action, uid, node, gen, detail) — the CAS-side
+        # exactly-once audit surface (placement_audit)
+        self.placement_log: List[tuple] = []
+        self._slices_by_node: Dict[str, set] = {}
+        self._slice_nodes: Dict[str, str] = {}   # slice name -> nodeName
         # ---- WATCH plane (ISSUE 12) -------------------------------------
         # The push side of the fabric: every accepted slice write appends a
         # pre-serialized event line under _lock, compacted to the newest
@@ -210,6 +230,9 @@ class FleetApiServer:
             "watch_force_closed_total": 0,   # slow-consumer closes
             "watch_chaos_breaks_total": 0,
             "watch_chaos_dups_total": 0,
+            # CAS placement plane (ISSUE 17)
+            "placement_conflicts_total": 0,
+            "commit_rounds_total": 0,
         }
         # slice name -> [(t_monotonic, method, pool generation), ...]
         self.write_log: Dict[str, List[tuple]] = {}
@@ -377,6 +400,8 @@ class FleetApiServer:
                     outer._rv += 1
                     obj["metadata"]["resourceVersion"] = str(outer._rv)
                     outer.slices[name] = obj
+                    outer._index_slice_locked(name, obj)
+                    outer._inject_consumed_locked(obj)
                     outer._log_write_locked(name, "POST", obj,
                                             self._req_t0)
                     outer._append_event_locked("ADDED", obj,
@@ -396,6 +421,13 @@ class FleetApiServer:
                     outer._rv += 1
                     obj["metadata"]["resourceVersion"] = str(outer._rv)
                     outer.slices[name] = obj
+                    outer._index_slice_locked(name, obj)
+                    # a driver's read-modify-write round-trips whatever it
+                    # fetched, but a driver that lost the guarded-PUT race
+                    # re-reads and re-projects from ITS state — the fabric
+                    # owns the consumed overlay, so re-stamp it on every
+                    # accepted write rather than trust the client copy
+                    outer._inject_consumed_locked(obj)
                     outer._log_write_locked(name, "PUT", obj,
                                             self._req_t0)
                     outer._append_event_locked("MODIFIED", obj,
@@ -408,6 +440,7 @@ class FleetApiServer:
                     live = outer.slices.pop(name, None)
                     if live is None:
                         return self._send(404, {})
+                    outer._unindex_slice_locked(name, live)
                     # deletes carry a fresh rv like any other write, so a
                     # watcher's resume cursor advances past the tombstone
                     outer._rv += 1
@@ -714,15 +747,295 @@ class FleetApiServer:
             self.multiclaim_log.append(
                 (time.monotonic(), uid, "begin", len(shards)))
 
-    def multiclaim_commit(self, uid: str) -> None:
+    def multiclaim_commit(self, uid: str, observed=None) -> dict:
+        """Commit one multiclaim — a batch of one (see
+        multiclaim_commit_batch for the CAS + crossing semantics).
+        Legacy callers that pass no `observed` keep the unconditional
+        PR 14 commit behavior and can ignore the return value."""
+        return self.multiclaim_commit_batch([(uid, observed)])[uid]
+
+    def multiclaim_commit_batch(self, commits) -> Dict[str, dict]:
+        """ONE commit round for a wave of multiclaims (ISSUE 17):
+        `commits` is [(uid, observed)] where `observed` is the per-node
+        placement generation map the scheduler planned against
+        ({node: gen}), or None for the legacy unconditional commit.
+
+        The round pays `commit_crossing_s` ONCE — outside the lock, GIL
+        released — modeling the etcd txn round-trip a real batched
+        commit amortizes across the wave; then every uid is CAS-checked
+        and applied under one lock crossing. A CAS loss (any planned
+        node's placement generation moved, or any planned chip already
+        owned) is a counted clean refusal: the claim record is NOT
+        committed, nothing is registered, and the caller rolls back its
+        prepared shards and replans. A CAS win registers device
+        ownership, bumps the per-node placement generations, and
+        re-projects the consumed overlay onto the stored slices (one
+        MODIFIED watch event per touched slice) so every peer
+        scheduler's cache converges on the new truth."""
+        if self.commit_crossing_s:
+            time.sleep(self.commit_crossing_s)   # one crossing per ROUND
+        out: Dict[str, dict] = {}
         with self._lock:
-            rec = self.multiclaims.get(uid)
-            if rec is not None:
-                rec["phase"] = "committed"
-            # the log records the attempt even when the record is absent/
-            # already committed — that is exactly what the audit flags
-            self.multiclaim_log.append(
-                (time.monotonic(), uid, "commit", None))
+            self.stats["commit_rounds_total"] += 1
+            # restamps are coalesced per ROUND per node: a wave packing
+            # eight claims onto one host emits ONE slice MODIFIED event,
+            # not eight — the watch fan-out cost scales with touched
+            # hosts, matching the accountant's O(request) delta claim
+            touched: Dict[str, Optional[str]] = {}
+            for uid, observed in commits:
+                out[uid] = self._commit_one_locked(uid, observed, touched)
+            restamped = {
+                node: self._restamp_node_slices_locked(node, tp)
+                for node, tp in touched.items()}
+        for res in out.values():
+            nodes = res.pop("nodes", None)
+            if res.get("committed"):
+                res["slices"] = [
+                    rec for node in dict.fromkeys(nodes or ())
+                    for rec in restamped.get(node, ())]
+        return out
+
+    def _commit_one_locked(self, uid: str, observed,
+                           touched: Dict[str, Optional[str]]) -> dict:
+        now = time.monotonic()
+        rec = self.multiclaims.get(uid)
+        shards = rec["shards"] if rec is not None else []
+        traceparent = rec.get("traceparent") if rec is not None else None
+        if observed is not None and rec is not None:
+            conflicts = []
+            for node, raws in shards:
+                if observed.get(node, 0) != \
+                        self.node_placement_gens.get(node, 0):
+                    conflicts.append(node)
+                    continue
+                owners = self.node_placements.get(node) or {}
+                if any(r in owners for r in raws):
+                    conflicts.append(node)
+            if conflicts:
+                conflicts = sorted(set(conflicts))
+                self.stats["placement_conflicts_total"] += 1
+                self.multiclaim_log.append(
+                    (now, uid, "conflict", conflicts))
+                self.placement_log.append(
+                    (now, "conflict", uid, conflicts[0], None, conflicts))
+                return {"committed": False, "conflicts": conflicts,
+                        "gens": {node: self.node_placement_gens.get(node, 0)
+                                 for node, _raws in shards}}
+        if rec is not None:
+            rec["phase"] = "committed"
+        # the log records the attempt even when the record is absent/
+        # already committed — that is exactly what the audit flags
+        self.multiclaim_log.append((now, uid, "commit", None))
+        gens: Dict[str, int] = {}
+        committed_nodes: List[str] = []
+        if observed is not None and rec is not None:
+            for node, raws in shards:
+                owners = self.node_placements.setdefault(node, {})
+                for r in raws:
+                    owners[r] = uid
+                gen = self.node_placement_gens.get(node, 0) + 1
+                self.node_placement_gens[node] = gen
+                gens[node] = gen
+                self.placement_log.append(
+                    (now, "place", uid, node, gen, sorted(raws)))
+                committed_nodes.append(node)
+                if node not in touched:
+                    touched[node] = traceparent
+        return {"committed": True, "gens": gens, "nodes": committed_nodes}
+
+    def release_placement(self, uid: str) -> Dict[str, object]:
+        """Free every chip the placement registry holds for multiclaim
+        `uid` (tenant departure / post-abort hygiene): bump the touched
+        nodes' placement generations, log, and re-project the consumed
+        overlay. Returns {"gens": {node: gen}, "slices": [restamp
+        deltas]} — the deltas feed the releasing scheduler's accountant
+        the same way commit feedback does, so its views free the chips
+        without waiting on the watch round-trip. Idempotent — an
+        unknown uid frees nothing."""
+        with self._lock:
+            now = time.monotonic()
+            gens: Dict[str, int] = {}
+            deltas: List[dict] = []
+            for node, owners in self.node_placements.items():
+                raws = sorted(r for r, o in owners.items() if o == uid)
+                if not raws:
+                    continue
+                for r in raws:
+                    del owners[r]
+                gen = self.node_placement_gens.get(node, 0) + 1
+                self.node_placement_gens[node] = gen
+                gens[node] = gen
+                self.placement_log.append(
+                    (now, "release", uid, node, gen, raws))
+                deltas.extend(self._restamp_node_slices_locked(node))
+            return {"gens": gens, "slices": deltas}
+
+    def move_placement(self, source_node: str, target_node: str,
+                       source_raws, target_raws) -> Dict[str, object]:
+        """Defrag-migration ownership handoff: re-home each owned source
+        chip to its paired target chip under the SAME multiclaim owner.
+        Executor-authoritative (no CAS — the migration machinery already
+        serialized the move); a source chip with no registered owner is
+        skipped, so fleets that never CAS-commit see a no-op.
+        Returns {"gens": ..., "slices": [restamp deltas]} like
+        release_placement — the deltas feed the coordinating
+        scheduler's accountant."""
+        with self._lock:
+            now = time.monotonic()
+            src = self.node_placements.get(source_node) or {}
+            moved = [(s, t) for s, t in zip(source_raws, target_raws)
+                     if s in src]
+            if not moved:
+                return {"gens": {}, "slices": []}
+            dst = self.node_placements.setdefault(target_node, {})
+            by_uid: Dict[str, List[tuple]] = {}
+            for s, t in moved:
+                by_uid.setdefault(src[s], []).append((s, t))
+            gens: Dict[str, int] = {}
+            for uid, pairs in sorted(by_uid.items()):
+                for s, t in pairs:
+                    del src[s]
+                    dst[t] = uid
+                for node, raws, action in (
+                        (source_node, [s for s, _ in pairs], "move_out"),
+                        (target_node, [t for _, t in pairs], "move_in")):
+                    gen = self.node_placement_gens.get(node, 0) + 1
+                    self.node_placement_gens[node] = gen
+                    gens[node] = gen
+                    self.placement_log.append(
+                        (now, action, uid, node, gen, sorted(raws)))
+            deltas: List[dict] = []
+            for node in (source_node, target_node):
+                deltas.extend(self._restamp_node_slices_locked(node))
+            return {"gens": gens, "slices": deltas}
+
+    def placement_audit(self) -> dict:
+        """Exactly-once audit over the placement log (the CAS-side
+        third of the ISSUE 17 triple audit): replaying place/release/
+        move must never double-own a (node, chip), per-node placement
+        generations must be strictly increasing, and the replay must
+        land exactly on the live registry."""
+        with self._lock:
+            log_copy = list(self.placement_log)
+            live = {(n, r): u for n, owners in self.node_placements.items()
+                    for r, u in owners.items()}
+        owned: Dict[tuple, str] = {}
+        double: List[tuple] = []
+        regressed: List[tuple] = []
+        gens_seen: Dict[str, int] = {}
+        conflicts = 0
+        placements = 0
+        for _t, action, uid, node, gen, detail in log_copy:
+            if action == "conflict":
+                conflicts += 1
+                continue
+            if gen <= gens_seen.get(node, 0):
+                regressed.append((node, gen))
+            gens_seen[node] = gen
+            if action in ("place", "move_in"):
+                if action == "place":
+                    placements += 1
+                for raw in detail:
+                    if (node, raw) in owned:
+                        double.append((node, raw, owned[(node, raw)], uid))
+                    owned[(node, raw)] = uid
+            else:   # release / move_out
+                for raw in detail:
+                    owned.pop((node, raw), None)
+        return {"placements_audited": placements,
+                "conflicts_total": conflicts,
+                "double_placements": double,
+                "regressed_generations": regressed,
+                "log_matches_registry": owned == live,
+                "exactly_once": (not double and not regressed
+                                 and owned == live)}
+
+    # ----------------------------------- consumed-overlay projection
+
+    def _index_slice_locked(self, name: str, obj: dict) -> None:
+        node = (obj.get("spec") or {}).get("nodeName")
+        old = self._slice_nodes.get(name)
+        if old is not None and old != node:
+            self._slices_by_node.get(old, set()).discard(name)
+        if node:
+            self._slice_nodes[name] = node
+            self._slices_by_node.setdefault(node, set()).add(name)
+
+    def _unindex_slice_locked(self, name: str, obj: dict) -> None:
+        node = self._slice_nodes.pop(name, None)
+        if node is not None:
+            self._slices_by_node.get(node, set()).discard(name)
+
+    def _inject_consumed_locked(self, obj: dict) -> None:
+        """Stamp the fabric-owned placement projection onto a slice
+        object: spec.consumed = {raw: owner uid} and
+        spec.pool.placementGeneration. Caller holds _lock and owns the
+        dict (fresh request body or a _restamp copy)."""
+        spec = obj.setdefault("spec", {})
+        node = spec.get("nodeName")
+        if not node:
+            return
+        owners = self.node_placements.get(node)
+        if owners:
+            spec["consumed"] = dict(owners)
+        else:
+            spec.pop("consumed", None)
+        gen = self.node_placement_gens.get(node, 0)
+        if gen:
+            spec.setdefault("pool", {})["placementGeneration"] = gen
+
+    def _restamp_node_slices_locked(self, node: str,
+                                    traceparent=None) -> List[dict]:
+        """Re-project the consumed overlay onto every stored slice of
+        `node` with a fresh resourceVersion + MODIFIED watch event.
+        Copy-on-write (a concurrent GET may be serializing the old
+        object outside the lock). Returns the per-slice delta records
+        the committing scheduler feeds its own accountant, so its cache
+        converges without waiting on the watch round-trip."""
+        out: List[dict] = []
+        for name in sorted(self._slices_by_node.get(node, ())):
+            live = self.slices.get(name)
+            if live is None:
+                continue
+            obj = dict(live)
+            obj["metadata"] = dict(live.get("metadata") or {})
+            spec = dict(live.get("spec") or {})
+            spec["pool"] = dict(spec.get("pool") or {})
+            obj["spec"] = spec
+            self._rv += 1
+            obj["metadata"]["resourceVersion"] = str(self._rv)
+            self._inject_consumed_locked(obj)
+            self.slices[name] = obj
+            self._append_event_locked("MODIFIED", obj, traceparent)
+            out.append({"name": name, "node": node,
+                        "resource_version": obj["metadata"]
+                        ["resourceVersion"],
+                        "generation": spec["pool"].get("generation"),
+                        "placement_generation": spec["pool"]
+                        .get("placementGeneration", 0),
+                        "consumed": dict(spec.get("consumed") or {})})
+        return out
+
+    def seed_slices(self, objs) -> int:
+        """Bulk-insert pre-built ResourceSlice objects directly into the
+        store (the SyntheticFleet boot path: 4096 nodes need no HTTP
+        herd to EXIST — the storms under test are scheduling storms).
+        Each insert is an accepted write for the exactly-once audit;
+        no watch events are emitted (seeding precedes every watcher,
+        which LISTs first)."""
+        now = time.monotonic()
+        with self._lock:
+            for obj in objs:
+                name = obj["metadata"]["name"]
+                if name in self.slices:
+                    raise AssertionError(f"seed of duplicate slice {name}")
+                self._rv += 1
+                obj["metadata"]["resourceVersion"] = str(self._rv)
+                self.slices[name] = obj
+                self._index_slice_locked(name, obj)
+                self._inject_consumed_locked(obj)
+                self._log_write_locked(name, "POST", obj, now)
+            return len(self.slices)
 
     def multiclaim_abort(self, uid: str, reason: str) -> None:
         with self._lock:
@@ -1367,7 +1680,7 @@ class FleetSim:
 
     def execute_plan(self, plan: "placement.SlicePlan", uid: str,
                      fail_node: Optional[str] = None,
-                     observer=None) -> dict:
+                     observer=None, observed=None) -> dict:
         """Execute an already-made placement decision through the
         multiclaim fabric — the fleetplace.FleetScheduler executor seam
         (prepare_slice delegates here after planning locally).
@@ -1375,7 +1688,13 @@ class FleetSim:
         shard prepared / failed / rolled back, aborted, committed —
         into the caller's commit log, so the scheduler's cluster-wide
         exactly-once audit spans decision → per-node sub-claims →
-        rollback on ONE log."""
+        rollback on ONE log. `observed` ({node: placement generation},
+        ISSUE 17) arms the optimistic-concurrency commit: the fabric
+        refuses the commit if any planned node's placement state moved
+        since the scheduler's snapshot, and the refusal unwinds exactly
+        like a shard failure — prepared shards unprepared, sub-claims
+        deleted, fabric record aborted, zero residue — then surfaces
+        `conflict: True` so the caller replans."""
         note = observer if observer is not None \
             else (lambda kind, u, detail=None: None)
         by_node = self._node_by_name()
@@ -1400,6 +1719,14 @@ class FleetSim:
                 break
             prepared.append((node, sub_uid))
             note("shard_prepared", uid, sub_uid)
+        commit = None
+        conflicts = None
+        if error is None:
+            commit = self.apiserver.multiclaim_commit(uid,
+                                                      observed=observed)
+            if not commit.get("committed", True):
+                conflicts = commit.get("conflicts") or []
+                error = f"placement conflict on {conflicts}"
         if error is not None:
             # whole-claim rollback: unprepare is idempotent and durable
             # (the deletion rides the group commit before ACK), so after
@@ -1419,22 +1746,113 @@ class FleetSim:
                 self.apiserver.remove_claim("fleet", f"{uid}-{node_name}")
             self.apiserver.multiclaim_abort(uid, error)
             note("aborted", uid, error)
-            return {"uid": uid, "placed": False, "rolled_back": True,
-                    "error": error,
-                    "residue": self.slice_residue(uid)}
-        self.apiserver.multiclaim_commit(uid)
+            out = {"uid": uid, "placed": False, "rolled_back": True,
+                   "error": error,
+                   "residue": self.slice_residue(uid)}
+            if conflicts is not None:
+                out["conflict"] = True
+                out["conflicts"] = conflicts
+                out["placement_gens"] = commit.get("gens") or {}
+            return out
         note("committed", uid, None)
         return {"uid": uid, "placed": True, "score": plan.score,
                 "hosts": plan.hosts,
                 "shards": [(node, list(raws))
                            for node, raws in plan.shards],
-                "sub_claims": [sub for _n, sub in prepared]}
+                "sub_claims": [sub for _n, sub in prepared],
+                "placement": commit}
 
-    def release_subclaims(self, pairs) -> None:
+    def execute_wave(self, items, observer=None) -> Dict[str, dict]:
+        """Batched-commit executor seam (ISSUE 17): prepare every
+        wave member's shards, then settle the whole wave through ONE
+        multiclaim_commit_batch round (one amortized fabric crossing).
+        `items` is a list of {plan, uid, observed, traceparent?};
+        returns {uid: result} shaped exactly like execute_plan. A CAS
+        loser is rolled back as cleanly as a lone conflicted claim; a
+        shard-prepare failure aborts that member before the commit
+        round (it never reaches the batch)."""
+        note = observer if observer is not None \
+            else (lambda kind, u, detail=None: None)
+        by_node = self._node_by_name()
+        results: Dict[str, dict] = {}
+        ready: List[dict] = []
+        for item in items:
+            plan, uid = item["plan"], item["uid"]
+            self.apiserver.multiclaim_begin(
+                uid, plan.shape, plan.shards,
+                traceparent=item.get("traceparent") or trace.propagate())
+            prepared: List[tuple] = []
+            error = None
+            for node_name, raws in plan.shards:
+                node = by_node[node_name]
+                sub_uid = f"{uid}-{node_name}"
+                names = node.host_view().names
+                self.apiserver.add_claim(
+                    "fleet", sub_uid, sub_uid, node.driver.driver_name,
+                    [{"device": names[r]} for r in raws])
+                resp = node.attach([sub_uid])
+                err = resp.claims[sub_uid].error
+                if err:
+                    error = f"{node_name}: {err}"
+                    note("shard_failed", uid, sub_uid)
+                    break
+                prepared.append((node, sub_uid))
+                note("shard_prepared", uid, sub_uid)
+            if error is not None:
+                results[uid] = self._unwind_wave_member(
+                    plan, uid, prepared, error, note)
+                continue
+            ready.append(dict(item, prepared=prepared))
+        if ready:
+            commits = self.apiserver.multiclaim_commit_batch(
+                [(item["uid"], item.get("observed")) for item in ready])
+            for item in ready:
+                plan, uid = item["plan"], item["uid"]
+                commit = commits[uid]
+                if commit.get("committed", True):
+                    note("committed", uid, None)
+                    results[uid] = {
+                        "uid": uid, "placed": True, "score": plan.score,
+                        "hosts": plan.hosts,
+                        "shards": [(n, list(r)) for n, r in plan.shards],
+                        "sub_claims": [s for _n, s in item["prepared"]],
+                        "placement": commit}
+                else:
+                    conflicts = commit.get("conflicts") or []
+                    out = self._unwind_wave_member(
+                        plan, uid, item["prepared"],
+                        f"placement conflict on {conflicts}", note)
+                    out["conflict"] = True
+                    out["conflicts"] = conflicts
+                    out["placement_gens"] = commit.get("gens") or {}
+                    results[uid] = out
+        return results
+
+    def _unwind_wave_member(self, plan, uid, prepared, error,
+                            note) -> dict:
+        """Shared all-or-nothing unwind for a wave member that failed
+        prepare or lost its CAS: identical guarantees to the
+        execute_plan rollback path."""
+        for node, sub_uid in prepared:
+            resp = node.detach([sub_uid])
+            if resp.claims[sub_uid].error:
+                raise AssertionError(
+                    f"rollback unprepare of {sub_uid} failed: "
+                    f"{resp.claims[sub_uid].error}")
+            note("shard_rolled_back", uid, sub_uid)
+        for node_name, _raws in plan.shards:
+            self.apiserver.remove_claim("fleet", f"{uid}-{node_name}")
+        self.apiserver.multiclaim_abort(uid, error)
+        note("aborted", uid, error)
+        return {"uid": uid, "placed": False, "rolled_back": True,
+                "error": error, "residue": self.slice_residue(uid)}
+
+    def release_subclaims(self, pairs) -> List[dict]:
         """Release node-level sub-claims by explicit (sub_uid, node)
         identity — the scheduler's tenant-departure path, correct even
         after defrag migrations moved a sub-claim to a host other than
-        the one its id was minted on. Idempotent like unprepare."""
+        the one its id was minted on. Idempotent like unprepare.
+        Returns the fabric's restamp deltas (accountant feedback)."""
         by_node = self._node_by_name()
         for sub_uid, node_name in pairs:
             node = by_node[node_name]
@@ -1444,6 +1862,18 @@ class FleetSim:
                     f"release unprepare of {sub_uid} on {node_name} "
                     f"failed: {resp.claims[sub_uid].error}")
             self.apiserver.remove_claim("fleet", sub_uid)
+        # free any CAS-registered chips the departing parents owned
+        # (idempotent no-op for legacy non-CAS placements); the restamp
+        # deltas go back to the releasing scheduler so its views free
+        # the chips synchronously (the watch event then lands as an
+        # unchanged-identity skip)
+        deltas: List[dict] = []
+        for parent in sorted({sub_uid[:-(len(node_name) + 1)]
+                              for sub_uid, node_name in pairs
+                              if sub_uid.endswith(f"-{node_name}")}):
+            rec = self.apiserver.release_placement(parent)
+            deltas.extend(rec.get("slices") or ())
+        return deltas
 
     def release_plan(self, uid: str, shards) -> None:
         """Release a committed multi-host claim's per-node sub-claims
@@ -1462,21 +1892,26 @@ class FleetSim:
         return out
 
     def scheduler(self, watch: bool = True, resync_s: float = 5.0,
-                  poll_s: float = 0.5, timeout_s: float = 2.0):
+                  poll_s: float = 0.5, timeout_s: float = 2.0,
+                  **sched_kwargs):
         """Build the fleet placement control plane over THIS fleet
         (fleetplace.FleetScheduler): decisions consume the PR 12
         watch-stream Reflector's slice cache — LIST seeds it, watch
         events converge it, published topology attributes rebuild the
         host grids — and execute through the multiclaim fabric.
         `watch=False` falls back to direct driver views (deterministic
-        unit tests without a reflector thread)."""
+        unit tests without a reflector thread). Extra keyword args
+        (shard_index/shard_count/partition/wave knobs, ISSUE 17) pass
+        through to the FleetScheduler — build one per shard over the
+        same fabric for a sharded control plane."""
         from .fleetplace import FleetScheduler, SliceCache
         from .kubeapi import Reflector
         if not watch:
             return FleetScheduler(executor=self,
                                   views_source=self._views_by_gen,
-                                  pod_dims=self.pod_dims)
-        cache = SliceCache()
+                                  pod_dims=self.pod_dims,
+                                  **sched_kwargs)
+        cache = SliceCache(pod_dims=self.pod_dims)
         api = ApiClient(self.apiserver.url, token_path="/nonexistent")
         reflector = Reflector(
             api, "/apis/resource.k8s.io/v1beta1/resourceslices",
@@ -1485,7 +1920,8 @@ class FleetSim:
             poll_interval_s=poll_s, watch_timeout_s=timeout_s)
         return FleetScheduler(executor=self, cache=cache,
                               reflector=reflector,
-                              pod_dims=self.pod_dims)
+                              pod_dims=self.pod_dims,
+                              **sched_kwargs)
 
     def fleet_flight(self):
         """The fleet's trace collector (fleetplace.FleetFlight). This
@@ -1532,7 +1968,8 @@ class FleetSim:
         return placement.propose_defrag(placement.parse_shape(shape),
                                         self.host_views())
 
-    def apply_defrag(self, proposal: dict) -> int:
+    def apply_defrag(self, proposal: dict,
+                     deltas_out: Optional[List[dict]] = None) -> int:
         """Apply a defrag advisory by riding the PR 7 migration-handoff
         machinery claim by claim: unprepare at the source (emits the
         durable handoff record), re-point the fabric claim at the target
@@ -1567,6 +2004,13 @@ class FleetSim:
                 raise AssertionError(
                     f"defrag prepare of {uid} on {dst.name} failed: "
                     f"{resp.claims[uid].error}")
+            # keep the CAS placement registry truthful across the move
+            # (no-op for claims that never CAS-committed)
+            rec = self.apiserver.move_placement(
+                mig["source_node"], mig["target_node"],
+                mig.get("devices") or (), mig["target_devices"])
+            if deltas_out is not None:
+                deltas_out.extend(rec.get("slices") or ())
             moves += 1
         return moves
 
@@ -1763,3 +2207,311 @@ def assert_fleet_invariants(sim: FleetSim,
         raise AssertionError("fleet invariants violated: "
                              + "; ".join(report["violations"]))
     return report
+
+
+# ====================================================================
+# synthetic scheduler-tier fleet (ISSUE 17: 4096-node storms)
+# ====================================================================
+
+
+def synthetic_slice_objects(n_nodes: int, devices_per_node: int = 8,
+                            generation: str = "v5e",
+                            pod_dims: Optional[tuple] = None):
+    """Mint `n_nodes` ResourceSlice objects in EXACTLY the shape
+    dra._device_entry publishes (v1beta1 basic-nested typed attributes:
+    generation/bdf/ici*/torus*/ringSize/hostId/host*), so
+    fleetplace._parse_slice_grids sees a synthetic fleet and a
+    driver-published one identically. Per-host chips form the tightest
+    near-square 2D torus holding `devices_per_node`; hosts sit on the
+    near-square pod grid FleetSim uses (node i at (i // cols, i %
+    cols)). Returns (objects, pod_dims)."""
+    if pod_dims is None:
+        cols = math.isqrt(n_nodes - 1) + 1 if n_nodes > 1 else 1
+        pod_dims = (-(-n_nodes // cols), cols)
+    pod_dims = tuple(pod_dims)
+    cols = pod_dims[-1]
+    rows = 1
+    for d in range(math.isqrt(devices_per_node), 0, -1):
+        if devices_per_node % d == 0:
+            rows = d
+            break
+    dims = (rows, devices_per_node // rows)
+    objs = []
+    for i in range(n_nodes):
+        node = f"node-{i:04d}"
+        host = (i // cols, i % cols)
+        devices = []
+        for j in range(devices_per_node):
+            coords = (j // dims[1], j % dims[1])
+            bdf = f"0000:{j:02x}:00.0"
+            attrs = {
+                "type": {"string": "passthrough"},
+                "generation": {"string": generation},
+                "bdf": {"string": bdf},
+                "iciX": {"int": coords[0]},
+                "iciY": {"int": coords[1]},
+                "torusX": {"int": dims[0]},
+                "torusY": {"int": dims[1]},
+                "ringSize": {"int": max(dims)},
+                "hostId": {"string": node},
+                "hostX": {"int": host[0]},
+                "hostY": {"int": host[1]},
+            }
+            devices.append({"name": f"{node}-tpu{j}",
+                            "basic": {"attributes": attrs}})
+        objs.append({
+            "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-slice"},
+            "spec": {"nodeName": node,
+                     "pool": {"name": node, "generation": 1},
+                     "driver": "tpu.fleetsim.synthetic",
+                     "devices": devices}})
+    return objs, pod_dims
+
+
+class SyntheticFleet:
+    """Scheduler-tier harness at fleet scale: a REAL FleetApiServer
+    fabric (watch plane, CAS placement registry, all three audit logs)
+    seeded with synthetic node slices — no per-node daemons, no sysfs
+    roots, no CDI dirs — so 4096-node / 16k-claim scheduling storms
+    run in one process. A checkpoint ledger stands in for the node
+    drivers' prepare/unprepare, giving the triple exactly-once audit
+    (multiclaim commit log, per-slice write log, checkpoint) the same
+    teeth FleetSim's real drivers give it, and the executor seam
+    (execute_plan / execute_wave / release_subclaims / slice_residue)
+    keeps FleetSim's all-or-nothing unwind contract bit-for-bit: a CAS
+    loser or prepare failure leaves zero residue."""
+
+    def __init__(self, n_nodes: int, devices_per_node: int = 8,
+                 pod_dims: Optional[tuple] = None,
+                 generation: str = "v5e",
+                 commit_crossing_s: float = 0.0,
+                 latency_s: float = 0.0,
+                 watch_backlog: int = 65536,
+                 watch_queue_max: int = 16384):
+        objs, dims = synthetic_slice_objects(
+            n_nodes, devices_per_node, generation=generation,
+            pod_dims=pod_dims)
+        self.n_nodes = n_nodes
+        self.pod_dims = dims
+        self.apiserver = FleetApiServer(
+            latency_s=latency_s,
+            commit_crossing_s=commit_crossing_s,
+            watch_backlog=watch_backlog,
+            watch_queue_max=watch_queue_max)
+        self.apiserver.seed_slices(objs)
+        self._ckpt_lock = threading.Lock()
+        # node -> {sub_uid: sorted raws} — the stand-in for each node
+        # driver's durable checkpoint
+        self.checkpoints: Dict[str, Dict[str, list]] = {}
+        # append-only (action, node, sub_uid): the replayable third
+        # audit log
+        self.checkpoint_log: List[tuple] = []
+        self._schedulers: List = []
+
+    # ------------------------------------------------- executor seam
+
+    def execute_plan(self, plan: "placement.SlicePlan", uid: str,
+                     fail_node: Optional[str] = None,
+                     observer=None, observed=None) -> dict:
+        """FleetSim.execute_plan's contract over the synthetic
+        checkpoint ledger: a wave of one."""
+        return self.execute_wave(
+            [{"plan": plan, "uid": uid, "observed": observed,
+              "fail_node": fail_node}],
+            observer=observer)[uid]
+
+    def execute_wave(self, items, observer=None) -> Dict[str, dict]:
+        """Batched-commit executor seam: checkpoint-prepare every wave
+        member's shards, then settle the whole wave through ONE
+        multiclaim_commit_batch round. CAS losers and prepare failures
+        unwind to zero residue before the result is returned."""
+        note = observer if observer is not None \
+            else (lambda kind, u, detail=None: None)
+        results: Dict[str, dict] = {}
+        ready: List[dict] = []
+        for item in items:
+            plan, uid = item["plan"], item["uid"]
+            self.apiserver.multiclaim_begin(
+                uid, plan.shape, plan.shards,
+                traceparent=item.get("traceparent") or trace.propagate())
+            prepared: List[tuple] = []
+            error = None
+            for node_name, raws in plan.shards:
+                sub_uid = f"{uid}-{node_name}"
+                if node_name == item.get("fail_node"):
+                    error = f"{node_name}: injected prepare failure"
+                    note("shard_failed", uid, sub_uid)
+                    break
+                with self._ckpt_lock:
+                    node_ckpt = self.checkpoints.setdefault(node_name, {})
+                    if sub_uid in node_ckpt:
+                        error = (f"{node_name}: duplicate prepare of "
+                                 f"{sub_uid}")
+                        note("shard_failed", uid, sub_uid)
+                        break
+                    node_ckpt[sub_uid] = sorted(raws)
+                    self.checkpoint_log.append(
+                        ("prepare", node_name, sub_uid))
+                prepared.append((node_name, sub_uid))
+                note("shard_prepared", uid, sub_uid)
+            if error is not None:
+                results[uid] = self._unwind_member(uid, prepared,
+                                                   error, note)
+                continue
+            ready.append(dict(item, prepared=prepared))
+        if ready:
+            commits = self.apiserver.multiclaim_commit_batch(
+                [(item["uid"], item.get("observed")) for item in ready])
+            for item in ready:
+                plan, uid = item["plan"], item["uid"]
+                commit = commits[uid]
+                if commit.get("committed", True):
+                    note("committed", uid, None)
+                    results[uid] = {
+                        "uid": uid, "placed": True, "score": plan.score,
+                        "hosts": plan.hosts,
+                        "shards": [(n, list(r)) for n, r in plan.shards],
+                        "sub_claims": [s for _n, s in item["prepared"]],
+                        "placement": commit}
+                else:
+                    conflicts = commit.get("conflicts") or []
+                    out = self._unwind_member(
+                        uid, item["prepared"],
+                        f"placement conflict on {conflicts}", note)
+                    out["conflict"] = True
+                    out["conflicts"] = conflicts
+                    out["placement_gens"] = commit.get("gens") or {}
+                    results[uid] = out
+        return results
+
+    def _unwind_member(self, uid, prepared, error, note) -> dict:
+        """All-or-nothing unwind: every prepared checkpoint entry is
+        rolled back (a rollback of an entry the ledger does not hold is
+        an invariant violation, not a no-op), the fabric record
+        aborted — then the member's residue is re-checked empty."""
+        with self._ckpt_lock:
+            for node_name, sub_uid in prepared:
+                if self.checkpoints.get(node_name, {}).pop(
+                        sub_uid, None) is None:
+                    raise AssertionError(
+                        f"rollback of {sub_uid}: not in checkpoint")
+                self.checkpoint_log.append(
+                    ("rollback", node_name, sub_uid))
+        for _node_name, sub_uid in prepared:
+            note("shard_rolled_back", uid, sub_uid)
+        self.apiserver.multiclaim_abort(uid, error)
+        note("aborted", uid, error)
+        return {"uid": uid, "placed": False, "rolled_back": True,
+                "error": error, "residue": self.slice_residue(uid)}
+
+    def release_subclaims(self, pairs) -> List[dict]:
+        """Tenant departure: drop the checkpoint entries, then free the
+        parents' CAS-registered chips (idempotent, like unprepare).
+        Returns the fabric's restamp deltas (accountant feedback)."""
+        with self._ckpt_lock:
+            for sub_uid, node_name in pairs:
+                if self.checkpoints.get(node_name, {}).pop(
+                        sub_uid, None) is not None:
+                    self.checkpoint_log.append(
+                        ("release", node_name, sub_uid))
+        deltas: List[dict] = []
+        for parent in sorted({sub_uid[:-(len(node_name) + 1)]
+                              for sub_uid, node_name in pairs
+                              if sub_uid.endswith(f"-{node_name}")}):
+            rec = self.apiserver.release_placement(parent)
+            deltas.extend(rec.get("slices") or ())
+        return deltas
+
+    def slice_residue(self, uid: str) -> List[str]:
+        """Checkpoint entries left behind by multi-host claim `uid` —
+        empty after a clean rollback, THE no-orphaned-sub-claims
+        assertion (FleetSim.slice_residue's contract minus the specs/
+        fabric-claims planes this harness does not model)."""
+        prefix = f"{uid}-"
+        residue = []
+        with self._ckpt_lock:
+            for node_name in sorted(self.checkpoints):
+                for sub_uid in self.checkpoints[node_name]:
+                    if sub_uid.startswith(prefix):
+                        residue.append(
+                            f"{node_name}:checkpoint:{sub_uid}")
+        return residue
+
+    # ------------------------------------------------------- audits
+
+    def checkpoint_audit(self) -> dict:
+        """The THIRD exactly-once log: replaying the checkpoint
+        prepare/rollback/release stream must never double-prepare a
+        live sub-claim, never drop one that is not held, and must land
+        exactly on the live checkpoint state."""
+        with self._ckpt_lock:
+            log_copy = list(self.checkpoint_log)
+            live = {(n, s) for n, ckpt in self.checkpoints.items()
+                    for s in ckpt}
+        held: set = set()
+        double_prepares: List[str] = []
+        phantom_drops: List[str] = []
+        for action, node_name, sub_uid in log_copy:
+            key = (node_name, sub_uid)
+            if action == "prepare":
+                if key in held:
+                    double_prepares.append(sub_uid)
+                held.add(key)
+            else:
+                if key not in held:
+                    phantom_drops.append(sub_uid)
+                held.discard(key)
+        matches = held == live
+        return {"entries_audited": len(log_copy),
+                "held": len(live),
+                "double_prepares": sorted(set(double_prepares)),
+                "phantom_drops": sorted(set(phantom_drops)),
+                "log_matches_checkpoints": matches,
+                "exactly_once": (not double_prepares
+                                 and not phantom_drops and matches)}
+
+    def audits(self) -> dict:
+        """All three exactly-once audit logs in one read — what every
+        bench cell folds through fleetplace.fleet_audit."""
+        return {"multiclaim": self.apiserver.multiclaim_audit(),
+                "writes": self.apiserver.exactly_once_audit(),
+                "placement": self.apiserver.placement_audit(),
+                "checkpoint": self.checkpoint_audit()}
+
+    # ---------------------------------------------------- schedulers
+
+    def scheduler(self, shard_index: int = 0, shard_count: int = 1,
+                  partition: bool = True, resync_s: float = 30.0,
+                  poll_s: float = 0.2, timeout_s: float = 2.0,
+                  **sched_kwargs):
+        """One shard of the sharded control plane: a watch-fed
+        FleetScheduler (Reflector -> SliceCache -> FragAccountant)
+        over THIS fabric. Build N of these for N-way sharding; they
+        are tracked for stop()."""
+        from .fleetplace import FleetScheduler, SliceCache
+        from .kubeapi import Reflector
+        cache = SliceCache(pod_dims=self.pod_dims)
+        api = ApiClient(self.apiserver.url, token_path="/nonexistent")
+        reflector = Reflector(
+            api, "/apis/resource.k8s.io/v1beta1/resourceslices",
+            on_event=cache.on_event, on_sync=cache.on_sync,
+            name=f"fleetsched-{shard_index}",
+            resync_interval_s=resync_s,
+            poll_interval_s=poll_s, watch_timeout_s=timeout_s)
+        sched = FleetScheduler(
+            executor=self, cache=cache, reflector=reflector,
+            pod_dims=self.pod_dims, shard_index=shard_index,
+            shard_count=shard_count, partition=partition,
+            **sched_kwargs)
+        self._schedulers.append(sched)
+        return sched
+
+    def stop(self) -> None:
+        for sched in self._schedulers:
+            try:
+                sched.stop()
+            except Exception:
+                pass
+        self._schedulers.clear()
+        self.apiserver.stop()

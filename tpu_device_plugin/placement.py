@@ -239,6 +239,76 @@ def fragmentation(view: HostView) -> dict:
     }
 
 
+class FragAggregate:
+    """Incrementally-maintained rollup of per-host fragmentation
+    records (ISSUE 17): the cluster_fragmentation totals for one
+    generation, updated by add/remove deltas as watch events flip
+    single hosts — O(1) per delta instead of re-reducing every host's
+    record per decision. `largest_free_box` keeps a multiset of
+    per-host values (a counted histogram), so removing the current
+    maximum finds the runner-up without a fleet scan. Pure bookkeeping
+    — single-writer, no locks; publication is the caller's problem."""
+
+    __slots__ = ("hosts", "chips", "free", "departed", "frag_sum",
+                 "fully_free_hosts", "_box_counts")
+
+    def __init__(self) -> None:
+        self.hosts = 0
+        self.chips = 0
+        self.free = 0
+        self.departed = 0
+        self.frag_sum = 0.0
+        self.fully_free_hosts = 0
+        self._box_counts: Dict[int, int] = {}
+
+    def add(self, record: dict, fully_free: bool) -> None:
+        self.hosts += 1
+        self.chips += record["chips"]
+        self.free += record["free"]
+        self.departed += record["departed"]
+        self.frag_sum += record["fragmentation"]
+        self.fully_free_hosts += bool(fully_free)
+        box = record["largest_free_box"]
+        self._box_counts[box] = self._box_counts.get(box, 0) + 1
+
+    def remove(self, record: dict, fully_free: bool) -> None:
+        self.hosts -= 1
+        self.chips -= record["chips"]
+        self.free -= record["free"]
+        self.departed -= record["departed"]
+        self.frag_sum -= record["fragmentation"]
+        self.fully_free_hosts -= bool(fully_free)
+        box = record["largest_free_box"]
+        left = self._box_counts.get(box, 0) - 1
+        if left > 0:
+            self._box_counts[box] = left
+        else:
+            self._box_counts.pop(box, None)
+
+    def largest_free_box(self) -> int:
+        return max(self._box_counts, default=0)
+
+    def rollup(self, largest_free_mesh: int = 0) -> dict:
+        """The exact cluster_fragmentation per-generation record shape
+        (the mesh term is the caller's — it is a cross-host property no
+        per-host delta can maintain)."""
+        largest_box = self.largest_free_box()
+        largest = max(largest_box, largest_free_mesh)
+        return {
+            "hosts": self.hosts,
+            "chips": self.chips,
+            "free": self.free,
+            "departed": self.departed,
+            "fully_free_hosts": self.fully_free_hosts,
+            "largest_free_box": largest_box,
+            "largest_free_mesh": largest_free_mesh,
+            "fragmentation": 0.0 if self.free == 0
+            else round(1.0 - largest / self.free, 4),
+            "mean_host_fragmentation": round(
+                self.frag_sum / max(1, self.hosts), 4),
+        }
+
+
 def _cyclic_span(values: Sequence[int], dim: int) -> int:
     """Length of the shortest wrap-aware interval on a ring of size
     `dim` covering `values` — the 1-D building block of cyclic_cover.

@@ -90,9 +90,14 @@ def _esc(value) -> str:
 
 class StatusServer:
     def __init__(self, manager, port: int = 0, host: str = "127.0.0.1",
-                 dra_driver=None, fleet_flight=None):
+                 dra_driver=None, fleet_flight=None,
+                 fleet_scheduler=None):
         self.manager = manager
         self.dra_driver = dra_driver
+        # placement control plane (fleetplace.FleetScheduler): when this
+        # daemon hosts a scheduler shard, its decision/conflict/frag
+        # counters ride the same /status + /metrics surface
+        self.fleet_scheduler = fleet_scheduler
         # /debug/fleet/trace collector (fleetplace.FleetFlight): None
         # builds a local-only collector lazily on first query — a
         # single daemon serves its own ring under the SAME endpoint
@@ -411,6 +416,13 @@ class StatusServer:
             paths = lockdep.path_stats()
             if paths:
                 out["read_paths"] = paths
+        # sharded placement control plane (fleetplace.FleetScheduler):
+        # decision/wave/conflict/replan counters plus the shard's
+        # FragAccountant delta-vs-recompute accounting — all lock-free
+        # AtomicCounter/attribute reads
+        sched = self.fleet_scheduler
+        if sched is not None:
+            out["fleet"] = sched.snapshot()
         d = self.dra_driver
         if d is not None:
             out["dra"] = {
@@ -980,6 +992,48 @@ class StatusServer:
             for site, n in sorted(fired.items()):
                 lines.append(f'tdp_fault_fires_total{{site="{_esc(site)}"}} '
                              f'{n}')
+        # sharded placement control plane (fleetplace.FleetScheduler):
+        # emitted only when this daemon hosts a scheduler shard; the
+        # per-shard decision-latency histogram (tdp_fleet_decision_ms)
+        # rides trace.render_prometheus below
+        flt = s.get("fleet")
+        if flt is not None:
+            shard = f'{{shard="{_esc(flt.get("shard_index", 0))}"}}'
+            for help_text, family, key in (
+                    ("Placement decisions finished (placed, unplaceable, "
+                     "or conflicted terminal).",
+                     "tpu_plugin_fleet_decisions_total",
+                     "decisions_total"),
+                    ("Batched decision waves settled (one snapshot, one "
+                     "sorted pass, one commit round each).",
+                     "tpu_plugin_fleet_decision_waves_total",
+                     "decision_waves_total"),
+                    ("Optimistic commits refused by the fabric CAS (peer "
+                     "scheduler consumed a planned chip first); every one "
+                     "is a clean counted abort.",
+                     "tpu_plugin_fleet_commit_conflicts_total",
+                     "commit_conflicts_total"),
+                    ("Replans after a commit conflict (bounded per "
+                     "claim by replan_max).",
+                     "tpu_plugin_fleet_replans_total",
+                     "replans_total"),
+                    ("Incremental fragmentation delta applies (one per "
+                     "watch-observed slice change — O(request), not "
+                     "O(fleet)).",
+                     "tpu_plugin_fleet_frag_delta_applies_total",
+                     "frag_delta_applies_total"),
+                    ("Full per-slice fragmentation recomputes (LIST "
+                     "relists only).",
+                     "tpu_plugin_fleet_frag_full_recomputes_total",
+                     "frag_full_recomputes_total"),
+                    ("Relisted slices skipped because resourceVersion/"
+                     "generation was unchanged (the 410-relist "
+                     "delta-skip guard).",
+                     "tpu_plugin_fleet_relist_unchanged_skips_total",
+                     "relist_unchanged_skips_total")):
+                lines += [f"# HELP {family} {help_text}",
+                          f"# TYPE {family} counter",
+                          f"{family}{shard} {flt.get(key, 0)}"]
         # privilege-boundary crossings (broker.py): client-side counters,
         # present in every scrape whichever mode the daemon runs in
         brk = s.get("broker") or {}

@@ -836,6 +836,10 @@ _register("tdp_watch_convergence_ms",
           "Watch convergence lag: wall time from a divergence-evidencing "
           "watch observation to the repair publish landing "
           "(dra.start_watch_reconciler).")
+_register("tdp_fleet_decision_ms",
+          "Fleet scheduler decision latency: submit (or wave entry) to "
+          "terminal result — plan, CAS commit, and any conflict replans "
+          "included (fleetplace.schedule / schedule_wave).")
 
 
 def histogram(name: str) -> Histogram:
