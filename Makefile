@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-brokeripc bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -202,6 +202,16 @@ bench-fleetsched:
 # CI bench-smoke runs the --quick variant.
 bench-broker:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --broker
+
+# Broker IPC fast-path bench (docs/design.md "Broker fast path"):
+# binary-vs-JSON framing byte overhead on the recorded corpus (the
+# >=3x pin), counted crossings for the batched multi-group claim
+# prefetch and chip_alive probe cycle, and live response-ring hit
+# latency against a spawned broker; wall encode/decode recorded
+# honestly unpinned. Writes docs/bench_brokeripc_r20.json. CI
+# bench-smoke runs the --quick variant.
+bench-brokeripc:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --brokeripc
 
 # Attach transport-endgame bench (docs/perf.md "Transport endgame"):
 # pre-serialized hot responses — the calibrated attach wall (<200 us

@@ -3102,6 +3102,306 @@ def run_broker(quick=False):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_brokeripc(quick=False):
+    """`bench.py --brokeripc` (r20): the broker crossing fast path.
+
+    Three claims, each measured the honest way (and each pinned by
+    tests/test_perf_honesty.py on the axis that is actually
+    load-insensitive):
+
+      - `framing_overhead_reduction_vs_json` (HEADLINE, DETERMINISTIC):
+        framing overhead in BYTES — frame length minus the operand
+        floor (UTF-8 operands + minimal varints the op actually
+        carries; the floor is identical for both framings) — summed
+        over a request+reply corpus of the hot crossing mix, binary v2
+        (RequestEncoder) vs JSON v1 SAME-RUN. Pinned >= 3x. The
+        wall-clock framing costs are reported alongside UNPINNED: the
+        varint codec is pure Python, so the cached client encode wins
+        modestly and decode LOSES to C json.loads — recorded, not
+        hidden. The crossing wins are the batch and the ring, which
+        remove whole round trips, not per-frame CPU.
+      - `batched_claim_crossings` (COUNTED): privilege crossings per
+        multi-group claim revalidation batch (the dra prefetch shape —
+        read_attr + read_link per partition), counted live from the
+        client AtomicCounter for group sizes {1,2,4,8} — pinned == 1.
+        Ditto `chip_alive_batch_crossings` for a health-cycle batch of
+        8 probes. Counting these away (skipping the revalidation)
+        would be the dishonest speedup.
+      - `ring_hits` (COUNTED, pinned > 0) + `ring_hit_p50_us`: the hot
+        read_attr served from the shared-memory response ring with NO
+        syscall, vs `crossing_rtt_p50_us_bin` over the socket.
+
+    The crossing breakdown is calibrated in-run on the same box:
+    `syscall_floor_p50_us` (socketpair self-ping — kernel copy cost,
+    no wakeup), `wakeup_p50_us` (echo-thread ping-pong minus the
+    self-ping — the scheduler handoff), and the end-to-end
+    `crossing_rtt_p50_us_{json,bin}` against a REAL spawned broker
+    (the remainder over floor+wakeup is dispatch + framing CPU).
+
+    Writes docs/bench_brokeripc_r20.json ($BENCH_BROKERIPC_OUT
+    overrides).
+    """
+    from tpu_device_plugin import broker as broker_mod
+    from tpu_device_plugin import brokeripc
+    from tpu_device_plugin.epoch import encode_varint
+
+    iters = 60 if quick else 300
+    warm = 10 if quick else 30
+
+    # ---- framing: byte overhead (deterministic) + wall cost (honest)
+    span = {"op": "dra.prepare", "seq": 7,
+            "trace_id": "c0ffee0ddeadbeefc0ffee0ddeadbeef",
+            "span_id": "beefc0ffee0ddead"}
+    pci_base = "/sys/bus/pci/devices"
+    corpus = [
+        ({"op": "read_attr", "seq": 101, "span": span,
+          "path": pci_base + "/0000:00:04.0/vendor"},
+         {"ok": True, "seq": 101, "data": "0x1ae0"}),
+        ({"op": "read_link", "seq": 102, "span": span,
+          "path": pci_base + "/0000:00:04.0/iommu_group"},
+         {"ok": True, "seq": 102,
+          "target": "../../../kernel/iommu_groups/11"}),
+        ({"op": "probe_config", "seq": 103, "span": span, "bits": 16,
+          "path": pci_base + "/0000:00:04.0/config"},
+         {"ok": True, "seq": 103, "data": "1ae0"}),
+        ({"op": "chip_alive", "seq": 104, "span": span,
+          "pci_base": pci_base, "bdf": "0000:00:04.0",
+          "node": "/dev/vfio/11"},
+         {"ok": True, "seq": 104, "alive": True}),
+        ({"op": "node_exists", "seq": 105, "span": span,
+          "path": "/dev/vfio/11"},
+         {"ok": True, "seq": 105, "exists": True}),
+    ]
+
+    def _floor(value):
+        # the information floor both framings must carry: operand
+        # strings verbatim, ints as minimal varints, bools as one byte
+        if isinstance(value, bool):
+            return 1
+        if isinstance(value, int):
+            return len(encode_varint(brokeripc._zigzag(value)))
+        if isinstance(value, str):
+            return len(value.encode("utf-8"))
+        if isinstance(value, dict):
+            return sum(_floor(v) for v in value.values() if v is not None)
+        if isinstance(value, (list, tuple)):
+            return sum(_floor(v) for v in value)
+        return 0
+
+    encoder = brokeripc.RequestEncoder()
+    for req, _rep in corpus:       # warm the static-frame cache
+        encoder.encode_frame(req)
+    floor_total = json_overhead = bin_overhead = 0
+    for req, rep in corpus:
+        for obj, is_req in ((req, True), (rep, False)):
+            fl = _floor(obj)
+            jlen = len(brokeripc._encode(obj, binary=False))
+            blen = len(encoder.encode_frame(obj) if is_req
+                       else brokeripc._encode(obj, binary=True))
+            floor_total += fl
+            json_overhead += jlen - fl
+            bin_overhead += blen - fl
+    overhead_ratio = json_overhead / max(bin_overhead, 1)
+
+    reqs = [dict(r, seq=0) for r, _ in corpus]
+    box = {"i": 0}
+
+    def _enc_json():
+        box["i"] += 1
+        brokeripc._encode(dict(reqs[box["i"] % 5], seq=box["i"],
+                               span=span), binary=False)
+
+    def _enc_bin():
+        box["i"] += 1
+        encoder.encode_frame(dict(reqs[box["i"] % 5], seq=box["i"],
+                                  span=span))
+
+    hdr = brokeripc._HEADER_SIZE
+    jframe = brokeripc._encode(corpus[0][0], binary=False)
+    bframe = encoder.encode_frame(corpus[0][0])
+    enc_json_us = _timed_median_us(_enc_json, iters * 10, warm)
+    enc_bin_us = _timed_median_us(_enc_bin, iters * 10, warm)
+    dec_json_us = _timed_median_us(
+        lambda: json.loads(jframe[hdr:]), iters * 10, warm)
+    dec_bin_us = _timed_median_us(
+        lambda: brokeripc.decode_body(bframe[hdr:]), iters * 10, warm)
+
+    # ---- in-run calibration: syscall floor and wakeup cost
+    import socket as socket_mod
+    left, right = socket_mod.socketpair()
+    try:
+        def _selfping():
+            left.sendall(bframe)
+            right.recv(65536)
+        syscall_floor_us = _timed_median_us(_selfping, iters, warm)
+
+        def _echo():
+            while True:
+                try:
+                    data = right.recv(65536)
+                except OSError:
+                    return
+                if not data:
+                    return
+                right.sendall(data)
+        echo_thread = threading.Thread(target=_echo, daemon=True)
+        echo_thread.start()
+
+        def _pingpong():
+            left.sendall(bframe)
+            left.recv(65536)
+        pingpong_us = _timed_median_us(_pingpong, iters, warm)
+    finally:
+        left.close()
+        right.close()
+    wakeup_us = max(pingpong_us - syscall_floor_us, 0.0)
+
+    # ---- real spawned broker: RTT, counted batches, ring hits
+    root = tempfile.mkdtemp(prefix="tdpbrokeripc-")
+    try:
+        _build_host(root, 8)
+        cfg = Config().with_root(root)
+        os.makedirs(cfg.device_plugin_path, exist_ok=True)
+        bdfs = [f"0000:00:{4 + i:02x}.0" for i in range(8)]
+        vendor_paths = [os.path.join(cfg.pci_base_path, b, "vendor")
+                        for b in bdfs]
+        group_paths = [os.path.join(cfg.pci_base_path, b, "iommu_group")
+                       for b in bdfs]
+        nodes = [os.path.join(root, "dev/vfio", str(11 + i))
+                 for i in range(8)]
+        sock_path = cfg.broker_socket_path
+        proc = broker_mod.spawn_broker(sock_path, root=root)
+        try:
+            # v1 peer: JSON framing, no ring (the broker serves ONE
+            # connection at a time — close each client before the next)
+            json_client = broker_mod.SocketBrokerClient(
+                sock_path, protocol_version=1)
+            rtt_json_us = _timed_median_us(
+                lambda: json_client.read_attr(bdfs[0], vendor_paths[0]),
+                iters, warm)
+            json_peer_version = json_client.negotiated_version
+            json_client.close()
+
+            # v2 peer, ring off: every call is a genuine socket crossing
+            bin_client = broker_mod.SocketBrokerClient(
+                sock_path, ring=False)
+            rtt_bin_us = _timed_median_us(
+                lambda: bin_client.read_attr(bdfs[0], vendor_paths[0]),
+                iters, warm)
+            bin_peer_version = bin_client.negotiated_version
+
+            group_sizes = [1, 2, 4, 8]
+            claim_crossings = []
+            for g in group_sizes:
+                subs = []
+                for i in range(g):
+                    subs.append({"op": "read_attr",
+                                 "path": vendor_paths[i]})
+                    subs.append({"op": "read_link",
+                                 "path": group_paths[i]})
+                c0 = bin_client.crossings.value
+                results = bin_client.run_batch(subs)
+                assert all(r.get("ok") for r in results), results
+                claim_crossings.append(bin_client.crossings.value - c0)
+            c0 = bin_client.crossings.value
+            alive = bin_client.chip_alive_batch(
+                cfg.pci_base_path, list(zip(bdfs, nodes)))
+            chip_alive_crossings = bin_client.crossings.value - c0
+            assert all(alive.values()), alive
+            bin_stats = bin_client.stats()
+            bin_client.close()
+
+            # v2 peer with the response ring: repeated hot reads hit
+            # shared memory, zero syscalls (long TTL keeps them hot
+            # for the duration of the timing loop)
+            ring_client = broker_mod.SocketBrokerClient(
+                sock_path, ring_ttl_s=60.0)
+            for b, p in zip(bdfs, vendor_paths):
+                ring_client.read_attr(b, p)   # first read publishes
+            box["i"] = 0
+
+            def _ring_read():
+                box["i"] += 1
+                i = box["i"] % 8
+                ring_client.read_attr(bdfs[i], vendor_paths[i])
+            ring_hit_us = _timed_median_us(_ring_read, iters * 4, warm)
+            ring_hits = ring_client.ring_hits.value
+            ring_fallbacks = ring_client.ring_fallbacks.value
+            ring_attached = ring_client.stats().get("ring_attached")
+            ring_client.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    out = {
+        "metric": "brokeripc_framing_overhead_reduction",
+        "value": round(overhead_ratio, 2),
+        "unit": "x_vs_json",
+        "vs_baseline": round(overhead_ratio / 3.0, 2),
+        "baseline_source": (
+            "r20 rebuilds the broker hot path; the pinned claims are "
+            "the DETERMINISTIC ones — byte framing overhead (frame "
+            "minus operand floor, same corpus, same run) >= 3x smaller "
+            "than JSON, one counted crossing per batched claim/probe "
+            "cycle, live ring hits > 0 — because wall RTT on a shared "
+            "core is an environment property like the r09 syscall "
+            "floor"),
+        "framing_overhead_json_bytes": json_overhead,
+        "framing_overhead_bin_bytes": bin_overhead,
+        "framing_corpus_floor_bytes": floor_total,
+        "framing_corpus_frames": len(corpus) * 2,
+        "framing_encode_json_us": round(enc_json_us, 2),
+        "framing_encode_bin_us": round(enc_bin_us, 2),
+        "framing_decode_json_us": round(dec_json_us, 2),
+        "framing_decode_bin_us": round(dec_bin_us, 2),
+        "framing_wallclock_note": (
+            "pure-Python varint decode loses to C json.loads and the "
+            "cached encode wins only modestly — recorded unpinned; the "
+            "latency wins are batching and the ring, which remove "
+            "whole round trips"),
+        "syscall_floor_p50_us": round(syscall_floor_us, 1),
+        "wakeup_p50_us": round(wakeup_us, 1),
+        "crossing_rtt_p50_us_json": round(rtt_json_us, 1),
+        "crossing_rtt_p50_us_bin": round(rtt_bin_us, 1),
+        "crossing_dispatch_and_framing_p50_us_json": round(
+            max(rtt_json_us - pingpong_us, 0.0), 1),
+        "crossing_dispatch_and_framing_p50_us_bin": round(
+            max(rtt_bin_us - pingpong_us, 0.0), 1),
+        "negotiated_version_json_peer": json_peer_version,
+        "negotiated_version_bin_peer": bin_peer_version,
+        "batched_claim_crossings": float(max(claim_crossings)),
+        "batched_claim_group_sizes": group_sizes,
+        "batched_claim_unbatched_equiv": 2 * max(group_sizes),
+        "chip_alive_batch_crossings": float(chip_alive_crossings),
+        "chip_alive_batch_probes": len(bdfs),
+        "frame_cache_hits": bin_stats.get("frame_cache_hits_total", 0),
+        "ring_attached": bool(ring_attached),
+        "ring_hits": int(ring_hits),
+        "ring_fallbacks": int(ring_fallbacks),
+        "ring_hit_p50_us": round(ring_hit_us, 2),
+        "ring_hit_vs_socket_speedup": round(
+            rtt_bin_us / max(ring_hit_us, 1e-9), 1),
+        "iterations": iters,
+        "quick": quick,
+    }
+    out_path = os.environ.get("BENCH_BROKERIPC_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "bench_brokeripc_r20.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    out["matrix_file"] = os.path.relpath(
+        out_path, os.path.dirname(os.path.abspath(__file__)))
+    print(f"  brokeripc framing overhead json {json_overhead}B bin "
+          f"{bin_overhead}B ({overhead_ratio:.1f}x) | crossing rtt "
+          f"json {rtt_json_us:.0f} us bin {rtt_bin_us:.0f} us (floor "
+          f"{syscall_floor_us:.0f} + wakeup {wakeup_us:.0f}) | claim "
+          f"batch {max(claim_crossings)} crossing(s) | ring hit "
+          f"{ring_hit_us:.1f} us x{ring_hits}", file=sys.stderr)
+    return out
+
+
 def run_autopilot(quick=False):
     """`bench.py --autopilot` (r14): the continuous fleet autopilot soak
     (tpu_device_plugin/autopilot.py; make soak-autopilot / the CI smoke
@@ -3546,6 +3846,9 @@ def main() -> int:
         # the soak ends with invariant violations — the report is still
         # printed and the artifact still written for the post-mortem
         return 0 if out["soak_ok"] else 1
+    if "--brokeripc" in sys.argv:
+        print(json.dumps(run_brokeripc(quick="--quick" in sys.argv)))
+        return 0
     if "--broker" in sys.argv:
         print(json.dumps(run_broker(quick="--quick" in sys.argv)))
         return 0

@@ -753,3 +753,438 @@ def test_socket_live_distinguishes_wedged_from_dead(short_root):
     finally:
         listener.close()
     assert broker.socket_live(path) is False          # stale socket file
+
+
+# ------------------------------------ round 20: crossing fast path
+
+
+def test_binary_codec_round_trip():
+    """decode_body(encode_body(x)) == x across every field kind: opcode,
+    zigzag ints, bools, strings, the compact span context, nested batch
+    bodies, and the JSON catch-all for unknown keys / wrong-typed
+    values."""
+    span = {"op": "dra.prepare", "seq": 5,
+            "trace_id": "a" * 32, "span_id": "b" * 16}
+    cases = [
+        {"op": "read_attr", "path": "/sys/x", "seq": 3, "span": span},
+        {"op": "read_attr", "path": "/x", "seq": -7,
+         "span": {"op": "p", "seq": 0}},                  # short span
+        {"op": "hello", "version": 2, "ring": True, "seq": 0},
+        {"ok": True, "seq": 0, "version": 2, "pid": 4242, "ring": True,
+         "ring_slots": 512, "ring_slot_size": 512},
+        {"op": "batch", "seq": 9, "ops": [
+            {"op": "read_link", "path": "/a", "seq": 0},
+            {"op": "node_exists", "path": "/b", "seq": 1}]},
+        {"ok": True, "seq": 9, "results": [
+            {"ok": True, "seq": 0, "target": "../g/11"},
+            {"ok": False, "seq": 1, "kind": "refused", "error": "no"}]},
+        # catch-all: unknown key, wrong-typed value, non-canonical span
+        {"op": "stats", "seq": 1, "mystery": {"deep": [1, 2]}},
+        {"op": "read_attr", "path": "/x", "seq": 1,
+         "span": {"op": "has\x1fus", "seq": 1}},
+        {"op": "read_attr", "path": "/x", "seq": 1,
+         "span": {"op": "extra", "seq": 1, "trace_id": "t",
+                  "span_id": "s", "more": True}},
+        {"ok": True, "seq": 2, "vendors": {"0000:00:04.0": "0x1ae0"}},
+    ]
+    enc = brokeripc.RequestEncoder()
+    for obj in cases:
+        assert brokeripc.decode_body(brokeripc.encode_body(obj)) == obj
+        frame = enc.encode_frame(obj)
+        assert frame[:4] == brokeripc.BIN_MAGIC
+        assert brokeripc.decode_body(
+            frame[brokeripc._HEADER_SIZE:]) == obj
+    # repeated static segments hit the pre-serialized cache
+    before = enc.static_hits
+    enc.encode_frame({"op": "read_attr", "path": "/sys/x", "seq": 99,
+                      "span": span})
+    assert enc.static_hits == before + 1
+
+
+def test_binary_codec_skips_unknown_tags_and_rejects_garbage():
+    from tpu_device_plugin.epoch import encode_delimited, encode_varint
+
+    body = brokeripc.encode_body({"op": "stats", "seq": 1})
+    # a future delimited field and a future varint field: skipped
+    future = encode_delimited(30, b"whatever") \
+        + encode_varint(30 << 3) + encode_varint(17)
+    assert brokeripc.decode_body(body + future) == \
+        {"op": "stats", "seq": 1}
+    for garbage, match in (
+            (b"\xff", "truncated varint"),
+            (encode_varint((4 << 3) | 2) + encode_varint(99), "truncated"),
+            (encode_varint((1 << 3) | 5), "unsupported wire type"),
+            (encode_varint(1 << 3) + encode_varint(99), "unknown opcode"),
+            (encode_varint((2 << 3) | 2) + encode_varint(1) + b"x",
+             "arrived delimited")):
+        with pytest.raises(brokeripc.BrokerProtocolError, match=match):
+            brokeripc.decode_body(garbage)
+
+
+def _open_fds():
+    return len(os.listdir("/proc/self/fd"))
+
+
+def test_recv_frame_closes_received_fds_on_every_error_path(tmp_path):
+    """The r20 bugfix regression: a frame that arrives WITH SCM_RIGHTS
+    fds but fails to decode must close the received kernel dups before
+    raising — on every error path — or each malformed reply leaks one
+    fd into the long-running daemon."""
+    payload = tmp_path / "f"
+    payload.write_bytes(b"x")
+    fd = os.open(payload, os.O_RDONLY)
+    try:
+        bad_frames = [
+            # bad magic
+            b"XXXX" + struct.pack(">I", 2) + b"{}",
+            # oversized length prefix
+            brokeripc.MAGIC + struct.pack(">I", brokeripc.MAX_FRAME + 1),
+            # malformed JSON payload
+            brokeripc.MAGIC + struct.pack(">I", 9) + b"not-json!",
+            # non-object payload
+            brokeripc.MAGIC + struct.pack(">I", 2) + b"[]",
+            # malformed binary payload
+            brokeripc.BIN_MAGIC + struct.pack(">I", 1) + b"\xff",
+        ]
+        for wire in bad_frames:
+            a, b = socket.socketpair()
+            try:
+                socket.send_fds(a, [wire], [fd])
+                # the kernel dup materializes in this process only once
+                # recv_fds runs — so a clean decode-error path leaves
+                # the fd table exactly as it was before the recv
+                baseline = _open_fds()
+                with pytest.raises(brokeripc.BrokerProtocolError):
+                    brokeripc.recv_frame(b, want_fds=1)
+                assert _open_fds() == baseline, \
+                    f"leaked received fd on {wire[:4]!r}"
+            finally:
+                a.close()
+                b.close()
+        # peer death after the fd-bearing first chunk: the header never
+        # completes, the dup must still be closed
+        a, b = socket.socketpair()
+        socket.send_fds(a, [brokeripc.MAGIC[:2]], [fd])
+        a.close()
+        baseline = _open_fds()
+        try:
+            with pytest.raises(brokeripc.BrokerConnectionLost):
+                brokeripc.recv_frame(b, want_fds=1)
+            assert _open_fds() == baseline
+        finally:
+            b.close()
+    finally:
+        os.close(fd)
+
+
+# ------------------------------------- round 20: version negotiation
+
+
+def test_negotiation_v2_binary_end_to_end(served):
+    """Both peers current: hello negotiates v2, every post-hello frame
+    is binary, the response ring attaches, and the pre-serialized frame
+    cache serves repeated requests."""
+    root, server, client = served
+    assert client.negotiated_version == 2
+    stats = client.stats()
+    assert stats["protocol_version"] == 2
+    assert stats["ring_attached"] is True
+    dev = os.path.join(root, "dev")
+    for _ in range(3):
+        assert client.node_exists(dev) is False
+    assert client.stats()["frame_cache_hits_total"] >= 2
+
+
+def test_negotiation_v1_peer_json_fallback(bare_server):
+    """A v1 serving daemon against a v2 broker: the hello version field
+    pins the session to JSON framing, no ring is offered, and every op
+    still round-trips."""
+    root, server = bare_server
+    client = SocketBrokerClient(server.socket_path, protocol_version=1)
+    try:
+        assert client.negotiated_version == 1
+        stats = client.stats()
+        assert stats["protocol_version"] == 1
+        assert stats["ring_attached"] is False
+        assert client.node_exists(os.path.join(root, "dev")) is False
+        vendor = os.path.join(root, "sys/bus/pci/devices",
+                              "0000:00:04.0", "vendor")
+        assert client.read_attr("0000:00:04.0", vendor) is None
+    finally:
+        client.close()
+
+
+def test_negotiation_rejects_unknown_version_client_side():
+    with pytest.raises(ValueError, match="not in"):
+        SocketBrokerClient("/nonexistent.sock", protocol_version=3)
+
+
+def test_binary_frame_before_v2_negotiation_refused(bare_server):
+    """A peer that negotiated v1 (JSON) and then speaks binary anyway is
+    a protocol violation — refused and disconnected, not served."""
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        brokeripc.send_frame(raw, {"op": "hello", "seq": 0, "version": 1})
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is True and reply["version"] == 1
+        brokeripc.send_frame(raw, {"op": "stats", "seq": 1}, binary=True)
+        reply, _ = brokeripc.recv_frame(raw)
+        assert reply["ok"] is False
+        assert reply["kind"] == "protocol"
+        assert "binary framing" in reply["error"]
+    finally:
+        raw.close()
+
+
+def test_reply_framing_mirrors_request_framing(bare_server):
+    """hello is ALWAYS JSON (framing is negotiated, not assumed); after
+    a v2 hello the server answers binary requests with binary frames
+    and JSON requests with JSON frames."""
+    root, server = bare_server
+    raw = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    raw.settimeout(5)
+    raw.connect(server.socket_path)
+    try:
+        brokeripc.send_frame(raw, {
+            "op": "hello", "seq": 0,
+            "version": brokeripc.PROTOCOL_VERSION})
+        reply, fds, binary = brokeripc.recv_frame_ex(raw)
+        assert reply["ok"] is True and binary is False
+        brokeripc.send_frame(raw, {"op": "stats", "seq": 1}, binary=True)
+        reply, fds, binary = brokeripc.recv_frame_ex(raw)
+        assert reply["ok"] is True and binary is True
+        brokeripc.send_frame(raw, {"op": "stats", "seq": 2})
+        reply, fds, binary = brokeripc.recv_frame_ex(raw)
+        assert reply["ok"] is True and binary is False
+    finally:
+        raw.close()
+
+
+# --------------------------------------- round 20: batched crossings
+
+
+def test_kill9_mid_batch_typed_unavailable_then_exactly_once_retry(
+        short_root):
+    """A broker killed -9 under a pending batch yields a typed
+    per-sub-op 'unavailable' result for EVERY sub-op (no partial
+    silence), and after respawn + handshake ONE retry executes the
+    batch exactly once — the respawned broker's audit shows a single
+    batch crossing."""
+    from tests.fakehost import FakeChip, FakeHost
+
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    sock_path = os.path.join(short_root, "broker.sock")
+    proc = broker.spawn_broker(sock_path, root=short_root)
+    client = SocketBrokerClient(sock_path)
+    pci = os.path.join(short_root, "sys/bus/pci/devices")
+    subs = [
+        {"op": "read_attr",
+         "path": os.path.join(pci, "0000:00:04.0", "vendor")},
+        {"op": "read_link",
+         "path": os.path.join(pci, "0000:00:04.0", "iommu_group")},
+    ]
+    try:
+        proc.kill()
+        proc.wait(timeout=5)
+        results = client.run_batch(subs)
+        assert len(results) == len(subs)
+        for i, res in enumerate(results):
+            assert res["ok"] is False and res["seq"] == i
+            assert res["kind"] == "unavailable"
+        # the typed batch degradation surfaces through the list helpers
+        # as the SAME exception type singular ops raise
+        with pytest.raises(BrokerUnavailable):
+            client.read_link_batch([subs[1]["path"]])
+
+        proc = broker.spawn_broker(sock_path, root=short_root)
+        client.reconnect()
+        retried = client.run_batch(subs)
+        assert [r["ok"] for r in retried] == [True, True]
+        assert retried[0]["data"] == "0x1ae0\n"
+        assert retried[1]["target"] == "11"
+        audit = client.stats()["broker"]["audit"]
+        # exactly ONE batch crossing on the respawned broker, carrying
+        # one audit entry per sub-op through the same machinery
+        assert len([a for a in audit if a["op"] == "batch"]) == 1
+        assert len([a for a in audit if a["op"] == "read_attr"]) == 1
+        assert len([a for a in audit if a["op"] == "read_link"]) == 1
+    finally:
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def _normalize_audit(entries):
+    """Audit entries minus the run-variant parts (timestamps, span
+    seq/ids): what MUST be byte-identical across framings."""
+    out = []
+    for a in entries:
+        span = a.get("span")
+        out.append({
+            "op": a["op"], "path": a.get("path"), "ok": a["ok"],
+            "error": a.get("error"),
+            "span": None if span is None else {
+                "op": span["op"],
+                "has_trace": "trace_id" in span and "span_id" in span},
+        })
+    return out
+
+
+def test_audit_and_trace_contract_identical_across_framings(short_root):
+    """The acceptance contract: the SAME op sequence over the v1 JSON
+    framing and the v2 binary framing must leave byte-identical audit
+    rings (modulo timestamps and span ids) and byte-identical
+    client-side broker.ipc span attributes — the fast path changes the
+    wire, never the semantics."""
+    from tests.fakehost import FakeChip, FakeHost
+
+    host = FakeHost(short_root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    pci = os.path.join(short_root, "sys/bus/pci/devices")
+    vendor = os.path.join(pci, "0000:00:04.0", "vendor")
+    group = os.path.join(pci, "0000:00:04.0", "iommu_group")
+
+    def run(version, sock_name):
+        sock_path = os.path.join(short_root, sock_name)
+        server = BrokerServer(sock_path, root=short_root)
+        server.start()
+        # ring off so the v2 run crosses for every op exactly like v1
+        # (a ring hit is the absence of a crossing, not a different one)
+        client = SocketBrokerClient(sock_path, protocol_version=version,
+                                    ring=False)
+        trace.reset()
+        try:
+            with trace.span("contract.check"):
+                client.node_exists(os.path.join(short_root, "dev"))
+                client.read_attr("0000:00:04.0", vendor)
+                client.read_link(group)
+                client.chip_alive(pci, "0000:00:04.0")
+                client.run_batch([
+                    {"op": "read_attr", "path": vendor},
+                    {"op": "read_link", "path": group}])
+                with pytest.raises(BrokerError):
+                    client.read_attr("0000:00:04.0", "/etc/passwd")
+            audit = client.stats()["broker"]["audit"]
+            spans = [{k: v for k, v in s["attrs"].items()}
+                     for s in trace.snapshot(op="broker.ipc")]
+            for s in spans:
+                s.pop("seq", None)
+            return _normalize_audit(audit), spans
+        finally:
+            client.close()
+            server.stop()
+            trace.reset()
+
+    audit_v1, spans_v1 = run(1, "v1.sock")
+    audit_v2, spans_v2 = run(2, "v2.sock")
+    assert json.dumps(audit_v1, sort_keys=True) == \
+        json.dumps(audit_v2, sort_keys=True)
+    assert json.dumps(spans_v1, sort_keys=True) == \
+        json.dumps(spans_v2, sort_keys=True)
+    # sanity: the contract actually covered the interesting entries
+    ops = [a["op"] for a in audit_v1]
+    assert "batch" in ops and "read_attr" in ops and "hello" in ops
+    assert any(a["error"] for a in audit_v1), "refusal must be audited"
+
+
+def test_batch_forbidden_ops_and_cap(served):
+    root, server, client = served
+    results = client.run_batch([
+        {"op": "node_exists", "path": os.path.join(root, "dev")},
+        {"op": "open_node", "path": "/dev/vfio/11"},
+        {"op": "shutdown"},
+        {"op": "write_sysfs", "path": "/sys/x", "data": "y"},
+        {"op": "frobnicate"},
+    ])
+    assert results[0]["ok"] is True
+    for res in results[1:]:
+        assert res["ok"] is False and res["kind"] == "refused"
+    with pytest.raises(BrokerError, match="batch of"):
+        client.run_batch([{"op": "node_exists", "path": "/dev"}]
+                         * (brokeripc.MAX_BATCH_OPS + 1))
+
+
+# ------------------------------------------- round 20: response ring
+
+
+def test_ring_writer_reader_round_trip_and_stats():
+    writer = brokeripc.RingWriter(slots=8, slot_size=256)
+    reader = brokeripc.RingReader(os.dup(writer.fd))
+    try:
+        key = brokeripc.ring_key("read_attr", "/sys/x/vendor")
+        assert writer.publish(key, {"ok": True, "data": "0x1ae0"})
+        value, verdict = reader.lookup(key, ttl_s=60.0)
+        assert verdict == "hit"
+        assert value == {"ok": True, "data": "0x1ae0"}
+        # unpublished key: miss (empty slot or key mismatch)
+        assert reader.lookup(
+            brokeripc.ring_key("read_attr", "/other"), ttl_s=60.0)[1] \
+            in ("miss",)
+    finally:
+        reader.close()
+        writer.close()
+
+
+def test_ring_torn_write_detected_and_stale_ttl():
+    writer = brokeripc.RingWriter(slots=8, slot_size=256)
+    reader = brokeripc.RingReader(os.dup(writer.fd))
+    try:
+        key = brokeripc.ring_key("probe_config", "/sys/x/config")
+        assert writer.publish(key, {"verdict": 1})
+        # TTL of zero: the entry is immediately stale — fall back
+        assert reader.lookup(key, ttl_s=0.0)[1] == "stale"
+        # fake a writer caught mid-update: odd seqlock == torn
+        import zlib
+        slot_off = brokeripc._RING_HEADER_PAD \
+            + (zlib.crc32(key) % writer.slots) * writer.slot_size
+        seq = struct.unpack_from(">I", writer._mm, slot_off)[0]
+        struct.pack_into(">I", writer._mm, slot_off, seq | 1)
+        assert reader.lookup(key, ttl_s=60.0)[1] == "torn"
+        # writer completes (seq moves on, even): readable again
+        struct.pack_into(">I", writer._mm, slot_off, (seq | 1) + 1)
+        value, verdict = reader.lookup(key, ttl_s=60.0)
+        assert verdict == "hit" and value == {"verdict": 1}
+    finally:
+        reader.close()
+        writer.close()
+
+
+def test_ring_oversized_value_skipped_not_torn():
+    writer = brokeripc.RingWriter(slots=4, slot_size=128)
+    reader = brokeripc.RingReader(os.dup(writer.fd))
+    try:
+        key = brokeripc.ring_key("read_attr", "/sys/x/vendor")
+        assert writer.publish(key, {"data": "y" * 500}) is False
+        assert writer.stats()["skipped_oversize_total"] == 1
+        assert reader.lookup(key, ttl_s=60.0)[1] == "miss"
+    finally:
+        reader.close()
+        writer.close()
+
+
+def test_ring_fault_forces_socket_fallback_with_correct_value(served):
+    """The broker.ring fault site: an injected torn read falls back to
+    the socket and still returns the RIGHT bytes — detected, counted,
+    never wrong."""
+    root, server, client = served
+    from tests.fakehost import FakeChip, FakeHost
+    host = FakeHost(root)
+    host.add_chip(FakeChip("0000:00:04.0", iommu_group="11"))
+    vendor = os.path.join(root, "sys/bus/pci/devices",
+                          "0000:00:04.0", "vendor")
+    assert client.stats()["ring_attached"] is True
+    first = client.read_attr("0000:00:04.0", vendor)   # publishes
+    hits0 = client.ring_hits.value
+    assert client.read_attr("0000:00:04.0", vendor) == first
+    assert client.ring_hits.value == hits0 + 1
+    fallbacks0 = client.ring_fallbacks.value
+    crossings0 = client.crossings.value
+    with faults.injected("broker.ring", kind="drop", count=1):
+        assert client.read_attr("0000:00:04.0", vendor) == first
+    assert client.ring_fallbacks.value == fallbacks0 + 1
+    assert client.crossings.value == crossings0 + 1, \
+        "the fallback must be a real, counted crossing"

@@ -605,6 +605,69 @@ def discover_inventory(cfg):
     return discover(cfg)
 
 
+def test_broker_ring_fault_storm_falls_back_to_socket_never_wrong(broker_rig):
+    """Chaos for the broker.ring fault site (round 20): with the
+    shared-memory response ring randomly unusable mid-storm, every hot
+    read degrades to a counted socket crossing and still returns the
+    exact bytes the broker would have served — the ring is a pure cache,
+    never a correctness dependency — and claim prepares riding through
+    the same client stay clean. After disarm the ring serves hits
+    again without a reattach."""
+    from tpu_device_plugin import faults
+    from tpu_device_plugin.dra import slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+
+    host, cfg, apiserver, driver, proc, client = broker_rig
+    vendor = os.path.join(short_root_of(host),
+                          "sys/bus/pci/devices/0000:00:04.0/vendor")
+    assert client.stats()["ring_attached"] is True
+
+    # warm: first read crosses AND publishes; the tight re-read is a
+    # ring hit — no socket, no crossing.
+    truth = client.read_attr("0000:00:04.0", vendor)
+    assert truth == b"0x1ae0\n"
+    crossings_warm = client.crossings.value
+    assert client.read_attr("0000:00:04.0", vendor) == truth
+    assert client.ring_hits.value >= 1
+    assert client.crossings.value == crossings_warm
+
+    rng = random.Random(SEED)
+    faults.arm("broker.ring", kind="drop", count=None, probability=0.5)
+    try:
+        fallbacks0 = client.ring_fallbacks.value
+        crossings0 = client.crossings.value
+        hits0 = client.ring_hits.value
+        for i in range(40):
+            assert client.read_attr("0000:00:04.0", vendor) == truth
+            if rng.random() < 0.2:     # prepares ride the faulted client
+                uid = f"ring-chaos-{i}"
+                apiserver.add_claim(
+                    "ns", uid, uid, driver.driver_name,
+                    [{"device": slice_device_name(
+                        TWO_MODEL_CHIPS[i % 2].bdf)}])
+                resp = driver.NodePrepareResources(
+                    drapb.NodePrepareResourcesRequest(claims=[
+                        drapb.Claim(namespace="ns", name=uid, uid=uid)]),
+                    None)
+                assert resp.claims[uid].error == "", resp.claims[uid].error
+        forced = client.ring_fallbacks.value - fallbacks0
+        assert forced > 0, "fault never forced a socket fallback"
+        # every forced fallback paid a real crossing (plus the prepares')
+        assert client.crossings.value - crossings0 >= forced
+        assert faults.stats().get("broker.ring", 0) > 0
+        # under p=0.5 the surviving half still hit the warm ring
+        assert client.ring_hits.value > hits0
+    finally:
+        faults.disarm("broker.ring")
+
+    # recovery: same attachment, hits resume, no crossing paid
+    crossings_after = client.crossings.value
+    hits_after = client.ring_hits.value
+    assert client.read_attr("0000:00:04.0", vendor) == truth
+    assert client.ring_hits.value == hits_after + 1
+    assert client.crossings.value == crossings_after
+
+
 # ------------------------------------------- watch-stream chaos (ISSUE 12)
 
 

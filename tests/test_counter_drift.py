@@ -186,6 +186,18 @@ SURFACES = {
     ("fleetplace.FragAccountant", "stats[*]"): {
         "status": "fleet.frag_delta_applies_total",
         "metrics": "tpu_plugin_fleet_frag_delta_applies_total"},
+    # broker crossing fast path (ISSUE 18): lock-free AtomicCounters on
+    # the client base class (tsalint LOCKFREE sentinel), surfaced via
+    # client_stats() -> /status broker.* and their tdp_broker_* families
+    ("broker._BaseClient", "batched_ops"): {
+        "status": "broker.batched_ops_total",
+        "metrics": "tdp_broker_batched_ops_total"},
+    ("broker._BaseClient", "ring_hits"): {
+        "status": "broker.ring_hits_total",
+        "metrics": "tdp_broker_ring_hits_total"},
+    ("broker._BaseClient", "ring_fallbacks"): {
+        "status": "broker.ring_fallbacks_total",
+        "metrics": "tdp_broker_ring_fallbacks_total"},
 }
 
 

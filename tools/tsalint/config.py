@@ -133,6 +133,15 @@ COUNTERS: Dict[str, Dict[str, str]] = {
         "_alloc_serializations": LOCKFREE,
         "_self_dial_reuses": LOCKFREE,
     },
+    # broker crossing fast path (round 20): batched-sub-op and response-
+    # ring counters are epoch.AtomicCounters on the client base class
+    # (any plain `+= 1` is a finding); registered on _BaseClient so the
+    # MRO walk covers InProcessBroker and SocketBrokerClient mutations.
+    "broker._BaseClient": {
+        "batched_ops": LOCKFREE,
+        "ring_hits": LOCKFREE,
+        "ring_fallbacks": LOCKFREE,
+    },
     "healthhub.HealthHub": {
         "_probe_cycles": "healthhub.HealthHub._lock",
         "_probes_last_cycle": "healthhub.HealthHub._lock",

@@ -186,7 +186,8 @@ class LiveAttrReader:
                 pass
 
 
-def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
+def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str,
+                   prefetched: Optional[bytes] = None) -> str:
     """Live mdev_type/name read (TOCTOU-grade, kept-fd) for Allocate-time
     validation; raises AllocationError when the mdev is gone. Shared by the
     classic vTPU server and the DRA prepare path so the two APIs can never
@@ -197,13 +198,21 @@ def live_mdev_type(reader: LiveAttrReader, cfg: Config, uuid: str) -> str:
     privileged process does the sysfs read), so a read-only serving
     daemon prepares mdev partitions without touching the host tree; the
     in-process mode keeps the caller's kept-fd reader — same bytes,
-    same lock-free fast path, same read counts."""
+    same lock-free fast path, same read counts.
+
+    `prefetched` carries bytes a BATCHED crossing already fetched (the
+    DRA prepare path coalesces every mdev partition's name read into
+    one round trip, round 20): the validation below is identical, only
+    the per-partition round trip is skipped. A failed prefetch is simply
+    not passed, so the singular read (and its diagnostics) still runs."""
     name_path = os.path.join(cfg.mdev_base_path, uuid, "mdev_type", "name")
     _plan_note(name_path)
     client = broker.get_client()
     spawn = client.mode == "spawn"
-    if spawn:
-        raw: Optional[bytes] = client.read_attr(uuid, name_path)
+    if prefetched is not None:
+        raw: Optional[bytes] = prefetched
+    elif spawn:
+        raw = client.read_attr(uuid, name_path)
     else:
         raw = reader.read(uuid, name_path)
     if raw is None:

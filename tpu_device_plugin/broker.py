@@ -42,6 +42,21 @@ boundary is enforced statically, not just by convention.
 Fault site ``broker.ipc`` (value kind) fires on the client's crossing
 path: an armed drop turns the next crossing into BrokerUnavailable —
 test_chaos.py scripts broker crashes mid-Allocate with it.
+
+The crossing fast path (round 20): spawn-mode connections NEGOTIATE the
+compact binary framing at hello (brokeripc v2 — pre-serialized varint
+frames via RequestEncoder; a v1 peer on either side keeps JSON framing,
+a version outside SUPPORTED_VERSIONS is refused before any op);
+``run_batch`` coalesces up to MAX_BATCH_OPS fd-free sub-operations into
+ONE round trip with per-sub typed results (one refused sub never
+poisons the batch; a dead broker types every sub "unavailable"); and
+hot read-only ops (readlinks, attr/vendor reads, config probes) consult
+the shared-memory RESPONSE RING the broker hands over at handshake
+before paying a socket round trip — torn/stale/missed slots fall back
+to the socket, counted (``ring_hits``/``ring_fallbacks``). Fault site
+``broker.ring`` (value kind) forces that fallback on demand. The audit
+ring, path policy and span-context contracts are framing-blind:
+tests/test_broker.py diffs audit entries across both framings.
 """
 
 from __future__ import annotations
@@ -71,6 +86,12 @@ SYSFS_WRITE_LEAVES = frozenset({"bind", "unbind", "driver_override"})
 # device-node path segments the broker will open
 DEV_NODE_SEGMENTS = ("dev/vfio", "dev/iommu", "dev/accel")
 AUDIT_RING = 256
+# ops a batch may NOT carry: handshake/lifecycle ops are connection
+# state, fd-passing ops keep SCM_RIGHTS on dedicated frames (an fd
+# buried in a batch reply could not be paired with its sub-op), and
+# mutations cross one at a time so the audit ring orders them exactly
+BATCH_FORBIDDEN = frozenset({"hello", "open_node", "batch",
+                             "shutdown", "stats", "write_sysfs"})
 
 
 class BrokerError(Exception):
@@ -86,6 +107,14 @@ class BrokerUnavailable(BrokerError):
 
     def __init__(self, detail: str) -> None:
         super().__init__(f"broker unavailable: {detail}")
+
+
+def _unavailable_detail(message: str) -> str:
+    """Strip the BrokerUnavailable prefix from an already-typed message
+    so re-raising it does not stutter 'broker unavailable: broker
+    unavailable: ...'."""
+    prefix = "broker unavailable: "
+    return message[len(prefix):] if message.startswith(prefix) else message
 
 
 def _is_dev_node(path: str) -> bool:
@@ -107,6 +136,18 @@ class _BaseClient:
     def __init__(self) -> None:
         self.crossings = AtomicCounter()
         self.errors = AtomicCounter()
+        # sub-operations carried by batched crossings (round 20): the
+        # gap between batched_ops and crossings is the round trips the
+        # batch path saved — /metrics tdp_broker_batched_ops_total
+        self.batched_ops = AtomicCounter()
+        # response-ring outcomes: a hit skipped a socket round trip
+        # entirely; a fallback (miss/stale/torn/injected) paid one
+        self.ring_hits = AtomicCounter()
+        self.ring_fallbacks = AtomicCounter()
+        # crossings the LAST claim paid (gauge, not a counter): written
+        # by note_claim_crossings from the Allocate/NodePrepare bracket,
+        # read by /status + /metrics — single plain write, last wins
+        self._last_claim_crossings = 0
 
     def _cross(self, op: str, **attrs: object):
         """Open the audited crossing span (call under ``with``). Counts
@@ -121,12 +162,79 @@ class _BaseClient:
         return trace.span("broker.ipc", histogram="tdp_broker_crossing_ms",
                           broker_op=op, broker_mode=self.mode, **attrs)
 
+    def note_claim_crossings(self, n: int) -> None:
+        """Record how many crossings the claim that just completed paid
+        (the Allocate / NodePrepareResources bracket) — the live
+        `crossings_per_claim` gauge the batching work is judged by."""
+        self._last_claim_crossings = max(int(n), 0)
+
+    # ---------------------------------------------------- batched subops
+
+    def run_batch(self, subops: Sequence[dict]) -> List[dict]:
+        """Submit fd-free sub-operations as ONE crossing; subclasses
+        implement the transport. Returns one typed result dict per
+        sub-op ({ok: True, ...fields} or {ok: False, kind, error}) —
+        partial failure by construction."""
+        raise NotImplementedError
+
+    def read_link_batch(self, paths: Sequence[str],
+                        ) -> List[Optional[str]]:
+        """Basenames of many symlink targets in ONE crossing (None per
+        vanished link). A refused sub-op raises BrokerError; a dead
+        broker raises BrokerUnavailable — same typed surface as the
+        singular read_link."""
+        paths = list(paths)
+        if not paths:
+            return []
+        out: List[Optional[str]] = []
+        for path, res in zip(paths,
+                             self.run_batch([{"op": "read_link",
+                                              "path": p} for p in paths])):
+            if res.get("ok"):
+                out.append(res.get("target"))
+            elif res.get("kind") == "unavailable":
+                raise BrokerUnavailable(
+                    _unavailable_detail(str(res.get("error", ""))))
+            else:
+                raise BrokerError(
+                    f"broker refused read_link {path!r}: "
+                    f"{res.get('error', 'unknown')}")
+        return out
+
+    def chip_alive_batch(self, pci_base_path: str,
+                         items: Sequence[Tuple[str, Optional[str]]],
+                         ) -> Dict[str, bool]:
+        """One health-cycle's chip probes in ONE crossing: `items` is
+        (bdf, node_path) pairs, result maps bdf -> alive. A refused
+        sub-op scores its chip dead (partial failure, the cycle
+        continues); a dead broker raises BrokerUnavailable so the hub
+        counts the degradation exactly as on the singular path."""
+        items = list(items)
+        if not items:
+            return {}
+        subs = [{"op": "chip_alive", "pci_base": pci_base_path,
+                 "bdf": bdf, "node": node} for bdf, node in items]
+        out: Dict[str, bool] = {}
+        for (bdf, _node), res in zip(items, self.run_batch(subs)):
+            if res.get("ok"):
+                out[bdf] = bool(res.get("alive"))
+            elif res.get("kind") == "unavailable":
+                raise BrokerUnavailable(
+                    _unavailable_detail(str(res.get("error", ""))))
+            else:
+                out[bdf] = False
+        return out
+
     # ------------------------------------------------------------- stats
 
     def client_stats(self) -> Dict[str, object]:
         return {"mode": self.mode,
                 "crossings_total": self.crossings.value,
-                "errors_total": self.errors.value}
+                "errors_total": self.errors.value,
+                "batched_ops_total": self.batched_ops.value,
+                "ring_hits_total": self.ring_hits.value,
+                "ring_fallbacks_total": self.ring_fallbacks.value,
+                "crossings_per_claim": self._last_claim_crossings}
 
     def stats(self) -> Dict[str, object]:
         return self.client_stats()
@@ -251,6 +359,79 @@ class InProcessBroker(_BaseClient):
             for member, group in pairs:
                 planner._revalidate_live(member, group)
 
+    # --------------------------------------------------- batched subops
+
+    def run_batch(self, subops: Sequence[dict]) -> List[dict]:
+        """ONE crossing for many fd-free sub-operations, executed by
+        direct calls — same typed per-sub results as the spawned broker
+        so callers are mode-blind."""
+        subs = list(subops)
+        if not subs:
+            return []
+        if len(subs) > brokeripc.MAX_BATCH_OPS:
+            raise BrokerError(
+                f"batch of {len(subs)} sub-ops exceeds MAX_BATCH_OPS "
+                f"{brokeripc.MAX_BATCH_OPS}")
+        results: List[dict] = []
+        try:
+            span = self._cross("batch", ops=len(subs))
+        except BrokerUnavailable as exc:
+            return [{"ok": False, "seq": i, "kind": "unavailable",
+                     "error": str(exc)} for i in range(len(subs))]
+        with span:
+            for i, sub in enumerate(subs):
+                results.append(self._run_sub(sub, i))
+                self.batched_ops.add()
+        return results
+
+    def _run_sub(self, sub: dict, index: int) -> dict:
+        op = str(sub.get("op"))
+        try:
+            if op in BATCH_FORBIDDEN:
+                raise BrokerError(f"op {op!r} not allowed in a batch")
+            if op == "node_exists":
+                return {"ok": True, "seq": index,
+                        "exists": os.path.exists(str(sub["path"]))}
+            if op == "read_attr":
+                path = str(sub["path"])
+                data = self._reader.read(str(sub.get("key") or path), path)
+                return {"ok": True, "seq": index,
+                        "data": (data.decode("latin-1")
+                                 if data is not None else None)}
+            if op == "read_link":
+                try:
+                    target: Optional[str] = os.path.basename(
+                        os.readlink(str(sub["path"])))
+                except OSError:
+                    target = None
+                return {"ok": True, "seq": index, "target": target}
+            if op == "probe_config":
+                return {"ok": True, "seq": index,
+                        "verdict": self._health.probe_config(
+                            str(sub["path"]))}
+            if op == "probe_node":
+                return {"ok": True, "seq": index,
+                        "verdict": self._health.probe_node(
+                            str(sub["path"]))}
+            if op == "chip_alive":
+                node = sub.get("node")
+                return {"ok": True, "seq": index,
+                        "alive": self._health.chip_alive(
+                            str(sub["pci_base"]), str(sub["bdf"]),
+                            str(node) if node is not None else None)}
+            if op == "chip_diagnostics":
+                bits, link = self._health.chip_diagnostics(
+                    str(sub["pci_base"]), str(sub["bdf"]))
+                return {"ok": True, "seq": index, "bits": bits,
+                        "link": link}
+            raise BrokerError(f"unknown batch op {op!r}")
+        except BrokerError as exc:
+            return {"ok": False, "seq": index, "kind": "refused",
+                    "error": str(exc)}
+        except Exception as exc:
+            return {"ok": False, "seq": index, "kind": "bad-request",
+                    "error": f"{type(exc).__name__}: {exc}"}
+
 
 class SocketBrokerClient(_BaseClient):
     """The unprivileged side of the two-process path: one unix-socket
@@ -263,10 +444,27 @@ class SocketBrokerClient(_BaseClient):
     mode = "spawn"
 
     def __init__(self, socket_path: str, connect_timeout_s: float = 5.0,
-                 op_timeout_s: float = 30.0) -> None:
+                 op_timeout_s: float = 30.0,
+                 protocol_version: int = brokeripc.PROTOCOL_VERSION,
+                 ring: bool = True,
+                 ring_ttl_s: float = brokeripc.RING_DEFAULT_TTL_S) -> None:
         super().__init__()
+        if protocol_version not in brokeripc.SUPPORTED_VERSIONS:
+            raise ValueError(
+                f"protocol_version {protocol_version!r} not in "
+                f"{sorted(brokeripc.SUPPORTED_VERSIONS)}")
         self.socket_path = socket_path
         self._timeout = connect_timeout_s
+        # the framing we OFFER at hello; what we SPEAK afterwards is
+        # whatever the broker negotiated down to (a v1 broker keeps the
+        # whole connection on JSON frames)
+        self._protocol = protocol_version
+        self._want_ring = ring and protocol_version >= 2
+        self._ring_ttl = ring_ttl_s
+        self._ring: Optional[brokeripc.RingReader] = None
+        self._binary = False
+        self._encoder = brokeripc.RequestEncoder()
+        self.negotiated_version = 0
         # every crossing is bounded: a broker that is alive but WEDGED
         # (stuck in an uninterruptible sysfs read on dying hardware)
         # must degrade to typed-unavailable like a dead one — an
@@ -294,9 +492,19 @@ class SocketBrokerClient(_BaseClient):
         sock.settimeout(self._timeout)
         try:
             sock.connect(self.socket_path)
-            brokeripc.send_frame(sock, brokeripc.hello_request())
-            reply, _fds = brokeripc.recv_frame(sock)
-            brokeripc.check_hello_reply(reply)
+            # hello is ALWAYS a v1 JSON frame so any broker can read it;
+            # the negotiated version governs every frame after it
+            brokeripc.send_frame(sock, brokeripc.hello_request(
+                version=self._protocol, ring=self._want_ring))
+            reply, fds = brokeripc.recv_frame(
+                sock, want_fds=1 if self._want_ring else 0)
+            try:
+                negotiated = brokeripc.check_hello_reply(
+                    reply, requested=self._protocol)
+            except brokeripc.BrokerProtocolError:
+                brokeripc.close_fds(fds)
+                raise
+            self._install_ring(reply, fds)
             sock.settimeout(self._op_timeout)
         except (OSError, brokeripc.BrokerConnectionLost) as exc:
             sock.close()
@@ -306,6 +514,49 @@ class SocketBrokerClient(_BaseClient):
             sock.close()
             raise
         self._sock = sock
+        self.negotiated_version = negotiated
+        self._binary = negotiated >= 2
+
+    def _install_ring(self, reply: dict, fds: List[int]) -> None:
+        """Map the response ring handed over at handshake (spawn-mode
+        hot-read fast path). A rejected ring is a LOGGED downgrade to
+        socket-only reads, never a failed dial — the ring is an
+        optimization, not a correctness surface."""
+        old, self._ring = self._ring, None
+        if old is not None:
+            old.close()
+        if reply.get("ring") and fds:
+            try:
+                self._ring = brokeripc.RingReader(fds[0])
+            except (brokeripc.BrokerProtocolError, OSError,
+                    ValueError) as exc:
+                log.warning("broker: response ring rejected (%s); "
+                            "falling back to socket-only reads", exc)
+        # the mmap holds the pages; the fds are not needed afterwards
+        brokeripc.close_fds(fds)
+
+    def _ring_lookup(self, op: str, path: str) -> Optional[dict]:
+        """Consult the response ring before paying a crossing. A hit is
+        NOT a crossing — no socket, no broker-side audit entry (the ring
+        serves only values the broker already audited when it published
+        them). Fault site broker.ring forces the socket fallback."""
+        ring = self._ring
+        if ring is None:
+            return None
+        if faults.fire("broker.ring", broker_op=op):
+            self.ring_fallbacks.add()
+            return None
+        try:
+            value, status = ring.lookup(brokeripc.ring_key(op, path),
+                                        ttl_s=self._ring_ttl)
+        except (OSError, ValueError):
+            self.ring_fallbacks.add()
+            return None
+        if status == "hit":
+            self.ring_hits.add()
+            return value
+        self.ring_fallbacks.add()
+        return None
 
     def reconnect(self) -> None:
         """Re-dial + re-handshake (broker respawn recovery). Raises
@@ -328,6 +579,9 @@ class SocketBrokerClient(_BaseClient):
                 except OSError:
                     pass
                 self._sock = None
+            if self._ring is not None:
+                self._ring.close()
+                self._ring = None
 
     def _request(self, op: str, want_fds: int = 0,
                  **fields: object) -> Tuple[dict, List[int]]:
@@ -340,7 +594,14 @@ class SocketBrokerClient(_BaseClient):
                    "span": brokeripc.span_context()}
             req.update(fields)
             try:
-                brokeripc.send_frame(self._sock, req)
+                if self._binary:
+                    # v2 fast path: the static field segment of this
+                    # request is pre-serialized and cached; only seq +
+                    # span encode per call
+                    brokeripc.send_encoded(
+                        self._sock, self._encoder.encode_frame(req))
+                else:
+                    brokeripc.send_frame(self._sock, req)
                 reply, fds = brokeripc.recv_frame(self._sock,
                                                   want_fds=want_fds)
             except brokeripc.BrokerConnectionLost as exc:
@@ -393,12 +654,19 @@ class SocketBrokerClient(_BaseClient):
             return fds[0]
 
     def read_attr(self, key: str, path: str) -> Optional[bytes]:
+        hit = self._ring_lookup("read_attr", path)
+        if hit is not None:
+            data = hit.get("data")
+            return data.encode("latin-1") if data is not None else None
         with self._cross("read_attr", path=path):
             reply, _ = self._request("read_attr", path=path)
             data = reply.get("data")
             return data.encode("latin-1") if data is not None else None
 
     def read_link(self, path: str) -> Optional[str]:
+        hit = self._ring_lookup("read_link", path)
+        if hit is not None:
+            return hit.get("target")
         with self._cross("read_link", path=path):
             reply, _ = self._request("read_link", path=path)
             return reply.get("target")
@@ -408,6 +676,9 @@ class SocketBrokerClient(_BaseClient):
             self._request("write_sysfs", path=path, data=data)
 
     def probe_config(self, config_path: str) -> int:
+        hit = self._ring_lookup("probe_config", config_path)
+        if hit is not None:
+            return int(hit["verdict"])
         with self._cross("probe_config", path=config_path):
             reply, _ = self._request("probe_config", path=config_path)
             return int(reply["verdict"])
@@ -444,9 +715,45 @@ class SocketBrokerClient(_BaseClient):
                 if err is not None:
                     raise AllocationError(err)
 
+    def run_batch(self, subops: Sequence[dict]) -> List[dict]:
+        """ONE round trip for many fd-free sub-operations. Typed partial
+        failure end to end: a refused sub rides back as its own {ok:
+        False, kind, error} result, and a broker that dies mid-batch
+        (kill -9) types EVERY sub-result "unavailable" instead of
+        raising through the caller — the caller decides per sub, exactly
+        once, and a reconnect() + resubmit after respawn is safe because
+        the batch carried only read-only ops."""
+        subs = [dict(sub) for sub in subops]
+        if not subs:
+            return []
+        if len(subs) > brokeripc.MAX_BATCH_OPS:
+            raise BrokerError(
+                f"batch of {len(subs)} sub-ops exceeds MAX_BATCH_OPS "
+                f"{brokeripc.MAX_BATCH_OPS}")
+        for i, sub in enumerate(subs):
+            sub["seq"] = i
+        try:
+            with self._cross("batch", ops=len(subs)):
+                reply, _ = self._request("batch", ops=subs)
+        except BrokerUnavailable as exc:
+            return [{"ok": False, "seq": i, "kind": "unavailable",
+                     "error": str(exc)} for i in range(len(subs))]
+        results = reply.get("results") or []
+        if len(results) != len(subs):
+            self.errors.add()
+            raise BrokerError(
+                f"broker answered {len(results)} results for "
+                f"{len(subs)} batched sub-ops")
+        for _ in subs:
+            self.batched_ops.add()
+        return results
+
     def stats(self) -> Dict[str, object]:
         out = self.client_stats()
         out["reconnects_total"] = self.reconnects.value
+        out["protocol_version"] = self.negotiated_version
+        out["ring_attached"] = self._ring is not None
+        out["frame_cache_hits_total"] = self._encoder.static_hits
         try:
             with self._cross("stats"):
                 reply, _ = self._request("stats")
@@ -537,10 +844,22 @@ class BrokerServer:
     privilege-separation payoff the acceptance test pins."""
 
     def __init__(self, socket_path: str, root: str = "/",
-                 native_lib_path: Optional[str] = None) -> None:
+                 native_lib_path: Optional[str] = None,
+                 enable_ring: bool = True) -> None:
         self.socket_path = socket_path
         self.policy = PathPolicy(root)
         self._health = TpuHealth(native_lib_path)
+        # the response ring (round 20): hot read-only results published
+        # here after being served (and audited) over the socket, so the
+        # daemon's next read of the same key skips the round trip. A
+        # kernel without memfd/mmap support just runs ringless.
+        self._ring: Optional[brokeripc.RingWriter] = None
+        if enable_ring:
+            try:
+                self._ring = brokeripc.RingWriter()
+            except (OSError, ValueError) as exc:
+                log.warning("broker: response ring unavailable (%s); "
+                            "serving socket-only", exc)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # the one live daemon connection (sequential accept: the serving
@@ -611,6 +930,9 @@ class BrokerServer:
             except OSError:
                 pass
         self._held.clear()
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
 
     def serve_forever(self) -> None:
         while not self._stop.is_set():
@@ -633,9 +955,14 @@ class BrokerServer:
         # a version mismatch is refused BEFORE anything else is served —
         # which only holds if a client that SKIPS hello gets nothing
         helloed = False
+        # per-connection NEGOTIATED framing: binary frames are only
+        # legal after a v2 hello on THIS connection; replies always
+        # mirror the request's framing (so the hello reply is JSON even
+        # when the rest of the connection goes binary)
+        binary_ok = False
         while not self._stop.is_set():
             try:
-                req, extra_fds = brokeripc.recv_frame(conn)
+                req, extra_fds, was_binary = brokeripc.recv_frame_ex(conn)
             except brokeripc.BrokerConnectionLost:
                 # the serving daemon died or restarted: keep running,
                 # keep the held fds, go back to accept()
@@ -652,6 +979,17 @@ class BrokerServer:
                     pass
                 return   # connection unusable after a framing error
             brokeripc.close_fds(extra_fds)   # clients never send fds
+            if was_binary and not binary_ok:
+                log.warning("broker: binary frame before v2 handshake")
+                try:
+                    brokeripc.send_frame(conn, {
+                        "ok": False, "seq": req.get("seq", -1),
+                        "kind": "protocol",
+                        "error": "binary framing not negotiated on this "
+                                 "connection"})
+                except brokeripc.BrokerConnectionLost:
+                    pass
+                return
             if not helloed and req.get("op") != "hello":
                 reply, fds = {
                     "ok": False, "seq": req.get("seq", -1),
@@ -674,8 +1012,10 @@ class BrokerServer:
                     reply, fds = self._dispatch(req)
                 if req.get("op") == "hello" and reply.get("ok"):
                     helloed = True
+                    binary_ok = int(reply.get("version") or 1) >= 2
             try:
-                brokeripc.send_frame(conn, reply, fds=tuple(fds))
+                brokeripc.send_frame(conn, reply, fds=tuple(fds),
+                                     binary=was_binary)
             except brokeripc.BrokerConnectionLost:
                 return
             finally:
@@ -699,20 +1039,41 @@ class BrokerServer:
             "ok": ok, "error": error or None,
             "span": req.get("span"), "ts": time.time()})
 
-    def _dispatch(self, req: dict) -> Tuple[dict, List[int]]:
+    def _ring_publish(self, op: str, path: str, value: dict) -> None:
+        ring = self._ring
+        if ring is not None:
+            ring.publish(brokeripc.ring_key(op, path), value)
+
+    def _dispatch(self, req: dict,
+                  in_batch: bool = False) -> Tuple[dict, List[int]]:
         op = req.get("op")
         seq = req.get("seq", -1)
         fds: List[int] = []
         reply: dict = {"ok": True, "seq": seq}
         try:
+            if in_batch and op in BATCH_FORBIDDEN:
+                raise BrokerError(f"op {op!r} not allowed in a batch")
             if op == "hello":
-                if req.get("version") != brokeripc.PROTOCOL_VERSION:
+                version = req.get("version")
+                if version not in brokeripc.SUPPORTED_VERSIONS:
                     raise BrokerError(
-                        f"protocol version {req.get('version')!r} "
+                        f"protocol version {version!r} "
                         f"unsupported (broker speaks "
-                        f"{brokeripc.PROTOCOL_VERSION})")
-                reply["version"] = brokeripc.PROTOCOL_VERSION
+                        f"{sorted(brokeripc.SUPPORTED_VERSIONS)})")
+                # negotiate DOWN to the client's version: a v1 client
+                # keeps JSON framing for the whole connection
+                reply["version"] = int(version)
                 reply["pid"] = os.getpid()
+                if (int(version) >= 2 and req.get("ring")
+                        and self._ring is not None):
+                    # the one-time ring handover: SCM_RIGHTS used for
+                    # actual fd passage, here and open_node only. The
+                    # dup is closed after send (server fds always are);
+                    # the client's copy keeps the mapping alive.
+                    reply["ring"] = True
+                    reply["ring_slots"] = self._ring.slots
+                    reply["ring_slot_size"] = self._ring.slot_size
+                    fds.append(os.dup(self._ring.fd))
             elif op == "node_exists":
                 path = str(req["path"])
                 self.policy.check_read(path)
@@ -745,6 +1106,8 @@ class BrokerServer:
                     data = None
                 reply["data"] = (data.decode("latin-1")
                                  if data else None)
+                self._ring_publish("read_attr", path,
+                                   {"data": reply["data"]})
             elif op == "read_link":
                 path = str(req["path"])
                 self.policy.check_read(path)
@@ -752,6 +1115,8 @@ class BrokerServer:
                     reply["target"] = os.path.basename(os.readlink(path))
                 except OSError:
                     reply["target"] = None
+                self._ring_publish("read_link", path,
+                                   {"target": reply["target"]})
             elif op == "write_sysfs":
                 path = str(req["path"])
                 self.policy.check_write(path)
@@ -765,6 +1130,8 @@ class BrokerServer:
                 path = str(req["path"])
                 self.policy.check_read(path)
                 reply["verdict"] = self._health.probe_config(path)
+                self._ring_publish("probe_config", path,
+                                   {"verdict": reply["verdict"]})
             elif op == "probe_node":
                 path = str(req["path"])
                 self.policy.check_read(path)
@@ -810,6 +1177,28 @@ class BrokerServer:
                 reply["errors"] = [
                     self._revalidate_one(base, m, g, vendors)
                     for m, g in pairs]
+            elif op == "batch":
+                subs = req.get("ops")
+                if not isinstance(subs, list):
+                    raise BrokerError("batch requires an ops list")
+                if len(subs) > brokeripc.MAX_BATCH_OPS:
+                    raise BrokerError(
+                        f"batch of {len(subs)} sub-ops exceeds "
+                        f"MAX_BATCH_OPS {brokeripc.MAX_BATCH_OPS}")
+                # partial-failure semantics: every sub-op dispatches
+                # through the SAME policy/audit machinery as a singular
+                # crossing (recursive _dispatch appends its own audit
+                # entry) and carries its own typed result — one refused
+                # sub never poisons the batch
+                results = []
+                for i, sub in enumerate(subs):
+                    if not isinstance(sub, dict):
+                        sub = {"op": "invalid", "seq": i}
+                    sub_reply, sub_fds = self._dispatch(sub,
+                                                        in_batch=True)
+                    brokeripc.close_fds(sub_fds)  # barred by policy; belt
+                    results.append(sub_reply)
+                reply["results"] = results
             elif op == "stats":
                 reply["broker"] = {
                     "pid": os.getpid(),
@@ -817,6 +1206,8 @@ class BrokerServer:
                     "held_paths": sorted(self._held),
                     "ops": dict(self._counters),
                     "refused_total": self._refused,
+                    "ring": (self._ring.stats()
+                             if self._ring is not None else None),
                     "audit": list(self._audit)[-32:],
                 }
             elif op == "shutdown":
@@ -903,6 +1294,14 @@ class BrokeredHealth:
                    node_path: Optional[str] = None) -> bool:
         return self._client.chip_alive(pci_base_path, bdf, node_path)
 
+    def chip_alive_batch(self, pci_base_path: str,
+                         items: Sequence[Tuple[str, Optional[str]]],
+                         ) -> Dict[str, bool]:
+        """A whole probe cycle's chip probes in ONE crossing — healthhub
+        detects this method on the shim and coalesces its per-bdf pool
+        submissions into one batched crossing per cycle."""
+        return self._client.chip_alive_batch(pci_base_path, items)
+
     def chip_diagnostics(self, pci_base_path: str, bdf: str):
         bits, link = self._client.chip_diagnostics(pci_base_path, bdf)
         return bits, link
@@ -933,6 +1332,21 @@ def seam_read_link(path: str) -> Optional[str]:
         return client.read_link(path)
     from .discovery import read_link_basename
     return read_link_basename(path)
+
+
+def seam_read_link_batch(paths: Sequence[str]) -> List[Optional[str]]:
+    """Batched seam_read_link: ONE crossing for the whole path list in
+    spawn mode (dra's per-partition mdev readlinks used to pay one round
+    trip each); in-process it is discovery's plain reader per path, so
+    the existing read accounting is unchanged."""
+    paths = list(paths)
+    if not paths:
+        return []
+    client = get_client()
+    if client.mode == "spawn":
+        return client.read_link_batch(paths)
+    from .discovery import read_link_basename
+    return [read_link_basename(p) for p in paths]
 
 
 def get_client() -> _BaseClient:

@@ -3,16 +3,35 @@
 The privilege-separated broker (broker.py) owns every vfio/sysfs/iommufd
 operation; the unprivileged serving daemon reaches them over a unix
 socket. This module is the NARROW, VERSIONED framing both sides speak —
-deliberately small enough to audit by reading:
+deliberately small enough to audit by reading.
 
-  frame   = MAGIC (4 bytes b"TDPB") + length (4-byte big-endian)
-            + payload (UTF-8 JSON object, <= MAX_FRAME bytes)
-  fds     = passed as SCM_RIGHTS ancillary data ON the frame's first
-            send/recv (socket.send_fds / socket.recv_fds; at most
-            MAX_FDS per frame)
+Two framings, one request/reply model (round 20):
+
+  v1 (JSON)   frame = MAGIC (b"TDPB") + length (4-byte big-endian)
+              + payload (UTF-8 JSON object, <= MAX_FRAME bytes)
+  v2 (binary) frame = BIN_MAGIC (b"TDBB") + length (4-byte big-endian)
+              + payload (compact varint op-table records — the PR 13
+              protobuf wire vocabulary: epoch.encode_varint /
+              epoch.encode_delimited; see _FIELD_DEFS)
+  fds         passed as SCM_RIGHTS ancillary data ON the frame's first
+              send/recv (socket.send_fds / socket.recv_fds; at most
+              MAX_FDS per frame). SCM_RIGHTS is reserved for ACTUAL fd
+              passage — open_node's device fd and the one-time response
+              ring handover at handshake — never for framing tricks.
+
+The framing is NEGOTIATED at `hello` (always a v1 JSON frame, so any
+peer can read it): the client offers its version, the broker answers
+with the negotiated one. Both at >= 2 → every subsequent frame on the
+connection is binary; a v1 peer on either side keeps JSON framing for
+the whole connection; an unsupported version is refused BEFORE any op
+is served, exactly as before. The two framings decode to the SAME
+request/reply dicts — broker.py's dispatch, audit ring and span
+plumbing are framing-blind (tests/test_broker.py pins the audit entries
+byte-identical across framings).
 
 Every request object carries:
-  op      — the operation name (broker.py's dispatch key)
+  op      — the operation name (broker.py's dispatch key; on the binary
+            framing a 1-byte opcode from the compact op table)
   seq     — a client-assigned sequence number echoed in the reply, so a
             desynced connection is detected instead of mis-pairing
   span    — the caller's active flight-recorder span context (op + seq +
@@ -20,41 +39,70 @@ Every request object carries:
             ring links back to the daemon-side trace (/debug/flight)
 
 and every reply carries `ok` (bool), `seq` (echoed), and either result
-fields or `error` + `kind`. The handshake is its own op ("hello"): the
-client sends PROTOCOL_VERSION, the broker refuses a mismatch with
-kind="version" BEFORE serving anything else — an old daemon can never
-drive a new broker into undefined requests, and vice versa.
+fields or `error` + `kind`.
+
+Batched crossings: a `batch` request carries up to MAX_BATCH_OPS fd-free
+sub-operations in its `ops` field and its reply pairs each with a typed
+sub-result in `results` — one round trip for a whole claim's
+revalidation + readlinks or a whole health cycle's probes, with
+PARTIAL-FAILURE semantics (one refused sub-op never poisons the batch;
+a dead broker types EVERY sub-result as unavailable).
+
+The response ring (spawn mode): the broker mmaps a small file-backed
+slot array (RingWriter) and hands the fd to the client ONCE at
+handshake. After serving a hot read-only op (config probes, readlinks,
+vendor/attr reads) over the socket, the broker PUBLISHES the result
+into the slot keyed by (op, path); the client (RingReader) consults the
+ring before paying a socket round trip. Each slot is seqlock-stamped
+(odd = write in progress; changed = torn) and publish-timestamped, so a
+torn or stale read is DETECTED and falls back to the socket path — the
+ring can serve bounded-staleness reads or nothing, never garbage.
 
 Robustness rules, enforced on BOTH sides:
-  - a frame without the magic, or longer than MAX_FRAME, is a protocol
-    error: the receiver raises (server side: replies kind="protocol"
-    then closes) — a corrupt length prefix must never turn into a
-    multi-GB allocation;
+  - a frame without a known magic, or longer than MAX_FRAME, is a
+    protocol error: the receiver raises (server side: replies
+    kind="protocol" then closes) — a corrupt length prefix must never
+    turn into a multi-GB allocation;
   - short reads (peer died mid-frame) raise BrokerConnectionLost, the
     typed signal broker.BrokerClient turns into "typed unavailable"
     claim errors;
-  - received fds the caller did not expect are closed immediately, never
-    leaked.
+  - received fds are closed on EVERY decode-error path (bad magic,
+    oversized frame, malformed payload, short read) — never leaked.
 
 No threading in this module: callers serialize access to a connection
 (broker.SocketBrokerClient holds one plain lock around each
 request/reply pair; the broker serves each connection on its own
-thread).
+thread). The ring writer has one writer (the broker process) by
+construction; readers are wait-free.
 """
 
 from __future__ import annotations
 
 import json
+import mmap
+import os
 import socket
 import struct
-from typing import List, Optional, Tuple
+import tempfile
+import time
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
-MAGIC = b"TDPB"
-PROTOCOL_VERSION = 1
+from .epoch import encode_delimited, encode_varint
+
+MAGIC = b"TDPB"          # v1 JSON framing
+BIN_MAGIC = b"TDBB"      # v2 binary framing (negotiated at hello)
+PROTOCOL_VERSION = 2
+SUPPORTED_VERSIONS = frozenset({1, 2})
 # one frame must fit a batched revalidation for a large claim plus audit
 # context, and nothing else — 1 MiB is orders of magnitude above both
 MAX_FRAME = 1 << 20
 MAX_FDS = 8
+# per-batch op cap: a whole claim's revalidation or a whole probe
+# cycle fits in a few dozen sub-ops; anything larger is a bug (or an
+# attempt to wedge the sequential broker behind one giant crossing)
+MAX_BATCH_OPS = 128
 
 _LEN = struct.Struct(">I")
 _HEADER_SIZE = len(MAGIC) + _LEN.size
@@ -62,7 +110,7 @@ _HEADER_SIZE = len(MAGIC) + _LEN.size
 
 class BrokerProtocolError(Exception):
     """The peer spoke something that is not this protocol (bad magic,
-    oversized/underflowing frame, non-JSON payload, non-object payload,
+    oversized/underflowing frame, malformed payload, non-object payload,
     mismatched seq). The connection is unusable afterwards."""
 
 
@@ -71,20 +119,350 @@ class BrokerConnectionLost(Exception):
     kill -9 signal the serving daemon maps to typed-unavailable errors."""
 
 
-def _encode(obj: dict) -> bytes:
-    payload = json.dumps(obj, separators=(",", ":"),
-                         sort_keys=True).encode("utf-8")
+# ------------------------------------------------------ binary op table
+#
+# The compact op table (round 20): every known operation gets a 1-byte
+# opcode and every known request/reply field a fixed tag + value kind,
+# so a hot crossing encodes to a handful of varint records instead of a
+# JSON object — and the static part of a request can be PRE-SERIALIZED
+# once and reused (RequestEncoder). Kinds:
+#   o  opcode (varint, OP_CODE table; unknown names ride the catch-all)
+#   i  signed int (zigzag varint)        u  unsigned int (varint)
+#   b  bool (varint 0/1)                 s  UTF-8 string (delimited)
+#   j  JSON value (delimited)            B  repeated nested body (delimited)
+#   t  trace-span context (delimited; op/seq/trace_id/span_id joined by
+#      US (0x1f) — the one per-crossing dict, so it gets a codec that
+#      skips the nested-JSON round trip; anything but the canonical
+#      span_context() shape rides the catch-all)
+# Anything else — unknown keys, wrong-typed values, empty B lists —
+# rides a _TAG_OTHER record carrying JSON [key, value], so the binary
+# framing can carry EVERY dict the JSON framing can: the two framings
+# decode to identical requests by construction.
+
+OPS = ("hello", "node_exists", "open_node", "read_attr", "read_link",
+       "write_sysfs", "probe_config", "probe_node", "chip_alive",
+       "chip_diagnostics", "revalidate", "stats", "shutdown", "batch")
+OP_CODE: Dict[str, int] = {name: i + 1 for i, name in enumerate(OPS)}
+OP_NAME: Dict[int, str] = {i + 1: name for i, name in enumerate(OPS)}
+
+_FIELD_DEFS: Tuple[Tuple[str, int, str], ...] = (
+    ("op", 1, "o"),
+    ("seq", 2, "i"),
+    ("span", 3, "t"),
+    ("path", 4, "s"),
+    ("data", 5, "s"),
+    ("ok", 6, "b"),
+    ("error", 7, "s"),
+    ("kind", 8, "s"),
+    ("version", 9, "i"),
+    ("pid", 10, "u"),
+    ("exists", 11, "b"),
+    ("target", 12, "s"),
+    ("verdict", 13, "i"),
+    ("alive", 14, "b"),
+    ("bits", 15, "i"),
+    ("link", 16, "s"),
+    ("pci_base", 17, "s"),
+    ("bdf", 18, "s"),
+    ("node", 19, "s"),
+    ("vendors", 20, "j"),
+    ("pairs", 21, "j"),
+    ("errors", 22, "j"),
+    ("broker", 23, "j"),
+    ("ops", 24, "B"),
+    ("results", 25, "B"),
+    ("ring", 26, "b"),
+    ("ring_slots", 27, "u"),
+    ("ring_slot_size", 28, "u"),
+    ("key", 29, "s"),
+)
+_TAG_OTHER = 31
+_FIELD_BY_KEY = {key: (tag, kind) for key, tag, kind in _FIELD_DEFS}
+_FIELD_BY_TAG = {tag: (key, kind) for key, tag, kind in _FIELD_DEFS}
+
+# precompute each field's record prefix (tag word varint — one byte for
+# tags <= 15, two for the rest) so the hot encoder does a dict lookup,
+# not an encode_varint call
+_PFX_VARINT = {key: encode_varint(tag << 3) for key, tag, _k in _FIELD_DEFS}
+_PFX_DELIM = {key: encode_varint((tag << 3) | 2)
+              for key, tag, _k in _FIELD_DEFS}
+
+_JSON_SEP = (",", ":")
+
+# the two per-call tail records RequestEncoder appends on every crossing
+_SEQ_PFX = _PFX_VARINT["seq"]
+_SPAN_PFX = _PFX_DELIM["span"]
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) if n >= 0 else ((-n << 1) - 1)
+
+
+def _unzigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Decode one base-128 varint at `pos` → (value, new pos)."""
+    result = 0
+    shift = 0
+    n = len(buf)
+    while True:
+        if pos >= n:
+            raise BrokerProtocolError("truncated varint in binary frame")
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise BrokerProtocolError("varint overflow in binary frame")
+
+
+def _json_bytes(value) -> bytes:
+    return json.dumps(value, separators=_JSON_SEP,
+                      sort_keys=True).encode("utf-8")
+
+
+_US = "\x1f"
+
+
+def _encode_span(span) -> Optional[bytes]:
+    """The canonical span_context() dict → compact US-joined payload, or
+    None when the value is not that exact shape (then the catch-all
+    record carries it with full JSON fidelity)."""
+    if not isinstance(span, dict):
+        return None
+    op = span.get("op")
+    seq = span.get("seq")
+    if not isinstance(op, str) or _US in op \
+            or not isinstance(seq, int) or isinstance(seq, bool):
+        return None
+    tid = span.get("trace_id")
+    sid = span.get("span_id")
+    if tid is None and sid is None:
+        if len(span) != 2:
+            return None
+        text = op + _US + str(seq)
+    else:
+        if len(span) != 4 or not isinstance(tid, str) \
+                or not isinstance(sid, str) or _US in tid or _US in sid:
+            return None
+        text = op + _US + str(seq) + _US + tid + _US + sid
+    return text.encode("utf-8")
+
+
+def _decode_span(chunk: bytes) -> dict:
+    parts = chunk.decode("utf-8").split(_US)
+    if len(parts) == 2:
+        return {"op": parts[0], "seq": int(parts[1])}
+    if len(parts) == 4:
+        return {"op": parts[0], "seq": int(parts[1]),
+                "trace_id": parts[2], "span_id": parts[3]}
+    raise ValueError(f"span context with {len(parts)} segments")
+
+
+def encode_body(obj: dict) -> bytes:
+    """One request/reply dict → compact binary records (no frame header).
+    Total: decode_body(encode_body(obj)) == obj for every JSON-able dict
+    (modulo None-valued keys, which both framings treat as absent)."""
+    parts: List[bytes] = []
+    for key, value in obj.items():
+        if value is None:
+            continue
+        spec = _FIELD_BY_KEY.get(key)
+        tag, kind = spec if spec is not None else (None, None)
+        if kind == "o" and isinstance(value, str) and value in OP_CODE:
+            parts.append(_PFX_VARINT[key]
+                         + encode_varint(OP_CODE[value]))
+        elif kind == "i" and isinstance(value, int) \
+                and not isinstance(value, bool):
+            parts.append(_PFX_VARINT[key]
+                         + encode_varint(_zigzag(value)))
+        elif kind == "u" and isinstance(value, int) \
+                and not isinstance(value, bool) and value >= 0:
+            parts.append(_PFX_VARINT[key] + encode_varint(value))
+        elif kind == "b" and isinstance(value, bool):
+            parts.append(_PFX_VARINT[key]
+                         + encode_varint(1 if value else 0))
+        elif kind == "s" and isinstance(value, str):
+            raw = value.encode("utf-8")
+            parts.append(_PFX_DELIM[key] + encode_varint(len(raw)) + raw)
+        elif kind == "t" and (raw := _encode_span(value)) is not None:
+            parts.append(_PFX_DELIM[key] + encode_varint(len(raw)) + raw)
+        elif kind == "j":
+            raw = _json_bytes(value)
+            parts.append(_PFX_DELIM[key] + encode_varint(len(raw)) + raw)
+        elif kind == "B" and isinstance(value, (list, tuple)) and value \
+                and all(isinstance(v, dict) for v in value):
+            for sub in value:
+                parts.append(encode_delimited(tag, encode_body(sub)))
+        else:
+            # catch-all: unknown key or a value this field's compact
+            # kind cannot carry — full fidelity beats compactness
+            parts.append(encode_delimited(
+                _TAG_OTHER, _json_bytes([key, value])))
+    return b"".join(parts)
+
+
+def decode_body(payload: bytes) -> dict:
+    """Binary records → the request/reply dict. Unknown tags are skipped
+    by wire type (forward-compatible within v2); malformed records raise
+    BrokerProtocolError."""
+    obj: dict = {}
+    pos = 0
+    n = len(payload)
+    while pos < n:
+        tagword, pos = _read_varint(payload, pos)
+        tag, wire = tagword >> 3, tagword & 7
+        if wire == 0:
+            value, pos = _read_varint(payload, pos)
+            spec = _FIELD_BY_TAG.get(tag)
+            if spec is None:
+                continue
+            key, kind = spec
+            if kind == "o":
+                name = OP_NAME.get(value)
+                if name is None:
+                    raise BrokerProtocolError(
+                        f"unknown opcode {value} in binary frame")
+                obj[key] = name
+            elif kind == "i":
+                obj[key] = _unzigzag(value)
+            elif kind == "b":
+                obj[key] = bool(value)
+            elif kind == "u":
+                obj[key] = value
+            else:
+                raise BrokerProtocolError(
+                    f"field {key!r} arrived as varint, expected "
+                    f"delimited (kind {kind!r})")
+        elif wire == 2:
+            length, pos = _read_varint(payload, pos)
+            if length > n - pos:
+                raise BrokerProtocolError(
+                    "truncated delimited record in binary frame")
+            chunk = payload[pos:pos + length]
+            pos += length
+            try:
+                if tag == _TAG_OTHER:
+                    pair = json.loads(chunk.decode("utf-8"))
+                    if not (isinstance(pair, list) and len(pair) == 2
+                            and isinstance(pair[0], str)):
+                        raise BrokerProtocolError(
+                            "malformed catch-all record")
+                    obj[pair[0]] = pair[1]
+                    continue
+                spec = _FIELD_BY_TAG.get(tag)
+                if spec is None:
+                    continue
+                key, kind = spec
+                if kind == "s":
+                    obj[key] = chunk.decode("utf-8")
+                elif kind == "t":
+                    obj[key] = _decode_span(chunk)
+                elif kind == "j":
+                    obj[key] = json.loads(chunk.decode("utf-8"))
+                elif kind == "B":
+                    obj.setdefault(key, []).append(decode_body(chunk))
+                else:
+                    raise BrokerProtocolError(
+                        f"field {key!r} arrived delimited, expected "
+                        f"varint (kind {kind!r})")
+            except (UnicodeDecodeError, ValueError) as exc:
+                raise BrokerProtocolError(
+                    f"malformed binary record (tag {tag}): {exc}") from exc
+        else:
+            raise BrokerProtocolError(
+                f"unsupported wire type {wire} in binary frame")
+    return obj
+
+
+class RequestEncoder:
+    """Pre-serialized binary request frames (the RPCAcc move, applied to
+    the broker boundary): the STATIC field segment of a request — opcode
+    plus its scalar operands, which repeat across crossings (the same
+    probe path every health cycle, the same readlink every prepare) —
+    is encoded once and cached; a crossing appends only the per-call
+    seq + span records and the frame header. The cache is a small LRU
+    keyed by the static field items; unhashable operands (batch sub-op
+    lists) simply encode fresh."""
+
+    def __init__(self, maxsize: int = 256) -> None:
+        self._cache: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._maxsize = maxsize
+        self.static_hits = 0
+
+    def encode_frame(self, obj: dict) -> bytes:
+        # key on the UNSORTED item tuple: hot requests are built at one
+        # construction site, so their key order repeats; two orderings
+        # of the same operands just occupy two cache slots
+        static_items = tuple(
+            (k, v) for k, v in obj.items() if k != "seq" and k != "span")
+        static: Optional[bytes] = None
+        key: Optional[tuple] = None
+        try:
+            static = self._cache.get(static_items)
+            key = static_items
+        except TypeError:
+            pass   # unhashable operand (lists/dicts): encode fresh
+        if static is None:
+            static = encode_body(dict(static_items))
+            if key is not None:
+                self._cache[key] = static
+                self._cache.move_to_end(key)
+                if len(self._cache) > self._maxsize:
+                    self._cache.popitem(last=False)
+        else:
+            self.static_hits += 1
+        # the per-call tail is hand-rolled — no dict build, no generic
+        # field walk — because it runs once per crossing
+        payload = static
+        seq = obj.get("seq")
+        if seq is not None:
+            payload += _SEQ_PFX + encode_varint(_zigzag(seq))
+        span = obj.get("span")
+        if span is not None:
+            raw = _encode_span(span)
+            if raw is not None:
+                payload += _SPAN_PFX + encode_varint(len(raw)) + raw
+            else:
+                payload += encode_delimited(
+                    _TAG_OTHER, _json_bytes(["span", span]))
+        if len(payload) > MAX_FRAME:
+            raise BrokerProtocolError(
+                f"frame payload {len(payload)} bytes exceeds MAX_FRAME "
+                f"{MAX_FRAME}")
+        return BIN_MAGIC + _LEN.pack(len(payload)) + payload
+
+
+# ---------------------------------------------------------- frame codec
+
+def _encode(obj: dict, binary: bool = False) -> bytes:
+    if binary:
+        payload = encode_body(obj)
+        magic = BIN_MAGIC
+    else:
+        payload = _json_bytes(obj)
+        magic = MAGIC
     if len(payload) > MAX_FRAME:
         raise BrokerProtocolError(
             f"frame payload {len(payload)} bytes exceeds MAX_FRAME "
             f"{MAX_FRAME}")
-    return MAGIC + _LEN.pack(len(payload)) + payload
+    return magic + _LEN.pack(len(payload)) + payload
 
 
 def send_frame(sock: socket.socket, obj: dict,
-               fds: Tuple[int, ...] = ()) -> None:
+               fds: Tuple[int, ...] = (), binary: bool = False) -> None:
     """Send one frame; `fds` ride as SCM_RIGHTS on the first byte."""
-    data = _encode(obj)
+    send_encoded(sock, _encode(obj, binary=binary), fds=fds)
+
+
+def send_encoded(sock: socket.socket, data: bytes,
+                 fds: Tuple[int, ...] = ()) -> None:
+    """Send pre-encoded frame bytes (RequestEncoder output) — the
+    fast-path twin of send_frame."""
     try:
         if fds:
             if len(fds) > MAX_FDS:
@@ -115,8 +493,18 @@ def _recv_exact(sock: socket.socket, n: int,
 
 def recv_frame(sock: socket.socket, want_fds: int = 0,
                ) -> Tuple[dict, List[int]]:
-    """Receive one frame → (object, fds). `want_fds` is the MAXIMUM fd
-    count the caller will accept; extras are closed, never leaked."""
+    """Receive one frame → (object, fds); framing auto-detected."""
+    obj, fds, _binary = recv_frame_ex(sock, want_fds=want_fds)
+    return obj, fds
+
+
+def recv_frame_ex(sock: socket.socket, want_fds: int = 0,
+                  ) -> Tuple[dict, List[int], bool]:
+    """Receive one frame → (object, fds, was_binary). `want_fds` is the
+    MAXIMUM fd count the caller will accept; extras are closed, never
+    leaked. Received fds are closed on EVERY decode-error path — a peer
+    that passes an fd and then speaks garbage must not leak it into this
+    process (the round-20 regression pin)."""
     fds: List[int] = []
     if want_fds > 0:
         # the ancillary data arrives with the first data bytes; ask for
@@ -130,35 +518,45 @@ def recv_frame(sock: socket.socket, want_fds: int = 0,
         if not head:
             raise BrokerConnectionLost("peer closed")
         fds = list(received)
-        header = _recv_exact(sock, _HEADER_SIZE, first=head)
     else:
-        header = _recv_exact(sock, _HEADER_SIZE)
+        head = b""
+    # EVERYTHING after the first fd-bearing recv runs under the close-on
+    # -error guard: a short read completing the header, a bad magic, an
+    # oversized length, a malformed payload — each closes received fds
+    # before raising
     try:
-        if header[:len(MAGIC)] != MAGIC:
-            raise BrokerProtocolError(
-                f"bad frame magic {header[:len(MAGIC)]!r}")
+        header = _recv_exact(sock, _HEADER_SIZE, first=head)
+        magic = header[:len(MAGIC)]
+        if magic == MAGIC:
+            binary = False
+        elif magic == BIN_MAGIC:
+            binary = True
+        else:
+            raise BrokerProtocolError(f"bad frame magic {magic!r}")
         (length,) = _LEN.unpack(header[len(MAGIC):])
         if length > MAX_FRAME:
             raise BrokerProtocolError(
                 f"frame length {length} exceeds MAX_FRAME {MAX_FRAME}")
         payload = _recv_exact(sock, length)
-        try:
-            obj = json.loads(payload.decode("utf-8"))
-        except (UnicodeDecodeError, ValueError) as exc:
-            raise BrokerProtocolError(f"malformed frame payload: {exc}") \
-                from exc
+        if binary:
+            obj = decode_body(payload)
+        else:
+            try:
+                obj = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError) as exc:
+                raise BrokerProtocolError(
+                    f"malformed frame payload: {exc}") from exc
         if not isinstance(obj, dict):
             raise BrokerProtocolError(
                 f"frame payload is {type(obj).__name__}, not an object")
     except Exception:
         close_fds(fds)
         raise
-    return obj, fds
+    return obj, fds, binary
 
 
 def close_fds(fds) -> None:
     """Best-effort close of received fds (error paths, unwanted extras)."""
-    import os
     for fd in fds:
         try:
             os.close(fd)
@@ -166,21 +564,35 @@ def close_fds(fds) -> None:
             pass
 
 
-def hello_request(seq: int = 0) -> dict:
-    return {"op": "hello", "seq": seq, "version": PROTOCOL_VERSION}
+# ------------------------------------------------------------ handshake
+
+def hello_request(seq: int = 0, version: int = PROTOCOL_VERSION,
+                  ring: bool = False) -> dict:
+    req = {"op": "hello", "seq": seq, "version": version}
+    if ring:
+        req["ring"] = True
+    return req
 
 
-def check_hello_reply(reply: dict) -> None:
-    """Raise BrokerProtocolError unless the broker accepted our version."""
+def check_hello_reply(reply: dict,
+                      requested: int = PROTOCOL_VERSION) -> int:
+    """Raise BrokerProtocolError unless the broker accepted a version we
+    speak; returns the NEGOTIATED version (<= requested). A v1 broker
+    answering version 1 to a v2 client is a valid downgrade — the client
+    keeps JSON framing; anything outside SUPPORTED_VERSIONS (or above
+    what we asked for) is a refusal."""
     if not reply.get("ok"):
         raise BrokerProtocolError(
             f"broker refused handshake: {reply.get('error', 'unknown')} "
             f"(kind={reply.get('kind')!r}, broker version "
             f"{reply.get('version')!r}, ours {PROTOCOL_VERSION})")
-    if reply.get("version") != PROTOCOL_VERSION:
+    version = reply.get("version")
+    if not isinstance(version, int) or version not in SUPPORTED_VERSIONS \
+            or version > requested:
         raise BrokerProtocolError(
-            f"broker answered version {reply.get('version')!r}, "
-            f"ours {PROTOCOL_VERSION}")
+            f"broker answered version {version!r}, ours "
+            f"{PROTOCOL_VERSION} (requested {requested})")
+    return version
 
 
 def span_context() -> Optional[dict]:
@@ -203,3 +615,158 @@ def span_context() -> Optional[dict]:
         out["trace_id"] = ctx["trace_id"]
         out["span_id"] = ctx["span_id"]
     return out
+
+
+# -------------------------------------------------------- response ring
+#
+# The shared-memory response ring (round 20): a file-backed slot array
+# the broker WRITES and the serving daemon READS, handed over once via
+# SCM_RIGHTS at handshake. Layout:
+#
+#   header  RING_MAGIC + u32 slot_count + u32 slot_size (+ pad to 64)
+#   slot    u32 seqlock | u32 key_len | u32 val_len | f64 publish_ts
+#           | key bytes | value bytes (JSON)          (fixed slot_size)
+#
+# Writer protocol (single writer — the broker process): bump the seqlock
+# ODD, write header + key + value, bump it EVEN. Reader protocol: read
+# seqlock (odd → torn), read the body, re-read the seqlock (changed →
+# torn), compare the key (hash-slot collision → miss), check the publish
+# timestamp against the caller's TTL (CLOCK_MONOTONIC is system-wide on
+# Linux, so the stamp is comparable across the two processes). Torn,
+# stale and missed reads all fall back to the socket path — detected,
+# counted, never wrong. CPython cannot order individual stores, but the
+# seqlock brackets make ANY interleaving detectable: a reader either
+# sees both brackets unchanged (consistent body) or retries.
+
+RING_MAGIC = b"TDPR"
+RING_SLOTS = 512
+RING_SLOT_SIZE = 512
+RING_DEFAULT_TTL_S = 0.5
+_RING_HEADER = struct.Struct(">4sII")
+_RING_HEADER_PAD = 64
+_RING_SLOT_HDR = struct.Struct(">IIId")
+
+
+def ring_key(op: str, path: str) -> bytes:
+    return f"{op}\x00{path}".encode("utf-8", "surrogatepass")
+
+
+class RingWriter:
+    """The broker-side (single-writer) half of the response ring."""
+
+    def __init__(self, slots: int = RING_SLOTS,
+                 slot_size: int = RING_SLOT_SIZE) -> None:
+        if slots <= 0 or slot_size <= _RING_SLOT_HDR.size:
+            raise ValueError("ring geometry too small")
+        self.slots = slots
+        self.slot_size = slot_size
+        self.published = 0
+        self.skipped_oversize = 0
+        size = _RING_HEADER_PAD + slots * slot_size
+        try:
+            fd = os.memfd_create("tdp-broker-ring")
+        except (AttributeError, OSError):
+            # pre-memfd kernel / container: an unlinked temp file is the
+            # same thing with a directory-entry lifetime of microseconds
+            fd, path = tempfile.mkstemp(prefix="tdp-broker-ring-")
+            os.unlink(path)
+        os.ftruncate(fd, size)
+        self.fd = fd
+        self._mm = mmap.mmap(fd, size)
+        _RING_HEADER.pack_into(self._mm, 0, RING_MAGIC, slots, slot_size)
+
+    def publish(self, key: bytes, value: dict) -> bool:
+        """Publish one (key, value) into its hash slot; False when the
+        entry cannot fit (counted, never truncated)."""
+        val = _json_bytes(value)
+        if _RING_SLOT_HDR.size + len(key) + len(val) > self.slot_size:
+            self.skipped_oversize += 1
+            return False
+        off = _RING_HEADER_PAD + (zlib.crc32(key) % self.slots) \
+            * self.slot_size
+        mm = self._mm
+        (seq,) = struct.unpack_from(">I", mm, off)
+        seq_odd = (seq + 1) & 0xFFFFFFFF
+        if not seq_odd & 1:   # heal an even+1 landing even (wrap)
+            seq_odd = (seq_odd + 1) & 0xFFFFFFFF
+        struct.pack_into(">I", mm, off, seq_odd)
+        _RING_SLOT_HDR.pack_into(mm, off, seq_odd, len(key), len(val),
+                                 time.monotonic())
+        base = off + _RING_SLOT_HDR.size
+        mm[base:base + len(key)] = key
+        mm[base + len(key):base + len(key) + len(val)] = val
+        struct.pack_into(">I", mm, off, (seq_odd + 1) & 0xFFFFFFFF)
+        self.published += 1
+        return True
+
+    def stats(self) -> dict:
+        return {"slots": self.slots, "slot_size": self.slot_size,
+                "published_total": self.published,
+                "skipped_oversize_total": self.skipped_oversize}
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+
+
+class RingReader:
+    """The daemon-side (wait-free) half: maps the fd received at
+    handshake read-only and serves seqlock-validated lookups. The fd can
+    be closed by the caller after construction — the mapping survives."""
+
+    def __init__(self, fd: int) -> None:
+        size = os.fstat(fd).st_size
+        if size < _RING_HEADER_PAD:
+            raise BrokerProtocolError("response ring file too small")
+        self._mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        magic, slots, slot_size = _RING_HEADER.unpack_from(self._mm, 0)
+        if magic != RING_MAGIC or slots <= 0 \
+                or slot_size <= _RING_SLOT_HDR.size \
+                or _RING_HEADER_PAD + slots * slot_size > size:
+            self._mm.close()
+            raise BrokerProtocolError("response ring header invalid")
+        self.slots = slots
+        self.slot_size = slot_size
+
+    def lookup(self, key: bytes,
+               ttl_s: float = RING_DEFAULT_TTL_S
+               ) -> Tuple[Optional[dict], str]:
+        """→ (value, "hit") or (None, "miss" | "torn" | "stale"). Torn
+        and stale readers fall back to the socket path — the ring serves
+        bounded-staleness values or nothing."""
+        mm = self._mm
+        off = _RING_HEADER_PAD + (zlib.crc32(key) % self.slots) \
+            * self.slot_size
+        (s1,) = struct.unpack_from(">I", mm, off)
+        if s1 == 0:
+            return None, "miss"
+        if s1 & 1:
+            return None, "torn"
+        _seq, key_len, val_len, ts = _RING_SLOT_HDR.unpack_from(mm, off)
+        if _RING_SLOT_HDR.size + key_len + val_len > self.slot_size:
+            return None, "torn"
+        base = off + _RING_SLOT_HDR.size
+        body = bytes(mm[base:base + key_len + val_len])
+        (s2,) = struct.unpack_from(">I", mm, off)
+        if s2 != s1:
+            return None, "torn"
+        if body[:key_len] != key:
+            return None, "miss"
+        if time.monotonic() - ts > ttl_s:
+            return None, "stale"
+        try:
+            return json.loads(body[key_len:].decode("utf-8")), "hit"
+        except (UnicodeDecodeError, ValueError):
+            return None, "torn"
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass

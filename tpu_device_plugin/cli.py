@@ -113,6 +113,14 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
                         help="seconds to wait for the spawned broker to "
                              "bind its socket and answer the version "
                              "handshake before aborting startup")
+    parser.add_argument("--broker-protocol", choices=("auto", "1", "2"),
+                        default=None,
+                        help="broker IPC framing to OFFER at the hello "
+                             "handshake: 2 negotiates the compact binary "
+                             "frames + response ring (round 20), 1 forces "
+                             "JSON framing (rollback / mixed-version "
+                             "debugging), auto offers the newest (default "
+                             "auto; env TDP_BROKER_PROTOCOL)")
     parser.add_argument("--policy-dir", default=None,
                         help="directory of sandboxed operator policy "
                              "modules (*.py; policy.py hooks: "
@@ -325,6 +333,19 @@ def build_config(argv=None) -> "tuple[Config, argparse.Namespace]":
             args.broker = mode
         else:
             args.broker = cfg.broker_mode
+    if args.broker_protocol is None:
+        env_proto = os.environ.get("TDP_BROKER_PROTOCOL")
+        if env_proto is not None and env_proto.strip():
+            proto = env_proto.strip().lower()
+            if proto not in ("auto", "1", "2"):
+                # same fail-loud contract as $TDP_BROKER: a typo'd
+                # protocol silently negotiating the wrong framing is
+                # exactly the confusion the flag exists to remove
+                parser.error(f"$TDP_BROKER_PROTOCOL={env_proto!r} is not "
+                             "a broker protocol (use auto, 1 or 2)")
+            args.broker_protocol = proto
+        else:
+            args.broker_protocol = "auto"
     if math.isnan(args.policy_hook_deadline_ms) \
             or math.isinf(args.policy_hook_deadline_ms) \
             or args.policy_hook_deadline_ms <= 0:
@@ -479,12 +500,19 @@ def main(argv=None) -> int:
     try:
         if cfg.broker_mode == "spawn":
             logger = logging.getLogger(__name__)
+            from . import brokeripc
+            offer = (brokeripc.PROTOCOL_VERSION
+                     if args.broker_protocol == "auto"
+                     else int(args.broker_protocol))
             try:
                 client = broker_mod.SocketBrokerClient(
                     cfg.broker_socket_path,
-                    connect_timeout_s=args.broker_handshake_timeout)
+                    connect_timeout_s=args.broker_handshake_timeout,
+                    protocol_version=offer)
                 logger.info("connected to existing broker on %s (daemon "
-                            "restart path)", cfg.broker_socket_path)
+                            "restart path; protocol v%d)",
+                            cfg.broker_socket_path,
+                            client.negotiated_version)
             except broker_mod.BrokerUnavailable:
                 if broker_mod.socket_live(cfg.broker_socket_path):
                     # something IS listening but would not complete the
@@ -499,9 +527,12 @@ def main(argv=None) -> int:
                     timeout_s=args.broker_handshake_timeout)
                 client = broker_mod.SocketBrokerClient(
                     cfg.broker_socket_path,
-                    connect_timeout_s=args.broker_handshake_timeout)
-                logger.info("spawned privileged broker pid=%d on %s",
-                            broker_proc.pid, cfg.broker_socket_path)
+                    connect_timeout_s=args.broker_handshake_timeout,
+                    protocol_version=offer)
+                logger.info("spawned privileged broker pid=%d on %s "
+                            "(protocol v%d)", broker_proc.pid,
+                            cfg.broker_socket_path,
+                            client.negotiated_version)
             broker_mod.set_client(client)
         else:
             # in-process mode: install the seam EXPLICITLY so the

@@ -153,6 +153,7 @@ _SITE_CATEGORY: Dict[str, str] = {
     "pci.replug": "value",
     "migration.handoff": "raising",
     "broker.ipc": "value",
+    "broker.ring": "value",
     "policy.hook": "raising",
 }
 _DEFAULT_KIND = {"raising": "error", "value": "drop"}

@@ -472,12 +472,39 @@ class HealthHub:
                 still_stuck = set(self._stuck)
             verdicts: Dict[str, bool] = {}
             futs: Dict[str, futures.Future] = {}
+            # partition into BATCHED groups and singles (round 20): a
+            # spawn-mode probe closure carries a `.batch` callable (one
+            # broker crossing for the whole group — see BrokeredHealth.
+            # chip_alive_batch) and a `.batch_key` identifying which
+            # closures may share a crossing; everything else keeps the
+            # one-submission-per-bdf path unchanged
+            batch_groups: Dict[object, Tuple[
+                Callable, List[Tuple[str, Optional[str]]]]] = {}
+            singles: Dict[str, Tuple[Callable, Optional[str]]] = {}
+            for bdf, (probe, node) in bdf_map.items():
+                if bdf in still_stuck:
+                    verdicts[bdf] = False
+                    continue
+                batch_fn = getattr(probe, "batch", None)
+                if batch_fn is not None:
+                    gkey = getattr(probe, "batch_key", id(probe))
+                    _fn, items = batch_groups.setdefault(
+                        gkey, (batch_fn, []))
+                    items.append((bdf, node))
+                else:
+                    singles[bdf] = (probe, node)
+            batch_futs: List[Tuple[
+                List[Tuple[str, Optional[str]]], futures.Future]] = []
+            batched = sum(len(items)
+                          for _fn, items in batch_groups.values())
             try:
-                for bdf, (probe, node) in bdf_map.items():
-                    if bdf in still_stuck:
-                        verdicts[bdf] = False
-                        continue
-                    futs[bdf] = pool.submit(self._probe_one, probe, bdf, node)
+                for bdf, (probe, node) in singles.items():
+                    futs[bdf] = pool.submit(self._probe_one, probe, bdf,
+                                            node)
+                for batch_fn, items in batch_groups.values():
+                    batch_futs.append(
+                        (items, pool.submit(self._probe_batch, batch_fn,
+                                            items)))
             except RuntimeError:
                 return {}  # pool shut down under us (hub.stop mid-cycle)
             deadline = t0 + self.probe_deadline_s
@@ -503,9 +530,33 @@ class HealthHub:
                     log.warning("liveness probe for %s exceeded the %.2fs "
                                 "deadline; scoring dead", bdf,
                                 self.probe_deadline_s)
+            for items, fut in batch_futs:
+                try:
+                    got = fut.result(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    for bdf, _node in items:
+                        verdicts[bdf] = bool(got.get(bdf, False))
+                except futures.CancelledError:
+                    for bdf, _node in items:
+                        verdicts[bdf] = False
+                except futures.TimeoutError:
+                    # the whole group shares one worker, so a stuck batch
+                    # costs one worker and every member keeps its dead
+                    # verdict without resubmission until it returns
+                    if not fut.cancel():
+                        with self._lock:
+                            for bdf, _node in items:
+                                self._stuck[bdf] = fut
+                    for bdf, _node in items:
+                        verdicts[bdf] = False
+                    timeouts += 1
+                    log.warning("batched liveness probe of %d chips "
+                                "exceeded the %.2fs deadline; scoring "
+                                "dead", len(items), self.probe_deadline_s)
             wall = time.monotonic() - t0
             cycle_span.set(probes=len(bdf_map),
                            deduped=requested - len(bdf_map),
+                           batched=batched,
                            timeouts=timeouts)
             with self._lock:
                 self._probe_cycles += 1
@@ -566,6 +617,49 @@ class HealthHub:
                           bdf, exc)
                 sp.set(alive=False, probe_error=str(exc))
                 return False
+
+    def _probe_batch(self, batch_fn: Callable,
+                     items: List[Tuple[str, Optional[str]]],
+                     ) -> Dict[str, bool]:
+        """One batched crossing for a whole probe group (spawn mode).
+        Fault injection still applies PER BDF — an armed "native.probe"
+        scores that chip dead without probing it, and the rest of the
+        group still crosses — and a dead broker degrades every member
+        exactly as the singular path would (counted per member, scored
+        dead until the broker returns)."""
+        out: Dict[str, bool] = {}
+        live: List[Tuple[str, Optional[str]]] = []
+        with trace.span("health.probe_batch", probes=len(items)) as sp:
+            for bdf, node in items:
+                if faults.fire("native.probe", bdf=bdf):
+                    out[bdf] = False
+                else:
+                    live.append((bdf, node))
+            if not live:
+                sp.set(injected=len(items))
+                return out
+            try:
+                got = batch_fn(live)
+                for bdf, _node in live:
+                    out[bdf] = bool(got.get(bdf, False))
+            except BrokerUnavailable as exc:
+                with self._lock:
+                    self._probe_broker_unavailable += len(live)
+                log.error("batched liveness probe of %d chips degraded "
+                          "(%s); scoring dead until the broker returns",
+                          len(live), exc)
+                sp.set(broker_unavailable=True)
+                for bdf, _node in live:
+                    out[bdf] = False
+            except Exception as exc:
+                with self._lock:
+                    self._probe_errors += len(live)
+                log.error("batched liveness probe raised (%s); scoring "
+                          "%d chips dead", exc, len(live))
+                sp.set(probe_error=str(exc))
+                for bdf, _node in live:
+                    out[bdf] = False
+        return out
 
     # -------------------------------------------------------------- stats
 

@@ -520,6 +520,9 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         # the "native.probe" fault point now fires inside the hub's probe
         # runner (healthhub._probe_one), so the closure here is the plain
         # native liveness read
+        probe = lambda bdf, node: self.health_shim.chip_alive(  # noqa: E731
+            self.cfg.pci_base_path, bdf, node)
+        self._attach_probe_batch(probe)
         self._subscribe_health(HubSubscription(
             name=self.resource_name,
             socket_path=self.socket_path,
@@ -527,9 +530,27 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             group_paths=group_paths,
             group_bdfs=group_bdfs,
             on_device_health=self.set_group_health,
-            probe=lambda bdf, node: self.health_shim.chip_alive(
-                self.cfg.pci_base_path, bdf, node),
+            probe=probe,
         ))
+
+    def _attach_probe_batch(self, probe, node_for=None) -> None:
+        """Mark the probe closure batchable when the health shim can
+        coalesce a whole cycle's probes into ONE broker crossing
+        (spawn-mode BrokeredHealth): the hub groups closures sharing a
+        batch_key — same shim, same pci root — into one submission.
+        `node_for` substitutes the representative node per bdf exactly
+        as the singular closure would (the vtpu parent mapping)."""
+        shim = self.health_shim
+        batch = getattr(shim, "chip_alive_batch", None)
+        if batch is None:
+            return
+        base = self.cfg.pci_base_path
+        if node_for is None:
+            probe.batch = lambda items: batch(base, items)
+        else:
+            probe.batch = lambda items: batch(
+                base, [(bdf, node_for(bdf)) for bdf, _node in items])
+        probe.batch_key = (id(shim), base)
 
     def _subscribe_health(self, sub: HubSubscription) -> None:
         """Attach this server's health filter to the shared hub, or to a
@@ -975,7 +996,14 @@ class TpuDevicePlugin(api.DevicePluginServicer):
             # on another thread can suppress this call's reuse count —
             # a rare undercount, never an overcount.
             ser_before = self._alloc_serializations.value
+            # crossings-per-claim bracket (round 20): the live gauge the
+            # batching work is judged by — a multi-group claim must pay
+            # ONE revalidation crossing, visible on /status + /metrics
+            client = broker_mod.get_client()
+            cross_before = client.crossings.value
             resp = self._allocate_impl(request, context)
+            client.note_claim_crossings(
+                client.crossings.value - cross_before)
             self.record_allocation(ids)
             if isinstance(resp, bytes):
                 if ids and self._alloc_serializations.value == ser_before:

@@ -1052,6 +1052,27 @@ class StatusServer:
             "process).",
             "# TYPE tdp_broker_spawn_mode gauge",
             f"tdp_broker_spawn_mode {int(brk.get('mode') == 'spawn')}",
+            "# HELP tdp_broker_batched_ops_total Sub-operations carried "
+            "by batched broker crossings (the gap to crossings_total is "
+            "round trips the batch path saved).",
+            "# TYPE tdp_broker_batched_ops_total counter",
+            f"tdp_broker_batched_ops_total {brk.get('batched_ops_total', 0)}",
+            "# HELP tdp_broker_ring_hits_total Hot reads served from the "
+            "shared-memory response ring without a socket round trip.",
+            "# TYPE tdp_broker_ring_hits_total counter",
+            f"tdp_broker_ring_hits_total {brk.get('ring_hits_total', 0)}",
+            "# HELP tdp_broker_ring_fallbacks_total Ring lookups that "
+            "fell back to the socket path (miss, stale, torn slot, or "
+            "injected broker.ring fault).",
+            "# TYPE tdp_broker_ring_fallbacks_total counter",
+            f"tdp_broker_ring_fallbacks_total "
+            f"{brk.get('ring_fallbacks_total', 0)}",
+            "# HELP tdp_broker_crossings_per_claim Privilege crossings "
+            "the most recent claim paid (Allocate or DRA prepare; the "
+            "batching budget is 1 revalidation crossing per claim).",
+            "# TYPE tdp_broker_crossings_per_claim gauge",
+            f"tdp_broker_crossings_per_claim "
+            f"{brk.get('crossings_per_claim', 0)}",
         ]
         # operator policy decisions (policy.py): emitted only when an
         # engine is loaded, like the dra section

@@ -121,6 +121,9 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             # parent BDF and fan out to every partition of that chip
             self.set_devices_health(children.get(key, [key]), ok, src)
 
+        probe = lambda bdf, _node: self.health_shim.chip_alive(  # noqa: E731
+            self.cfg.pci_base_path, bdf, parent_node.get(bdf))
+        self._attach_probe_batch(probe, node_for=parent_node.get)
         self._subscribe_health(HubSubscription(
             name=self.resource_name,
             socket_path=self.socket_path,
@@ -132,8 +135,7 @@ class VtpuDevicePlugin(TpuDevicePlugin):
             # resource's subscription down to ONE physical read
             group_bdfs={parent: [parent] for parent in children},
             on_device_health=on_health,
-            probe=lambda bdf, _node: self.health_shim.chip_alive(
-                self.cfg.pci_base_path, bdf, parent_node.get(bdf)),
+            probe=probe,
         ))
 
     # ------------------------------------------------------------------- RPCs
