@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-brokeripc bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-brokeripc bench-restart bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
 
 all: native proto
 
@@ -212,6 +212,16 @@ bench-broker:
 # bench-smoke runs the --quick variant.
 bench-brokeripc:
 	JAX_PLATFORMS=cpu $(PYTHON) bench.py --brokeripc
+
+# Restart-to-ready bench (docs/design.md "Boot sequence"): counted
+# cold-walk vs persisted-snapshot-warm boots at 64/4096 devices (the
+# >=10x reads / >=3x wall pins), the two-wave readiness edges under a
+# membership invalidation, corrupt-cache fallback + re-seed, claims
+# exactly-once across restarts, and the 256-node rolling-upgrade
+# node-seconds-unready wave (>=2x pin). Writes
+# docs/bench_restart_r21.json. CI bench-smoke runs the --quick variant.
+bench-restart:
+	JAX_PLATFORMS=cpu $(PYTHON) bench.py --restart
 
 # Attach transport-endgame bench (docs/perf.md "Transport endgame"):
 # pre-serialized hot responses — the calibrated attach wall (<200 us

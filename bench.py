@@ -3821,6 +3821,348 @@ def run_selfheal(quick=False):
     return out
 
 
+# ------------------------------------------- restart-to-ready (round 21)
+
+
+def _restart_boot(cfg):
+    """One counted PluginManager boot against a live fake kubelet:
+    {"wall_ms", "reads", "plugins", ...boot_stats}. The wall clock wraps
+    start() itself — everything the daemon pays before its run loop,
+    including the cold boot's snapshot seed write (the warm path skips
+    the re-save when the cache just validated clean, so the asymmetry is
+    the code's, not the harness's)."""
+    from tpu_device_plugin.lifecycle import PluginManager
+    mgr = PluginManager(cfg)
+    t0 = time.monotonic()
+    with count_reads() as counter:
+        mgr.start()
+    wall_ms = round((time.monotonic() - t0) * 1e3, 3)
+    cell = dict(mgr.boot_stats)
+    cell["wall_ms"] = wall_ms
+    cell["reads"] = counter.reads
+    cell["plugins"] = len(mgr.plugins)
+    mgr.stop()
+    return cell
+
+
+def _restart_host(n_devices, build=None):
+    """(root, cfg, kubelet) for one restart cell; caller cleans up."""
+    from tests.fakehost import FakeKubelet
+    root = tempfile.mkdtemp(prefix="tdp-restart-")
+    if build is None:
+        _build_host(root, n_devices)
+    else:
+        build(root)
+    cfg = Config().with_root(root)
+    os.makedirs(cfg.device_plugin_path, exist_ok=True)
+    return root, cfg, FakeKubelet(cfg.kubelet_socket)
+
+
+def _restart_single_cell(n_devices, cold_runs=2, warm_runs=3):
+    """Cold vs snapshot-warm restart at one device count. Each cold
+    sample deletes the cache first (a real first boot); warm samples
+    reuse the cache the last cold run seeded. Medians, plus the counted
+    read totals the honesty pin locks."""
+    root, cfg, kubelet = _restart_host(n_devices)
+    try:
+        colds, warms = [], []
+        for _ in range(cold_runs):
+            try:
+                os.unlink(cfg.discovery_snapshot_path)
+            except OSError:
+                pass
+            colds.append(_restart_boot(cfg))
+        for _ in range(warm_runs):
+            warms.append(_restart_boot(cfg))
+        for c in colds:
+            assert c["boot_path"] == "cold", c
+        for w in warms:
+            assert w["boot_path"] == "snapshot" and w["invalidated"] == 0, w
+        cold_ms = statistics.median(c["wall_ms"] for c in colds)
+        warm_ms = statistics.median(w["wall_ms"] for w in warms)
+        return {
+            "devices": n_devices,
+            "cold_wall_ms": round(cold_ms, 3),
+            "warm_wall_ms": round(warm_ms, 3),
+            "wall_ratio": round(cold_ms / max(1e-9, warm_ms), 2),
+            "cold_reads": colds[-1]["reads"],
+            "warm_reads": warms[-1]["reads"],
+            "reads_ratio": round(colds[-1]["reads"]
+                                 / max(1, warms[-1]["reads"]), 1),
+            "cold_ready_ms": round(statistics.median(
+                c["restart_ready_ms"] for c in colds), 3),
+            "warm_ready_ms": round(statistics.median(
+                w["restart_ready_ms"] for w in warms), 3),
+            "samples": {"cold": cold_runs, "warm": warm_runs},
+        }
+    finally:
+        kubelet.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _restart_two_wave_cell():
+    """Two models on one host; after the cache is seeded, one model-B
+    chip leaves (membership change — the invalidation the revalidation
+    stat pass detects without dirty hints). The warm boot must ship the
+    intact model in wave 1 (first-resource-ready) and converge the
+    tainted one from cold reads in wave 2 (all-resources-ready),
+    STRICTLY later."""
+    def build(root):
+        host = FakeHost(root)
+        for i in range(8):
+            host.add_chip(FakeChip(f"0000:01:{4 + i:02x}.0",
+                                   device_id="0062",
+                                   iommu_group=str(11 + i), numa_node=0))
+        for i in range(8):
+            host.add_chip(FakeChip(f"0000:02:{4 + i:02x}.0",
+                                   device_id="0063",
+                                   iommu_group=str(31 + i), numa_node=1))
+
+    root, cfg, kubelet = _restart_host(0, build=build)
+    try:
+        seed = _restart_boot(cfg)
+        assert seed["boot_path"] == "cold" and seed["plugins"] == 2, seed
+        shutil.rmtree(os.path.join(cfg.pci_base_path, "0000:02:04.0"))
+        warm = _restart_boot(cfg)
+        assert warm["boot_path"] == "snapshot", warm
+        assert warm["invalidated"] >= 1, warm
+        first = warm["first_resource_ready_ms"]
+        alldone = warm["all_resources_ready_ms"]
+        assert first < alldone, (
+            f"wave 1 must strictly precede wave 2: {first} vs {alldone}")
+        return {
+            "invalidated": warm["invalidated"],
+            "first_resource_ready_ms": first,
+            "all_resources_ready_ms": alldone,
+            "first_strictly_before_all": True,
+            "plugins": warm["plugins"],
+        }
+    finally:
+        kubelet.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _restart_corrupt_cell(n_devices=64):
+    """A torn/garbage cache must NEVER be trusted: boot falls back to
+    the counted cold walk, converges, and (because the cold walk
+    re-seeds the cache atomically) the NEXT boot goes warm again."""
+    root, cfg, kubelet = _restart_host(n_devices)
+    try:
+        seed = _restart_boot(cfg)
+        with open(cfg.discovery_snapshot_path, "w") as f:
+            f.write('{"version": 1, "records": {')   # torn mid-write
+        corrupt = _restart_boot(cfg)
+        assert corrupt["boot_path"] == "cold", corrupt
+        assert corrupt["snapshot_outcome"] == "corrupt", corrupt
+        assert corrupt["plugins"] == seed["plugins"], corrupt
+        healed = _restart_boot(cfg)
+        assert healed["boot_path"] == "snapshot", healed
+        return {
+            "devices": n_devices,
+            "fallback_outcome": corrupt["snapshot_outcome"],
+            "fallback_reads": corrupt["reads"],
+            "fallback_converged": corrupt["plugins"] == seed["plugins"],
+            "next_boot_warm": healed["boot_path"] == "snapshot",
+        }
+    finally:
+        kubelet.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _restart_claims_cell():
+    """Claims across the restart boundary: prepare against a live
+    fabric, cold-restart (seeds the cache), warm-restart, replay the
+    same claims (idempotent prepare must ride the restored pre-
+    serialized ack bytes), then run the full fleet invariant sweep —
+    exactly-once on the fabric audit, zero lost claims, zero orphan
+    specs."""
+    from tpu_device_plugin.fleetsim import FleetSim, fleet_invariants
+
+    sim = FleetSim(n_nodes=1, devices_per_node=8, latency_s=0.0, seed=21)
+    try:
+        node = sim.nodes[0]
+        assert node.boot()
+        uids = node.register_claims(4)
+        resp = node.attach(uids)
+        assert not any(resp.claims[u].error for u in uids), resp
+        prepared = node.driver.prepared_claim_count()
+        cold = node.restart_with_discovery(warm=True)    # no cache yet
+        warm = node.restart_with_discovery(warm=True)
+        assert cold["path"] == "cold" and warm["path"] == "snapshot", (
+            cold, warm)
+        assert node.driver.prepared_claim_count() == prepared
+        replay = node.attach(uids)   # kubelet replay after restart
+        assert not any(replay.claims[u].error for u in uids), replay
+        ack = node.driver.ack_byte_stats()
+        inv = fleet_invariants(sim, confirm=lambda: None)
+        assert inv["ok"], inv["violations"]
+        return {
+            "prepared_claims": prepared,
+            "cold_restart_reads": cold["reads"],
+            "warm_restart_reads": warm["reads"],
+            "replay_ack_bytes_reused": ack["reused"],
+            "exactly_once": inv["ok"],
+            "violations": inv["violations"],
+        }
+    finally:
+        sim.stop()
+
+
+def _restart_rolling_cell(n_nodes, devices_per_node, batch_size,
+                          sysfs_read_cost_s=0.0005):
+    """The fleet-operations shape: a rolling daemon upgrade where every
+    node pays its restart INCLUDING discovery. Baseline wave = the
+    pre-snapshot daemon (full cold walk + identity reads every time);
+    then a seeding wave (first warm-path restart per node is cold and
+    writes the cache) and the measured FAST wave where every node rides
+    the snapshot. Headline: node-seconds-unready, baseline vs fast.
+
+    `sysfs_read_cost_s` (0.5 ms/access) models real-host sysfs/config-
+    space IO the same way the fabric models service time (the sim's
+    tmpfs reads are ~free); the charge is counted-reads x cost INSIDE
+    each node's unready window, so both waves pay for exactly the IO
+    they do — the ratio is the read-count ratio doing the work, not a
+    thumb on the scale (reads_total is recorded beside it)."""
+    from tpu_device_plugin.fleetsim import FleetSim, fleet_invariants
+
+    sim = FleetSim(n_nodes=n_nodes, devices_per_node=devices_per_node,
+                   latency_s=0.0, seed=21, build_workers=16)
+    try:
+        results = sim._storm(lambda n: n.boot())
+        assert all(results), "boot storm failed"
+        storm = sim.attach_storm(claims_per_node=2)
+        assert not storm["errors"], storm["errors"]
+        baseline = sim.rolling_upgrade_wave(
+            batch_size=batch_size, warm=False,
+            sysfs_read_cost_s=sysfs_read_cost_s)
+        seeding = sim.rolling_upgrade_wave(
+            batch_size=batch_size, warm=True,
+            sysfs_read_cost_s=sysfs_read_cost_s)
+        fast = sim.rolling_upgrade_wave(
+            batch_size=batch_size, warm=True,
+            sysfs_read_cost_s=sysfs_read_cost_s)
+        assert seeding["paths"] == {"cold": n_nodes}, seeding["paths"]
+        assert fast["paths"] == {"snapshot": n_nodes}, fast["paths"]
+        inv = fleet_invariants(sim, confirm=lambda: None)
+        assert inv["ok"], inv["violations"]
+        ratio = round(baseline["node_seconds_unready"]
+                      / max(1e-9, fast["node_seconds_unready"]), 2)
+        return {
+            "nodes": n_nodes,
+            "devices_per_node": devices_per_node,
+            "batch_size": batch_size,
+            "claims_per_node": 2,
+            "baseline": baseline,
+            "seeding": seeding,
+            "fast": fast,
+            "unready_ratio": ratio,
+            "exactly_once": inv["ok"],
+        }
+    finally:
+        sim.stop()
+
+
+def run_restart(quick=False):
+    """`bench.py --restart` (r21): restart-to-ready — the persisted
+    discovery snapshot + parallel boot pipeline vs the classic cold
+    walk (make bench-restart).
+
+    Cells (assertions are the acceptance pins; test_perf_honesty locks
+    the committed artifact):
+
+      - SINGLE NODE at {64, 4096} devices ({64} quick): counted cold
+        boot (full sysfs walk + per-device identity reads + cache seed)
+        vs snapshot-warm boot (load + one batched revalidation pass).
+        Headline: warm >= 10x fewer counted reads AND >= 3x lower
+        restart-to-ready wall at 4096.
+      - TWO-WAVE: a membership change under the cache makes wave 1
+        register the intact resource straight from the snapshot while
+        wave 2 cold-reads only the tainted model —
+        first-resource-ready STRICTLY before all-resources-ready.
+      - CORRUPT CACHE: torn-mid-write garbage is refused, boot degrades
+        to the counted cold walk, converges, and re-seeds (next boot
+        warm again).
+      - CLAIMS EXACTLY-ONCE: prepared claims survive cold AND warm
+        restarts; the kubelet's post-restart replay rides the restored
+        pre-serialized ack bytes; full fleet invariant sweep green.
+      - ROLLING UPGRADE at 256 nodes x 16 devices (16 x 4 quick),
+        batches of 16: node-seconds-unready, pre-snapshot baseline vs
+        the fast path — >= 2x better.
+
+    Writes docs/bench_restart_r21.json ($BENCH_RESTART_OUT overrides;
+    --quick lands in a sibling *_quick file so the committed artifact
+    the perf-honesty pin reads is never clobbered).
+    """
+    out = {"quick": quick}
+    sizes = (64,) if quick else (64, 4096)
+    out["single_node"] = [_restart_single_cell(n) for n in sizes]
+    for cell in out["single_node"]:
+        print(f"  single n={cell['devices']}: cold "
+              f"{cell['cold_wall_ms']} ms/{cell['cold_reads']} reads | "
+              f"warm {cell['warm_wall_ms']} ms/{cell['warm_reads']} "
+              f"reads | wall {cell['wall_ratio']}x reads "
+              f"{cell['reads_ratio']}x", file=sys.stderr)
+    out["two_wave"] = _restart_two_wave_cell()
+    print(f"  two-wave: invalidated={out['two_wave']['invalidated']} "
+          f"first {out['two_wave']['first_resource_ready_ms']} ms < all "
+          f"{out['two_wave']['all_resources_ready_ms']} ms",
+          file=sys.stderr)
+    out["corrupt_cache"] = _restart_corrupt_cell()
+    print(f"  corrupt: outcome={out['corrupt_cache']['fallback_outcome']}"
+          f" converged={out['corrupt_cache']['fallback_converged']} "
+          f"next_warm={out['corrupt_cache']['next_boot_warm']}",
+          file=sys.stderr)
+    out["claims"] = _restart_claims_cell()
+    print(f"  claims: prepared={out['claims']['prepared_claims']} "
+          f"survive cold+warm, ack reuse="
+          f"{out['claims']['replay_ack_bytes_reused']}B, exactly_once="
+          f"{out['claims']['exactly_once']}", file=sys.stderr)
+    out["rolling_upgrade"] = (_restart_rolling_cell(16, 4, 8) if quick
+                              else _restart_rolling_cell(256, 16, 16))
+    roll = out["rolling_upgrade"]
+    print(f"  rolling n={roll['nodes']}: baseline "
+          f"{roll['baseline']['node_seconds_unready']} node-s | fast "
+          f"{roll['fast']['node_seconds_unready']} node-s | "
+          f"{roll['unready_ratio']}x", file=sys.stderr)
+
+    key = out["single_node"][-1]
+    if not quick:
+        assert key["reads_ratio"] >= 10.0, (
+            f"warm reads ratio {key['reads_ratio']}x < 10x floor")
+        assert key["wall_ratio"] >= 3.0, (
+            f"warm wall ratio {key['wall_ratio']}x < 3x floor")
+        assert roll["unready_ratio"] >= 2.0, (
+            f"rolling unready ratio {roll['unready_ratio']}x < 2x floor")
+    default_name = ("bench_restart_r21_quick.json" if quick
+                    else "bench_restart_r21.json")
+    out_path = os.environ.get("BENCH_RESTART_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "docs", default_name)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    return {
+        "metric": "restart_ready_warm_speedup",
+        "value": key["wall_ratio"],
+        "unit": "x",
+        "vs_baseline": round(key["wall_ratio"] / 3.0, 3),
+        "baseline_source": "ISSUE 19 acceptance: snapshot-warm restart "
+                           ">= 10x fewer counted sysfs reads AND >= 3x "
+                           "lower restart-to-ready wall than the cold "
+                           "walk at 4096 devices; first-resource-ready "
+                           "strictly before all-resources-ready; claims "
+                           "exactly-once across restart; corrupt cache "
+                           "falls back cold and converges; rolling "
+                           "upgrade >= 2x less node-seconds-unready",
+        "reads_ratio": key["reads_ratio"],
+        "warm_wall_ms": key["warm_wall_ms"],
+        "cold_wall_ms": key["cold_wall_ms"],
+        "rolling_unready_ratio": roll["unready_ratio"],
+        "exactly_once": out["claims"]["exactly_once"]
+        and roll["exactly_once"],
+        "matrix_file": os.path.relpath(
+            out_path, os.path.dirname(os.path.abspath(__file__))),
+    }
+
+
 def main() -> int:
     import logging
     logging.disable(logging.CRITICAL)  # keep the one-line contract
@@ -3846,6 +4188,9 @@ def main() -> int:
         # the soak ends with invariant violations — the report is still
         # printed and the artifact still written for the post-mortem
         return 0 if out["soak_ok"] else 1
+    if "--restart" in sys.argv:
+        print(json.dumps(run_restart(quick="--quick" in sys.argv)))
+        return 0
     if "--brokeripc" in sys.argv:
         print(json.dumps(run_brokeripc(quick="--quick" in sys.argv)))
         return 0

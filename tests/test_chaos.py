@@ -764,3 +764,54 @@ def test_watch_stream_chaos_storm_converges_exactly_once():
     finally:
         faults.reset()
         sim.stop()
+
+
+# --------------------------------------- snapshot-cache chaos (ISSUE 19)
+
+
+def test_restart_under_snapshot_fault_converges_zero_lost_claims():
+    """`discovery.snapshot` armed across a restart: the warm path's
+    cache load reads as untrusted, boot degrades to the counted cold
+    walk, the node converges with EVERY prepared claim intact, the
+    cold walk re-seeds the cache — and with the fault exhausted the
+    NEXT restart rides the snapshot again. The fast path must never
+    trade durability for speed: a poisoned cache costs reads, not
+    claims."""
+    from tpu_device_plugin.fleetsim import FleetSim, fleet_invariants
+
+    sim = FleetSim(n_nodes=1, devices_per_node=8, latency_s=0.0,
+                   seed=SEED)
+    try:
+        node = sim.nodes[0]
+        assert node.boot()
+        uids = node.register_claims(4)
+        resp = node.attach(uids)
+        assert not any(resp.claims[u].error for u in uids), resp
+        prepared = node.driver.prepared_claim_count()
+
+        seeding = node.restart_with_discovery(warm=True)  # seeds cache
+        assert seeding["path"] == "cold"
+
+        faults.arm("discovery.snapshot", kind="drop", count=1)
+        poisoned = node.restart_with_discovery(warm=True)
+        assert poisoned["path"] == "cold", poisoned
+        assert faults.stats().get("discovery.snapshot") == 1
+        assert node.driver.prepared_claim_count() == prepared
+        # the degraded restart still paid the FULL counted walk (no
+        # half-trusted shortcut) and left a fresh cache behind
+        assert poisoned["reads"] >= 8 * 5, poisoned
+
+        healed = node.restart_with_discovery(warm=True)
+        assert healed["path"] == "snapshot", healed
+        assert node.driver.prepared_claim_count() == prepared
+        assert healed["reads"] * 10 <= poisoned["reads"]
+
+        # replayed prepares after all three restarts: idempotent, no
+        # errors, nothing double-prepared
+        replay = node.attach(uids)
+        assert not any(replay.claims[u].error for u in uids), replay
+        assert node.driver.prepared_claim_count() == prepared
+        inv = fleet_invariants(sim, confirm=lambda: None)
+        assert inv["ok"], inv["violations"]
+    finally:
+        sim.stop()

@@ -1,0 +1,170 @@
+"""Persisted discovery snapshot durability (ISSUE 19).
+
+The cache is derived data with zero tolerance for trust errors: a
+rejected envelope must NEVER reach a plugin table (fallback = the
+counted cold walk re-derives everything), and the write must be
+crash-safe (temp + fsync + rename beside the DRA checkpoint) so a
+reader observes either the old envelope or the new one, never a torn
+write. Boot-level trust rules live in lifecycle.start(); this file
+pins the envelope mechanics underneath them.
+"""
+
+import json
+import os
+
+import pytest
+
+from tests.fakehost import FakeChip, FakeHost
+from tpu_device_plugin import faults
+from tpu_device_plugin.config import Config
+from tpu_device_plugin.discovery import HostSnapshot, count_reads
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _host(root, n=8):
+    host = FakeHost(root)
+    for i in range(n):
+        host.add_chip(FakeChip(f"0000:00:{4 + i:02x}.0", device_id="0063",
+                               iommu_group=str(11 + i), numa_node=i // 4))
+    return host
+
+
+def _seed(root, n=8):
+    """Scanned snapshot + saved cache; returns (cfg, cache_path)."""
+    _host(root, n)
+    cfg = Config().with_root(str(root))
+    path = os.path.join(str(root), "discovery-snapshot.json")
+    snap = HostSnapshot(cfg)
+    snap.rescan()
+    assert snap.save_cache(path)
+    return cfg, path
+
+
+def test_roundtrip_loads_and_revalidates_with_few_reads(tmp_path):
+    cfg, path = _seed(tmp_path)
+    snap = HostSnapshot(cfg)
+    assert snap.load_cache(path) == "loaded"
+    with count_reads() as counter:
+        assert snap.revalidate() == set()
+    # shallow tier only: membership listdirs + bus signature, not a
+    # per-device walk (the 10x boot pin rides on this staying tiny)
+    assert counter.reads <= 8, counter.paths
+    registry, _ = snap.build_excluding(())
+    assert len(registry.all_devices()) == 8
+
+
+def test_unscanned_snapshot_refuses_to_save(tmp_path):
+    _host(tmp_path)
+    cfg = Config().with_root(str(tmp_path))
+    snap = HostSnapshot(cfg)
+    path = os.path.join(str(tmp_path), "discovery-snapshot.json")
+    assert not snap.save_cache(path)
+    assert not os.path.exists(path)
+
+
+def test_save_is_atomic_replace_with_no_temp_residue(tmp_path,
+                                                     monkeypatch):
+    cfg, path = _seed(tmp_path)
+    directory = os.path.dirname(path)
+    # a crash at the rename boundary (ENOSPC, kill) leaves the OLD
+    # envelope intact and no temp file behind
+    before = open(path).read()
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("crash mid-write")
+
+    monkeypatch.setattr(os, "replace", boom)
+    snap = HostSnapshot(cfg)
+    snap.rescan()
+    assert not snap.save_cache(path)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert open(path).read() == before
+    residue = [f for f in os.listdir(directory)
+               if f.startswith(".snapshot-")]
+    assert residue == [], residue
+    # the old envelope still loads — a failed save costs nothing now
+    assert HostSnapshot(cfg).load_cache(path) == "loaded"
+
+
+def test_truncated_cache_refused_then_replaced_by_cold_walk(tmp_path):
+    cfg, path = _seed(tmp_path)
+    with open(path, "w") as f:
+        f.write('{"version": 1, "records": {"0000:00')   # torn write
+    snap = HostSnapshot(cfg)
+    assert snap.load_cache(path) == "corrupt"
+    assert snap.stats["snapshot_fallbacks"] == 1
+    # fallback pays the counted cold walk, then re-seeds atomically
+    with count_reads() as counter:
+        registry, _ = snap.rescan()
+    assert len(registry.all_devices()) == 8
+    assert counter.reads >= 8 * 5
+    assert snap.save_cache(path)
+    assert HostSnapshot(cfg).load_cache(path) == "loaded"
+
+
+def test_future_version_refused(tmp_path):
+    cfg, path = _seed(tmp_path)
+    with open(path) as f:
+        env = json.load(f)
+    env["version"] = 99
+    with open(path, "w") as f:
+        json.dump(env, f)
+    # future versions refuse like past ones: derived data has no
+    # migration ladder, one cold walk re-derives everything
+    assert HostSnapshot(cfg).load_cache(path) == "version"
+
+
+def test_signature_version_mismatch_refused(tmp_path):
+    cfg, path = _seed(tmp_path)
+    with open(path) as f:
+        env = json.load(f)
+    env["signature_version"] = -1
+    with open(path, "w") as f:
+        json.dump(env, f)
+    assert HostSnapshot(cfg).load_cache(path) == "signature"
+
+
+def test_missing_cache_is_quiet_fallback(tmp_path):
+    _host(tmp_path)
+    cfg = Config().with_root(str(tmp_path))
+    snap = HostSnapshot(cfg)
+    assert snap.load_cache(
+        os.path.join(str(tmp_path), "nope.json")) == "missing"
+    assert snap.stats["snapshot_fallbacks"] == 1
+
+
+def test_fault_site_forces_cold_then_recovers(tmp_path):
+    """`discovery.snapshot` armed: the load reads as untrusted (the
+    torn-write/unreadable failure mode on demand) and the fallback
+    counter ticks; once the fault exhausts, the SAME file loads."""
+    cfg, path = _seed(tmp_path)
+    faults.arm("discovery.snapshot", kind="drop", count=1)
+    snap = HostSnapshot(cfg)
+    assert snap.load_cache(path) == "fault"
+    assert snap.stats["snapshot_fallbacks"] == 1
+    assert snap.load_cache(path) == "loaded"
+
+
+def test_revalidate_detects_membership_change_and_taints_model(tmp_path):
+    """A device dir that vanished between boots invalidates on the
+    shallow membership pass, and taint_groups expands to every sibling
+    of its model — wave 1 must not ship a half-validated resource."""
+    cfg, path = _seed(tmp_path)
+    import shutil
+    shutil.rmtree(os.path.join(cfg.pci_base_path, "0000:00:04.0"))
+    snap = HostSnapshot(cfg)
+    assert snap.load_cache(path) == "loaded"
+    invalidated = snap.revalidate()
+    assert "0000:00:04.0" in invalidated
+    tainted = snap.taint_groups(invalidated)
+    # all 8 seeded chips share device_id 0063 -> the whole model taints
+    assert len(tainted) == 8
+    registry, _ = snap.build_excluding(tainted)
+    assert registry.all_devices() == []

@@ -405,6 +405,13 @@ class InProcessBroker(_BaseClient):
                 except OSError:
                     target = None
                 return {"ok": True, "seq": index, "target": target}
+            if op == "stat_sig":
+                try:
+                    st = os.stat(str(sub["path"]))
+                    sig: Optional[List[int]] = [st.st_mtime_ns, st.st_size]
+                except OSError:
+                    sig = None
+                return {"ok": True, "seq": index, "sig": sig}
             if op == "probe_config":
                 return {"ok": True, "seq": index,
                         "verdict": self._health.probe_config(
@@ -1117,6 +1124,18 @@ class BrokerServer:
                     reply["target"] = None
                 self._ring_publish("read_link", path,
                                    {"target": reply["target"]})
+            elif op == "stat_sig":
+                # snapshot-revalidation change signature (batch-carried on
+                # boot: one crossing stats a whole host's device dirs); a
+                # vanished path is a None signature, not an error — the
+                # caller treats it as "invalidated, re-read cold"
+                path = str(req["path"])
+                self.policy.check_read(path)
+                try:
+                    st = os.stat(path)
+                    reply["sig"] = [st.st_mtime_ns, st.st_size]
+                except OSError:
+                    reply["sig"] = None
             elif op == "write_sysfs":
                 path = str(req["path"])
                 self.policy.check_write(path)
@@ -1359,6 +1378,13 @@ def get_client() -> _BaseClient:
     if client is None:
         client = _client = InProcessBroker()
     return client
+
+
+def peek_client() -> Optional[_BaseClient]:
+    """The installed client WITHOUT instantiating the lazy default —
+    discovery's snapshot revalidation runs before any serving surface is
+    up and must not be the accidental creator of the process seam."""
+    return _client
 
 
 def set_client(client: Optional[_BaseClient]) -> Optional[_BaseClient]:

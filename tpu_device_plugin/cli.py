@@ -14,6 +14,7 @@ import math
 import os
 import signal
 import threading
+from concurrent import futures
 from dataclasses import replace
 
 from .config import Config
@@ -497,50 +498,74 @@ def main(argv=None) -> int:
     # leaves the lazy audited in-process seam in place.
     from . import broker as broker_mod
     broker_proc = None
+    # Privilege separation (broker.py): in spawn mode the global broker
+    # seam is pointed at a separate privileged process. An existing
+    # broker on the socket (serving-daemon restart -- the broker survived
+    # us) is connected to and version-handshaked; otherwise one is
+    # spawned. In-process mode installs the audited in-process seam
+    # explicitly so the configured native lib reaches probes routed
+    # through it (the lazy default client has no cfg to read).
+    #
+    # Parallel boot pipeline: the spawn fork/exec + socket dial + version
+    # handshake is pure wall time that neither policy-module loading nor
+    # the DRA driver's checkpoint restore depends on -- it runs on a boot
+    # worker thread, overlapped with both, and is joined at the barrier
+    # below before the first consumer that crosses the seam (the
+    # PluginManager ctor builds its health shim through it; discovery
+    # crosses it in spawn mode).
+    broker_boot: dict = {}
+
+    def _boot_broker() -> None:
+        try:
+            if cfg.broker_mode == "spawn":
+                logger = logging.getLogger(__name__)
+                from . import brokeripc
+                offer = (brokeripc.PROTOCOL_VERSION
+                         if args.broker_protocol == "auto"
+                         else int(args.broker_protocol))
+                try:
+                    client = broker_mod.SocketBrokerClient(
+                        cfg.broker_socket_path,
+                        connect_timeout_s=args.broker_handshake_timeout,
+                        protocol_version=offer)
+                    logger.info("connected to existing broker on %s (daemon "
+                                "restart path; protocol v%d)",
+                                cfg.broker_socket_path,
+                                client.negotiated_version)
+                except broker_mod.BrokerUnavailable:
+                    if broker_mod.socket_live(cfg.broker_socket_path):
+                        # something IS listening but would not complete the
+                        # handshake (a wedged broker): spawning a duplicate
+                        # would unlink the live broker's socket and orphan
+                        # its held device fds -- refuse startup loudly and
+                        # let the operator deal with the stuck process
+                        raise
+                    broker_boot["proc"] = broker_mod.spawn_broker(
+                        cfg.broker_socket_path, root=cfg.root_path,
+                        native_lib_path=cfg.native_lib_path,
+                        timeout_s=args.broker_handshake_timeout)
+                    client = broker_mod.SocketBrokerClient(
+                        cfg.broker_socket_path,
+                        connect_timeout_s=args.broker_handshake_timeout,
+                        protocol_version=offer)
+                    logger.info("spawned privileged broker pid=%d on %s "
+                                "(protocol v%d)", broker_boot["proc"].pid,
+                                cfg.broker_socket_path,
+                                client.negotiated_version)
+                broker_mod.set_client(client)
+            else:
+                broker_mod.set_client(
+                    broker_mod.InProcessBroker(cfg.native_lib_path))
+        except BaseException as exc:   # published; re-raised at the barrier
+            broker_boot["error"] = exc
+
+    boot_pool = futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="boot-broker")
+    boot_pool.submit(_boot_broker)
     try:
-        if cfg.broker_mode == "spawn":
-            logger = logging.getLogger(__name__)
-            from . import brokeripc
-            offer = (brokeripc.PROTOCOL_VERSION
-                     if args.broker_protocol == "auto"
-                     else int(args.broker_protocol))
-            try:
-                client = broker_mod.SocketBrokerClient(
-                    cfg.broker_socket_path,
-                    connect_timeout_s=args.broker_handshake_timeout,
-                    protocol_version=offer)
-                logger.info("connected to existing broker on %s (daemon "
-                            "restart path; protocol v%d)",
-                            cfg.broker_socket_path,
-                            client.negotiated_version)
-            except broker_mod.BrokerUnavailable:
-                if broker_mod.socket_live(cfg.broker_socket_path):
-                    # something IS listening but would not complete the
-                    # handshake (a wedged broker): spawning a duplicate
-                    # would unlink the live broker's socket and orphan
-                    # its held device fds — refuse startup loudly and
-                    # let the operator deal with the stuck process
-                    raise
-                broker_proc = broker_mod.spawn_broker(
-                    cfg.broker_socket_path, root=cfg.root_path,
-                    native_lib_path=cfg.native_lib_path,
-                    timeout_s=args.broker_handshake_timeout)
-                client = broker_mod.SocketBrokerClient(
-                    cfg.broker_socket_path,
-                    connect_timeout_s=args.broker_handshake_timeout,
-                    protocol_version=offer)
-                logger.info("spawned privileged broker pid=%d on %s "
-                            "(protocol v%d)", broker_proc.pid,
-                            cfg.broker_socket_path,
-                            client.negotiated_version)
-            broker_mod.set_client(client)
-        else:
-            # in-process mode: install the seam EXPLICITLY so the
-            # configured native lib reaches any probe routed through it
-            # (the lazy default client has no cfg to read)
-            broker_mod.set_client(
-                broker_mod.InProcessBroker(cfg.native_lib_path))
-        # Operator policy hooks (policy.py): fail-loud loading — a broken
+        # --- overlapped with the broker handshake: nothing in this
+        # stretch crosses the privilege seam ---
+        # Operator policy hooks (policy.py): fail-loud loading -- a broken
         # policy module must refuse startup, not silently run without it
         policy_engine = None
         if cfg.policy_dir:
@@ -551,83 +576,91 @@ def main(argv=None) -> int:
             logging.getLogger(__name__).info(
                 "policy engine: %d module(s) loaded from %s",
                 n_modules, cfg.policy_dir)
+        stop = threading.Event()
+
+        def handle(signum, frame):
+            logging.getLogger(__name__).info("signal %d; shutting down", signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, handle)
+        signal.signal(signal.SIGINT, handle)
+        inventory_sinks = []
+        if args.label_node or args.feature_file:
+            from .labeler import NodeLabeler, node_facts
+            labeler = NodeLabeler(node_name=args.node_name,
+                                  api_server=args.api_server,
+                                  feature_file=args.feature_file,
+                                  require_api=args.label_node,
+                                  label_prefix=cfg.resource_namespace)
+            inventory_sinks.append(lambda reg, gens: labeler.publish(
+                node_facts(cfg, reg, gens)))
+        # SLO-closed-loop remediation (remediation.py): subscribes to the
+        # engine above; breach → pacer backoff + typed admission shed,
+        # recovery → rollback. Every action runs the policy remediate gate.
+        # Off with --no-remediation; without a DRA driver the pacer knob is
+        # simply absent and only the admission throttle can arm.
+        remediation_engine = None
+        if not args.no_remediation:
+            from .remediation import RemediationEngine
+            remediation_engine = RemediationEngine(policy=policy_engine)
+            slo.get_engine().subscribe(remediation_engine.on_transition)
+        dra_driver = None
+        health_listener = None
+        if args.dra:
+            from .dra import DraDriver
+            from .kubeapi import ApiClient, in_cluster_server
+            from .registry import Registry
+            server_url = args.api_server or in_cluster_server()
+            api = ApiClient(server_url) if server_url else None
+            dra_driver = DraDriver(cfg, Registry(), {}, node_name=args.node_name,
+                                   api=api, policy=policy_engine,
+                                   remediation=remediation_engine)
+            if remediation_engine is not None:
+                # the knob the self-heal plane turns on a burning publish/
+                # attach SLO — wired here because the pacer is born with the
+                # driver, after the engine
+                remediation_engine.pacer = dra_driver.pacer
+
+            def dra_sink(reg, gens, _d=dra_driver):
+                _d.set_inventory(reg, gens)
+                ok = _d.publish_resource_slices()
+                # sockets come up only AFTER the first discovery has filled the
+                # inventory: the kubelet may call NodePrepareResources the
+                # moment the registration socket appears, and an empty
+                # inventory would fail claims that are perfectly preparable
+                if not _d.serving:
+                    _d.start()
+                return ok
+            inventory_sinks.append(dra_sink)
+            # the plugin servers' ANDed health verdict prunes dead devices from
+            # the published ResourceSlice on the same transition that flips
+            # them Unhealthy on ListAndWatch (no second health watcher)
+            health_listener = dra_driver.apply_health
+        on_inventory = None
+        if inventory_sinks:
+            def on_inventory(reg, gens):
+                ok = True
+                for sink in inventory_sinks:
+                    ok = sink(reg, gens) and ok
+                return ok
+        # barrier: everything past here may cross the privilege seam
+        boot_pool.shutdown(wait=True)
+        if "error" in broker_boot:
+            raise broker_boot["error"]
+        broker_proc = broker_boot.get("proc")
     except Exception:
-        # a startup failure AFTER the broker spawned (handshake timeout,
-        # broken policy module) must not orphan a root-privileged child
-        if broker_proc is not None:
-            broker_proc.terminate()
+        # a boot failure BEFORE the barrier resolves (handshake timeout,
+        # broken policy module, checkpoint restore error) must not
+        # orphan a root-privileged child the worker thread spawned
+        boot_pool.shutdown(wait=True)
+        proc = broker_boot.get("proc")
+        if proc is not None:
+            proc.terminate()
             try:
-                broker_proc.wait(timeout=5)
+                proc.wait(timeout=5)
             except Exception:
-                broker_proc.kill()
+                proc.kill()
         raise
-    stop = threading.Event()
-
-    def handle(signum, frame):
-        logging.getLogger(__name__).info("signal %d; shutting down", signum)
-        stop.set()
-
-    signal.signal(signal.SIGTERM, handle)
-    signal.signal(signal.SIGINT, handle)
-    inventory_sinks = []
-    if args.label_node or args.feature_file:
-        from .labeler import NodeLabeler, node_facts
-        labeler = NodeLabeler(node_name=args.node_name,
-                              api_server=args.api_server,
-                              feature_file=args.feature_file,
-                              require_api=args.label_node,
-                              label_prefix=cfg.resource_namespace)
-        inventory_sinks.append(lambda reg, gens: labeler.publish(
-            node_facts(cfg, reg, gens)))
-    # SLO-closed-loop remediation (remediation.py): subscribes to the
-    # engine above; breach → pacer backoff + typed admission shed,
-    # recovery → rollback. Every action runs the policy remediate gate.
-    # Off with --no-remediation; without a DRA driver the pacer knob is
-    # simply absent and only the admission throttle can arm.
-    remediation_engine = None
-    if not args.no_remediation:
-        from .remediation import RemediationEngine
-        remediation_engine = RemediationEngine(policy=policy_engine)
-        slo.get_engine().subscribe(remediation_engine.on_transition)
-    dra_driver = None
-    health_listener = None
-    if args.dra:
-        from .dra import DraDriver
-        from .kubeapi import ApiClient, in_cluster_server
-        from .registry import Registry
-        server_url = args.api_server or in_cluster_server()
-        api = ApiClient(server_url) if server_url else None
-        dra_driver = DraDriver(cfg, Registry(), {}, node_name=args.node_name,
-                               api=api, policy=policy_engine,
-                               remediation=remediation_engine)
-        if remediation_engine is not None:
-            # the knob the self-heal plane turns on a burning publish/
-            # attach SLO — wired here because the pacer is born with the
-            # driver, after the engine
-            remediation_engine.pacer = dra_driver.pacer
-
-        def dra_sink(reg, gens, _d=dra_driver):
-            _d.set_inventory(reg, gens)
-            ok = _d.publish_resource_slices()
-            # sockets come up only AFTER the first discovery has filled the
-            # inventory: the kubelet may call NodePrepareResources the
-            # moment the registration socket appears, and an empty
-            # inventory would fail claims that are perfectly preparable
-            if not _d.serving:
-                _d.start()
-            return ok
-        inventory_sinks.append(dra_sink)
-        # the plugin servers' ANDed health verdict prunes dead devices from
-        # the published ResourceSlice on the same transition that flips
-        # them Unhealthy on ListAndWatch (no second health watcher)
-        health_listener = dra_driver.apply_health
-    on_inventory = None
-    if inventory_sinks:
-        def on_inventory(reg, gens):
-            ok = True
-            for sink in inventory_sinks:
-                ok = sink(reg, gens) and ok
-            return ok
     manager = PluginManager(cfg, on_inventory=on_inventory,
                             health_listener=health_listener,
                             policy_engine=policy_engine,

@@ -38,6 +38,11 @@ class Config:
     # sockets; the driver's service socket lives under dra_plugins_path.
     dra_plugins_path: str = "/var/lib/kubelet/plugins/"
     dra_registry_path: str = "/var/lib/kubelet/plugins_registry/"
+    # Persisted discovery snapshot (discovery.HostSnapshot.save_cache):
+    # lives beside the DRA checkpoint so both restart artifacts share one
+    # durability story. None disables persistence entirely.
+    discovery_snapshot_path: Optional[str] = \
+        "/var/lib/kubelet/plugins/discovery-snapshot.json"
 
     # --- resource naming ----------------------------------------------------
     # Extended-resource namespace: devices surface as
@@ -184,6 +189,8 @@ class Config:
             kubelet_socket=os.path.join(root, "device-plugins/kubelet.sock"),
             dra_plugins_path=os.path.join(root, "plugins/"),
             dra_registry_path=os.path.join(root, "plugins_registry/"),
+            discovery_snapshot_path=os.path.join(
+                root, "plugins/discovery-snapshot.json"),
             shared_device_classes=(os.path.join(root, "sys/class/egm"),),
             broker_socket_path=os.path.join(root, "run/broker.sock"),
         )

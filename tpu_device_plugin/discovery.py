@@ -14,10 +14,12 @@ import json
 import logging
 import os
 import re
+import tempfile
 import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from . import faults
 from . import lockdep
 from .config import Config
 from .naming import GenerationInfo, load_generation_map
@@ -67,6 +69,68 @@ def _stat_sig(path: str) -> Optional[Tuple[int, int]]:
     except OSError:
         return None
     return (st.st_mtime_ns, st.st_size)
+
+
+def _stat_sig_raw(path: str) -> Optional[Tuple[int, int]]:
+    """_stat_sig WITHOUT read accounting: used when capturing signatures
+    at snapshot-save time (post-boot bookkeeping, not discovery cost)."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size)
+
+
+def _stat_sigs_batched(paths: List[str]) -> List[Optional[Tuple[int, int]]]:
+    """Per-path dir stat signatures for snapshot revalidation, counted one
+    read each. In spawn mode the whole pass rides the broker's `run_batch`
+    (`stat_sig` sub-ops, ONE crossing per MAX_BATCH_OPS chunk) so a
+    4096-device revalidation never pays per-device crossings; in-process
+    mode (and any broker degradation) stats locally — same answers, same
+    counted cost."""
+    for p in paths:
+        _note(p)
+    if not paths:
+        return []
+    from . import broker as broker_mod
+    client = broker_mod.peek_client()
+    if client is not None and getattr(client, "mode", "") == "spawn":
+        try:
+            from . import brokeripc
+            out: List[Optional[Tuple[int, int]]] = []
+            for start in range(0, len(paths), brokeripc.MAX_BATCH_OPS):
+                chunk = paths[start:start + brokeripc.MAX_BATCH_OPS]
+                results = client.run_batch(
+                    [{"op": "stat_sig", "path": p} for p in chunk])
+                for res in results:
+                    sig = res.get("sig") if res.get("ok") else None
+                    out.append(tuple(sig) if sig else None)
+            return out
+        except Exception as exc:
+            log.warning("batched stat_sig via broker failed (%s); "
+                        "falling back to local stats", exc)
+    return [_stat_sig_raw(p) for p in paths]
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """Crash-safe snapshot write: temp file in the target dir + fsync +
+    rename, so a reader observes either the old envelope or the new one,
+    never a torn write (same discipline as the DRA checkpoint)."""
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".snapshot-")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 # --- low-level sysfs readers (unit-testable against tmpdir fixtures) ---------
@@ -462,6 +526,14 @@ def discover(cfg: Config) -> Tuple[Registry, Dict[str, GenerationInfo]]:
 # dirty-set path can be trusted again.
 SNAPSHOT_SIGNATURE_VERSION = 1
 
+# Persisted-cache envelope version (HostSnapshot.save_cache/load_cache).
+# Same refusal rules as the DRA checkpoint envelope (docs/design.md):
+# a malformed or FUTURE version is never trusted — but unlike the
+# checkpoint (allocation truth, refuse to start), the snapshot is derived
+# data, so refusal degrades to the counted cold walk and the stale file
+# is simply replaced by the next save.
+SNAPSHOT_CACHE_VERSION = 1
+
 
 class HostSnapshot:
     """Incremental discovery: cache the full sysfs walk, rescan only deltas.
@@ -521,10 +593,24 @@ class HostSnapshot:
         # run on the manager's run loop but /status reads from HTTP
         # threads, so mutations take the stats lock (values stay ints —
         # readers see a torn dict never, a stale value at worst)
+        # silicon identity cache (read_serial answers), persisted with the
+        # snapshot so a warm boot pays ZERO identity reads; invalidated
+        # whenever the owning record is re-read or dropped
+        self._serials: Dict[str, str] = {}
+        # per-BDF device-dir stat signatures captured at save time + the
+        # bus dir's own signature: the two-tier revalidation evidence
+        self._record_sigs: Dict[str, Optional[Tuple[int, int]]] = {}
+        self._bus_sig: Optional[Tuple[int, int]] = None
         self._stats_lock = lockdep.instrument(
             "discovery.HostSnapshot._stats_lock", threading.Lock())
         self.stats = {"full_scans": 0, "dirty_rescans": 0,
-                      "last_scan_reads": 0}
+                      "last_scan_reads": 0,
+                      # persisted-snapshot boot accounting: records served
+                      # straight from the cache / records that had to be
+                      # re-read cold / whole-cache rejections (missing,
+                      # corrupt, version-refused, injected fault)
+                      "snapshot_hits": 0, "snapshot_invalidated": 0,
+                      "snapshot_fallbacks": 0}
 
     # ------------------------------------------------------------- public
 
@@ -629,12 +715,17 @@ class HostSnapshot:
                     os.path.join(self.cfg.pci_base_path, bdf, "numa_node"))
             return changed
         changed = self._records.get(bdf) != rec
+        if changed:
+            # a moved record may be different silicon in the same slot:
+            # its cached identity is evidence no longer
+            self._serials.pop(bdf, None)
         self._records[bdf] = rec
         self._foreign.pop(bdf, None)
         return changed
 
     def _drop_bdf(self, bdf: str) -> bool:
         self._foreign.pop(bdf, None)
+        self._serials.pop(bdf, None)
         return self._records.pop(bdf, None) is not None
 
     def _rescan_accel(self, dirty: Set[str] = frozenset()) -> bool:
@@ -763,6 +854,19 @@ class HostSnapshot:
         return read_numa_node(
             os.path.join(self.cfg.pci_base_path, bdf, "numa_node"))
 
+    def serial_of(self, bdf: str) -> Optional[str]:
+        """Cached silicon identity (lifecycle_fsm replug reconciliation):
+        a warm boot serves identity straight from the persisted snapshot
+        with zero sysfs reads; re-scanned or dropped records invalidate
+        their entry, so a genuine replug still pays the real read."""
+        cached = self._serials.get(bdf)
+        if cached is not None:
+            return cached
+        serial = read_serial(self.cfg.pci_base_path, bdf)
+        if serial is not None:
+            self._serials[bdf] = serial
+        return serial
+
     def _cached_attrs(self, bdf: str) -> Tuple[bool, Optional[str], int]:
         """attr_reader for discover_logical_partitions: serve vendor/id/numa
         from the cache — including the known-foreign verdict, so warm
@@ -775,23 +879,269 @@ class HostSnapshot:
             return False, None, self._foreign[bdf]
         return _sysfs_chip_attrs(self.cfg)(bdf)
 
+    # ------------------------------------------------- persisted snapshot
+
+    def save_cache(self, path: Optional[str]) -> bool:
+        """Serialize the scanned host view into a versioned envelope via
+        atomic temp+rename (same crash-safety discipline as the DRA
+        checkpoint beside which it lives). Captures per-BDF device-dir
+        stat signatures as the revalidation evidence the next boot's
+        batched stat pass compares against. Post-boot bookkeeping: its
+        own stats are NOT counted as discovery reads. Returns False (and
+        logs) rather than raising — a failed save costs the next boot a
+        cold walk, never this boot anything."""
+        if not path or not self._scanned:
+            return False
+        self._bus_sig = _stat_sig_raw(self.cfg.pci_base_path)
+        self._record_sigs = {
+            bdf: _stat_sig_raw(os.path.join(self.cfg.pci_base_path, bdf))
+            for bdf in self._records}
+        envelope = {
+            "version": SNAPSHOT_CACHE_VERSION,
+            "signature_version": self._signature_version,
+            "bus_sig": self._bus_sig,
+            "record_sigs": self._record_sigs,
+            "records": {
+                bdf: {"device_id": rec.device_id, "driver": rec.driver,
+                      "iommu_group": rec.iommu_group,
+                      "numa_node": rec.numa_node,
+                      "pcie_path": rec.pcie_path}
+                for bdf, rec in self._records.items()},
+            "foreign": self._foreign,
+            "accel_by_bdf": self._accel_by_bdf,
+            "accel_index_of": self._accel_index_of,
+            "mdevs": {
+                uuid: {"type_name": p.type_name,
+                       "parent_bdf": p.parent_bdf,
+                       "numa_node": p.numa_node}
+                for uuid, p in self._mdevs.items()},
+            "serials": self._serials,
+            "spec": self._spec,
+            "spec_sig": self._spec_sig,
+            "genmap_sig": self._genmap_sig,
+            "hints_sig": self._hints_sig,
+        }
+        try:
+            _atomic_write_json(path, envelope)
+        except OSError as exc:
+            log.warning("discovery snapshot save to %s failed: %s",
+                        path, exc)
+            return False
+        return True
+
+    def load_cache(self, path: Optional[str]) -> str:
+        """Restore the host view from a persisted envelope. Returns the
+        outcome: "loaded" (cache trusted — revalidate() next), or a
+        fallback reason ("missing" / "corrupt" / "version" /
+        "signature" / "fault"), every one of which leaves the snapshot
+        unscanned so the caller's rescan pays the counted cold walk —
+        a rejected cache is never trusted stale. Fault site
+        `discovery.snapshot` (value kind) makes the next load read as
+        corrupt/missing."""
+        outcome = self._load_cache_impl(path)
+        if outcome != "loaded":
+            with self._stats_lock:
+                self.stats["snapshot_fallbacks"] += 1
+            if outcome != "missing":
+                log.warning("discovery snapshot %s rejected (%s); "
+                            "falling back to the cold walk", path, outcome)
+        return outcome
+
+    def _load_cache_impl(self, path: Optional[str]) -> str:
+        if not path:
+            return "missing"
+        if faults.fire("discovery.snapshot", path=path):
+            return "fault"
+        _note(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                env = json.load(f)
+            if not isinstance(env, dict):
+                raise ValueError("envelope must be an object")
+        except FileNotFoundError:
+            return "missing"
+        except (OSError, ValueError):
+            # unreadable or torn mid-write (truncated/garbage JSON)
+            return "corrupt"
+        version = env.get("version")
+        if not isinstance(version, int) or isinstance(version, bool) \
+                or version < 0:
+            return "corrupt"
+        if version != SNAPSHOT_CACHE_VERSION:
+            # future AND past versions both refuse: derived data has no
+            # migration ladder — one cold walk re-derives everything
+            return "version"
+        if env.get("signature_version") != SNAPSHOT_SIGNATURE_VERSION:
+            return "signature"
+
+        def _sig(value) -> Optional[Tuple[int, int]]:
+            if value is None:
+                return None
+            a, b = value
+            return (int(a), int(b))
+
+        try:
+            records = {
+                str(bdf): _ChipRecord(
+                    bdf=str(bdf), device_id=r["device_id"],
+                    driver=r["driver"], iommu_group=r["iommu_group"],
+                    numa_node=int(r["numa_node"]),
+                    pcie_path=str(r["pcie_path"]))
+                for bdf, r in env["records"].items()}
+            foreign = {str(b): int(n)
+                       for b, n in env["foreign"].items()}
+            accel_by_bdf = {str(b): int(i)
+                            for b, i in env["accel_by_bdf"].items()}
+            accel_index_of = {str(e): int(i)
+                              for e, i in env["accel_index_of"].items()}
+            mdevs = {
+                str(uuid): TpuPartition(
+                    uuid=str(uuid), type_name=str(m["type_name"]),
+                    parent_bdf=str(m["parent_bdf"]),
+                    numa_node=int(m["numa_node"]), provider="mdev")
+                for uuid, m in env["mdevs"].items()}
+            serials = {str(b): str(s)
+                       for b, s in env["serials"].items()}
+            record_sigs = {str(b): _sig(s)
+                           for b, s in env["record_sigs"].items()}
+            bus_sig = _sig(env.get("bus_sig"))
+            spec = env.get("spec")
+            if spec is not None and not isinstance(spec, dict):
+                raise ValueError("spec must be an object or null")
+            spec_sig = _sig(env.get("spec_sig"))
+            genmap_sig = _sig(env.get("genmap_sig"))
+            hints_sig = _sig(env.get("hints_sig"))
+        except (KeyError, TypeError, ValueError, AttributeError):
+            return "corrupt"
+        # commit only after the WHOLE envelope parsed — a half-applied
+        # cache would be worse than no cache
+        self._signature_version = SNAPSHOT_SIGNATURE_VERSION
+        self._records = records
+        self._foreign = foreign
+        self._accel_by_bdf = accel_by_bdf
+        self._accel_index_of = accel_index_of
+        self._mdevs = mdevs
+        self._serials = serials
+        self._record_sigs = record_sigs
+        self._bus_sig = bus_sig
+        self._spec = spec
+        self._spec_sig = spec_sig
+        self._genmap_sig = genmap_sig
+        self._hints_sig = hints_sig
+        # config OBJECTS are re-parsed from their (small) files — the
+        # cached sigs only spare the re-parse when the next rescan's
+        # _revalidate_configs finds them unmoved
+        self._generations = load_generation_map(self.cfg.generation_map_path)
+        self._hints = load_topology_hints(self.cfg.topology_hints_path)
+        self._pending_dirty = set()
+        self._logical_parent = {}
+        self._last = None
+        self._scanned = True
+        return "loaded"
+
+    def revalidate(self) -> Set[str]:
+        """Two-tier trust pass over a just-loaded cache; returns the ids
+        whose cached records may NOT be served (they pay cold per-device
+        reads in the next rescan(dirty=...)); everything else boots
+        straight from cache.
+
+        Shallow tier (always): one PCI-bus listdir membership diff plus
+        the bus dir's own stat signature, one mdev-bus listdir — a
+        handful of reads regardless of host size. Deep tier (only when
+        the bus dir's signature moved): ONE batched stat pass over every
+        surviving cached device dir — `run_batch` `stat_sig` sub-ops in
+        spawn mode, one crossing for the whole host — invalidating
+        exactly the dirs whose signature differs from the one captured
+        at save time. In-place mutations that move no signature follow
+        the snapshot's documented warm-path contract: health flaps dirty
+        them, operators force --full-rescan."""
+        invalidated: Set[str] = set()
+        known = set(self._records) | set(self._foreign)
+        try:
+            listed = set(_listdir(self.cfg.pci_base_path))
+        except OSError:
+            listed = None   # unreadable bus: the rescan defers, not us
+        if listed is not None:
+            invalidated |= (listed - known) | (known - listed)
+            bus_sig = _stat_sig(self.cfg.pci_base_path)
+            if bus_sig is None or bus_sig != self._bus_sig:
+                bdfs = sorted(set(self._records) & listed)
+                paths = [os.path.join(self.cfg.pci_base_path, b)
+                         for b in bdfs]
+                for bdf, sig in zip(bdfs, _stat_sigs_batched(paths)):
+                    if sig is None or sig != self._record_sigs.get(bdf):
+                        invalidated.add(bdf)
+        try:
+            mdev_listed = set(_listdir(self.cfg.mdev_base_path))
+        except OSError:
+            mdev_listed = set(self._mdevs)
+        invalidated |= mdev_listed.symmetric_difference(self._mdevs)
+        with self._stats_lock:
+            self.stats["snapshot_invalidated"] += len(invalidated)
+            self.stats["snapshot_hits"] += max(
+                0, len(known) + len(self._mdevs) - len(invalidated))
+        return invalidated
+
+    def taint_groups(self, invalidated: Set[str]) -> Set[str]:
+        """Expand invalidated ids to everything wave 1 of the boot
+        pipeline must EXCLUDE, so each resource either boots entirely
+        from validated cache or waits whole for wave 2: every cached
+        chip sharing a device model with an invalidated chip, every
+        partition sharing a type with an invalidated partition. Ids the
+        cache has never seen expand to nothing — their resource is
+        unknown until wave 2 reads them."""
+        models = {self._records[b].device_id
+                  for b in invalidated if b in self._records}
+        types = {self._mdevs[u].type_name
+                 for u in invalidated if u in self._mdevs}
+        out = set(invalidated)
+        out |= {b for b, r in self._records.items()
+                if r.device_id in models}
+        out |= {u for u, p in self._mdevs.items() if p.type_name in types}
+        return out
+
     # -------------------------------------------------------------- build
 
     def _build(self) -> Tuple[Registry, Dict[str, GenerationInfo]]:
         """Pure in-memory rebuild from the caches (no sysfs access)."""
-        records = [self._records[b] for b in sorted(self._records)]
+        return self._compose(self._records, self._mdevs, commit=True)
+
+    def build_excluding(self, exclude: Set[str],
+                        ) -> Tuple[Registry, Dict[str, GenerationInfo]]:
+        """Wave-1 boot registry (pure, no sysfs access): every cached
+        record EXCEPT the excluded ids, without touching the snapshot's
+        last-known-good state — the wave-2 rescan still reconciles from
+        the full cached view."""
+        records = {b: r for b, r in self._records.items()
+                   if b not in exclude}
+        mdevs = {u: p for u, p in self._mdevs.items() if u not in exclude}
+        return self._compose(records, mdevs, commit=False,
+                             exclude=exclude)
+
+    def _compose(self, records_map: Dict[str, _ChipRecord],
+                 mdevs_map: Dict[str, TpuPartition], commit: bool,
+                 exclude: Set[str] = frozenset(),
+                 ) -> Tuple[Registry, Dict[str, GenerationInfo]]:
+        records = [records_map[b] for b in sorted(records_map)]
         raw = _devices_from_records(self.cfg, records, self._accel_by_bdf)
         pcie_paths = {rec.bdf: rec.pcie_path for rec in records}
         registry = _stamp_coords(raw, self._generations, self._hints,
                                  pcie_paths)
-        partitions = [self._mdevs[u] for u in sorted(self._mdevs)]
+        partitions = [mdevs_map[u] for u in sorted(mdevs_map)]
         logical = discover_logical_partitions(
             self.cfg, self._generations, self._accel_by_bdf,
             spec=self._spec, attr_reader=self._cached_attrs)
-        self._logical_parent = {p.uuid: p.parent_bdf for p in logical}
-        self._last = _finalize(self.cfg, registry, self._generations,
-                               partitions + logical)
-        return self._last
+        if exclude:
+            # a logical partition rides its parent chip's validation
+            logical = [p for p in logical
+                       if p.uuid not in exclude
+                       and p.parent_bdf not in exclude]
+        result = _finalize(self.cfg, registry, self._generations,
+                           partitions + logical)
+        if commit:
+            self._logical_parent = {p.uuid: p.parent_bdf for p in logical}
+            self._last = result
+        return result
 
 
 def _finalize(cfg: Config, registry: Registry,

@@ -36,6 +36,7 @@ checkpoint file is the source of truth, like upstream DRA drivers).
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import itertools
 import json
@@ -46,7 +47,7 @@ import threading
 import time
 from concurrent import futures
 from contextlib import contextmanager
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
@@ -381,6 +382,16 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._ckpt_result_gen = 0     # covered by a COMPLETED write attempt
         self._ckpt_durable_gen = 0    # covered by a SUCCESSFUL write
         self._ckpt_error: Optional[BaseException] = None  # last attempt's
+        # failed-attempt generation intervals (gen_lo, gen_hi, err]: a
+        # waiter whose target landed inside a FAILED commit must raise
+        # that attempt's error even if a LATER successful retry (covering
+        # other claims' rollbacks plus this still-present entry) advanced
+        # _ckpt_durable_gen past its target first — the claim was told
+        # nothing durable happened, so ACKing off the retry would be a
+        # silent ACK the rollback then immediately un-commits. Bounded:
+        # waiters scan on the wake that follows each publish, so stale
+        # intervals die within one scheduling quantum.
+        self._ckpt_failures: Deque[tuple] = collections.deque(maxlen=64)
         self._ckpt_pending_claims = 0  # mutations since the last write
         self._ckpt_thread: Optional[threading.Thread] = None
         self._ckpt_stopped = False
@@ -464,6 +475,11 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self.orphan_specs_removed = self._sweep_orphan_specs()
         # restored claims occupy slots: fragmentation must see them
         self._recompute_fragmentation()
+        # warm byte plane: pre-serialize every restored claim's ack NOW,
+        # before the kubelet reconnects — its post-restart
+        # NodePrepareResources replays then hit the byte cache instead of
+        # paying first-touch serialization during the restart storm
+        self.warm_ack_cache()
 
     # ---------------------------------------------------------- inventory
 
@@ -1739,9 +1755,22 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             self._ckpt_cond.notify_all()
             while self._ckpt_result_gen < target and not self._ckpt_stopped:
                 self._ckpt_cond.wait()
-            if self._ckpt_durable_gen >= target:
-                return
-            err = self._ckpt_error or OSError("checkpoint writer stopped")
+            # FAILED-interval scan BEFORE the durable check: if the
+            # attempt covering this target failed, this claim must error
+            # and roll back — a later successful retry may already have
+            # advanced _ckpt_durable_gen past the target (it covered the
+            # other claims' rollbacks and this claim's still-present
+            # entry), but that write was never this claim's ACK.
+            err: Optional[BaseException] = None
+            for gen_lo, gen_hi, fail_err in self._ckpt_failures:
+                if gen_lo < target <= gen_hi:
+                    err = fail_err
+                    break
+            if err is None:
+                if self._ckpt_durable_gen >= target:
+                    return
+                err = self._ckpt_error \
+                    or OSError("checkpoint writer stopped")
         raise err
 
     def _checkpoint_writer_loop(self) -> None:
@@ -1816,6 +1845,13 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 # flush barrier releases already sees the fresh gauges.
                 self._recompute_fragmentation()
             with cond:
+                if err is not None:
+                    # record the failed attempt's generation interval
+                    # BEFORE publishing its result: every waiter whose
+                    # target lies in (result_gen, target] must see the
+                    # failure even if a later retry succeeds first
+                    self._ckpt_failures.append(
+                        (self._ckpt_result_gen, target, err))
                 self._ckpt_result_gen = target
                 self._ckpt_error = err
                 if err is None:
@@ -2520,6 +2556,34 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         self._ack_cache[uid] = (devices, payload)
         return payload
 
+    def warm_ack_cache(self) -> int:
+        """Rebuild the pre-serialized ack payload for every restored,
+        non-orphaned checkpoint entry (boot-time byte-plane warm-up).
+
+        The idempotent prepare path returns ``entry["devices"]`` by
+        identity, so seeding the cache against that same list object
+        gives a kubelet replay an identity-matched byte reuse — the
+        replay costs a dict lookup, not a protobuf serialization. An
+        orphaned entry is skipped (its replay must build the error path),
+        and a malformed legacy entry is skipped rather than failing boot.
+        Returns the number of acks warmed."""
+        warmed = 0
+        for uid, entry in self._checkpoint.items():
+            if "orphaned" in entry:
+                continue
+            devices = entry.get("devices")
+            if not isinstance(devices, list):
+                continue
+            try:
+                self._ack_segment(uid, devices)
+                warmed += 1
+            except Exception as exc:
+                log.warning("DRA: could not pre-serialize ack for restored "
+                            "claim %s: %s", uid, exc)
+        if warmed:
+            trace.event("dra.ack_cache.warmed", claims=warmed)
+        return warmed
+
     def ack_byte_stats(self) -> Dict[str, int]:
         return {"reused": self._ack_bytes_reused.value,
                 "serializations": self._ack_serializations.value}
@@ -2674,6 +2738,9 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
             # pool and a writer allowed to spawn again
             with self._ckpt_cond:
                 self._ckpt_stopped = False
+                # stale failure intervals from the previous incarnation
+                # must not poison fresh targets after a stop()/start()
+                self._ckpt_failures.clear()
             if getattr(self._prepare_pool, "_shutdown", False):
                 self._prepare_pool = futures.ThreadPoolExecutor(
                     max_workers=self.prepare_workers,

@@ -155,6 +155,7 @@ _SITE_CATEGORY: Dict[str, str] = {
     "broker.ipc": "value",
     "broker.ring": "value",
     "policy.hook": "raising",
+    "discovery.snapshot": "value",
 }
 _DEFAULT_KIND = {"raising": "error", "value": "drop"}
 

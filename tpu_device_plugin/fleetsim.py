@@ -53,7 +53,8 @@ from urllib.parse import parse_qs, urlsplit
 from . import placement
 from . import trace
 from .config import Config
-from .discovery import discover_passthrough
+from .discovery import (HostSnapshot, count_reads, discover_passthrough,
+                        read_serial)
 from .dra import DraDriver, slice_device_name
 from .kubeapi import ApiClient, PublishPacer
 from .kubeletapi import drapb
@@ -1290,6 +1291,80 @@ class FleetNode:
                 f"({before} -> {self.driver.prepared_claim_count()})")
         return self.driver.publish_resource_slices()
 
+    def restart_with_discovery(self, warm: bool = True,
+                               sysfs_read_cost_s: float = 0.0) -> dict:
+        """Full daemon restart INCLUDING host re-learning (upgrade()
+        above models only the driver swap): stop the driver, rediscover
+        the host — the classic cold walk plus per-device identity reads,
+        or the persisted-snapshot fast path (load + one revalidation
+        pass, serials straight from the cache) — then rebuild the driver
+        from its checkpoint and republish. The node is unavailable for
+        the whole measured window; claims must survive exactly.
+
+        `sysfs_read_cost_s` models per-access host IO the way
+        FleetApiServer.latency_s models fabric service time: the sim's
+        tmpfs makes a sysfs read ~free, where real silicon pays config-
+        space/driver latency per access — the modeled delay is counted
+        reads x cost, charged inside the unready window so both the
+        cold walk and the snapshot path pay for exactly the IO they do.
+
+        Returns {"unready_s", "reads", "path"}."""
+        before = self.driver.prepared_claim_count()
+        snap_path = self.cfg.discovery_snapshot_path
+        t0 = time.monotonic()
+        self.driver.stop()
+        with count_reads() as counter:
+            snap = HostSnapshot(self.cfg)
+            path = "cold"
+            if warm and snap_path:
+                if snap.load_cache(snap_path) == "loaded":
+                    path = "snapshot"
+                    invalidated = snap.revalidate()
+                    self.registry, self.generations = snap.rescan(
+                        dirty=snap.taint_groups(invalidated))
+                else:
+                    # untrusted/missing cache: counted cold walk through
+                    # the snapshot (so THIS restart seeds the next one)
+                    self.registry, self.generations = snap.rescan()
+                for d in self.registry.devices_by_model[self.device_id]:
+                    snap.serial_of(d.bdf)
+            else:
+                self.registry, self.generations = discover_passthrough(
+                    self.cfg)
+                # cold boot identity cost: the lifecycle FSM re-reads
+                # every device's serial before admitting it
+                for d in self.registry.devices_by_model[self.device_id]:
+                    read_serial(self.cfg.pci_base_path, d.bdf)
+            if sysfs_read_cost_s:
+                time.sleep(counter.reads * sysfs_read_cost_s)
+            self.devices = self.registry.devices_by_model[self.device_id]
+            self.bdfs = [d.bdf for d in self.devices]
+            self.driver = self._build_driver()
+            if self.driver.prepared_claim_count() != before:
+                raise AssertionError(
+                    f"{self.name}: restart lost claims ({before} -> "
+                    f"{self.driver.prepared_claim_count()})")
+            info = self.generations.get(self.device_id)
+            suffix = (info.name if info is not None
+                      else f"tpu-{self.device_id}")
+            self.plugin = TpuDevicePlugin(
+                self.cfg, suffix, self.registry, self.devices,
+                torus_dims=info.host_topology if info is not None else None,
+                health_listener=self._health_listener)
+            ok = self.driver.publish_resource_slices()
+        unready_s = time.monotonic() - t0
+        if not ok:
+            raise AssertionError(f"{self.name}: restart republish failed")
+        # persist (atomic temp+rename) so the NEXT restart can go warm;
+        # outside the unready window — the node is already serving. A
+        # baseline (warm=False) restart never scans the snapshot, so it
+        # saves nothing and stays cold forever, as a pre-snapshot
+        # daemon would.
+        if snap_path and warm:
+            snap.save_cache(snap_path)
+        return {"unready_s": unready_s, "reads": counter.reads,
+                "path": path}
+
     def pacer_stats(self) -> dict:
         return self.driver.pacer.snapshot()
 
@@ -2061,6 +2136,50 @@ class FleetSim:
                     f"{node.name}: slice devices {sorted(published)} != "
                     f"expected {sorted(expected)}")
         return True
+
+    def rolling_upgrade_wave(self, batch_size: int = 16,
+                             warm: bool = True,
+                             sysfs_read_cost_s: float = 0.0) -> dict:
+        """Rolling daemon upgrade across the fleet: batches of nodes
+        restart concurrently WITH their discovery cost
+        (FleetNode.restart_with_discovery) while the rest keep serving —
+        the fleet-operations shape of the restart-to-ready problem. The
+        headline is aggregate node-seconds-unready: sum over nodes of
+        the stop→republished wall, the capacity the wave takes offline.
+        `warm=False` is the pre-snapshot baseline (every node pays the
+        full cold walk + identity reads every upgrade);
+        `sysfs_read_cost_s` models per-access host IO (see
+        restart_with_discovery) and is recorded in the result."""
+        unready: List[float] = []
+        reads_total = 0
+        paths: Dict[str, int] = {}
+        t0 = time.monotonic()
+        for start in range(0, self.n_nodes, batch_size):
+            nodes = self.nodes[start:start + batch_size]
+            with futures.ThreadPoolExecutor(
+                    max_workers=len(nodes),
+                    thread_name_prefix="fleet-upgrade") as pool:
+                results = list(pool.map(
+                    lambda n: n.restart_with_discovery(
+                        warm=warm, sysfs_read_cost_s=sysfs_read_cost_s),
+                    nodes))
+            for r in results:
+                unready.append(r["unready_s"])
+                reads_total += r["reads"]
+                paths[r["path"]] = paths.get(r["path"], 0) + 1
+        mid = sorted(unready)
+        return {
+            "nodes": self.n_nodes,
+            "batch_size": batch_size,
+            "warm": warm,
+            "sysfs_read_cost_ms": round(sysfs_read_cost_s * 1e3, 3),
+            "wall_s": round(time.monotonic() - t0, 3),
+            "node_seconds_unready": round(sum(unready), 4),
+            "p50_unready_ms": round(mid[len(mid) // 2] * 1e3, 3),
+            "max_unready_ms": round(max(unready) * 1e3, 3),
+            "reads_total": reads_total,
+            "paths": paths,
+        }
 
     def pacer_totals(self) -> dict:
         totals = {"publish_waves_total": 0, "publishes_coalesced_total": 0,

@@ -485,8 +485,16 @@ class TpuDevicePlugin(api.DevicePluginServicer):
         try:
             with grpc.insecure_channel(
                     f"unix://{self.cfg.kubelet_socket}") as ch:
-                grpc.channel_ready_future(ch).result(
-                    timeout=self.cfg.grpc_timeout_s)
+                # wait_for_ready on the RPC itself, NOT a
+                # channel_ready_future pre-wait: the ready future resolves
+                # through gRPC's connectivity-state poller, which costs a
+                # ~200 ms poll tick per fresh channel even when the socket
+                # answers instantly — at restart that tick dominated every
+                # plugin's registration wall. The RPC-level wait connects
+                # event-driven (~1-2 ms) and still queues until the
+                # kubelet answers, bounded by the same dial deadline (a
+                # dead socket surfaces as DEADLINE_EXCEEDED below instead
+                # of FutureTimeoutError; same KubeletUnavailable mapping).
                 api.RegistrationStub(ch).Register(
                     pb.RegisterRequest(
                         version=api.API_VERSION,
@@ -496,11 +504,8 @@ class TpuDevicePlugin(api.DevicePluginServicer):
                             get_preferred_allocation_available=True),
                     ),
                     timeout=self.cfg.grpc_timeout_s,
+                    wait_for_ready=True,
                 )
-        except grpc.FutureTimeoutError as exc:
-            raise KubeletUnavailable(
-                f"kubelet socket {self.cfg.kubelet_socket} not answering"
-            ) from exc
         except grpc.RpcError as exc:
             code = exc.code()
             if code in (grpc.StatusCode.UNAVAILABLE,

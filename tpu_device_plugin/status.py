@@ -365,6 +365,12 @@ class StatusServer:
         discovery_stats = getattr(self.manager, "discovery_stats", None)
         if discovery_stats is not None:
             out["discovery"] = discovery_stats()
+        # restart fast path (lifecycle.PluginManager.start): boot wall
+        # times, readiness edges and the snapshot-cache outcome of the
+        # most recent boot
+        boot_stats = getattr(self.manager, "boot_stats", None)
+        if boot_stats:
+            out["boot"] = dict(boot_stats)
         # shared-health-plane counters (healthhub.HealthHub): hub fd/thread
         # gauges, probe-cycle latency, per-probe timeout/error counters
         health_stats = getattr(self.manager, "health_stats", None)
@@ -615,6 +621,26 @@ class StatusServer:
                 "# TYPE tpu_plugin_discovery_last_scan_reads gauge",
                 f'tpu_plugin_discovery_last_scan_reads '
                 f'{disc.get("last_scan_reads", 0)}',
+                "# HELP tpu_plugin_discovery_snapshot_hits_total Devices "
+                "revalidated straight from the persisted discovery "
+                "snapshot at boot (no cold sysfs reads paid).",
+                "# TYPE tpu_plugin_discovery_snapshot_hits_total counter",
+                f'tpu_plugin_discovery_snapshot_hits_total '
+                f'{disc.get("snapshot_hits", 0)}',
+                "# HELP tpu_plugin_discovery_snapshot_invalidated_total "
+                "Cached devices invalidated by boot revalidation (paid "
+                "counted cold re-reads).",
+                "# TYPE tpu_plugin_discovery_snapshot_invalidated_total "
+                "counter",
+                f'tpu_plugin_discovery_snapshot_invalidated_total '
+                f'{disc.get("snapshot_invalidated", 0)}',
+                "# HELP tpu_plugin_discovery_snapshot_fallbacks_total "
+                "Snapshot-cache loads refused (missing/corrupt/version/"
+                "fault) — boots that degraded to the full cold walk.",
+                "# TYPE tpu_plugin_discovery_snapshot_fallbacks_total "
+                "counter",
+                f'tpu_plugin_discovery_snapshot_fallbacks_total '
+                f'{disc.get("snapshot_fallbacks", 0)}',
             ]
         health = s.get("health")
         if health:

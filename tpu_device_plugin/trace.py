@@ -836,6 +836,11 @@ _register("tdp_watch_convergence_ms",
           "Watch convergence lag: wall time from a divergence-evidencing "
           "watch observation to the repair publish landing "
           "(dra.start_watch_reconciler).")
+_register("tdp_restart_ready_ms",
+          "Restart-to-ready wall time: process boot (or explicit "
+          "PluginManager.start) to every resource registered and every "
+          "DRA slice published (boot.total span; the snapshot fast path "
+          "and the counted cold walk both land here).")
 _register("tdp_fleet_decision_ms",
           "Fleet scheduler decision latency: submit (or wave entry) to "
           "terminal result — plan, CAS commit, and any conflict replans "
