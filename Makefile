@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -Wall -Wextra -fPIC
 IMAGE ?= tpu-device-plugin
 VERSION ?= 0.1.0
 
-.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-brokeripc bench-restart bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test
+.PHONY: all native proto test coverage bench bench-discovery bench-health bench-attach bench-attach-path bench-trace bench-trace-fleet bench-fleet bench-fleetsched bench-scale bench-placement bench-fleet-placement bench-broker bench-brokeripc bench-restart bench-transport bench-selfheal test-broker-spawn fleet-soak soak-autopilot clean update-pcidb image push dryrun hash-requirements e2e-kubevirt-local verify-drive chaos chaos-soak chaos-lifecycle lint lint-baseline lockdep-test weave weave-soak
 
 all: native proto
 
@@ -53,6 +53,21 @@ lint-baseline:
 lockdep-test:
 	TDP_LOCKDEP=1 JAX_PLATFORMS=cpu \
 		$(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# Deterministic interleaving checker (docs/static-analysis.md "weave"):
+# enumerate thread schedules of the lock-free planes under DPOR +
+# bounded preemption, real production code, seed-replayable
+# counterexamples (.weave-artifacts/). Runs the 9-scenario quick
+# matrix, then the 8 seeded-bug twins (which must FAIL — every
+# invariant is mutation-tested). The soak leg multiplies execution
+# budgets 25x and raises preemption bounds by 1.
+weave:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.weave
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.weave --twins
+
+weave-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.weave --soak
+	JAX_PLATFORMS=cpu $(PYTHON) -m tools.weave --twins
 
 # Seeded chaos suite (docs/fault-injection.md): randomized kubelet-restart
 # storms, flapping /dev/vfio nodes, apiserver 5xx/timeout bursts — fixed

@@ -59,16 +59,19 @@ def main(argv=None) -> int:
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
     faults_py = os.path.join(root, PACKAGE, "faults.py")
     doc_md = os.path.join(root, "docs", "fault-injection.md")
+    obs_md = os.path.join(root, "docs", "observability.md")
     try:
         with open(faults_py, "r", encoding="utf-8") as f:
             faults_src = f.read()
         with open(doc_md, "r", encoding="utf-8") as f:
             doc_text = f.read()
+        with open(obs_md, "r", encoding="utf-8") as f:
+            obs_text = f.read()
     except OSError as exc:
         print(f"tsalint: cannot read rule inputs: {exc}", file=sys.stderr)
         return 2
 
-    config = project_config(faults_src, doc_text)
+    config = project_config(faults_src, doc_text, obs_text)
     paths = _package_files(root)
     rel = [os.path.relpath(p, root).replace(os.sep, "/") for p in paths]
     sources = []

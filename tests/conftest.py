@@ -75,6 +75,41 @@ def _leaked_threads(settle_s: float = 5.0):
         time.sleep(0.1)
 
 
+# weave smoke gate (docs/static-analysis.md "Deterministic interleaving
+# checking"): a sub-second slice of the schedule-exploration matrix runs
+# at session end so a regressed lock-free invariant — or a checker that
+# can no longer fire — fails the DEFAULT tier-1 run, not only the
+# dedicated CI weave job. The full matrix is `make weave`.
+_WEAVE_SMOKE_SCENARIOS = (
+    "epoch-publish-waiter",     # complete reduced space in 2 executions
+    "ring-seqlock",             # seqlock torn-read guard, ~60 executions
+    "placement-cas-race",       # CAS single-winner, 3 executions
+    "breaker-half-open-probe",  # half-open single-probe, 3 executions
+)
+_WEAVE_SMOKE_TWIN = "twin-epoch-publish-no-notify"   # must FIRE
+
+
+def _weave_smoke_problems():
+    from tools.weave.core import explore
+    from tools.weave.scenarios import SCENARIOS, TWINS
+
+    problems = []
+    for name in _WEAVE_SMOKE_SCENARIOS:
+        res = explore(SCENARIOS[name]())
+        if not res.ok:
+            assert res.counterexample is not None
+            problems.append(
+                f"weave smoke: {name} found a counterexample "
+                f"({res.counterexample.failure}); replay via "
+                f"`python -m tools.weave --scenario {name}`")
+    twin = explore(TWINS[_WEAVE_SMOKE_TWIN]())
+    if twin.counterexample is None:
+        problems.append(
+            f"weave smoke: {_WEAVE_SMOKE_TWIN} did NOT fire — the "
+            f"lost-wakeup checker is dead (mutation test)")
+    return problems
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs a real TPU backend (TDP_TPU_TESTS=1)")
@@ -89,22 +124,28 @@ def pytest_sessionfinish(session, exitstatus):
     Without TDP_LOCKDEP the leak scan still runs and prints, so a leak
     regression is visible in any tier-1 log even before the dedicated CI
     lockdep job catches it."""
-    problems = []
+    problems = []      # enforced only under TDP_LOCKDEP=1
+    enforced = []      # enforced in EVERY run (weave smoke gate)
     leaked = _leaked_threads()
     if leaked:
         problems.append(
             "thread leak: " + ", ".join(sorted(t.name for t in leaked))
             + " still alive at session end (stop() paths must join)")
+    if os.environ.get("TDP_WEAVE_SMOKE") != "0":
+        try:
+            enforced.extend(_weave_smoke_problems())
+        except Exception as exc:   # a broken explorer is a failure too
+            enforced.append(f"weave smoke: explorer crashed: {exc!r}")
     if _lockdep_on:
         rep = _lockdep.report()
         violations = rep.violations()
         print("\n" + rep.render(stacks=bool(violations)))
         problems.extend(violations)
-    if problems:
+    if problems or enforced:
         print("\nconcurrency gate FAILED:")
-        for p in problems:
+        for p in problems + enforced:
             print("  " + p)
-        if _lockdep_on:
+        if _lockdep_on or enforced:
             session.exitstatus = 1
         else:
             print("  (TDP_LOCKDEP not set: reported, not enforced)")

@@ -453,6 +453,64 @@ def test_checkpoint_write_fault_errors_claims_never_silent_acks(dra_rig):
         assert set(json_mod.load(f)["claims"]) == set(uids)
 
 
+def test_claim_burst_preadmitted_to_commit_window_before_fanout(dra_rig):
+    """Deterministic regression for the commit-window race behind the
+    flaky checkpoint-fault failure: _claim_task used to increment
+    _attach_active only when a pool worker STARTED its claim, so a claim
+    admitted in the same RPC but not yet picked up was invisible to the
+    writer's commit window — an early lone claim could commit solo and
+    split the burst across checkpoint writes. The whole burst must be
+    charged to the gauge BEFORE fan-out: the first claim to run — forced
+    here to run to completion before any sibling starts, the exact
+    ordering the lazy gauge was blind to — must already see every
+    admitted claim counted."""
+    from tpu_device_plugin.dra import slice_device_name
+    from tpu_device_plugin.kubeletapi import drapb
+
+    host, cfg, apiserver, driver, breaker = dra_rig
+    names = [slice_device_name(c.bdf) for c in TWO_MODEL_CHIPS[:2]]
+    uids = [f"burst-{i}" for i in range(4)]
+    for i, uid in enumerate(uids):
+        apiserver.add_claim("ns", uid, uid, driver.driver_name,
+                            [{"device": names[i % 2]}])
+
+    seen = []
+    real_pool = driver._prepare_pool
+
+    class _FirstClaimAloneThenRest:
+        def map(self, fn, items):
+            items = list(items)
+
+            def probe(claim):
+                seen.append(driver._attach_active)
+                return fn(claim)
+
+            out = [probe(items[0])]
+            out += list(real_pool.map(probe, items[1:]))
+            return out
+
+    driver._prepare_pool = _FirstClaimAloneThenRest()
+    try:
+        resp = driver.NodePrepareResources(
+            drapb.NodePrepareResourcesRequest(claims=[
+                drapb.Claim(namespace="ns", name=u, uid=u)
+                for u in uids]), None)
+    finally:
+        driver._prepare_pool = real_pool
+
+    for uid in uids:
+        assert resp.claims[uid].error == "", resp.claims[uid].error
+    assert len(seen) == len(uids)
+    # the first claim runs before any sibling has started: with lazy
+    # admission it saw only itself (1); pre-admission makes the whole
+    # burst visible. Later claims see one slot fewer — claim 0's slot is
+    # correctly released once it is durable.
+    assert seen[0] == len(uids), \
+        f"burst not pre-admitted to the commit window: saw {seen}"
+    assert driver._attach_active == 0          # every slot released
+    assert driver.prepared_claim_count() == 4
+
+
 # --------------------------------------------------- broker chaos (ISSUE 11)
 
 

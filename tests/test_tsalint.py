@@ -15,12 +15,14 @@ from tools.tsalint import (LintConfig, analyze_sources,  # noqa: E402
                            diff_against_baseline, load_baseline,
                            save_baseline)
 from tools.tsalint.config import (BLOCKING_CALLS, BLOCKING_METHODS,  # noqa: E402
+                                  CARRIERS, CarrierSpec,
+                                  documented_carriers,
                                   documented_fault_sites,
                                   registered_fault_sites)
 
 
 def run(source, *, hot=(), counters=None, registered=None, documented=None,
-        path="mod.py", privileged=None):
+        path="mod.py", privileged=None, carriers=None, carrier_docs=None):
     cfg = LintConfig(
         hot_locks=frozenset(hot),
         counters=counters or {},
@@ -29,6 +31,8 @@ def run(source, *, hot=(), counters=None, registered=None, documented=None,
         registered_sites=registered,
         documented_sites=documented,
         privileged_modules=privileged,
+        carriers=carriers,
+        documented_carriers=carrier_docs,
     )
     return analyze_sources([(path, source)], cfg)
 
@@ -903,3 +907,291 @@ def test_thread_list_join_over_other_attr_does_not_vouch():
         "for thread in self._others:")
     findings = run(wrong)
     assert [f.detail for f in findings] == ["not-joined:Thread"]
+
+
+# ----------------------------------------------------- trace-carrier (r8)
+
+
+MULTICLAIM_SPEC = (CarrierSpec(
+    name="multiclaim.traceparent", kind="call-kwarg",
+    field="traceparent", call="multiclaim_begin", arg_index=3),)
+
+RECORD_SPEC = (CarrierSpec(
+    name="rec.traceparent", kind="dict-key", field="traceparent",
+    markers=frozenset({"source_node", "generation"})),)
+
+FRAME_SPEC = (CarrierSpec(
+    name="frame.span", kind="dict-key", field="span",
+    markers=frozenset({"op", "seq"})),)
+
+HEADER_SPEC = (CarrierSpec(
+    name="header.traceparent", kind="header-store", field="Traceparent"),)
+
+
+def _docs(specs):
+    return {s.name for s in specs}
+
+
+CARRIER_CALL_BARE = """
+def begin(api, uid, plan):
+    api.multiclaim_begin(uid, plan.shape, plan.shards)
+"""
+
+CARRIER_CALL_KWARG = """
+def begin(api, uid, plan, tp):
+    api.multiclaim_begin(uid, plan.shape, plan.shards, traceparent=tp)
+"""
+
+CARRIER_CALL_POSITIONAL = """
+def begin(api, uid, plan, tp):
+    api.multiclaim_begin(uid, plan.shape, plan.shards, tp)
+"""
+
+CARRIER_CALL_EXPLICIT_NONE = """
+def begin(api, uid, plan):
+    api.multiclaim_begin(uid, plan.shape, plan.shards, traceparent=None)
+"""
+
+
+def test_carrier_call_without_context_fires():
+    findings = run(CARRIER_CALL_BARE, carriers=MULTICLAIM_SPEC,
+                   carrier_docs=_docs(MULTICLAIM_SPEC))
+    assert [f.detail for f in findings] == \
+        ["unthreaded:multiclaim.traceparent"]
+    assert "multiclaim_begin()" in findings[0].message
+
+
+def test_carrier_call_threaded_is_clean():
+    for fixture in (CARRIER_CALL_KWARG, CARRIER_CALL_POSITIONAL):
+        assert run(fixture, carriers=MULTICLAIM_SPEC,
+                   carrier_docs=_docs(MULTICLAIM_SPEC)) == [], fixture
+
+
+def test_carrier_call_explicit_none_fires():
+    # traceparent=None is dropping the context on purpose, not threading
+    findings = run(CARRIER_CALL_EXPLICIT_NONE, carriers=MULTICLAIM_SPEC,
+                   carrier_docs=_docs(MULTICLAIM_SPEC))
+    assert [f.detail for f in findings] == \
+        ["unthreaded:multiclaim.traceparent"]
+
+
+CARRIER_RECORD_BARE = """
+class D:
+    def emit(self, entry):
+        self._records[entry.uid] = {
+            "source_node": self.node_name,
+            "generation": entry.get("generation"),
+        }
+"""
+
+CARRIER_RECORD_STAMPED = """
+class D:
+    def emit(self, entry, tp):
+        self._records[entry.uid] = {
+            "source_node": self.node_name,
+            "generation": entry.get("generation"),
+            "traceparent": tp,
+        }
+"""
+
+CARRIER_RECORD_NONE = CARRIER_RECORD_STAMPED.replace(
+    '"traceparent": tp,', '"traceparent": None,')
+
+CARRIER_RECORD_LATE_STAMP = """
+class D:
+    def emit(self, entry, tp):
+        rec = {
+            "source_node": self.node_name,
+            "generation": entry.get("generation"),
+        }
+        rec["traceparent"] = tp
+        self._records[entry.uid] = rec
+"""
+
+CARRIER_RECORD_WRAPPER_STAMP = """
+class D:
+    def _base_record(self, entry):
+        return {
+            "source_node": self.node_name,
+            "generation": entry.get("generation"),
+        }
+
+    def emit(self, entry, tp):
+        rec = self._base_record(entry)
+        rec["traceparent"] = tp
+        return rec
+"""
+
+CARRIER_RECORD_WRAPPER_LEAK = CARRIER_RECORD_WRAPPER_STAMP + """
+    def emit_bare(self, entry):
+        return self._base_record(entry)
+"""
+
+
+def test_carrier_record_without_field_fires():
+    findings = run(CARRIER_RECORD_BARE, carriers=RECORD_SPEC,
+                   carrier_docs=_docs(RECORD_SPEC))
+    assert [f.detail for f in findings] == ["unthreaded:rec.traceparent"]
+    assert "generation, source_node" in findings[0].message
+
+
+def test_carrier_record_stamped_is_clean():
+    for fixture in (CARRIER_RECORD_STAMPED, CARRIER_RECORD_LATE_STAMP):
+        assert run(fixture, carriers=RECORD_SPEC,
+                   carrier_docs=_docs(RECORD_SPEC)) == [], fixture
+
+
+def test_carrier_record_none_field_fires():
+    findings = run(CARRIER_RECORD_NONE, carriers=RECORD_SPEC,
+                   carrier_docs=_docs(RECORD_SPEC))
+    assert [f.detail for f in findings] == ["unthreaded:rec.traceparent"]
+
+
+def test_carrier_record_wrapper_stamp_is_clean():
+    """The interprocedural credit: a record BUILDER stays clean when
+    every resolved caller stamps the context field after the call —
+    the wrapper fixpoint, not just same-function subscript stores."""
+    assert run(CARRIER_RECORD_WRAPPER_STAMP, carriers=RECORD_SPEC,
+               carrier_docs=_docs(RECORD_SPEC)) == []
+
+
+def test_carrier_record_wrapper_leak_fires():
+    """...and ONE caller that forwards the record without stamping
+    un-credits the builder (all-callers quantifier, not any-caller)."""
+    findings = run(CARRIER_RECORD_WRAPPER_LEAK, carriers=RECORD_SPEC,
+                   carrier_docs=_docs(RECORD_SPEC))
+    assert [f.detail for f in findings] == ["unthreaded:rec.traceparent"]
+    assert findings[0].qualname == "mod.D._base_record"
+
+
+CARRIER_FRAME_SHAPES = """
+class Client:
+    def request(self, op, tp):
+        self._seq += 1
+        req = {"op": op, "seq": self._seq, "span": tp}
+        return req
+
+    def synthesized(self, i):
+        # constant-op frame: an injected placeholder, not a crossing
+        return {"op": "invalid", "seq": i}
+
+    def spread(self, base):
+        # a ** spread makes the literal opaque: absence is unprovable
+        return {**base, "op": self._op, "seq": self._seq}
+"""
+
+
+def test_carrier_frame_const_and_spread_are_not_crossings():
+    assert run(CARRIER_FRAME_SHAPES, carriers=FRAME_SPEC,
+               carrier_docs=_docs(FRAME_SPEC)) == []
+
+
+def test_carrier_frame_without_span_fires():
+    broken = CARRIER_FRAME_SHAPES.replace(', "span": tp', "")
+    findings = run(broken, carriers=FRAME_SPEC,
+                   carrier_docs=_docs(FRAME_SPEC))
+    assert [f.detail for f in findings] == ["unthreaded:frame.span"]
+
+
+def test_carrier_scope_limits_detection():
+    scoped = (CarrierSpec(
+        name="frame.span", kind="dict-key", field="span",
+        markers=frozenset({"op", "seq"}),
+        scope=frozenset({"pkg/broker.py"})),)
+    broken = CARRIER_FRAME_SHAPES.replace(', "span": tp', "")
+    # out of scope: the decode-side twin of the frame shape is not a
+    # crossing — but the carrier is then dead (nothing crossed it)
+    findings = run(broken, path="pkg/brokeripc.py", carriers=scoped,
+                   carrier_docs=_docs(scoped))
+    assert [f.detail for f in findings] == ["dead:frame.span"]
+    findings = run(broken, path="pkg/broker.py", carriers=scoped,
+                   carrier_docs=_docs(scoped))
+    assert [f.detail for f in findings] == ["unthreaded:frame.span"]
+
+
+CARRIER_HEADER_STORE = """
+def request(headers, tp):
+    headers["Traceparent"] = tp
+"""
+
+
+def test_carrier_header_store_is_the_crossing():
+    assert run(CARRIER_HEADER_STORE, carriers=HEADER_SPEC,
+               carrier_docs=_docs(HEADER_SPEC)) == []
+
+
+def test_carrier_header_missing_everywhere_is_dead():
+    findings = run("def request(headers, tp):\n    pass\n",
+                   carriers=HEADER_SPEC, carrier_docs=_docs(HEADER_SPEC))
+    assert [f.detail for f in findings] == ["dead:header.traceparent"]
+
+
+def test_carrier_doc_drift_fires_both_ways():
+    # registered but not documented; documented but not registered
+    findings = run(CARRIER_CALL_KWARG, carriers=MULTICLAIM_SPEC,
+                   carrier_docs={"ghost.carrier"})
+    assert sorted(f.detail for f in findings) == [
+        "undeclared:ghost.carrier",
+        "undocumented:multiclaim.traceparent"]
+
+
+def test_carrier_rule_disabled_without_registry():
+    assert run(CARRIER_CALL_BARE, carriers=None) == []
+
+
+def test_documented_carriers_parsed_from_doc():
+    with open(os.path.join(REPO, "docs", "observability.md")) as f:
+        ids = documented_carriers(f.read())
+    assert ids == {s.name for s in CARRIERS}
+
+
+def test_project_carriers_name_the_r17_boundaries():
+    kinds = {s.name: s.kind for s in CARRIERS}
+    assert kinds == {
+        "multiclaim.traceparent": "call-kwarg",
+        "checkpoint-entry.traceparent": "dict-key",
+        "handoff.traceparent": "dict-key",
+        "broker-frame.span": "dict-key",
+        "kubeapi.traceparent-header": "header-store",
+    }
+
+
+def test_carrier_mutation_on_real_tree_fires():
+    """Mutation-test rule 8 against the REAL package: strip the span
+    field from the broker client's request frame and the traceparent
+    kwarg from a fabric multiclaim_begin call — each mutation must
+    produce a new trace-carrier finding (a rule that cannot fire on the
+    production crossing sites is a failing test)."""
+    from tools.tsalint.config import (CARRIERS as REAL_CARRIERS,
+                                      documented_carriers as parse_docs)
+    with open(os.path.join(REPO, "docs", "observability.md")) as f:
+        docs = parse_docs(f.read())
+
+    def lint(path, text):
+        cfg = LintConfig(carriers=REAL_CARRIERS, documented_carriers=docs)
+        return [f for f in analyze_sources([(path, text)], cfg)
+                if f.rule == "trace-carrier"
+                and f.detail.startswith("unthreaded:")]
+
+    broker_path = "tpu_device_plugin/broker.py"
+    with open(os.path.join(REPO, broker_path)) as f:
+        broker_src = f.read()
+    assert lint(broker_path, broker_src) == []
+    mutated = broker_src.replace(
+        '"span": brokeripc.span_context()}', '}')
+    assert mutated != broker_src
+    assert [f.detail for f in lint(broker_path, mutated)] == \
+        ["unthreaded:broker-frame.span"]
+
+    fleetsim_path = "tpu_device_plugin/fleetsim.py"
+    with open(os.path.join(REPO, fleetsim_path)) as f:
+        fleetsim_src = f.read()
+    assert lint(fleetsim_path, fleetsim_src) == []
+    mutated = fleetsim_src.replace(
+        "self.apiserver.multiclaim_begin(uid, plan.shape, plan.shards,\n"
+        "                                        "
+        "traceparent=trace.propagate())",
+        "self.apiserver.multiclaim_begin(uid, plan.shape, plan.shards)")
+    assert mutated != fleetsim_src
+    assert [f.detail for f in lint(fleetsim_path, mutated)] == \
+        ["unthreaded:multiclaim.traceparent"]

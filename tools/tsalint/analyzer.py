@@ -23,7 +23,7 @@ from __future__ import annotations
 import ast
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 # the ONE cycle-detection implementation, shared with the runtime half
 # (lockdep is stdlib-only and the package __init__ is import-light, so the
@@ -188,6 +188,21 @@ class _FuncFacts:
     # opens, sysfs bind/unbind/driver_override writes, config-space
     # reads (broker-boundary rule)
     priv_calls: List[Tuple[str, str, int]] = field(default_factory=list)
+    # trace-carrier rule (rule 8) evidence:
+    # (callee leaf, kwarg names, positional argc, None-valued kwargs,
+    # line) for calls matching a registered call-kwarg carrier
+    carrier_calls: List[Tuple[str, FrozenSet[str], int,
+                              FrozenSet[str], int]] = field(
+        default_factory=list)
+    # (string keys, None-valued keys, string-CONSTANT-valued keys,
+    # has ** spread, line) for every dict literal — the rule matches
+    # marker sets against these
+    carrier_dicts: List[Tuple[FrozenSet[str], FrozenSet[str],
+                              FrozenSet[str], bool, int]] = field(
+        default_factory=list)
+    # string-constant subscript-store keys (`x["Traceparent"] = ...`):
+    # header-store crossings and late carrier-field stamps
+    key_stores: Set[str] = field(default_factory=set)
 
 
 class _FunctionWalker(ast.NodeVisitor):
@@ -318,6 +333,13 @@ class _FunctionWalker(ast.NodeVisitor):
             self.held.pop()
 
     def visit_Assign(self, node: ast.Assign) -> None:
+        # trace-carrier rule (rule 8): a constant-key subscript store is
+        # a header-store crossing or a late carrier-field stamp
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.slice, ast.Constant) and \
+                    isinstance(tgt.slice.value, str):
+                self.facts.key_stores.add(tgt.slice.value)
         # local alias tracking: name = self.attr — including the
         # teardown-swap form `name, self.attr = self.attr, None`
         if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name) \
@@ -457,6 +479,19 @@ class _FunctionWalker(ast.NodeVisitor):
                 self.facts.priv_calls.append(
                     (priv[0], priv[1], node.lineno))
 
+        # trace-carrier rule (rule 8): calls into a registered call-kwarg
+        # carrier — record the argument shape, judged by the rule pass
+        if leaf in self.a.carrier_call_names:
+            kwargs = frozenset(kw.arg for kw in node.keywords
+                               if kw.arg is not None)
+            none_kwargs = frozenset(
+                kw.arg for kw in node.keywords
+                if kw.arg is not None
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is None)
+            self.facts.carrier_calls.append(
+                (leaf, kwargs, len(node.args), none_kwargs, node.lineno))
+
         # blocking calls
         if self.a.is_blocking_name(rendered):
             self.facts.blocking.append(
@@ -522,6 +557,30 @@ class _FunctionWalker(ast.NodeVisitor):
             if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
                 site.daemon = bool(kw.value.value)
         self.facts.threads.append(site)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        # trace-carrier rule (rule 8): every dict literal's string-key
+        # shape, so the rule pass can match carrier-record marker sets
+        keys: Set[str] = set()
+        none_keys: Set[str] = set()
+        const_keys: Set[str] = set()
+        spread = False
+        for k, v in zip(node.keys, node.values):
+            if k is None:           # {**other}: opaque, can't prove absence
+                spread = True
+                continue
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+                if isinstance(v, ast.Constant):
+                    if v.value is None:
+                        none_keys.add(k.value)
+                    elif isinstance(v.value, str):
+                        const_keys.add(k.value)
+        if keys:
+            self.facts.carrier_dicts.append(
+                (frozenset(keys), frozenset(none_keys),
+                 frozenset(const_keys), spread, node.lineno))
+        self.generic_visit(node)
 
     # nested defs run later on other stacks: analyze separately, not inline
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -642,6 +701,9 @@ class Analyzer:
                                  str, ast.AST]] = []
         self._lock_attr_index: Dict[str, Set[str]] = {}
         self.lock_kinds: Dict[str, str] = {}
+        self.carrier_call_names = frozenset(
+            c.call for c in (config.carriers or ())
+            if c.kind == "call-kwarg")
 
     # ----------------------------------------------------------- structure
 
@@ -868,10 +930,11 @@ class Analyzer:
         findings += self._rule_threads()
         findings += self._rule_epoch_mutation()
         findings += self._rule_broker_boundary()
+        findings += self._rule_trace_carrier()
         order = {r: i for i, r in enumerate((
             "lock-order-cycle", "blocking-under-hot-lock", "counter-lock",
             "fault-site", "thread-lifecycle", "epoch-mutation",
-            "broker-boundary"))}
+            "broker-boundary", "trace-carrier"))}
         findings.sort(key=lambda f: (order.get(f.rule, 99), f.path, f.line))
         return findings
 
@@ -1127,6 +1190,121 @@ class Analyzer:
                             f"broker.get_client() (docs/design.md "
                             f"'Privilege separation')",
                     detail=f"{kind}:{token}"))
+        return findings
+
+    def _stamp_contexts(self, fld: str) -> Set[str]:
+        """Functions in whose context a carrier record is guaranteed to
+        receive a `rec[fld] = ...` stamp: the function stamps the key
+        itself, or (interprocedurally) EVERY resolved caller does —
+        the wrapper fixpoint that lets a record builder stay clean when
+        its callers thread the context after the call. Least fixpoint,
+        so an unresolved or cyclic caller chain stays conservative."""
+        callers: Dict[str, Set[str]] = {}
+        for qual, facts in self.facts.items():
+            for _held, callee, _line in facts.calls:
+                callers.setdefault(callee, set()).add(qual)
+        stamped = {qual for qual, facts in self.facts.items()
+                   if fld in facts.key_stores}
+        changed = True
+        while changed:
+            changed = False
+            for qual in self.facts:
+                if qual in stamped:
+                    continue
+                callset = callers.get(qual)
+                if callset and callset <= stamped:
+                    stamped.add(qual)
+                    changed = True
+        return stamped
+
+    def _rule_trace_carrier(self) -> List[Finding]:
+        """Rule 8: every cross-boundary trace carrier (config.carriers)
+        must thread its context field at every crossing, and the
+        registry must agree 3-way with docs/observability.md's carrier
+        taxonomy table and with the production crossing sites — the
+        same usage/registry/docs triangle as the fault-site rule. None
+        disables the rule (fixture runs)."""
+        if self.config.carriers is None:
+            return []
+        documented = self.config.documented_carriers or set()
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+        stamped_cache: Dict[str, Set[str]] = {}
+        for spec in self.config.carriers:
+            for qual, facts in self.facts.items():
+                if not spec.in_scope(facts.path):
+                    continue
+                if spec.kind == "call-kwarg":
+                    for leaf, kwargs, argc, none_kwargs, line in \
+                            facts.carrier_calls:
+                        if leaf != spec.call:
+                            continue
+                        seen.add(spec.name)
+                        threaded = (spec.field in kwargs
+                                    and spec.field not in none_kwargs) \
+                            or (0 <= spec.arg_index < argc)
+                        if not threaded:
+                            findings.append(Finding(
+                                rule="trace-carrier", path=facts.path,
+                                qualname=qual, line=line,
+                                message=f"{spec.call}() crosses a traced "
+                                        f"boundary without threading "
+                                        f"{spec.field}= (carrier "
+                                        f"{spec.name}, docs/observability"
+                                        f".md 'Trace propagation')",
+                                detail=f"unthreaded:{spec.name}"))
+                elif spec.kind == "dict-key":
+                    for keys, none_keys, const_keys, spread, line in \
+                            facts.carrier_dicts:
+                        if not spec.markers <= keys or spread \
+                                or spec.markers & const_keys:
+                            continue
+                        seen.add(spec.name)
+                        if spec.field in keys and \
+                                spec.field not in none_keys:
+                            continue
+                        if spec.field not in stamped_cache:
+                            stamped_cache[spec.field] = \
+                                self._stamp_contexts(spec.field)
+                        if qual in stamped_cache[spec.field]:
+                            continue
+                        findings.append(Finding(
+                            rule="trace-carrier", path=facts.path,
+                            qualname=qual, line=line,
+                            message=f"carrier record "
+                                    f"{{{', '.join(sorted(spec.markers))}}}"
+                                    f" built without its {spec.field!r} "
+                                    f"context (carrier {spec.name}, "
+                                    f"docs/observability.md "
+                                    f"'Trace propagation')",
+                            detail=f"unthreaded:{spec.name}"))
+                elif spec.kind == "header-store":
+                    if spec.field in facts.key_stores:
+                        seen.add(spec.name)
+        registered = {spec.name for spec in self.config.carriers}
+        for name in sorted(registered - documented):
+            findings.append(Finding(
+                rule="trace-carrier", path="docs/observability.md",
+                qualname="trace-propagation", line=0,
+                message=f"carrier {name!r} is registered "
+                        f"(tsalint config CARRIERS) but missing from the "
+                        f"propagation taxonomy table",
+                detail=f"undocumented:{name}"))
+        for name in sorted(documented - registered):
+            findings.append(Finding(
+                rule="trace-carrier", path="docs/observability.md",
+                qualname="trace-propagation", line=0,
+                message=f"carrier {name!r} is documented in the "
+                        f"propagation taxonomy table but not registered "
+                        f"in the tsalint config CARRIERS",
+                detail=f"undeclared:{name}"))
+        for name in sorted(registered - seen):
+            findings.append(Finding(
+                rule="trace-carrier", path="docs/observability.md",
+                qualname="trace-propagation", line=0,
+                message=f"registered carrier {name!r} has no production "
+                        f"crossing site (dead carrier)",
+                detail=f"dead:{name}"))
         return findings
 
 

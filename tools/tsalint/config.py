@@ -15,7 +15,49 @@ from __future__ import annotations
 import ast
 import re
 from dataclasses import dataclass, field
-from typing import Dict, FrozenSet, Optional, Set
+from typing import Dict, FrozenSet, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class CarrierSpec:
+    """One cross-boundary trace carrier (rule 8, trace-carrier).
+
+    ``name`` is the lint id documented in docs/observability.md's
+    propagation taxonomy table (the 3-way cross-check key, exactly like
+    fault sites vs docs/fault-injection.md). ``kind`` selects how the
+    crossing is detected and what "threads context" means there:
+
+    - ``call-kwarg``: every call whose leaf name is ``call`` must pass
+      the ``field`` keyword (or reach the positional slot ``arg_index``,
+      0-based, self excluded) — and not as a literal ``None``.
+    - ``dict-key``: every dict literal containing ALL ``markers`` keys
+      is a carrier record and must also carry ``field``. A record built
+      without it is still fine when the builder (or, via the call-graph
+      fixpoint, every resolved caller) stamps ``rec[field] = ...``
+      afterwards. A ``**spread`` makes the literal opaque (skipped), and
+      a marker bound to a string CONSTANT marks a synthesized fixed
+      frame (hello handshakes, injected-invalid sub-ops), not a
+      crossing.
+    - ``header-store``: the crossing evidence is a subscript store of
+      the literal ``field`` key (``headers["Traceparent"] = ...``);
+      existence anywhere in scope is the threading — rule 8 only pins
+      liveness (a registered header carrier with no store is dead).
+
+    ``scope``: path suffixes the detection applies to (empty = every
+    scanned file) — the broker frame shape {"op", "seq"} also appears on
+    the DECODE side in brokeripc.py, which receives context rather than
+    threads it.
+    """
+    name: str
+    kind: str
+    field: str
+    call: str = ""
+    arg_index: int = -1
+    markers: FrozenSet[str] = frozenset()
+    scope: FrozenSet[str] = frozenset()
+
+    def in_scope(self, path: str) -> bool:
+        return not self.scope or any(path.endswith(s) for s in self.scope)
 
 
 @dataclass
@@ -48,6 +90,12 @@ class LintConfig:
     # disables the rule (fixture runs); the project config whitelists the
     # broker, discovery, and the native shim (PRIVILEGED_SEAMS below).
     privileged_modules: Optional[FrozenSet[str]] = None
+    # trace-carrier rule (rule 8) inputs; None disables the rule (fixture
+    # runs). `carriers` is the code-side registry (CARRIERS below),
+    # `documented_carriers` the lint ids parsed from docs/observability.md's
+    # propagation taxonomy table — the same 3-way check as fault sites.
+    carriers: Optional[Tuple[CarrierSpec, ...]] = None
+    documented_carriers: Optional[Set[str]] = None
 
 
 # Blocking-call vocabulary: calls that can sleep, touch disk, or cross the
@@ -117,6 +165,45 @@ PRIVILEGED_SEAMS = frozenset({
     "tpu_device_plugin/discovery.py",
     "tpu_device_plugin/native/__init__.py",
 })
+
+# The trace-carrier registry (rule 8, ISSUE 20): every OUTBOUND
+# process/privilege boundary the r17 propagation design names must
+# thread its context field, and the registry must stay in 3-way sync
+# with docs/observability.md's propagation taxonomy table (lint ids in
+# the table's first column) and with the production crossing sites —
+# a registered carrier no code crosses is dead, a carrier the docs
+# don't name is undocumented, a documented id the registry dropped is
+# undeclared. Inbound attach points (server.py gRPC metadata, the
+# brokeripc decode path, watch-event consumption) RECEIVE context and
+# are deliberately not carriers.
+CARRIERS: Tuple[CarrierSpec, ...] = (
+    # scheduler decision -> fabric multiclaim record: the fleetsim
+    # fabric's multiclaim_begin(uid, shape, shards, traceparent=)
+    CarrierSpec(name="multiclaim.traceparent", kind="call-kwarg",
+                field="traceparent", call="multiclaim_begin", arg_index=3),
+    # claim prepare -> the claim itself: the checkpoint entry stamped
+    # under DraDriver._lock (spec_path+devices identify the entry shape)
+    CarrierSpec(name="checkpoint-entry.traceparent", kind="dict-key",
+                field="traceparent",
+                markers=frozenset({"spec_path", "devices"}),
+                scope=frozenset({"tpu_device_plugin/dra.py"})),
+    # migration source -> destination host: the handoff record that
+    # rides the same group commit as the entry deletion
+    CarrierSpec(name="handoff.traceparent", kind="dict-key",
+                field="traceparent",
+                markers=frozenset({"source_node", "generation"}),
+                scope=frozenset({"tpu_device_plugin/dra.py"})),
+    # serving daemon -> privileged broker: the request frame's span
+    # field ({"op", "seq"} is the outbound frame shape; brokeripc.py's
+    # decode side and constant-op synthesized frames are out of scope)
+    CarrierSpec(name="broker-frame.span", kind="dict-key", field="span",
+                markers=frozenset({"op", "seq"}),
+                scope=frozenset({"tpu_device_plugin/broker.py"})),
+    # daemon -> apiserver: the W3C Traceparent request header
+    CarrierSpec(name="kubeapi.traceparent-header", kind="header-store",
+                field="Traceparent",
+                scope=frozenset({"tpu_device_plugin/kubeapi.py"})),
+)
 
 # /status + /metrics counter ownership. Key classes by "module.Class";
 # "name[*]" covers dict-backed counter groups (stats["k"] += 1).
@@ -298,7 +385,29 @@ def documented_fault_sites(doc_text: str) -> Set[str]:
     return sites
 
 
-def project_config(faults_source: str, doc_text: str) -> LintConfig:
+def documented_carriers(doc_text: str) -> Set[str]:
+    """Carrier lint ids documented in docs/observability.md — the first
+    backticked token of each row of the boundary-by-boundary carrier
+    taxonomy table ('## Trace propagation'). Rows whose first cell is
+    not a backticked id (same-thread inheritance, inbound attach points)
+    are taxonomy prose, not checkable carriers."""
+    ids: Set[str] = set()
+    in_table = False
+    for line in doc_text.splitlines():
+        if "boundary-by-boundary carrier taxonomy" in line:
+            in_table = True
+            continue
+        if in_table:
+            if line.startswith("## ") or (ids and not line.strip()):
+                break
+            m = re.match(r"\s*\|\s*`([a-z0-9_.-]+)`\s*\|", line)
+            if m:
+                ids.add(m.group(1))
+    return ids
+
+
+def project_config(faults_source: str, doc_text: str,
+                   observability_text: str) -> LintConfig:
     """The LintConfig for THIS repo (scripts/lint_concurrency.py)."""
     return LintConfig(
         hot_locks=HOT_LOCKS,
@@ -308,4 +417,6 @@ def project_config(faults_source: str, doc_text: str) -> LintConfig:
         registered_sites=registered_fault_sites(faults_source),
         documented_sites=documented_fault_sites(doc_text),
         privileged_modules=PRIVILEGED_SEAMS,
+        carriers=CARRIERS,
+        documented_carriers=documented_carriers(observability_text),
     )

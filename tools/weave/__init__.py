@@ -1,0 +1,13 @@
+"""weave — deterministic interleaving checker for the lock-free planes.
+
+See tools/weave/core.py for the cooperative scheduler + DPOR explorer
+and tools/weave/scenarios.py for the checked production scenarios.
+Run ``python -m tools.weave`` (or ``make weave``).
+"""
+
+from tools.weave.core import (Counterexample, DeadlockError, ExploreResult,
+                              Scenario, WeaveError, WeaveHang, explore,
+                              replay, run_once)
+
+__all__ = ["Counterexample", "DeadlockError", "ExploreResult", "Scenario",
+           "WeaveError", "WeaveHang", "explore", "replay", "run_once"]

@@ -1,0 +1,191 @@
+"""weave CLI — deterministic interleaving checking for the lock-free
+planes.
+
+    python -m tools.weave                  # quick matrix: every scenario
+    python -m tools.weave --twins          # mutation side: twins must FIRE
+    python -m tools.weave --scenario NAME  # one scenario (repeatable)
+    python -m tools.weave --soak           # deeper budgets (CI soak leg)
+    python -m tools.weave --replay CE.json # reproduce a counterexample
+    python -m tools.weave --list           # what exists
+
+Exit codes: 0 = every selected scenario held (and every selected twin
+fired); 1 = a counterexample was found (or a twin failed to fire — a
+checker that cannot fire is a failing test); 2 = usage error.
+
+On failure the counterexample (exact schedule, JSON) is written under
+--artifacts (default .weave-artifacts/) for `--replay`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional, Type
+
+from tools.weave.core import (Counterexample, ExploreResult, Scenario,
+                              explore, replay)
+from tools.weave.scenarios import SCENARIOS, TWINS
+
+# soak multiplies the per-scenario execution budget and relaxes the
+# preemption bound by one — the quick matrix stays seconds-fast while
+# the soak leg buys schedules the bounded pass prunes (counts of which
+# the quick pass REPORTS, never hides)
+SOAK_BUDGET_FACTOR = 25
+SOAK_EXTRA_PREEMPTIONS = 1
+
+
+def _budgets(cls: Type[Scenario], soak: bool
+             ) -> Dict[str, Optional[int]]:
+    budget = cls.max_executions
+    bound = cls.preemption_bound
+    if soak:
+        budget *= SOAK_BUDGET_FACTOR
+        if bound is not None:
+            bound += SOAK_EXTRA_PREEMPTIONS
+    return {"max_executions": budget, "preemption_bound": bound}
+
+
+def _describe(res: ExploreResult) -> str:
+    if res.complete:
+        space = f"complete reduced space in {res.executions} execution(s)"
+    else:
+        space = f"budget-bounded: {res.executions} execution(s)"
+    extra = f", {res.bound_pruned} bound-pruned branch(es)" \
+        if res.bound_pruned else ""
+    return f"{space}, {res.steps_total} step(s){extra}"
+
+
+def _write_artifact(dirpath: str, ce: Counterexample) -> str:
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, f"{ce.scenario}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(ce.to_json())
+    return path
+
+
+def _run_scenarios(names: List[str], soak: bool,
+                   artifacts: str) -> int:
+    rc = 0
+    for name in names:
+        res = explore(SCENARIOS[name](), **_budgets(SCENARIOS[name], soak))
+        if res.ok:
+            print(f"ok   {name}: {_describe(res)}")
+            continue
+        rc = 1
+        assert res.counterexample is not None
+        path = _write_artifact(artifacts, res.counterexample)
+        print(f"FAIL {name}: {_describe(res)}")
+        print(res.counterexample.render())
+        print(f"     counterexample saved: {path}")
+        print(f"     reproduce: python -m tools.weave --replay {path}")
+    return rc
+
+
+def _run_twins(names: List[str], soak: bool, artifacts: str) -> int:
+    """Mutation testing for the invariants: every twin seeds a real
+    concurrency bug and weave MUST find it."""
+    rc = 0
+    for name in names:
+        res = explore(TWINS[name](), **_budgets(TWINS[name], soak))
+        if res.counterexample is not None:
+            path = _write_artifact(artifacts, res.counterexample)
+            print(f"ok   {name}: seeded bug found "
+                  f"({res.executions} execution(s)) — {path}")
+        else:
+            rc = 1
+            print(f"FAIL {name}: seeded bug NOT found — the "
+                  f"'{TWINS[name].twin_of}' checker cannot fire "
+                  f"({_describe(res)})")
+    return rc
+
+
+def _replay(path: str) -> int:
+    with open(path, "r", encoding="utf-8") as f:
+        ce = Counterexample.from_json(f.read())
+    cls = SCENARIOS.get(ce.scenario) or TWINS.get(ce.scenario)
+    if cls is None:
+        print(f"unknown scenario in counterexample: {ce.scenario!r}",
+              file=sys.stderr)
+        return 2
+    failure = replay(cls(), ce)
+    if failure is None:
+        print(f"did NOT reproduce: {ce.scenario} ran the recorded "
+              f"schedule clean (code changed since capture?)")
+        return 1
+    print(f"reproduced {ce.scenario}:")
+    print(f"  recorded: {ce.failure}")
+    print(f"  now:      {failure}")
+    print(ce.render())
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.weave",
+        description="deterministic interleaving checker "
+                    "(see docs/static-analysis.md)")
+    ap.add_argument("--scenario", action="append", default=[],
+                    help="run one scenario/twin by name (repeatable; "
+                         "default: every production scenario)")
+    ap.add_argument("--twins", action="store_true",
+                    help="run the seeded-bug twins (each MUST fire)")
+    ap.add_argument("--soak", action="store_true",
+                    help=f"{SOAK_BUDGET_FACTOR}x execution budgets, "
+                         f"+{SOAK_EXTRA_PREEMPTIONS} preemption bound")
+    ap.add_argument("--replay", metavar="CE_JSON",
+                    help="reproduce a saved counterexample")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="list scenarios and twins")
+    ap.add_argument("--artifacts", default=".weave-artifacts",
+                    help="directory for counterexample JSON "
+                         "(default: %(default)s)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="keep production log output (default: quiet — "
+                         "failure-path scenarios log errors by design)")
+    args = ap.parse_args(argv)
+
+    if args.list_:
+        print("scenarios:")
+        for name, cls in SCENARIOS.items():
+            print(f"  {name:28s} {cls.description}")
+        print("twins (seeded bugs — must fire):")
+        for name, cls in TWINS.items():
+            print(f"  {name:28s} mutation of {cls.twin_of}")
+        return 0
+
+    if not args.verbose:
+        logging.disable(logging.CRITICAL)
+
+    if args.replay:
+        return _replay(args.replay)
+
+    scenario_names = []
+    twin_names = []
+    for name in args.scenario:
+        if name in SCENARIOS:
+            scenario_names.append(name)
+        elif name in TWINS:
+            twin_names.append(name)
+        else:
+            print(f"unknown scenario: {name!r} (see --list)",
+                  file=sys.stderr)
+            return 2
+    if not args.scenario:
+        scenario_names = list(SCENARIOS)
+        twin_names = list(TWINS) if args.twins else []
+    elif args.twins and not twin_names:
+        twin_names = list(TWINS)
+
+    rc = 0
+    if scenario_names:
+        rc |= _run_scenarios(scenario_names, args.soak, args.artifacts)
+    if twin_names:
+        rc |= _run_twins(twin_names, args.soak, args.artifacts)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1090 @@
+"""weave core — a loom-style deterministic schedule explorer.
+
+One scenario = a handful of threads running REAL production code whose
+concurrency seams have been virtualized:
+
+- ``threading.Lock/RLock/Condition/Event/Thread`` constructed inside a
+  run are replaced by cooperative shims: every acquire/release/wait/
+  notify/set/start/join is a *schedule point* where the calling thread
+  parks and the controller decides who runs next.
+- ``schedcheck.yield_point(...)`` calls in production code (the marked
+  C-atomic accesses of the lock-free planes) become schedule points
+  the same way.
+- ``time.monotonic``/``time.sleep`` read a virtual clock. A timed wait
+  never fires while any thread is runnable: when the run quiesces, the
+  clock jumps to the earliest pending deadline. An UNTIMED wait that is
+  never notified is therefore a detected deadlock — exactly the
+  lost-wakeup class of bug.
+
+Exactly one logical thread runs at a time (each parked on its own real
+`Event`), so an execution is a deterministic function of the schedule —
+the sequence of thread choices. The explorer enumerates schedules with
+Flanagan–Godefroid dynamic partial-order reduction (per-step backtrack
+sets seeded from the last dependent access to the same object), an
+optional preemption bound, and a per-scenario execution budget. A
+failing execution yields a counterexample whose schedule replays the
+exact interleaving deterministically.
+"""
+
+from __future__ import annotations
+
+import _thread
+import json
+import sys
+import threading
+import time
+import traceback
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Set,
+                    Tuple)
+
+from tpu_device_plugin import schedcheck
+
+__all__ = [
+    "Counterexample", "DeadlockError", "ExploreResult", "Scenario",
+    "WeaveError", "WeaveHang", "explore", "replay", "run_once",
+    "WeaveLock", "WeaveRLock", "WeaveCondition", "WeaveEvent",
+    "WeaveThread",
+]
+
+# real primitives, captured before any patching. Controlled threads are
+# started with _thread.start_new_thread, NOT threading.Thread, and the
+# harness parks them on raw _thread locks: anything from the threading
+# module (Thread, Event, even a pre-captured Event CLASS) resolves
+# Lock/Condition from the threading namespace at call time, which inside
+# a run would hand the harness its own cooperative shims.
+_REAL_CURRENT_THREAD = threading.current_thread
+_REAL_GET_IDENT = threading.get_ident
+_REAL_MONOTONIC = time.monotonic
+_REAL_SLEEP = time.sleep
+
+
+class _Gate:
+    """Auto-reset event on a raw C lock — safe to use under the patch."""
+
+    __slots__ = ("_lk",)
+
+    def __init__(self) -> None:
+        self._lk = _thread.allocate_lock()
+        self._lk.acquire()             # start closed
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if timeout is None:
+            self._lk.acquire()
+            return True
+        return self._lk.acquire(True, timeout)
+
+    def set(self) -> None:
+        try:
+            self._lk.release()
+        except RuntimeError:
+            pass                       # already open: saturate
+
+    def clear(self) -> None:
+        self._lk.acquire(False)        # drain a stale set, never block
+
+# a controlled thread stuck in a REAL blocking call longer than this is
+# a harness bug (or un-virtualized blocking in production code) — fail
+# loudly with stacks instead of hanging CI
+_WATCHDOG_S = 30.0
+
+_MAX_STEPS_DEFAULT = 20_000
+
+
+class WeaveError(Exception):
+    """Scenario/harness error (not an invariant violation)."""
+
+
+class DeadlockError(WeaveError):
+    """No thread runnable, no pending deadline: a lost wakeup."""
+
+
+class WeaveHang(WeaveError):
+    """A controlled thread blocked in real (un-virtualized) code."""
+
+
+class _ReapSignal(BaseException):
+    """Raised inside abandoned threads to unwind them after a verdict."""
+
+
+# --------------------------------------------------------------- model ops
+
+# op kinds that touch a keyed location but are NOT conflict points for
+# the dependency relation (see the dep_log comment in _Run.run_until)
+_NONCONFLICT_KINDS = frozenset({"release", "wakeup"})
+
+
+class _Op:
+    """One announced schedule point: what the thread will do next.
+
+    `key`   identifies the shared object (dependency equivalence class).
+    `mode`  "r" or "w" — two ops are dependent iff same key and not
+            both reads.
+    `deadline` — virtual-clock instant at which a blocked op becomes
+            enabled (timed waits/sleeps/joins); None = untimed.
+    """
+
+    __slots__ = ("kind", "label", "key", "mode", "deadline",
+                 "enabled", "execute")
+
+    def __init__(self, kind: str, label: str, key: Optional[int],
+                 mode: str = "w",
+                 deadline: Optional[float] = None,
+                 enabled: Optional[Callable[[], bool]] = None,
+                 execute: Optional[Callable[[], None]] = None) -> None:
+        self.kind = kind
+        self.label = label
+        self.key = key
+        self.mode = mode
+        self.deadline = deadline
+        self.enabled = enabled or _always
+        self.execute = execute or _noop
+
+    def depends(self, other: "_Op") -> bool:
+        if self.key is None or other.key is None:
+            return False
+        if self.key != other.key:
+            return False
+        return not (self.mode == "r" and other.mode == "r")
+
+    def __repr__(self) -> str:
+        return self.label
+
+
+def _always() -> bool:
+    return True
+
+
+def _noop() -> None:
+    return None
+
+
+def _name(obj: object) -> str:
+    return f"{type(obj).__name__}#{id(obj) & 0xFFFF:04x}"
+
+
+class _VThread:
+    """One controlled logical thread (backed by a real thread that only
+    ever runs while the controller has handed it the baton)."""
+
+    def __init__(self, run: "_Run", name: str,
+                 fn: Callable[[], None]) -> None:
+        self.run = run
+        self.name = name
+        self.fn = fn
+        self.go = _Gate()
+        self.pending: Optional[_Op] = None
+        self.finished = False
+        self.exc: Optional[BaseException] = None
+        self.notified = False          # condition wakeup flag
+        self.shim: Optional["WeaveThread"] = None   # threading.Thread shim
+        self.ident: Optional[int] = None
+        self.done = _Gate()      # set when the real thread exits
+
+    def _main(self) -> None:
+        # initial park at "begin" WITHOUT signaling the controller: the
+        # spawner synchronizes on `pending` becoming visible, and the
+        # thread only starts running user code when first scheduled
+        self.pending = _Op("begin", f"begin:{self.name}", None)
+        self.go.wait()
+        self.go.clear()
+        self.pending = None
+        try:
+            if not self.run._reaping:
+                self.fn()
+        except _ReapSignal:
+            pass
+        except BaseException as exc:      # noqa: BLE001 — reported as CE
+            self.exc = exc
+        finally:
+            self.finished = True
+            self.run._ctrl.set()
+            self.done.set()
+
+
+class _Clock:
+    def __init__(self) -> None:
+        self.now = 1000.0              # arbitrary epoch, away from zero
+        self.advances = 0
+
+    def advance_to(self, t: float) -> None:
+        if t > self.now:
+            self.now = t
+            self.advances += 1
+
+
+class _Run:
+    """One execution: the controller state shared with the shims."""
+
+    def __init__(self, max_steps: int = _MAX_STEPS_DEFAULT) -> None:
+        self.clock = _Clock()
+        self.threads: List[_VThread] = []
+        self._by_real: Dict[int, _VThread] = {}
+        self._ctrl = _Gate()
+        self.steps: List[Tuple[str, str]] = []       # (thread, op label)
+        self.enabled_log: List[Tuple[str, ...]] = []  # per step
+        self.dep_log: List[Tuple[int, int, str, str]] = []
+        #             (step index, key, mode, thread)
+        self.max_steps = max_steps
+        self._reaping = False
+        self._spawn_seq = 0
+
+    # ---- thread registry
+
+    def spawn(self, name: str, fn: Callable[[], None],
+              shim: Optional["WeaveThread"] = None) -> _VThread:
+        taken = {t.name for t in self.threads}
+        base, uniq = name, name
+        n = 2
+        while uniq in taken:
+            uniq = f"{base}#{n}"
+            n += 1
+        vt = _VThread(self, uniq, fn)
+        vt.shim = shim
+        self.threads.append(vt)
+        vt.ident = _thread.start_new_thread(vt._main, ())
+        self._by_real[vt.ident] = vt
+        # wait until the thread parks at its begin announce, so spawn is
+        # atomic from the spawner's point of view
+        deadline = _REAL_MONOTONIC() + _WATCHDOG_S
+        while vt.pending is None and not vt.finished:
+            if _REAL_MONOTONIC() > deadline:
+                raise WeaveHang(f"thread {uniq} never parked")
+            _REAL_SLEEP(0.00005)
+        return vt
+
+    def current(self) -> Optional[_VThread]:
+        return self._by_real.get(_REAL_GET_IDENT())
+
+    # ---- schedule points (called from controlled threads)
+
+    def schedule(self, op: _Op) -> None:
+        vt = self.current()
+        if vt is None:
+            # main/uncontrolled thread: runs only while every controlled
+            # thread is parked — execute the effect directly
+            op.execute()
+            return
+        if self._reaping:
+            raise _ReapSignal()
+        vt.pending = op
+        self._ctrl.set()
+        vt.go.wait()
+        vt.go.clear()
+        if self._reaping:
+            vt.pending = None
+            raise _ReapSignal()
+        pend, vt.pending = vt.pending, None
+        if pend is not None:
+            pend.execute()
+
+    # ---- controller (runs on the main thread)
+
+    def _step_one(self, vt: _VThread) -> None:
+        self._ctrl.clear()
+        vt.go.set()
+        if not self._ctrl.wait(timeout=_WATCHDOG_S):
+            frames = sys._current_frames()
+            stacks = []
+            for t in self.threads:
+                fr = frames.get(t.ident or -1)
+                if fr is not None:
+                    stacks.append(f"--- {t.name} ---\n" +
+                                  "".join(traceback.format_stack(fr)))
+            raise WeaveHang(
+                "controlled thread blocked in real code:\n" +
+                "\n".join(stacks))
+
+    def run_until(self, forced: Sequence[str],
+                  stop_when: Optional[Callable[[], bool]] = None) -> None:
+        """Drive threads until all are finished (or `stop_when` holds).
+        The first len(forced) choices overall are pinned; after that the
+        default policy runs — stay on the previous thread while it is
+        enabled, else the first enabled by name (run-to-completion,
+        which minimizes preemptions)."""
+        prev: Optional[str] = None if not self.steps else self.steps[-1][0]
+        while True:
+            if stop_when is not None and stop_when():
+                return
+            live = [t for t in self.threads if not t.finished]
+            if not live:
+                return
+            enabled = sorted(
+                t.name for t in live
+                if t.pending is not None and t.pending.enabled())
+            if not enabled:
+                deadlines = [t.pending.deadline for t in live
+                             if t.pending is not None
+                             and t.pending.deadline is not None]
+                if not deadlines:
+                    blocked = ", ".join(
+                        f"{t.name} at {t.pending!r}" for t in live
+                        if t.pending is not None)
+                    raise DeadlockError(
+                        f"deadlock (lost wakeup): no runnable thread, no "
+                        f"pending deadline; blocked: {blocked}")
+                self.clock.advance_to(min(deadlines))
+                continue
+            i = len(self.steps)
+            if i >= self.max_steps:
+                raise WeaveError(
+                    f"step budget exceeded ({self.max_steps}): livelock "
+                    f"or unbounded loop in scenario")
+            if i < len(forced):
+                name = forced[i]
+                if name not in enabled:
+                    raise WeaveError(
+                        f"schedule diverged at step {i}: {name!r} not in "
+                        f"enabled set {enabled}")
+            else:
+                name = prev if prev in enabled else enabled[0]
+            vt = next(t for t in self.threads if t.name == name)
+            op = vt.pending
+            assert op is not None
+            self.steps.append((name, repr(op)))
+            self.enabled_log.append(tuple(enabled))
+            # releases and post-notify wakeups are enabledness plumbing,
+            # not conflicts: an acquire can never be reordered before the
+            # release that enables it, so logging them as dependencies
+            # would stop the DPOR backward scan at a step whose pre-state
+            # has only the lock holder enabled — hiding the acquire
+            # (the true race point) behind it and losing interleavings
+            # (e.g. a check/apply TOCTOU split across two crossings).
+            if op.key is not None and op.kind not in _NONCONFLICT_KINDS:
+                self.dep_log.append((i, op.key, op.mode, name))
+            self._step_one(vt)
+            prev = name
+
+    def reap(self) -> None:
+        """Unwind every still-live thread (post-verdict cleanup: failed
+        or deadlocked executions leave threads parked)."""
+        self._reaping = True
+        for vt in self.threads:
+            if not vt.finished:
+                vt.go.set()
+        for vt in self.threads:
+            if not vt.done.wait(timeout=5):
+                raise WeaveHang(f"thread {vt.name} would not unwind")
+
+
+# ------------------------------------------------------------------ shims
+
+_CURRENT_RUN: Optional[_Run] = None
+
+
+def _run_and_me() -> Tuple[Optional[_Run], Optional[_VThread]]:
+    run = _CURRENT_RUN
+    if run is None:
+        return None, None
+    return run, run.current()
+
+
+class WeaveLock:
+    """Cooperative threading.Lock replacement."""
+
+    _reentrant = False
+
+    def __init__(self) -> None:
+        self._owner: Optional[_VThread] = None
+        self._count = 0
+        self._main_held = 0       # held by the (uncontrolled) main thread
+
+    # -- model helpers (controller-atomic: called from op.execute or
+    #    enabled() while every other thread is parked)
+
+    def _free_for(self, vt: Optional[_VThread]) -> bool:
+        if self._main_held:
+            return False
+        if self._owner is None:
+            return True
+        return self._reentrant and self._owner is vt
+
+    def _take(self, vt: Optional[_VThread]) -> None:
+        if vt is None:
+            self._main_held += 1
+            return
+        self._owner = vt
+        self._count += 1
+
+    def _drop(self, vt: Optional[_VThread]) -> None:
+        if vt is None and self._main_held:
+            self._main_held -= 1
+            return
+        if self._owner is not vt or self._count <= 0:
+            raise RuntimeError("release of un-acquired weave lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+
+    def _release_all(self, vt: _VThread) -> int:
+        if self._owner is not vt:
+            raise RuntimeError("cannot wait on un-owned lock")
+        saved, self._count, self._owner = self._count, 0, None
+        return saved
+
+    def _restore(self, vt: _VThread, count: int) -> None:
+        self._owner, self._count = vt, count
+
+    # -- threading API
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            if not self._free_for(me):
+                raise WeaveError("main thread would block on weave lock")
+            self._take(me)
+            return True
+        if not blocking:
+            got: List[bool] = []
+
+            def _try() -> None:
+                ok = self._free_for(me)
+                if ok:
+                    self._take(me)
+                got.append(ok)
+
+            run.schedule(_Op("tryacquire", f"tryacquire:{_name(self)}",
+                             id(self), execute=_try))
+            return got[0]
+        run.schedule(_Op(
+            "acquire", f"acquire:{_name(self)}", id(self),
+            enabled=lambda: self._free_for(me),
+            execute=lambda: self._take(me)))
+        return True
+
+    def release(self) -> None:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            self._drop(me)
+            return
+        run.schedule(_Op("release", f"release:{_name(self)}", id(self),
+                         execute=lambda: self._drop(me)))
+
+    def locked(self) -> bool:
+        return self._owner is not None or bool(self._main_held)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class WeaveRLock(WeaveLock):
+    _reentrant = True
+
+
+class WeaveCondition(threading.Condition):
+    """Cooperative threading.Condition replacement.
+
+    Subclasses the real Condition so `isinstance(x, threading.Condition)`
+    dispatch (lockdep.instrument's proxy selection) keeps working; every
+    inherited behavior is overridden and the base __init__ is NOT called
+    (its real RLock would be dead weight)."""
+
+    def __init__(self, lock: Optional[WeaveLock] = None) -> None:
+        self._wlock = lock if lock is not None else WeaveRLock()
+        self._cond_waiters: List[_VThread] = []
+
+    # lock passthrough
+
+    def acquire(self, *args: Any, **kwargs: Any) -> bool:
+        return self._wlock.acquire(*args, **kwargs)
+
+    def release(self) -> None:
+        self._wlock.release()
+
+    def __enter__(self) -> bool:
+        return self._wlock.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self._wlock.release()
+
+    # condition protocol: wait = three schedule points — release+park
+    # ("wait"), wake eligibility ("wakeup": notified or timed out), then
+    # a normal contended reacquire
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            raise WeaveError("main thread cannot wait on weave condition")
+        if self._wlock._owner is not me:
+            raise RuntimeError("cannot wait on un-acquired lock")
+        saved = [0]
+
+        def _exec_wait() -> None:
+            saved[0] = self._wlock._release_all(me)
+            me.notified = False
+            self._cond_waiters.append(me)
+
+        run.schedule(_Op("wait", f"wait:{_name(self)}", id(self._wlock),
+                         execute=_exec_wait))
+        deadline = (run.clock.now + timeout) if timeout is not None else None
+        timed_out = [False]
+
+        def _exec_wake() -> None:
+            timed_out[0] = not me.notified
+            if me in self._cond_waiters:
+                self._cond_waiters.remove(me)
+
+        run.schedule(_Op(
+            "wakeup", f"wakeup:{_name(self)}", id(self._wlock),
+            deadline=deadline,
+            enabled=lambda: me.notified or (
+                deadline is not None and run.clock.now >= deadline),
+            execute=_exec_wake))
+        run.schedule(_Op(
+            "reacquire", f"reacquire:{_name(self)}", id(self._wlock),
+            enabled=lambda: self._wlock._free_for(me),
+            execute=lambda: self._wlock._restore(me, saved[0])))
+        return not timed_out[0]
+
+    def wait_for(self, predicate: Callable[[], bool],
+                 timeout: Optional[float] = None) -> bool:
+        run, _me = _run_and_me()
+        endtime: Optional[float] = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                assert run is not None
+                if endtime is None:
+                    endtime = run.clock.now + timeout
+                waittime = endtime - run.clock.now
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        def _exec() -> None:
+            woken = 0
+            for vt in self._cond_waiters:
+                if not vt.notified:
+                    vt.notified = True
+                    woken += 1
+                    if woken >= n:
+                        break
+
+        run, me = _run_and_me()
+        if run is None or me is None:
+            _exec()
+            return
+        if self._wlock._owner is not me:
+            raise RuntimeError("cannot notify on un-acquired lock")
+        run.schedule(_Op("notify", f"notify:{_name(self)}",
+                         id(self._wlock), execute=_exec))
+
+    def notify_all(self) -> None:
+        self.notify(1_000_000)
+
+
+class WeaveEvent:
+    """Cooperative threading.Event replacement."""
+
+    def __init__(self) -> None:
+        self._flag = False
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            self._flag = True
+            return
+
+        def _exec() -> None:
+            self._flag = True
+
+        run.schedule(_Op("evset", f"evset:{_name(self)}", id(self),
+                         execute=_exec))
+
+    def clear(self) -> None:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            self._flag = False
+            return
+
+        def _exec() -> None:
+            self._flag = False
+
+        run.schedule(_Op("evclear", f"evclear:{_name(self)}", id(self),
+                         execute=_exec))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        run, me = _run_and_me()
+        if run is None or me is None:
+            if not self._flag:
+                raise WeaveError("main thread would block on weave event")
+            return True
+        deadline = (run.clock.now + timeout) if timeout is not None else None
+        run.schedule(_Op(
+            "evwait", f"evwait:{_name(self)}", id(self),
+            deadline=deadline,
+            enabled=lambda: self._flag or (
+                deadline is not None and run.clock.now >= deadline)))
+        return self._flag
+
+
+class WeaveThread:
+    """Cooperative threading.Thread replacement: threads production code
+    spawns inside a run become controlled threads."""
+
+    def __init__(self, group: None = None,
+                 target: Optional[Callable[..., Any]] = None,
+                 name: Optional[str] = None,
+                 args: Tuple[Any, ...] = (),
+                 kwargs: Optional[Dict[str, Any]] = None,
+                 daemon: Optional[bool] = None) -> None:
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        self._requested_name = name
+        self.daemon = bool(daemon)
+        self._vt: Optional[_VThread] = None
+
+    @property
+    def name(self) -> str:
+        if self._vt is not None:
+            return self._vt.name
+        return self._requested_name or "unstarted"
+
+    def start(self) -> None:
+        run, me = _run_and_me()
+        if run is None:
+            raise WeaveError("weave thread started outside a run")
+        name = self._requested_name
+        if name is None:
+            run._spawn_seq += 1
+            name = f"spawned-{run._spawn_seq}"
+
+        def body() -> None:
+            if self._target is not None:
+                self._target(*self._args, **self._kwargs)
+
+        if me is None:
+            self._vt = run.spawn(name, body, shim=self)
+            return
+
+        def _exec() -> None:
+            self._vt = run.spawn(name, body, shim=self)
+
+        run.schedule(_Op("spawn", f"spawn:{name}", None, execute=_exec))
+
+    def is_alive(self) -> bool:
+        vt = self._vt
+        return vt is not None and not vt.finished
+
+    @property
+    def ident(self) -> Optional[int]:
+        vt = self._vt
+        return vt.ident if vt is not None else None
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        run, me = _run_and_me()
+        vt = self._vt
+        if vt is None:
+            return
+        if run is None or me is None:
+            raise WeaveError("main thread cannot join a weave thread; "
+                             "the controller drains it")
+        deadline = (run.clock.now + timeout) if timeout is not None else None
+        run.schedule(_Op(
+            "join", f"join:{vt.name}", None, deadline=deadline,
+            enabled=lambda: vt.finished or (
+                deadline is not None and run.clock.now >= deadline)))
+
+
+class _FakeThread:
+    """current_thread() stand-in for controlled threads with no
+    threading.Thread shim (the scenario's own threads)."""
+
+    def __init__(self, vt: _VThread) -> None:
+        self._vt = vt
+        self.name = vt.name
+        self.daemon = True
+
+    @property
+    def ident(self) -> Optional[int]:
+        return self._vt.ident
+
+    def is_alive(self) -> bool:
+        return not self._vt.finished
+
+
+def _weave_current_thread() -> Any:
+    run = _CURRENT_RUN
+    if run is not None:
+        vt = run.current()
+        if vt is not None:
+            if vt.shim is not None:
+                return vt.shim
+            fake = getattr(vt, "fake", None)
+            if fake is None:
+                fake = vt.fake = _FakeThread(vt)
+            return fake
+    return _REAL_CURRENT_THREAD()
+
+
+def _weave_monotonic() -> float:
+    run = _CURRENT_RUN
+    if run is not None:
+        return run.clock.now
+    return _REAL_MONOTONIC()
+
+
+def _weave_sleep(seconds: float) -> None:
+    run = _CURRENT_RUN
+    if run is None:
+        _REAL_SLEEP(seconds)
+        return
+    me = run.current()
+    if me is None:
+        run.clock.advance_to(run.clock.now + seconds)
+        return
+    deadline = run.clock.now + max(seconds, 0.0)
+    run.schedule(_Op(
+        "sleep", f"sleep:{seconds:g}", None, deadline=deadline,
+        enabled=lambda: run.clock.now >= deadline))
+
+
+def _yield_hook(label: str, obj: Optional[object], mode: str,
+                key: Optional[str] = None) -> None:
+    run = _CURRENT_RUN
+    if run is None:
+        return
+    me = run.current()
+    if me is None:
+        return
+    if key is not None:
+        loc = hash(("yp-key", key)) | 1
+    elif obj is not None:
+        loc = id(obj)
+    else:
+        loc = hash(("yp-label", label)) | 1
+    run.schedule(_Op("yp", f"yp:{label}", loc, mode=mode))
+
+
+class _Patch:
+    """Swap the concurrency seams for shims for the duration of a run."""
+
+    def __init__(self, run: _Run) -> None:
+        self.run = run
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self) -> "_Patch":
+        global _CURRENT_RUN
+        if _CURRENT_RUN is not None:
+            raise WeaveError("nested weave runs are not supported")
+        self._saved = {
+            "Lock": threading.Lock, "RLock": threading.RLock,
+            "Condition": threading.Condition, "Event": threading.Event,
+            "Thread": threading.Thread,
+            "current_thread": threading.current_thread,
+            "monotonic": time.monotonic, "sleep": time.sleep,
+        }
+        threading.Lock = WeaveLock                  # type: ignore[misc]
+        threading.RLock = WeaveRLock                # type: ignore[misc]
+        threading.Condition = WeaveCondition        # type: ignore[misc]
+        threading.Event = WeaveEvent                # type: ignore[misc]
+        threading.Thread = WeaveThread              # type: ignore[misc]
+        threading.current_thread = _weave_current_thread
+        time.monotonic = _weave_monotonic
+        time.sleep = _weave_sleep
+        _CURRENT_RUN = self.run
+        schedcheck.install(_yield_hook)
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        global _CURRENT_RUN
+        schedcheck.uninstall()
+        _CURRENT_RUN = None
+        threading.Lock = self._saved["Lock"]
+        threading.RLock = self._saved["RLock"]
+        threading.Condition = self._saved["Condition"]
+        threading.Event = self._saved["Event"]
+        threading.Thread = self._saved["Thread"]
+        threading.current_thread = self._saved["current_thread"]
+        time.monotonic = self._saved["monotonic"]
+        time.sleep = self._saved["sleep"]
+
+
+# -------------------------------------------------------------- scenarios
+
+class Scenario:
+    """Subclass and override:
+
+    - ``setup(self) -> state``: construct the objects under test (the
+      patched constructors are active — locks/conditions built here are
+      cooperative).
+    - ``threads(self, state) -> [(name, fn), ...]``: the racing thread
+      bodies (2–4).
+    - ``invariant(self, state, run)``: raise AssertionError on
+      violation; runs after every complete execution. ``run`` exposes
+      ``clock`` (with ``.advances``) and ``steps``.
+    - ``drain(self, state)`` (optional): runs on the controller thread
+      once the scenario threads finish — stop flags for background
+      threads production code spawned; they are then scheduled to
+      completion before the invariant runs.
+    """
+
+    name = "scenario"
+    description = ""
+    max_executions = 2000
+    preemption_bound: Optional[int] = None
+    max_steps = _MAX_STEPS_DEFAULT
+
+    def setup(self) -> Any:
+        raise NotImplementedError
+
+    def threads(self, state: Any) -> List[Tuple[str, Callable[[], None]]]:
+        raise NotImplementedError
+
+    def invariant(self, state: Any, run: _Run) -> None:
+        raise NotImplementedError
+
+    def drain(self, state: Any) -> None:
+        return None
+
+
+class Counterexample:
+    def __init__(self, scenario: str, schedule: List[str],
+                 steps: List[Tuple[str, str]], failure: str) -> None:
+        self.scenario = scenario
+        self.schedule = schedule
+        self.steps = steps
+        self.failure = failure
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "scenario": self.scenario,
+            "schedule": self.schedule,
+            "steps": [list(s) for s in self.steps],
+            "failure": self.failure,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(text: str) -> "Counterexample":
+        d = json.loads(text)
+        return Counterexample(
+            d["scenario"], list(d["schedule"]),
+            [(s[0], s[1]) for s in d.get("steps", [])],
+            d.get("failure", ""))
+
+    def render(self) -> str:
+        lines = [f"counterexample: {self.scenario}",
+                 f"  failure: {self.failure}",
+                 "  schedule (step: thread  op):"]
+        for i, (name, op) in enumerate(self.steps):
+            lines.append(f"    {i:4d}: {name:<14s} {op}")
+        return "\n".join(lines)
+
+
+class ExploreResult:
+    def __init__(self, scenario: str) -> None:
+        self.scenario = scenario
+        self.executions = 0
+        self.steps_total = 0
+        self.complete = False          # full reduced space explored
+        self.bound_pruned = 0          # choices pruned by preemption bound
+        self.counterexample: Optional[Counterexample] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.counterexample is None
+
+    def render(self) -> str:
+        status = "ok" if self.ok else "FAIL"
+        if not self.ok:
+            space = "stopped at first counterexample;"
+        elif self.complete:
+            space = "complete"
+        else:
+            space = "budget-bounded"
+        extra = (f", {self.bound_pruned} choice(s) pruned by preemption "
+                 f"bound" if self.bound_pruned else "")
+        return (f"{self.scenario}: {status} — {self.executions} "
+                f"execution(s), {self.steps_total} step(s), "
+                f"{space} exploration{extra}")
+
+
+def _execute(scenario: Scenario,
+             forced: Sequence[str]) -> Tuple[_Run, Optional[str]]:
+    """One deterministic execution under the forced schedule prefix.
+    Returns (run, failure_text or None)."""
+    run = _Run(max_steps=scenario.max_steps)
+    failure: Optional[str] = None
+    with _Patch(run):
+        try:
+            state = scenario.setup()
+            svts = [run.spawn(tname, fn)
+                    for tname, fn in scenario.threads(state)]
+            try:
+                run.run_until(
+                    forced,
+                    stop_when=lambda: all(t.finished for t in svts))
+                scenario.drain(state)
+                run.run_until(forced)
+            except DeadlockError as exc:
+                failure = str(exc)
+            if failure is None:
+                for vt in run.threads:
+                    if vt.exc is not None:
+                        tb = "".join(traceback.format_exception(
+                            type(vt.exc), vt.exc,
+                            vt.exc.__traceback__)).strip()
+                        failure = f"thread {vt.name} raised: {tb}"
+                        break
+            if failure is None:
+                try:
+                    scenario.invariant(state, run)
+                except AssertionError as exc:
+                    failure = f"invariant violated: {exc}"
+        finally:
+            run.reap()
+    return run, failure
+
+
+def run_once(scenario: Scenario,
+             schedule: Sequence[str]) -> Tuple[_Run, Optional[str]]:
+    """Replay one exact schedule (counterexample reproduction)."""
+    return _execute(scenario, list(schedule))
+
+
+def replay(scenario: Scenario, ce: Counterexample) -> Optional[str]:
+    """Re-run a counterexample's schedule; returns the reproduced
+    failure text (None = did not reproduce)."""
+    _run, failure = run_once(scenario, ce.schedule)
+    return failure
+
+
+# -------------------------------------------------------------- explorer
+
+class _Node:
+    """Per-depth exploration record (persists across executions)."""
+
+    __slots__ = ("enabled", "chosen", "backtrack", "done", "preempts",
+                 "label")
+
+    def __init__(self, enabled: Tuple[str, ...], chosen: str,
+                 preempts: int, label: str) -> None:
+        self.enabled = enabled
+        self.chosen = chosen
+        self.backtrack: Set[str] = {chosen}
+        self.done: Set[str] = {chosen}
+        self.preempts = preempts       # preemptions along prefix incl. this
+        self.label = label             # the chosen op (repr) at this depth
+
+
+def _is_preemption(prev: Optional[str], choice: str,
+                   enabled: Tuple[str, ...],
+                   prev_label: Optional[str]) -> bool:
+    """A switch counts against the preemption bound only when it takes
+    the scheduler away from a thread that could have continued AND that
+    thread had started running its body — switching after a `begin`
+    step orders thread starts (real-scheduler nondeterminism), it does
+    not preempt any user code."""
+    return (prev is not None and prev != choice and prev in enabled
+            and not (prev_label or "").startswith("begin:"))
+
+
+def explore(scenario: Scenario,
+            max_executions: Optional[int] = None,
+            preemption_bound: Optional[int] = None) -> ExploreResult:
+    """DPOR exploration of the scenario's schedule space.
+
+    Runs executions until the reduced space is exhausted (``complete``)
+    or the execution budget is spent. The first failing execution stops
+    exploration and becomes the counterexample."""
+    budget = max_executions if max_executions is not None \
+        else scenario.max_executions
+    bound = preemption_bound if preemption_bound is not None \
+        else scenario.preemption_bound
+    result = ExploreResult(scenario.name)
+    nodes: List[_Node] = []
+    forced: List[str] = []
+
+    while True:
+        run, failure = _execute(scenario, forced)
+        result.executions += 1
+        result.steps_total += len(run.steps)
+
+        # a re-branched node's label is stale until its forced execution
+        # runs — refresh from the steps actually taken this round
+        for i in range(min(len(nodes), len(run.steps))):
+            nodes[i].label = run.steps[i][1]
+
+        # append fresh nodes for the suffix this execution discovered
+        for i in range(len(nodes), len(run.steps)):
+            tname, label = run.steps[i]
+            enabled = run.enabled_log[i]
+            prev = run.steps[i - 1][0] if i else None
+            prev_label = run.steps[i - 1][1] if i else None
+            base = nodes[i - 1].preempts if i else 0
+            nodes.append(_Node(
+                enabled, tname,
+                base + int(_is_preemption(prev, tname, enabled,
+                                          prev_label)),
+                label))
+
+        # DPOR: seed backtrack sets from the last dependent access
+        last_by_key: Dict[int, List[Tuple[int, str, str]]] = {}
+        for i, key, mode, tname in run.dep_log:
+            hist = last_by_key.setdefault(key, [])
+            for j, jmode, jname in reversed(hist):
+                if jname == tname:
+                    continue
+                if jmode == "r" and mode == "r":
+                    continue
+                if tname in nodes[j].enabled:
+                    nodes[j].backtrack.add(tname)
+                else:
+                    nodes[j].backtrack.update(nodes[j].enabled)
+                break
+            hist.append((i, mode, tname))
+
+        if failure is not None:
+            result.counterexample = Counterexample(
+                scenario.name, [tname for tname, _ in run.steps],
+                run.steps, failure)
+            return result
+
+        if result.executions >= budget:
+            return result
+
+        # deepest node with an unexplored, bound-feasible backtrack choice
+        pick: Optional[Tuple[int, str]] = None
+        for i in range(len(nodes) - 1, -1, -1):
+            node = nodes[i]
+            cands = sorted((node.backtrack & set(node.enabled))
+                           - node.done)
+            for c in cands:
+                if bound is not None:
+                    prev = nodes[i - 1].chosen if i else None
+                    prev_label = nodes[i - 1].label if i else None
+                    base = nodes[i - 1].preempts if i else 0
+                    if base + int(_is_preemption(prev, c, node.enabled,
+                                                 prev_label)) > bound:
+                        node.done.add(c)
+                        result.bound_pruned += 1
+                        continue
+                pick = (i, c)
+                break
+            if pick is not None:
+                break
+        if pick is None:
+            result.complete = True
+            return result
+        depth, choice = pick
+        node = nodes[depth]
+        node.chosen = choice
+        node.done.add(choice)
+        prev = nodes[depth - 1].chosen if depth else None
+        prev_label = nodes[depth - 1].label if depth else None
+        base = nodes[depth - 1].preempts if depth else 0
+        node.preempts = base + int(
+            _is_preemption(prev, choice, node.enabled, prev_label))
+        del nodes[depth + 1:]
+        forced = [nodes[k].chosen for k in range(depth + 1)]

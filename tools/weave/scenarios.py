@@ -1,0 +1,897 @@
+"""The checked production scenarios — weave's reason to exist.
+
+Each scenario drives REAL production code (epoch.py, trace.py, dra.py,
+brokeripc.py, fleetsim.py, resilience.py, allocate.py) under the
+cooperative scheduler and asserts a cross-thread protocol invariant
+over EVERY explored interleaving. Scenarios come in pairs:
+
+- the production scenario must pass (complete or stated-bounded
+  exploration, zero counterexamples);
+- its TWIN seeds a concurrency bug of exactly the class the invariant
+  guards against (a forgotten notify, a torn seqlock write, a TOCTOU
+  CAS, an ACK before durability) and must FAIL — a checker that cannot
+  fire is a failing test (tests/test_weave.py enforces both directions,
+  and `python -m tools.weave --twins` runs the mutation side in CI).
+
+Scenario bodies construct their objects inside ``setup`` so the locks
+and conditions production __init__ code creates are the cooperative
+shims; module-level primitives (trace._maintenance_lock, faults._lock)
+stay real, which is safe because no schedule point sits inside their
+critical sections (see trace.Histogram._claim_cell).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import threading
+import time
+from bisect import bisect_right
+from typing import Any, Callable, Dict, List, Optional, Tuple, Type
+
+from tools.weave.core import Scenario
+from tpu_device_plugin import schedcheck
+from tpu_device_plugin.allocate import LiveAttrReader
+from tpu_device_plugin.brokeripc import (RING_DEFAULT_TTL_S, RingReader,
+                                         RingWriter, ring_key,
+                                         _json_bytes, _RING_HEADER_PAD,
+                                         _RING_SLOT_HDR)
+from tpu_device_plugin.dra import DraDriver
+from tpu_device_plugin.epoch import AtomicCounter, Epoch, EpochStore
+from tpu_device_plugin.fleetsim import FleetApiServer
+from tpu_device_plugin.resilience import CircuitBreaker
+from tpu_device_plugin.trace import Histogram
+
+
+# =====================================================================
+# 1. epoch publish vs ListAndWatch waiter
+# =====================================================================
+
+class EpochPublishWaiter(Scenario):
+    """A writer publishes epoch 1 while a ListAndWatch-style waiter
+    parks on the store condition. No schedule may lose the wakeup (the
+    wait is untimed, so a lost notify is a detected deadlock) and the
+    woken waiter must observe the published payload, never a stale
+    epoch-0 view."""
+
+    name = "epoch-publish-waiter"
+    description = "epoch publish vs parked ListAndWatch waiter"
+
+    PAYLOAD = b"lw-payload-gen-1"
+
+    def setup(self) -> Dict[str, Any]:
+        store = EpochStore(Epoch(0))
+        return {"store": store, "seen": [], "woke": []}
+
+    def _publish(self, store: EpochStore) -> None:
+        ep = Epoch(1, lw_payload=self.PAYLOAD)
+        with store.lock():
+            store.publish_locked(ep)
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        store = state["store"]
+
+        def writer() -> None:
+            self._publish(store)
+
+        def waiter() -> None:
+            woke = store.wait_for(lambda: store.current.epoch_id >= 1)
+            state["woke"].append(woke)
+            state["seen"].append(store.current.lw_payload)
+
+        return [("writer", writer), ("waiter", waiter)]
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        store = state["store"]
+        assert state["woke"] == [True], f"waiter never woke: {state}"
+        assert state["seen"] == [self.PAYLOAD], \
+            f"stale payload observed: {state['seen']!r}"
+        assert store.publishes.value == 1
+        assert store.waiters == 0, "waiter gauge leaked"
+
+
+class EpochPublishNoNotifyTwin(EpochPublishWaiter):
+    """SEEDED BUG twin: the writer swaps the epoch pointer without the
+    notify_all — the classic forgotten wakeup. Weave must find the
+    schedule where the waiter parks first and starves (deadlock)."""
+
+    name = "twin-epoch-publish-no-notify"
+    twin_of = "epoch-publish-waiter"
+
+    def _publish(self, store: EpochStore) -> None:
+        ep = Epoch(1, lw_payload=self.PAYLOAD)
+        with store.lock():
+            # seeded bug: publish without waking the waiters (setattr so
+            # tsalint's epoch-mutation rule, which polices production
+            # writers, is not what this deliberately-broken twin tests)
+            setattr(store, "current", ep)
+            store.publishes.add()
+
+
+# =====================================================================
+# 2. counter / histogram shard adoption vs concurrent observe
+# =====================================================================
+
+class CounterShardObserve(Scenario):
+    """Two threads each count one event through AtomicCounter (each
+    adopts its own shard on first add) while a reader sums a snapshot
+    mid-flight. The mid-read may be anything from 0 to 2 but the final
+    sum must be exactly 2 — the sharded design's whole claim."""
+
+    name = "counter-shard-observe"
+    description = "AtomicCounter shard adoption vs concurrent value read"
+
+    def setup(self) -> Dict[str, Any]:
+        return {"counter": AtomicCounter(), "mid": []}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        c = state["counter"]
+
+        def bump() -> None:
+            c.add()
+
+        def reader() -> None:
+            state["mid"].append(c.value)
+
+        return [("add-1", bump), ("add-2", bump), ("reader", reader)]
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        final = state["counter"].value
+        assert final == 2, f"lost count: final={final}"
+        mid = state["mid"][0]
+        assert 0 <= mid <= 2, f"impossible mid-read {mid}"
+
+
+class _LossyTotalCounter:
+    """SEEDED BUG: the store-last-total counter design the AtomicCounter
+    docstring warns against — a shared read-modify-write total."""
+
+    def __init__(self) -> None:
+        self._total = 0
+
+    def add(self) -> None:
+        schedcheck.yield_point("lossy.counter.read", obj=self, mode="r")
+        total = self._total
+        schedcheck.yield_point("lossy.counter.write", obj=self)
+        self._total = total + 1
+
+    @property
+    def value(self) -> int:
+        schedcheck.yield_point("lossy.counter.snapshot", obj=self,
+                               mode="r")
+        return self._total
+
+
+class CounterLostUpdateTwin(CounterShardObserve):
+    """SEEDED BUG twin: swap in the lossy shared-total counter. Weave
+    must find the read-read-write-write schedule where one count is
+    lost (final == 1)."""
+
+    name = "twin-counter-lost-update"
+    twin_of = "counter-shard-observe"
+
+    def setup(self) -> Dict[str, Any]:
+        return {"counter": _LossyTotalCounter(), "mid": []}
+
+
+class HistogramAdoptObserve(Scenario):
+    """Two observers race shard adoption on a Histogram that holds one
+    dead-owner cell (a retired checkpoint-writer thread's shard, with
+    counts already in it) while a scraper snapshots mid-flight. The
+    adopted shard's history must never be lost and the final snapshot
+    must count every observation exactly once."""
+
+    name = "histogram-adopt-observe"
+    description = "Histogram dead-shard adoption vs concurrent snapshot"
+
+    class _DeadOwner:
+        def is_alive(self) -> bool:
+            return False
+
+    def setup(self) -> Dict[str, Any]:
+        h = Histogram("tdp_weave_scenario_ms", "weave scenario fixture",
+                      bounds=(1.0,))
+        # one retired shard with history: 5 observations totalling 2.5ms
+        h._cells.append([self._DeadOwner(), [5, 0, 2.5]])
+        return {"hist": h, "mid": []}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        h = state["hist"]
+
+        def observe() -> None:
+            h.observe(0.5)
+
+        def scraper() -> None:
+            state["mid"].append(h.snapshot())
+
+        return [("obs-1", observe), ("obs-2", observe),
+                ("scraper", scraper)]
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        final = state["hist"].snapshot()
+        assert final["count"] == 7, \
+            f"lost count: {final['count']} != 7 (5 adopted + 2 new)"
+        assert abs(final["sum"] - 3.5) < 1e-9, f"lost sum: {final['sum']}"
+        mid = state["mid"][0]
+        assert 5 <= mid["count"] <= 7, \
+            f"impossible mid-scrape count {mid['count']}"
+        # derived-count consistency: buckets can never exceed +Inf
+        assert mid["buckets"][-1][1] <= mid["count"]
+
+
+class _RacyAdoptHistogram(Histogram):
+    """SEEDED BUG: shard adoption without the maintenance lock — two
+    threads can both pass the dead-owner check and adopt the SAME cell.
+    The per-bucket `cell[i] += 1` is only safe because ownership is
+    exclusive, so with a shared cell the C-level read-modify-write
+    (modeled here by the split around the schedule point) loses counts."""
+
+    def _claim_cell(self) -> list:
+        me = threading.current_thread()
+        for entry in self._cells:
+            schedcheck.yield_point("twin.hist.scan", obj=self, mode="r")
+            if not entry[0].is_alive():
+                # seeded bug: dead-check and adopt-write in different
+                # steps, no lock — both observers adopt this shard
+                schedcheck.yield_point("twin.hist.adopt", obj=self)
+                entry[0] = me
+                return entry[1]
+        cell = [0] * (len(self.bounds) + 1) + [0.0]
+        self._cells.append([me, cell])
+        return cell
+
+    def observe(self, value_ms: float,
+                exemplar: Optional[str] = None) -> None:
+        cell = self._claim_cell()
+        i = bisect_right(self.bounds, value_ms)
+        schedcheck.yield_point("twin.hist.read", obj=self, mode="r")
+        count, total = cell[i], cell[-1]
+        schedcheck.yield_point("twin.hist.write", obj=self)
+        cell[i] = count + 1
+        cell[-1] = total + value_ms
+
+
+class HistogramDoubleAdoptTwin(HistogramAdoptObserve):
+    """SEEDED BUG twin: the unlocked-adoption histogram above. Weave
+    must find the schedule where both observers adopt the one dead
+    shard and a count is lost to the shared-cell read-modify-write."""
+
+    name = "twin-histogram-double-adopt"
+    twin_of = "histogram-adopt-observe"
+
+    def setup(self) -> Dict[str, Any]:
+        h = _RacyAdoptHistogram("tdp_weave_scenario_ms",
+                                "weave scenario fixture", bounds=(1.0,))
+        h._cells.append([self._DeadOwner(), [5, 0, 2.5]])
+        return {"hist": h, "mid": []}
+
+
+# =====================================================================
+# 3. dra group-commit writer vs claim mutations vs flush barrier
+# =====================================================================
+
+def _minimal_dra_driver(checkpoint_path: str) -> DraDriver:
+    """A DraDriver stripped to its group-commit plane: enough real
+    attributes for _claim_task / _checkpoint_flush / the writer loop to
+    run unmodified. Built via __new__ so setup stays O(checkpoint) —
+    the full __init__ wants sockets, inventory and kubelet plumbing."""
+    drv = object.__new__(DraDriver)
+    drv._lock = threading.Lock()
+    drv._ckpt_cond = threading.Condition()
+    drv._ckpt_dirty_gen = 0
+    drv._ckpt_result_gen = 0
+    drv._ckpt_durable_gen = 0
+    drv._ckpt_pending_claims = 0
+    drv._ckpt_failures = []
+    drv._ckpt_error = None
+    drv._ckpt_stopped = False
+    drv._ckpt_thread = None
+    drv._attach_active = 0
+    drv._prepare_inflight = 0
+    drv._checkpoint = {}
+    drv._handoffs = {}
+    drv._checkpoint_bytes = 0
+    drv.checkpoint_path = checkpoint_path
+    drv.checkpoint_commit_window_s = 0.010
+    drv.checkpoint_stats_counters = {
+        "checkpoint_commits_total": 0,
+        "checkpoint_claims_coalesced_total": 0,
+    }
+    # the scenario runs the writer as an explicit controlled thread
+    drv._ensure_checkpoint_writer_locked = lambda: None
+    drv._recompute_fragmentation = lambda: None
+    return drv
+
+
+class DraGroupCommit(Scenario):
+    """Two claims bracket real attach work (_claim_task), mutate the
+    checkpoint under the driver lock, and hit the real flush barrier
+    while the REAL _checkpoint_writer_loop group-commits. Every
+    schedule must ACK both claims exactly once, only after their
+    mutation is durable on disk, and drain the in-flight gauges."""
+
+    name = "dra-group-commit"
+    description = "group-commit writer vs claim mutations vs flush barrier"
+    # quick matrix: preemption bound 1 completes in ~1s (condition-plane
+    # switches at blocking points are free — only body preemptions
+    # count); the soak leg (+1 bound, x25 budget) exhausts bound 2
+    max_executions = 6000
+    preemption_bound = 1
+
+    def setup(self) -> Dict[str, Any]:
+        fd, path = tempfile.mkstemp(prefix="weave-ckpt-")
+        os.close(fd)
+        os.unlink(path)
+        drv = _minimal_dra_driver(path)
+        return {"drv": drv, "path": path, "acked": [], "errors": {}}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        drv = state["drv"]
+
+        def claim(i: int) -> Callable[[], None]:
+            def body() -> None:
+                with drv._claim_task() as task:
+                    with drv._lock:
+                        drv._checkpoint[f"claim{i}"] = {"devices": [i]}
+                    try:
+                        drv._checkpoint_flush(task)
+                    except BaseException as exc:
+                        with drv._lock:
+                            drv._checkpoint.pop(f"claim{i}", None)
+                        state["errors"][i] = exc
+                        return
+                state["acked"].append(i)
+            return body
+
+        return [("claim-0", claim(0)), ("claim-1", claim(1)),
+                ("writer", drv._checkpoint_writer_loop)]
+
+    def drain(self, state: Dict[str, Any]) -> None:
+        drv = state["drv"]
+        with drv._ckpt_cond:
+            drv._ckpt_stopped = True
+            drv._ckpt_cond.notify_all()
+        for leftover in (state["path"], state["path"] + ".tmp"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        drv = state["drv"]
+        assert sorted(state["acked"]) == [0, 1], \
+            f"claims not all ACKed: {state['acked']} " \
+            f"errors={state['errors']}"
+        assert not state["errors"], f"unexpected errors: {state['errors']}"
+        assert drv._ckpt_durable_gen == drv._ckpt_dirty_gen, \
+            "ACK released before the covering write was durable"
+        stats = drv.checkpoint_stats_counters
+        assert stats["checkpoint_claims_coalesced_total"] == 2
+        assert 1 <= stats["checkpoint_commits_total"] <= 2
+        assert drv._attach_active == 0 and drv._prepare_inflight == 0, \
+            "in-flight gauges leaked"
+
+
+class DraCommitFailure(DraGroupCommit):
+    """Same protocol with every checkpoint write FAILING (the
+    checkpoint directory does not exist): no schedule may ACK either
+    claim — both must see the write error through the failed-interval
+    scan, roll back, and still drain the gauges."""
+
+    name = "dra-commit-failure"
+    description = "failing group commit: error fan-out, never a false ACK"
+
+    def setup(self) -> Dict[str, Any]:
+        # the checkpoint "directory" is a regular file, so the write's
+        # os.makedirs fails deterministically on every attempt
+        fd, blocker = tempfile.mkstemp(prefix="weave-ckpt-blocker-")
+        os.close(fd)
+        path = os.path.join(blocker, "ckpt.json")
+        drv = _minimal_dra_driver(path)
+        return {"drv": drv, "path": path, "blocker": blocker,
+                "acked": [], "errors": {}}
+
+    def drain(self, state: Dict[str, Any]) -> None:
+        super().drain(state)
+        try:
+            os.unlink(state["blocker"])
+        except OSError:
+            pass
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        drv = state["drv"]
+        assert state["acked"] == [], \
+            f"claim ACKed despite failed commit: {state['acked']}"
+        assert sorted(state["errors"]) == [0, 1], \
+            f"claims did not all see the write error: {state['errors']}"
+        assert drv._ckpt_durable_gen == 0
+        assert drv._ckpt_result_gen == drv._ckpt_dirty_gen
+        assert drv._ckpt_failures, "failed attempt interval not recorded"
+        assert drv._attach_active == 0 and drv._prepare_inflight == 0, \
+            "in-flight gauges leaked"
+
+
+class DraAckBeforeDurableTwin(DraCommitFailure):
+    """SEEDED BUG twin: a flush barrier that releases on attempt
+    COMPLETION instead of durability (no failed-interval scan, no
+    durable-generation check) — with the write failing, every schedule
+    ACKs a claim whose checkpoint never reached disk."""
+
+    name = "twin-dra-ack-before-durable"
+    twin_of = "dra-commit-failure"
+
+    def setup(self) -> Dict[str, Any]:
+        state = super().setup()
+        drv = state["drv"]
+
+        def buggy_flush_impl(task: dict) -> None:
+            with drv._ckpt_cond:
+                drv._ckpt_dirty_gen += 1
+                drv._ckpt_pending_claims += 1
+                target = drv._ckpt_dirty_gen
+                if task.get("active"):
+                    task["active"] = False
+                    drv._attach_active -= 1
+                drv._ckpt_cond.notify_all()
+                while drv._ckpt_result_gen < target \
+                        and not drv._ckpt_stopped:
+                    drv._ckpt_cond.wait()
+                # seeded bug: "the writer ran" is treated as "my claim
+                # is durable" — no durable check, no failure scan
+
+        drv._checkpoint_flush_impl = buggy_flush_impl
+        return state
+
+
+# =====================================================================
+# 4. seqlock response ring: writer vs reader vs slot retirement
+# =====================================================================
+
+class RingSeqlock(Scenario):
+    """The broker overwrites a primed ring slot (retiring the old
+    payload) while the daemon-side reader does a seqlock-validated
+    lookup. Across every interleaving of the stamped C-atomic accesses
+    the reader must return one of the two published values whole, or
+    cleanly fall back (miss/torn/stale) — never a mixed payload."""
+
+    name = "ring-seqlock"
+    description = "seqlock ring writer vs reader vs slot retirement"
+
+    VAL_A = {"v": "AAAAAA"}
+    VAL_B = {"v": "BBBBBB"}
+
+    def _writer_cls(self) -> Type[RingWriter]:
+        return RingWriter
+
+    def setup(self) -> Dict[str, Any]:
+        w = self._writer_cls()(slots=1, slot_size=256)
+        key = ring_key("read_attr", "/sys/devices/tpu0/serial")
+        w.publish(key, self.VAL_A)          # primed: uncontended
+        rd = RingReader(w.fd)
+        return {"w": w, "rd": rd, "key": key, "obs": []}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        w, rd, key = state["w"], state["rd"], state["key"]
+
+        def writer() -> None:
+            w.publish(key, self.VAL_B)      # retire A, publish B
+
+        def reader() -> None:
+            value, status = rd.lookup(key, ttl_s=RING_DEFAULT_TTL_S)
+            state["obs"].append((status, value))
+
+        return [("writer", writer), ("reader", reader)]
+
+    def drain(self, state: Dict[str, Any]) -> None:
+        state["rd"].close()
+        state["w"].close()
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        (status, value), = state["obs"]
+        assert status in ("hit", "torn", "miss", "stale"), status
+        if status == "hit":
+            assert value in (self.VAL_A, self.VAL_B), \
+                f"mixed/garbage ring payload: {value!r}"
+        else:
+            assert value is None
+
+
+class _TornRingWriter(RingWriter):
+    """SEEDED BUG: publishes without the seqlock brackets — the body is
+    written in two visible halves under an even, unchanged sequence, so
+    a racing reader can validate a mixed payload as a hit."""
+
+    def publish(self, key: bytes, value: dict) -> bool:
+        val = _json_bytes(value)
+        off = _RING_HEADER_PAD      # slots=1: everything is slot 0
+        mm = self._mm
+        base = off + _RING_SLOT_HDR.size
+        split = max(0, len(val) - 3)
+        schedcheck.yield_point("ring.pub.body", key=f"ring.slot.{off}")
+        mm[base:base + len(key)] = key
+        mm[base + len(key):base + len(key) + split] = val[:split]
+        schedcheck.yield_point("ring.pub.body2", key=f"ring.slot.{off}")
+        mm[base + len(key) + split:base + len(key) + len(val)] = \
+            val[split:]
+        (seq,) = struct.unpack_from(">I", mm, off)
+        schedcheck.yield_point("ring.pub.seq_even",
+                               key=f"ring.slot.{off}")
+        _RING_SLOT_HDR.pack_into(mm, off, (seq + 2) & 0xFFFFFFFF,
+                                 len(key), len(val), time.monotonic())
+        self.published += 1
+        return True
+
+
+class RingTornWriteTwin(RingSeqlock):
+    """SEEDED BUG twin: the torn writer above. Weave must find the
+    schedule where the reader returns a half-A half-B payload as a
+    validated hit."""
+
+    name = "twin-ring-torn-write"
+    twin_of = "ring-seqlock"
+
+    def _writer_cls(self) -> Type[RingWriter]:
+        return _TornRingWriter
+
+
+# =====================================================================
+# 5. CAS placement commit race
+# =====================================================================
+
+def _minimal_fleet_server() -> FleetApiServer:
+    """A FleetApiServer stripped to the placement-CAS plane (the full
+    __init__ binds a socket and starts a serve thread)."""
+    srv = object.__new__(FleetApiServer)
+    srv._lock = threading.Lock()
+    srv.commit_crossing_s = 0.0
+    srv.multiclaims = {}
+    srv.multiclaim_log = []
+    srv.placement_log = []
+    srv.node_placements = {}
+    srv.node_placement_gens = {}
+    srv.slices = {}
+    srv._slices_by_node = {}
+    srv.stats = {"placement_conflicts_total": 0,
+                 "commit_rounds_total": 0}
+    return srv
+
+
+class PlacementCasRace(Scenario):
+    """Two schedulers planned the same chip against the same observed
+    placement generation and race their CAS commits. Every schedule
+    must produce exactly one winner, a counted clean conflict for the
+    loser, and an audit log with exactly one commit."""
+
+    name = "placement-cas-race"
+    description = "CAS placement commit race: at most one winner"
+
+    def _make_server(self) -> FleetApiServer:
+        return _minimal_fleet_server()
+
+    def setup(self) -> Dict[str, Any]:
+        srv = self._make_server()
+        for uid in ("claim-a", "claim-b"):
+            srv.multiclaim_begin(uid, shape=[1, 1],
+                                 shards=[("node-0", ["tpu-chip-0"])])
+        return {"srv": srv, "res": {}}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        srv = state["srv"]
+
+        def committer(uid: str) -> Callable[[], None]:
+            def body() -> None:
+                state["res"][uid] = srv.multiclaim_commit(
+                    uid, observed={"node-0": 0})
+            return body
+
+        return [("sched-a", committer("claim-a")),
+                ("sched-b", committer("claim-b"))]
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        srv, res = state["srv"], state["res"]
+        wins = sorted(u for u, r in res.items() if r.get("committed"))
+        assert len(wins) == 1, f"CAS let {len(wins)} committers win: {res}"
+        loser = next(u for u in res if u != wins[0])
+        assert res[loser]["conflicts"] == ["node-0"], res[loser]
+        commits = [e for e in srv.multiclaim_log if e[2] == "commit"]
+        assert len(commits) == 1, \
+            f"audit log shows {len(commits)} commits"
+        assert srv.node_placements["node-0"] == {"tpu-chip-0": wins[0]}
+        assert srv.node_placement_gens["node-0"] == 1
+        assert srv.stats["placement_conflicts_total"] == 1
+
+
+class _ToctouFleetServer(FleetApiServer):
+    """SEEDED BUG: the CAS check and the apply run in separate lock
+    crossings with a schedule point between — both racers can pass the
+    check before either applies."""
+
+    def multiclaim_commit_batch(self, commits) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for uid, observed in commits:
+            with self._lock:
+                rec = self.multiclaims[uid]
+                conflicts = sorted({
+                    node for node, raws in rec["shards"]
+                    if observed.get(node, 0)
+                    != self.node_placement_gens.get(node, 0)
+                    or any(r in (self.node_placements.get(node) or {})
+                           for r in raws)})
+            if conflicts:
+                with self._lock:
+                    self.stats["placement_conflicts_total"] += 1
+                    self.multiclaim_log.append(
+                        (time.monotonic(), uid, "conflict", conflicts))
+                out[uid] = {"committed": False, "conflicts": conflicts,
+                            "gens": dict(self.node_placement_gens)}
+                continue
+            schedcheck.yield_point("twin.cas.toctou", obj=self)
+            with self._lock:
+                rec["phase"] = "committed"
+                self.multiclaim_log.append(
+                    (time.monotonic(), uid, "commit", None))
+                gens: Dict[str, int] = {}
+                for node, raws in rec["shards"]:
+                    owners = self.node_placements.setdefault(node, {})
+                    for r in raws:
+                        owners[r] = uid
+                    gen = self.node_placement_gens.get(node, 0) + 1
+                    self.node_placement_gens[node] = gen
+                    gens[node] = gen
+                out[uid] = {"committed": True, "gens": gens}
+        return out
+
+
+class PlacementToctouTwin(PlacementCasRace):
+    """SEEDED BUG twin: check/apply split across lock crossings —
+    weave must find the double-commit."""
+
+    name = "twin-placement-toctou"
+    twin_of = "placement-cas-race"
+
+    def _make_server(self) -> FleetApiServer:
+        srv = object.__new__(_ToctouFleetServer)
+        srv._lock = threading.Lock()
+        srv.commit_crossing_s = 0.0
+        srv.multiclaims = {}
+        srv.multiclaim_log = []
+        srv.placement_log = []
+        srv.node_placements = {}
+        srv.node_placement_gens = {}
+        srv.slices = {}
+        srv._slices_by_node = {}
+        srv.stats = {"placement_conflicts_total": 0,
+                     "commit_rounds_total": 0}
+        return srv
+
+
+# =====================================================================
+# 6. circuit-breaker half-open probe race
+# =====================================================================
+
+class BreakerHalfOpenProbe(Scenario):
+    """A tripped breaker past its cooldown faces two simultaneous
+    callers. Exactly one may receive the half-open probe; the loser is
+    rejected and counted. (The breaker's injectable clock is bound to
+    the virtual clock, and both callers sleep past the cooldown — the
+    quiescence-only clock advance makes the window race exact.)"""
+
+    name = "breaker-half-open-probe"
+    description = "half-open window: exactly one probe"
+
+    def _make_breaker(self) -> CircuitBreaker:
+        return CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05,
+                              clock=time.monotonic, name="weave")
+
+    def setup(self) -> Dict[str, Any]:
+        br = self._make_breaker()
+        br.record_failure()                  # trip: closed -> open
+        return {"br": br, "allowed": []}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        br = state["br"]
+
+        def caller(tag: str) -> Callable[[], None]:
+            def body() -> None:
+                time.sleep(0.1)              # ride past the cooldown
+                state["allowed"].append((tag, br.allow()))
+            return body
+
+        return [("probe-a", caller("a")), ("probe-b", caller("b"))]
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        br = state["br"]
+        granted = [tag for tag, ok in state["allowed"] if ok]
+        assert len(granted) == 1, \
+            f"half-open window granted {len(granted)} probes: " \
+            f"{state['allowed']}"
+        assert br.half_open_rejected == 1, br.snapshot()
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert run.clock.advances >= 1, "cooldown never elapsed"
+
+
+class _LeakyProbeBreaker(CircuitBreaker):
+    """SEEDED BUG: the open->half-open transition checks the cooldown
+    OUTSIDE the lock, then transitions in a second crossing."""
+
+    def allow(self) -> bool:
+        with self._lock:
+            st = self._state
+            opened = self._opened_at
+        if st == self.CLOSED:
+            return True
+        if st == self.OPEN \
+                and self._clock() - opened >= self.reset_timeout_s:
+            schedcheck.yield_point("twin.breaker.toctou", obj=self)
+            with self._lock:
+                self._state = self.HALF_OPEN
+                self._probe_owner = threading.get_ident()
+            return True
+        with self._lock:
+            self.rejected += 1
+            if st == self.HALF_OPEN:
+                self.half_open_rejected += 1
+        return False
+
+
+class BreakerDoubleProbeTwin(BreakerHalfOpenProbe):
+    """SEEDED BUG twin: both callers pass the unlocked cooldown check
+    before either claims the window — two probes escape."""
+
+    name = "twin-breaker-double-probe"
+    twin_of = "breaker-half-open-probe"
+
+    def _make_breaker(self) -> CircuitBreaker:
+        return _LeakyProbeBreaker(failure_threshold=1,
+                                  reset_timeout_s=0.05,
+                                  clock=time.monotonic, name="weave")
+
+
+# =====================================================================
+# 7. LiveAttrReader stat -> pread -> recheck vs entry swap (ABA)
+# =====================================================================
+
+class LiveAttrSwapRace(Scenario):
+    """The lock-free attr fast path races a file replace + record swap
+    + fd close, with the freed fd number deliberately RECYCLED onto an
+    unrelated file (os.dup2 — the ABA the record recheck exists for).
+    Every schedule must return the old bytes, the new bytes, or fall
+    back; the recycled fd's bytes must never escape."""
+
+    name = "liveattr-swap-race"
+    description = "LiveAttrReader fast path vs entry swap + fd recycle"
+
+    OLD, NEW, EVIL = b"OLD!", b"NEW!", b"EVIL"
+
+    def _make_reader(self) -> LiveAttrReader:
+        return LiveAttrReader()
+
+    def setup(self) -> Dict[str, Any]:
+        def mkfile(content: bytes) -> str:
+            fd, path = tempfile.mkstemp(prefix="weave-attr-")
+            os.write(fd, content)
+            os.close(fd)
+            return path
+
+        path = mkfile(self.OLD)
+        newpath = mkfile(self.NEW)
+        decoy_fd = os.open(mkfile(self.EVIL), os.O_RDONLY)
+        rd = self._make_reader()
+        primed = rd.read("serial", path)
+        assert primed == self.OLD
+        old_fd = rd._fds["serial"][0]
+        return {"rd": rd, "path": path, "newpath": newpath,
+                "decoy_fd": decoy_fd, "old_fd": old_fd, "got": []}
+
+    def threads(self, state: Dict[str, Any]
+                ) -> List[Tuple[str, Callable[[], None]]]:
+        rd = state["rd"]
+
+        def reader() -> None:
+            state["got"].append(rd.read("serial", state["path"]))
+
+        def swapper() -> None:
+            os.replace(state["newpath"], state["path"])
+            state["swapped"] = rd.read("serial", state["path"])
+            # the freed fd number comes back as an UNRELATED file — the
+            # ABA hazard the fast path's record recheck must survive
+            schedcheck.yield_point("attr.fd.recycle", obj=rd)
+            os.dup2(state["decoy_fd"], state["old_fd"])
+
+        return [("reader", reader), ("swapper", swapper)]
+
+    def drain(self, state: Dict[str, Any]) -> None:
+        for rec in list(state["rd"]._fds.values()):
+            try:
+                os.close(rec[0])
+            except OSError:
+                pass
+        state["rd"]._fds.clear()
+        for fd in (state["decoy_fd"], state["old_fd"]):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        for path in (state["path"], state["newpath"]):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def invariant(self, state: Dict[str, Any], run: Any) -> None:
+        got, = state["got"]
+        assert got in (self.OLD, self.NEW), \
+            f"recycled-fd bytes escaped the fast path: {got!r}"
+        assert state["swapped"] == self.NEW
+
+
+class _NoRecheckReader(LiveAttrReader):
+    """SEEDED BUG: the fast path without the record recheck — the
+    pre-recheck design whose fd-reuse hole the class docstring
+    documents."""
+
+    def read(self, key: str, path: str) -> Optional[bytes]:
+        schedcheck.yield_point("attr.read.lookup", obj=self, mode="r")
+        rec = self._fds.get(key)
+        if rec is not None:
+            fd, dev, ino = rec
+            try:
+                st = os.stat(path)
+                if (st.st_dev, st.st_ino) == (dev, ino):
+                    schedcheck.yield_point("attr.read.pread", obj=self,
+                                           mode="r")
+                    raw = os.pread(fd, 256, 0)
+                    if raw:        # seeded bug: no record recheck
+                        return raw
+            except OSError:
+                pass
+        return self._read_slow(key, path, rec)
+
+
+class LiveAttrAbaTwin(LiveAttrSwapRace):
+    """SEEDED BUG twin: drop the record recheck — weave must find the
+    stat/swap/recycle/pread schedule where the decoy bytes escape."""
+
+    name = "twin-liveattr-aba"
+    twin_of = "liveattr-swap-race"
+
+    def _make_reader(self) -> LiveAttrReader:
+        return _NoRecheckReader()
+
+
+# =====================================================================
+# registry
+# =====================================================================
+
+SCENARIOS: Dict[str, Type[Scenario]] = {
+    s.name: s for s in (
+        EpochPublishWaiter,
+        CounterShardObserve,
+        HistogramAdoptObserve,
+        DraGroupCommit,
+        DraCommitFailure,
+        RingSeqlock,
+        PlacementCasRace,
+        BreakerHalfOpenProbe,
+        LiveAttrSwapRace,
+    )}
+
+TWINS: Dict[str, Type[Scenario]] = {
+    s.name: s for s in (
+        EpochPublishNoNotifyTwin,
+        CounterLostUpdateTwin,
+        HistogramDoubleAdoptTwin,
+        DraAckBeforeDurableTwin,
+        RingTornWriteTwin,
+        PlacementToctouTwin,
+        BreakerDoubleProbeTwin,
+        LiveAttrAbaTwin,
+    )}

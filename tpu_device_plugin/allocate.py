@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import broker
 from . import lockdep
+from . import schedcheck
 from . import trace
 from .config import Config
 from .epoch import AtomicCounter, encode_delimited
@@ -108,16 +109,21 @@ class LiveAttrReader:
     def read(self, key: str, path: str) -> Optional[bytes]:
         """Fresh non-empty bytes of `path` (cached fd keyed by `key`);
         None if gone/unreadable/empty."""
+        schedcheck.yield_point("attr.read.lookup", obj=self, mode="r")
         rec = self._fds.get(key)          # GIL-atomic; no lock
         if rec is not None:
             fd, dev, ino = rec
             try:
                 st = os.stat(path)
                 if (st.st_dev, st.st_ino) == (dev, ino):
+                    schedcheck.yield_point("attr.read.pread", obj=self,
+                                           mode="r")
                     raw = os.pread(fd, 256, 0)
                     # record recheck (class docstring): replaces swap the
                     # dict entry before closing the fd, so rec still
                     # being cached proves no close raced the pread
+                    schedcheck.yield_point("attr.read.recheck", obj=self,
+                                           mode="r")
                     if raw and self._fds.get(key) is rec:
                         return raw
             except OSError:
@@ -155,6 +161,7 @@ class LiveAttrReader:
                 # ORDERING CONTRACT: the dict swap (here, under the lock)
                 # happens-before the close below — the fast path's record
                 # recheck relies on it
+                schedcheck.yield_point("attr.swap.install", obj=self)
                 self._fds[key] = rec
                 if prev is not None:
                     close_fd = prev[0]   # the replaced stale fd
@@ -164,6 +171,7 @@ class LiveAttrReader:
             # closing a replaced fd can race a concurrent fast-path pread
             # on it — that reader's record recheck discards the bytes
             # (the entry was already swapped), so the close is safe here
+            schedcheck.yield_point("attr.swap.close", obj=self)
             try:
                 os.close(close_fd)
             except OSError:

@@ -1450,8 +1450,23 @@ def spawn_broker(socket_path: str, root: str = "/",
     proc = subprocess.Popen(argv)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
+        # readiness = the broker ACCEPTS a connection, not just that the
+        # socket file exists: bind() creates the file before listen()
+        # runs, so an existence check can hand the caller a path whose
+        # first connect() is refused (seen as a flaky respawn under
+        # load). The probe connection is closed without a hello; the
+        # broker's accept loop tolerates that as a dead peer.
         if os.path.exists(socket_path):
-            return proc
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.settimeout(1.0)
+                probe.connect(socket_path)
+            except OSError:
+                pass
+            else:
+                return proc
+            finally:
+                probe.close()
         if proc.poll() is not None:
             raise BrokerUnavailable(
                 f"broker process exited rc={proc.returncode} before "

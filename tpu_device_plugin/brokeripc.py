@@ -87,8 +87,9 @@ import tempfile
 import time
 import zlib
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from . import schedcheck
 from .epoch import encode_delimited, encode_varint
 
 MAGIC = b"TDPB"          # v1 JSON framing
@@ -220,7 +221,7 @@ def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
             raise BrokerProtocolError("varint overflow in binary frame")
 
 
-def _json_bytes(value) -> bytes:
+def _json_bytes(value: object) -> bytes:
     return json.dumps(value, separators=_JSON_SEP,
                       sort_keys=True).encode("utf-8")
 
@@ -228,7 +229,7 @@ def _json_bytes(value) -> bytes:
 _US = "\x1f"
 
 
-def _encode_span(span) -> Optional[bytes]:
+def _encode_span(span: object) -> Optional[bytes]:
     """The canonical span_context() dict → compact US-joined payload, or
     None when the value is not that exact shape (then the catch-all
     record carries it with full JSON fidelity)."""
@@ -253,7 +254,7 @@ def _encode_span(span) -> Optional[bytes]:
     return text.encode("utf-8")
 
 
-def _decode_span(chunk: bytes) -> dict:
+def _decode_span(chunk: bytes) -> Dict[str, Any]:
     parts = chunk.decode("utf-8").split(_US)
     if len(parts) == 2:
         return {"op": parts[0], "seq": int(parts[1])}
@@ -263,7 +264,7 @@ def _decode_span(chunk: bytes) -> dict:
     raise ValueError(f"span context with {len(parts)} segments")
 
 
-def encode_body(obj: dict) -> bytes:
+def encode_body(obj: Dict[str, Any]) -> bytes:
     """One request/reply dict → compact binary records (no frame header).
     Total: decode_body(encode_body(obj)) == obj for every JSON-able dict
     (modulo None-valued keys, which both framings treat as absent)."""
@@ -306,11 +307,11 @@ def encode_body(obj: dict) -> bytes:
     return b"".join(parts)
 
 
-def decode_body(payload: bytes) -> dict:
+def decode_body(payload: bytes) -> Dict[str, Any]:
     """Binary records → the request/reply dict. Unknown tags are skipped
     by wire type (forward-compatible within v2); malformed records raise
     BrokerProtocolError."""
-    obj: dict = {}
+    obj: Dict[str, Any] = {}
     pos = 0
     n = len(payload)
     while pos < n:
@@ -394,7 +395,7 @@ class RequestEncoder:
         self._maxsize = maxsize
         self.static_hits = 0
 
-    def encode_frame(self, obj: dict) -> bytes:
+    def encode_frame(self, obj: Dict[str, Any]) -> bytes:
         # key on the UNSORTED item tuple: hot requests are built at one
         # construction site, so their key order repeats; two orderings
         # of the same operands just occupy two cache slots
@@ -439,7 +440,7 @@ class RequestEncoder:
 
 # ---------------------------------------------------------- frame codec
 
-def _encode(obj: dict, binary: bool = False) -> bytes:
+def _encode(obj: Dict[str, Any], binary: bool = False) -> bytes:
     if binary:
         payload = encode_body(obj)
         magic = BIN_MAGIC
@@ -453,7 +454,7 @@ def _encode(obj: dict, binary: bool = False) -> bytes:
     return magic + _LEN.pack(len(payload)) + payload
 
 
-def send_frame(sock: socket.socket, obj: dict,
+def send_frame(sock: socket.socket, obj: Dict[str, Any],
                fds: Tuple[int, ...] = (), binary: bool = False) -> None:
     """Send one frame; `fds` ride as SCM_RIGHTS on the first byte."""
     send_encoded(sock, _encode(obj, binary=binary), fds=fds)
@@ -555,7 +556,7 @@ def recv_frame_ex(sock: socket.socket, want_fds: int = 0,
     return obj, fds, binary
 
 
-def close_fds(fds) -> None:
+def close_fds(fds: Iterable[int]) -> None:
     """Best-effort close of received fds (error paths, unwanted extras)."""
     for fd in fds:
         try:
@@ -567,14 +568,14 @@ def close_fds(fds) -> None:
 # ------------------------------------------------------------ handshake
 
 def hello_request(seq: int = 0, version: int = PROTOCOL_VERSION,
-                  ring: bool = False) -> dict:
-    req = {"op": "hello", "seq": seq, "version": version}
+                  ring: bool = False) -> Dict[str, Any]:
+    req: Dict[str, Any] = {"op": "hello", "seq": seq, "version": version}
     if ring:
         req["ring"] = True
     return req
 
 
-def check_hello_reply(reply: dict,
+def check_hello_reply(reply: Dict[str, Any],
                       requested: int = PROTOCOL_VERSION) -> int:
     """Raise BrokerProtocolError unless the broker accepted a version we
     speak; returns the NEGOTIATED version (<= requested). A v1 broker
@@ -675,7 +676,7 @@ class RingWriter:
         self._mm = mmap.mmap(fd, size)
         _RING_HEADER.pack_into(self._mm, 0, RING_MAGIC, slots, slot_size)
 
-    def publish(self, key: bytes, value: dict) -> bool:
+    def publish(self, key: bytes, value: Dict[str, Any]) -> bool:
         """Publish one (key, value) into its hash slot; False when the
         entry cannot fit (counted, never truncated)."""
         val = _json_bytes(value)
@@ -689,17 +690,20 @@ class RingWriter:
         seq_odd = (seq + 1) & 0xFFFFFFFF
         if not seq_odd & 1:   # heal an even+1 landing even (wrap)
             seq_odd = (seq_odd + 1) & 0xFFFFFFFF
+        schedcheck.yield_point("ring.pub.seq_odd", key=f"ring.slot.{off}")
         struct.pack_into(">I", mm, off, seq_odd)
         _RING_SLOT_HDR.pack_into(mm, off, seq_odd, len(key), len(val),
                                  time.monotonic())
         base = off + _RING_SLOT_HDR.size
+        schedcheck.yield_point("ring.pub.body", key=f"ring.slot.{off}")
         mm[base:base + len(key)] = key
         mm[base + len(key):base + len(key) + len(val)] = val
+        schedcheck.yield_point("ring.pub.seq_even", key=f"ring.slot.{off}")
         struct.pack_into(">I", mm, off, (seq_odd + 1) & 0xFFFFFFFF)
         self.published += 1
         return True
 
-    def stats(self) -> dict:
+    def stats(self) -> Dict[str, Any]:
         return {"slots": self.slots, "slot_size": self.slot_size,
                 "published_total": self.published,
                 "skipped_oversize_total": self.skipped_oversize}
@@ -743,16 +747,24 @@ class RingReader:
         mm = self._mm
         off = _RING_HEADER_PAD + (zlib.crc32(key) % self.slots) \
             * self.slot_size
+        schedcheck.yield_point("ring.read.s1", mode="r",
+                               key=f"ring.slot.{off}")
         (s1,) = struct.unpack_from(">I", mm, off)
         if s1 == 0:
             return None, "miss"
         if s1 & 1:
             return None, "torn"
+        schedcheck.yield_point("ring.read.hdr", mode="r",
+                               key=f"ring.slot.{off}")
         _seq, key_len, val_len, ts = _RING_SLOT_HDR.unpack_from(mm, off)
         if _RING_SLOT_HDR.size + key_len + val_len > self.slot_size:
             return None, "torn"
         base = off + _RING_SLOT_HDR.size
+        schedcheck.yield_point("ring.read.body", mode="r",
+                               key=f"ring.slot.{off}")
         body = bytes(mm[base:base + key_len + val_len])
+        schedcheck.yield_point("ring.read.s2", mode="r",
+                               key=f"ring.slot.{off}")
         (s2,) = struct.unpack_from(">I", mm, off)
         if s2 != s1:
             return None, "torn"

@@ -1863,12 +1863,20 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                 cond.notify_all()
 
     @contextmanager
-    def _claim_task(self):
+    def _claim_task(self, admitted: Optional[list] = None):
         """Bracket one per-claim unit of attach work for the in-flight
-        gauges and the writer's commit window."""
+        gauges and the writer's commit window. `admitted` is the burst
+        pre-admission cell from _run_claim_tasks: when it still holds
+        slots, this task TAKES OVER one pre-admitted _attach_active slot
+        instead of incrementing again — the gauge counts each claim of
+        the burst exactly once, from RPC admission to its durability
+        barrier."""
         task = {"active": True}
         with self._ckpt_cond:
-            self._attach_active += 1
+            if admitted is not None and admitted[0] > 0:
+                admitted[0] -= 1
+            else:
+                self._attach_active += 1
             self._prepare_inflight += 1
         try:
             yield task
@@ -2512,6 +2520,10 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
         unprepare) so the per-claim span JOINS the trace that originally
         placed the claim — the cross-host migration waterfall."""
 
+        # Burst pre-admission cell (see below): slots pre-charged to
+        # _attach_active that pool workers take over one by one.
+        admitted = [0]
+
         def run_one(claim) -> Optional[str]:
             # Per-claim child span of the burst fan-out: runs on a pool
             # worker, so the claim context rides the span's own attrs
@@ -2522,7 +2534,7 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
                                 namespace=claim.namespace, name=claim.name,
                                 link=(link_for(claim) if link_for
                                       else None)), \
-                        self._claim_task() as tsk, \
+                        self._claim_task(admitted) as tsk, \
                         self._claim_lock(claim.uid):
                     fn(claim, tsk)
                 return None
@@ -2533,13 +2545,35 @@ class DraDriver(draapi.DraPluginServicer, draapi.PluginRegistrationServicer):
 
         if len(claims) <= 1 or self.prepare_workers <= 1:
             return [run_one(c) for c in claims]
+        # Pre-admit the WHOLE burst into _attach_active before handing it
+        # to the pool. _claim_task used to increment the gauge only when a
+        # pool worker STARTED its claim, so claims admitted in this RPC but
+        # not yet picked up were invisible to the writer's commit window —
+        # it saw attach_active drop to 0 after an early lone claim reached
+        # its barrier and committed just that one, splitting the burst
+        # across checkpoint writes (and letting a count=1 checkpoint.write
+        # fault error one claim while its siblings silently ACKed later).
+        # Each worker takes over a pre-admitted slot via `admitted`; any
+        # slots left if the pool dies mid-burst are released below so the
+        # gauge can't drift.
+        with self._ckpt_cond:
+            self._attach_active += len(claims)
+            admitted[0] = len(claims)
         try:
-            return list(self._prepare_pool.map(run_one, claims))
-        except RuntimeError:
-            # pool shut down under us (stop() racing a straggler RPC):
-            # degrade to the inline path — each claim still errors/answers
-            # individually instead of the RuntimeError failing the RPC
-            return [run_one(c) for c in claims]
+            try:
+                return list(self._prepare_pool.map(run_one, claims))
+            except RuntimeError:
+                # pool shut down under us (stop() racing a straggler RPC):
+                # degrade to the inline path — each claim still errors/
+                # answers individually instead of the RuntimeError failing
+                # the RPC
+                return [run_one(c) for c in claims]
+        finally:
+            with self._ckpt_cond:
+                leftover, admitted[0] = admitted[0], 0
+                if leftover:
+                    self._attach_active -= leftover
+                    self._ckpt_cond.notify_all()
 
     def _ack_segment(self, uid: str, devices: List[dict]) -> bytes:
         """Serialized NodePrepareResourceResponse payload for one prepared

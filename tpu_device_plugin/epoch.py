@@ -43,9 +43,10 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 from types import MappingProxyType
-from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, FrozenSet, List, Mapping,
+                    Optional, Sequence, Tuple)
 
-from . import lockdep
+from . import lockdep, schedcheck
 
 __all__ = ["AtomicCounter", "Epoch", "EpochStore", "InventoryEpoch",
            "build_inventory_epoch", "build_server_epoch",
@@ -106,7 +107,7 @@ class AtomicCounter:
 
     def __init__(self, start: int = 0) -> None:
         self._start = start
-        self._cells: list = []
+        self._cells: List[List[int]] = []
         self._local = threading.local()
 
     def add(self) -> None:
@@ -116,11 +117,14 @@ class AtomicCounter:
         cell = getattr(self._local, "cell", None)
         if cell is None:
             cell = self._local.cell = [0]
+            schedcheck.yield_point("epoch.counter.adopt", obj=self)
             self._cells.append(cell)   # C-atomic list append
+        schedcheck.yield_point("epoch.counter.bump", obj=self)
         cell[0] += 1                   # owner-thread only: exact
 
     @property
     def value(self) -> int:
+        schedcheck.yield_point("epoch.counter.snapshot", obj=self, mode="r")
         return self._start + sum(c[0] for c in list(self._cells))
 
 
@@ -164,8 +168,8 @@ class InventoryEpoch:
     by_name: Mapping[str, Tuple[str, str, Any]] = _EMPTY_MAP
     planners: Mapping[str, Any] = _EMPTY_MAP
     parent_planner: Any = None
-    unhealthy: frozenset = field(default_factory=frozenset)
-    departed: frozenset = field(default_factory=frozenset)
+    unhealthy: FrozenSet[str] = field(default_factory=frozenset)
+    departed: FrozenSet[str] = field(default_factory=frozenset)
 
 
 def build_server_epoch(epoch_id: int,
@@ -200,8 +204,9 @@ def build_inventory_epoch(epoch_id: int,
                           by_name: Mapping[str, Tuple[str, str, Any]],
                           planners: Mapping[str, Any],
                           parent_planner: Any,
-                          unhealthy: frozenset,
-                          departed: frozenset = frozenset()) -> InventoryEpoch:
+                          unhealthy: FrozenSet[str],
+                          departed: FrozenSet[str] = frozenset()
+                          ) -> InventoryEpoch:
     """The DRA inventory-epoch builder. The mappings are snapshotted into
     read-only views here so a writer that keeps mutating its working dict
     after publish cannot reach readers."""
@@ -250,6 +255,7 @@ class EpochStore:
         """Swap the current epoch and wake every waiter. Caller holds
         `lock()`; the swap itself is one attribute store, so readers on
         other threads switch epochs atomically."""
+        schedcheck.yield_point("epoch.publish.swap", obj=self)
         self.current = ep
         self.publishes.add()
         self._cond.notify_all()

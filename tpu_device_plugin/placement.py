@@ -50,7 +50,8 @@ from __future__ import annotations
 import itertools
 import logging
 from dataclasses import dataclass
-from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import (Any, Dict, FrozenSet, Iterator, List, Mapping,
+                    Optional, Sequence, Set, Tuple)
 
 from .topology import Coords, _boxes
 
@@ -77,7 +78,7 @@ class ShapeError(ValueError):
     working."""
 
 
-def parse_shape(text) -> Coords:
+def parse_shape(text: object) -> Coords:
     """"2x2x1" / "4" / [2, 2] → validated dims tuple (every axis >= 1,
     bounded by MAX_SHAPE_AXIS / MAX_SHAPE_VOLUME). Raises ShapeError
     (a ValueError) on anything degenerate — zero, negative, or
@@ -149,7 +150,7 @@ def selection_score(dims: Optional[Coords],
     return round(len(set(pts)) / cover, 4) if cover else 0.0
 
 
-def largest_fit(dims: Coords, avail: frozenset) -> int:
+def largest_fit(dims: Coords, avail: FrozenSet[Coords]) -> int:
     """Volume of the largest axis-aligned sub-box of `dims` whose every
     coordinate is in `avail` — the core of the fragmentation score and
     the best-fit tie-break."""
@@ -199,12 +200,12 @@ class HostView:
     dims: Coords
     coords: Mapping[str, Coords]
     names: Mapping[str, str]
-    free: frozenset
-    departed: frozenset
+    free: FrozenSet[str]
+    departed: FrozenSet[str]
     claims: Mapping[str, Tuple[str, ...]]
     host_coords: Optional[Coords] = None
 
-    def free_coords(self) -> frozenset:
+    def free_coords(self) -> FrozenSet[Coords]:
         return frozenset(self.coords[r] for r in self.free
                          if r in self.coords)
 
@@ -217,7 +218,7 @@ class HostView:
         return {c: raw for raw, c in self.coords.items()}
 
 
-def fragmentation(view: HostView) -> dict:
+def fragmentation(view: HostView) -> Dict[str, Any]:
     """The per-host fragmentation record /status + /metrics publish.
 
     score = 1 - largest_placeable_subbox / free. 0.0 when free capacity
@@ -261,7 +262,7 @@ class FragAggregate:
         self.fully_free_hosts = 0
         self._box_counts: Dict[int, int] = {}
 
-    def add(self, record: dict, fully_free: bool) -> None:
+    def add(self, record: Dict[str, Any], fully_free: bool) -> None:
         self.hosts += 1
         self.chips += record["chips"]
         self.free += record["free"]
@@ -271,7 +272,7 @@ class FragAggregate:
         box = record["largest_free_box"]
         self._box_counts[box] = self._box_counts.get(box, 0) + 1
 
-    def remove(self, record: dict, fully_free: bool) -> None:
+    def remove(self, record: Dict[str, Any], fully_free: bool) -> None:
         self.hosts -= 1
         self.chips -= record["chips"]
         self.free -= record["free"]
@@ -288,7 +289,7 @@ class FragAggregate:
     def largest_free_box(self) -> int:
         return max(self._box_counts, default=0)
 
-    def rollup(self, largest_free_mesh: int = 0) -> dict:
+    def rollup(self, largest_free_mesh: int = 0) -> Dict[str, Any]:
         """The exact cluster_fragmentation per-generation record shape
         (the mesh term is the caller's — it is a cross-host property no
         per-host delta can maintain)."""
@@ -363,7 +364,8 @@ class SlicePlan:
         return [(node, raw) for node, raws in self.shards for raw in raws]
 
 
-def _host_boxes(view: HostView, shape: Coords):
+def _host_boxes(view: HostView, shape: Coords
+                ) -> Iterator[Tuple[Tuple[str, ...], FrozenSet[Coords]]]:
     """Candidate placements of `shape` on one host: (raws, boxset) for
     every free axis-aligned box matching any orientation of the shape,
     in deterministic (orientation, position) order."""
@@ -428,7 +430,7 @@ def _mesh_window(counts: Coords, candidates: Sequence[HostView],
             at[tuple(v.host_coords)] = v
     if len(at) < volume(counts):
         return None
-    seen: set = set()
+    seen: Set[FrozenSet[Coords]] = set()
     for start in itertools.product(*[range(p) for p in pod_dims]):
         cells = tuple(itertools.product(
             *[tuple((s + k) % p for k in range(c))
@@ -604,7 +606,8 @@ def plan_slice(shape: Coords, views: Sequence[HostView],
 # ------------------------------------------------------------------ defrag
 
 
-def _box_candidates(shape: Coords, view: HostView):
+def _box_candidates(shape: Coords, view: HostView
+                    ) -> Iterator[Tuple[FrozenSet[Coords], FrozenSet[str]]]:
     """Defrag target candidates on one host: boxes of the shape whose
     every slot is free or claim-held. A box containing a DEPARTED hole
     (no silicon to migrate onto) or an unhealthy/untracked occupant (no
@@ -624,7 +627,7 @@ def _box_candidates(shape: Coords, view: HostView):
             continue
         if boxset & departed_coords:
             continue
-        blockers: set = set()
+        blockers: Set[str] = set()
         feasible = True
         for c in boxset:
             if c in free_coords:
@@ -638,8 +641,9 @@ def _box_candidates(shape: Coords, view: HostView):
             yield boxset, frozenset(blockers)
 
 
-def _destination(view: HostView, n: int, exclude: frozenset,
-                 reserved: set) -> Optional[Tuple[str, ...]]:
+def _destination(view: HostView, n: int, exclude: FrozenSet[Coords],
+                 reserved: Set[Tuple[str, Coords]]
+                 ) -> Optional[Tuple[str, ...]]:
     """`n` free slots on `view` outside `exclude` coords and not already
     `reserved` by an earlier migration of the same proposal — preferring
     a contiguous box of the migrated claim's size so defrag does not
@@ -663,7 +667,8 @@ def _destination(view: HostView, n: int, exclude: frozenset,
     return tuple(raw_at[c] for c in chosen)
 
 
-def propose_defrag(shape: Coords, views: Sequence[HostView]) -> dict:
+def propose_defrag(shape: Coords, views: Sequence[HostView]
+                   ) -> Dict[str, Any]:
     """The defrag advisory (docs/design.md "Slice placement" documents
     this format):
 
@@ -682,7 +687,7 @@ def propose_defrag(shape: Coords, views: Sequence[HostView]) -> dict:
     shape = parse_shape(shape)
     need = volume(shape)
     free_total = sum(len(v.free) for v in views)
-    out = {
+    out: Dict[str, Any] = {
         "shape": list(shape),
         "placeable": False,
         "satisfiable": free_total >= need,
@@ -712,12 +717,12 @@ def propose_defrag(shape: Coords, views: Sequence[HostView]) -> dict:
     by_free = sorted(views, key=lambda v: (-len(v.free), v.node))
     best_partial = None
     for _n, _chips, _node, _box, view, boxset, blockers in candidates:
-        reserved: set = set()
-        migrations = []
+        reserved: Set[Tuple[str, Coords]] = set()
+        migrations: List[Dict[str, Any]] = []
         resolved = True
         for uid in sorted(blockers):
             raws = view.claims[uid]
-            migration = {
+            migration: Dict[str, Any] = {
                 "claim": uid,
                 "source_node": view.node,
                 "devices": sorted(raws),

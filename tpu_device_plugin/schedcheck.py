@@ -1,0 +1,67 @@
+"""schedcheck — yield-point hooks for deterministic interleaving checking.
+
+The lock-free planes (epoch publish, trace shards, the seqlock response
+ring, CAS placement commit, the LiveAttrReader fast path) synchronize
+through C-atomic operations the interpreter guarantees, not through
+locks — so lockdep and tsalint cannot see their schedule points. This
+module marks them explicitly: production code calls
+
+    schedcheck.yield_point("epoch.publish.store", obj=self, mode="w")
+
+immediately before a C-atomic read or write that a concurrent protocol
+depends on. Disabled (always, in production), a yield point is one
+module-global bool check and a return — the zero-lock read-path gates
+and the r10 trace-overhead bench both run with the hooks in place and
+pin their budgets, which is the proof the no-op stays a no-op. Enabled
+(only inside tools/weave's cooperative scheduler), each yield point
+becomes a schedule point: the checker parks the calling thread there
+and enumerates every interleaving of the marked accesses.
+
+`obj` identifies the shared location (two yield points race only if
+they name the same location and at least one is a write); `mode` is
+"r" or "w" from the caller's perspective. When the shared location is
+not one Python object — the response ring's writer and reader are two
+objects mapping the same memory — pass an explicit string `key`
+instead; equal keys are the same location. A yield point with neither
+keys on its label alone — use only for points that race with every
+peer sharing the label.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["active", "install", "uninstall", "yield_point"]
+
+Hook = Callable[[str, Optional[object], str, Optional[str]], None]
+
+_ACTIVE = False
+_HOOK: Optional[Hook] = None
+
+
+def yield_point(label: str, obj: Optional[object] = None,
+                mode: str = "w", key: Optional[str] = None) -> None:
+    """Mark one C-atomic access as a schedule point (no-op unless a
+    checker installed a hook)."""
+    if not _ACTIVE:
+        return
+    hook = _HOOK
+    if hook is not None:
+        hook(label, obj, mode, key)
+
+
+def install(hook: Hook) -> None:
+    """Route every yield point through `hook` (the weave scheduler)."""
+    global _ACTIVE, _HOOK
+    _HOOK = hook
+    _ACTIVE = True
+
+
+def uninstall() -> None:
+    global _ACTIVE, _HOOK
+    _ACTIVE = False
+    _HOOK = None
+
+
+def active() -> bool:
+    return _ACTIVE

@@ -89,6 +89,7 @@ from bisect import bisect_right
 from collections import deque
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
+from . import schedcheck
 from .epoch import AtomicCounter
 
 log = logging.getLogger(__name__)
@@ -742,6 +743,12 @@ class Histogram:
 
     def _claim_cell(self) -> list:
         me = threading.current_thread()
+        # the schedule point sits OUTSIDE the maintenance lock: the lock
+        # is a real (never-virtualized) module-level primitive, so the
+        # interleaving checker must not park a thread while it is held —
+        # adopt-vs-adopt is serialized by the lock itself; what races is
+        # the claim as a whole against observe/snapshot on other shards
+        schedcheck.yield_point("trace.hist.claim", obj=self)
         with _maintenance_lock:
             for entry in self._cells:
                 if not entry[0].is_alive():
@@ -760,11 +767,13 @@ class Histogram:
             self._local.cell = cell
             self._local.home = cells
         i = bisect_right(self.bounds, value_ms)
+        schedcheck.yield_point("trace.hist.bump", obj=self)
         cell[i] += 1                    # owner thread only: exact
         cell[-1] += value_ms            # sum (float; owner-only)
         if exemplar:
             # one C-atomic slot store of an immutable tuple — a scrape
             # racing this sees either the old or the new exemplar, whole
+            schedcheck.yield_point("trace.hist.exemplar", obj=self)
             self._exemplars[i] = (exemplar, value_ms, time.time())
 
     def exemplars(self) -> List[dict]:
@@ -790,6 +799,7 @@ class Histogram:
         n_buckets = len(self.bounds) + 1
         per_bucket = [0] * n_buckets
         total = 0.0
+        schedcheck.yield_point("trace.hist.snapshot", obj=self, mode="r")
         for entry in list(self._cells):
             copied = entry[1][:]        # one C-atomic slice copy
             for i in range(n_buckets):
